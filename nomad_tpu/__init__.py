@@ -1,0 +1,28 @@
+"""nomad-tpu: a TPU-native cluster-scheduling framework.
+
+A from-scratch re-design of the capabilities of HashiCorp Nomad (reference:
+/root/reference) built TPU-first: the control plane (state store, eval broker,
+plan applier, client agents, HTTP API) is host-side Python/C++, while the
+scheduler's hot inner loop -- feasibility filtering, bin-pack/spread/affinity
+scoring, and preemption search -- is reformulated as dense, vmapped JAX/XLA
+computations over allocation x node resource matrices and solved on TPU.
+
+Layout (mirrors SURVEY.md section 2 component inventory):
+  structs/    data model: Job/TaskGroup/Task/Node/Allocation/Evaluation/Plan
+              (reference: nomad/structs/)
+  state/      MVCC state store with index-watch blocking queries
+              (reference: nomad/state/)
+  tensor/     tensorization: structs <-> packed dense int32/float32 matrices
+  scheduler/  host-side reference-path scheduler -- the parity oracle
+              (reference: scheduler/)
+  solver/     the TPU solver core: vmapped feasibility/binpack/preemption
+  server/     control plane: eval broker, plan queue+applier, workers,
+              heartbeats, blocked evals, periodic dispatch, GC
+              (reference: nomad/)
+  client/     node agent: fingerprinting, alloc/task runners, drivers
+              (reference: client/)
+  api/        HTTP API + agent glue (reference: command/agent/)
+  parallel/   device-mesh sharding of the solver (multi-chip scale axis)
+"""
+
+__version__ = "0.1.0"

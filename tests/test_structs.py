@@ -1,0 +1,148 @@
+"""Data-model tests (reference analog: nomad/structs/funcs_test.go)."""
+import math
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    AllocatedPortMapping, ComparableResources, NetworkIndex, NetworkResource,
+    Port, allocs_fit, score_fit_binpack, score_fit_spread,
+    ALLOC_CLIENT_COMPLETE, ALLOC_DESIRED_STOP,
+)
+
+
+def test_comparable_superset():
+    a = ComparableResources(cpu_shares=2000, memory_mb=2048, disk_mb=10000)
+    b = ComparableResources(cpu_shares=2000, memory_mb=2048, disk_mb=10000)
+    ok, _ = a.superset(b)
+    assert ok
+    b.cpu_shares = 2001
+    ok, dim = a.superset(b)
+    assert not ok and dim == "cpu"
+
+
+def test_allocs_fit_basic():
+    n = mock.node()
+    j = mock.job()
+    a1 = mock.alloc_for(j, n)
+    fits, dim, used = allocs_fit(n, [a1])
+    assert fits, dim
+    assert used.cpu_shares == 500 and used.memory_mb == 256
+
+    # 8 more of the same still fit cpu-wise (9*500=4500 > 4000 fails)
+    allocs = [mock.alloc_for(j, n, i) for i in range(8)]
+    fits, dim, _ = allocs_fit(n, allocs)
+    assert fits
+    allocs.append(mock.alloc_for(j, n, 8))
+    fits, dim, _ = allocs_fit(n, allocs)
+    assert not fits and dim == "cpu"
+
+
+def test_allocs_fit_ignores_client_terminal():
+    n = mock.node()
+    j = mock.job()
+    allocs = [mock.alloc_for(j, n, i) for i in range(9)]
+    allocs[0].client_status = ALLOC_CLIENT_COMPLETE
+    fits, _, used = allocs_fit(n, allocs)
+    assert fits
+    assert used.cpu_shares == 8 * 500
+
+
+def test_allocs_fit_server_stop_still_counts():
+    # Server-side stop without client-terminal still consumes (reference:
+    # AllocsFit only skips ClientTerminalStatus, funcs.go:150)
+    n = mock.node()
+    j = mock.job()
+    allocs = [mock.alloc_for(j, n, i) for i in range(9)]
+    allocs[0].desired_status = ALLOC_DESIRED_STOP
+    fits, dim, _ = allocs_fit(n, allocs)
+    assert not fits and dim == "cpu"
+
+
+def test_allocs_fit_core_overlap():
+    n = mock.node()
+    j = mock.job()
+    a1 = mock.alloc_for(j, n)
+    a2 = mock.alloc_for(j, n, 1)
+    a1.allocated_resources.tasks["web"].reserved_cores = [0, 1]
+    a2.allocated_resources.tasks["web"].reserved_cores = [1]
+    fits, dim, _ = allocs_fit(n, [a1, a2])
+    assert not fits and dim == "cores"
+
+
+def test_allocs_fit_port_collision():
+    n = mock.node()
+    j = mock.job()
+    a1 = mock.alloc_for(j, n)
+    a2 = mock.alloc_for(j, n, 1)
+    for a in (a1, a2):
+        a.allocated_resources.shared.ports = [
+            AllocatedPortMapping(label="http", value=8080, host_ip="192.168.0.100")]
+    fits, dim, _ = allocs_fit(n, [a1, a2])
+    assert not fits and "collision" in dim
+
+
+def test_score_fit_binpack_reference_points():
+    n = mock.node()  # 4000 MHz, 8192 MB
+    # Empty utilization: free=1.0 each -> total 20 -> score 0
+    assert score_fit_binpack(n, ComparableResources()) == 0.0
+    # Full: free=0 -> total 2 -> score 18
+    full = ComparableResources(cpu_shares=4000, memory_mb=8192)
+    assert score_fit_binpack(n, full) == 18.0
+    # Half: free=0.5 -> total 2*sqrt(10) -> 20-6.324..
+    half = ComparableResources(cpu_shares=2000, memory_mb=4096)
+    expected = 20.0 - 2 * math.pow(10, 0.5)
+    assert abs(score_fit_binpack(n, half) - expected) < 1e-12
+    # Spread is the mirror image
+    assert score_fit_spread(n, ComparableResources()) == 18.0
+    assert score_fit_spread(n, full) == 0.0
+
+
+def test_score_fit_binpack_with_node_reserved():
+    n = mock.node()
+    n.reserved_resources.cpu_shares = 2000
+    n.reserved_resources.memory_mb = 4096
+    # usable: 2000 MHz / 4096 MB; util of that size -> perfect fit
+    full = ComparableResources(cpu_shares=2000, memory_mb=4096)
+    assert score_fit_binpack(n, full) == 18.0
+
+
+def test_network_index_assign_ports():
+    n = mock.node()
+    idx = NetworkIndex()
+    assert idx.set_node(n) is None
+    ask = [NetworkResource(
+        reserved_ports=[Port(label="admin", value=8080)],
+        dynamic_ports=[Port(label="http"), Port(label="rpc")])]
+    got, err = idx.assign_ports(ask)
+    assert err == ""
+    labels = {p.label: p.value for p in got.ports}
+    assert labels["admin"] == 8080
+    assert labels["http"] == 20000     # deterministic lowest-free
+    assert labels["rpc"] == 20001
+
+
+def test_network_index_reserved_collision():
+    n = mock.node()
+    n.reserved_resources.reserved_ports = [8080]
+    idx = NetworkIndex()
+    assert idx.set_node(n) is None
+    ask = [NetworkResource(reserved_ports=[Port(label="admin", value=8080)])]
+    got, err = idx.assign_ports(ask)
+    assert got is None and "collision" in err
+
+
+def test_node_compute_class_stable():
+    n1 = mock.node()
+    n2 = mock.node()
+    # differing unique attrs (id/name) must not affect class
+    n1.attributes["unique.hostname"] = "a"
+    n2.attributes["unique.hostname"] = "b"
+    assert n1.compute_class() == n2.compute_class()
+    n2.attributes["kernel.name"] = "darwin"
+    assert n1.compute_class() != n2.compute_class()
+
+
+def test_alloc_index():
+    n = mock.node()
+    j = mock.job()
+    a = mock.alloc_for(j, n, 7)
+    assert a.index() == 7
