@@ -1,0 +1,11 @@
+"""Host-side reference-path scheduler -- the parity oracle
+(reference: /root/reference/scheduler/)."""
+from .context import EvalContext, EvalEligibility  # noqa: F401
+from .factory import new_scheduler, register_scheduler, registered_schedulers  # noqa: F401
+from .generic import GenericScheduler, SetStatusError  # noqa: F401
+from .harness import Harness  # noqa: F401
+from .rank import BinPackIterator, RankedNode  # noqa: F401
+from .reconcile import AllocReconciler, ReconcileResults, tasks_updated  # noqa: F401
+from .stack import GenericStack, SelectOptions, SystemStack  # noqa: F401
+from .system import SystemScheduler  # noqa: F401
+from .util import shuffle_nodes, shuffled_order, tainted_nodes  # noqa: F401

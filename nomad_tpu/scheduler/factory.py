@@ -1,0 +1,47 @@
+"""Scheduler factory registry (reference:
+/root/reference/scheduler/scheduler.go:27-49 Factory + BuiltinSchedulers).
+
+The TPU solver registers here too: scheduler type names stay {service,
+batch, system, sysbatch}; the *algorithm* (binpack/spread/tpu-binpack/
+tpu-spread) is a SchedulerConfiguration concern read by the stack
+(reference: stack.go:292, rank.go:192)."""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_scheduler(name: str, factory: Callable) -> None:
+    _REGISTRY[name] = factory
+
+
+def new_scheduler(name: str, state, planner, **kwargs):
+    factory = _REGISTRY.get(name)
+    if factory is None:
+        raise ValueError(f"unknown scheduler '{name}'")
+    return factory(state, planner, **kwargs)
+
+
+def registered_schedulers():
+    return sorted(_REGISTRY)
+
+
+def _register_builtins() -> None:
+    from .generic import GenericScheduler
+    from .system import SystemScheduler
+    register_scheduler(
+        "service", lambda state, planner, **kw:
+        GenericScheduler(state, planner, batch=False, **kw))
+    register_scheduler(
+        "batch", lambda state, planner, **kw:
+        GenericScheduler(state, planner, batch=True, **kw))
+    register_scheduler(
+        "system", lambda state, planner, **kw:
+        SystemScheduler(state, planner, sysbatch=False, **kw))
+    register_scheduler(
+        "sysbatch", lambda state, planner, **kw:
+        SystemScheduler(state, planner, sysbatch=True, **kw))
+
+
+_register_builtins()

@@ -1,0 +1,362 @@
+"""GenericScheduler: service and batch evaluation processing.
+
+Semantic parity with /root/reference/scheduler/generic_sched.go
+(Process :149, process :248, computeJobAllocs :364, computePlacements :511)
+and scheduler.go (Scheduler/State/Planner interfaces :59-151, Factory :27).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional, Set
+
+from ..structs import (
+    AllocatedResources, AllocatedSharedResources, Allocation, Evaluation, Job,
+    Plan, PlanResult, RescheduleEvent, RescheduleTracker, generate_uuid,
+    ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST, ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED, EVAL_STATUS_PENDING, JOB_TYPE_BATCH, JOB_TYPE_SERVICE,
+    NODE_STATUS_DOWN, TRIGGER_ALLOC_STOP, TRIGGER_DEPLOYMENT_WATCHER,
+    TRIGGER_JOB_DEREGISTER, TRIGGER_JOB_REGISTER, TRIGGER_MAX_DISCONNECT_TIMEOUT,
+    TRIGGER_NODE_DRAIN, TRIGGER_NODE_UPDATE, TRIGGER_PERIODIC_JOB,
+    TRIGGER_QUEUED_ALLOCS, TRIGGER_RECONNECT, TRIGGER_RETRY_FAILED_ALLOC,
+    TRIGGER_ROLLING_UPDATE, TRIGGER_FAILED_FOLLOW_UP, TRIGGER_SCALING,
+)
+from .context import EvalContext
+from .reconcile import (
+    ALLOC_RESCHEDULED, AllocPlaceResult, AllocReconciler, ReconcileResults,
+)
+from .stack import GenericStack, SelectOptions
+from .util import progress_made, tainted_nodes
+
+MAX_SERVICE_SCHEDULE_ATTEMPTS = 5
+MAX_BATCH_SCHEDULE_ATTEMPTS = 2
+
+BLOCKED_EVAL_MAX_PLAN = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
+
+
+class SetStatusError(Exception):
+    """Terminal scheduling failure that still sets eval status
+    (reference: generic_sched.go SetStatusError)."""
+
+    def __init__(self, msg: str, status: str = EVAL_STATUS_FAILED):
+        super().__init__(msg)
+        self.eval_status = status
+
+
+class GenericScheduler:
+    """(reference: generic_sched.go:101 GenericScheduler)"""
+
+    def __init__(self, state, planner, batch: bool = False, logger=None):
+        self.state = state
+        self.planner = planner
+        self.batch = batch
+        self.logger = logger
+
+        self.eval: Optional[Evaluation] = None
+        self.job: Optional[Job] = None
+        self.plan: Optional[Plan] = None
+        self.plan_result: Optional[PlanResult] = None
+        self.ctx: Optional[EvalContext] = None
+        self.stack: Optional[GenericStack] = None
+        self.deployment = None
+
+        self.blocked: Optional[Evaluation] = None
+        self.failed_tg_allocs: Dict[str, object] = {}
+        self.queued_allocs: Dict[str, int] = {}
+        self.followup_evals: Dict[str, List[Evaluation]] = {}
+
+    # ------------------------------------------------------------------
+    def process(self, evaluation: Evaluation):
+        """Entry point (reference: generic_sched.go:149 Process)."""
+        self.eval = evaluation
+
+        ok_triggers = {
+            TRIGGER_JOB_REGISTER, TRIGGER_JOB_DEREGISTER, TRIGGER_NODE_DRAIN,
+            TRIGGER_NODE_UPDATE, TRIGGER_ALLOC_STOP, TRIGGER_ROLLING_UPDATE,
+            TRIGGER_QUEUED_ALLOCS, TRIGGER_DEPLOYMENT_WATCHER,
+            TRIGGER_RETRY_FAILED_ALLOC, TRIGGER_FAILED_FOLLOW_UP,
+            TRIGGER_MAX_DISCONNECT_TIMEOUT, TRIGGER_RECONNECT,
+            TRIGGER_PERIODIC_JOB, TRIGGER_SCALING, "job-scaling",
+        }
+        if evaluation.triggered_by not in ok_triggers:
+            desc = f"scheduler cannot handle '{evaluation.triggered_by}' evaluation"
+            self.planner.update_eval(self._eval_with_status(
+                EVAL_STATUS_FAILED, desc))
+            return None
+
+        limit = (MAX_BATCH_SCHEDULE_ATTEMPTS if self.batch
+                 else MAX_SERVICE_SCHEDULE_ATTEMPTS)
+        attempts = 0
+        err: Optional[Exception] = None
+        while attempts < limit:
+            try:
+                done = self._process_once()
+            except SetStatusError as e:
+                self.planner.update_eval(self._eval_with_status(
+                    e.eval_status, str(e)))
+                return e
+            if done:
+                err = None
+                break
+            if progress_made(self.plan_result):
+                attempts = 0
+            else:
+                attempts += 1
+            if attempts >= limit:
+                err = SetStatusError(
+                    f"maximum attempts reached ({limit})")
+        if err is not None:
+            self.planner.update_eval(self._eval_with_status(
+                EVAL_STATUS_FAILED, str(err)))
+            return err
+
+        self.planner.update_eval(self._eval_with_status(
+            EVAL_STATUS_COMPLETE, ""))
+        return None
+
+    def _eval_with_status(self, status: str, desc: str) -> Evaluation:
+        ev = self.eval.copy()
+        ev.status = status
+        ev.status_description = desc
+        if self.blocked is not None:
+            ev.blocked_eval = self.blocked.id
+        ev.failed_tg_allocs = dict(self.failed_tg_allocs)
+        ev.queued_allocations = dict(self.queued_allocs)
+        return ev
+
+    # ------------------------------------------------------------------
+    def _process_once(self) -> bool:
+        """(reference: generic_sched.go:248 process) Returns True when the
+        plan fully committed (or was a no-op)."""
+        self.blocked = None
+        self.failed_tg_allocs = {}
+
+        ns, job_id = self.eval.namespace, self.eval.job_id
+        self.job = self.state.job_by_id(ns, job_id)
+        num_tainted = 0
+
+        self.plan = Plan(
+            eval_id=self.eval.id,
+            priority=self.eval.priority,
+            job=self.job,
+            all_at_once=self.job.all_at_once if self.job else False,
+        )
+        self.ctx = EvalContext(self.state, self.plan, self.logger)
+        self.stack = GenericStack(self.batch, self.ctx)
+        if self.job is not None and not self.job.stopped():
+            if hasattr(self.state, "scheduler_config"):
+                self.stack.set_scheduler_configuration(
+                    self.state.scheduler_config())
+            self.stack.set_job(self.job)
+            nodes = self.state.ready_nodes_in_pool(self.job.node_pool)
+            # datacenter filter (reference: readyNodesInDCsAndPool)
+            dcs = set(self.job.datacenters)
+            if "*" not in dcs:
+                nodes = [n for n in nodes if n.datacenter in dcs]
+            self.stack.set_nodes(nodes)
+            self.ctx.metrics.nodes_in_pool = len(nodes)
+
+        if not self._compute_job_allocs():
+            return False
+
+        # Queued allocations accounting for annotations
+        return self._finish_plan()
+
+    def _compute_job_allocs(self) -> bool:
+        """(reference: generic_sched.go:364 computeJobAllocs)"""
+        ns, job_id = self.eval.namespace, self.eval.job_id
+        allocs = self.state.allocs_by_job(ns, job_id)
+        tainted = tainted_nodes(self.state, allocs)
+
+        # node-update evals mark running allocs on down nodes lost
+        # (reference: generic_sched.go:382 updateNonTerminalAllocsToLost)
+        reconciler = AllocReconciler(
+            batch=self.batch,
+            job_id=job_id,
+            job=self.job if (self.job and not self.job.stopped()) else None,
+            deployment=self.state.latest_deployment_by_job(ns, job_id),
+            existing_allocs=allocs,
+            tainted_nodes=tainted,
+            eval_id=self.eval.id,
+            eval_priority=self.eval.priority,
+        )
+        results = reconciler.compute()
+        self.followup_evals = results.desired_followup_evals
+
+        if results.deployment is not None:
+            self.plan.deployment = results.deployment
+        self.plan.deployment_updates = list(results.deployment_updates)
+
+        # Stops
+        for stop in results.stop:
+            self.plan.append_stopped_alloc(
+                stop.alloc, stop.status_description, stop.client_status,
+                stop.followup_eval_id)
+
+        # Disconnect/reconnect attribute updates ride the plan as allocs
+        for alloc in results.disconnect_updates.values():
+            self.plan.append_alloc(alloc)
+        for alloc in results.reconnect_updates.values():
+            self.plan.append_alloc(alloc)
+
+        # In-place updates
+        for alloc in results.inplace_update:
+            self.plan.append_alloc(alloc)
+
+        # Followup evals must exist before failed allocs reference them
+        for evals in self.followup_evals.values():
+            for ev in evals:
+                self.planner.create_eval(ev)
+
+        # Queued per TG
+        self.queued_allocs = {
+            tg: du.place + du.destructive_update
+            for tg, du in results.desired_tg_updates.items()}
+
+        # Destructive updates: stop + place
+        destructive_places: List[AllocPlaceResult] = []
+        for d in results.destructive_update:
+            self.plan.append_stopped_alloc(
+                d.stop_alloc, d.stop_status_description)
+            destructive_places.append(AllocPlaceResult(
+                name=d.place_name, task_group=d.place_task_group,
+                previous_alloc=d.stop_alloc))
+
+        if self.job is None or self.job.stopped():
+            return True
+
+        return self._compute_placements(
+            results.place + destructive_places)
+
+    def _compute_placements(self, places: List[AllocPlaceResult]) -> bool:
+        """(reference: generic_sched.go:511 computePlacements)"""
+        deployment_id = ""
+        if self.plan.deployment is not None:
+            deployment_id = self.plan.deployment.id
+
+        for place in places:
+            tg = place.task_group
+            # Penalty node: previous alloc's node when rescheduling
+            penalty: Set[str] = set()
+            preferred = []
+            prev = place.previous_alloc
+            if prev is not None:
+                if place.reschedule:
+                    penalty.add(prev.node_id)
+                if (tg.ephemeral_disk.sticky and not place.previous_lost):
+                    node = self.state.node_by_id(prev.node_id)
+                    # Only steer back to a node still accepting work
+                    # (reference: generic_sched.go:889 preferredNode.Ready())
+                    if node is not None and node.ready():
+                        preferred = [node]
+
+            option = self.stack.select(tg, SelectOptions(
+                penalty_node_ids=penalty,
+                preferred_nodes=preferred,
+                alloc_name=place.name,
+                preempt=self._preemption_enabled()))
+
+            if option is None:
+                # Failed placement: record metrics, coalesce
+                if tg.name in self.failed_tg_allocs:
+                    self.failed_tg_allocs[tg.name].coalesced_failures += 1
+                else:
+                    self.failed_tg_allocs[tg.name] = self.ctx.metrics.copy()
+                continue
+
+            resources = AllocatedResources(
+                tasks=dict(option.task_resources),
+                shared=option.alloc_resources
+                if option.alloc_resources is not None
+                else AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb))
+
+            alloc = Allocation(
+                id=generate_uuid(),
+                namespace=self.job.namespace,
+                eval_id=self.eval.id,
+                name=place.name,
+                job_id=self.job.id,
+                job=self.job,
+                job_version=self.job.version,
+                task_group=tg.name,
+                node_id=option.node.id,
+                node_name=option.node.name,
+                deployment_id=deployment_id,
+                allocated_resources=resources,
+                desired_status=ALLOC_DESIRED_RUN,
+                client_status="pending",
+                metrics=self.ctx.metrics.copy(),
+            )
+            if prev is not None:
+                alloc.previous_allocation = prev.id
+                if place.reschedule:
+                    tracker = RescheduleTracker()
+                    if prev.reschedule_tracker is not None:
+                        tracker.events = list(prev.reschedule_tracker.events)
+                    tracker.events.append(RescheduleEvent(
+                        reschedule_time=_time.time(),
+                        prev_alloc_id=prev.id,
+                        prev_node_id=prev.node_id))
+                    alloc.reschedule_tracker = tracker
+
+            if option.preempted_allocs:
+                for p in option.preempted_allocs:
+                    self.plan.append_preempted_alloc(p, alloc.id)
+
+            self.plan.append_alloc(alloc)
+
+        # Any failures -> blocked eval for the remainder (service only)
+        if self.failed_tg_allocs and not self.batch:
+            self._queue_blocked_eval()
+        return True
+
+    def _preemption_enabled(self) -> bool:
+        cfg = (self.state.scheduler_config()
+               if hasattr(self.state, "scheduler_config") else None)
+        if cfg is None:
+            return False
+        sched_type = JOB_TYPE_BATCH if self.batch else JOB_TYPE_SERVICE
+        return cfg.preemption_config.is_enabled(sched_type)
+
+    def _queue_blocked_eval(self) -> None:
+        """(reference: generic_sched.go:300 + blocked eval creation)"""
+        if self.blocked is not None:
+            return
+        elig = self.ctx.eligibility()
+        blocked = Evaluation(
+            id=generate_uuid(),
+            namespace=self.eval.namespace,
+            priority=self.eval.priority,
+            type=self.eval.type,
+            triggered_by=TRIGGER_QUEUED_ALLOCS,
+            job_id=self.eval.job_id,
+            status=EVAL_STATUS_BLOCKED,
+            previous_eval=self.eval.id,
+            class_eligibility=elig.class_eligibility(),
+            escaped_computed_class=elig.has_escaped(),
+        )
+        self.blocked = blocked
+        self.planner.create_eval(blocked)
+
+    def _finish_plan(self) -> bool:
+        if self.plan.is_no_op():
+            self.plan_result = None
+            return True
+        result, new_state = self.planner.submit_plan(self.plan)
+        self.plan_result = result
+        if result is None:
+            return False
+        # Decrement queued allocations by what actually committed
+        # (reference: generic_sched.go:339 adjustQueuedAllocations)
+        for allocs in result.node_allocation.values():
+            for alloc in allocs:
+                if alloc.task_group in self.queued_allocs:
+                    self.queued_allocs[alloc.task_group] -= 1
+        full, expected, actual = result.full_commit(self.plan)
+        if not full:
+            if new_state is not None:
+                self.state = new_state
+            return False
+        return True

@@ -1,0 +1,86 @@
+"""Scheduler test harness (reference: /root/reference/scheduler/testing.go).
+
+Wraps a real StateStore with a fake Planner that locally applies submitted
+plans to the store -- the mechanism the reference uses for all scheduler
+unit tests, and the parity-diff mechanism between the host oracle and the
+TPU solver path (SURVEY.md section 4).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..state import StateStore
+from ..structs import (
+    Evaluation, Plan, PlanResult, allocs_fit,
+)
+from .factory import new_scheduler
+
+
+class Harness:
+    """(reference: testing.go:50 Harness)"""
+
+    def __init__(self, state: Optional[StateStore] = None):
+        self.state = state if state is not None else StateStore()
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []
+        self.create_evals: List[Evaluation] = []
+        self.reblock_evals: List[Evaluation] = []
+        self.reject_plan = False
+        self.reject_tracker = 0
+        self._lock = threading.Lock()
+
+    # -- Planner interface ---------------------------------------------------
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], object]:
+        with self._lock:
+            self.plans.append(plan)
+            if self.reject_plan:
+                self.reject_tracker += 1
+                result = PlanResult(refresh_index=self.state.latest_index())
+                return result, self.state.snapshot()
+
+            result = PlanResult(
+                node_update={k: list(v) for k, v in plan.node_update.items()},
+                node_allocation={k: list(v)
+                                 for k, v in plan.node_allocation.items()},
+                node_preemptions={k: list(v)
+                                  for k, v in plan.node_preemptions.items()},
+                deployment=plan.deployment,
+                deployment_updates=list(plan.deployment_updates),
+            )
+            index = self.state.upsert_plan_results(result)
+            result.alloc_index = index
+            return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        with self._lock:
+            self.evals.append(ev)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        with self._lock:
+            self.create_evals.append(ev)
+            self.state.upsert_evals([ev])
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        with self._lock:
+            self.reblock_evals.append(ev)
+
+    def scheduler_config(self):
+        return self.state.scheduler_config()
+
+    # -- driving -------------------------------------------------------------
+    def process(self, factory_name_or_fn, ev: Evaluation):
+        """Instantiate the scheduler for the eval type and run it
+        (reference: testing.go Process)."""
+        snap = self.state.snapshot()
+        if callable(factory_name_or_fn):
+            sched = factory_name_or_fn(snap, self)
+        else:
+            sched = new_scheduler(factory_name_or_fn, snap, self)
+        return sched.process(ev)
+
+    def assert_eval_status(self, testcase, count: int, status: str) -> None:
+        assert len(self.evals) == count, \
+            f"expected {count} eval updates, got {len(self.evals)}"
+        assert self.evals[-1].status == status, \
+            f"expected status {status}, got {self.evals[-1].status}"

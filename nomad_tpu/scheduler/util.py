@@ -1,0 +1,134 @@
+"""Scheduler utilities (reference: /root/reference/scheduler/util.go).
+
+The deterministic node shuffle is a re-design of the reference's
+Go-rand-seeded Fisher-Yates (util.go:167 shuffleNodes): we keep the same
+seeding contract (last 8 bytes of the eval ID XOR the refresh index, so
+retried plans reshuffle) but use splitmix64 as the PRNG so the host oracle,
+the TPU solver, and any future C++ runtime can reproduce the order exactly
+from the same integer seed.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import (
+    Allocation, Job, Node, Plan, NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED,
+)
+
+MASK64 = (1 << 64) - 1
+
+
+def splitmix64(state: int) -> Tuple[int, int]:
+    """One step of splitmix64; returns (new_state, output)."""
+    state = (state + 0x9E3779B97F4A7C15) & MASK64
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & MASK64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & MASK64
+    z = z ^ (z >> 31)
+    return state, z
+
+
+def shuffle_seed(eval_id: str, index: int) -> int:
+    """Derive the shuffle seed from eval ID + refresh index
+    (reference contract: util.go:167-177)."""
+    raw = eval_id.encode()[-8:].rjust(8, b"\0")
+    seed = int.from_bytes(raw, "big") ^ (index & MASK64)
+    return seed & MASK64
+
+
+def shuffle_nodes(plan: Plan, index: int, nodes: List[Node]) -> None:
+    """In-place deterministic Fisher-Yates (reference: util.go shuffleNodes)."""
+    state = shuffle_seed(plan.eval_id, index)
+    n = len(nodes)
+    for i in range(n - 1, 0, -1):
+        state, out = splitmix64(state)
+        j = out % (i + 1)
+        nodes[i], nodes[j] = nodes[j], nodes[i]
+
+
+def shuffled_order(eval_id: str, index: int, n: int) -> List[int]:
+    """The permutation shuffle_nodes applies, as index positions -- used by
+    the TPU solver to reproduce the host shuffle on dense arrays."""
+    order = list(range(n))
+    state = shuffle_seed(eval_id, index)
+    for i in range(n - 1, 0, -1):
+        state, out = splitmix64(state)
+        j = out % (i + 1)
+        order[i], order[j] = order[j], order[i]
+    return order
+
+
+def tainted_nodes(state, allocs: List[Allocation]) -> Dict[str, Optional[Node]]:
+    """Map of node id -> node for nodes that are down/draining/disconnected
+    or deregistered (None) among the allocs' nodes
+    (reference: util.go:130 taintedNodes)."""
+    out: Dict[str, Optional[Node]] = {}
+    for alloc in allocs:
+        if alloc.node_id in out:
+            continue
+        node = state.node_by_id(alloc.node_id)
+        if node is None:
+            out[alloc.node_id] = None
+            continue
+        if node.status == NODE_STATUS_DOWN or node.drain:
+            out[alloc.node_id] = node
+        elif node.status == NODE_STATUS_DISCONNECTED:
+            out[alloc.node_id] = node
+    return out
+
+
+def retry_max(max_attempts: int, cb, reset_cb=None):
+    """Retry cb up to max_attempts, resetting the count when reset_cb says
+    progress was made (reference: util.go:94 retryMax)."""
+    attempts = 0
+    while attempts < max_attempts:
+        done, err = cb()
+        if done:
+            return None
+        if reset_cb is not None and reset_cb():
+            attempts = 0
+        else:
+            attempts += 1
+    from .generic import SetStatusError  # local import to avoid cycle
+    return SetStatusError(f"maximum attempts reached ({max_attempts})")
+
+
+def progress_made(result) -> bool:
+    """Did the plan application commit anything? (reference: util.go:120)"""
+    return result is not None and (
+        result.node_update or result.node_allocation
+        or result.deployment is not None or result.deployment_updates)
+
+
+def alloc_name(job_id: str, tg_name: str, idx: int) -> str:
+    return f"{job_id}.{tg_name}[{idx}]"
+
+
+def resolve_target(target: str, node: Node):
+    """Resolve an interpolation target like ${attr.kernel.name} against a
+    node (reference: feasible.go resolveTarget). Returns (value, found)."""
+    if not target.startswith("${"):
+        # raw values are returned as-is (constraint RTarget side)
+        return target, True
+    inner = target[2:-1] if target.endswith("}") else target[2:]
+    if inner == "node.unique.id":
+        return node.id, True
+    if inner == "node.datacenter":
+        return node.datacenter, True
+    if inner == "node.unique.name":
+        return node.name, True
+    if inner == "node.class":
+        return node.node_class, True
+    if inner == "node.pool":
+        return node.node_pool, True
+    if inner.startswith("attr."):
+        key = inner[len("attr."):]
+        if key in node.attributes:
+            return node.attributes[key], True
+        return "", False
+    if inner.startswith("meta."):
+        key = inner[len("meta."):]
+        if key in node.meta:
+            return node.meta[key], True
+        return "", False
+    return "", False

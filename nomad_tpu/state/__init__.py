@@ -1,0 +1,2 @@
+"""MVCC state store (reference: /root/reference/nomad/state/)."""
+from .store import StateStore, StateSnapshot, TABLES  # noqa: F401

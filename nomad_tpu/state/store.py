@@ -1,0 +1,533 @@
+"""MVCC state store with index-watch blocking queries.
+
+Semantic parity with /root/reference/nomad/state/state_store.go over
+go-memdb: every write bumps a monotone raft-style index, reads run against
+cheap snapshots (copy-on-write dict views -- objects are replaced on write,
+never mutated in place, which is what makes snapshots safe to share with
+concurrently-running scheduler workers, mirroring the immutable-radix
+guarantee), and watchers block until a table index advances
+(reference: nomad/rpc.go:852 blockingRPC + go-memdb WatchSet).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..structs import (
+    Allocation, Deployment, Evaluation, Job, Node, NodePool, Plan, PlanResult,
+    SchedulerConfiguration,
+    ALLOC_DESIRED_STOP, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
+    ALLOC_CLIENT_COMPLETE,
+    EVAL_STATUS_BLOCKED, JOB_STATUS_DEAD, JOB_STATUS_PENDING,
+    JOB_STATUS_RUNNING, NODE_STATUS_DOWN,
+)
+
+TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
+          "scheduler_config", "job_versions")
+
+
+class StateSnapshot:
+    """An immutable point-in-time view (reference: state.StateSnapshot).
+
+    Shares object references with the live store; safe because writes
+    replace objects instead of mutating them.
+    """
+
+    def __init__(self, store: "StateStore"):
+        with store._lock:
+            self.index = store._index
+            self._nodes = dict(store._nodes)
+            self._jobs = dict(store._jobs)
+            self._evals = dict(store._evals)
+            self._allocs = dict(store._allocs)
+            self._deployments = dict(store._deployments)
+            self._node_pools = dict(store._node_pools)
+            self._scheduler_config = store._scheduler_config
+            self._allocs_by_node = {k: list(v) for k, v in store._allocs_by_node.items()}
+            self._allocs_by_job = {k: list(v) for k, v in store._allocs_by_job.items()}
+
+    # -- read API shared with the live store ---------------------------------
+    def latest_index(self) -> int:
+        return self.index
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._nodes.get(node_id)
+
+    def nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def ready_nodes_in_pool(self, pool: str = "all") -> List[Node]:
+        """(reference: state_store.go ReadyNodesInDC / node pool filtering)"""
+        out = []
+        for n in self._nodes.values():
+            if not n.ready():
+                continue
+            if pool not in ("", "all") and n.node_pool != pool:
+                continue
+            out.append(n)
+        return out
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._jobs.get((namespace, job_id))
+
+    def jobs(self) -> List[Job]:
+        return list(self._jobs.values())
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._evals.get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [e for e in self._evals.values()
+                if e.namespace == namespace and e.job_id == job_id]
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._allocs.get(alloc_id)
+
+    def allocs(self) -> List[Allocation]:
+        return list(self._allocs.values())
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return [self._allocs[i] for i in self._allocs_by_node.get(node_id, ())
+                if i in self._allocs]
+
+    def allocs_by_node_terminal(self, node_id: str,
+                                terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, namespace: str, job_id: str,
+                      anyCreateIndex: bool = True) -> List[Allocation]:
+        return [self._allocs[i]
+                for i in self._allocs_by_job.get((namespace, job_id), ())
+                if i in self._allocs]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return [a for a in self._allocs.values() if a.eval_id == eval_id]
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self._deployments.get(deployment_id)
+
+    def latest_deployment_by_job(self, namespace: str,
+                                 job_id: str) -> Optional[Deployment]:
+        best = None
+        for d in self._deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def deployments(self) -> List[Deployment]:
+        return list(self._deployments.values())
+
+    def node_pool_by_name(self, name: str) -> Optional[NodePool]:
+        return self._node_pools.get(name)
+
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self._scheduler_config
+
+
+class StateStore:
+    """The live, writable store. All writes go through raft in the reference
+    (fsm.go:211 nomadFSM.Apply); here the FSM calls these methods directly
+    under one lock, bumping the index exactly once per logical write."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._index = 1
+        self._table_index: Dict[str, int] = {t: 1 for t in TABLES}
+        self._nodes: Dict[str, Node] = {}
+        self._jobs: Dict[Tuple[str, str], Job] = {}
+        self._job_versions: Dict[Tuple[str, str, int], Job] = {}
+        self._evals: Dict[str, Evaluation] = {}
+        self._allocs: Dict[str, Allocation] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        self._node_pools: Dict[str, NodePool] = {"default": NodePool(name="default"),
+                                                 "all": NodePool(name="all")}
+        self._scheduler_config = SchedulerConfiguration()
+        # secondary indexes
+        self._allocs_by_node: Dict[str, List[str]] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
+        # watch support
+        self._watch_cond = threading.Condition(self._lock)
+
+    # -- watch / blocking query ---------------------------------------------
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def table_index(self, *tables: str) -> int:
+        with self._lock:
+            return max(self._table_index.get(t, 0) for t in tables)
+
+    def block_until(self, min_index: int, timeout: float = 5.0,
+                    tables: Tuple[str, ...] = ()) -> int:
+        """Wait until the (table) index passes min_index
+        (reference: blockingRPC nomad/rpc.go:852). Returns current index."""
+        deadline = None
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        with self._watch_cond:
+            while True:
+                cur = (self.table_index(*tables) if tables else self._index)
+                if cur > min_index:
+                    return self._index
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return self._index
+                self._watch_cond.wait(remaining)
+
+    def _bump(self, *tables: str) -> int:
+        self._index += 1
+        for t in tables:
+            self._table_index[t] = self._index
+        self._watch_cond.notify_all()
+        return self._index
+
+    def snapshot(self) -> StateSnapshot:
+        return StateSnapshot(self)
+
+    # -- nodes ---------------------------------------------------------------
+    def upsert_node(self, node: Node) -> int:
+        with self._lock:
+            existing = self._nodes.get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+            else:
+                node.create_index = self._index + 1
+            node.modify_index = self._index + 1
+            if not node.computed_class:
+                node.compute_class()
+            self._nodes[node.id] = node
+            return self._bump("nodes")
+
+    def delete_node(self, node_id: str) -> int:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            return self._bump("nodes")
+
+    def update_node_status(self, node_id: str, status: str,
+                           updated_at: float = 0.0) -> int:
+        with self._lock:
+            old = self._nodes.get(node_id)
+            if old is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy as _copy
+            node = _copy.copy(old)
+            node.status = status
+            node.status_updated_at = updated_at
+            node.modify_index = self._index + 1
+            self._nodes[node_id] = node
+            return self._bump("nodes")
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
+        with self._lock:
+            old = self._nodes.get(node_id)
+            if old is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy as _copy
+            node = _copy.copy(old)
+            node.scheduling_eligibility = eligibility
+            node.modify_index = self._index + 1
+            self._nodes[node_id] = node
+            return self._bump("nodes")
+
+    def update_node_drain(self, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> int:
+        with self._lock:
+            old = self._nodes.get(node_id)
+            if old is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy as _copy
+            from ..structs import NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE
+            node = _copy.copy(old)
+            node.drain_strategy = drain_strategy
+            if drain_strategy is not None:
+                node.scheduling_eligibility = NODE_SCHED_INELIGIBLE
+            elif mark_eligible:
+                node.scheduling_eligibility = NODE_SCHED_ELIGIBLE
+            node.modify_index = self._index + 1
+            self._nodes[node_id] = node
+            return self._bump("nodes")
+
+    # -- jobs ----------------------------------------------------------------
+    def upsert_job(self, job: Job) -> int:
+        with self._lock:
+            key = (job.namespace, job.id)
+            existing = self._jobs.get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.version = existing.version + 1
+            else:
+                job.create_index = self._index + 1
+                job.version = 0
+            job.modify_index = self._index + 1
+            job.job_modify_index = self._index + 1
+            if job.status not in (JOB_STATUS_DEAD,):
+                job.status = JOB_STATUS_PENDING
+            self._jobs[key] = job
+            self._job_versions[(job.namespace, job.id, job.version)] = job
+            return self._bump("jobs", "job_versions")
+
+    def delete_job(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            self._jobs.pop((namespace, job_id), None)
+            for k in [k for k in self._job_versions
+                      if k[0] == namespace and k[1] == job_id]:
+                del self._job_versions[k]
+            return self._bump("jobs", "job_versions")
+
+    def job_version(self, namespace: str, job_id: str,
+                    version: int) -> Optional[Job]:
+        with self._lock:
+            return self._job_versions.get((namespace, job_id, version))
+
+    # -- evals ---------------------------------------------------------------
+    def upsert_evals(self, evals: List[Evaluation]) -> int:
+        with self._lock:
+            for ev in evals:
+                existing = self._evals.get(ev.id)
+                if existing is not None:
+                    ev.create_index = existing.create_index
+                else:
+                    ev.create_index = self._index + 1
+                ev.modify_index = self._index + 1
+                self._evals[ev.id] = ev
+                self._update_job_summary_status(ev)
+            return self._bump("evals")
+
+    def delete_evals(self, eval_ids: List[str]) -> int:
+        with self._lock:
+            for eid in eval_ids:
+                self._evals.pop(eid, None)
+            return self._bump("evals")
+
+    def _update_job_summary_status(self, ev: Evaluation) -> None:
+        # Blocked eval => job still pending work; minimal summary upkeep.
+        pass
+
+    # -- allocs --------------------------------------------------------------
+    def upsert_allocs(self, allocs: List[Allocation]) -> int:
+        with self._lock:
+            self._insert_allocs_locked(allocs)
+            return self._bump("allocs")
+
+    def _insert_allocs_locked(self, allocs: List[Allocation]) -> None:
+        for alloc in allocs:
+            existing = self._allocs.get(alloc.id)
+            if existing is not None:
+                alloc.create_index = existing.create_index
+            else:
+                alloc.create_index = self._index + 1
+            alloc.modify_index = self._index + 1
+            if alloc.job is None and existing is not None:
+                alloc.job = existing.job
+            self._allocs[alloc.id] = alloc
+            self._allocs_by_node.setdefault(alloc.node_id, [])
+            if alloc.id not in self._allocs_by_node[alloc.node_id]:
+                self._allocs_by_node[alloc.node_id].append(alloc.id)
+            jk = (alloc.namespace, alloc.job_id)
+            self._allocs_by_job.setdefault(jk, [])
+            if alloc.id not in self._allocs_by_job[jk]:
+                self._allocs_by_job[jk].append(alloc.id)
+
+    def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
+        """Client-side status updates (reference: Node.UpdateAlloc
+        node_endpoint.go:1322 -> state UpdateAllocsFromClient)."""
+        with self._lock:
+            for updated in allocs:
+                existing = self._allocs.get(updated.id)
+                if existing is None:
+                    continue
+                import copy as _copy
+                alloc = _copy.copy(existing)
+                alloc.client_status = updated.client_status
+                alloc.client_description = updated.client_description
+                alloc.task_states = dict(updated.task_states)
+                alloc.network_status = updated.network_status
+                if updated.deployment_status is not None:
+                    alloc.deployment_status = updated.deployment_status
+                alloc.modify_index = self._index + 1
+                self._allocs[alloc.id] = alloc
+            return self._bump("allocs")
+
+    def delete_allocs(self, alloc_ids: List[str]) -> int:
+        with self._lock:
+            for aid in alloc_ids:
+                a = self._allocs.pop(aid, None)
+                if a is not None:
+                    ids = self._allocs_by_node.get(a.node_id)
+                    if ids and aid in ids:
+                        ids.remove(aid)
+                    jids = self._allocs_by_job.get((a.namespace, a.job_id))
+                    if jids and aid in jids:
+                        jids.remove(aid)
+            return self._bump("allocs")
+
+    # -- deployments ---------------------------------------------------------
+    def upsert_deployment(self, deployment: Deployment) -> int:
+        with self._lock:
+            existing = self._deployments.get(deployment.id)
+            if existing is not None:
+                deployment.create_index = existing.create_index
+            else:
+                deployment.create_index = self._index + 1
+            deployment.modify_index = self._index + 1
+            self._deployments[deployment.id] = deployment
+            return self._bump("deployments")
+
+    def delete_deployment(self, deployment_id: str) -> int:
+        with self._lock:
+            self._deployments.pop(deployment_id, None)
+            return self._bump("deployments")
+
+    # -- node pools / config -------------------------------------------------
+    def upsert_node_pool(self, pool: NodePool) -> int:
+        with self._lock:
+            self._node_pools[pool.name] = pool
+            return self._bump("node_pools")
+
+    def set_scheduler_config(self, cfg: SchedulerConfiguration) -> int:
+        with self._lock:
+            cfg.modify_index = self._index + 1
+            self._scheduler_config = cfg
+            return self._bump("scheduler_config")
+
+    def scheduler_config(self) -> SchedulerConfiguration:
+        with self._lock:
+            return self._scheduler_config
+
+    # -- plan application ----------------------------------------------------
+    def upsert_plan_results(self, result: PlanResult,
+                            eval_updates: Optional[List[Evaluation]] = None
+                            ) -> int:
+        """Commit a verified plan in one logical raft write
+        (reference: state_store.go:382 UpsertPlanResults, applied by the FSM
+        for ApplyPlanResultsRequestType)."""
+        with self._lock:
+            stops: List[Allocation] = []
+            for allocs in result.node_update.values():
+                stops.extend(allocs)
+            for allocs in result.node_preemptions.values():
+                stops.extend(allocs)
+            placements: List[Allocation] = []
+            for allocs in result.node_allocation.values():
+                placements.extend(allocs)
+
+            # Stops/preemptions update desired status on existing allocs
+            import copy as _copy
+            for stop in stops:
+                existing = self._allocs.get(stop.id)
+                if existing is None:
+                    continue
+                alloc = _copy.copy(existing)
+                alloc.desired_status = stop.desired_status
+                alloc.desired_description = stop.desired_description
+                alloc.preempted_by_allocation = stop.preempted_by_allocation
+                if stop.client_status:
+                    alloc.client_status = stop.client_status
+                if stop.followup_eval_id:
+                    alloc.followup_eval_id = stop.followup_eval_id
+                alloc.modify_index = self._index + 1
+                self._allocs[alloc.id] = alloc
+
+            self._insert_allocs_locked(placements)
+
+            if result.deployment is not None:
+                d = result.deployment
+                existing_d = self._deployments.get(d.id)
+                if existing_d is not None:
+                    d.create_index = existing_d.create_index
+                else:
+                    d.create_index = self._index + 1
+                d.modify_index = self._index + 1
+                self._deployments[d.id] = d
+            for du in result.deployment_updates:
+                d = self._deployments.get(du.deployment_id)
+                if d is not None:
+                    nd = _copy.copy(d)
+                    nd.status = du.status
+                    nd.status_description = du.status_description
+                    nd.modify_index = self._index + 1
+                    self._deployments[nd.id] = nd
+
+            if eval_updates:
+                for ev in eval_updates:
+                    ev.modify_index = self._index + 1
+                    self._evals[ev.id] = ev
+
+            idx = self._bump("allocs", "deployments", "evals")
+            result.alloc_index = idx
+            return idx
+
+    # -- snapshot passthrough reads (so StateStore satisfies the scheduler's
+    #    State interface directly in tests) --------------------------------
+    def node_by_id(self, node_id):
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def nodes(self):
+        with self._lock:
+            return list(self._nodes.values())
+
+    def ready_nodes_in_pool(self, pool: str = "all"):
+        return StateSnapshot(self).ready_nodes_in_pool(pool)
+
+    def job_by_id(self, namespace, job_id):
+        with self._lock:
+            return self._jobs.get((namespace, job_id))
+
+    def jobs(self):
+        with self._lock:
+            return list(self._jobs.values())
+
+    def eval_by_id(self, eval_id):
+        with self._lock:
+            return self._evals.get(eval_id)
+
+    def evals(self):
+        with self._lock:
+            return list(self._evals.values())
+
+    def evals_by_job(self, namespace, job_id):
+        with self._lock:
+            return [e for e in self._evals.values()
+                    if e.namespace == namespace and e.job_id == job_id]
+
+    def alloc_by_id(self, alloc_id):
+        with self._lock:
+            return self._allocs.get(alloc_id)
+
+    def allocs(self):
+        with self._lock:
+            return list(self._allocs.values())
+
+    def allocs_by_node(self, node_id):
+        with self._lock:
+            return [self._allocs[i]
+                    for i in self._allocs_by_node.get(node_id, ())
+                    if i in self._allocs]
+
+    def allocs_by_job(self, namespace, job_id, anyCreateIndex=True):
+        with self._lock:
+            return [self._allocs[i]
+                    for i in self._allocs_by_job.get((namespace, job_id), ())
+                    if i in self._allocs]
+
+    def allocs_by_eval(self, eval_id):
+        with self._lock:
+            return [a for a in self._allocs.values() if a.eval_id == eval_id]
+
+    def deployment_by_id(self, deployment_id):
+        with self._lock:
+            return self._deployments.get(deployment_id)
+
+    def latest_deployment_by_job(self, namespace, job_id):
+        return StateSnapshot(self).latest_deployment_by_job(namespace, job_id)
+
+    def deployments(self):
+        with self._lock:
+            return list(self._deployments.values())
+
+    def node_pool_by_name(self, name):
+        with self._lock:
+            return self._node_pools.get(name)

@@ -1,0 +1,405 @@
+"""Generic/system scheduler tests over the harness
+(reference analog: scheduler/generic_sched_test.go, scheduler_system_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    Constraint, Evaluation, generate_uuid,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN, ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_COMPLETE, JOB_TYPE_BATCH, JOB_TYPE_SERVICE,
+    NODE_STATUS_DOWN, TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE,
+)
+
+
+def make_eval(job, **kw):
+    e = mock.evaluation(job_id=job.id, namespace=job.namespace, type=job.type,
+                        priority=job.priority)
+    for k, v in kw.items():
+        setattr(e, k, v)
+    return e
+
+
+def placed_allocs(h):
+    out = []
+    for plan in h.plans:
+        for allocs in plan.node_allocation.values():
+            out.extend(allocs)
+    return out
+
+
+def test_service_job_register_places_all():
+    h = Harness()
+    for _ in range(10):
+        h.state.upsert_node(mock.node())
+    job = mock.job()
+    h.state.upsert_job(job)
+    ev = make_eval(job)
+    h.state.upsert_evals([ev])
+
+    err = h.process("service", ev)
+    assert err is None
+    assert len(h.plans) == 1
+    allocs = placed_allocs(h)
+    assert len(allocs) == 10
+    # all running state allocations exist in store
+    stored = h.state.allocs_by_job(job.namespace, job.id)
+    assert len(stored) == 10
+    # names are unique indexes [0,10)
+    names = sorted(a.index() for a in stored)
+    assert names == list(range(10))
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+
+
+def test_binpack_consolidates_across_jobs():
+    # Within one job, job-anti-affinity spreads instances; ACROSS jobs,
+    # BestFit-v3 consolidates onto loaded nodes (reference: rank.go:622
+    # penalty only counts this job's allocs).
+    # 2 nodes -> the log2 scan limit (max(2, ceil(log2 n))) covers the whole
+    # fleet, so consolidation is deterministic.
+    h = Harness()
+    nodes = [mock.node() for _ in range(2)]
+    for n in nodes:
+        h.state.upsert_node(n)
+    used_nodes = set()
+    for _ in range(3):
+        job = mock.job()
+        job.task_groups[0].count = 1
+        h.state.upsert_job(job)
+        h2 = Harness(h.state)
+        err = h2.process("service", make_eval(job))
+        assert err is None
+        allocs = placed_allocs(h2)
+        assert len(allocs) == 1
+        used_nodes.add(allocs[0].node_id)
+    assert len(used_nodes) == 1
+
+
+def test_insufficient_capacity_creates_blocked_eval():
+    h = Harness()
+    n = mock.node()
+    n.node_resources.cpu.cpu_shares = 1000   # fits 2 x 500MHz
+    h.state.upsert_node(n)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(job)
+    ev = make_eval(job)
+    err = h.process("service", ev)
+    assert err is None
+    allocs = placed_allocs(h)
+    assert len(allocs) == 2
+    # blocked eval queued for the remaining 2
+    assert len(h.create_evals) == 1
+    assert h.create_evals[0].status == EVAL_STATUS_BLOCKED
+    assert h.evals[-1].blocked_eval == h.create_evals[0].id
+    failed = h.evals[-1].failed_tg_allocs
+    assert "web" in failed
+    assert failed["web"].coalesced_failures == 1
+
+
+def test_job_constraint_filters_nodes():
+    h = Harness()
+    good = mock.node()
+    bad = mock.node()
+    bad.attributes["kernel.name"] = "windows"
+    bad.compute_class()
+    h.state.upsert_node(good)
+    h.state.upsert_node(bad)
+    job = mock.job()
+    job.constraints = [Constraint(l_target="${attr.kernel.name}",
+                                  r_target="linux", operand="=")]
+    job.task_groups[0].count = 2
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    for a in placed_allocs(h):
+        assert a.node_id == good.id
+
+
+def test_job_update_destructive_rolling():
+    # With update.max_parallel=1, a destructive change updates ONE alloc per
+    # round (reference: reconcile.go computeUpdates rolling gate).
+    h = Harness()
+    node = mock.node()
+    h.state.upsert_node(node)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+    assert len(placed_allocs(h)) == 2
+
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 2
+    job2.task_groups[0].tasks[0].config = {"run_for": "60s"}
+    h.state.upsert_job(job2)
+    old_ids = {a.id for a in h.state.allocs_by_job(job.namespace, job.id)}
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job2))
+    assert err is None
+    plan = h2.plans[0]
+    stops = sum(len(v) for v in plan.node_update.values())
+    new_places = [a for v in plan.node_allocation.values() for a in v
+                  if a.job_version == job2.version and a.id not in old_ids]
+    assert stops == 1
+    assert len(new_places) == 1
+
+
+def test_job_update_destructive_all_at_once():
+    # Without an update strategy every old alloc is replaced in one plan.
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = None
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 2
+    job2.task_groups[0].update = None
+    job2.task_groups[0].tasks[0].config = {"run_for": "60s"}
+    h.state.upsert_job(job2)
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job2))
+    assert err is None
+    plan = h2.plans[0]
+    stops = sum(len(v) for v in plan.node_update.values())
+    places = sum(len(v) for v in plan.node_allocation.values())
+    assert stops == 2
+    assert places == 2
+    for allocs in plan.node_allocation.values():
+        for a in allocs:
+            assert a.job_version == job2.version
+
+
+def test_job_update_in_place():
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+
+    # bump only meta at the job level -> in-place update
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 2
+    job2.meta = {"foo": "bar"}
+    h.state.upsert_job(job2)
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job2))
+    assert err is None
+    plan = h2.plans[0]
+    stops = sum(len(v) for v in plan.node_update.values())
+    assert stops == 0
+    inplace = sum(len(v) for v in plan.node_allocation.values())
+    assert inplace == 2
+
+
+def test_count_decrease_stops_highest_indexes():
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 5
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 2
+    h.state.upsert_job(job2)
+    # make versions equal so no updates besides stop
+    for a in h.state.allocs_by_job(job.namespace, job.id):
+        a.job_version = job2.version
+        a.job = job2
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job2))
+    assert err is None
+    plan = h2.plans[0]
+    stopped = [a for v in plan.node_update.values() for a in v]
+    assert len(stopped) == 3
+    assert sorted(a.index() for a in stopped) == [2, 3, 4]
+
+
+def test_job_deregister_stops_everything():
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+
+    job_stopped = mock.job(id=job.id)
+    job_stopped.stop = True
+    job_stopped.task_groups[0].count = 4
+    h.state.upsert_job(job_stopped)
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job_stopped,
+                                          triggered_by="job-deregister"))
+    assert err is None
+    plan = h2.plans[0]
+    stops = sum(len(v) for v in plan.node_update.values())
+    assert stops == 4
+    assert not plan.node_allocation
+
+
+def test_node_down_reschedules_allocs():
+    h = Harness()
+    n1 = mock.node()
+    n2 = mock.node()
+    h.state.upsert_node(n1)
+    h.state.upsert_node(n2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+    # mark allocs running
+    for a in h.state.allocs_by_job(job.namespace, job.id):
+        a.client_status = ALLOC_CLIENT_RUNNING
+
+    # find the node(s) used; take one down
+    used = {a.node_id for a in h.state.allocs_by_job(job.namespace, job.id)}
+    down_id = sorted(used)[0]
+    h.state.update_node_status(down_id, NODE_STATUS_DOWN)
+
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job, triggered_by=TRIGGER_NODE_UPDATE,
+                                          node_id=down_id))
+    assert err is None
+    plan = h2.plans[0]
+    lost = [a for v in plan.node_update.values() for a in v]
+    assert all(a.client_status == "lost" for a in lost)
+    placed = [a for v in plan.node_allocation.values() for a in v]
+    assert len(placed) == len(lost)
+    up_nodes = {nid for nid in used if nid != down_id} | \
+        {n1.id, n2.id} - {down_id}
+    for a in placed:
+        assert a.node_id != down_id
+
+
+def test_failed_alloc_rescheduled_with_penalty():
+    h = Harness()
+    n1 = mock.node()
+    n2 = mock.node()
+    h.state.upsert_node(n1)
+    h.state.upsert_node(n2)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(job)
+    h.process("service", make_eval(job))
+    allocs = h.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 1
+    failed_node = allocs[0].node_id
+    allocs[0].client_status = ALLOC_CLIENT_FAILED
+    # failure happened long enough ago that the reschedule delay has passed
+    import time
+    allocs[0].client_terminal_time = time.time() - 60
+
+    h2 = Harness(h.state)
+    err = h2.process("service", make_eval(job, triggered_by="alloc-failure"))
+    assert err is None
+    placed = placed_allocs(h2)
+    assert len(placed) == 1
+    # reschedule tracker carries the event
+    assert placed[0].reschedule_tracker is not None
+    assert len(placed[0].reschedule_tracker.events) == 1
+    assert placed[0].previous_allocation == allocs[0].id
+    # with a second node available, the penalty steers away
+    assert placed[0].node_id != failed_node
+
+
+def test_batch_job_complete_allocs_ignored():
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.batch_job(count=3)
+    h.state.upsert_job(job)
+    h.process("batch", make_eval(job))
+    for a in h.state.allocs_by_job(job.namespace, job.id):
+        a.client_status = ALLOC_CLIENT_COMPLETE
+
+    h2 = Harness(h.state)
+    err = h2.process("batch", make_eval(job, triggered_by="job-register"))
+    assert err is None
+    # nothing to do: complete batch allocs are not replaced
+    assert len(h2.plans) == 0 or h2.plans[0].is_no_op() or \
+        not placed_allocs(h2)
+
+
+def test_system_job_places_on_every_node():
+    h = Harness()
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        h.state.upsert_node(n)
+    job = mock.system_job()
+    h.state.upsert_job(job)
+    ev = make_eval(job)
+    err = h.process("system", ev)
+    assert err is None
+    allocs = placed_allocs(h)
+    assert len(allocs) == 4
+    assert {a.node_id for a in allocs} == {n.id for n in nodes}
+
+
+def test_system_job_skips_infeasible_nodes():
+    h = Harness()
+    good = mock.node()
+    bad = mock.node()
+    bad.attributes.pop("driver.mock")
+    bad.compute_class()
+    h.state.upsert_node(good)
+    h.state.upsert_node(bad)
+    job = mock.system_job()
+    h.state.upsert_job(job)
+    err = h.process("system", make_eval(job))
+    assert err is None
+    allocs = placed_allocs(h)
+    assert len(allocs) == 1
+    assert allocs[0].node_id == good.id
+
+
+def test_plan_rejection_retries_then_fails():
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.job()
+    h.state.upsert_job(job)
+    h.reject_plan = True
+    err = h.process("service", make_eval(job))
+    assert err is not None
+    # 5 attempts for service jobs
+    assert h.reject_tracker == 5
+
+
+def test_spread_algorithm_distributes():
+    from nomad_tpu.structs import SchedulerConfiguration, SCHED_ALG_SPREAD
+    h = Harness()
+    h.state.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_SPREAD))
+    for _ in range(4):
+        h.state.upsert_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    allocs = placed_allocs(h)
+    assert len(allocs) == 4
+    # worst-fit spread should use more than one node
+    assert len({a.node_id for a in allocs}) > 1
+
+
+def test_deployment_created_for_service_update():
+    h = Harness()
+    h.state.upsert_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    plan = h.plans[0]
+    assert plan.deployment is not None
+    assert plan.deployment.job_version == job.version
+    assert "web" in plan.deployment.task_groups
+    # deployment persisted with the plan
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d is not None
+    for a in placed_allocs(h):
+        assert a.deployment_id == d.id
