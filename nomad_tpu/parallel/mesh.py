@@ -1,0 +1,83 @@
+"""Device-mesh sharding of the solver: the multi-chip scale path.
+
+The reference scales scheduling by running NumCPU workers per server x M
+servers against snapshots (SURVEY.md section 2.6); the TPU-native analog
+shards two axes over a jax.sharding.Mesh:
+  - ``evals``  (data-parallel): independent evaluations, one snapshot each;
+  - ``nodes``  (model-parallel): the fleet axis inside every eval -- fit and
+    scoring are elementwise over nodes, and the select/argmax reductions
+    become cross-shard collectives that XLA inserts automatically (psum/
+    all-gather over ICI), per the standard pick-mesh -> annotate ->
+    let-XLA-insert-collectives recipe.
+
+No NCCL/MPI analog is needed: collectives ride ICI within a slice and DCN
+across slices, and the host-side control plane (raft-analog, plan applier)
+stays on CPU exactly as nomad/plan_apply.go stays authoritative.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              eval_parallel: Optional[int] = None):
+    """Build a 2D (evals, nodes) mesh over the available devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if eval_parallel is None:
+        # favor eval-parallelism (perfectly parallel) over node sharding:
+        # give the evals axis the LARGER factor of the balanced split
+        eval_parallel = n
+        for cand in range(int(np.floor(np.sqrt(n))), 0, -1):
+            if n % cand == 0:
+                eval_parallel = n // cand
+                break
+    node_parallel = n // eval_parallel
+    dev_grid = np.asarray(devices).reshape(eval_parallel, node_parallel)
+    return Mesh(dev_grid, ("evals", "nodes"))
+
+
+def shard_solver_inputs(mesh, const, init, batch):
+    """NamedShardings for solve_eval_batch inputs: leading axis (E) on
+    'evals'; node-axis (last dim of per-node arrays) on 'nodes'."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def shard_const(c):
+        specs = type(c)(
+            cpu_cap=P("evals", "nodes"), mem_cap=P("evals", "nodes"),
+            disk_cap=P("evals", "nodes"), feasible=P("evals", "nodes"),
+            affinity=P("evals", "nodes"), has_affinity=P("evals"),
+            distinct_hosts=P("evals"), distinct_job_level=P("evals"),
+            spread_vidx=P("evals", None, "nodes"),
+            spread_desired=P("evals"), spread_has_targets=P("evals"),
+            spread_weights=P("evals"), spread_sum_weights=P("evals"),
+            n_spreads=P("evals"))
+        return jax.tree.map(
+            lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
+            c, specs)
+
+    def shard_state(s):
+        specs = type(s)(
+            used_cpu=P("evals", "nodes"), used_mem=P("evals", "nodes"),
+            used_disk=P("evals", "nodes"), placed=P("evals", "nodes"),
+            placed_job=P("evals", "nodes"),
+            static_free=P("evals", "nodes"), dyn_avail=P("evals", "nodes"),
+            spread_counts=P("evals"))
+        return jax.tree.map(
+            lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
+            s, specs)
+
+    def shard_batch(b):
+        return jax.tree.map(
+            lambda leaf: jax.device_put(
+                leaf, NamedSharding(mesh, P("evals"))), b)
+
+    return shard_const(const), shard_state(init), shard_batch(batch)

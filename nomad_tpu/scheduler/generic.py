@@ -61,6 +61,7 @@ class GenericScheduler:
         self.stack: Optional[GenericStack] = None
         self.deployment = None
 
+        self.base_nodes: List = []
         self.blocked: Optional[Evaluation] = None
         self.failed_tg_allocs: Dict[str, object] = {}
         self.queued_allocs: Dict[str, int] = {}
@@ -154,6 +155,7 @@ class GenericScheduler:
             dcs = set(self.job.datacenters)
             if "*" not in dcs:
                 nodes = [n for n in nodes if n.datacenter in dcs]
+            self.base_nodes = list(nodes)   # pre-shuffle order, for the solver
             self.stack.set_nodes(nodes)
             self.ctx.metrics.nodes_in_pool = len(nodes)
 
@@ -230,7 +232,19 @@ class GenericScheduler:
             results.place + destructive_places)
 
     def _compute_placements(self, places: List[AllocPlaceResult]) -> bool:
-        """(reference: generic_sched.go:511 computePlacements)"""
+        """(reference: generic_sched.go:511 computePlacements)
+
+        When SchedulerConfiguration selects a tpu-* algorithm, whole
+        task-group batches are solved in one dense dispatch on the
+        accelerator (nomad_tpu/solver/); anything the dense path does not
+        model falls back to the host iterator stack per placement."""
+        if self._tpu_algorithm():
+            places = self._compute_placements_tpu(places)
+            if not places:
+                if self.failed_tg_allocs and not self.batch:
+                    self._queue_blocked_eval()
+                return True
+
         deployment_id = ""
         if self.plan.deployment is not None:
             deployment_id = self.plan.deployment.id
@@ -311,6 +325,113 @@ class GenericScheduler:
         if self.failed_tg_allocs and not self.batch:
             self._queue_blocked_eval()
         return True
+
+    def _tpu_algorithm(self) -> bool:
+        if not hasattr(self.state, "scheduler_config"):
+            return False
+        cfg = self.state.scheduler_config()
+        return cfg is not None and cfg.uses_tpu()
+
+    def _compute_placements_tpu(self, places: List[AllocPlaceResult]
+                                ) -> List[AllocPlaceResult]:
+        """Solve eligible TG batches densely; returns the places the solver
+        could NOT handle (devices/cores/sticky-disk/preemption) so the host
+        path picks them up."""
+        from ..solver.service import TpuPlacementService, tg_solver_eligible
+        from ..structs import SCHED_ALG_TPU_SPREAD
+
+        cfg = self.state.scheduler_config()
+        spread_alg = cfg.scheduler_algorithm == SCHED_ALG_TPU_SPREAD
+
+        groups: Dict[str, List[AllocPlaceResult]] = {}
+        order: List[str] = []
+        for place in places:
+            if place.task_group.name not in groups:
+                order.append(place.task_group.name)
+            groups.setdefault(place.task_group.name, []).append(place)
+
+        deployment_id = ""
+        if self.plan.deployment is not None:
+            deployment_id = self.plan.deployment.id
+
+        fallback: List[AllocPlaceResult] = []
+        service = TpuPlacementService(
+            self.ctx, self.job, self.batch, spread_alg)
+        # the solver derives the same shuffle the stack applied from the
+        # eval id, so hand it the pre-shuffle base ordering
+        base_nodes = getattr(self, "base_nodes", None) or \
+            self.state.ready_nodes_in_pool(self.job.node_pool)
+
+        for tg_name in order:
+            tg_places = groups[tg_name]
+            tg = tg_places[0].task_group
+            sticky = tg.ephemeral_disk.sticky and any(
+                p.previous_alloc is not None for p in tg_places)
+            if (self._preemption_enabled() or sticky
+                    or not tg_solver_eligible(tg, self.job)):
+                fallback.extend(tg_places)
+                continue
+            penalties = [
+                {p.previous_alloc.node_id} if (p.reschedule and
+                                               p.previous_alloc) else set()
+                for p in tg_places]
+            solved = service.solve(tg, tg_places, base_nodes, penalties)
+            if solved is None:
+                fallback.extend(tg_places)
+                continue
+            for sp in solved:
+                if sp.node is None:
+                    if tg.name in self.failed_tg_allocs:
+                        self.failed_tg_allocs[tg.name].coalesced_failures += 1
+                    else:
+                        m = self.ctx.metrics.copy()
+                        m.nodes_evaluated = sp.n_yielded
+                        self.failed_tg_allocs[tg.name] = m
+                    continue
+                self._append_solved_alloc(sp, deployment_id)
+        return fallback
+
+    def _append_solved_alloc(self, sp, deployment_id: str) -> None:
+        place = sp.place
+        tg = place.task_group
+        resources = AllocatedResources(
+            tasks=sp.task_resources,
+            shared=sp.alloc_resources
+            if sp.alloc_resources is not None
+            else AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb))
+        metrics = self.ctx.metrics.copy()
+        metrics.nodes_evaluated = sp.n_yielded
+        metrics.score_node(sp.node.id, "normalized-score", sp.score)
+        alloc = Allocation(
+            id=generate_uuid(),
+            namespace=self.job.namespace,
+            eval_id=self.eval.id,
+            name=place.name,
+            job_id=self.job.id,
+            job=self.job,
+            job_version=self.job.version,
+            task_group=tg.name,
+            node_id=sp.node.id,
+            node_name=sp.node.name,
+            deployment_id=deployment_id,
+            allocated_resources=resources,
+            desired_status=ALLOC_DESIRED_RUN,
+            client_status="pending",
+            metrics=metrics,
+        )
+        prev = place.previous_alloc
+        if prev is not None:
+            alloc.previous_allocation = prev.id
+            if place.reschedule:
+                tracker = RescheduleTracker()
+                if prev.reschedule_tracker is not None:
+                    tracker.events = list(prev.reschedule_tracker.events)
+                tracker.events.append(RescheduleEvent(
+                    reschedule_time=_time.time(),
+                    prev_alloc_id=prev.id,
+                    prev_node_id=prev.node_id))
+                alloc.reschedule_tracker = tracker
+        self.plan.append_alloc(alloc)
 
     def _preemption_enabled(self) -> bool:
         cfg = (self.state.scheduler_config()

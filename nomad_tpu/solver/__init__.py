@@ -1,0 +1,6 @@
+"""TPU solver: dense vmapped placement engine (the north-star component)."""
+from .binpack import (  # noqa: F401
+    NodeConst, NodeState, PlacementBatch, make_node_const, make_node_state,
+    solve_placements,
+)
+from .service import TpuPlacement, TpuPlacementService, tg_solver_eligible  # noqa: F401

@@ -1,0 +1,345 @@
+"""The TPU solver core: the bin-packing inner loop as dense JAX.
+
+This is the north star (BASELINE.json): the per-candidate work of
+BinPackIterator.Next (reference: scheduler/rank.go:205) -- fit check,
+BestFit-v3 scoring, anti-affinity/penalty/affinity/spread scoring, and the
+LimitIterator/MaxScoreIterator selection semantics (select.go, stack.go:82)
+-- computed for EVERY node at once as vectorized XLA ops, with the
+within-eval sequential dependence (earlier placements consume resources,
+context.go:176 ProposedAllocs) carried through a lax.scan.
+
+Selection parity: the reference scans a shuffled, log2-limited window with
+up-to-3 low-score skips and picks the max score (first-seen wins ties).
+The dense emulation reproduces that exactly from per-node (feasible, score)
+arrays laid out in shuffled order -- see _select_window.
+
+All arrays are in SHUFFLED ORDER (nomad_tpu/scheduler/util.py
+shuffled_order); callers map chosen indexes back to node ids.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_SKIP = 3               # select.go maxSkip
+SKIP_THRESHOLD = 0.0       # select.go skipScoreThreshold
+BINPACK_MAX = 18.0
+
+
+class PlacementBatch(NamedTuple):
+    """Per-placement (scan-step) inputs, each shaped (P,)."""
+
+    ask_cpu: jnp.ndarray
+    ask_mem: jnp.ndarray
+    ask_disk: jnp.ndarray
+    n_dyn_ports: jnp.ndarray    # int32 dynamic ports asked
+    has_static: jnp.ndarray     # bool: TG asks static ports
+    limit: jnp.ndarray          # int32 scan-window limit for this placement
+    count: jnp.ndarray          # int32 TG desired count (anti-affinity denom)
+    penalty_idx: jnp.ndarray    # int32 node index to penalize, -1 = none
+    active: jnp.ndarray         # bool: real placement vs padding
+
+
+class NodeState(NamedTuple):
+    """Scan carry: mutable usage along the node axis, shaped (N,)."""
+
+    used_cpu: jnp.ndarray
+    used_mem: jnp.ndarray
+    used_disk: jnp.ndarray
+    placed: jnp.ndarray         # int32: this job+TG alloc count per node
+    placed_job: jnp.ndarray     # int32: this job's alloc count (any TG)
+    static_free: jnp.ndarray    # bool: TG's static ports still free
+    dyn_avail: jnp.ndarray      # int32: free dynamic-range ports
+    spread_counts: jnp.ndarray  # (S, V) int32
+
+
+class NodeConst(NamedTuple):
+    """Static per-eval node arrays, shaped (N,) (+ spread tables)."""
+
+    cpu_cap: jnp.ndarray
+    mem_cap: jnp.ndarray
+    disk_cap: jnp.ndarray
+    feasible: jnp.ndarray       # bool: constraint/driver/etc feasibility
+    affinity: jnp.ndarray       # float: normalized affinity score per node
+    has_affinity: jnp.ndarray   # bool scalar
+    distinct_hosts: jnp.ndarray  # bool scalar: distinct_hosts applies
+    distinct_job_level: jnp.ndarray  # bool scalar: it is a JOB-level
+                                     # constraint (blocks any of the job's
+                                     # allocs, feasible.go:507)
+    # spreads
+    spread_vidx: jnp.ndarray    # (S, N) int32 value index per node, -1 missing
+    spread_desired: jnp.ndarray  # (S, V) float; -1 = no target for value
+    spread_has_targets: jnp.ndarray  # (S,) bool
+    spread_weights: jnp.ndarray      # (S,) float
+    spread_sum_weights: jnp.ndarray  # float scalar
+    n_spreads: jnp.ndarray      # int32 scalar (0 = no spreads)
+
+
+def _binpack_score(free_cpu, free_mem, spread_alg: bool):
+    """BestFit v3 / worst-fit, normalized to [0,1]
+    (reference: structs/funcs.go:236,263; rank.go:571 fitness/18)."""
+    total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
+    raw = jnp.where(spread_alg, total - 2.0, 20.0 - total)
+    return jnp.clip(raw, 0.0, BINPACK_MAX) / BINPACK_MAX
+
+
+def _spread_score(state: NodeState, const: NodeConst, dtype):
+    """Vectorized SpreadIterator.Next + evenSpreadScoreBoost
+    (reference: spread.go:128-270). Returns (N,) total spread boost."""
+    S, N = const.spread_vidx.shape
+    if S == 0:
+        return jnp.zeros(N, dtype=dtype)
+
+    def one_spread(vidx, desired, has_targets, weight, counts):
+        # vidx: (N,) value index; counts: (V,) current counts
+        missing = vidx < 0
+        safe_vidx = jnp.maximum(vidx, 0)
+        used = counts[safe_vidx] + 1          # include this placement
+        weight_frac = weight / jnp.maximum(const.spread_sum_weights, 1e-9)
+
+        # -- target path (reference: spread.go:171-200)
+        des = desired[safe_vidx]
+        no_target = des < 0.0
+        boost_t = jnp.where(
+            no_target, -1.0,
+            jnp.where(des == 0.0, -1.0,
+                      (des - used.astype(dtype)) / jnp.maximum(des, 1e-9)
+                      * weight_frac))
+
+        # -- even-spread path (reference: spread.go:216-270)
+        present = counts > 0
+        any_present = jnp.any(present)
+        big = jnp.iinfo(jnp.int32).max
+        min_c = jnp.min(jnp.where(present, counts, big))
+        max_c = jnp.max(jnp.where(present, counts, 0))
+        current = counts[safe_vidx]
+        min_f = min_c.astype(dtype)
+        max_f = max_c.astype(dtype)
+        cur_f = current.astype(dtype)
+        even = jnp.where(
+            current != min_c,
+            jnp.where(min_c == 0, -1.0, (min_f - cur_f) / jnp.maximum(min_f, 1e-9)),
+            jnp.where(min_c == max_c, -1.0,
+                      (max_f - min_f) / jnp.maximum(min_f, 1e-9)))
+        boost_e = jnp.where(any_present, even, 0.0)
+
+        per_node = jnp.where(has_targets, boost_t, boost_e)
+        return jnp.where(missing, -1.0, per_node).astype(dtype)
+
+    boosts = jax.vmap(one_spread)(
+        const.spread_vidx, const.spread_desired, const.spread_has_targets,
+        const.spread_weights, state.spread_counts)
+    return jnp.sum(boosts, axis=0)
+
+
+def _select_window(score, fit, limit, dtype):
+    """Dense emulation of LimitIterator + MaxScoreIterator over nodes laid
+    out in shuffled order (reference: select.go:38-77, stack.go:82).
+
+    Yield set = first min(L, C) counted options (C = feasible minus the
+    first <=3 low-score skips) plus skipped options as fallback when the
+    source ran dry; winner = max score, earliest yield wins ties.
+    Returns (chosen_index, chosen_score, n_yielded); chosen = -1 if none.
+    """
+    n = score.shape[0]
+    low = fit & (score <= SKIP_THRESHOLD)
+    skip_rank = jnp.cumsum(low.astype(jnp.int32))        # 1-based among low
+    skipped = low & (skip_rank <= MAX_SKIP)
+    counted = fit & ~skipped
+    cpos = jnp.cumsum(counted.astype(jnp.int32))         # 1-based
+    total_counted = cpos[-1] if n > 0 else jnp.int32(0)
+    window = counted & (cpos <= limit)
+    # fallback: yield skipped (in skip order) for the deficit
+    deficit = jnp.maximum(0, limit - jnp.minimum(total_counted, limit))
+    srank = jnp.cumsum(skipped.astype(jnp.int32))
+    fallback = skipped & (srank <= deficit)
+    yielded = window | fallback
+    # yield order: counted first (cpos), then skipped (limit + srank)
+    order = jnp.where(window, cpos, limit + srank)
+    neg_inf = jnp.array(-jnp.inf, dtype=dtype)
+    eff_score = jnp.where(yielded, score, neg_inf)
+    best_score = jnp.max(eff_score)
+    is_best = yielded & (eff_score == best_score)
+    big = jnp.iinfo(jnp.int32).max
+    best_order = jnp.min(jnp.where(is_best, order, big))
+    chosen = jnp.argmax(is_best & (order == best_order))
+    any_yield = jnp.any(yielded)
+    chosen = jnp.where(any_yield, chosen, -1)
+    return chosen, jnp.where(any_yield, best_score, neg_inf), \
+        jnp.sum(yielded.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("spread_alg", "dtype_name"))
+def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
+                     spread_alg: bool = False, dtype_name: str = "float32"):
+    """Place a batch of allocations sequentially via lax.scan.
+
+    Each step reproduces one Stack.Select call (stack.go:128): score every
+    node against current usage, select within the limited window, commit the
+    winner's resources into the carry. Returns (chosen (P,), scores (P,),
+    n_yielded (P,), final NodeState).
+    """
+    dtype = jnp.dtype(dtype_name)
+
+    def step(state: NodeState, b):
+        (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
+         penalty_idx, active) = b
+        n = const.cpu_cap.shape[0]
+
+        new_cpu = state.used_cpu + ask_cpu
+        new_mem = state.used_mem + ask_mem
+        new_disk = state.used_disk + ask_disk
+
+        distinct_count = jnp.where(const.distinct_job_level,
+                                   state.placed_job, state.placed)
+        fit = (const.feasible
+               & (new_cpu <= const.cpu_cap)
+               & (new_mem <= const.mem_cap)
+               & (new_disk <= const.disk_cap)
+               & (state.dyn_avail >= n_dyn)
+               & (state.static_free | ~has_static)
+               & (~const.distinct_hosts | (distinct_count == 0)))
+
+        cap_cpu = jnp.maximum(const.cpu_cap, 1e-9)
+        cap_mem = jnp.maximum(const.mem_cap, 1e-9)
+        free_cpu = 1.0 - new_cpu / cap_cpu
+        free_mem = 1.0 - new_mem / cap_mem
+        binpack = _binpack_score(free_cpu, free_mem, spread_alg)
+
+        collisions = state.placed
+        anti = jnp.where(
+            collisions > 0,
+            -(collisions.astype(dtype) + 1.0) / jnp.maximum(
+                count.astype(dtype), 1.0),
+            0.0)
+        idx = jnp.arange(n)
+        is_penalty = idx == penalty_idx
+        resched = jnp.where(is_penalty, -1.0, 0.0)
+        aff = jnp.where(const.has_affinity, const.affinity, 0.0)
+        aff_present = aff != 0.0
+        spread_total = _spread_score(state, const, dtype)
+        spread_present = spread_total != 0.0
+
+        nscores = (1
+                   + (collisions > 0).astype(dtype)
+                   + is_penalty.astype(dtype)
+                   + aff_present.astype(dtype)
+                   + spread_present.astype(dtype))
+        final = (binpack + anti + resched + aff + spread_total) / nscores
+
+        chosen, cscore, n_yield = _select_window(final, fit, limit, dtype)
+        do = active & (chosen >= 0)
+        safe = jnp.maximum(chosen, 0)
+        onehot = (idx == safe) & do
+
+        sel_vidx = const.spread_vidx[:, safe]               # (S,)
+        S, V = state.spread_counts.shape
+        if S > 0:
+            upd = ((jnp.arange(V)[None, :] == jnp.maximum(sel_vidx, 0)[:, None])
+                   & (sel_vidx >= 0)[:, None] & do)
+            new_counts = state.spread_counts + upd.astype(jnp.int32)
+        else:
+            new_counts = state.spread_counts
+
+        new_state = NodeState(
+            used_cpu=jnp.where(onehot, new_cpu, state.used_cpu),
+            used_mem=jnp.where(onehot, new_mem, state.used_mem),
+            used_disk=jnp.where(onehot, new_disk, state.used_disk),
+            placed=state.placed + onehot.astype(jnp.int32),
+            placed_job=state.placed_job + onehot.astype(jnp.int32),
+            static_free=state.static_free & ~(onehot & has_static),
+            dyn_avail=state.dyn_avail - onehot.astype(jnp.int32) * n_dyn,
+            spread_counts=new_counts,
+        )
+        chosen_out = jnp.where(do, chosen, -1)
+        return new_state, (chosen_out, cscore, n_yield)
+
+    final_state, (chosen, scores, n_yielded) = jax.lax.scan(
+        step, init,
+        (batch.ask_cpu, batch.ask_mem, batch.ask_disk, batch.n_dyn_ports,
+         batch.has_static, batch.limit, batch.count, batch.penalty_idx,
+         batch.active))
+    return chosen, scores, n_yielded, final_state
+
+
+def solve_eval_batch(const: NodeConst, init: NodeState, batch: PlacementBatch,
+                     spread_alg: bool = False,
+                     dtype_name: str = "float32"):
+    """Solve E independent evaluations in one dispatch: every leaf carries a
+    leading eval axis (E, ...). This is the TPU-native form of the
+    reference's optimistic concurrency (SURVEY.md section 2.6: N scheduler
+    workers scheduling concurrently against snapshots, serialized only at
+    plan apply) -- evals don't see each other's placements; the plan
+    applier resolves conflicts exactly as nomad/plan_apply.go does.
+
+    The eval axis is the data-parallel axis for multi-chip sharding; the
+    node axis shards as the model axis (see parallel/mesh.py).
+    """
+    import functools as _ft
+    inner = _ft.partial(solve_placements, spread_alg=spread_alg,
+                        dtype_name=dtype_name)
+    return jax.vmap(inner)(const, init, batch)
+
+
+def make_node_const(matrix, feasible: np.ndarray, affinity,
+                    distinct_hosts: bool, spread_info, order: np.ndarray,
+                    dtype=np.float32,
+                    distinct_job_level: bool = False) -> NodeConst:
+    """Assemble NodeConst in shuffled order (order[i] = original index of the
+    node at shuffled position i)."""
+    n_pad = matrix.n_pad
+    perm = np.asarray(order, dtype=np.int64)
+    cpu = matrix.cpu_cap[perm].astype(dtype)
+    mem = matrix.mem_cap[perm].astype(dtype)
+    disk = matrix.disk_cap[perm].astype(dtype)
+    feas = (feasible & matrix.valid)[perm]
+    aff = (affinity[perm].astype(dtype) if affinity is not None
+           else np.zeros(n_pad, dtype=dtype))
+    if spread_info is not None:
+        vidx = spread_info.value_index[:, perm]
+        desired = spread_info.desired.astype(dtype)
+        has_t = spread_info.has_targets
+        weights = spread_info.weights.astype(dtype)
+        sum_w = np.asarray(spread_info.sum_weights, dtype=dtype)
+        n_s = spread_info.n_spreads
+    else:
+        vidx = np.zeros((0, n_pad), dtype=np.int32)
+        desired = np.zeros((0, 1), dtype=dtype)
+        has_t = np.zeros(0, dtype=bool)
+        weights = np.zeros(0, dtype=dtype)
+        sum_w = np.asarray(0.0, dtype=dtype)
+        n_s = 0
+    return NodeConst(
+        cpu_cap=jnp.asarray(cpu), mem_cap=jnp.asarray(mem),
+        disk_cap=jnp.asarray(disk), feasible=jnp.asarray(feas),
+        affinity=jnp.asarray(aff),
+        has_affinity=jnp.asarray(affinity is not None),
+        distinct_hosts=jnp.asarray(bool(distinct_hosts)),
+        distinct_job_level=jnp.asarray(bool(distinct_job_level)),
+        spread_vidx=jnp.asarray(vidx), spread_desired=jnp.asarray(desired),
+        spread_has_targets=jnp.asarray(has_t),
+        spread_weights=jnp.asarray(weights),
+        spread_sum_weights=jnp.asarray(sum_w),
+        n_spreads=jnp.asarray(n_s, dtype=jnp.int32))
+
+
+def make_node_state(usage, matrix, static_ports_free: np.ndarray,
+                    order: np.ndarray, n_spreads: int, n_values: int,
+                    spread_counts=None, dtype=np.float32) -> NodeState:
+    perm = np.asarray(order, dtype=np.int64)
+    counts = (spread_counts if spread_counts is not None
+              else np.zeros((n_spreads, max(n_values, 1)), dtype=np.int32))
+    return NodeState(
+        used_cpu=jnp.asarray(usage.used_cpu[perm].astype(dtype)),
+        used_mem=jnp.asarray(usage.used_mem[perm].astype(dtype)),
+        used_disk=jnp.asarray(usage.used_disk[perm].astype(dtype)),
+        placed=jnp.asarray(usage.placed_jobtg[perm]),
+        placed_job=jnp.asarray(usage.placed_job[perm]),
+        static_free=jnp.asarray(static_ports_free[perm]),
+        dyn_avail=jnp.asarray(
+            (matrix.dyn_free - usage.dyn_used)[perm].astype(np.int32)),
+        spread_counts=jnp.asarray(counts))

@@ -1,0 +1,291 @@
+"""TPU placement service: bridges the generic scheduler to the dense solver.
+
+Registered behind the same boundary the reference exposes for algorithm
+selection (SchedulerConfiguration.scheduler_algorithm, read at
+stack.go:292/rank.go:192): algorithms ``tpu-binpack`` / ``tpu-spread`` route
+eligible placement batches through nomad_tpu/solver/binpack.py; anything the
+dense path does not model (devices, reserved cores, preemption, sticky-disk
+preferred nodes) falls back to the host iterator stack per placement, so
+behavior is always complete.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..structs import (
+    AllocatedSharedResources, AllocatedTaskResources, NetworkIndex,
+    CONSTRAINT_DISTINCT_HOSTS,
+)
+from ..tensor import (
+    pack_affinities, pack_feasibility, pack_nodes, pack_spreads, pack_usage,
+)
+from ..scheduler.util import shuffled_order
+
+
+class TpuPlacement:
+    """One solved placement returned to the scheduler."""
+
+    __slots__ = ("place", "node", "task_resources", "alloc_resources",
+                 "score", "n_yielded")
+
+    def __init__(self, place, node, task_resources, alloc_resources, score,
+                 n_yielded):
+        self.place = place
+        self.node = node
+        self.task_resources = task_resources
+        self.alloc_resources = alloc_resources
+        self.score = score
+        self.n_yielded = n_yielded
+
+
+def tg_solver_eligible(tg, job=None) -> bool:
+    """Does the dense path model everything this TG asks for? Anything it
+    does not (devices, reserved cores, per-task networks, distinct_property,
+    0%-spread targets whose stateful lowest-boost scoring is host-only)
+    falls back to the host iterator stack."""
+    for task in tg.tasks:
+        if task.resources.devices or task.resources.cores > 0:
+            return False
+        if task.resources.networks:
+            return False
+    if len(tg.networks) > 1:
+        return False
+    constraints = list(tg.constraints) + [
+        c for t in tg.tasks for c in t.constraints]
+    if job is not None:
+        constraints += list(job.constraints)
+    from ..structs import CONSTRAINT_DISTINCT_PROPERTY
+    if any(c.operand == CONSTRAINT_DISTINCT_PROPERTY for c in constraints):
+        return False
+    spreads = list(tg.spreads) + (list(job.spreads) if job is not None else [])
+    for s in spreads:
+        if any(t.percent == 0 for t in s.spread_target):
+            return False
+    return True
+
+
+class TpuPlacementService:
+    """Solves all of one TG's placements for one eval in a single dispatch
+    (amortizing host->TPU latency, SURVEY.md section 7 hard part 5)."""
+
+    def __init__(self, ctx, job, batch_mode: bool, spread_alg: bool,
+                 dtype: Optional[str] = None):
+        self.ctx = ctx
+        self.job = job
+        self.batch_mode = batch_mode
+        self.spread_alg = spread_alg
+        if dtype is None:
+            # float64 on CPU (exact parity with the host oracle's float64
+            # math); float32 on TPU where f64 is emulated and the MXU wants
+            # narrow types.
+            import jax
+            dtype = ("float64" if jax.config.jax_enable_x64
+                     and jax.default_backend() == "cpu" else "float32")
+        self.dtype = dtype
+        # The host stack's limit persists across Select calls within one
+        # eval (stack.go: set_nodes sets log2 once; the spread/affinity
+        # override in Select is never restored). Mirror that statefulness.
+        self._current_limit: Optional[int] = None
+
+    def solve(self, tg, places, nodes, penalty_nodes_per_place=None
+              ) -> Optional[List[TpuPlacement]]:
+        """Returns one TpuPlacement per place (node=None for failures), or
+        None when the TG is not solver-eligible (caller falls back)."""
+        from .binpack import (
+            PlacementBatch, make_node_const, make_node_state,
+            solve_placements)
+        import jax.numpy as jnp
+
+        if not tg_solver_eligible(tg, self.job) or not places:
+            return None
+
+        n = len(nodes)
+        state_index = self.ctx.state.latest_index()
+        matrix = pack_nodes(nodes)
+        n_pad = matrix.n_pad
+
+        # Same permutation the host stack applies in set_nodes
+        # (scheduler/util.py shuffle_nodes seeded by eval id + index).
+        order = shuffled_order(self.ctx.plan.eval_id, state_index, n)
+        perm = np.concatenate([np.asarray(order, dtype=np.int64),
+                               np.arange(n, n_pad, dtype=np.int64)])
+        inv = np.empty(n_pad, dtype=np.int64)
+        inv[perm] = np.arange(n_pad)
+
+        proposed_by_node = {
+            node.id: self.ctx.proposed_allocs(node.id) for node in nodes}
+        usage = pack_usage(matrix, proposed_by_node, self.job.id, tg.name,
+                           self.job.namespace, nodes)
+
+        feasible = pack_feasibility(self.ctx, None, tg, nodes, n_pad,
+                                    alloc_name=places[0].name)
+
+        affinities = (list(self.job.affinities) + list(tg.affinities)
+                      + [a for t in tg.tasks for a in t.affinities])
+        affinity = pack_affinities(affinities, self.ctx, nodes, n_pad)
+
+        spreads = list(self.job.spreads) + list(tg.spreads)
+        existing_counts = self._existing_spread_counts(spreads, tg)
+        spread_info = pack_spreads(spreads, nodes, n_pad, tg.count,
+                                   existing_counts)
+
+        distinct_job_level = any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            and str(c.r_target).lower() != "false"
+            for c in self.job.constraints)
+        distinct_hosts = distinct_job_level or any(
+            c.operand == CONSTRAINT_DISTINCT_HOSTS
+            and str(c.r_target).lower() != "false"
+            for c in tg.constraints)
+
+        # Static port availability per node for this TG's ask
+        static_ports = []
+        n_dyn = 0
+        if tg.networks:
+            static_ports = [p.value for p in tg.networks[0].reserved_ports]
+            n_dyn = len(tg.networks[0].dynamic_ports)
+        static_free = np.ones(n_pad, dtype=bool)
+        if static_ports:
+            for i in range(n):
+                for p in static_ports:
+                    if usage.port_bitmap[i, p >> 5] & np.uint32(1 << (p & 31)):
+                        static_free[i] = False
+                        break
+
+        limit = self._limit(n, tg, bool(affinities), bool(spreads))
+
+        dtype = np.float64 if self.dtype == "float64" else np.float32
+        const = make_node_const(matrix, feasible, affinity, distinct_hosts,
+                                spread_info, perm, dtype=dtype,
+                                distinct_job_level=distinct_job_level)
+        init = make_node_state(
+            usage, matrix, static_free, perm,
+            spread_info.n_spreads if spread_info else 0,
+            spread_info.n_values if spread_info else 1,
+            spread_counts=(spread_info.initial_counts
+                           if spread_info else None), dtype=dtype)
+
+        P = len(places)
+        ask = tg.total_resources()
+        penalty = np.full(P, -1, dtype=np.int32)
+        if penalty_nodes_per_place:
+            id_to_pos = {nid: int(inv[i])
+                         for i, nid in enumerate(matrix.node_ids)}
+            for pi, pen in enumerate(penalty_nodes_per_place):
+                if pen:
+                    pos = id_to_pos.get(next(iter(pen)))
+                    if pos is not None:
+                        penalty[pi] = pos
+
+        batch = PlacementBatch(
+            ask_cpu=jnp.full(P, float(ask.cpu), dtype=dtype),
+            ask_mem=jnp.full(P, float(ask.memory_mb), dtype=dtype),
+            ask_disk=jnp.full(P, float(ask.disk_mb), dtype=dtype),
+            n_dyn_ports=jnp.full(P, n_dyn, dtype=jnp.int32),
+            has_static=jnp.full(P, bool(static_ports)),
+            limit=jnp.full(P, limit, dtype=jnp.int32),
+            count=jnp.full(P, tg.count, dtype=jnp.int32),
+            penalty_idx=jnp.asarray(penalty),
+            active=jnp.ones(P, dtype=bool),
+        )
+
+        chosen, scores, n_yielded, _ = solve_placements(
+            const, init, batch, spread_alg=self.spread_alg,
+            dtype_name=np.dtype(dtype).name)
+        # Single device->host fetch: individual fetches each pay the full
+        # host<->device round trip (severe over a tunneled TPU), so stack all
+        # outputs and read once. int32 values are exact in f32/f64 here
+        # (node indexes < 2^24).
+        combined = np.asarray(jnp.stack([
+            chosen.astype(scores.dtype), scores,
+            n_yielded.astype(scores.dtype)]))
+        chosen = combined[0].astype(np.int64)
+        scores = combined[1]
+        n_yielded = combined[2].astype(np.int64)
+
+        # Materialize: map shuffled positions back to nodes, assign real
+        # ports by replaying the deterministic NetworkIndex per node.
+        out: List[TpuPlacement] = []
+        net_indexes: Dict[str, NetworkIndex] = {}
+        for pi, place in enumerate(places):
+            pos = int(chosen[pi])
+            if pos < 0:
+                out.append(TpuPlacement(place, None, None, None, 0.0,
+                                        int(n_yielded[pi])))
+                continue
+            node = nodes[order[pos]]
+            task_resources = {}
+            for task in tg.tasks:
+                task_resources[task.name] = AllocatedTaskResources(
+                    cpu_shares=task.resources.cpu,
+                    memory_mb=task.resources.memory_mb)
+            alloc_resources = None
+            if tg.networks:
+                idx = net_indexes.get(node.id)
+                if idx is None:
+                    idx = NetworkIndex()
+                    idx.set_node(node)
+                    idx.add_allocs(proposed_by_node[node.id])
+                    net_indexes[node.id] = idx
+                offer, err = idx.assign_ports([tg.networks[0]])
+                if offer is None:
+                    out.append(TpuPlacement(place, None, None, None, 0.0,
+                                            int(n_yielded[pi])))
+                    continue
+                for pm in offer.ports:
+                    idx.add_reserved_port(
+                        pm.value, idx._network_for_ip(pm.host_ip))
+                alloc_resources = AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb, ports=offer.ports)
+            out.append(TpuPlacement(place, node, task_resources,
+                                    alloc_resources, float(scores[pi]),
+                                    int(n_yielded[pi])))
+        return out
+
+    def _limit(self, n: int, tg, has_affinities: bool,
+               has_spreads: bool) -> int:
+        """(reference: stack.go:82-95 log2 limit, :176-185 spread override).
+        The override is sticky across TGs within one eval, exactly like the
+        host LimitIterator whose limit is never restored after a
+        spread/affinity TG raises it."""
+        if has_affinities or has_spreads:
+            limit = tg.count if tg.count >= 100 else 100
+            self._current_limit = limit
+            return limit
+        if self._current_limit is not None:
+            return self._current_limit
+        limit = 2
+        if not self.batch_mode and n > 1:
+            log_limit = int(math.ceil(math.log2(n)))
+            if log_limit > limit:
+                limit = log_limit
+        return limit
+
+    def _existing_spread_counts(self, spreads, tg):
+        """Per spread: current alloc counts per attribute value
+        (reference: propertyset.go UsedCount seeding)."""
+        from ..scheduler.util import resolve_target
+        if not spreads:
+            return None
+        stopped = set()
+        for na in self.ctx.plan.node_update.values():
+            stopped.update(a.id for a in na)
+        allocs = [a for a in self.ctx.state.allocs_by_job(
+            self.job.namespace, self.job.id)
+            if a.id not in stopped and not a.terminal_status()
+            and a.task_group == tg.name]
+        out = []
+        for s in spreads:
+            counts: Dict[str, int] = {}
+            for a in allocs:
+                node = self.ctx.state.node_by_id(a.node_id)
+                if node is None:
+                    continue
+                v, ok = resolve_target(s.attribute, node)
+                if ok:
+                    counts[str(v)] = counts.get(str(v), 0) + 1
+            out.append(counts)
+        return out

@@ -1,0 +1,5 @@
+"""Tensorization layer: structs <-> dense arrays (north-star marshalling)."""
+from .pack import (  # noqa: F401
+    NodeMatrix, SpreadInfo, UsageState, bucket_size, pack_affinities,
+    pack_feasibility, pack_nodes, pack_spreads, pack_usage, PORT_WORDS,
+)

@@ -1,0 +1,282 @@
+"""Tensorization: structs <-> dense arrays for the TPU solver.
+
+This is the marshalling layer the north star calls for (BASELINE.json:
+"nomad/structs Allocation/Node are marshalled into packed int32 tensors"):
+node capacities, proposed usage, port bitmaps, spread-attribute value
+indexes and feasibility masks become fixed-shape numpy arrays that
+nomad_tpu/solver/binpack.py consumes on TPU.
+
+Shapes are padded to bucket sizes so XLA compiles once per bucket, not once
+per fleet size (SURVEY.md section 7 hard part 6: bucket-and-pad).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+PORT_WORDS = 2048          # 65536 ports / 32 bits
+DEFAULT_NODE_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+
+
+def bucket_size(n: int, buckets=DEFAULT_NODE_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(2 ** np.ceil(np.log2(max(n, 1))))
+
+
+@dataclass
+class NodeMatrix:
+    """Static per-eval node-axis tensors (padded to n_pad).
+
+    Columns mirror what BinPackIterator reads per node
+    (reference: scheduler/rank.go:205-571).
+    """
+
+    n_real: int
+    n_pad: int
+    node_ids: List[str]
+    cpu_cap: np.ndarray        # (n_pad,) float64 -- capacity minus reserved
+    mem_cap: np.ndarray
+    disk_cap: np.ndarray
+    port_bitmap: np.ndarray    # (n_pad, PORT_WORDS) uint32, agent-reserved ports
+    dyn_free: np.ndarray       # (n_pad,) int32 free ports in dynamic range
+    valid: np.ndarray          # (n_pad,) bool -- real node vs padding
+
+
+def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
+    n = len(nodes)
+    if n_pad is None:
+        n_pad = bucket_size(n)
+    cpu = np.zeros(n_pad, dtype=np.float64)
+    mem = np.zeros(n_pad, dtype=np.float64)
+    disk = np.zeros(n_pad, dtype=np.float64)
+    ports = np.zeros((n_pad, PORT_WORDS), dtype=np.uint32)
+    dyn_free = np.zeros(n_pad, dtype=np.int32)
+    valid = np.zeros(n_pad, dtype=bool)
+    ids = []
+    for i, node in enumerate(nodes):
+        ids.append(node.id)
+        nr, rr = node.node_resources, node.reserved_resources
+        cpu[i] = nr.cpu.cpu_shares - rr.cpu_shares
+        mem[i] = nr.memory.memory_mb - rr.memory_mb
+        disk[i] = nr.disk.disk_mb - rr.disk_mb
+        lo, hi = nr.min_dynamic_port, nr.max_dynamic_port
+        dyn_free[i] = max(0, hi - lo + 1)
+        for p in rr.reserved_ports:
+            if 0 <= p < 65536:
+                ports[i, p >> 5] |= np.uint32(1 << (p & 31))
+                if lo <= p <= hi:
+                    dyn_free[i] -= 1
+        valid[i] = True
+    return NodeMatrix(n_real=n, n_pad=n_pad, node_ids=ids, cpu_cap=cpu,
+                      mem_cap=mem, disk_cap=disk, port_bitmap=ports,
+                      dyn_free=dyn_free, valid=valid)
+
+
+@dataclass
+class UsageState:
+    """Dynamic usage on the node axis: what proposed allocs consume
+    (reference analog: EvalContext.ProposedAllocs -> AllocsFit used sum)."""
+
+    used_cpu: np.ndarray       # (n_pad,) float64
+    used_mem: np.ndarray
+    used_disk: np.ndarray
+    placed_jobtg: np.ndarray   # (n_pad,) int32 allocs of THIS job+tg per node
+    placed_job: np.ndarray     # (n_pad,) int32 allocs of THIS job (any tg)
+    port_bitmap: np.ndarray    # (n_pad, PORT_WORDS) uint32 incl. alloc ports
+    dyn_used: np.ndarray       # (n_pad,) int32 dynamic-range ports in use
+
+
+def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
+               job_id: str, tg_name: str, namespace: str = "default",
+               nodes=None) -> UsageState:
+    """Fold proposed allocations into usage tensors. ``proposed_by_node``
+    maps node id -> list of proposed allocs (already excluding plan stops
+    and client-terminal allocs, exactly what ctx.proposed_allocs returns)."""
+    n_pad = matrix.n_pad
+    used_cpu = np.zeros(n_pad, dtype=np.float64)
+    used_mem = np.zeros(n_pad, dtype=np.float64)
+    used_disk = np.zeros(n_pad, dtype=np.float64)
+    placed = np.zeros(n_pad, dtype=np.int32)
+    placed_job = np.zeros(n_pad, dtype=np.int32)
+    ports = matrix.port_bitmap.copy()
+    dyn_used = np.zeros(n_pad, dtype=np.int32)
+    index = {nid: i for i, nid in enumerate(matrix.node_ids)}
+    dyn_ranges = {}
+    if nodes is not None:
+        for node in nodes:
+            dyn_ranges[node.id] = (node.node_resources.min_dynamic_port,
+                                   node.node_resources.max_dynamic_port)
+    for nid, allocs in proposed_by_node.items():
+        i = index.get(nid)
+        if i is None:
+            continue
+        lo, hi = dyn_ranges.get(nid, (20000, 32000))
+        for alloc in allocs:
+            cr = alloc.allocated_resources.comparable()
+            used_cpu[i] += cr.cpu_shares
+            used_mem[i] += cr.memory_mb
+            used_disk[i] += cr.disk_mb
+            if alloc.job_id == job_id and alloc.namespace == namespace:
+                placed_job[i] += 1
+                if alloc.task_group == tg_name:
+                    placed[i] += 1
+            for pm in alloc.allocated_resources.shared.ports:
+                v = pm.value
+                if 0 <= v < 65536:
+                    word, bit = v >> 5, np.uint32(1 << (v & 31))
+                    if not ports[i, word] & bit:
+                        ports[i, word] |= bit
+                        if lo <= v <= hi:
+                            dyn_used[i] += 1
+    return UsageState(used_cpu=used_cpu, used_mem=used_mem,
+                      used_disk=used_disk, placed_jobtg=placed,
+                      placed_job=placed_job, port_bitmap=ports,
+                      dyn_used=dyn_used)
+
+
+def pack_feasibility(ctx, stack_like, tg, nodes, n_pad: int,
+                     alloc_name: str = "") -> np.ndarray:
+    """Evaluate the boolean feasibility pipeline per node, memoized by
+    computed class exactly like FeasibilityWrapper (feasible.go:1126).
+
+    Host-side by design: constraint evaluation is string/regex-shaped and
+    runs once per (eval, class), not per placement -- the per-placement hot
+    loop (fit+score+select) is what runs on TPU."""
+    from ..scheduler.feasible import (
+        ConstraintChecker, DriverChecker, DeviceChecker, HostVolumeChecker,
+        NetworkChecker)
+    from ..scheduler.stack import _tg_constraints
+
+    job = ctx.plan.job
+    drivers, constraints = _tg_constraints(tg)
+    job_check = ConstraintChecker(ctx, job.constraints if job else [])
+    drv_check = DriverChecker(ctx, drivers)
+    tg_check = ConstraintChecker(ctx, constraints)
+    dev_check = DeviceChecker(ctx)
+    dev_check.set_task_group(tg)
+    vol_check = HostVolumeChecker(ctx)
+    vol_check.set_volumes(alloc_name, tg.volumes)
+    net_check = NetworkChecker(ctx)
+    if tg.networks:
+        net_check.set_network(tg.networks[0])
+
+    out = np.zeros(n_pad, dtype=bool)
+    class_cache: Dict[str, bool] = {}
+    escaped = any("unique." in (c.l_target + c.r_target)
+                  for c in (job.constraints if job else []) + constraints)
+    for i, node in enumerate(nodes):
+        cls = node.computed_class
+        if not escaped and cls in class_cache:
+            class_ok = class_cache[cls]
+        else:
+            class_ok = (job_check.feasible(node) and drv_check.feasible(node)
+                        and tg_check.feasible(node)
+                        and dev_check.feasible(node)
+                        and net_check.feasible(node))
+            if not escaped and cls:
+                class_cache[cls] = class_ok
+        out[i] = class_ok and vol_check.feasible(node)
+    return out
+
+
+@dataclass
+class SpreadInfo:
+    """Spread attributes tensorized: per spread, each node's value index into
+    a padded value table plus desired counts (reference: spread.go
+    computeSpreadInfo + propertyset.go)."""
+
+    n_spreads: int
+    value_index: np.ndarray    # (S, n_pad) int32; -1 = attribute missing
+    n_values: int              # V (padded distinct values across spreads)
+    desired: np.ndarray        # (S, V) float64; -1 = no explicit target
+    has_targets: np.ndarray    # (S,) bool
+    weights: np.ndarray        # (S,) float64
+    sum_weights: float
+    initial_counts: np.ndarray  # (S, V) int32 existing allocs per value
+    values: List[List[str]]    # per spread, the value table
+
+
+def pack_spreads(spreads, nodes, n_pad: int, tg_count: int,
+                 existing_value_counts: Optional[List[Dict[str, int]]] = None
+                 ) -> Optional[SpreadInfo]:
+    """Build spread tensors; None when the TG has no spreads."""
+    from ..scheduler.util import resolve_target
+    if not spreads:
+        return None
+    S = len(spreads)
+    tables: List[List[str]] = []
+    per_node_vals: List[List[str]] = []
+    for s in spreads:
+        vals = []
+        node_vals = []
+        for node in nodes:
+            v, ok = resolve_target(s.attribute, node)
+            node_vals.append(str(v) if ok else None)
+            if ok and str(v) not in vals:
+                vals.append(str(v))
+        # values referenced only by existing allocs still need slots
+        if existing_value_counts:
+            idx = len(tables)
+            if idx < len(existing_value_counts):
+                for v in existing_value_counts[idx]:
+                    if v not in vals:
+                        vals.append(v)
+        tables.append(vals)
+        per_node_vals.append(node_vals)
+    V = max(1, max(len(t) for t in tables))
+    value_index = np.full((S, n_pad), -1, dtype=np.int32)
+    desired = np.full((S, V), -1.0, dtype=np.float64)
+    has_targets = np.zeros(S, dtype=bool)
+    weights = np.zeros(S, dtype=np.float64)
+    init_counts = np.zeros((S, V), dtype=np.int32)
+    for si, s in enumerate(spreads):
+        table = {v: j for j, v in enumerate(tables[si])}
+        for ni, v in enumerate(per_node_vals[si]):
+            if v is not None:
+                value_index[si, ni] = table[v]
+        weights[si] = float(s.weight)
+        if s.spread_target:
+            has_targets[si] = True
+            implicit = None
+            for t in s.spread_target:
+                if t.value == "*":
+                    implicit = (t.percent / 100.0) * tg_count
+                    continue
+                if t.value in table:
+                    desired[si, table[t.value]] = (t.percent / 100.0) * tg_count
+            if implicit is not None:
+                for v, j in table.items():
+                    if desired[si, j] < 0:
+                        desired[si, j] = implicit
+        if existing_value_counts and si < len(existing_value_counts):
+            for v, c in existing_value_counts[si].items():
+                if v in table:
+                    init_counts[si, table[v]] = c
+    return SpreadInfo(n_spreads=S, value_index=value_index, n_values=V,
+                      desired=desired, has_targets=has_targets,
+                      weights=weights, sum_weights=float(weights.sum()),
+                      initial_counts=init_counts, values=tables)
+
+
+def pack_affinities(affinities, ctx, nodes, n_pad: int) -> Optional[np.ndarray]:
+    """Per-node normalized affinity score (static within an eval)
+    (reference: rank.go:756 NodeAffinityIterator)."""
+    from ..scheduler.feasible import check_constraint
+    from ..scheduler.util import resolve_target
+    if not affinities:
+        return None
+    sum_weight = sum(abs(float(a.weight)) for a in affinities)
+    out = np.zeros(n_pad, dtype=np.float64)
+    for i, node in enumerate(nodes):
+        total = 0.0
+        for aff in affinities:
+            lval, l_ok = resolve_target(aff.l_target, node)
+            rval, r_ok = resolve_target(aff.r_target, node)
+            if check_constraint(ctx, aff.operand, lval, rval, l_ok, r_ok):
+                total += float(aff.weight)
+        out[i] = total / sum_weight if sum_weight else 0.0
+    return out

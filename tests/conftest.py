@@ -1,13 +1,19 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so
 multi-chip sharding paths are exercised without TPU hardware.
 
-Must run before the first `import jax` anywhere in the test session.
+This image's jax build mis-handles the JAX_PLATFORMS env var (the axon TPU
+plugin wins whenever the env var is set), so the var must be REMOVED and
+the platform forced via jax.config.update instead.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ.pop("JAX_PLATFORMS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

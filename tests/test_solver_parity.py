@@ -1,0 +1,207 @@
+"""TPU-solver vs host-oracle parity: identical placements on the same eval.
+
+This is the north-star contract (BASELINE.json: "identical plan to the Go
+BinPackIterator"): tpu-binpack must place exactly where the host iterator
+stack places, including the shuffled log2-limited scan window and score
+tie-breaks. Runs on the virtual CPU mesh (conftest.py) in float64.
+"""
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    Affinity, Constraint, SchedulerConfiguration, Spread, SpreadTarget,
+    NetworkResource, Port,
+    SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU_BINPACK,
+    SCHED_ALG_TPU_SPREAD, ALLOC_CLIENT_RUNNING,
+)
+
+
+def _random_fleet(rng, n):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.node_resources.cpu.cpu_shares = rng.choice([2000, 4000, 8000])
+        node.node_resources.memory.memory_mb = rng.choice([4096, 8192, 16384])
+        node.compute_class()
+        nodes.append(node)
+    return nodes
+
+
+def _seed_usage(rng, h, nodes):
+    """Pre-place allocs from other jobs to diversify utilization."""
+    for node in nodes:
+        for _ in range(rng.randint(0, 3)):
+            other = mock.job()
+            other.task_groups[0].tasks[0].resources.cpu = rng.choice([250, 500, 1000])
+            other.task_groups[0].tasks[0].resources.memory_mb = rng.choice([256, 512, 1024])
+            a = mock.alloc_for(other, node)
+            a.client_status = ALLOC_CLIENT_RUNNING
+            h.state.upsert_allocs([a])
+
+
+def _run_both(make_job, n_nodes=12, seed=0, host_alg=SCHED_ALG_BINPACK,
+              tpu_alg=SCHED_ALG_TPU_BINPACK, seed_usage=True):
+    """Build two identical worlds, schedule with host vs tpu algorithm,
+    return the two {alloc name -> node id} placement maps."""
+    placements = []
+    eval_id = f"parity-eval-{seed:08d}"
+    for alg in (host_alg, tpu_alg):
+        rng = random.Random(seed)
+        mock._counter = __import__("itertools").count()
+        h = Harness()
+        h.state.set_scheduler_config(
+            SchedulerConfiguration(scheduler_algorithm=alg))
+        nodes = _random_fleet(rng, n_nodes)
+        # identical node ids across the two worlds
+        for i, node in enumerate(nodes):
+            node.id = f"node-{seed}-{i:04d}"
+            h.state.upsert_node(node)
+        if seed_usage:
+            _seed_usage(rng, h, nodes)
+        job = make_job(rng)
+        job.id = f"parity-job-{seed}"
+        h.state.upsert_job(job)
+        ev = mock.evaluation(job_id=job.id, type=job.type)
+        ev.id = eval_id
+        err = h.process("service" if job.type == "service" else job.type, ev)
+        assert err is None
+        result = {}
+        for plan in h.plans:
+            for node_id, allocs in plan.node_allocation.items():
+                for a in allocs:
+                    if a.eval_id == eval_id:
+                        result[a.name] = node_id
+        placements.append(result)
+    return placements
+
+
+def _basic_job(rng):
+    job = mock.job()
+    job.task_groups[0].count = rng.randint(2, 8)
+    job.task_groups[0].tasks[0].resources.cpu = rng.choice([250, 500, 1000])
+    job.task_groups[0].tasks[0].resources.memory_mb = rng.choice([256, 512])
+    return job
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_basic_service(seed):
+    host, tpu = _run_both(_basic_job, n_nodes=12, seed=seed)
+    assert host and host == tpu
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_parity_spread_algorithm(seed):
+    host, tpu = _run_both(_basic_job, n_nodes=10, seed=seed,
+                          host_alg=SCHED_ALG_SPREAD,
+                          tpu_alg=SCHED_ALG_TPU_SPREAD)
+    assert host and host == tpu
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_parity_with_constraints(seed):
+    def make_job(rng):
+        job = _basic_job(rng)
+        job.constraints = [Constraint(l_target="${attr.kernel.name}",
+                                      r_target="linux", operand="=")]
+        job.task_groups[0].constraints = [
+            Constraint(l_target="${attr.cpu.numcores}", r_target="2",
+                       operand=">=")]
+        return job
+    host, tpu = _run_both(make_job, n_nodes=10, seed=seed + 100)
+    assert host and host == tpu
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_parity_with_affinities(seed):
+    def make_job(rng):
+        job = _basic_job(rng)
+        job.affinities = [Affinity(l_target="${node.datacenter}",
+                                   r_target="dc1", operand="=", weight=50)]
+        return job
+    host, tpu = _run_both(make_job, n_nodes=8, seed=seed + 200)
+    assert host and host == tpu
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_parity_with_spread_block(seed):
+    def make_job(rng):
+        job = _basic_job(rng)
+        job.task_groups[0].spreads = [
+            Spread(attribute="${node.datacenter}", weight=50)]
+        return job
+
+    # give nodes two datacenters deterministically
+    def fleet_patch(run):
+        pass
+    host, tpu = _run_both(make_job, n_nodes=8, seed=seed + 300)
+    assert host and host == tpu
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_parity_with_ports(seed):
+    def make_job(rng):
+        job = _basic_job(rng)
+        job.task_groups[0].networks = [NetworkResource(
+            reserved_ports=[Port(label="admin", value=8080)],
+            dynamic_ports=[Port(label="http")])]
+        return job
+    host, tpu = _run_both(make_job, n_nodes=8, seed=seed + 400)
+    assert host and host == tpu
+    # static port conflicts: at most one alloc per node
+    nodes_used = list(host.values())
+    assert len(nodes_used) == len(set(nodes_used))
+
+
+def test_parity_distinct_hosts():
+    def make_job(rng):
+        job = _basic_job(rng)
+        job.task_groups[0].count = 4
+        job.task_groups[0].constraints = [
+            Constraint(operand="distinct_hosts")]
+        return job
+    host, tpu = _run_both(make_job, n_nodes=8, seed=77)
+    assert host and host == tpu
+    assert len(set(host.values())) == len(host)
+
+
+def test_parity_job_level_distinct_hosts():
+    # job-level distinct_hosts blocks ANY alloc of the job per host
+    def make_job(rng):
+        job = _basic_job(rng)
+        job.task_groups[0].count = 3
+        import copy
+        tg2 = copy.deepcopy(job.task_groups[0])
+        tg2.name = "api"
+        tg2.count = 2
+        job.task_groups.append(tg2)
+        job.constraints = [Constraint(operand="distinct_hosts")]
+        return job
+    host, tpu = _run_both(make_job, n_nodes=8, seed=88)
+    assert host and host == tpu
+    assert len(set(host.values())) == len(host)  # every alloc on its own host
+
+
+def test_parity_large_fleet():
+    host, tpu = _run_both(_basic_job, n_nodes=200, seed=9)
+    assert host and host == tpu
+
+
+def test_tpu_insufficient_capacity_blocks():
+    h = Harness()
+    h.state.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU_BINPACK))
+    n = mock.node()
+    n.node_resources.cpu.cpu_shares = 1000
+    h.state.upsert_node(n)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id, type="service")
+    err = h.process("service", ev)
+    assert err is None
+    placed = [a for p in h.plans for v in p.node_allocation.values() for a in v]
+    assert len(placed) == 2
+    assert len(h.create_evals) == 1  # blocked eval
