@@ -1,0 +1,2 @@
+"""HTTP API + dev agent (reference: /root/reference/command/agent/)."""
+from .http import HttpServer, job_from_json, to_jsonable  # noqa: F401

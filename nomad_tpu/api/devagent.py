@@ -1,0 +1,63 @@
+"""Dev agent: single-process server + simulated fleet + HTTP API
+(reference analog: `nomad agent -dev`, command/agent/command.go:775).
+
+Run: python -m nomad_tpu.api.devagent [--nodes N] [--port P] [--tpu]
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="nomad-tpu dev agent")
+    parser.add_argument("--nodes", type=int, default=3,
+                        help="simulated client nodes")
+    parser.add_argument("--port", type=int, default=4646)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--tpu", action="store_true",
+                        help="enable the tpu-binpack scheduler algorithm")
+    args = parser.parse_args(argv)
+
+    from .. import mock
+    from ..client import SimClient
+    from ..server import Server
+    from ..structs import SchedulerConfiguration, SCHED_ALG_TPU_BINPACK
+    from .http import HttpServer
+
+    server = Server(num_workers=args.workers)
+    if args.tpu:
+        server.state.set_scheduler_config(SchedulerConfiguration(
+            scheduler_algorithm=SCHED_ALG_TPU_BINPACK))
+    server.start()
+
+    clients = []
+    for _ in range(args.nodes):
+        c = SimClient(server, mock.node())
+        c.start()
+        clients.append(c)
+
+    http = HttpServer(server, port=args.port)
+    http.start()
+    print(f"==> nomad-tpu dev agent: http://127.0.0.1:{http.port} "
+          f"({args.nodes} simulated nodes, "
+          f"algorithm={server.state.scheduler_config().scheduler_algorithm})")
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        http.shutdown()
+        for c in clients:
+            c.stop()
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,334 @@
+"""HTTP API: the /v1/* surface (reference:
+/root/reference/command/agent/http.go:382 registerHandlers + per-resource
+endpoint files). JSON in/out; blocking queries via ?index=N&wait=Ns exactly
+like the reference's blocking-query contract (nomad/rpc.go:852).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..structs import (
+    Constraint, EphemeralDisk, Job, NetworkResource, Port, ReschedulePolicy,
+    Resources, RestartPolicy, SchedulerConfiguration, Spread, SpreadTarget,
+    Task, TaskGroup, UpdateStrategy, Affinity, PeriodicConfig,
+)
+
+
+def to_jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: to_jsonable(v)
+                for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {str(k): to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", "replace")
+    return obj
+
+
+def job_from_json(data: dict) -> Job:
+    """Parse the JSON jobspec (the reference's api.Job JSON shape,
+    snake_cased; jobspec2 HCL parsing maps to the same structure)."""
+    def build(cls, src, **overrides):
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in (src or {}).items() if k in fields}
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    tgs = []
+    for tg_src in data.get("task_groups", []):
+        tasks = []
+        for t_src in tg_src.get("tasks", []):
+            res_src = t_src.get("resources", {})
+            networks = [
+                build(NetworkResource, n,
+                      reserved_ports=[build(Port, p) for p in
+                                      n.get("reserved_ports", [])],
+                      dynamic_ports=[build(Port, p) for p in
+                                     n.get("dynamic_ports", [])])
+                for n in res_src.get("networks", [])]
+            resources = build(Resources, res_src, networks=networks,
+                              devices=[])
+            tasks.append(build(
+                Task, t_src, resources=resources,
+                constraints=[build(Constraint, c)
+                             for c in t_src.get("constraints", [])],
+                affinities=[build(Affinity, a)
+                            for a in t_src.get("affinities", [])],
+                services=[]))
+        networks = [
+            build(NetworkResource, n,
+                  reserved_ports=[build(Port, p)
+                                  for p in n.get("reserved_ports", [])],
+                  dynamic_ports=[build(Port, p)
+                                 for p in n.get("dynamic_ports", [])])
+            for n in tg_src.get("networks", [])]
+        tg = build(
+            TaskGroup, tg_src, tasks=tasks, networks=networks,
+            constraints=[build(Constraint, c)
+                         for c in tg_src.get("constraints", [])],
+            affinities=[build(Affinity, a)
+                        for a in tg_src.get("affinities", [])],
+            spreads=[build(Spread, s,
+                           spread_target=[build(SpreadTarget, t)
+                                          for t in s.get("spread_target", [])])
+                     for s in tg_src.get("spreads", [])],
+            update=(build(UpdateStrategy, tg_src["update"])
+                    if tg_src.get("update") else None),
+            restart_policy=build(RestartPolicy,
+                                 tg_src.get("restart_policy", {})),
+            reschedule_policy=(build(ReschedulePolicy,
+                                     tg_src["reschedule_policy"])
+                               if tg_src.get("reschedule_policy") else None),
+            ephemeral_disk=build(EphemeralDisk,
+                                 tg_src.get("ephemeral_disk", {})),
+            volumes={}, scaling=None, migrate=None)
+        tgs.append(tg)
+    job = Job(
+        id=data.get("id", ""),
+        name=data.get("name", data.get("id", "")),
+        namespace=data.get("namespace", "default"),
+        type=data.get("type", "service"),
+        priority=int(data.get("priority", 50)),
+        all_at_once=bool(data.get("all_at_once", False)),
+        datacenters=data.get("datacenters", ["*"]),
+        node_pool=data.get("node_pool", "default"),
+        constraints=[Constraint(**{k: v for k, v in c.items()
+                                   if k in ("l_target", "r_target", "operand")})
+                     for c in data.get("constraints", [])],
+        affinities=[Affinity(**{k: v for k, v in a.items()
+                                if k in ("l_target", "r_target", "operand",
+                                         "weight")})
+                    for a in data.get("affinities", [])],
+        spreads=[],
+        task_groups=tgs,
+        meta=data.get("meta", {}),
+    )
+    if data.get("update"):
+        fields = {f.name for f in dataclasses.fields(UpdateStrategy)}
+        job.update = UpdateStrategy(**{k: v for k, v in data["update"].items()
+                                       if k in fields})
+    if data.get("periodic"):
+        fields = {f.name for f in dataclasses.fields(PeriodicConfig)}
+        job.periodic = PeriodicConfig(
+            **{k: v for k, v in data["periodic"].items() if k in fields})
+    return job
+
+
+class ApiHandler(BaseHTTPRequestHandler):
+    server_version = "nomad-tpu/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # quiet logs
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def nomad(self):
+        return self.server.nomad_server
+
+    # ------------------------------------------------------------------
+    def _send(self, code: int, payload, index: Optional[int] = None) -> None:
+        body = json.dumps(to_jsonable(payload)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if index is not None:
+            self.send_header("X-Nomad-Index", str(index))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str) -> None:
+        self._send(code, {"error": msg})
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _blocking(self, query) -> int:
+        """Apply ?index/?wait blocking semantics; returns current index."""
+        q = parse_qs(query)
+        if "index" in q:
+            min_index = int(q["index"][0])
+            wait = 5.0
+            if "wait" in q:
+                wait = float(q["wait"][0].rstrip("s"))
+            return self.nomad.state.block_until(min_index, timeout=wait)
+        return self.nomad.state.latest_index()
+
+    # ------------------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        state = self.nomad.state
+        try:
+            index = self._blocking(url.query)
+            q = parse_qs(url.query)
+            ns = q.get("namespace", ["default"])[0]
+            if parts[:2] == ["v1", "jobs"] and len(parts) == 2:
+                self._send(200, [self._job_stub(j) for j in state.jobs()],
+                           index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 3:
+                job = state.job_by_id(ns, parts[2])
+                if job is None:
+                    return self._error(404, "job not found")
+                self._send(200, job, index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "allocations":
+                self._send(200, state.allocs_by_job(ns, parts[2]), index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "evaluations":
+                self._send(200, state.evals_by_job(ns, parts[2]), index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "deployment":
+                self._send(200, state.latest_deployment_by_job(ns, parts[2]),
+                           index)
+            elif parts[:2] == ["v1", "evaluations"]:
+                self._send(200, state.evals(), index)
+            elif parts[:2] == ["v1", "evaluation"] and len(parts) == 3:
+                ev = state.eval_by_id(parts[2])
+                if ev is None:
+                    return self._error(404, "eval not found")
+                self._send(200, ev, index)
+            elif parts[:2] == ["v1", "allocations"]:
+                self._send(200, state.allocs(), index)
+            elif parts[:2] == ["v1", "allocation"] and len(parts) == 3:
+                a = state.alloc_by_id(parts[2])
+                if a is None:
+                    return self._error(404, "alloc not found")
+                self._send(200, a, index)
+            elif parts[:2] == ["v1", "nodes"]:
+                self._send(200, [self._node_stub(n) for n in state.nodes()],
+                           index)
+            elif parts[:2] == ["v1", "node"] and len(parts) == 3:
+                n = state.node_by_id(parts[2])
+                if n is None:
+                    return self._error(404, "node not found")
+                self._send(200, n, index)
+            elif parts[:2] == ["v1", "deployments"]:
+                self._send(200, state.deployments(), index)
+            elif parts == ["v1", "operator", "scheduler", "configuration"]:
+                self._send(200, state.scheduler_config(), index)
+            elif parts == ["v1", "status", "leader"]:
+                self._send(200, "local")
+            elif parts == ["v1", "agent", "health"]:
+                self._send(200, {"server": {"ok": True}})
+            elif parts == ["v1", "event", "stream"]:
+                since = int(q.get("index", ["0"])[0])
+                self._send(200, self.nomad.events_since(since), index)
+            elif parts == ["v1", "metrics"]:
+                self._send(200, self._metrics())
+            else:
+                self._error(404, f"unknown path {url.path}")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # pragma: no cover
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_PUT(self):  # noqa: N802
+        self.do_POST()
+
+    def do_POST(self):  # noqa: N802
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if parts[:2] == ["v1", "jobs"]:
+                body = self._body()
+                job = job_from_json(body.get("job", body))
+                if not job.id:
+                    return self._error(400, "job id required")
+                ev = self.nomad.register_job(job)
+                self._send(200, {"eval_id": ev.id if ev else "",
+                                 "job_modify_index": job.job_modify_index})
+            elif parts == ["v1", "operator", "scheduler", "configuration"]:
+                body = self._body()
+                cfg = SchedulerConfiguration(
+                    scheduler_algorithm=body.get("scheduler_algorithm",
+                                                 "binpack"),
+                    memory_oversubscription_enabled=body.get(
+                        "memory_oversubscription_enabled", False))
+                self.nomad.state.set_scheduler_config(cfg)
+                self._send(200, {"updated": True})
+            elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
+                    parts[3] == "drain":
+                from ..structs import DrainStrategy
+                body = self._body()
+                strategy = None
+                if body.get("drain_spec") is not None:
+                    strategy = DrainStrategy(
+                        deadline_s=body["drain_spec"].get("deadline_s", 3600))
+                self.nomad.drain_node(parts[2], strategy)
+                self._send(200, {"updated": True})
+            elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
+                    parts[3] == "eligibility":
+                body = self._body()
+                self.nomad.state.update_node_eligibility(
+                    parts[2], body.get("eligibility", "eligible"))
+                self._send(200, {"updated": True})
+            else:
+                self._error(404, f"unknown path {url.path}")
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_DELETE(self):  # noqa: N802
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        q = parse_qs(url.query)
+        ns = q.get("namespace", ["default"])[0]
+        purge = q.get("purge", ["false"])[0] == "true"
+        if parts[:2] == ["v1", "job"] and len(parts) == 3:
+            ev = self.nomad.deregister_job(ns, parts[2], purge=purge)
+            if ev is None:
+                return self._error(404, "job not found")
+            self._send(200, {"eval_id": ev.id})
+        else:
+            self._error(404, f"unknown path {url.path}")
+
+    # ------------------------------------------------------------------
+    def _job_stub(self, j) -> dict:
+        return {"id": j.id, "name": j.name, "namespace": j.namespace,
+                "type": j.type, "priority": j.priority, "status": j.status,
+                "version": j.version, "stop": j.stop}
+
+    def _node_stub(self, n) -> dict:
+        return {"id": n.id, "name": n.name, "datacenter": n.datacenter,
+                "status": n.status, "node_class": n.node_class,
+                "scheduling_eligibility": n.scheduling_eligibility,
+                "drain": n.drain}
+
+    def _metrics(self) -> dict:
+        s = self.nomad
+        return {
+            "broker": s.broker.stats(),
+            "blocked_evals": s.blocked_evals.stats(),
+            "plans_applied": s.planner.plans_applied,
+            "plans_rejected": s.planner.plans_rejected,
+            "state_index": s.state.latest_index(),
+        }
+
+
+class HttpServer:
+    """(reference: command/agent/http.go:179)"""
+
+    def __init__(self, nomad_server, host: str = "127.0.0.1", port: int = 4646):
+        self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
+        self.httpd.nomad_server = nomad_server
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="http-api")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()

@@ -1,0 +1,2 @@
+"""Node agent (reference: /root/reference/client/)."""
+from .agent import SimClient  # noqa: F401

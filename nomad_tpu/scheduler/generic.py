@@ -185,6 +185,9 @@ class GenericScheduler:
         )
         results = reconciler.compute()
         self.followup_evals = results.desired_followup_evals
+        # the deployment placements attach to: existing-and-active or newly
+        # created by the reconciler (reference: generic_sched.go s.deployment)
+        self.deployment = reconciler.deployment
 
         if results.deployment is not None:
             self.plan.deployment = results.deployment
@@ -245,9 +248,7 @@ class GenericScheduler:
                     self._queue_blocked_eval()
                 return True
 
-        deployment_id = ""
-        if self.plan.deployment is not None:
-            deployment_id = self.plan.deployment.id
+        deployment_id = self._deployment_id()
 
         for place in places:
             tg = place.task_group
@@ -326,6 +327,17 @@ class GenericScheduler:
             self._queue_blocked_eval()
         return True
 
+    def _deployment_id(self) -> str:
+        """Placements attach to the active deployment of the CURRENT job
+        version (reference: generic_sched.go computePlacements
+        deploymentID)."""
+        d = self.deployment if self.deployment is not None \
+            else self.plan.deployment
+        if (d is not None and d.active() and self.job is not None
+                and d.job_version == self.job.version):
+            return d.id
+        return ""
+
     def _tpu_algorithm(self) -> bool:
         if not hasattr(self.state, "scheduler_config"):
             return False
@@ -350,9 +362,7 @@ class GenericScheduler:
                 order.append(place.task_group.name)
             groups.setdefault(place.task_group.name, []).append(place)
 
-        deployment_id = ""
-        if self.plan.deployment is not None:
-            deployment_id = self.plan.deployment.id
+        deployment_id = self._deployment_id()
 
         fallback: List[AllocPlaceResult] = []
         service = TpuPlacementService(

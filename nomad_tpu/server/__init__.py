@@ -1,0 +1,5 @@
+"""Server control plane (reference: /root/reference/nomad/)."""
+from .broker import BlockedEvals, EvalBroker  # noqa: F401
+from .core import Server  # noqa: F401
+from .plan_apply import BadNodeTracker, Planner  # noqa: F401
+from .worker import Worker, WorkerPlanner  # noqa: F401

@@ -1,0 +1,304 @@
+"""Evaluation broker: the priority work queue feeding scheduler workers.
+
+Semantic parity with /root/reference/nomad/eval_broker.go (EvalBroker :52,
+Enqueue :201, Dequeue :354, Ack :555, Nack :632, delayed-eval heap
+:791) and blocked_evals.go (BlockedEvals :35, class-keyed unblocking).
+Leader-only in the reference; here enabled/disabled the same way.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..structs import (
+    Evaluation, EVAL_STATUS_BLOCKED, EVAL_STATUS_PENDING,
+    TRIGGER_MAX_DISCONNECT_TIMEOUT, TRIGGER_QUEUED_ALLOCS,
+)
+
+DEFAULT_NACK_TIMEOUT = 60.0
+DEFAULT_DELIVERY_LIMIT = 3
+FAILED_QUEUE = "_failed"
+
+
+class EvalBroker:
+    """(reference: eval_broker.go:52)"""
+
+    def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT):
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._lock = threading.Condition()
+        self.enabled = False
+        # sched type -> heap of (-priority, seq, eval)
+        self._ready: Dict[str, list] = {}
+        self._unack: Dict[str, Tuple[Evaluation, str, float]] = {}  # id -> (eval, token, deadline)
+        self._waiting: Dict[str, Evaluation] = {}   # dedup: pending per job
+        self._evals: Dict[str, int] = {}            # eval id -> dequeue count
+        self._delayed: list = []                    # (wait_until, seq, eval)
+        self._seq = 0
+        self._stats = {"total_ready": 0, "total_unacked": 0,
+                       "total_blocked": 0, "total_waiting": 0}
+        self._timer_thread: Optional[threading.Thread] = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            was = self.enabled
+            self.enabled = enabled
+            if not enabled:
+                # flush everything (reference: broker.flush on disable)
+                self._ready.clear()
+                self._unack.clear()
+                self._waiting.clear()
+                self._evals.clear()
+                self._delayed = []
+            self._lock.notify_all()
+        if enabled and not was:
+            self._start_delayed_watcher()
+
+    def _start_delayed_watcher(self) -> None:
+        if self._timer_thread is not None and self._timer_thread.is_alive():
+            return
+        self._timer_thread = threading.Thread(
+            target=self._run_delayed_watcher, daemon=True,
+            name="eval-broker-delayed")
+        self._timer_thread.start()
+
+    def _run_delayed_watcher(self) -> None:
+        """Move delayed evals into the ready queues when their wait_until
+        passes (reference: eval_broker.go:791 runDelayedEvalsWatcher), and
+        periodically retry failed evals (reference: the leader's
+        failed-eval follow-up, leader.go reapFailedEvaluations)."""
+        last_failed_retry = time.time()
+        while True:
+            with self._lock:
+                if self._shutdown or not self.enabled:
+                    return
+                now = time.time()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, ev = heapq.heappop(self._delayed)
+                    self._enqueue_locked(ev)
+                if now - last_failed_retry >= self.nack_timeout / 2:
+                    last_failed_retry = now
+                    failed = self._ready.pop(FAILED_QUEUE, None)
+                    if failed:
+                        for _, _, ev in failed:
+                            self._evals.pop(ev.id, None)  # reset deliveries
+                            self._enqueue_locked(ev)
+                        self._lock.notify_all()
+                timeout = (self._delayed[0][0] - now) if self._delayed else 1.0
+                self._lock.wait(min(max(timeout, 0.01), 1.0))
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------------
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._process_enqueue(ev)
+            self._lock.notify_all()
+
+    def enqueue_all(self, evals: List[Evaluation]) -> None:
+        with self._lock:
+            for ev in evals:
+                self._process_enqueue(ev)
+            self._lock.notify_all()
+
+    def _process_enqueue(self, ev: Evaluation) -> None:
+        if not self.enabled:
+            return
+        if ev.id in self._evals and ev.id not in self._unack:
+            return  # already tracked and ready
+        if ev.wait_until and ev.wait_until > time.time():
+            self._seq += 1
+            heapq.heappush(self._delayed, (ev.wait_until, self._seq, ev))
+            return
+        self._enqueue_locked(ev)
+
+    def _enqueue_locked(self, ev: Evaluation) -> None:
+        # Dedup: one eval per job in-flight; extras wait
+        # (reference: eval_broker.go blocked/waiting tracking by job)
+        namespaced_job = (ev.namespace, ev.job_id)
+        for other in list(self._unack.values()):
+            if (other[0].namespace, other[0].job_id) == namespaced_job:
+                self._waiting[ev.id] = ev
+                return
+        self._seq += 1
+        sched = ev.type
+        self._ready.setdefault(sched, [])
+        heapq.heappush(self._ready[sched], (-ev.priority, self._seq, ev))
+        self._evals.setdefault(ev.id, 0)
+
+    # ------------------------------------------------------------------
+    def dequeue(self, schedulers: List[str], timeout: Optional[float] = None
+                ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue; returns (eval, ack-token)
+        (reference: eval_broker.go:354)."""
+        deadline = time.time() + timeout if timeout is not None else None
+        with self._lock:
+            while True:
+                if not self.enabled:
+                    return None, ""
+                self._check_nack_timeouts_locked()
+                best = None
+                best_key = None
+                for sched in schedulers:
+                    heap = self._ready.get(sched)
+                    while heap and heap[0][2].id in self._unack:
+                        heapq.heappop(heap)
+                    if heap:
+                        key = heap[0][:2]
+                        if best is None or key < best_key:
+                            best = sched
+                            best_key = key
+                if best is not None:
+                    _, _, ev = heapq.heappop(self._ready[best])
+                    token = f"token-{ev.id}-{self._evals.get(ev.id, 0)}"
+                    self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+                    self._unack[ev.id] = (ev, token,
+                                          time.time() + self.nack_timeout)
+                    return ev, token
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None, ""
+                    self._lock.wait(min(remaining, 0.5))
+                else:
+                    self._lock.wait(0.5)
+
+    def _check_nack_timeouts_locked(self) -> None:
+        now = time.time()
+        for eid, (ev, token, dl) in list(self._unack.items()):
+            if dl <= now:
+                del self._unack[eid]
+                self._requeue_or_fail_locked(ev)
+
+    def _requeue_or_fail_locked(self, ev: Evaluation) -> None:
+        if self._evals.get(ev.id, 0) >= self.delivery_limit:
+            self._seq += 1
+            self._ready.setdefault(FAILED_QUEUE, [])
+            heapq.heappush(self._ready[FAILED_QUEUE],
+                           (-ev.priority, self._seq, ev))
+            # the job's pipeline must not wedge behind the failed eval
+            self._promote_waiting_locked(ev)
+        else:
+            self._seq += 1
+            self._ready.setdefault(ev.type, [])
+            heapq.heappush(self._ready[ev.type], (-ev.priority, self._seq, ev))
+        self._lock.notify_all()
+
+    # ------------------------------------------------------------------
+    def ack(self, eval_id: str, token: str) -> Optional[str]:
+        """(reference: eval_broker.go:555). Releases the job's waiting eval."""
+        with self._lock:
+            entry = self._unack.get(eval_id)
+            if entry is None or entry[1] != token:
+                return "token mismatch or eval not outstanding"
+            ev = entry[0]
+            del self._unack[eval_id]
+            self._evals.pop(eval_id, None)
+            self._promote_waiting_locked(ev)
+            self._lock.notify_all()
+            return None
+
+    def _promote_waiting_locked(self, ev: Evaluation) -> None:
+        """Promote one waiting eval for the same job."""
+        for wid, wev in list(self._waiting.items()):
+            if (wev.namespace, wev.job_id) == (ev.namespace, ev.job_id):
+                del self._waiting[wid]
+                self._enqueue_locked(wev)
+                break
+
+    def nack(self, eval_id: str, token: str) -> Optional[str]:
+        """(reference: eval_broker.go:632)"""
+        with self._lock:
+            entry = self._unack.get(eval_id)
+            if entry is None or entry[1] != token:
+                return "token mismatch or eval not outstanding"
+            ev = entry[0]
+            del self._unack[eval_id]
+            self._requeue_or_fail_locked(ev)
+            return None
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total_ready": sum(len(h) for s, h in self._ready.items()
+                                   if s != FAILED_QUEUE),
+                "total_unacked": len(self._unack),
+                "total_waiting": len(self._waiting),
+                "total_delayed": len(self._delayed),
+                "total_failed": len(self._ready.get(FAILED_QUEUE, [])),
+                "by_scheduler": {s: len(h) for s, h in self._ready.items()},
+            }
+
+
+class BlockedEvals:
+    """Holds evals that failed placement until capacity frees
+    (reference: nomad/blocked_evals.go:35). Unblocking is keyed by
+    computed node class: an eval ineligible for every class a new node
+    belongs to stays blocked."""
+
+    def __init__(self, broker: EvalBroker):
+        self.broker = broker
+        self._lock = threading.Lock()
+        self.enabled = False
+        # (namespace, job_id) -> Evaluation  (one blocked eval per job)
+        self._captured: Dict[Tuple[str, str], Evaluation] = {}
+        self._escaped: Set[str] = set()
+        self._stats_blocked = 0
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                self._captured.clear()
+                self._escaped.clear()
+
+    def block(self, ev: Evaluation) -> None:
+        with self._lock:
+            if not self.enabled:
+                return
+            key = (ev.namespace, ev.job_id)
+            # keep only the newest blocked eval per job
+            # (reference: blocked_evals.go duplicate tracking)
+            self._captured[key] = ev
+            if ev.escaped_computed_class:
+                self._escaped.add(ev.id)
+
+    def unblock(self, computed_class: str, index: int = 0) -> List[Evaluation]:
+        """Capacity freed on a node of the given class -> requeue matching
+        evals (reference: blocked_evals.go Unblock)."""
+        with self._lock:
+            if not self.enabled:
+                return []
+            unblock: List[Evaluation] = []
+            for key, ev in list(self._captured.items()):
+                elig = ev.class_eligibility or {}
+                if (ev.id in self._escaped
+                        or not computed_class
+                        or computed_class not in elig
+                        or elig.get(computed_class, True)):
+                    unblock.append(ev)
+                    del self._captured[key]
+                    self._escaped.discard(ev.id)
+            for ev in unblock:
+                requeued = ev.copy()
+                requeued.status = EVAL_STATUS_PENDING
+                requeued.triggered_by = TRIGGER_QUEUED_ALLOCS
+                self.broker.enqueue(requeued)
+            return unblock
+
+    def unblock_all(self) -> List[Evaluation]:
+        return self.unblock("")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"total_blocked": len(self._captured),
+                    "total_escaped": len(self._escaped)}

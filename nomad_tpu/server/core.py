@@ -1,0 +1,503 @@
+"""Server core: wires state, broker, planner, workers, heartbeats, GC,
+periodic dispatch and the deployment watcher into one control plane.
+
+Semantic parity with /root/reference/nomad/server.go (NewServer :326,
+setupWorkers :1793), leader.go (establishLeadership :357 -- broker/queue
+enablement, GC timers :431), heartbeat.go (nodeHeartbeater :37),
+core_sched.go (CoreScheduler GC :44), periodic.go (PeriodicDispatch :25),
+deploymentwatcher/ and node_endpoint.go flows (Register :99, UpdateStatus
+:541, UpdateAlloc :1322). Single-server dev topology: this process is
+always the leader; the raft boundary is the StateStore write API.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..state import StateStore
+from ..structs import (
+    Allocation, Deployment, DeploymentStatusUpdate, Evaluation, Job, Node,
+    Plan, PlanResult, generate_uuid,
+    ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP, DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_PENDING, JOB_STATUS_DEAD, JOB_STATUS_RUNNING,
+    JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
+    NODE_STATUS_DISCONNECTED, NODE_STATUS_DOWN, NODE_STATUS_READY,
+    TRIGGER_DEPLOYMENT_WATCHER, TRIGGER_JOB_DEREGISTER, TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_UPDATE, TRIGGER_PERIODIC_JOB,
+)
+from .broker import BlockedEvals, EvalBroker
+from .plan_apply import Planner
+from .worker import Worker
+
+DEFAULT_HEARTBEAT_TTL = 10.0
+GC_EVAL_THRESHOLD = 3600.0
+GC_INTERVAL = 60.0
+
+
+class Server:
+    """(reference: nomad/server.go:105 Server)"""
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
+                 logger=None):
+        import os
+        self.logger = logger
+        self.state = StateStore()
+        self.broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self.broker)
+        self.planner = Planner(self.state)
+        self.num_workers = num_workers or max(2, (os.cpu_count() or 4))
+        self.workers: List[Worker] = []
+        self.heartbeat_ttl = heartbeat_ttl
+        self._heartbeat_deadlines: Dict[str, float] = {}
+        self._hb_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._events: List[dict] = []
+        self._events_lock = threading.Lock()
+        self._periodic_last: Dict[tuple, float] = {}
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        """Boot + establish leadership (reference: leader.go:357)."""
+        self.broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        for i in range(self.num_workers):
+            w = Worker(self, i)
+            w.start()
+            self.workers.append(w)
+        for fn, name in ((self._run_heartbeat_watcher, "heartbeat"),
+                         (self._run_gc, "core-gc"),
+                         (self._run_periodic, "periodic"),
+                         (self._run_deployment_watcher, "deploy-watch")):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for w in self.workers:
+            w.stop()
+        self.broker.set_enabled(False)
+        self.broker.shutdown()
+        self.planner.shutdown()
+
+    # ------------------------------------------------------------------
+    # Job API (reference: nomad/job_endpoint.go Job.Register :96)
+    def register_job(self, job: Job) -> Evaluation:
+        self.state.upsert_job(job)
+        if job.is_periodic() or job.is_parameterized():
+            # periodic/parameterized jobs don't get an immediate eval
+            # (reference: job_endpoint.go:432 region)
+            return None
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.state.upsert_evals([ev])
+        self.broker.enqueue(ev)
+        self.publish_event("JobRegistered", {"job_id": job.id})
+        return ev
+
+    def deregister_job(self, namespace: str, job_id: str,
+                       purge: bool = False) -> Optional[Evaluation]:
+        """(reference: job_endpoint.go Job.Deregister)"""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        stopped = job
+        import copy
+        stopped = copy.copy(job)
+        stopped.stop = True
+        self.state.upsert_job(stopped)
+        if purge:
+            self.state.delete_job(namespace, job_id)
+        ev = Evaluation(
+            id=generate_uuid(), namespace=namespace, priority=job.priority,
+            type=job.type, triggered_by=TRIGGER_JOB_DEREGISTER,
+            job_id=job_id, status=EVAL_STATUS_PENDING)
+        self.state.upsert_evals([ev])
+        self.broker.enqueue(ev)
+        self.publish_event("JobDeregistered", {"job_id": job_id})
+        return ev
+
+    # ------------------------------------------------------------------
+    # Node API (reference: nomad/node_endpoint.go)
+    def register_node(self, node: Node) -> None:
+        """(reference: node_endpoint.go:99 Register)"""
+        node.status = NODE_STATUS_READY
+        self.state.upsert_node(node)
+        self._reset_heartbeat(node.id)
+        # new capacity -> unblock evals for this class
+        self.blocked_evals.unblock(node.computed_class)
+        self.publish_event("NodeRegistered", {"node_id": node.id})
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        """(reference: node_endpoint.go:541 UpdateStatus)"""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return
+        old = node.status
+        self.state.update_node_status(node_id, status, time.time())
+        if status == NODE_STATUS_READY:
+            self._reset_heartbeat(node_id)
+            if old != NODE_STATUS_READY:
+                self.blocked_evals.unblock(node.computed_class)
+                self._create_node_evals(node_id)
+        elif status in (NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED):
+            with self._hb_lock:
+                self._heartbeat_deadlines.pop(node_id, None)
+            self._create_node_evals(node_id)
+        self.publish_event("NodeStatusUpdate",
+                           {"node_id": node_id, "status": status})
+
+    def heartbeat(self, node_id: str) -> float:
+        """Client TTL refresh (reference: heartbeat.go:93). Returns TTL."""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return 0.0
+        if node.status in (NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED):
+            # heartbeat from a down node: it must re-register its status
+            self.update_node_status(node_id, NODE_STATUS_READY)
+        self._reset_heartbeat(node_id)
+        return self.heartbeat_ttl
+
+    def _reset_heartbeat(self, node_id: str) -> None:
+        with self._hb_lock:
+            self._heartbeat_deadlines[node_id] = (
+                time.time() + self.heartbeat_ttl)
+
+    def _create_node_evals(self, node_id: str) -> None:
+        """Evals for every job with allocs on the node + system jobs
+        (reference: node_endpoint.go createNodeEvals)."""
+        allocs = self.state.allocs_by_node(node_id)
+        jobs = {}
+        for a in allocs:
+            if not a.terminal_status():
+                jobs[(a.namespace, a.job_id)] = a.job
+        evals = []
+        for (ns, job_id), job in jobs.items():
+            stored = self.state.job_by_id(ns, job_id)
+            if stored is None:
+                continue
+            evals.append(Evaluation(
+                id=generate_uuid(), namespace=ns,
+                priority=stored.priority, type=stored.type,
+                triggered_by=TRIGGER_NODE_UPDATE, job_id=job_id,
+                node_id=node_id, status=EVAL_STATUS_PENDING))
+        # system jobs must consider new/changed nodes
+        for job in self.state.jobs():
+            if job.type in (JOB_TYPE_SYSTEM, "sysbatch") and not job.stop:
+                evals.append(Evaluation(
+                    id=generate_uuid(), namespace=job.namespace,
+                    priority=job.priority, type=job.type,
+                    triggered_by=TRIGGER_NODE_UPDATE, job_id=job.id,
+                    node_id=node_id, status=EVAL_STATUS_PENDING))
+        if evals:
+            self.state.upsert_evals(evals)
+            self.broker.enqueue_all(evals)
+
+    def drain_node(self, node_id: str, strategy) -> None:
+        """Start/stop a drain: mark the node ineligible, request migration
+        of its allocs, and evaluate affected jobs (reference:
+        nomad/drainer/ NodeDrainer + watch_nodes.go, condensed: the
+        deadline/batched-update machinery collapses because desired
+        transitions commit through the same state API)."""
+        self.state.update_node_drain(node_id, strategy,
+                                     mark_eligible=strategy is None)
+        if strategy is None:
+            return
+        alloc_ids = [a.id for a in self.state.allocs_by_node(node_id)
+                     if not a.terminal_status()
+                     and (a.job is None or not strategy.ignore_system_jobs
+                          or a.job.type not in (JOB_TYPE_SYSTEM, "sysbatch"))]
+        if alloc_ids:
+            self.state.update_alloc_desired_transition(alloc_ids,
+                                                       migrate=True)
+        self._create_node_evals(node_id)
+        self.publish_event("NodeDrain", {"node_id": node_id})
+
+    def update_allocs_from_client(self, allocs: List[Allocation]) -> None:
+        """(reference: node_endpoint.go:1322 UpdateAlloc)"""
+        self.state.update_allocs_from_client(allocs)
+        # allocs going terminal can complete the job
+        for key in {(a.namespace, a.job_id) for a in allocs}:
+            self._refresh_job_status(*key)
+        # failed allocs trigger reschedule evals
+        evals = []
+        seen = set()
+        for a in allocs:
+            if a.client_status == ALLOC_CLIENT_FAILED:
+                stored = self.state.alloc_by_id(a.id)
+                if stored is None or (stored.namespace, stored.job_id) in seen:
+                    continue
+                job = self.state.job_by_id(stored.namespace, stored.job_id)
+                if job is None or job.stop:
+                    continue
+                seen.add((stored.namespace, stored.job_id))
+                evals.append(Evaluation(
+                    id=generate_uuid(), namespace=stored.namespace,
+                    priority=job.priority, type=job.type,
+                    triggered_by="alloc-failure", job_id=job.id,
+                    status=EVAL_STATUS_PENDING))
+        if evals:
+            self.state.upsert_evals(evals)
+            self.broker.enqueue_all(evals)
+
+    # ------------------------------------------------------------------
+    # Worker callbacks
+    def on_plan_result(self, plan: Plan, result: PlanResult) -> None:
+        # Freed capacity (stops/preemptions) unblocks class-keyed evals
+        # (reference: FSM hooks into BlockedEvals on alloc updates)
+        freed_classes = set()
+        for node_id in list(result.node_update) + list(result.node_preemptions):
+            node = self.state.node_by_id(node_id)
+            if node is not None:
+                freed_classes.add(node.computed_class)
+        for cls in freed_classes:
+            self.blocked_evals.unblock(cls)
+        if not result.is_no_op():
+            self.publish_event("PlanApplied", {
+                "eval_id": plan.eval_id,
+                "placed": sum(len(v) for v in result.node_allocation.values()),
+                "stopped": sum(len(v) for v in result.node_update.values()),
+            })
+
+    def on_eval_update(self, ev: Evaluation) -> None:
+        if ev.status == EVAL_STATUS_COMPLETE:
+            self._refresh_job_status(ev.namespace, ev.job_id)
+        self.publish_event("EvalUpdated",
+                           {"eval_id": ev.id, "status": ev.status})
+
+    def _refresh_job_status(self, namespace: str, job_id: str) -> None:
+        """(reference: fsm job summary / setJobStatus)"""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            return
+        allocs = self.state.allocs_by_job(namespace, job_id)
+        status = job.status
+        if any(not a.terminal_status() for a in allocs):
+            status = JOB_STATUS_RUNNING
+        elif allocs and all(a.terminal_status() for a in allocs):
+            # Everything ran and finished (or the job was stopped): dead --
+            # unless an eval is still in flight to place more work
+            # (reference: fsm setJobStatus dead conditions).
+            pending = any(not e.terminal_status() for e in
+                          self.state.evals_by_job(namespace, job_id))
+            if job.stop or not pending:
+                status = JOB_STATUS_DEAD
+        if status != job.status:
+            self.state.update_job_status(namespace, job_id, status)
+
+    # ------------------------------------------------------------------
+    # Event stream (reference: nomad/stream/event_broker.go)
+    def publish_event(self, topic: str, payload: dict) -> None:
+        with self._events_lock:
+            self._events.append({
+                "topic": topic, "index": self.state.latest_index(),
+                "time": time.time(), "payload": payload})
+            if len(self._events) > 4096:     # ring buffer semantics
+                self._events = self._events[-2048:]
+
+    def events_since(self, index: int) -> List[dict]:
+        with self._events_lock:
+            return [e for e in self._events if e["index"] > index]
+
+    # ------------------------------------------------------------------
+    # Background loops
+    def _run_heartbeat_watcher(self) -> None:
+        """Server-side TTL timers (reference: heartbeat.go invalidateHeartbeat
+        :138): a missed TTL marks the node down/disconnected and creates
+        evals for its workloads."""
+        while not self._shutdown.wait(0.2):
+            now = time.time()
+            expired = []
+            with self._hb_lock:
+                for node_id, dl in list(self._heartbeat_deadlines.items()):
+                    if dl <= now:
+                        expired.append(node_id)
+                        del self._heartbeat_deadlines[node_id]
+            for node_id in expired:
+                node = self.state.node_by_id(node_id)
+                if node is None:
+                    continue
+                # disconnected when any alloc has disconnect grace
+                # (reference: heartbeat.go:180 disconnectState)
+                grace = False
+                for a in self.state.allocs_by_node(node_id):
+                    if a.terminal_status() or a.job is None:
+                        continue
+                    tg = a.job.lookup_task_group(a.task_group)
+                    if tg is not None and tg.max_client_disconnect_s:
+                        grace = True
+                        break
+                status = (NODE_STATUS_DISCONNECTED if grace
+                          else NODE_STATUS_DOWN)
+                self.update_node_status(node_id, status)
+
+    def _run_gc(self) -> None:
+        """Core GC job (reference: core_sched.go evalGC :236, nodeGC :423)."""
+        while not self._shutdown.wait(GC_INTERVAL):
+            self.run_gc_once()
+
+    def run_gc_once(self, threshold: float = GC_EVAL_THRESHOLD) -> dict:
+        cutoff = time.time() - threshold
+        gone_evals = []
+        for ev in self.state.evals():
+            if not ev.terminal_status():
+                continue
+            allocs = self.state.allocs_by_eval(ev.id)
+            if all(a.terminal_status() for a in allocs) and \
+                    ev.modify_time < cutoff:
+                gone_evals.append(ev.id)
+        if gone_evals:
+            self.state.delete_evals(gone_evals)
+        gone_set = set(gone_evals)
+        gone_allocs = [
+            a.id for a in self.state.allocs()
+            if a.terminal_status() and a.modify_time < cutoff
+            and (a.eval_id in gone_set or not a.eval_id
+                 or self.state.eval_by_id(a.eval_id) is None)]
+        if gone_allocs:
+            self.state.delete_allocs(gone_allocs)
+        # dead jobs with no allocs/evals
+        gone_jobs = 0
+        for job in self.state.jobs():
+            if job.status == JOB_STATUS_DEAD and not job.is_periodic():
+                if not self.state.allocs_by_job(job.namespace, job.id) and \
+                        not self.state.evals_by_job(job.namespace, job.id):
+                    self.state.delete_job(job.namespace, job.id)
+                    gone_jobs += 1
+        return {"evals": len(gone_evals), "allocs": len(gone_allocs),
+                "jobs": gone_jobs}
+
+    def _run_periodic(self) -> None:
+        """Cron-style launcher (reference: periodic.go:25). Supports
+        '@every <N>s' specs; full cron parsing is a later round."""
+        while not self._shutdown.wait(0.5):
+            now = time.time()
+            for job in self.state.jobs():
+                if not job.is_periodic() or job.stop:
+                    continue
+                p = job.periodic
+                if not p.enabled or not p.spec.startswith("@every "):
+                    continue
+                try:
+                    interval = float(p.spec[len("@every "):].rstrip("s"))
+                except ValueError:
+                    continue
+                key = (job.namespace, job.id)
+                last = self._periodic_last.get(key, 0.0)
+                if now - last < interval:
+                    continue
+                if p.prohibit_overlap:
+                    children = [j for j in self.state.jobs()
+                                if j.parent_id == job.id
+                                and j.status != JOB_STATUS_DEAD]
+                    if children:
+                        continue
+                self._periodic_last[key] = now
+                self._dispatch_periodic(job, now)
+
+    def _dispatch_periodic(self, job: Job, now: float) -> None:
+        """(reference: periodic.go:51 DispatchJob -> derived child job)"""
+        import copy
+        child = copy.deepcopy(job)
+        child.id = f"{job.id}/periodic-{int(now)}"
+        child.parent_id = job.id
+        child.periodic = None
+        self.register_job(child)
+
+    def _run_deployment_watcher(self) -> None:
+        """Drives rolling updates: watches alloc health within active
+        deployments, advances/fails/completes them, and emits evals so the
+        reconciler's max_parallel gate releases the next batch
+        (reference: nomad/deploymentwatcher/deployments_watcher.go)."""
+        while not self._shutdown.wait(0.3):
+            for d in self.state.deployments():
+                if not d.active() or d.status != DEPLOYMENT_STATUS_RUNNING:
+                    continue
+                self._watch_deployment(d)
+
+    def _watch_deployment(self, d: Deployment) -> None:
+        import copy
+        allocs = [a for a in self.state.allocs()
+                  if a.deployment_id == d.id]
+        changed = False
+        nd = copy.deepcopy(d)
+        failed_tg = None
+        for tg_name, st in nd.task_groups.items():
+            tg_allocs = [a for a in allocs if a.task_group == tg_name]
+            placed = len(tg_allocs)
+            healthy = sum(1 for a in tg_allocs
+                          if a.deployment_status is not None
+                          and a.deployment_status.is_healthy())
+            unhealthy = sum(1 for a in tg_allocs
+                            if a.deployment_status is not None
+                            and a.deployment_status.is_unhealthy())
+            if (placed, healthy, unhealthy) != (
+                    st.placed_allocs, st.healthy_allocs, st.unhealthy_allocs):
+                st.placed_allocs = placed
+                st.healthy_allocs = healthy
+                st.unhealthy_allocs = unhealthy
+                changed = True
+            if unhealthy > 0:
+                failed_tg = tg_name
+        if failed_tg is not None:
+            # Unhealthy allocs fail the deployment regardless of
+            # auto_revert; auto_revert only controls the rollback
+            # (reference: deploymentwatcher FailDeployment).
+            nd.status = DEPLOYMENT_STATUS_FAILED
+            nd.status_description = (
+                f"Failed due to unhealthy allocations in {failed_tg}")
+            if self.state.upsert_deployment_cas(nd, d.modify_index):
+                if nd.task_groups[failed_tg].auto_revert:
+                    self._revert_job(nd)
+            return
+        job = self.state.job_by_id(nd.namespace, nd.job_id)
+        complete = bool(nd.task_groups) and all(
+            st.healthy_allocs >= st.desired_total
+            for st in nd.task_groups.values())
+        if complete and not nd.requires_promotion():
+            nd.status = DEPLOYMENT_STATUS_SUCCESSFUL
+            nd.status_description = "Deployment completed successfully"
+            changed = True
+        if changed:
+            # CAS guards against a concurrent plan commit having advanced
+            # the deployment while we computed counts (lost-update race);
+            # on conflict just retry next tick.
+            if not self.state.upsert_deployment_cas(nd, d.modify_index):
+                return
+            # progress -> let the reconciler release the next batch
+            if job is not None and not job.stop and \
+                    nd.status == DEPLOYMENT_STATUS_RUNNING:
+                ev = Evaluation(
+                    id=generate_uuid(), namespace=nd.namespace,
+                    priority=nd.eval_priority, type=job.type,
+                    triggered_by=TRIGGER_DEPLOYMENT_WATCHER,
+                    job_id=nd.job_id, deployment_id=nd.id,
+                    status=EVAL_STATUS_PENDING)
+                self.state.upsert_evals([ev])
+                self.broker.enqueue(ev)
+
+    def _revert_job(self, d: Deployment) -> None:
+        """Auto-revert to the last stable version
+        (reference: deploymentwatcher FailDeployment + job revert)."""
+        job = self.state.job_by_id(d.namespace, d.job_id)
+        if job is None:
+            return
+        for v in range(job.version - 1, -1, -1):
+            prev = self.state.job_version(d.namespace, d.job_id, v)
+            if prev is not None and prev.stable:
+                import copy
+                revert = copy.deepcopy(prev)
+                self.register_job(revert)
+                return

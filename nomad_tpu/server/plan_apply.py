@@ -1,0 +1,171 @@
+"""Plan queue + applier: the serialization point of optimistic concurrency.
+
+Semantic parity with /root/reference/nomad/plan_apply.go (planApply :96,
+evaluatePlan :468, evaluatePlanPlacements :507, evaluateNodePlan :717 --
+the authoritative AllocsFit re-check), plan_queue.go (priority queue) and
+plan_apply_node_tracker.go (BadNodeTracker). Scheduler workers race against
+snapshots; every plan is re-verified here against the LATEST state before
+commit, and partial commits hand back a refresh index so the scheduler
+retries against fresher state (generic_sched.go:330-356 contract).
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from ..state import StateStore
+from ..structs import (
+    Allocation, Evaluation, Plan, PlanResult, allocs_fit,
+    NODE_STATUS_DISCONNECTED, NODE_STATUS_DOWN, NODE_STATUS_READY,
+)
+
+
+class BadNodeTracker:
+    """Tracks nodes that repeatedly reject plans (reference:
+    plan_apply_node_tracker.go). Exceeding the threshold emits telemetry;
+    the reference also uses it to deprioritize, we expose the score."""
+
+    def __init__(self, threshold: int = 100, window: float = 300.0):
+        self.threshold = threshold
+        self.window = window
+        self._hits: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, node_id: str) -> bool:
+        """Record a rejection; True if the node is now 'bad'."""
+        now = time.time()
+        with self._lock:
+            hits = self._hits.setdefault(node_id, [])
+            hits.append(now)
+            cutoff = now - self.window
+            while hits and hits[0] < cutoff:
+                hits.pop(0)
+            return len(hits) >= self.threshold
+
+    def score(self, node_id: str) -> int:
+        with self._lock:
+            return len(self._hits.get(node_id, ()))
+
+
+class Planner:
+    """The leader's plan applier (reference: plan_apply.go:24 planner).
+
+    apply() is called by workers (via the plan queue's serialization lock);
+    verification fans out per node across a pool sized NumCPU/2 like the
+    reference's EvaluatePool (plan_apply.go:113-118).
+    """
+
+    def __init__(self, state: StateStore, pool_size: Optional[int] = None):
+        import os
+        self.state = state
+        self.bad_nodes = BadNodeTracker()
+        self._serial = threading.Lock()   # the single serialized queue
+        pool_size = pool_size or max(1, (os.cpu_count() or 2) // 2)
+        self._pool = ThreadPoolExecutor(max_workers=pool_size,
+                                        thread_name_prefix="plan-verify")
+        self.plans_applied = 0
+        self.plans_rejected = 0
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    def apply(self, plan: Plan,
+              eval_updates: Optional[List[Evaluation]] = None
+              ) -> PlanResult:
+        """Verify against latest state, commit what fits
+        (reference: planApply plan_apply.go:96 + evaluatePlan :468)."""
+        with self._serial:
+            snapshot = self.state.snapshot()
+            result = self._evaluate_plan(snapshot, plan)
+            if result.is_no_op() and not plan.is_no_op():
+                # everything was rejected; hand back a refresh index
+                result.refresh_index = self.state.latest_index()
+                self.plans_rejected += 1
+                return result
+            index = self.state.upsert_plan_results(result, eval_updates)
+            result.alloc_index = index
+            if result.rejected_nodes:
+                result.refresh_index = index
+            self.plans_applied += 1
+            return result
+
+    # ------------------------------------------------------------------
+    def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
+        """Per-node re-verification (reference: evaluatePlanPlacements :507).
+        Nodes whose placements no longer fit are trimmed from the result
+        (partial commit) unless plan.all_at_once."""
+        result = PlanResult(
+            node_update={k: list(v) for k, v in plan.node_update.items()},
+            node_allocation={},
+            node_preemptions={k: list(v)
+                              for k, v in plan.node_preemptions.items()},
+            deployment=plan.deployment,
+            deployment_updates=list(plan.deployment_updates),
+        )
+
+        node_ids = list(plan.node_allocation.keys())
+
+        def check(node_id: str) -> Tuple[str, bool, str]:
+            ok, reason = self._evaluate_node_plan(snapshot, plan, node_id)
+            return node_id, ok, reason
+
+        checks = list(self._pool.map(check, node_ids)) if node_ids else []
+
+        rejected: List[str] = []
+        for node_id, ok, reason in checks:
+            if ok:
+                result.node_allocation[node_id] = list(
+                    plan.node_allocation[node_id])
+            else:
+                rejected.append(node_id)
+                self.bad_nodes.add(node_id)
+
+        if rejected and plan.all_at_once:
+            # all-or-nothing (reference: evaluatePlan AllAtOnce handling)
+            result.node_allocation = {}
+            result.deployment = None
+            result.deployment_updates = []
+        result.rejected_nodes = rejected
+        return result
+
+    def _evaluate_node_plan(self, snapshot, plan: Plan,
+                            node_id: str) -> Tuple[bool, str]:
+        """(reference: evaluateNodePlan plan_apply.go:717)"""
+        new_allocs = plan.node_allocation.get(node_id, [])
+        node = snapshot.node_by_id(node_id)
+        if node is None:
+            return not new_allocs, "node does not exist"
+        if new_allocs:
+            if node.status == NODE_STATUS_DOWN:
+                return False, "node is down"
+            if node.status == NODE_STATUS_DISCONNECTED:
+                # only reconnect updates allowed (reference: :745)
+                for a in new_allocs:
+                    if a.client_status not in ("unknown", "running"):
+                        return False, "node is disconnected"
+            elif node.status != NODE_STATUS_READY:
+                return False, f"node is {node.status}"
+
+        existing = snapshot.allocs_by_node(node_id)
+        removed = set()
+        for a in plan.node_update.get(node_id, ()):
+            removed.add(a.id)
+        for a in plan.node_preemptions.get(node_id, ()):
+            removed.add(a.id)
+        proposed: Dict[str, Allocation] = {}
+        for a in existing:
+            if a.id in removed or a.terminal_status():
+                continue
+            proposed[a.id] = a
+        for a in new_allocs:
+            proposed[a.id] = a
+
+        fit, dim, _ = allocs_fit(node, list(proposed.values()),
+                                 check_devices=True)
+        if not fit:
+            return False, dim
+        return True, ""

@@ -1,0 +1,94 @@
+"""Scheduler workers: dequeue evals, invoke the scheduler, submit plans.
+
+Semantic parity with /root/reference/nomad/worker.go (Worker.run :397,
+dequeueEvaluation :476, invokeScheduler :610, and the Planner impl
+SubmitPlan :650 / UpdateEval :721 / CreateEval :760 / ReblockEval :802).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..scheduler.factory import new_scheduler
+from ..structs import (
+    Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
+)
+
+ALL_SCHEDULERS = ["service", "batch", "system", "sysbatch", "_core"]
+
+
+class WorkerPlanner:
+    """Planner interface handed to schedulers; routes through the leader's
+    plan applier and raft-equivalent state writes."""
+
+    def __init__(self, server, eval_token: str):
+        self.server = server
+        self.eval_token = eval_token
+
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], object]:
+        result = self.server.planner.apply(plan)
+        new_state = None
+        if result.rejected_nodes or (result.is_no_op() and not plan.is_no_op()):
+            # partial/failed commit: scheduler refreshes its snapshot
+            new_state = self.server.state.snapshot()
+        self.server.on_plan_result(plan, result)
+        return result, new_state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.state.upsert_evals([ev])
+        self.server.on_eval_update(ev)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.server.state.upsert_evals([ev])
+        if ev.status == EVAL_STATUS_BLOCKED:
+            self.server.blocked_evals.block(ev)
+        elif ev.should_enqueue():
+            self.server.broker.enqueue(ev)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.blocked_evals.block(ev)
+
+
+class Worker(threading.Thread):
+    """(reference: worker.go:397 Worker.run)"""
+
+    def __init__(self, server, worker_id: int,
+                 schedulers: Optional[List[str]] = None):
+        super().__init__(daemon=True, name=f"scheduler-worker-{worker_id}")
+        self.server = server
+        self.worker_id = worker_id
+        self.schedulers = schedulers or ["service", "batch", "system",
+                                         "sysbatch"]
+        self._stop = threading.Event()
+        self.evals_processed = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            ev, token = self.server.broker.dequeue(
+                self.schedulers, timeout=0.5)
+            if ev is None:
+                continue
+            try:
+                self._invoke_scheduler(ev, token)
+                err = self.server.broker.ack(ev.id, token)
+            except Exception:
+                self.server.broker.nack(ev.id, token)
+                if self.server.logger:
+                    import traceback
+                    traceback.print_exc()
+            self.evals_processed += 1
+
+    def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
+        """(reference: worker.go:610 invokeScheduler). The snapshot must be
+        at least as fresh as the eval's creation (snapshotMinIndex :591)."""
+        self.server.state.block_until(ev.modify_index - 1, timeout=2.0)
+        snapshot = self.server.state.snapshot()
+        planner = WorkerPlanner(self.server, token)
+        sched = new_scheduler(ev.type if ev.type in
+                              ("service", "batch", "system", "sysbatch")
+                              else "service",
+                              snapshot, planner)
+        sched.process(ev)

@@ -268,6 +268,23 @@ class StateStore:
             self._job_versions[(job.namespace, job.id, job.version)] = job
             return self._bump("jobs", "job_versions")
 
+    def update_job_status(self, namespace: str, job_id: str,
+                          status: str) -> int:
+        """Status-only update: no new job version (reference: the FSM's
+        setJobStatus path, distinct from Job.Register's version bump)."""
+        with self._lock:
+            key = (namespace, job_id)
+            existing = self._jobs.get(key)
+            if existing is None:
+                return self._index
+            import copy as _copy
+            job = _copy.copy(existing)
+            job.status = status
+            job.modify_index = self._index + 1
+            self._jobs[key] = job
+            self._job_versions[(namespace, job_id, job.version)] = job
+            return self._bump("jobs")
+
     def delete_job(self, namespace: str, job_id: str) -> int:
         with self._lock:
             self._jobs.pop((namespace, job_id), None)
@@ -283,14 +300,19 @@ class StateStore:
 
     # -- evals ---------------------------------------------------------------
     def upsert_evals(self, evals: List[Evaluation]) -> int:
+        import time as _time
+        now = _time.time()
         with self._lock:
             for ev in evals:
                 existing = self._evals.get(ev.id)
                 if existing is not None:
                     ev.create_index = existing.create_index
+                    ev.create_time = existing.create_time
                 else:
                     ev.create_index = self._index + 1
+                    ev.create_time = now
                 ev.modify_index = self._index + 1
+                ev.modify_time = now
                 self._evals[ev.id] = ev
                 self._update_job_summary_status(ev)
             return self._bump("evals")
@@ -312,13 +334,18 @@ class StateStore:
             return self._bump("allocs")
 
     def _insert_allocs_locked(self, allocs: List[Allocation]) -> None:
+        import time as _time
+        now = _time.time()
         for alloc in allocs:
             existing = self._allocs.get(alloc.id)
             if existing is not None:
                 alloc.create_index = existing.create_index
+                alloc.create_time = existing.create_time
             else:
                 alloc.create_index = self._index + 1
+                alloc.create_time = now
             alloc.modify_index = self._index + 1
+            alloc.modify_time = now
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job
             self._allocs[alloc.id] = alloc
@@ -346,8 +373,29 @@ class StateStore:
                 alloc.network_status = updated.network_status
                 if updated.deployment_status is not None:
                     alloc.deployment_status = updated.deployment_status
+                if updated.client_terminal_time:
+                    alloc.client_terminal_time = updated.client_terminal_time
                 alloc.modify_index = self._index + 1
+                import time as _time
+                alloc.modify_time = _time.time()
                 self._allocs[alloc.id] = alloc
+            return self._bump("allocs")
+
+    def update_alloc_desired_transition(self, alloc_ids: List[str],
+                                        migrate: bool = True) -> int:
+        """(reference: state AllocUpdateDesiredTransition, used by the
+        drainer to request migrations)."""
+        with self._lock:
+            import copy as _copy
+            from ..structs import DesiredTransition
+            for aid in alloc_ids:
+                existing = self._allocs.get(aid)
+                if existing is None:
+                    continue
+                alloc = _copy.copy(existing)
+                alloc.desired_transition = DesiredTransition(migrate=migrate)
+                alloc.modify_index = self._index + 1
+                self._allocs[aid] = alloc
             return self._bump("allocs")
 
     def delete_allocs(self, alloc_ids: List[str]) -> int:
@@ -366,14 +414,30 @@ class StateStore:
     # -- deployments ---------------------------------------------------------
     def upsert_deployment(self, deployment: Deployment) -> int:
         with self._lock:
+            self._upsert_deployment_locked(deployment)
+            return self._index
+
+    def upsert_deployment_cas(self, deployment: Deployment,
+                              expected_modify_index: int) -> bool:
+        """Compare-and-swap: commit only if the stored deployment's
+        modify_index still matches (lost-update guard for the watcher)."""
+        with self._lock:
             existing = self._deployments.get(deployment.id)
-            if existing is not None:
-                deployment.create_index = existing.create_index
-            else:
-                deployment.create_index = self._index + 1
-            deployment.modify_index = self._index + 1
-            self._deployments[deployment.id] = deployment
-            return self._bump("deployments")
+            if existing is not None and \
+                    existing.modify_index != expected_modify_index:
+                return False
+            self._upsert_deployment_locked(deployment)
+            return True
+
+    def _upsert_deployment_locked(self, deployment: Deployment) -> None:
+        existing = self._deployments.get(deployment.id)
+        if existing is not None:
+            deployment.create_index = existing.create_index
+        else:
+            deployment.create_index = self._index + 1
+        deployment.modify_index = self._index + 1
+        self._deployments[deployment.id] = deployment
+        self._bump("deployments")
 
     def delete_deployment(self, deployment_id: str) -> int:
         with self._lock:
@@ -428,6 +492,8 @@ class StateStore:
                 if stop.followup_eval_id:
                     alloc.followup_eval_id = stop.followup_eval_id
                 alloc.modify_index = self._index + 1
+                import time as _time
+                alloc.modify_time = _time.time()
                 self._allocs[alloc.id] = alloc
 
             self._insert_allocs_locked(placements)

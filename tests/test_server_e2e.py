@@ -1,0 +1,263 @@
+"""End-to-end dev-agent tests: server + workers + simulated clients
+(reference analog: nomad/testing.go TestServer in-process integration)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import SimClient
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_LOST,
+    EVAL_STATUS_COMPLETE, JOB_STATUS_DEAD, NODE_STATUS_DOWN,
+)
+
+
+def wait_until(cond, timeout=10.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def cluster():
+    server = Server(num_workers=2, heartbeat_ttl=1.0)
+    server.start()
+    clients = []
+    for _ in range(3):
+        c = SimClient(server, mock.node())
+        c.start()
+        clients.append(c)
+    wait_until(lambda: len(server.state.nodes()) == 3, msg="nodes registered")
+    yield server, clients
+    for c in clients:
+        c.stop()
+    server.shutdown()
+
+
+def running_allocs(server, job):
+    return [a for a in server.state.allocs_by_job(job.namespace, job.id)
+            if a.client_status == ALLOC_CLIENT_RUNNING
+            and a.desired_status == "run"]
+
+
+def test_service_job_end_to_end(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].config = {}   # run forever
+    server.register_job(job)
+
+    wait_until(lambda: len(running_allocs(server, job)) == 4,
+               msg="4 allocs running")
+    # eval completed
+    evals = server.state.evals_by_job(job.namespace, job.id)
+    assert any(e.status == EVAL_STATUS_COMPLETE for e in evals)
+    # deployment progressed to successful
+    wait_until(lambda: (server.state.latest_deployment_by_job(
+        job.namespace, job.id) or object()) and
+        getattr(server.state.latest_deployment_by_job(job.namespace, job.id),
+                "status", "") == "successful",
+        msg="deployment successful")
+
+
+def test_batch_job_runs_to_completion(cluster):
+    server, clients = cluster
+    job = mock.batch_job(count=3)
+    job.task_groups[0].tasks[0].config = {"run_for": "0.3s"}
+    server.register_job(job)
+    wait_until(lambda: len([
+        a for a in server.state.allocs_by_job(job.namespace, job.id)
+        if a.client_status == ALLOC_CLIENT_COMPLETE]) == 3,
+        msg="batch allocs complete")
+    # completed batch allocs are NOT replaced
+    time.sleep(0.5)
+    allocs = server.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 3
+
+
+def test_node_failure_recovery(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 3
+    job.task_groups[0].tasks[0].config = {}
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 3,
+               msg="3 allocs running")
+
+    # find a client hosting at least one alloc and freeze it
+    used_nodes = {a.node_id for a in running_allocs(server, job)}
+    victim = next(c for c in clients if c.node.id in used_nodes)
+    n_on_victim = len([a for a in running_allocs(server, job)
+                       if a.node_id == victim.node.id])
+    victim.freeze()
+
+    # server marks the node down after TTL, reschedules elsewhere
+    wait_until(lambda: (server.state.node_by_id(victim.node.id) or
+                        object()).status == NODE_STATUS_DOWN,
+               timeout=5.0, msg="node down")
+    wait_until(
+        lambda: len([a for a in running_allocs(server, job)
+                     if a.node_id != victim.node.id]) == 3,
+        timeout=10.0, msg="allocs replaced off the dead node")
+    # lost allocs marked lost
+    lost = [a for a in server.state.allocs_by_job(job.namespace, job.id)
+            if a.client_status == ALLOC_CLIENT_LOST]
+    assert len(lost) >= n_on_victim
+    victim.thaw()
+
+
+def test_job_stop_kills_allocs(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {}
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 2,
+               msg="2 running")
+    server.deregister_job(job.namespace, job.id)
+    wait_until(lambda: all(
+        a.terminal_status()
+        for a in server.state.allocs_by_job(job.namespace, job.id)),
+        msg="all allocs stopped")
+    wait_until(lambda: (server.state.job_by_id(job.namespace, job.id)
+                        or object()).status == JOB_STATUS_DEAD
+               if server.state.job_by_id(job.namespace, job.id) else True,
+               msg="job dead")
+
+
+def test_failed_alloc_rescheduled(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 1
+    # fails quickly; reschedule policy: constant 0 delay for fast test
+    job.task_groups[0].tasks[0].config = {"run_for": "0.2s", "exit_code": 1}
+    job.task_groups[0].reschedule_policy.delay_s = 0.0
+    job.task_groups[0].reschedule_policy.delay_function = "constant"
+    job.task_groups[0].reschedule_policy.attempts = 1
+    job.task_groups[0].reschedule_policy.interval_s = 300.0
+    job.task_groups[0].reschedule_policy.unlimited = False
+    server.register_job(job)
+    # wait for: place -> run -> fail -> reschedule eval -> replacement
+    wait_until(lambda: len(
+        server.state.allocs_by_job(job.namespace, job.id)) >= 2,
+        timeout=10.0, msg="replacement placed after failure")
+    allocs = server.state.allocs_by_job(job.namespace, job.id)
+    replacement = [a for a in allocs if a.previous_allocation]
+    assert replacement
+    assert replacement[0].reschedule_tracker is not None
+
+
+def test_blocked_eval_unblocks_on_new_node(cluster):
+    server, clients = cluster
+    # job too big for current fleet: each node has 4000MHz, ask 3500 x4
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.cpu = 3500
+    job.task_groups[0].tasks[0].config = {}
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 3,
+               msg="3 of 4 placed")
+    assert server.blocked_evals.stats()["total_blocked"] >= 1
+
+    # new capacity arrives -> blocked eval unblocks -> 4th placed
+    extra = SimClient(server, mock.node())
+    extra.start()
+    try:
+        wait_until(lambda: len(running_allocs(server, job)) == 4,
+                   timeout=10.0, msg="4th alloc placed on new node")
+    finally:
+        extra.stop()
+
+
+def test_periodic_job_dispatches_children(cluster):
+    server, clients = cluster
+    from nomad_tpu.structs import PeriodicConfig
+    job = mock.batch_job(count=1)
+    job.task_groups[0].tasks[0].config = {"run_for": "0.1s"}
+    job.periodic = PeriodicConfig(enabled=True, spec="@every 0.5s")
+    server.register_job(job)
+    wait_until(lambda: len([
+        j for j in server.state.jobs() if j.parent_id == job.id]) >= 2,
+        timeout=10.0, msg="periodic children dispatched")
+
+
+def test_failed_deployment_auto_reverts(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {}
+    job.task_groups[0].update.auto_revert = True
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 2, msg="v0 up")
+    # mark v0 stable so revert has a target
+    stored = server.state.job_by_id(job.namespace, job.id)
+    stored.stable = True
+
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 2
+    job2.task_groups[0].update.auto_revert = True
+    job2.task_groups[0].tasks[0].config = {"run_for": "0.2s", "exit_code": 1}
+    server.register_job(job2)
+
+    # v1 allocs fail -> deployment failed -> auto-revert re-registers v0
+    wait_until(lambda: any(
+        d.status == "failed" and d.job_version == 1
+        for d in server.state.deployments()),
+        timeout=15.0, msg="deployment failed")
+    wait_until(lambda: (server.state.job_by_id(job.namespace, job.id)
+                        or job).version >= 2,
+               timeout=10.0, msg="job reverted to new version")
+    reverted = server.state.job_by_id(job.namespace, job.id)
+    assert reverted.task_groups[0].tasks[0].config == {}
+
+
+def test_gc_collects_terminal_state(cluster):
+    server, clients = cluster
+    job = mock.batch_job(count=2)
+    job.task_groups[0].tasks[0].config = {"run_for": "0.1s"}
+    server.register_job(job)
+    wait_until(lambda: len([
+        a for a in server.state.allocs_by_job(job.namespace, job.id)
+        if a.client_status == ALLOC_CLIENT_COMPLETE]) == 2,
+        msg="batch complete")
+    wait_until(lambda: (server.state.job_by_id(job.namespace, job.id)
+                        or job).status == JOB_STATUS_DEAD,
+               msg="job dead")
+    stats = server.run_gc_once(threshold=0.0)
+    assert stats["evals"] >= 1
+    assert stats["allocs"] >= 2
+    stats2 = server.run_gc_once(threshold=0.0)
+    assert server.state.job_by_id(job.namespace, job.id) is None
+
+
+def test_rolling_update_respects_max_parallel(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].config = {}
+    job.task_groups[0].update.max_parallel = 1
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 4,
+               msg="v0 running")
+
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 4
+    job2.task_groups[0].tasks[0].config = {"cmd": "v2"}
+    job2.task_groups[0].update.max_parallel = 1
+    server.register_job(job2)
+
+    # deployment watcher drives the rollout one alloc at a time until all
+    # 4 run the new version
+    wait_until(lambda: len([
+        a for a in running_allocs(server, job)
+        if a.job_version == 1]) == 4,
+        timeout=20.0, msg="rolling update finished")
+    d = server.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d is not None and d.job_version == 1
+    wait_until(lambda: server.state.latest_deployment_by_job(
+        job.namespace, job.id).status == "successful",
+        timeout=10.0, msg="deployment successful")
