@@ -1,0 +1,122 @@
+// Native tensorization kernels for nomad-tpu.
+//
+// The reference's native boundary is go-plugin subprocesses + libcontainer
+// (SURVEY.md section 2.4); this framework's equivalent performance-critical
+// native component is the host-side marshalling path of the TPU solver:
+// folding the live allocation table into dense node-axis usage tensors
+// (cpu/mem/disk sums, port bitmaps, dynamic-port counts) and batch plan
+// verification. Exposed as a C ABI consumed via ctypes
+// (nomad_tpu/native.py), with a pure-numpy fallback.
+//
+// Build: cmake -S native -B native/build && cmake --build native/build
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Fold the alloc table into node-axis usage tensors.
+//
+// rows: n_rows allocations, SoA layout:
+//   node_slot[i]  int32   -- node index, -1 = node unknown (skip)
+//   cpu[i]/mem[i]/disk[i] double
+//   live[i]       uint8   -- 1 unless client-terminal
+//   ports[i*max_ports..]  int32, -1 = empty slot
+// node inputs:
+//   dyn_lo/dyn_hi int32 per node (dynamic port range)
+// outputs (caller-zeroed, length n_pad):
+//   used_cpu/used_mem/used_disk double
+//   dyn_used int32
+//   port_words uint32 (n_pad * 2048) -- caller seeds agent-reserved ports
+void nt_pack_usage(const int32_t* node_slot, const double* cpu,
+                   const double* mem, const double* disk,
+                   const uint8_t* live, const int32_t* ports,
+                   int64_t n_rows, int32_t max_ports,
+                   const int32_t* dyn_lo, const int32_t* dyn_hi,
+                   double* used_cpu, double* used_mem, double* used_disk,
+                   int32_t* dyn_used, uint32_t* port_words,
+                   int64_t n_pad) {
+  const int64_t words_per_node = 2048;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    if (!live[i]) continue;
+    const int32_t slot = node_slot[i];
+    if (slot < 0 || slot >= n_pad) continue;
+    used_cpu[slot] += cpu[i];
+    used_mem[slot] += mem[i];
+    used_disk[slot] += disk[i];
+    if (port_words == nullptr) continue;  // no port state this eval
+    uint32_t* words = port_words + slot * words_per_node;
+    const int32_t lo = dyn_lo[slot], hi = dyn_hi[slot];
+    for (int32_t p = 0; p < max_ports; ++p) {
+      const int32_t port = ports[i * max_ports + p];
+      if (port < 0) break;
+      if (port >= 65536) continue;
+      const uint32_t bit = 1u << (port & 31);
+      uint32_t* w = &words[port >> 5];
+      if (!(*w & bit)) {
+        *w |= bit;
+        if (port >= lo && port <= hi) dyn_used[slot] += 1;
+      }
+    }
+  }
+}
+
+// Count allocations per node for a specific (job, tg) -- the anti-affinity
+// and distinct-hosts inputs. jobtg_hash rows match -> placed; job_hash
+// rows match -> placed_job.
+void nt_count_placed(const int32_t* node_slot, const uint64_t* job_hash,
+                     const uint64_t* jobtg_hash, const uint8_t* live,
+                     int64_t n_rows, uint64_t want_job, uint64_t want_jobtg,
+                     int32_t* placed, int32_t* placed_job, int64_t n_pad) {
+  for (int64_t i = 0; i < n_rows; ++i) {
+    if (!live[i]) continue;
+    const int32_t slot = node_slot[i];
+    if (slot < 0 || slot >= n_pad) continue;
+    if (job_hash[i] == want_job) {
+      placed_job[slot] += 1;
+      if (jobtg_hash[i] == want_jobtg) placed[slot] += 1;
+    }
+  }
+}
+
+// Check whether each of n_check static ports is free on each listed node.
+// out[k] = 1 if all ports free on node check_slots[k].
+void nt_static_ports_free(const uint32_t* port_words, int64_t n_pad,
+                          const int32_t* check_ports, int32_t n_ports,
+                          uint8_t* out) {
+  const int64_t words_per_node = 2048;
+  for (int64_t slot = 0; slot < n_pad; ++slot) {
+    const uint32_t* words = port_words + slot * words_per_node;
+    uint8_t free = 1;
+    for (int32_t p = 0; p < n_ports; ++p) {
+      const int32_t port = check_ports[p];
+      if (port < 0 || port >= 65536) continue;
+      if (words[port >> 5] & (1u << (port & 31))) {
+        free = 0;
+        break;
+      }
+    }
+    out[slot] = free;
+  }
+}
+
+// Batch plan verification: node-axis superset check
+// (reference: nomad/plan_apply.go:717 evaluateNodePlan -> AllocsFit).
+// For each node k: fits iff used + ask <= cap on every dimension.
+// Returns the failing dimension per node: 0 ok, 1 cpu, 2 memory, 3 disk.
+void nt_verify_fit(const double* cpu_cap, const double* mem_cap,
+                   const double* disk_cap, const double* used_cpu,
+                   const double* used_mem, const double* used_disk,
+                   const double* ask_cpu, const double* ask_mem,
+                   const double* ask_disk, int64_t n, int32_t* out_dim) {
+  for (int64_t k = 0; k < n; ++k) {
+    if (used_cpu[k] + ask_cpu[k] > cpu_cap[k]) out_dim[k] = 1;
+    else if (used_mem[k] + ask_mem[k] > mem_cap[k]) out_dim[k] = 2;
+    else if (used_disk[k] + ask_disk[k] > disk_cap[k]) out_dim[k] = 3;
+    else out_dim[k] = 0;
+  }
+}
+
+int32_t nt_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,214 @@
+"""ctypes bindings for the native tensorization kernels (native/
+pack_kernels.cc), with pure-numpy fallbacks when the library is absent.
+
+The native boundary mirrors where the reference keeps native code
+(SURVEY.md section 2.4): performance-critical runtime components, here the
+struct->tensor marshalling path of the TPU solver.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+PORT_WORDS = 2048
+MAX_PORTS_PER_ALLOC = 8
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _find_library() -> Optional[str]:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for cand in (
+            os.path.join(here, "native", "build", "libnomad_tpu_native.so"),
+            os.environ.get("NOMAD_TPU_NATIVE_LIB", "")):
+        if cand and os.path.exists(cand):
+            return cand
+    return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = _find_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        if lib.nt_abi_version() != 1:
+            return None
+        d = ctypes.POINTER(ctypes.c_double)
+        i32 = ctypes.POINTER(ctypes.c_int32)
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        u32 = ctypes.POINTER(ctypes.c_uint32)
+        u64 = ctypes.POINTER(ctypes.c_uint64)
+        lib.nt_pack_usage.argtypes = [
+            i32, d, d, d, u8, i32, ctypes.c_int64, ctypes.c_int32,
+            i32, i32, d, d, d, i32, u32, ctypes.c_int64]
+        lib.nt_count_placed.argtypes = [
+            i32, u64, u64, u8, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_uint64, i32, i32, ctypes.c_int64]
+        lib.nt_static_ports_free.argtypes = [
+            u32, ctypes.c_int64, i32, ctypes.c_int32, u8]
+        lib.nt_verify_fit.argtypes = [d, d, d, d, d, d, d, d, d,
+                                      ctypes.c_int64, i32]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def pack_usage(node_slot: np.ndarray, cpu: np.ndarray, mem: np.ndarray,
+               disk: np.ndarray, live: np.ndarray,
+               ports: Optional[np.ndarray],
+               dyn_lo: np.ndarray, dyn_hi: np.ndarray, n_pad: int,
+               port_words_seed: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, ...]:
+    """Fold the alloc table into node-axis usage tensors. All row arrays are
+    length n_rows; ports is (n_rows, MAX_PORTS_PER_ALLOC) int32 (-1 empty)
+    or None to skip port folding entirely.
+    Returns (used_cpu, used_mem, used_disk, dyn_used, port_words);
+    port_words is None when no port state exists."""
+    n_rows = len(node_slot)
+    used_cpu = np.zeros(n_pad, dtype=np.float64)
+    used_mem = np.zeros(n_pad, dtype=np.float64)
+    used_disk = np.zeros(n_pad, dtype=np.float64)
+    dyn_used = np.zeros(n_pad, dtype=np.int32)
+    # The bitmap is 80MB at 10K nodes; only materialize when port state
+    # exists (seed present or any row carries ports).
+    has_ports = (ports is not None and n_rows
+                 and bool((ports[:, 0] >= 0).any()))
+    if port_words_seed is None and not has_ports:
+        port_words = None
+    else:
+        port_words = (port_words_seed.copy() if port_words_seed is not None
+                      else np.zeros((n_pad, PORT_WORDS), dtype=np.uint32))
+    max_ports = MAX_PORTS_PER_ALLOC if ports is not None else 0
+    lib = load()
+    if lib is not None and n_rows:
+        node_slot = np.ascontiguousarray(node_slot, dtype=np.int32)
+        cpu = np.ascontiguousarray(cpu, dtype=np.float64)
+        mem = np.ascontiguousarray(mem, dtype=np.float64)
+        disk = np.ascontiguousarray(disk, dtype=np.float64)
+        live = np.ascontiguousarray(live, dtype=np.uint8)
+        if ports is not None:
+            ports = np.ascontiguousarray(ports, dtype=np.int32)
+        dyn_lo = np.ascontiguousarray(dyn_lo, dtype=np.int32)
+        dyn_hi = np.ascontiguousarray(dyn_hi, dtype=np.int32)
+        lib.nt_pack_usage(
+            _ptr(node_slot, ctypes.c_int32), _ptr(cpu, ctypes.c_double),
+            _ptr(mem, ctypes.c_double), _ptr(disk, ctypes.c_double),
+            _ptr(live, ctypes.c_uint8),
+            (_ptr(ports, ctypes.c_int32) if ports is not None else None),
+            n_rows, max_ports,
+            _ptr(dyn_lo, ctypes.c_int32), _ptr(dyn_hi, ctypes.c_int32),
+            _ptr(used_cpu, ctypes.c_double), _ptr(used_mem, ctypes.c_double),
+            _ptr(used_disk, ctypes.c_double), _ptr(dyn_used, ctypes.c_int32),
+            (_ptr(port_words, ctypes.c_uint32)
+             if port_words is not None else None), n_pad)
+        return used_cpu, used_mem, used_disk, dyn_used, port_words
+
+    # numpy fallback
+    mask = (live != 0) & (node_slot >= 0) & (node_slot < n_pad)
+    slots = node_slot[mask]
+    np.add.at(used_cpu, slots, cpu[mask])
+    np.add.at(used_mem, slots, mem[mask])
+    np.add.at(used_disk, slots, disk[mask])
+    if port_words is not None and ports is not None:
+        for i in np.nonzero(mask)[0]:
+            slot = node_slot[i]
+            for p in ports[i]:
+                if p < 0:
+                    break
+                if p >= 65536:
+                    continue
+                word, bit = p >> 5, np.uint32(1 << (p & 31))
+                if not port_words[slot, word] & bit:
+                    port_words[slot, word] |= bit
+                    if dyn_lo[slot] <= p <= dyn_hi[slot]:
+                        dyn_used[slot] += 1
+    return used_cpu, used_mem, used_disk, dyn_used, port_words
+
+
+def count_placed(node_slot: np.ndarray, job_hash: np.ndarray,
+                 jobtg_hash: np.ndarray, live: np.ndarray,
+                 want_job: int, want_jobtg: int, n_pad: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    placed = np.zeros(n_pad, dtype=np.int32)
+    placed_job = np.zeros(n_pad, dtype=np.int32)
+    n_rows = len(node_slot)
+    lib = load()
+    if lib is not None and n_rows:
+        node_slot = np.ascontiguousarray(node_slot, dtype=np.int32)
+        job_hash = np.ascontiguousarray(job_hash, dtype=np.uint64)
+        jobtg_hash = np.ascontiguousarray(jobtg_hash, dtype=np.uint64)
+        live = np.ascontiguousarray(live, dtype=np.uint8)
+        lib.nt_count_placed(
+            _ptr(node_slot, ctypes.c_int32), _ptr(job_hash, ctypes.c_uint64),
+            _ptr(jobtg_hash, ctypes.c_uint64), _ptr(live, ctypes.c_uint8),
+            n_rows, want_job, want_jobtg,
+            _ptr(placed, ctypes.c_int32), _ptr(placed_job, ctypes.c_int32),
+            n_pad)
+        return placed, placed_job
+    mask = (live != 0) & (node_slot >= 0) & (node_slot < n_pad) & \
+        (job_hash == want_job)
+    np.add.at(placed_job, node_slot[mask], 1)
+    mask_tg = mask & (jobtg_hash == want_jobtg)
+    np.add.at(placed, node_slot[mask_tg], 1)
+    return placed, placed_job
+
+
+def static_ports_free(port_words: np.ndarray,
+                      check_ports: np.ndarray) -> np.ndarray:
+    n_pad = port_words.shape[0]
+    out = np.ones(n_pad, dtype=np.uint8)
+    n_ports = len(check_ports)
+    if n_ports == 0:
+        return out.astype(bool)
+    lib = load()
+    if lib is not None:
+        pw = np.ascontiguousarray(port_words, dtype=np.uint32)
+        cp = np.ascontiguousarray(check_ports, dtype=np.int32)
+        lib.nt_static_ports_free(
+            _ptr(pw, ctypes.c_uint32), n_pad,
+            _ptr(cp, ctypes.c_int32), n_ports, _ptr(out, ctypes.c_uint8))
+        return out.astype(bool)
+    for p in check_ports:
+        if p < 0 or p >= 65536:
+            continue
+        word, bit = int(p) >> 5, np.uint32(1 << (int(p) & 31))
+        out &= ((port_words[:, word] & bit) == 0).astype(np.uint8)
+    return out.astype(bool)
+
+
+def verify_fit(cpu_cap, mem_cap, disk_cap, used_cpu, used_mem, used_disk,
+               ask_cpu, ask_mem, ask_disk) -> np.ndarray:
+    """Batch node-axis fit verification. Returns failing dim per node
+    (0 ok, 1 cpu, 2 memory, 3 disk)."""
+    n = len(cpu_cap)
+    out = np.zeros(n, dtype=np.int32)
+    lib = load()
+    if lib is not None and n:
+        args = [np.ascontiguousarray(a, dtype=np.float64) for a in
+                (cpu_cap, mem_cap, disk_cap, used_cpu, used_mem, used_disk,
+                 ask_cpu, ask_mem, ask_disk)]
+        lib.nt_verify_fit(*[_ptr(a, ctypes.c_double) for a in args],
+                          n, _ptr(out, ctypes.c_int32))
+        return out
+    out = np.where(used_cpu + ask_cpu > cpu_cap, 1,
+                   np.where(used_mem + ask_mem > mem_cap, 2,
+                            np.where(used_disk + ask_disk > disk_cap, 3, 0)))
+    return out.astype(np.int32)

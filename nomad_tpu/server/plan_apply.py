@@ -109,7 +109,16 @@ class Planner:
 
         node_ids = list(plan.node_allocation.keys())
 
+        # Native fast-reject pre-pass: batch cpu/mem/disk superset check
+        # across all touched nodes (native/pack_kernels.cc nt_verify_fit).
+        # A kernel reject is authoritative -- ports/cores/devices can only
+        # add MORE rejections, never rescue a resource overflow.
+        fast_reject = self._fast_reject(snapshot, plan, node_ids)
+
         def check(node_id: str) -> Tuple[str, bool, str]:
+            dim = fast_reject.get(node_id)
+            if dim:
+                return node_id, False, dim
             ok, reason = self._evaluate_node_plan(snapshot, plan, node_id)
             return node_id, ok, reason
 
@@ -131,6 +140,53 @@ class Planner:
             result.deployment_updates = []
         result.rejected_nodes = rejected
         return result
+
+    def _fast_reject(self, snapshot, plan: Plan, node_ids) -> Dict[str, str]:
+        """Batch resource check via the native kernel. Returns node_id ->
+        failing dimension for definite rejects; absent means 'run the full
+        authoritative check'."""
+        import numpy as np
+        from .. import native
+
+        n = len(node_ids)
+        if n < 8:       # not worth the batch setup
+            return {}
+        caps = [np.zeros(n) for _ in range(3)]
+        used = [np.zeros(n) for _ in range(3)]
+        asks = [np.zeros(n) for _ in range(3)]
+        valid = np.zeros(n, dtype=bool)
+        for k, node_id in enumerate(node_ids):
+            node = snapshot.node_by_id(node_id)
+            if node is None:
+                continue
+            valid[k] = True
+            caps[0][k] = (node.node_resources.cpu.cpu_shares
+                          - node.reserved_resources.cpu_shares)
+            caps[1][k] = (node.node_resources.memory.memory_mb
+                          - node.reserved_resources.memory_mb)
+            caps[2][k] = (node.node_resources.disk.disk_mb
+                          - node.reserved_resources.disk_mb)
+            removed = {a.id for a in plan.node_update.get(node_id, ())}
+            removed |= {a.id for a in plan.node_preemptions.get(node_id, ())}
+            new_ids = {a.id for a in plan.node_allocation.get(node_id, ())}
+            for a in snapshot.allocs_by_node(node_id):
+                if (a.id in removed or a.id in new_ids
+                        or a.client_terminal_status()
+                        or a.terminal_status()):
+                    continue
+                cr = a.allocated_resources.comparable()
+                used[0][k] += cr.cpu_shares
+                used[1][k] += cr.memory_mb
+                used[2][k] += cr.disk_mb
+            for a in plan.node_allocation.get(node_id, ()):
+                cr = a.allocated_resources.comparable()
+                asks[0][k] += cr.cpu_shares
+                asks[1][k] += cr.memory_mb
+                asks[2][k] += cr.disk_mb
+        dims = native.verify_fit(*caps, *used, *asks)
+        names = {1: "cpu", 2: "memory", 3: "disk"}
+        return {node_ids[k]: names[int(dims[k])]
+                for k in range(n) if valid[k] and dims[k] != 0}
 
     def _evaluate_node_plan(self, snapshot, plan: Plan,
                             node_id: str) -> Tuple[bool, str]:

@@ -115,10 +115,14 @@ class TpuPlacementService:
         inv = np.empty(n_pad, dtype=np.int64)
         inv[perm] = np.arange(n_pad)
 
-        proposed_by_node = {
-            node.id: self.ctx.proposed_allocs(node.id) for node in nodes}
-        usage = pack_usage(matrix, proposed_by_node, self.job.id, tg.name,
-                           self.job.namespace, nodes)
+        table = getattr(self.ctx.state, "alloc_table", None)
+        if table is not None and not table.has_port_overflow:
+            usage = self._pack_usage_from_table(table, matrix, nodes, tg)
+        else:
+            proposed_by_node = {
+                node.id: self.ctx.proposed_allocs(node.id) for node in nodes}
+            usage = pack_usage(matrix, proposed_by_node, self.job.id, tg.name,
+                               self.job.namespace, nodes)
 
         feasible = pack_feasibility(self.ctx, None, tg, nodes, n_pad,
                                     alloc_name=places[0].name)
@@ -148,12 +152,10 @@ class TpuPlacementService:
             static_ports = [p.value for p in tg.networks[0].reserved_ports]
             n_dyn = len(tg.networks[0].dynamic_ports)
         static_free = np.ones(n_pad, dtype=bool)
-        if static_ports:
-            for i in range(n):
-                for p in static_ports:
-                    if usage.port_bitmap[i, p >> 5] & np.uint32(1 << (p & 31)):
-                        static_free[i] = False
-                        break
+        if static_ports and usage.port_bitmap is not None:
+            from .. import native as _native
+            static_free = _native.static_ports_free(
+                usage.port_bitmap, np.asarray(static_ports, dtype=np.int32))
 
         limit = self._limit(n, tg, bool(affinities), bool(spreads))
 
@@ -228,7 +230,8 @@ class TpuPlacementService:
                 if idx is None:
                     idx = NetworkIndex()
                     idx.set_node(node)
-                    idx.add_allocs(proposed_by_node[node.id])
+                    # lazily fetch proposed allocs only for chosen nodes
+                    idx.add_allocs(self.ctx.proposed_allocs(node.id))
                     net_indexes[node.id] = idx
                 offer, err = idx.assign_ports([tg.networks[0]])
                 if offer is None:
@@ -244,6 +247,111 @@ class TpuPlacementService:
                                     alloc_resources, float(scores[pi]),
                                     int(n_yielded[pi])))
         return out
+
+    def _pack_usage_from_table(self, table, matrix, nodes, tg):
+        """Fast marshalling: fold the state store's tensor-resident alloc
+        table via the native kernels (nomad_tpu/native.py), then overlay
+        this eval's plan deltas (stops/preemptions/placements so far) --
+        equivalent to folding ctx.proposed_allocs per node, without the
+        O(nodes x allocs) Python walk."""
+        from ..tensor.pack import UsageState
+        n, n_pad = len(nodes), matrix.n_pad
+        store = getattr(self.ctx.state, "_store", None)
+        lock = store._lock if store is not None else None
+
+        with_ports = bool(tg.networks)
+        slots = np.full(n_pad, -1, dtype=np.int32)
+        if lock is not None:
+            lock.acquire()
+        try:
+            for i, node in enumerate(nodes):
+                slots[i] = table.node_slot_of(node.id)
+            packed = table.pack(n_pad, slots, with_ports,
+                                port_words_seed=matrix.port_bitmap)
+            placed, placed_job = table.count_placed(
+                n_pad, packed["row_slots"], self.job.namespace, self.job.id,
+                tg.name)
+        finally:
+            if lock is not None:
+                lock.release()
+
+        usage = UsageState(
+            used_cpu=packed["used_cpu"], used_mem=packed["used_mem"],
+            used_disk=packed["used_disk"], placed_jobtg=placed,
+            placed_job=placed_job, port_bitmap=packed["port_words"],
+            dyn_used=packed["dyn_used"])
+        self._overlay_plan_deltas(usage, nodes, tg)
+        return usage
+
+    def _overlay_plan_deltas(self, usage, nodes, tg) -> None:
+        """Apply this eval's in-flight plan to the packed usage: stops and
+        preemptions release resources, placements (incl. in-place updates,
+        which REPLACE their existing row) consume them -- the semantics of
+        EvalContext.proposed_allocs (context.go:176)."""
+        pos_of = {node.id: i for i, node in enumerate(nodes)}
+        plan = self.ctx.plan
+        ns, jid, tgn = self.job.namespace, self.job.id, tg.name
+
+        def ports_of(a):
+            return a.allocated_resources.all_ports()
+
+        def adjust(a, sign: int) -> None:
+            pos = pos_of.get(a.node_id)
+            if pos is None:
+                return
+            if sign < 0 and a.client_terminal_status():
+                return  # never counted in the table
+            cr = a.allocated_resources.comparable()
+            usage.used_cpu[pos] += sign * cr.cpu_shares
+            usage.used_mem[pos] += sign * cr.memory_mb
+            usage.used_disk[pos] += sign * cr.disk_mb
+            if a.namespace == ns and a.job_id == jid:
+                usage.placed_job[pos] += sign
+                if a.task_group == tgn:
+                    usage.placed_jobtg[pos] += sign
+            node = nodes[pos]
+            lo = node.node_resources.min_dynamic_port
+            hi = node.node_resources.max_dynamic_port
+            ports = ports_of(a)
+            if not ports:
+                return
+            bitmap = usage.ensure_bitmap(len(usage.used_cpu))
+            for p in ports:
+                if not 0 <= p < 65536:
+                    continue
+                word, bit = p >> 5, np.uint32(1 << (p & 31))
+                if sign > 0:
+                    if not bitmap[pos, word] & bit:
+                        bitmap[pos, word] |= bit
+                        if lo <= p <= hi:
+                            usage.dyn_used[pos] += 1
+                else:
+                    if bitmap[pos, word] & bit:
+                        bitmap[pos, word] &= ~bit
+                        if lo <= p <= hi:
+                            usage.dyn_used[pos] -= 1
+
+        # Subtract against the STORED alloc (what the table counted) -- the
+        # plan's stop copies may carry overridden client statuses.
+        seen_ids = set()
+        for allocs in plan.node_update.values():
+            for a in allocs:
+                stored = self.ctx.state.alloc_by_id(a.id)
+                adjust(stored if stored is not None else a, -1)
+                seen_ids.add(a.id)
+        for allocs in plan.node_preemptions.values():
+            for a in allocs:
+                if a.id not in seen_ids:
+                    stored = self.ctx.state.alloc_by_id(a.id)
+                    adjust(stored if stored is not None else a, -1)
+                    seen_ids.add(a.id)
+        for allocs in plan.node_allocation.values():
+            for a in allocs:
+                # in-place update: the plan alloc replaces the stored one
+                stored = self.ctx.state.alloc_by_id(a.id)
+                if stored is not None and a.id not in seen_ids:
+                    adjust(stored, -1)
+                adjust(a, +1)
 
     def _limit(self, n: int, tg, has_affinities: bool,
                has_spreads: bool) -> int:

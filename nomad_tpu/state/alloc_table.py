@@ -1,0 +1,185 @@
+"""Incrementally-maintained flat allocation table: the tensor-resident
+half of the state store.
+
+Every alloc write updates fixed-width numpy rows (node slot, cpu, mem,
+disk, liveness, job/tg hashes, ports), so the TPU solver's marshalling
+step is a single native fold over the table (nomad_tpu/native.py
+nt_pack_usage) instead of an O(nodes x allocs) Python walk per eval --
+the "packed int32 tensors" marshalling of the north star maintained
+incrementally at write time.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import native
+
+MAX_PORTS = native.MAX_PORTS_PER_ALLOC
+
+
+def stable_hash(*parts: str) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    for p in parts:
+        h.update(p.encode())
+        h.update(b"\0")
+    return int.from_bytes(h.digest(), "little")
+
+
+class AllocTable:
+    """Guarded by the owning StateStore's lock; all mutators are called
+    with that lock held."""
+
+    def __init__(self, initial_capacity: int = 1024):
+        cap = initial_capacity
+        self._row_of: Dict[str, int] = {}
+        self._free: list = []
+        self.n_rows = 0
+        self._cap = cap
+        self.node_slot = np.full(cap, -1, dtype=np.int32)
+        self.cpu = np.zeros(cap, dtype=np.float64)
+        self.mem = np.zeros(cap, dtype=np.float64)
+        self.disk = np.zeros(cap, dtype=np.float64)
+        self.live = np.zeros(cap, dtype=np.uint8)
+        self.job_hash = np.zeros(cap, dtype=np.uint64)
+        self.jobtg_hash = np.zeros(cap, dtype=np.uint64)
+        self.ports = np.full((cap, MAX_PORTS), -1, dtype=np.int32)
+        self.rows_with_ports = 0
+        self._overflow_rows: set = set()
+        # node axis
+        self._slot_of_node: Dict[str, int] = {}
+        self.n_nodes = 0
+        self._node_cap = 256
+        self.dyn_lo = np.full(self._node_cap, 20000, dtype=np.int32)
+        self.dyn_hi = np.full(self._node_cap, 32000, dtype=np.int32)
+
+    # ------------------------------------------------------------------
+    def register_node(self, node) -> int:
+        slot = self._slot_of_node.get(node.id)
+        if slot is None:
+            if self.n_nodes == self._node_cap:
+                self._node_cap *= 2
+                self.dyn_lo = np.resize(self.dyn_lo, self._node_cap)
+                self.dyn_hi = np.resize(self.dyn_hi, self._node_cap)
+            slot = self.n_nodes
+            self._slot_of_node[node.id] = slot
+            self.n_nodes += 1
+        self.dyn_lo[slot] = node.node_resources.min_dynamic_port
+        self.dyn_hi[slot] = node.node_resources.max_dynamic_port
+        return slot
+
+    def node_slot_of(self, node_id: str) -> int:
+        return self._slot_of_node.get(node_id, -1)
+
+    # ------------------------------------------------------------------
+    def _grow(self) -> None:
+        self._cap *= 2
+        for name in ("node_slot", "cpu", "mem", "disk", "live",
+                     "job_hash", "jobtg_hash"):
+            arr = getattr(self, name)
+            setattr(self, name, np.resize(arr, self._cap))
+        new_ports = np.full((self._cap, MAX_PORTS), -1, dtype=np.int32)
+        new_ports[:self.ports.shape[0]] = self.ports
+        self.ports = new_ports
+
+    def upsert(self, alloc) -> None:
+        row = self._row_of.get(alloc.id)
+        if row is None:
+            if self._free:
+                row = self._free.pop()
+            else:
+                if self.n_rows == self._cap:
+                    self._grow()
+                row = self.n_rows
+                self.n_rows += 1
+            self._row_of[alloc.id] = row
+        cr = alloc.allocated_resources.comparable()
+        self.node_slot[row] = self._slot_of_node.get(alloc.node_id, -1)
+        self.cpu[row] = cr.cpu_shares
+        self.mem[row] = cr.memory_mb
+        self.disk[row] = cr.disk_mb
+        self.live[row] = 0 if alloc.client_terminal_status() else 1
+        self.job_hash[row] = stable_hash(alloc.namespace, alloc.job_id)
+        self.jobtg_hash[row] = stable_hash(alloc.namespace, alloc.job_id,
+                                           alloc.task_group)
+        had_ports = self.ports[row, 0] >= 0
+        had_overflow = row in self._overflow_rows
+        self.ports[row, :] = -1
+        ports = alloc.allocated_resources.all_ports()
+        for pi, value in enumerate(ports[:MAX_PORTS]):
+            self.ports[row, pi] = value
+        if len(ports) > MAX_PORTS:
+            # row can't represent all ports: the solver service must fall
+            # back to the exact per-node fold while any overflow exists
+            self._overflow_rows.add(row)
+        elif had_overflow:
+            self._overflow_rows.discard(row)
+        has_ports = bool(ports)
+        if has_ports and not had_ports:
+            self.rows_with_ports += 1
+        elif had_ports and not has_ports:
+            self.rows_with_ports -= 1
+
+    @property
+    def has_port_overflow(self) -> bool:
+        return bool(self._overflow_rows)
+
+    def remove(self, alloc_id: str) -> None:
+        row = self._row_of.pop(alloc_id, None)
+        if row is None:
+            return
+        if self.ports[row, 0] >= 0:
+            self.rows_with_ports -= 1
+        self._overflow_rows.discard(row)
+        self.live[row] = 0
+        self.node_slot[row] = -1
+        self.ports[row, :] = -1
+        self._free.append(row)
+
+    # ------------------------------------------------------------------
+    def pack(self, n_pad: int, node_slots_for_pad: np.ndarray,
+             with_ports: bool, port_words_seed: Optional[np.ndarray] = None):
+        """Fold the table into node-axis tensors aligned to the caller's
+        node ordering. node_slots_for_pad[i] = table slot of the node at
+        position i (or -1). Returns dict of arrays (position-indexed)."""
+        n = self.n_rows
+        # remap table node slots -> caller positions
+        remap = np.full(self.n_nodes + 1, -1, dtype=np.int32)
+        for pos, slot in enumerate(node_slots_for_pad):
+            if slot >= 0:
+                remap[slot] = pos
+        row_slots = self.node_slot[:n]
+        mapped = np.where(row_slots >= 0, remap[np.maximum(row_slots, 0)], -1)
+
+        dyn_lo_pos = np.full(n_pad, 20000, dtype=np.int32)
+        dyn_hi_pos = np.full(n_pad, 32000, dtype=np.int32)
+        valid = node_slots_for_pad >= 0
+        dyn_lo_pos[valid] = self.dyn_lo[node_slots_for_pad[valid]]
+        dyn_hi_pos[valid] = self.dyn_hi[node_slots_for_pad[valid]]
+
+        # Port state only matters when the asking TG has networks; skip the
+        # (potentially 80MB) bitmap fold entirely otherwise.
+        use_ports = with_ports and (self.rows_with_ports > 0
+                                    or port_words_seed is not None)
+        used_cpu, used_mem, used_disk, dyn_used, port_words = \
+            native.pack_usage(
+                mapped.astype(np.int32), self.cpu[:n], self.mem[:n],
+                self.disk[:n], self.live[:n],
+                self.ports[:n] if use_ports else None,
+                dyn_lo_pos, dyn_hi_pos, n_pad,
+                port_words_seed=port_words_seed if with_ports else None)
+        return {"used_cpu": used_cpu, "used_mem": used_mem,
+                "used_disk": used_disk, "dyn_used": dyn_used,
+                "port_words": port_words, "row_slots": mapped}
+
+    def count_placed(self, n_pad: int, mapped_slots: np.ndarray,
+                     namespace: str, job_id: str, tg_name: str):
+        n = self.n_rows
+        return native.count_placed(
+            mapped_slots.astype(np.int32), self.job_hash[:n],
+            self.jobtg_hash[:n], self.live[:n],
+            stable_hash(namespace, job_id),
+            stable_hash(namespace, job_id, tg_name), n_pad)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .alloc_table import AllocTable
 from ..structs import (
     Allocation, Deployment, Evaluation, Job, Node, NodePool, Plan, PlanResult,
     SchedulerConfiguration,
@@ -43,6 +44,11 @@ class StateSnapshot:
             self._deployments = dict(store._deployments)
             self._node_pools = dict(store._node_pools)
             self._scheduler_config = store._scheduler_config
+            # live reference: the dense solver's fast packing path may
+            # observe usage newer than this snapshot; safe because the
+            # plan applier re-verifies every plan against latest state
+            self.alloc_table = store.alloc_table
+            self._store = store
             self._allocs_by_node = {k: list(v) for k, v in store._allocs_by_node.items()}
             self._allocs_by_job = {k: list(v) for k, v in store._allocs_by_job.items()}
 
@@ -149,6 +155,9 @@ class StateStore:
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
         # watch support
         self._watch_cond = threading.Condition(self._lock)
+        # tensor-resident alloc table (fed to the TPU solver's native
+        # packing kernels; maintained incrementally on every write)
+        self.alloc_table = AllocTable()
 
     # -- watch / blocking query ---------------------------------------------
     def latest_index(self) -> int:
@@ -198,6 +207,7 @@ class StateStore:
             if not node.computed_class:
                 node.compute_class()
             self._nodes[node.id] = node
+            self.alloc_table.register_node(node)
             return self._bump("nodes")
 
     def delete_node(self, node_id: str) -> int:
@@ -356,6 +366,7 @@ class StateStore:
             self._allocs_by_job.setdefault(jk, [])
             if alloc.id not in self._allocs_by_job[jk]:
                 self._allocs_by_job[jk].append(alloc.id)
+            self.alloc_table.upsert(alloc)
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
         """Client-side status updates (reference: Node.UpdateAlloc
@@ -379,6 +390,7 @@ class StateStore:
                 import time as _time
                 alloc.modify_time = _time.time()
                 self._allocs[alloc.id] = alloc
+                self.alloc_table.upsert(alloc)
             return self._bump("allocs")
 
     def update_alloc_desired_transition(self, alloc_ids: List[str],
@@ -409,6 +421,7 @@ class StateStore:
                     jids = self._allocs_by_job.get((a.namespace, a.job_id))
                     if jids and aid in jids:
                         jids.remove(aid)
+                self.alloc_table.remove(aid)
             return self._bump("allocs")
 
     # -- deployments ---------------------------------------------------------

@@ -211,6 +211,23 @@ class AllocatedResources:
         out.ports = list(self.shared.ports)
         return out
 
+    def all_ports(self) -> List[int]:
+        """Every host port this allocation holds, deduplicated, in
+        first-seen order -- the single enumeration used by the port
+        bitmap paths (alloc table, usage packing, plan overlays)."""
+        seen = []
+        seen_set = set()
+        for pm in self.shared.ports:
+            if pm.value not in seen_set:
+                seen_set.add(pm.value)
+                seen.append(pm.value)
+        for net in self.shared.networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                if p.value not in seen_set:
+                    seen_set.add(p.value)
+                    seen.append(p.value)
+        return seen
+
 
 @dataclass
 class ComparableResources:

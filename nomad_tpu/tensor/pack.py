@@ -41,7 +41,10 @@ class NodeMatrix:
     cpu_cap: np.ndarray        # (n_pad,) float64 -- capacity minus reserved
     mem_cap: np.ndarray
     disk_cap: np.ndarray
-    port_bitmap: np.ndarray    # (n_pad, PORT_WORDS) uint32, agent-reserved ports
+    # (n_pad, PORT_WORDS) uint32 agent-reserved ports; None when no node
+    # reserves ports (the common case -- the 10K-node bitmap is 80MB, so it
+    # is only materialized when port state actually exists)
+    port_bitmap: Optional[np.ndarray]
     dyn_free: np.ndarray       # (n_pad,) int32 free ports in dynamic range
     valid: np.ndarray          # (n_pad,) bool -- real node vs padding
 
@@ -53,7 +56,7 @@ def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
     cpu = np.zeros(n_pad, dtype=np.float64)
     mem = np.zeros(n_pad, dtype=np.float64)
     disk = np.zeros(n_pad, dtype=np.float64)
-    ports = np.zeros((n_pad, PORT_WORDS), dtype=np.uint32)
+    ports: Optional[np.ndarray] = None
     dyn_free = np.zeros(n_pad, dtype=np.int32)
     valid = np.zeros(n_pad, dtype=bool)
     ids = []
@@ -67,6 +70,8 @@ def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
         dyn_free[i] = max(0, hi - lo + 1)
         for p in rr.reserved_ports:
             if 0 <= p < 65536:
+                if ports is None:
+                    ports = np.zeros((n_pad, PORT_WORDS), dtype=np.uint32)
                 ports[i, p >> 5] |= np.uint32(1 << (p & 31))
                 if lo <= p <= hi:
                     dyn_free[i] -= 1
@@ -86,8 +91,14 @@ class UsageState:
     used_disk: np.ndarray
     placed_jobtg: np.ndarray   # (n_pad,) int32 allocs of THIS job+tg per node
     placed_job: np.ndarray     # (n_pad,) int32 allocs of THIS job (any tg)
-    port_bitmap: np.ndarray    # (n_pad, PORT_WORDS) uint32 incl. alloc ports
+    # (n_pad, PORT_WORDS) uint32 incl. alloc ports; None when no port state
+    port_bitmap: Optional[np.ndarray]
     dyn_used: np.ndarray       # (n_pad,) int32 dynamic-range ports in use
+
+    def ensure_bitmap(self, n_pad: int) -> np.ndarray:
+        if self.port_bitmap is None:
+            self.port_bitmap = np.zeros((n_pad, PORT_WORDS), dtype=np.uint32)
+        return self.port_bitmap
 
 
 def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
@@ -102,7 +113,8 @@ def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
     used_disk = np.zeros(n_pad, dtype=np.float64)
     placed = np.zeros(n_pad, dtype=np.int32)
     placed_job = np.zeros(n_pad, dtype=np.int32)
-    ports = matrix.port_bitmap.copy()
+    ports = (matrix.port_bitmap.copy()
+             if matrix.port_bitmap is not None else None)
     dyn_used = np.zeros(n_pad, dtype=np.int32)
     index = {nid: i for i, nid in enumerate(matrix.node_ids)}
     dyn_ranges = {}
@@ -124,9 +136,10 @@ def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
                 placed_job[i] += 1
                 if alloc.task_group == tg_name:
                     placed[i] += 1
-            for pm in alloc.allocated_resources.shared.ports:
-                v = pm.value
+            for v in alloc.allocated_resources.all_ports():
                 if 0 <= v < 65536:
+                    if ports is None:
+                        ports = np.zeros((n_pad, PORT_WORDS), dtype=np.uint32)
                     word, bit = v >> 5, np.uint32(1 << (v & 31))
                     if not ports[i, word] & bit:
                         ports[i, word] |= bit

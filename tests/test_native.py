@@ -1,0 +1,164 @@
+"""Native kernel equivalence: C++ kernels vs numpy fallbacks."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from nomad_tpu import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native_lib():
+    """Build the native library on demand so a fresh clone tests the real
+    kernels; skip the module if no C++ toolchain is available."""
+    if native.available():
+        return
+    try:
+        subprocess.run(["cmake", "-S", os.path.join(REPO, "native"),
+                        "-B", os.path.join(REPO, "native", "build")],
+                       check=True, capture_output=True, timeout=120)
+        subprocess.run(["cmake", "--build",
+                        os.path.join(REPO, "native", "build")],
+                       check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired) as e:
+        pytest.skip(f"cannot build native library: {e}")
+    native._load_attempted = False
+    native._lib = None
+    if not native.available():
+        pytest.skip("native library built but failed to load")
+
+
+def _rows(n_rows, n_pad, rng):
+    node_slot = rng.integers(-1, n_pad, n_rows).astype(np.int32)
+    cpu = rng.uniform(100, 2000, n_rows)
+    mem = rng.uniform(64, 4096, n_rows)
+    disk = rng.uniform(0, 500, n_rows)
+    live = rng.integers(0, 2, n_rows).astype(np.uint8)
+    ports = np.full((n_rows, native.MAX_PORTS_PER_ALLOC), -1, dtype=np.int32)
+    for i in range(0, n_rows, 3):
+        ports[i, 0] = int(rng.integers(1024, 65536))
+        if i % 6 == 0:
+            ports[i, 1] = int(rng.integers(20000, 32001))
+    dyn_lo = np.full(n_pad, 20000, dtype=np.int32)
+    dyn_hi = np.full(n_pad, 32000, dtype=np.int32)
+    return node_slot, cpu, mem, disk, live, ports, dyn_lo, dyn_hi
+
+
+def test_native_lib_loads():
+    # the built library must be present in this repo
+    assert native.available(), "native/build/libnomad_tpu_native.so missing"
+
+
+def test_pack_usage_native_matches_numpy():
+    rng = np.random.default_rng(42)
+    n_rows, n_pad = 500, 64
+    args = _rows(n_rows, n_pad, rng)
+    got = native.pack_usage(*args, n_pad)
+    # force fallback
+    lib, native._lib = native._lib, None
+    try:
+        want = native.pack_usage(*args, n_pad)
+    finally:
+        native._lib = lib
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=0, atol=1e-9)
+
+
+def test_count_placed_matches_numpy():
+    rng = np.random.default_rng(7)
+    n_rows, n_pad = 300, 32
+    node_slot = rng.integers(-1, n_pad, n_rows).astype(np.int32)
+    live = rng.integers(0, 2, n_rows).astype(np.uint8)
+    job_hash = rng.integers(0, 4, n_rows).astype(np.uint64)
+    jobtg_hash = rng.integers(0, 8, n_rows).astype(np.uint64)
+    got = native.count_placed(node_slot, job_hash, jobtg_hash, live, 2, 5,
+                              n_pad)
+    lib, native._lib = native._lib, None
+    try:
+        want = native.count_placed(node_slot, job_hash, jobtg_hash, live,
+                                   2, 5, n_pad)
+    finally:
+        native._lib = lib
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_static_ports_free_matches_numpy():
+    rng = np.random.default_rng(3)
+    n_pad = 16
+    words = np.zeros((n_pad, native.PORT_WORDS), dtype=np.uint32)
+    for slot in range(n_pad):
+        for p in rng.integers(0, 65536, 20):
+            words[slot, p >> 5] |= np.uint32(1 << (p & 31))
+    check = rng.integers(0, 65536, 5).astype(np.int32)
+    got = native.static_ports_free(words, check)
+    lib, native._lib = native._lib, None
+    try:
+        want = native.static_ports_free(words, check)
+    finally:
+        native._lib = lib
+    np.testing.assert_array_equal(got, want)
+
+
+def test_verify_fit_matches_numpy():
+    rng = np.random.default_rng(11)
+    n = 200
+    caps = [rng.uniform(1000, 8000, n) for _ in range(3)]
+    used = [rng.uniform(0, 8000, n) for _ in range(3)]
+    asks = [rng.uniform(0, 2000, n) for _ in range(3)]
+    got = native.verify_fit(*caps, *used, *asks)
+    lib, native._lib = native._lib, None
+    try:
+        want = native.verify_fit(*caps, *used, *asks)
+    finally:
+        native._lib = lib
+    np.testing.assert_array_equal(got, want)
+
+
+def test_alloc_table_pack_equals_direct_pack():
+    """Table-based packing must equal the direct proposed-allocs fold."""
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tensor import pack_nodes, pack_usage
+
+    s = StateStore()
+    nodes = [mock.node() for _ in range(6)]
+    for n in nodes:
+        s.upsert_node(n)
+    jobs = [mock.job() for _ in range(3)]
+    for j in jobs:
+        s.upsert_job(j)
+    rng = np.random.default_rng(5)
+    for j in jobs:
+        for i in range(4):
+            a = mock.alloc_for(j, nodes[int(rng.integers(0, 6))], i)
+            a.client_status = "running" if rng.random() < 0.8 else "complete"
+            s.upsert_allocs([a])
+
+    matrix = pack_nodes(nodes)
+    job = jobs[0]
+    tg = job.task_groups[0]
+    # direct fold over non-client-terminal allocs
+    by_node = {n.id: [a for a in s.allocs_by_node(n.id)
+                      if not a.client_terminal_status()] for n in nodes}
+    want = pack_usage(matrix, by_node, job.id, tg.name, job.namespace, nodes)
+
+    slots = np.full(matrix.n_pad, -1, dtype=np.int32)
+    for i, n in enumerate(nodes):
+        slots[i] = s.alloc_table.node_slot_of(n.id)
+    packed = s.alloc_table.pack(matrix.n_pad, slots, with_ports=True,
+                                port_words_seed=matrix.port_bitmap)
+    placed, placed_job = s.alloc_table.count_placed(
+        matrix.n_pad, packed["row_slots"], job.namespace, job.id, tg.name)
+
+    np.testing.assert_allclose(packed["used_cpu"], want.used_cpu)
+    np.testing.assert_allclose(packed["used_mem"], want.used_mem)
+    np.testing.assert_allclose(packed["used_disk"], want.used_disk)
+    np.testing.assert_array_equal(packed["dyn_used"], want.dyn_used)
+    np.testing.assert_array_equal(placed, want.placed_jobtg)
+    np.testing.assert_array_equal(placed_job, want.placed_job)
+    np.testing.assert_array_equal(packed["port_words"], want.port_bitmap)

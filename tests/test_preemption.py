@@ -1,0 +1,217 @@
+"""Preemption tests (reference analog: scheduler/preemption_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    DeviceRequest, PreemptionConfig, SchedulerConfiguration,
+    ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_EVICT,
+)
+
+
+def enable_preemption(h):
+    h.state.set_scheduler_config(SchedulerConfiguration(
+        preemption_config=PreemptionConfig(
+            system_scheduler_enabled=True,
+            batch_scheduler_enabled=True,
+            service_scheduler_enabled=True)))
+
+
+def make_eval(job, **kw):
+    e = mock.evaluation(job_id=job.id, namespace=job.namespace, type=job.type,
+                        priority=job.priority)
+    for k, v in kw.items():
+        setattr(e, k, v)
+    return e
+
+
+def fill_node(h, node, cpu_each=1800, count=2, priority=20):
+    """Fill a node with low-priority allocs."""
+    allocs = []
+    for i in range(count):
+        j = mock.job(priority=priority)
+        j.task_groups[0].tasks[0].resources.cpu = cpu_each
+        j.task_groups[0].tasks[0].resources.memory_mb = 512
+        h.state.upsert_job(j)
+        a = mock.alloc_for(j, node, i)
+        a.client_status = ALLOC_CLIENT_RUNNING
+        allocs.append(a)
+    h.state.upsert_allocs(allocs)
+    return allocs
+
+
+def test_service_preempts_lower_priority():
+    h = Harness()
+    enable_preemption(h)
+    node = mock.node()   # 4000 MHz
+    h.state.upsert_node(node)
+    low = fill_node(h, node, cpu_each=1800, count=2, priority=20)  # 3600 used
+
+    # high-priority job needing 2000 MHz: must evict one low-prio alloc
+    job = mock.job(priority=70)
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 2000
+    job.task_groups[0].tasks[0].resources.memory_mb = 512
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    plan = h.plans[0]
+    placed = [a for v in plan.node_allocation.values() for a in v]
+    assert len(placed) == 1
+    preempted = [a for v in plan.node_preemptions.values() for a in v]
+    assert len(preempted) == 1
+    assert preempted[0].id in {a.id for a in low}
+    assert preempted[0].desired_status == ALLOC_DESIRED_EVICT
+    assert preempted[0].preempted_by_allocation == placed[0].id
+    # preemption score recorded
+    assert any(".preemption" in k for k in placed[0].metrics.scores)
+
+
+def test_no_preemption_within_priority_delta():
+    # allocs within 10 priority levels are NOT preemptible
+    # (reference: preemption.go:678 jobPriority - alloc.priority < 10)
+    h = Harness()
+    enable_preemption(h)
+    node = mock.node()
+    h.state.upsert_node(node)
+    fill_node(h, node, cpu_each=1800, count=2, priority=65)
+
+    job = mock.job(priority=70)
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 2000
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    placed = [a for p in h.plans for v in p.node_allocation.values()
+              for a in v]
+    assert not placed
+    assert h.create_evals and h.create_evals[0].status == "blocked"
+
+
+def test_preemption_picks_minimal_set():
+    h = Harness()
+    enable_preemption(h)
+    node = mock.node()  # 4000 MHz
+    h.state.upsert_node(node)
+    # one big (2000) and two small (900 each) low-prio allocs: 3800 used
+    big = fill_node(h, node, cpu_each=2000, count=1, priority=20)
+    small = fill_node(h, node, cpu_each=900, count=2, priority=30)
+
+    # need 2000 -> evicting the single big alloc suffices and is closest
+    job = mock.job(priority=70)
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 2000
+    job.task_groups[0].tasks[0].resources.memory_mb = 256
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    preempted = [a for p in h.plans for v in p.node_preemptions.values()
+                 for a in v]
+    assert len(preempted) == 1
+    assert preempted[0].id == big[0].id
+
+
+def test_preemption_disabled_by_default():
+    h = Harness()  # default config: service preemption off
+    node = mock.node()
+    h.state.upsert_node(node)
+    fill_node(h, node, cpu_each=1800, count=2, priority=20)
+    job = mock.job(priority=70)
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 2000
+    h.state.upsert_job(job)
+    err = h.process("service", make_eval(job))
+    assert err is None
+    preempted = [a for p in h.plans for v in p.node_preemptions.values()
+                 for a in v]
+    assert not preempted
+
+
+def test_device_preemption():
+    h = Harness()
+    enable_preemption(h)
+    node = mock.gpu_node(count=2)
+    h.state.upsert_node(node)
+    # low-prio job holding both GPUs
+    low = mock.job(priority=20)
+    low.task_groups[0].tasks[0].resources.devices = [
+        DeviceRequest(name="gpu", count=2)]
+    h.state.upsert_job(low)
+    a = mock.alloc_for(low, node)
+    a.client_status = ALLOC_CLIENT_RUNNING
+    from nomad_tpu.structs import AllocatedDeviceResource
+    a.allocated_resources.tasks["web"].devices = [AllocatedDeviceResource(
+        vendor="nvidia", type="gpu", name="1080ti",
+        device_ids=node.node_resources.devices[0].instance_ids[:2])]
+    h.state.upsert_allocs([a])
+
+    high = mock.job(priority=70)
+    high.task_groups[0].count = 1
+    high.task_groups[0].tasks[0].resources.devices = [
+        DeviceRequest(name="gpu", count=1)]
+    h.state.upsert_job(high)
+    err = h.process("service", make_eval(high))
+    assert err is None
+    preempted = [x for p in h.plans for v in p.node_preemptions.values()
+                 for x in v]
+    assert len(preempted) == 1 and preempted[0].id == a.id
+    placed = [x for p in h.plans for v in p.node_allocation.values()
+              for x in v]
+    assert len(placed) == 1
+    devs = placed[0].allocated_resources.tasks["web"].devices
+    assert devs and devs[0].type == "gpu" and len(devs[0].device_ids) == 1
+
+
+def test_preemption_end_to_end():
+    """Preempted allocs actually stop on the client and are replaced."""
+    import time
+    from nomad_tpu.client import SimClient
+    from nomad_tpu.server import Server
+
+    server = Server(num_workers=2, heartbeat_ttl=2.0)
+    server.state.set_scheduler_config(SchedulerConfiguration(
+        preemption_config=PreemptionConfig(service_scheduler_enabled=True)))
+    server.start()
+    node = mock.node()
+    client = SimClient(server, node)
+    client.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not server.state.nodes():
+            time.sleep(0.05)
+        low = mock.job(priority=20)
+        low.task_groups[0].count = 2
+        low.task_groups[0].tasks[0].resources.cpu = 1800
+        low.task_groups[0].tasks[0].config = {}
+        server.register_job(low)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            running = [a for a in server.state.allocs_by_job(
+                low.namespace, low.id)
+                if a.client_status == ALLOC_CLIENT_RUNNING]
+            if len(running) == 2:
+                break
+            time.sleep(0.05)
+
+        high = mock.job(priority=70)
+        high.task_groups[0].count = 1
+        high.task_groups[0].tasks[0].resources.cpu = 2000
+        high.task_groups[0].tasks[0].config = {}
+        server.register_job(high)
+        deadline = time.time() + 10
+        ok = False
+        while time.time() < deadline:
+            running_high = [a for a in server.state.allocs_by_job(
+                high.namespace, high.id)
+                if a.client_status == ALLOC_CLIENT_RUNNING]
+            evicted = [a for a in server.state.allocs_by_job(
+                low.namespace, low.id)
+                if a.desired_status == ALLOC_DESIRED_EVICT]
+            if running_high and evicted:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, "high-priority job did not preempt"
+    finally:
+        client.stop()
+        server.shutdown()
