@@ -1,0 +1,22 @@
+"""Consensus layer: replicated log, leader election, snapshots, membership.
+
+The reference embeds hashicorp/raft (reference: nomad/server.go:1365
+setupRaft -- BoltDB log store, TCP transport) and hashicorp/serf gossip
+(server.go:1602 setupSerf). This package is a from-scratch equivalent:
+`RaftNode` (election + log replication + snapshot install over a TCP
+transport), `FileLogStore`/`InMemLogStore` (the WAL), `StateFSM` (applies
+committed entries into the StateStore, mirroring nomad/fsm.go:211
+nomadFSM.Apply), and `Membership` (serf-lite gossip for discovery and
+failure detection).
+"""
+from .log import LogEntry, InMemLogStore, FileLogStore, SnapshotStore
+from .transport import TcpTransport
+from .node import RaftNode, NotLeaderError
+from .fsm import StateFSM, dump_state, restore_state
+from .membership import Membership
+
+__all__ = [
+    "LogEntry", "InMemLogStore", "FileLogStore", "SnapshotStore",
+    "TcpTransport", "RaftNode", "NotLeaderError", "StateFSM",
+    "dump_state", "restore_state", "Membership",
+]

@@ -1,0 +1,149 @@
+"""FSM: applies committed raft entries into the StateStore.
+
+The reference's nomadFSM dispatches ~60 msgpack message types into state
+(reference: nomad/fsm.go:211 Apply; snapshot Persist/Restore further down
+fsm.go; state/state_store_restore.go rebuilds tables). Equivalent here:
+each entry is {"m": <StateStore write method>, "a": [codec-encoded args]};
+a typed registry drives decoding, so the full writable API of the store is
+the replicated-message surface. Snapshots dump every table through the
+generic struct codec.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..state.store import StateStore
+from ..structs import (
+    Allocation, Deployment, DrainStrategy, Evaluation, Job, Node, NodePool,
+    PlanResult, SchedulerConfiguration,
+)
+from ..structs import codec
+
+# method -> positional arg type hints (kwargs are normalized positionally
+# by RaftBackedStateStore before proposing)
+WRITE_METHODS: Dict[str, List[Any]] = {
+    "upsert_node": [Node],
+    "delete_node": [str],
+    "update_node_status": [str, str, float],
+    "update_node_eligibility": [str, str],
+    "update_node_drain": [str, Optional[DrainStrategy], bool],
+    "upsert_job": [Job],
+    "update_job_status": [str, str, str],
+    "delete_job": [str, str],
+    "upsert_evals": [List[Evaluation]],
+    "delete_evals": [List[str]],
+    "upsert_allocs": [List[Allocation]],
+    "update_allocs_from_client": [List[Allocation]],
+    "update_alloc_desired_transition": [List[str], bool],
+    "delete_allocs": [List[str]],
+    "upsert_deployment": [Deployment],
+    "upsert_deployment_cas": [Deployment, int],
+    "delete_deployment": [str],
+    "upsert_node_pool": [NodePool],
+    "set_scheduler_config": [SchedulerConfiguration],
+    "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
+}
+
+
+def encode_command(method: str, args: Tuple[Any, ...]) -> dict:
+    specs = WRITE_METHODS[method]
+    return {"m": method,
+            "a": [codec.encode(a) for a in args[:len(specs)]]}
+
+
+class StateFSM:
+    """(reference: nomad/fsm.go nomadFSM)"""
+
+    def __init__(self, store: StateStore):
+        self.store = store
+
+    def apply(self, data: dict) -> Any:
+        method = data["m"]
+        specs = WRITE_METHODS.get(method)
+        if specs is None:
+            raise ValueError(f"unknown FSM command: {method}")
+        args = [codec.decode(spec, raw)
+                for spec, raw in zip(specs, data["a"])]
+        return getattr(self.store, method)(*args)
+
+    def snapshot(self) -> Any:
+        return dump_state(self.store)
+
+    def restore(self, blob: Any) -> None:
+        restore_state(self.store, blob)
+
+
+# ---------------------------------------------------------------------------
+# whole-store dump/restore (reference: fsm.go Persist/Restore +
+# state/state_store_restore.go)
+
+def dump_state(store: StateStore) -> dict:
+    with store._lock:
+        return {
+            "index": store._index,
+            "table_index": dict(store._table_index),
+            "nodes": [codec.encode(n) for n in store._nodes.values()],
+            "jobs": [codec.encode(j) for j in store._jobs.values()],
+            "job_versions": {
+                codec._encode_key(k): codec.encode(v)
+                for k, v in store._job_versions.items()},
+            "evals": [codec.encode(e) for e in store._evals.values()],
+            "allocs": [codec.encode(a) for a in store._allocs.values()],
+            "deployments": [codec.encode(d)
+                            for d in store._deployments.values()],
+            "node_pools": [codec.encode(p)
+                           for p in store._node_pools.values()],
+            "scheduler_config": codec.encode(store._scheduler_config),
+        }
+
+
+def restore_state(store: StateStore, blob: dict) -> None:
+    nodes = [codec.decode(Node, n) for n in blob.get("nodes", [])]
+    jobs = [codec.decode(Job, j) for j in blob.get("jobs", [])]
+    evals = [codec.decode(Evaluation, e) for e in blob.get("evals", [])]
+    allocs = [codec.decode(Allocation, a) for a in blob.get("allocs", [])]
+    deployments = [codec.decode(Deployment, d)
+                   for d in blob.get("deployments", [])]
+    pools = [codec.decode(NodePool, p) for p in blob.get("node_pools", [])]
+    sched_cfg = codec.decode(SchedulerConfiguration,
+                             blob.get("scheduler_config") or {})
+    with store._lock:
+        store._nodes = {n.id: n for n in nodes}
+        store._jobs = {(j.namespace, j.id): j for j in jobs}
+        store._job_versions = {}
+        for k, v in blob.get("job_versions", {}).items():
+            ns, jid, ver = k.split("\x1f")
+            store._job_versions[(ns, jid, int(ver))] = codec.decode(Job, v)
+        store._evals = {e.id: e for e in evals}
+        store._allocs = {a.id: a for a in allocs}
+        store._deployments = {d.id: d for d in deployments}
+        store._node_pools = {p.name: p for p in pools}
+        if sched_cfg is not None:
+            store._scheduler_config = sched_cfg
+        # rebuild secondary indexes
+        store._allocs_by_node = {}
+        store._allocs_by_job = {}
+        for a in allocs:
+            store._allocs_by_node.setdefault(a.node_id, []).append(a.id)
+            store._allocs_by_job.setdefault(
+                (a.namespace, a.job_id), []).append(a.id)
+        # re-link alloc.job to the stored job (codec duplicates the object)
+        for a in allocs:
+            stored = store._jobs.get((a.namespace, a.job_id))
+            if stored is not None and a.job is not None and \
+                    a.job.version == stored.version:
+                a.job = stored
+        store._index = blob.get("index", 1)
+        ti = blob.get("table_index", {})
+        for t in store._table_index:
+            store._table_index[t] = ti.get(t, store._index)
+        # rebuild the tensor-resident alloc table
+        from ..state.alloc_table import AllocTable
+        table = AllocTable()
+        for n in nodes:
+            table.register_node(n)
+        for a in allocs:
+            if not a.terminal_status():
+                table.upsert(a)
+        store.alloc_table = table
+        store._watch_cond.notify_all()

@@ -1,0 +1,242 @@
+"""Raft log + snapshot storage.
+
+The reference persists its raft log in BoltDB (reference: nomad/server.go:30
+raft-boltdb/v2, setupRaft server.go:1365) and snapshots as files through the
+raft snapshot store (helper/snapshot/snapshot.go archives them). Equivalent
+here: `FileLogStore` is an append-only JSONL WAL with an in-memory mirror
+(every committed entry is one fsync-able line), `InMemLogStore` backs tests
+and dev mode, `SnapshotStore` writes whole-FSM snapshots that allow the WAL
+prefix to be compacted.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class LogEntry:
+    index: int = 0
+    term: int = 0
+    type: str = ""          # "noop" | "command" | "barrier"
+    data: Any = None
+
+
+class InMemLogStore:
+    """Volatile log: a list offset by first_index (compaction trims the
+    prefix once a snapshot covers it)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: List[LogEntry] = []
+        self._first = 1          # index of _entries[0] if non-empty
+
+    # -- reads ---------------------------------------------------------
+    def first_index(self) -> int:
+        with self._lock:
+            return self._first if self._entries else 0
+
+    def last_index(self) -> int:
+        with self._lock:
+            return (self._first + len(self._entries) - 1
+                    if self._entries else self._first - 1)
+
+    def last_term(self) -> int:
+        with self._lock:
+            return self._entries[-1].term if self._entries else 0
+
+    def get(self, index: int) -> Optional[LogEntry]:
+        with self._lock:
+            k = index - self._first
+            if 0 <= k < len(self._entries):
+                return self._entries[k]
+            return None
+
+    def entries_from(self, index: int, limit: int = 64) -> List[LogEntry]:
+        with self._lock:
+            k = max(0, index - self._first)
+            return list(self._entries[k:k + limit])
+
+    # -- writes --------------------------------------------------------
+    def append(self, entry: LogEntry) -> None:
+        with self._lock:
+            self._entries.append(entry)
+            self._persist(entry)
+
+    def truncate_after(self, index: int) -> None:
+        """Drop entries with index > `index` (conflict resolution on
+        followers)."""
+        with self._lock:
+            keep = index - self._first + 1
+            if keep < len(self._entries):
+                self._entries = self._entries[:max(keep, 0)]
+                self._persist_truncate(index)
+
+    def compact_to(self, index: int) -> None:
+        """Drop entries with index <= `index` (covered by a snapshot)."""
+        with self._lock:
+            drop = index - self._first + 1
+            if drop > 0:
+                self._entries = self._entries[drop:]
+                self._first = index + 1
+                self._persist_compact(index)
+
+    def reset(self, first_index: int) -> None:
+        """After installing a snapshot past our log."""
+        with self._lock:
+            self._entries = []
+            self._first = first_index
+            self._persist_reset(first_index)
+
+    # -- persistence hooks (no-ops in memory) --------------------------
+    def _persist(self, entry: LogEntry) -> None:
+        pass
+
+    def _persist_truncate(self, index: int) -> None:
+        pass
+
+    def _persist_compact(self, index: int) -> None:
+        pass
+
+    def _persist_reset(self, first_index: int) -> None:
+        pass
+
+
+class FileLogStore(InMemLogStore):
+    """JSONL WAL. Each line is {"op": "append"|"truncate"|"compact"|"reset",
+    ...}; recovery replays the ops. Rewritten compactly when the file grows
+    past `rewrite_bytes`."""
+
+    def __init__(self, path: str, rewrite_bytes: int = 8 << 20) -> None:
+        super().__init__()
+        self.path = path
+        self.rewrite_bytes = rewrite_bytes
+        self._fh = None
+        if os.path.exists(path):
+            self._recover()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def _recover(self) -> None:
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break       # torn tail write: discard
+                op = rec.get("op")
+                if op == "append":
+                    e = rec["entry"]
+                    self._entries.append(LogEntry(
+                        index=e["index"], term=e["term"], type=e["type"],
+                        data=e.get("data")))
+                    if len(self._entries) == 1:
+                        self._first = e["index"]
+                elif op == "truncate":
+                    keep = rec["index"] - self._first + 1
+                    self._entries = self._entries[:max(keep, 0)]
+                elif op == "compact":
+                    drop = rec["index"] - self._first + 1
+                    if drop > 0:
+                        self._entries = self._entries[drop:]
+                        self._first = rec["index"] + 1
+                elif op == "reset":
+                    self._entries = []
+                    self._first = rec["first"]
+
+    def _write(self, rec: dict) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._fh.flush()
+
+    def _persist(self, entry: LogEntry) -> None:
+        self._write({"op": "append", "entry": {
+            "index": entry.index, "term": entry.term, "type": entry.type,
+            "data": entry.data}})
+
+    def _persist_truncate(self, index: int) -> None:
+        self._write({"op": "truncate", "index": index})
+
+    def _persist_compact(self, index: int) -> None:
+        self._write({"op": "compact", "index": index})
+        self._maybe_rewrite()
+
+    def _persist_reset(self, first_index: int) -> None:
+        self._write({"op": "reset", "first": first_index})
+        self._maybe_rewrite()
+
+    def _maybe_rewrite(self) -> None:
+        try:
+            if os.path.getsize(self.path) < self.rewrite_bytes:
+                return
+        except OSError:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"op": "reset", "first": self._first},
+                                separators=(",", ":")) + "\n")
+            for e in self._entries:
+                fh.write(json.dumps(
+                    {"op": "append", "entry": {
+                        "index": e.index, "term": e.term, "type": e.type,
+                        "data": e.data}}, separators=(",", ":")) + "\n")
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+@dataclass
+class Snapshot:
+    last_index: int = 0
+    last_term: int = 0
+    state: Any = None        # FSM-opaque JSON-able blob
+
+
+class SnapshotStore:
+    """Latest-wins snapshot storage; file-backed when given a directory
+    (reference: raft snapshot store + FSM Persist/Restore, nomad/fsm.go)."""
+
+    def __init__(self, dirpath: Optional[str] = None) -> None:
+        self.dirpath = dirpath
+        self._latest: Optional[Snapshot] = None
+        self._lock = threading.Lock()
+        if dirpath:
+            os.makedirs(dirpath, exist_ok=True)
+            path = os.path.join(dirpath, "snapshot.json")
+            if os.path.exists(path):
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        rec = json.load(fh)
+                    self._latest = Snapshot(rec["last_index"],
+                                            rec["last_term"], rec["state"])
+                except (json.JSONDecodeError, KeyError, OSError):
+                    pass
+
+    def save(self, snap: Snapshot) -> None:
+        with self._lock:
+            self._latest = snap
+            if self.dirpath:
+                path = os.path.join(self.dirpath, "snapshot.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump({"last_index": snap.last_index,
+                               "last_term": snap.last_term,
+                               "state": snap.state}, fh,
+                              separators=(",", ":"))
+                os.replace(tmp, path)
+
+    def latest(self) -> Optional[Snapshot]:
+        with self._lock:
+            return self._latest

@@ -1,0 +1,214 @@
+"""Serf-lite cluster membership: join, gossip, failure detection.
+
+The reference uses hashicorp/serf (SWIM gossip over UDP+TCP) for member
+discovery, failure detection and leader-election events (reference:
+nomad/server.go:1602 setupSerf; nomad/serf.go reacts to member joins).
+Equivalent here, riding the same TCP transport as raft: each server keeps a
+versioned member map; `join(addr)` merges maps both ways; a gossip loop
+pushes the map to k random peers per round (epidemic dissemination); a
+probe loop pings members and marks them failed/left. Raft remains the
+authority for leadership -- membership only feeds discovery and health,
+exactly as serf does for the reference.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .transport import TcpTransport
+
+ALIVE, SUSPECT, FAILED, LEFT = "alive", "suspect", "failed", "left"
+
+
+@dataclass
+class Member:
+    name: str
+    addr: Tuple[str, int]
+    status: str = ALIVE
+    incarnation: int = 0       # per-member version; highest wins on merge
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "addr": list(self.addr),
+                "status": self.status, "incarnation": self.incarnation,
+                "tags": self.tags}
+
+    @staticmethod
+    def from_wire(d: dict) -> "Member":
+        return Member(name=d["name"], addr=tuple(d["addr"]),
+                      status=d["status"], incarnation=d["incarnation"],
+                      tags=d.get("tags", {}))
+
+
+class Membership:
+    """(reference: serf cluster via nomad/serf.go)"""
+
+    def __init__(self, name: str, transport: TcpTransport,
+                 tags: Optional[Dict[str, str]] = None,
+                 gossip_interval: float = 0.2,
+                 probe_interval: float = 0.5,
+                 suspicion_timeout: float = 2.0):
+        self.name = name
+        self.transport = transport
+        self.gossip_interval = gossip_interval
+        self.probe_interval = probe_interval
+        self.suspicion_timeout = suspicion_timeout
+        self._lock = threading.Lock()
+        self._members: Dict[str, Member] = {
+            name: Member(name=name, addr=transport.addr, tags=tags or {})}
+        self._suspect_since: Dict[str, float] = {}
+        self._shutdown = threading.Event()
+        self._callbacks: List = []    # cb(event, member)
+        transport.register("gossip", self._handle_gossip)
+        transport.register("ping", lambda msg: {"ack": True,
+                                                "from": self.name})
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        for fn, label in ((self._gossip_loop, "gossip"),
+                          (self._probe_loop, "probe")):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"serf-{label}-{self.name}")
+            t.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    def leave(self) -> None:
+        """Graceful leave: bump incarnation, mark left, push once."""
+        with self._lock:
+            me = self._members[self.name]
+            me.incarnation += 1
+            me.status = LEFT
+        self._gossip_round()
+        self._shutdown.set()
+
+    def on_event(self, cb) -> None:
+        """cb(event: 'join'|'failed'|'left', member: Member)"""
+        self._callbacks.append(cb)
+
+    def members(self) -> List[Member]:
+        with self._lock:
+            return list(self._members.values())
+
+    def alive_members(self) -> List[Member]:
+        return [m for m in self.members() if m.status == ALIVE]
+
+    # ------------------------------------------------------------------
+    def join(self, addr: Tuple[str, int], timeout: float = 3.0) -> int:
+        """Push-pull state sync with an existing member
+        (reference: serf Join)."""
+        reply = self.transport.send(tuple(addr), {
+            "type": "gossip",
+            "members": [m.to_wire() for m in self.members()],
+        }, timeout=timeout)
+        merged = reply.get("members", [])
+        self._merge([Member.from_wire(d) for d in merged])
+        return len(merged)
+
+    def _handle_gossip(self, msg: dict) -> dict:
+        self._merge([Member.from_wire(d) for d in msg.get("members", [])])
+        return {"members": [m.to_wire() for m in self.members()]}
+
+    def _merge(self, remote: List[Member]) -> None:
+        events = []
+        with self._lock:
+            for rm in remote:
+                cur = self._members.get(rm.name)
+                if rm.name == self.name:
+                    # refute rumors about ourselves (serf's alive-refutation)
+                    if cur is not None and rm.incarnation >= cur.incarnation \
+                            and rm.status != ALIVE:
+                        cur.incarnation = rm.incarnation + 1
+                        cur.status = ALIVE
+                    continue
+                if cur is None:
+                    self._members[rm.name] = rm
+                    if rm.status == ALIVE:
+                        events.append(("join", rm))
+                elif (rm.incarnation, _prio(rm.status)) > (
+                        cur.incarnation, _prio(cur.status)):
+                    old_status = cur.status
+                    self._members[rm.name] = rm
+                    if rm.status != old_status:
+                        if rm.status == ALIVE:
+                            events.append(("join", rm))
+                        elif rm.status == FAILED:
+                            events.append(("failed", rm))
+                        elif rm.status == LEFT:
+                            events.append(("left", rm))
+        for ev, m in events:
+            self._notify(ev, m)
+
+    def _notify(self, event: str, member: Member) -> None:
+        for cb in self._callbacks:
+            try:
+                cb(event, member)
+            except Exception:   # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    def _gossip_loop(self) -> None:
+        while not self._shutdown.wait(self.gossip_interval):
+            self._gossip_round()
+
+    def _gossip_round(self, fanout: int = 3) -> None:
+        peers = [m for m in self.members()
+                 if m.name != self.name and m.status in (ALIVE, SUSPECT)]
+        random.shuffle(peers)
+        payload = {"type": "gossip",
+                   "members": [m.to_wire() for m in self.members()]}
+        for m in peers[:fanout]:
+            try:
+                reply = self.transport.send(m.addr, payload, timeout=1.0)
+                self._merge([Member.from_wire(d)
+                             for d in reply.get("members", [])])
+            except (OSError, ConnectionError):
+                pass
+
+    def _probe_loop(self) -> None:
+        while not self._shutdown.wait(self.probe_interval):
+            targets = [m for m in self.members()
+                       if m.name != self.name and m.status in (ALIVE, SUSPECT)]
+            if not targets:
+                continue
+            m = random.choice(targets)
+            ok = False
+            try:
+                reply = self.transport.send(m.addr, {"type": "ping"},
+                                            timeout=0.5)
+                ok = bool(reply.get("ack"))
+            except (OSError, ConnectionError):
+                ok = False
+            now = time.monotonic()
+            events = []
+            with self._lock:
+                cur = self._members.get(m.name)
+                if cur is None:
+                    continue
+                if ok:
+                    self._suspect_since.pop(m.name, None)
+                    if cur.status in (SUSPECT, FAILED):
+                        cur.status = ALIVE
+                        cur.incarnation += 1
+                        events.append(("join", cur))
+                else:
+                    since = self._suspect_since.setdefault(m.name, now)
+                    if cur.status == ALIVE:
+                        cur.status = SUSPECT
+                        cur.incarnation += 1
+                    elif cur.status == SUSPECT and \
+                            now - since >= self.suspicion_timeout:
+                        cur.status = FAILED
+                        cur.incarnation += 1
+                        events.append(("failed", cur))
+            for ev, mem in events:
+                self._notify(ev, mem)
+
+
+def _prio(status: str) -> int:
+    # at equal incarnation, stronger claims win (serf's precedence)
+    return {ALIVE: 0, SUSPECT: 1, FAILED: 2, LEFT: 3}.get(status, 0)

@@ -1,0 +1,580 @@
+"""Raft consensus node: leader election, log replication, snapshots.
+
+A from-scratch Raft in the role hashicorp/raft plays for the reference
+(reference: nomad/server.go:1365 setupRaft wires the log store, transport
+and FSM; leader.go:90 monitorLeadership reacts to leadership changes).
+Standard Raft: randomized election timeouts, per-peer replicator threads,
+majority commit with current-term gate, InstallSnapshot for lagging
+followers, and a `barrier()` (commit a noop) for linearizable reads.
+
+`apply()` is the write path every state mutation rides -- the analog of the
+reference's `raftApply` (nomad/rpc.go raftApplyFuture): append to the log,
+replicate to a majority, apply to the FSM, return the FSM's result.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .log import InMemLogStore, LogEntry, Snapshot, SnapshotStore
+from .transport import TcpTransport
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_id: str = "", leader_addr=None):
+        super().__init__(f"not the leader (leader={leader_id or '?'})")
+        self.leader_id = leader_id
+        self.leader_addr = leader_addr
+
+
+class _Pending:
+    __slots__ = ("event", "result", "error", "term")
+
+    def __init__(self, term: int):
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[Exception] = None
+        self.term = term
+
+
+class RaftNode:
+    """One consensus participant. `peers` maps server name -> (host, port)
+    for every member INCLUDING this node (static bootstrap configuration,
+    like the reference's bootstrap_expect dev clusters)."""
+
+    def __init__(self, name: str, transport: TcpTransport,
+                 peers: Dict[str, Tuple[str, int]], fsm,
+                 log: Optional[InMemLogStore] = None,
+                 data_dir: Optional[str] = None,
+                 heartbeat_interval: float = 0.05,
+                 election_timeout: float = 0.25,
+                 snapshot_threshold: int = 8192):
+        self.name = name
+        self.transport = transport
+        self.peers = dict(peers)
+        self.fsm = fsm
+        self.log = log if log is not None else InMemLogStore()
+        self.data_dir = data_dir
+        self.snapshots = SnapshotStore(
+            os.path.join(data_dir, "snapshots") if data_dir else None)
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.snapshot_threshold = snapshot_threshold
+
+        self._lock = threading.RLock()
+        self.state = FOLLOWER
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self._meta_path = (os.path.join(data_dir, "raft_meta.json")
+                           if data_dir else None)
+        self._load_meta()
+
+        snap = self.snapshots.latest()
+        self._snap_last_index = snap.last_index if snap else 0
+        self._snap_last_term = snap.last_term if snap else 0
+        if snap is not None:
+            self.fsm.restore(snap.state)
+            self.commit_index = snap.last_index
+            self.last_applied = snap.last_index
+
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._pending: Dict[int, _Pending] = {}
+        self._election_deadline = self._rand_deadline()
+        self._apply_cond = threading.Condition(self._lock)
+        self._fsm_lock = threading.Lock()
+        self._repl_events: Dict[str, threading.Event] = {}
+        self._repl_threads: List[threading.Thread] = []
+        self._leadership_cbs: List[Callable[[bool], None]] = []
+        self._leadership_q: List[bool] = []
+        self._leadership_signal = threading.Event()
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        transport.register("request_vote", self._handle_request_vote)
+        transport.register("append_entries", self._handle_append_entries)
+        transport.register("install_snapshot", self._handle_install_snapshot)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        for fn, name in ((self._ticker, "raft-ticker"),
+                         (self._apply_loop, "raft-apply"),
+                         (self._leadership_dispatch_loop, "raft-leadership")):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"{name}-{self.name}")
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._apply_cond:
+            self._apply_cond.notify_all()
+        for ev in self._repl_events.values():
+            ev.set()
+
+    def on_leadership(self, cb: Callable[[bool], None]) -> None:
+        self._leadership_cbs.append(cb)
+
+    # -- public API ----------------------------------------------------
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader(self) -> Tuple[str, Optional[Tuple[str, int]]]:
+        with self._lock:
+            lid = self.leader_id or ""
+            return lid, self.peers.get(lid)
+
+    def apply(self, data: Any, timeout: float = 10.0,
+              entry_type: str = "command") -> Any:
+        """Replicate one command and return the FSM's application result."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id or "",
+                                     self.peers.get(self.leader_id or ""))
+            entry = LogEntry(index=self.log.last_index() + 1,
+                             term=self.current_term, type=entry_type,
+                             data=data)
+            self.log.append(entry)
+            self._match_self()
+            pend = _Pending(self.current_term)
+            self._pending[entry.index] = pend
+        self._wake_replicators()
+        self._maybe_advance_commit()
+        if not pend.event.wait(timeout):
+            with self._lock:
+                self._pending.pop(entry.index, None)
+            raise TimeoutError(f"raft apply timed out at {entry.index}")
+        if pend.error is not None:
+            raise pend.error
+        return pend.result
+
+    def barrier(self, timeout: float = 10.0) -> int:
+        """Commit a noop; after it applies, local reads reflect every write
+        committed before the call (linearizable read point)."""
+        self.apply(None, timeout=timeout, entry_type="barrier")
+        with self._lock:
+            return self.last_applied
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"state": self.state, "term": self.current_term,
+                    "leader": self.leader_id,
+                    "commit_index": self.commit_index,
+                    "last_applied": self.last_applied,
+                    "last_log_index": self.log.last_index(),
+                    "snapshot_index": self._snap_last_index}
+
+    # -- persistence ---------------------------------------------------
+    def _load_meta(self) -> None:
+        if self._meta_path and os.path.exists(self._meta_path):
+            try:
+                with open(self._meta_path, encoding="utf-8") as fh:
+                    m = json.load(fh)
+                self.current_term = m.get("term", 0)
+                self.voted_for = m.get("voted_for")
+            except (json.JSONDecodeError, OSError):
+                pass
+
+    def _save_meta(self) -> None:
+        if not self._meta_path:
+            return
+        os.makedirs(os.path.dirname(self._meta_path), exist_ok=True)
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"term": self.current_term,
+                       "voted_for": self.voted_for}, fh)
+        os.replace(tmp, self._meta_path)
+
+    # -- helpers -------------------------------------------------------
+    def _rand_deadline(self) -> float:
+        return time.monotonic() + self.election_timeout * (
+            1.0 + random.random())
+
+    def _last_log(self) -> Tuple[int, int]:
+        """(last index, last term) accounting for a compacted prefix."""
+        li = self.log.last_index()
+        if li <= self._snap_last_index or self.log.first_index() == 0:
+            return self._snap_last_index, self._snap_last_term
+        return li, self.log.last_term()
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == 0:
+            return 0
+        if index == self._snap_last_index:
+            return self._snap_last_term
+        e = self.log.get(index)
+        return e.term if e else None
+
+    def _match_self(self) -> None:
+        self._match_index[self.name] = self.log.last_index()
+
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        was_leader = self.state == LEADER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._save_meta()
+        self.state = FOLLOWER
+        if leader is not None:
+            self.leader_id = leader
+        self._election_deadline = self._rand_deadline()
+        if was_leader:
+            # fail in-flight applies immediately (hashicorp/raft fails
+            # futures on stepdown rather than letting them time out)
+            err = NotLeaderError(leader or "", self.peers.get(leader or ""))
+            for pend in self._pending.values():
+                pend.error = err
+                pend.event.set()
+            self._pending.clear()
+            self._notify_leadership(False)
+
+    def _notify_leadership(self, is_leader: bool) -> None:
+        """Dispatch on a separate thread: callbacks run raft operations
+        (barrier, apply) and must not run under self._lock. A serialized
+        queue preserves gained/lost ordering (reference: the
+        leaderCh/monitorLeadership pattern, nomad/leader.go:90)."""
+        self._leadership_q.append(is_leader)
+        self._leadership_signal.set()
+
+    def _leadership_dispatch_loop(self) -> None:
+        while not self._shutdown.is_set():
+            self._leadership_signal.wait(0.5)
+            self._leadership_signal.clear()
+            while self._leadership_q:
+                is_leader = self._leadership_q.pop(0)
+                for cb in self._leadership_cbs:
+                    try:
+                        cb(is_leader)
+                    except Exception:   # noqa: BLE001
+                        pass
+
+    def _wake_replicators(self) -> None:
+        for ev in self._repl_events.values():
+            ev.set()
+
+    # -- ticker / elections --------------------------------------------
+    def _ticker(self) -> None:
+        while not self._shutdown.wait(self.heartbeat_interval / 2):
+            with self._lock:
+                if self.state == LEADER:
+                    continue
+                expired = time.monotonic() >= self._election_deadline
+            if expired:
+                self._run_election()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.name
+            self._save_meta()
+            term = self.current_term
+            self.leader_id = None
+            self._election_deadline = self._rand_deadline()
+            last_idx, last_term = self._last_log()
+        votes = {self.name}
+        vote_lock = threading.Lock()
+        done = threading.Event()
+        majority = len(self.peers) // 2 + 1
+
+        def ask(peer: str, addr) -> None:
+            try:
+                reply = self.transport.send(addr, {
+                    "type": "request_vote", "term": term,
+                    "candidate": self.name,
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                }, timeout=self.election_timeout)
+            except (OSError, ConnectionError):
+                return
+            with self._lock:
+                if reply.get("term", 0) > self.current_term:
+                    self._become_follower(reply["term"], None)
+                    done.set()
+                    return
+            if reply.get("granted"):
+                with vote_lock:
+                    votes.add(peer)
+                    if len(votes) >= majority:
+                        done.set()
+
+        threads = []
+        for peer, addr in self.peers.items():
+            if peer == self.name:
+                continue
+            t = threading.Thread(target=ask, args=(peer, addr), daemon=True)
+            t.start()
+            threads.append(t)
+        done.wait(self.election_timeout)
+        with self._lock:
+            if (self.state == CANDIDATE and self.current_term == term
+                    and len(votes) >= majority):
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.name
+        last = self.log.last_index()
+        for peer in self.peers:
+            if peer == self.name:
+                continue
+            self._next_index[peer] = last + 1
+            self._match_index[peer] = 0
+            ev = self._repl_events.setdefault(peer, threading.Event())
+            ev.set()
+        self._match_self()
+        for peer, addr in self.peers.items():
+            if peer == self.name:
+                continue
+            t = threading.Thread(target=self._replicate_loop,
+                                 args=(peer, addr, self.current_term),
+                                 daemon=True,
+                                 name=f"raft-repl-{self.name}->{peer}")
+            t.start()
+            self._repl_threads.append(t)
+        # Commit a noop from the new term so earlier-term entries commit
+        # (Raft safety: only current-term entries commit by counting).
+        noop = LogEntry(index=self.log.last_index() + 1,
+                        term=self.current_term, type="noop", data=None)
+        self.log.append(noop)
+        self._match_self()
+        self._notify_leadership(True)
+        self._wake_replicators()
+
+    # -- replication (leader side) -------------------------------------
+    def _replicate_loop(self, peer: str, addr, term: int) -> None:
+        ev = self._repl_events[peer]
+        while not self._shutdown.is_set():
+            ev.wait(self.heartbeat_interval)
+            ev.clear()
+            with self._lock:
+                if self.state != LEADER or self.current_term != term:
+                    return
+            try:
+                self._replicate_once(peer, addr, term)
+            except (OSError, ConnectionError):
+                time.sleep(self.heartbeat_interval)
+
+    def _replicate_once(self, peer: str, addr, term: int) -> None:
+        with self._lock:
+            next_idx = self._next_index.get(peer, self.log.last_index() + 1)
+            first = self.log.first_index()
+            need_snapshot = (self._snap_last_index > 0
+                             and next_idx <= self._snap_last_index
+                             and (first == 0 or next_idx < first))
+            if need_snapshot:
+                snap = self.snapshots.latest()
+            else:
+                prev_index = next_idx - 1
+                prev_term = self._term_at(prev_index)
+                if prev_term is None:       # compacted under us: snapshot
+                    need_snapshot = True
+                    snap = self.snapshots.latest()
+                else:
+                    entries = self.log.entries_from(next_idx, limit=256)
+                    commit = self.commit_index
+        if need_snapshot and snap is None:
+            return              # nothing to send yet
+        if need_snapshot:
+            reply = self.transport.send(addr, {
+                "type": "install_snapshot", "term": term,
+                "leader": self.name, "last_index": snap.last_index,
+                "last_term": snap.last_term, "state": snap.state,
+            }, timeout=10.0)
+            with self._lock:
+                if reply.get("term", 0) > self.current_term:
+                    self._become_follower(reply["term"], None)
+                    return
+                self._next_index[peer] = snap.last_index + 1
+                self._match_index[peer] = snap.last_index
+            self._maybe_advance_commit()
+            return
+        reply = self.transport.send(addr, {
+            "type": "append_entries", "term": term, "leader": self.name,
+            "prev_log_index": prev_index, "prev_log_term": prev_term,
+            "entries": [{"index": e.index, "term": e.term, "type": e.type,
+                         "data": e.data} for e in entries],
+            "leader_commit": commit,
+        }, timeout=2.0)
+        with self._lock:
+            if reply.get("term", 0) > self.current_term:
+                self._become_follower(reply["term"], None)
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if reply.get("success"):
+                if entries:
+                    self._next_index[peer] = entries[-1].index + 1
+                    self._match_index[peer] = entries[-1].index
+            else:
+                # follower hints its last index to speed backtracking
+                hint = reply.get("last_index")
+                if hint is not None and hint + 1 < next_idx:
+                    self._next_index[peer] = hint + 1
+                else:
+                    self._next_index[peer] = max(1, next_idx - 1)
+                self._repl_events[peer].set()
+        if reply.get("success") and entries:
+            self._maybe_advance_commit()
+            with self._lock:
+                more = self._next_index.get(peer, 1) <= self.log.last_index()
+            if more:
+                self._repl_events[peer].set()
+
+    def _maybe_advance_commit(self) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            majority = len(self.peers) // 2 + 1
+            matches = sorted(
+                (self._match_index.get(p, 0) for p in self.peers),
+                reverse=True)
+            candidate = matches[majority - 1]
+            if candidate > self.commit_index and \
+                    self._term_at(candidate) == self.current_term:
+                self.commit_index = candidate
+                self._apply_cond.notify_all()
+
+    # -- apply loop ----------------------------------------------------
+    def _apply_loop(self) -> None:
+        while not self._shutdown.is_set():
+            with self._apply_cond:
+                while (self.last_applied >= self.commit_index
+                       and not self._shutdown.is_set()):
+                    self._apply_cond.wait(0.2)
+                if self._shutdown.is_set():
+                    return
+                start = self.last_applied + 1
+                end = self.commit_index
+            for idx in range(start, end + 1):
+                pend = None
+                # _fsm_lock serializes with InstallSnapshot: a concurrent
+                # restore must not interleave with entry application, and
+                # entries the snapshot already covers must be skipped.
+                with self._fsm_lock:
+                    with self._lock:
+                        if idx <= self.last_applied:
+                            continue        # snapshot advanced past us
+                        entry = self.log.get(idx)
+                    result, error = None, None
+                    if entry is not None and entry.type == "command":
+                        try:
+                            result = self.fsm.apply(entry.data)
+                        except Exception as e:   # noqa: BLE001
+                            error = e
+                    with self._lock:
+                        self.last_applied = idx
+                        pend = self._pending.pop(idx, None)
+                        if pend is not None and entry is not None and \
+                                pend.term != entry.term:
+                            # a different leader's entry landed at this
+                            # index: the original write was lost
+                            error = NotLeaderError(self.leader_id or "")
+                            result = None
+                if pend is not None:
+                    pend.result, pend.error = result, error
+                    pend.event.set()
+            self._maybe_snapshot()
+
+    def _maybe_snapshot(self) -> None:
+        with self._lock:
+            log_len = self.log.last_index() - self.log.first_index() + 1
+            if (self.log.first_index() == 0
+                    or log_len < self.snapshot_threshold):
+                return
+            last = self.last_applied
+            term = self._term_at(last) or self.current_term
+        with self._fsm_lock:
+            blob = self.fsm.snapshot()
+        self.snapshots.save(Snapshot(last_index=last, last_term=term,
+                                     state=blob))
+        with self._lock:
+            self._snap_last_index = last
+            self._snap_last_term = term
+            self.log.compact_to(last)
+
+    # -- RPC handlers (follower side) ----------------------------------
+    def _handle_request_vote(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "granted": False}
+            if term > self.current_term:
+                self._become_follower(term, None)
+            last_idx, last_term = self._last_log()
+            up_to_date = (msg["last_log_term"], msg["last_log_index"]) >= (
+                last_term, last_idx)
+            if up_to_date and self.voted_for in (None, msg["candidate"]):
+                self.voted_for = msg["candidate"]
+                self._save_meta()
+                self._election_deadline = self._rand_deadline()
+                return {"term": self.current_term, "granted": True}
+            return {"term": self.current_term, "granted": False}
+
+    def _handle_append_entries(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False,
+                        "last_index": self.log.last_index()}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term, msg["leader"])
+            self.leader_id = msg["leader"]
+            self._election_deadline = self._rand_deadline()
+
+            prev_index = msg["prev_log_index"]
+            prev_term = msg["prev_log_term"]
+            my_term = self._term_at(prev_index)
+            if my_term is None or my_term != prev_term:
+                return {"term": self.current_term, "success": False,
+                        "last_index": min(self.log.last_index(),
+                                          prev_index - 1)}
+            for e in msg["entries"]:
+                existing = self.log.get(e["index"])
+                if existing is not None:
+                    if existing.term == e["term"]:
+                        continue
+                    self.log.truncate_after(e["index"] - 1)
+                if self.log.first_index() == 0 and e["index"] > 1 and \
+                        self.log.last_index() + 1 != e["index"]:
+                    # empty log after snapshot restore: entries continue
+                    # from the snapshot point
+                    self.log.reset(e["index"])
+                self.log.append(LogEntry(index=e["index"], term=e["term"],
+                                         type=e["type"], data=e["data"]))
+            if msg["leader_commit"] > self.commit_index:
+                self.commit_index = min(msg["leader_commit"],
+                                        self.log.last_index())
+                self._apply_cond.notify_all()
+            return {"term": self.current_term, "success": True}
+
+    def _handle_install_snapshot(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term}
+            self._become_follower(term, msg["leader"])
+            self._election_deadline = self._rand_deadline()
+            if msg["last_index"] <= self._snap_last_index:
+                return {"term": self.current_term}
+        with self._fsm_lock:        # serialize with the apply loop
+            self.fsm.restore(msg["state"])
+            with self._lock:
+                self.snapshots.save(Snapshot(last_index=msg["last_index"],
+                                             last_term=msg["last_term"],
+                                             state=msg["state"]))
+                self._snap_last_index = msg["last_index"]
+                self._snap_last_term = msg["last_term"]
+                self.log.reset(msg["last_index"] + 1)
+                self.commit_index = max(self.commit_index, msg["last_index"])
+                self.last_applied = max(self.last_applied, msg["last_index"])
+        return {"term": self.current_term}

@@ -1,0 +1,163 @@
+"""TCP transport: length-prefixed JSON request/response RPC.
+
+The reference multiplexes msgpack-RPC over yamux on one TCP listener
+(reference: nomad/rpc.go:24,409) and runs raft on its own stream layer
+(server.go:1399). Equivalent here: one listener per server; each RPC is a
+fresh connection carrying a 4-byte big-endian length + JSON request, and
+the same framing back. Handlers are registered by message type; raft RPCs
+and server->leader forwarding share the transport.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+Addr = Tuple[str, int]
+_LEN = struct.Struct(">I")
+MAX_MSG = 256 << 20
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, 4))
+    if length > MAX_MSG:
+        raise ConnectionError(f"frame too large: {length}")
+    return json.loads(_recv_exact(sock, length))
+
+
+class TcpTransport:
+    """Listener + dispatcher. `register(msg_type, handler)` wires a
+    callable(dict) -> dict; `send(addr, msg)` performs one blocking RPC."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.addr: Addr = self._listener.getsockname()
+        self._handlers: Dict[str, Callable[[dict], dict]] = {}
+        self._shutdown = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        # outbound connection pool: one persistent conn per peer addr
+        # (reference: helper/pool ConnPool reuses yamux sessions)
+        self._pool: Dict[Addr, Tuple[socket.socket, threading.Lock]] = {}
+        self._pool_lock = threading.Lock()
+
+    def register(self, msg_type: str, handler: Callable[[dict], dict]) -> None:
+        self._handlers[msg_type] = handler
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"transport-{self.addr[1]}")
+        self._accept_thread.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._pool_lock:
+            for sock, _ in self._pool.values():
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._pool.clear()
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(30.0)
+                while not self._shutdown.is_set():
+                    try:
+                        msg = _recv_frame(conn)
+                    except (ConnectionError, socket.timeout, OSError,
+                            json.JSONDecodeError):
+                        return
+                    handler = self._handlers.get(msg.get("type", ""))
+                    if handler is None:
+                        reply = {"error": f"no handler: {msg.get('type')}"}
+                    else:
+                        try:
+                            reply = handler(msg)
+                        except Exception as e:  # noqa: BLE001
+                            reply = {"error": f"{type(e).__name__}: {e}"}
+                    try:
+                        _send_frame(conn, reply)
+                    except OSError:
+                        return
+        except Exception:       # pragma: no cover - defensive
+            pass
+
+    # ------------------------------------------------------------------
+    def send(self, addr: Addr, msg: dict, timeout: float = 5.0) -> dict:
+        """One blocking request/response RPC to `addr`. Reuses a pooled
+        connection per peer; a busy pooled conn falls back to an ephemeral
+        one so concurrent RPCs don't serialize."""
+        addr = tuple(addr)
+        with self._pool_lock:
+            entry = self._pool.get(addr)
+            if entry is None:
+                entry = (None, threading.Lock())
+                self._pool[addr] = entry
+        sock, lock = entry
+        if lock.acquire(blocking=False):
+            try:
+                if sock is None:
+                    sock = socket.create_connection(addr, timeout=timeout)
+                    with self._pool_lock:
+                        self._pool[addr] = (sock, lock)
+                try:
+                    sock.settimeout(timeout)
+                    _send_frame(sock, msg)
+                    return _recv_frame(sock)
+                except (OSError, ConnectionError, json.JSONDecodeError):
+                    # stale pooled conn: drop it and retry fresh once
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = socket.create_connection(addr, timeout=timeout)
+                    with self._pool_lock:
+                        self._pool[addr] = (sock, lock)
+                    sock.settimeout(timeout)
+                    _send_frame(sock, msg)
+                    return _recv_frame(sock)
+            finally:
+                lock.release()
+        # pooled conn busy: ephemeral connection
+        with socket.create_connection(addr, timeout=timeout) as tmp:
+            tmp.settimeout(timeout)
+            _send_frame(tmp, msg)
+            return _recv_frame(tmp)

@@ -1,0 +1,284 @@
+"""Multi-server topology: raft-replicated state + leader-gated services.
+
+Mirrors how the reference wires consensus under the server core
+(reference: nomad/server.go:1365 setupRaft, serf.go membership,
+leader.go:90 monitorLeadership, rpc.go forward -- non-leader servers
+forward writes to the leader). The key seam: `RaftBackedStateStore`
+exposes the exact StateStore write API but proposes every mutation through
+the raft log; the FSM applies committed entries into the real store on
+every server. `Server` (core.py), `Planner` and the workers run unmodified
+on top -- the same boundary the reference draws at raftApply.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..raft import (
+    FileLogStore, InMemLogStore, Membership, NotLeaderError, RaftNode,
+    StateFSM, TcpTransport,
+)
+from ..raft.fsm import encode_command
+from ..state import StateStore
+from ..structs import (
+    Allocation, DrainStrategy, Evaluation, Job, Node, codec,
+)
+from .core import Server
+
+
+class RaftBackedStateStore:
+    """Write API -> raft proposals; read API -> the local FSM-applied
+    store. The analog of the reference's raftApply(...) helpers that every
+    endpoint write path rides (reference: nomad/rpc.go raftApply)."""
+
+    def __init__(self, raft: RaftNode, store: StateStore):
+        self._raft = raft
+        self._store = store
+
+    def _propose(self, method: str, *args) -> Any:
+        return self._raft.apply(encode_command(method, args))
+
+    # -- replicated writes (signatures mirror StateStore) --------------
+    def upsert_node(self, node):
+        return self._propose("upsert_node", node)
+
+    def delete_node(self, node_id):
+        return self._propose("delete_node", node_id)
+
+    def update_node_status(self, node_id, status, ts=0.0):
+        return self._propose("update_node_status", node_id, status, ts)
+
+    def update_node_eligibility(self, node_id, eligibility):
+        return self._propose("update_node_eligibility", node_id, eligibility)
+
+    def update_node_drain(self, node_id, drain_strategy,
+                          mark_eligible: bool = False):
+        return self._propose("update_node_drain", node_id, drain_strategy,
+                             mark_eligible)
+
+    def upsert_job(self, job):
+        return self._propose("upsert_job", job)
+
+    def update_job_status(self, namespace, job_id, status):
+        return self._propose("update_job_status", namespace, job_id, status)
+
+    def delete_job(self, namespace, job_id):
+        return self._propose("delete_job", namespace, job_id)
+
+    def upsert_evals(self, evals):
+        return self._propose("upsert_evals", evals)
+
+    def delete_evals(self, eval_ids):
+        return self._propose("delete_evals", eval_ids)
+
+    def upsert_allocs(self, allocs):
+        return self._propose("upsert_allocs", allocs)
+
+    def update_allocs_from_client(self, allocs):
+        return self._propose("update_allocs_from_client", allocs)
+
+    def update_alloc_desired_transition(self, alloc_ids, migrate=True):
+        return self._propose("update_alloc_desired_transition", alloc_ids,
+                             migrate)
+
+    def delete_allocs(self, alloc_ids):
+        return self._propose("delete_allocs", alloc_ids)
+
+    def upsert_deployment(self, deployment):
+        return self._propose("upsert_deployment", deployment)
+
+    def upsert_deployment_cas(self, deployment, expected_modify_index):
+        return self._propose("upsert_deployment_cas", deployment,
+                             expected_modify_index)
+
+    def delete_deployment(self, deployment_id):
+        return self._propose("delete_deployment", deployment_id)
+
+    def upsert_node_pool(self, pool):
+        return self._propose("upsert_node_pool", pool)
+
+    def set_scheduler_config(self, cfg):
+        return self._propose("set_scheduler_config", cfg)
+
+    def upsert_plan_results(self, result, eval_updates=None):
+        return self._propose("upsert_plan_results", result, eval_updates)
+
+    # -- reads delegate to the applied local store ---------------------
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+# method -> (arg type specs, return type spec) for leader forwarding
+_FORWARD_SPECS: Dict[str, Tuple[List[Any], Any]] = {
+    "register_job": ([Job], Optional[Evaluation]),
+    "deregister_job": ([str, str, bool], Optional[Evaluation]),
+    "register_node": ([Node], type(None)),
+    "update_node_status": ([str, str], type(None)),
+    "heartbeat": ([str], float),
+    "drain_node": ([str, Optional[DrainStrategy]], type(None)),
+    "update_allocs_from_client": ([List[Allocation]], type(None)),
+}
+
+
+class ClusterServer(Server):
+    """One server of a raft cluster. Leader runs broker/workers/watchers;
+    followers replicate state and forward writes
+    (reference: nomad/server.go Server + rpc.go forwarding)."""
+
+    def __init__(self, name: str, peers: Optional[Dict[str, Tuple[str, int]]]
+                 = None, transport: Optional[TcpTransport] = None,
+                 data_dir: Optional[str] = None, num_workers: int = 2,
+                 heartbeat_ttl: float = 10.0,
+                 election_timeout: float = 0.25):
+        self.name = name
+        self.transport = transport or TcpTransport()
+        self.data_dir = data_dir
+        self.store = StateStore()           # FSM-applied local store
+        self.fsm = StateFSM(self.store)
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            log = FileLogStore(os.path.join(data_dir, "wal.jsonl"))
+        else:
+            log = InMemLogStore()
+        self.raft = RaftNode(
+            name, self.transport,
+            peers or {name: self.transport.addr}, self.fsm, log=log,
+            data_dir=data_dir, election_timeout=election_timeout)
+        super().__init__(num_workers=num_workers,
+                         heartbeat_ttl=heartbeat_ttl,
+                         state=RaftBackedStateStore(self.raft, self.store))
+        self.serf = Membership(name, self.transport,
+                               tags={"role": "server", "raft": "true"})
+        self.raft.on_leadership(self._on_leadership)
+        self.transport.register("server_rpc", self._handle_server_rpc)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.transport.start()
+        self.serf.start()
+        self.raft.start()
+        self._start_background()
+
+    def join(self, addr: Tuple[str, int]) -> int:
+        """Gossip-join an existing cluster member (reference: serf Join via
+        `nomad server join`)."""
+        return self.serf.join(addr)
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        self.raft.shutdown()
+        self.serf.shutdown()
+        self.transport.shutdown()
+
+    # -- leadership ----------------------------------------------------
+    def _on_leadership(self, is_leader: bool) -> None:
+        if is_leader:
+            # Barrier first: our FSM must reflect every commit from prior
+            # terms before restoring broker state (leader.go:357 region).
+            try:
+                self.raft.barrier(timeout=10.0)
+            except (NotLeaderError, TimeoutError):
+                return
+            self.establish_leadership()
+        else:
+            self.revoke_leadership()
+
+    # -- write forwarding (reference: rpc.go forward) ------------------
+    def _leader_call(self, method: str, args: tuple, timeout: float = 10.0):
+        arg_specs, ret_spec = _FORWARD_SPECS[method]
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.raft.is_leader():
+                # Run locally. A NotLeaderError mid-method propagates to
+                # the caller: some writes may already be committed, so
+                # silently re-executing on the new leader would duplicate
+                # them (e.g. double-bump a job version). The caller owns
+                # the retry, as with the reference's RPC error contract.
+                return getattr(Server, method)(self, *args)
+            lid, addr = self.raft.leader()
+            if addr is not None and lid != self.name:
+                try:
+                    reply = self.transport.send(tuple(addr), {
+                        "type": "server_rpc", "method": method,
+                        "args": [codec.encode(a) for a in args],
+                    }, timeout=min(5.0, timeout))
+                    if "error" not in reply:
+                        return codec.decode(ret_spec, reply.get("result"))
+                except (OSError, ConnectionError):
+                    pass
+            if time.monotonic() >= deadline:
+                raise NotLeaderError(lid or "", addr)
+            time.sleep(0.05)
+
+    def _handle_server_rpc(self, msg: dict) -> dict:
+        method = msg.get("method", "")
+        if method not in _FORWARD_SPECS:
+            return {"error": f"unknown method {method}"}
+        if not self.raft.is_leader():
+            lid, addr = self.raft.leader()
+            return {"error": "not leader", "leader": lid,
+                    "leader_addr": list(addr) if addr else None}
+        arg_specs, _ = _FORWARD_SPECS[method]
+        args = [codec.decode(spec, raw)
+                for spec, raw in zip(arg_specs, msg.get("args", []))]
+        result = getattr(Server, method)(self, *args)
+        return {"result": codec.encode(result)}
+
+    # -- forwarded endpoints -------------------------------------------
+    def register_job(self, job: Job):
+        return self._leader_call("register_job", (job,))
+
+    def deregister_job(self, namespace: str, job_id: str,
+                       purge: bool = False):
+        return self._leader_call("deregister_job",
+                                 (namespace, job_id, purge))
+
+    def register_node(self, node: Node):
+        return self._leader_call("register_node", (node,))
+
+    def update_node_status(self, node_id: str, status: str):
+        return self._leader_call("update_node_status", (node_id, status))
+
+    def heartbeat(self, node_id: str):
+        return self._leader_call("heartbeat", (node_id,))
+
+    def drain_node(self, node_id: str, strategy):
+        return self._leader_call("drain_node", (node_id, strategy))
+
+    def update_allocs_from_client(self, allocs):
+        return self._leader_call("update_allocs_from_client", (allocs,))
+
+
+# ---------------------------------------------------------------------------
+# in-process test/dev cluster (reference: nomad/testing.go TestServer :43 +
+# TestJoin :184 -- multi-server clusters in one process)
+
+def make_cluster(n: int, data_dirs: Optional[List[str]] = None,
+                 num_workers: int = 1,
+                 election_timeout: float = 0.15) -> List[ClusterServer]:
+    transports = [TcpTransport() for _ in range(n)]
+    peers = {f"server-{i}": t.addr for i, t in enumerate(transports)}
+    servers = []
+    for i in range(n):
+        servers.append(ClusterServer(
+            f"server-{i}", peers=peers, transport=transports[i],
+            data_dir=data_dirs[i] if data_dirs else None,
+            num_workers=num_workers, election_timeout=election_timeout))
+    for s in servers:
+        s.start()
+    for s in servers[1:]:
+        s.join(servers[0].transport.addr)
+    return servers
+
+
+def wait_for_leader(servers: List[ClusterServer], timeout: float = 10.0
+                    ) -> ClusterServer:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for s in servers:
+            if s.raft.is_leader() and s.is_leader():
+                return s
+        time.sleep(0.02)
+    raise TimeoutError("no leader elected")

@@ -42,10 +42,10 @@ class Server:
 
     def __init__(self, num_workers: Optional[int] = None,
                  heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
-                 logger=None):
+                 logger=None, state=None):
         import os
         self.logger = logger
-        self.state = StateStore()
+        self.state = state if state is not None else StateStore()
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
         self.planner = Planner(self.state)
@@ -59,16 +59,17 @@ class Server:
         self._events: List[dict] = []
         self._events_lock = threading.Lock()
         self._periodic_last: Dict[tuple, float] = {}
+        self._leader_active = threading.Event()
+        self._leader_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        """Boot + establish leadership (reference: leader.go:357)."""
-        self.broker.set_enabled(True)
-        self.blocked_evals.set_enabled(True)
-        for i in range(self.num_workers):
-            w = Worker(self, i)
-            w.start()
-            self.workers.append(w)
+        """Boot; the dev single-server topology is immediately the leader
+        (reference: server boot + monitorLeadership leader.go:90)."""
+        self._start_background()
+        self.establish_leadership()
+
+    def _start_background(self) -> None:
         for fn, name in ((self._run_heartbeat_watcher, "heartbeat"),
                          (self._run_gc, "core-gc"),
                          (self._run_periodic, "periodic"),
@@ -76,6 +77,81 @@ class Server:
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
             self._threads.append(t)
+
+    def establish_leadership(self) -> None:
+        """(reference: leader.go:357 establishLeadership -- enable broker
+        and plan queue, restore evals from state :403, start workers)."""
+        with self._leader_lock:
+            if self._leader_active.is_set():
+                return
+            self.broker.set_enabled(True)
+            self.blocked_evals.set_enabled(True)
+            self._restore_evals()
+            self._initialize_heartbeat_timers()
+            self._restore_periodic_launch_times()
+            for i in range(self.num_workers):
+                w = Worker(self, i)
+                w.start()
+                self.workers.append(w)
+            self._leader_active.set()
+
+    def revoke_leadership(self) -> None:
+        """(reference: leader.go revokeLeadership -- drain workers, disable
+        broker; in-flight evals are nacked back by their workers)."""
+        with self._leader_lock:
+            if not self._leader_active.is_set():
+                return
+            self._leader_active.clear()
+            for w in self.workers:
+                w.stop()
+            self.workers = []
+            self.broker.set_enabled(False)
+            self.blocked_evals.set_enabled(False)
+            with self._hb_lock:
+                self._heartbeat_deadlines.clear()
+            self._periodic_last.clear()
+
+    def _restore_evals(self) -> None:
+        """Re-populate broker/blocked-evals from replicated state
+        (reference: leader.go:403 restoreEvals)."""
+        for ev in self.state.evals():
+            if ev.status == EVAL_STATUS_BLOCKED:
+                self.blocked_evals.block(ev)
+            elif ev.should_enqueue():
+                self.broker.enqueue(ev)
+
+    def _initialize_heartbeat_timers(self) -> None:
+        """A fresh leader owns node liveness: every non-down node gets a
+        full TTL to check in (reference: heartbeat.go:59
+        initializeHeartbeatTimers)."""
+        now = time.time()
+        with self._hb_lock:
+            for node in self.state.nodes():
+                if node.status not in (NODE_STATUS_DOWN,
+                                       NODE_STATUS_DISCONNECTED):
+                    self._heartbeat_deadlines[node.id] = (
+                        now + self.heartbeat_ttl)
+
+    def _restore_periodic_launch_times(self) -> None:
+        """Recover last-dispatch times from the periodic children already
+        in replicated state so failover doesn't re-dispatch mid-interval
+        (reference: periodic.go restores LaunchTime from state)."""
+        for job in self.state.jobs():
+            if not job.parent_id or "/periodic-" not in job.id:
+                continue
+            try:
+                launched = float(job.id.rsplit("/periodic-", 1)[1])
+            except ValueError:
+                continue
+            parent = self.state.job_by_id(job.namespace, job.parent_id)
+            if parent is None:
+                continue
+            key = (job.namespace, job.parent_id)
+            self._periodic_last[key] = max(
+                self._periodic_last.get(key, 0.0), launched)
+
+    def is_leader(self) -> bool:
+        return self._leader_active.is_set()
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -318,6 +394,8 @@ class Server:
         :138): a missed TTL marks the node down/disconnected and creates
         evals for its workloads."""
         while not self._shutdown.wait(0.2):
+            if not self._leader_active.is_set():
+                continue
             now = time.time()
             expired = []
             with self._hb_lock:
@@ -346,7 +424,8 @@ class Server:
     def _run_gc(self) -> None:
         """Core GC job (reference: core_sched.go evalGC :236, nodeGC :423)."""
         while not self._shutdown.wait(GC_INTERVAL):
-            self.run_gc_once()
+            if self._leader_active.is_set():
+                self.run_gc_once()
 
     def run_gc_once(self, threshold: float = GC_EVAL_THRESHOLD) -> dict:
         cutoff = time.time() - threshold
@@ -383,6 +462,8 @@ class Server:
         """Cron-style launcher (reference: periodic.go:25). Supports
         '@every <N>s' specs; full cron parsing is a later round."""
         while not self._shutdown.wait(0.5):
+            if not self._leader_active.is_set():
+                continue
             now = time.time()
             for job in self.state.jobs():
                 if not job.is_periodic() or job.stop:
@@ -422,6 +503,8 @@ class Server:
         reconciler's max_parallel gate releases the next batch
         (reference: nomad/deploymentwatcher/deployments_watcher.go)."""
         while not self._shutdown.wait(0.3):
+            if not self._leader_active.is_set():
+                continue
             for d in self.state.deployments():
                 if not d.active() or d.status != DEPLOYMENT_STATUS_RUNNING:
                     continue

@@ -1,4 +1,5 @@
 """Data model for nomad-tpu (reference: /root/reference/nomad/structs/)."""
+from . import codec  # noqa: F401
 from .resources import (  # noqa: F401
     AllocatedDeviceResource, AllocatedPortMapping, AllocatedResources,
     AllocatedSharedResources, AllocatedTaskResources, ComparableResources,

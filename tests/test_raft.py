@@ -1,0 +1,335 @@
+"""Consensus layer tests: codec, log store, raft core, multi-server cluster.
+
+Mirrors the reference's in-process multi-server integration pattern
+(reference: nomad/testing.go:43 TestServer + TestJoin :184 -- raft
+leadership, replication and plan application tested in one process).
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft import (
+    FileLogStore, InMemLogStore, LogEntry, RaftNode, StateFSM, TcpTransport,
+)
+from nomad_tpu.raft.fsm import dump_state, restore_state
+from nomad_tpu.server.cluster import (
+    ClusterServer, make_cluster, wait_for_leader,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import codec
+from nomad_tpu.structs import (
+    Allocation, Evaluation, Job, Node, ALLOC_CLIENT_RUNNING,
+    NODE_STATUS_READY,
+)
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+def test_codec_roundtrip_job():
+    job = mock.job(id="codec-job")
+    data = codec.encode(job)
+    back = codec.decode(Job, data)
+    assert back.id == job.id
+    assert back.task_groups[0].name == job.task_groups[0].name
+    assert back.task_groups[0].count == job.task_groups[0].count
+    assert (back.task_groups[0].tasks[0].resources.cpu ==
+            job.task_groups[0].tasks[0].resources.cpu)
+    # nested restart policy survives
+    assert (back.task_groups[0].restart_policy.attempts ==
+            job.task_groups[0].restart_policy.attempts)
+
+
+def test_codec_roundtrip_node_and_eval():
+    node = mock.node()
+    back = codec.decode(Node, codec.encode(node))
+    assert back.id == node.id
+    assert back.node_resources.cpu.cpu_shares == \
+        node.node_resources.cpu.cpu_shares
+    ev = Evaluation(id="e1", namespace="default", priority=50,
+                    type="service", job_id="j1", status="pending")
+    back_ev = codec.decode(Evaluation, codec.encode(ev))
+    assert back_ev.id == "e1" and back_ev.priority == 50
+
+
+# ---------------------------------------------------------------------------
+# log store
+
+def test_file_log_store_recovery(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    log = FileLogStore(path)
+    for i in range(1, 6):
+        log.append(LogEntry(index=i, term=1, type="command",
+                            data={"k": i}))
+    log.truncate_after(4)
+    log.append(LogEntry(index=5, term=2, type="command", data={"k": 50}))
+    log.close()
+
+    log2 = FileLogStore(path)
+    assert log2.last_index() == 5
+    assert log2.get(5).data == {"k": 50}
+    assert log2.get(5).term == 2
+    assert log2.get(3).data == {"k": 3}
+    log2.compact_to(3)
+    assert log2.first_index() == 4
+    log2.close()
+
+    log3 = FileLogStore(path)
+    assert log3.first_index() == 4
+    assert log3.last_index() == 5
+    log3.close()
+
+
+# ---------------------------------------------------------------------------
+# fsm snapshot/restore
+
+def test_state_dump_restore():
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(node)
+    job = mock.job(id="dump-job")
+    store.upsert_job(job)
+    ev = Evaluation(id="ev-1" + "0" * 28, namespace="default", priority=50,
+                    type="service", job_id=job.id, status="pending")
+    store.upsert_evals([ev])
+    blob = dump_state(store)
+
+    fresh = StateStore()
+    restore_state(fresh, blob)
+    assert fresh.node_by_id(node.id) is not None
+    assert fresh.job_by_id("default", "dump-job") is not None
+    assert fresh.eval_by_id(ev.id) is not None
+    assert fresh.latest_index() == store.latest_index()
+
+
+# ---------------------------------------------------------------------------
+# raft core
+
+class CountingFSM:
+    def __init__(self):
+        self.applied = []
+
+    def apply(self, data):
+        self.applied.append(data)
+        return len(self.applied)
+
+    def snapshot(self):
+        return list(self.applied)
+
+    def restore(self, blob):
+        self.applied = list(blob)
+
+
+def _make_raft_cluster(n, **kw):
+    transports = [TcpTransport() for _ in range(n)]
+    peers = {f"n{i}": t.addr for i, t in enumerate(transports)}
+    fsms = [CountingFSM() for _ in range(n)]
+    nodes = [RaftNode(f"n{i}", transports[i], peers, fsms[i],
+                      election_timeout=0.15, **kw) for i in range(n)]
+    for t in transports:
+        t.start()
+    for r in nodes:
+        r.start()
+    return nodes, fsms, transports
+
+
+def _leader_of(nodes, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [r for r in nodes if r.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise TimeoutError("no single leader")
+
+
+def _stop_all(nodes, transports):
+    for r in nodes:
+        r.shutdown()
+    for t in transports:
+        t.shutdown()
+
+
+def test_raft_elects_and_replicates():
+    nodes, fsms, transports = _make_raft_cluster(3)
+    try:
+        leader = _leader_of(nodes)
+        for i in range(5):
+            result = leader.apply({"op": i})
+            assert result == i + 1          # FSM result returned to caller
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if all(len(f.applied) == 5 for f in fsms):
+                break
+            time.sleep(0.02)
+        assert all(f.applied == [{"op": i} for i in range(5)]
+                   for f in fsms), [f.applied for f in fsms]
+    finally:
+        _stop_all(nodes, transports)
+
+
+def test_raft_failover():
+    nodes, fsms, transports = _make_raft_cluster(3)
+    try:
+        leader = _leader_of(nodes)
+        leader.apply({"op": "before"})
+        # kill the leader
+        leader.shutdown()
+        transports[nodes.index(leader)].shutdown()
+        remaining = [r for r in nodes if r is not leader]
+        new_leader = _leader_of(remaining)
+        assert new_leader is not leader
+        new_leader.apply({"op": "after"})
+        live_fsms = [fsms[nodes.index(r)] for r in remaining]
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if all({"op": "after"} in f.applied for f in live_fsms):
+                break
+            time.sleep(0.02)
+        for f in live_fsms:
+            assert f.applied[0] == {"op": "before"}
+            assert f.applied[-1] == {"op": "after"}
+    finally:
+        _stop_all(nodes, transports)
+
+
+def test_raft_snapshot_compaction():
+    nodes, fsms, transports = _make_raft_cluster(3, snapshot_threshold=10)
+    try:
+        leader = _leader_of(nodes)
+        for i in range(30):
+            leader.apply({"op": i})
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if leader.stats()["snapshot_index"] > 0:
+                break
+            time.sleep(0.05)
+        assert leader.stats()["snapshot_index"] > 0
+        assert leader.log.first_index() > 1    # prefix compacted
+        # cluster still works after compaction
+        leader.apply({"op": "post-snap"})
+    finally:
+        _stop_all(nodes, transports)
+
+
+def test_raft_not_leader_error():
+    nodes, fsms, transports = _make_raft_cluster(3)
+    try:
+        leader = _leader_of(nodes)
+        follower = next(r for r in nodes if r is not leader)
+        from nomad_tpu.raft import NotLeaderError
+        with pytest.raises(NotLeaderError):
+            follower.apply({"op": "x"})
+    finally:
+        _stop_all(nodes, transports)
+
+
+# ---------------------------------------------------------------------------
+# full cluster servers
+
+@pytest.fixture
+def cluster():
+    servers = make_cluster(3, num_workers=1)
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+def _wait(predicate, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_cluster_schedules_and_replicates(cluster):
+    leader = wait_for_leader(cluster)
+    follower = next(s for s in cluster if s is not leader)
+
+    # register fleet through the leader
+    for i in range(4):
+        n = mock.node()
+        n.id = f"cluster-node-{i:02d}" + "0" * 17
+        n.compute_class()
+        leader.register_node(n)
+
+    # job registered via a FOLLOWER must forward to the leader and place
+    job = mock.job(id="cluster-job")
+    job.task_groups[0].count = 3
+    ev = follower.register_job(job)
+    assert ev is not None
+
+    assert _wait(lambda: len([
+        a for a in leader.state.allocs()
+        if a.job_id == "cluster-job"]) == 3), leader.state.allocs()
+
+    # replication: every server's local store converges
+    assert _wait(lambda: all(
+        len(s.store.allocs_by_job("default", "cluster-job")) == 3
+        for s in cluster))
+    # membership converged too
+    assert _wait(lambda: all(
+        len(s.serf.alive_members()) == 3 for s in cluster))
+
+
+def test_cluster_leader_failover_reschedules(cluster):
+    leader = wait_for_leader(cluster)
+    for i in range(3):
+        n = mock.node()
+        n.id = f"failover-node-{i:02d}" + "0" * 16
+        n.compute_class()
+        leader.register_node(n)
+    job = mock.job(id="failover-job")
+    job.task_groups[0].count = 2
+    leader.register_job(job)
+    assert _wait(lambda: len(leader.state.allocs_by_job(
+        "default", "failover-job")) == 2)
+
+    # leader dies; a new leader must take over and keep scheduling
+    leader.shutdown()
+    rest = [s for s in cluster if s is not leader]
+    new_leader = wait_for_leader(rest)
+    job2 = mock.job(id="post-failover-job")
+    job2.task_groups[0].count = 2
+    new_leader.register_job(job2)
+    assert _wait(lambda: len(new_leader.state.allocs_by_job(
+        "default", "post-failover-job")) == 2), \
+        new_leader.state.allocs()
+
+
+def test_cluster_persistence(tmp_path):
+    dirs = [str(tmp_path / f"s{i}") for i in range(3)]
+    servers = make_cluster(3, data_dirs=dirs, num_workers=1)
+    try:
+        leader = wait_for_leader(servers)
+        n = mock.node()
+        n.id = "persist-node-00" + "0" * 17
+        n.compute_class()
+        leader.register_node(n)
+        job = mock.job(id="persist-job")
+        job.task_groups[0].count = 1
+        leader.register_job(job)
+        assert _wait(lambda: len(leader.state.allocs_by_job(
+            "default", "persist-job")) == 1)
+        applied = leader.store.latest_index()
+    finally:
+        for s in servers:
+            s.shutdown()
+    time.sleep(0.2)
+
+    # restart from the WALs: state must recover without the network
+    servers2 = make_cluster(3, data_dirs=dirs, num_workers=1)
+    try:
+        leader2 = wait_for_leader(servers2)
+        assert _wait(lambda: leader2.store.job_by_id(
+            "default", "persist-job") is not None)
+        assert len(leader2.store.allocs_by_job(
+            "default", "persist-job")) == 1
+        assert leader2.store.node_by_id(n.id) is not None
+    finally:
+        for s in servers2:
+            s.shutdown()
