@@ -1,2 +1,12 @@
 """Node agent (reference: /root/reference/client/)."""
 from .agent import SimClient  # noqa: F401
+from .alloc_runner import AllocRunner  # noqa: F401
+from .allocdir import AllocDir, TaskDir  # noqa: F401
+from .client import Client, LocalServerConn, ServerConn  # noqa: F401
+from .drivers import (  # noqa: F401
+    Driver, DriverRegistry, ExecDriver, MockDriver, RawExecDriver,
+    TaskHandle,
+)
+from .fingerprint import FingerprintManager  # noqa: F401
+from .state_db import StateDB  # noqa: F401
+from .task_runner import TaskRunner, TaskState  # noqa: F401

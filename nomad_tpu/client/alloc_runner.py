@@ -1,0 +1,293 @@
+"""AllocRunner: per-allocation lifecycle over its TaskRunners.
+
+Semantic parity with /root/reference/client/allocrunner/ (alloc_runner.go:353
+Run; hook pipeline alloc_runner_hooks.go -- allocdir, network, upstream
+allocs, checks, health health_hook.go; task lifecycle ordering
+tasklifecycle/ -- prestart hooks run before main tasks, leader failure
+kills followers; client alloc status aggregation alloc_runner.go
+clientStatus derivation).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs import (
+    AllocDeploymentStatus, Allocation,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING,
+)
+from .allocdir import AllocDir
+from .drivers import DriverRegistry, TASK_STATE_DEAD, TASK_STATE_RUNNING
+from .task_runner import TaskRunner, TaskState
+
+
+class AllocRunner:
+    """(reference: client/allocrunner/alloc_runner.go)"""
+
+    def __init__(self, alloc: Allocation, drivers: DriverRegistry,
+                 data_dir: str, node=None,
+                 on_update: Optional[Callable[["AllocRunner"], None]] = None,
+                 identity_signer=None):
+        self.alloc = alloc
+        self.drivers = drivers
+        self.node = node
+        self.on_update = on_update
+        self.identity_signer = identity_signer
+        self.alloc_dir = AllocDir(data_dir, alloc.id)
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self.client_status = ALLOC_CLIENT_PENDING
+        self.client_description = ""
+        self.deployment_health: Optional[bool] = None
+        self._deployment_healthy_at = 0.0
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._update_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name=f"alloc-{self.alloc.id[:8]}")
+        self._thread.start()
+
+    def run(self) -> None:
+        """(reference: alloc_runner.go:353 Run -- pre-run hooks, task
+        runners by lifecycle phase, post-run)."""
+        try:
+            self.alloc_dir.build()      # allocdir hook
+        except OSError as e:
+            self._set_status(ALLOC_CLIENT_FAILED, f"allocdir: {e}")
+            self._done.set()
+            return
+        tg = (self.alloc.job.lookup_task_group(self.alloc.task_group)
+              if self.alloc.job else None)
+        if tg is None or not tg.tasks:
+            self._set_status(ALLOC_CLIENT_FAILED, "task group not found")
+            self._done.set()
+            return
+
+        prestart = [t for t in tg.tasks if t.lifecycle
+                    and t.lifecycle.get("hook") == "prestart"
+                    and not t.lifecycle.get("sidecar")]
+        sidecars = [t for t in tg.tasks if t.lifecycle
+                    and t.lifecycle.get("sidecar")]
+        main = [t for t in tg.tasks if not t.lifecycle]
+        poststop = [t for t in tg.tasks if t.lifecycle
+                    and t.lifecycle.get("hook") == "poststop"]
+
+        def mk_runner(task) -> TaskRunner:
+            tr = TaskRunner(
+                self.alloc, task, self.drivers.get(task.driver),
+                self.alloc_dir, node=self.node,
+                restart_policy=tg.restart_policy,
+                on_state_change=lambda _tr: self._on_task_change(),
+                identity_signer=self.identity_signer)
+            self.task_runners[task.name] = tr
+            return tr
+
+        # prestart (non-sidecar) tasks run to completion first
+        # (reference: tasklifecycle coordinator)
+        for task in prestart:
+            tr = mk_runner(task)
+            tr.start()
+            tr.wait()
+            if tr.state.failed:
+                self._set_status(ALLOC_CLIENT_FAILED,
+                                 f"prestart task {task.name} failed")
+                self._done.set()
+                self._notify()
+                return
+        if self._kill.is_set():
+            # stopped/destroyed during prestart: don't launch main tasks
+            self._finalize_status(stopped=True)
+            self._done.set()
+            self._notify()
+            return
+        for task in sidecars + main:
+            mk_runner(task).start()
+        if self._kill.is_set():
+            # stop raced task launch: reap everything we just started
+            for tr in self.task_runners.values():
+                tr.kill()
+        self._set_status(ALLOC_CLIENT_RUNNING, "tasks are running")
+        self._notify()
+
+        main_runners = [self.task_runners[t.name] for t in main]
+        leader_names = {t.name for t in main if t.leader}
+        while not self._kill.is_set():
+            if all(tr.state.state == TASK_STATE_DEAD
+                   for tr in main_runners):
+                break
+            # leader death kills followers (reference: task leader logic)
+            if leader_names and any(
+                    tr.state.state == TASK_STATE_DEAD
+                    for tr in main_runners
+                    if tr.task.name in leader_names):
+                for tr in main_runners:
+                    if tr.state.state != TASK_STATE_DEAD:
+                        tr.kill()
+                break
+            time.sleep(0.05)
+        # kill sidecars once main tasks are done; on stop/destroy kill
+        # every still-running task, main included
+        if self._kill.is_set():
+            for tr in self.task_runners.values():
+                if tr.state.state != TASK_STATE_DEAD:
+                    tr.kill()
+        for t in sidecars:
+            self.task_runners[t.name].kill()
+        for task in poststop:
+            if not self._kill.is_set():
+                tr = self.task_runners.get(task.name) or mk_runner(task)
+                tr.start()
+                tr.wait()
+        self._finalize_status()
+        self._done.set()
+        self._notify()
+
+    def destroy(self, timeout: float = 10.0) -> None:
+        """Kill everything and remove the alloc dir
+        (reference: alloc_runner Destroy)."""
+        self._kill.set()
+        for tr in self.task_runners.values():
+            tr.kill()
+        self._done.wait(timeout)
+        self.alloc_dir.destroy()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Graceful stop, alloc dir kept for inspection."""
+        self._kill.set()
+        for tr in self.task_runners.values():
+            tr.kill()
+        self._done.wait(timeout)
+        self._finalize_status(stopped=True)
+        self._notify()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    # -- restore (reference: alloc_runner.go:455 Restore) --------------
+    def restore(self, task_states: Dict[str, TaskState],
+                handles: Dict[str, object]) -> bool:
+        """Re-attach task runners to live tasks. Returns True if any task
+        was recovered running."""
+        self.alloc_dir.build()
+        tg = (self.alloc.job.lookup_task_group(self.alloc.task_group)
+              if self.alloc.job else None)
+        if tg is None:
+            return False
+        any_live = False
+        for task in tg.tasks:
+            st = task_states.get(task.name)
+            if st is None:
+                continue
+            tr = TaskRunner(
+                self.alloc, task, self.drivers.get(task.driver),
+                self.alloc_dir, node=self.node,
+                restart_policy=tg.restart_policy,
+                on_state_change=lambda _tr: self._on_task_change(),
+                identity_signer=self.identity_signer)
+            self.task_runners[task.name] = tr
+            if tr.restore(st, handles.get(task.name)):
+                any_live = True
+        if any_live:
+            self.client_status = ALLOC_CLIENT_RUNNING
+            self._thread = threading.Thread(
+                target=self._watch_restored, daemon=True,
+                name=f"alloc-restored-{self.alloc.id[:8]}")
+            self._thread.start()
+        else:
+            # nothing recovered: the alloc terminated while we were down --
+            # the server must hear about it or it will never reschedule
+            self._finalize_status()
+            self._done.set()
+            self._notify()
+        return any_live
+
+    def _watch_restored(self) -> None:
+        while not self._kill.is_set():
+            if all(tr.state.state == TASK_STATE_DEAD
+                   for tr in self.task_runners.values()):
+                break
+            time.sleep(0.05)
+        self._finalize_status()
+        self._done.set()
+        self._notify()
+
+    # -- health (reference: allocrunner/health_hook.go +
+    #    allochealth/tracker.go) --------------------------------------
+    def check_health(self, min_healthy_time: float) -> Optional[bool]:
+        """None = still deciding; True/False once decided. Healthy when
+        every task has been running for min_healthy_time; unhealthy when
+        any task failed."""
+        if self.deployment_health is not None:
+            return self.deployment_health
+        if self.client_status == ALLOC_CLIENT_FAILED or any(
+                tr.state.failed for tr in self.task_runners.values()):
+            self.deployment_health = False
+            return False
+        runners = list(self.task_runners.values())
+        if not runners:
+            return None
+        now = time.time()
+        if all(tr.state.state == TASK_STATE_RUNNING
+               and tr.state.restarts == 0
+               and now - tr.state.started_at >= min_healthy_time
+               for tr in runners):
+            self.deployment_health = True
+            return True
+        return None
+
+    # -- status aggregation (reference: alloc_runner.go clientStatus) --
+    def _on_task_change(self) -> None:
+        with self._update_lock:
+            runners = list(self.task_runners.values())
+            if any(tr.state.state == TASK_STATE_DEAD and tr.state.failed
+                   for tr in runners):
+                self._set_status(ALLOC_CLIENT_FAILED, "a task failed")
+            elif any(tr.state.state == TASK_STATE_RUNNING
+                     for tr in runners):
+                self._set_status(ALLOC_CLIENT_RUNNING, "tasks are running")
+        self._notify()
+
+    def _finalize_status(self, stopped: bool = False) -> None:
+        runners = list(self.task_runners.values())
+        if any(tr.state.failed for tr in runners) and not stopped:
+            self._set_status(ALLOC_CLIENT_FAILED, "a task failed")
+        else:
+            self._set_status(ALLOC_CLIENT_COMPLETE,
+                             "all tasks have completed")
+
+    def _set_status(self, status: str, desc: str) -> None:
+        self.client_status = status
+        self.client_description = desc
+
+    def _notify(self) -> None:
+        if self.on_update is not None:
+            try:
+                self.on_update(self)
+            except Exception:   # noqa: BLE001
+                pass
+
+    # -- snapshot for the server update (reference: Node.UpdateAlloc) --
+    def client_update(self) -> Allocation:
+        upd = Allocation(
+            id=self.alloc.id, namespace=self.alloc.namespace,
+            node_id=self.alloc.node_id, job_id=self.alloc.job_id,
+            task_group=self.alloc.task_group)
+        upd.client_status = self.client_status
+        upd.client_description = self.client_description
+        upd.task_states = {
+            name: {"state": tr.state.state, "failed": tr.state.failed,
+                   "restarts": tr.state.restarts,
+                   "started_at": tr.state.started_at,
+                   "finished_at": tr.state.finished_at}
+            for name, tr in self.task_runners.items()}
+        if self.client_status == ALLOC_CLIENT_FAILED:
+            upd.client_terminal_time = time.time()
+        if self.alloc.deployment_id and self.deployment_health is not None:
+            upd.deployment_status = AllocDeploymentStatus(
+                healthy=self.deployment_health, timestamp=time.time())
+        return upd

@@ -1,0 +1,69 @@
+"""Allocation directory layout.
+
+Semantic parity with /root/reference/client/allocdir/ (alloc_dir.go:
+SharedAllocDir `alloc/` with data/logs/tmp, per-task dirs with
+local/secrets/tmp). No chroot builds -- task isolation is the driver's
+concern; the layout contract (NOMAD_ALLOC_DIR, NOMAD_TASK_DIR,
+NOMAD_SECRETS_DIR) is what tasks and the log shipper rely on.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+
+SHARED_ALLOC = "alloc"
+TASK_LOCAL = "local"
+TASK_SECRETS = "secrets"
+TASK_TMP = "tmp"
+
+
+class AllocDir:
+    """(reference: client/allocdir/alloc_dir.go AllocDir)"""
+
+    def __init__(self, base: str, alloc_id: str):
+        self.alloc_dir = os.path.join(base, alloc_id)
+        self.shared_dir = os.path.join(self.alloc_dir, SHARED_ALLOC)
+
+    def build(self) -> None:
+        for sub in ("data", "logs", "tmp"):
+            os.makedirs(os.path.join(self.shared_dir, sub), exist_ok=True)
+
+    def new_task_dir(self, task_name: str) -> "TaskDir":
+        td = TaskDir(self, task_name)
+        td.build()
+        return td
+
+    def log_dir(self) -> str:
+        return os.path.join(self.shared_dir, "logs")
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.alloc_dir)
+
+
+class TaskDir:
+    """(reference: client/allocdir/task_dir.go)"""
+
+    def __init__(self, alloc_dir: AllocDir, task_name: str):
+        self.alloc = alloc_dir
+        self.task_name = task_name
+        self.dir = os.path.join(alloc_dir.alloc_dir, task_name)
+        self.local_dir = os.path.join(self.dir, TASK_LOCAL)
+        self.secrets_dir = os.path.join(self.dir, TASK_SECRETS)
+        self.tmp_dir = os.path.join(self.dir, TASK_TMP)
+
+    def build(self) -> None:
+        for d in (self.local_dir, self.secrets_dir, self.tmp_dir):
+            os.makedirs(d, exist_ok=True)
+
+    def stdout_path(self) -> str:
+        return os.path.join(self.alloc.log_dir(),
+                            f"{self.task_name}.stdout.0")
+
+    def stderr_path(self) -> str:
+        return os.path.join(self.alloc.log_dir(),
+                            f"{self.task_name}.stderr.0")

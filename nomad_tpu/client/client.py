@@ -1,0 +1,297 @@
+"""Client agent: registration, heartbeats, alloc watch loop, restore, GC.
+
+Semantic parity with /root/reference/client/client.go (NewClient :350,
+registerAndHeartbeat :1734, watchAllocations :2280 -- blocking
+Node.GetClientAllocs pull, runAllocs :2538 -- diff desired vs running,
+restoreState :1215 -- re-attach via driver handles, heartbeatstop.go --
+stop_after_client_disconnect). The server boundary is the `ServerConn`
+interface: in-process for the dev topology, HTTP for real deployments --
+the client is pull-based either way, which is what makes 10K-node fleets
+tractable (no server->client push).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..structs import (
+    Allocation, Node,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_DESIRED_RUN,
+)
+from .alloc_runner import AllocRunner
+from .drivers import DriverRegistry
+from .fingerprint import FingerprintManager
+from .state_db import StateDB
+
+
+class ServerConn:
+    """Client->server RPC surface (reference: client/rpc.go +
+    servers manager client/servers/)."""
+
+    def register_node(self, node: Node) -> None:
+        raise NotImplementedError
+
+    def heartbeat(self, node_id: str) -> float:
+        raise NotImplementedError
+
+    def pull_allocs(self, node_id: str, min_index: int,
+                    timeout: float) -> tuple:
+        """Blocking pull -> (allocs, index)
+        (reference: Node.GetClientAllocs node_endpoint.go:1170)."""
+        raise NotImplementedError
+
+    def update_allocs(self, updates: List[Allocation]) -> None:
+        raise NotImplementedError
+
+    def get_alloc(self, alloc_id: str) -> Optional[Allocation]:
+        raise NotImplementedError
+
+
+class LocalServerConn(ServerConn):
+    """In-process server (dev agent topology)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def register_node(self, node: Node) -> None:
+        self.server.register_node(node)
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.server.heartbeat(node_id)
+
+    def pull_allocs(self, node_id: str, min_index: int,
+                    timeout: float) -> tuple:
+        index = self.server.state.block_until(min_index, timeout=timeout,
+                                              tables=("allocs",))
+        return self.server.state.allocs_by_node(node_id), index
+
+    def update_allocs(self, updates: List[Allocation]) -> None:
+        self.server.update_allocs_from_client(updates)
+
+    def get_alloc(self, alloc_id: str) -> Optional[Allocation]:
+        return self.server.state.alloc_by_id(alloc_id)
+
+
+MAX_TERMINAL_RUNNERS = 50     # client GC watermark (reference: client/gc.go)
+
+
+class Client:
+    """(reference: client/client.go Client)"""
+
+    def __init__(self, conn: ServerConn, data_dir: str,
+                 node: Optional[Node] = None, name: str = "",
+                 drivers: Optional[DriverRegistry] = None,
+                 probe_jax: bool = False, identity_signer=None):
+        self.conn = conn
+        self.data_dir = data_dir
+        self.drivers = drivers or DriverRegistry()
+        self.state_db = StateDB(data_dir)
+        self.identity_signer = identity_signer
+        fm = FingerprintManager(data_dir=data_dir, probe_jax=probe_jax)
+        self.node = fm.fingerprint_node(node=node, name=name)
+        # driver fingerprints -> node.drivers (reference: drivermanager)
+        from ..structs import DriverInfo
+        for dname, fp in self.drivers.fingerprints().items():
+            self.node.drivers[dname] = DriverInfo(
+                detected=bool(fp.get("detected")),
+                healthy=bool(fp.get("healthy")))
+        self.node.compute_class()
+        # restore node identity across restarts
+        prev = self.state_db.node_id()
+        if prev:
+            self.node.id = prev
+        else:
+            self.state_db.put_node_id(self.node.id)
+
+        self.runners: Dict[str, AllocRunner] = {}
+        self._runner_lock = threading.Lock()
+        self._last_index = 0
+        self._last_ok_heartbeat = time.time()
+        self._shutdown = threading.Event()
+        self._frozen = threading.Event()    # fault injection: partition
+        self._threads: List[threading.Thread] = []
+        self.heartbeat_ttl = 10.0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.restore()
+        self.conn.register_node(self.node)
+        for fn, label in ((self._heartbeat_loop, "heartbeat"),
+                          (self._watch_allocations, "alloc-watch"),
+                          (self._health_loop, "health"),
+                          (self._heartbeatstop_loop, "heartbeatstop")):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"client-{label}-{self.node.name}")
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._runner_lock:
+            runners = list(self.runners.values())
+        for r in runners:
+            r.stop(timeout=2.0)
+
+    # -- fault injection (parity with SimClient for tests) -------------
+    def freeze(self) -> None:
+        self._frozen.set()
+
+    def thaw(self) -> None:
+        self._frozen.clear()
+
+    # -- restore (reference: client.go:1215 restoreState) --------------
+    def restore(self) -> None:
+        for alloc_id in self.state_db.alloc_ids():
+            alloc = self.conn.get_alloc(alloc_id)
+            if alloc is None or alloc.terminal_status():
+                self.state_db.delete_alloc(alloc_id)
+                continue
+            tasks = self.state_db.get_alloc_tasks(alloc_id)
+            runner = AllocRunner(
+                alloc, self.drivers, self.data_dir, node=self.node,
+                on_update=self._on_runner_update,
+                identity_signer=self.identity_signer)
+            with self._runner_lock:
+                self.runners[alloc_id] = runner
+            states = {name: st for name, (st, _h) in tasks.items()}
+            handles = {name: h for name, (_st, h) in tasks.items()}
+            runner.restore(states, handles)
+
+    # -- heartbeats (reference: registerAndHeartbeat :1734) ------------
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            interval = max(self.heartbeat_ttl / 3.0, 0.05)
+            if self._shutdown.wait(interval):
+                return
+            if self._frozen.is_set():
+                continue
+            try:
+                ttl = self.conn.heartbeat(self.node.id)
+                if ttl:
+                    self.heartbeat_ttl = ttl
+                self._last_ok_heartbeat = time.time()
+            except Exception:   # noqa: BLE001 - server unreachable
+                pass
+
+    # -- watch loop (reference: watchAllocations :2280) ----------------
+    def _watch_allocations(self) -> None:
+        while not self._shutdown.is_set():
+            if self._frozen.is_set():
+                time.sleep(0.05)
+                continue
+            try:
+                allocs, index = self.conn.pull_allocs(
+                    self.node.id, self._last_index, timeout=1.0)
+            except Exception:   # noqa: BLE001
+                time.sleep(0.2)
+                continue
+            self._last_index = index
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, allocs: List[Allocation]) -> None:
+        """Diff desired vs running (reference: runAllocs :2538)."""
+        desired = {a.id: a for a in allocs}
+        updates: List[Allocation] = []
+        with self._runner_lock:
+            known = dict(self.runners)
+        # stop/evict + server-side removals
+        for alloc_id, runner in known.items():
+            a = desired.get(alloc_id)
+            if a is None:
+                # server no longer tracks it: destroy (reference: alloc GC)
+                runner.destroy(timeout=2.0)
+                with self._runner_lock:
+                    self.runners.pop(alloc_id, None)
+                self.state_db.delete_alloc(alloc_id)
+            elif a.desired_status != ALLOC_DESIRED_RUN and \
+                    runner.client_status not in (ALLOC_CLIENT_COMPLETE,
+                                                 ALLOC_CLIENT_FAILED):
+                runner.stop(timeout=5.0)
+                updates.append(runner.client_update())
+        # new allocations
+        for alloc_id, a in desired.items():
+            if alloc_id in known or a.terminal_status() or \
+                    a.client_terminal_status():
+                continue
+            if a.desired_status != ALLOC_DESIRED_RUN:
+                continue
+            runner = AllocRunner(
+                a, self.drivers, self.data_dir, node=self.node,
+                on_update=self._on_runner_update,
+                identity_signer=self.identity_signer)
+            with self._runner_lock:
+                self.runners[alloc_id] = runner
+            self.state_db.put_alloc(alloc_id, a.modify_index)
+            runner.start()
+        if updates:
+            self._push_updates(updates)
+        self._gc_terminal_runners()
+
+    # -- runner callbacks ----------------------------------------------
+    def _on_runner_update(self, runner: AllocRunner) -> None:
+        for name, tr in runner.task_runners.items():
+            self.state_db.put_task_state(runner.alloc.id, name,
+                                         tr.state, tr.handle)
+        self._push_updates([runner.client_update()])
+
+    def _push_updates(self, updates: List[Allocation]) -> None:
+        if self._frozen.is_set():
+            return
+        try:
+            self.conn.update_allocs(updates)
+        except Exception:   # noqa: BLE001
+            pass
+
+    # -- deployment health (reference: health_hook + allochealth) ------
+    def _health_loop(self) -> None:
+        while not self._shutdown.wait(0.1):
+            if self._frozen.is_set():
+                continue
+            with self._runner_lock:
+                runners = list(self.runners.values())
+            for r in runners:
+                if not r.alloc.deployment_id or \
+                        r.deployment_health is not None:
+                    continue
+                min_healthy = 0.05
+                if r.alloc.job is not None:
+                    tg = r.alloc.job.lookup_task_group(r.alloc.task_group)
+                    upd = (tg.update if tg and tg.update
+                           else r.alloc.job.update)
+                    if upd is not None:
+                        min_healthy = upd.min_healthy_time_s
+                decided = r.check_health(min_healthy)
+                if decided is not None:
+                    self._push_updates([r.client_update()])
+
+    # -- heartbeatstop (reference: client/heartbeatstop.go) ------------
+    def _heartbeatstop_loop(self) -> None:
+        while not self._shutdown.wait(0.2):
+            lost_for = time.time() - self._last_ok_heartbeat
+            with self._runner_lock:
+                runners = list(self.runners.values())
+            for r in runners:
+                tg = (r.alloc.job.lookup_task_group(r.alloc.task_group)
+                      if r.alloc.job else None)
+                stop_after = (tg.stop_after_client_disconnect_s
+                              if tg else None)
+                if stop_after is not None and lost_for >= stop_after and \
+                        r.client_status not in (ALLOC_CLIENT_COMPLETE,
+                                                ALLOC_CLIENT_FAILED):
+                    r.stop(timeout=5.0)
+
+    # -- client GC (reference: client/gc.go AllocGarbageCollector) -----
+    def _gc_terminal_runners(self) -> None:
+        with self._runner_lock:
+            terminal = [(aid, r) for aid, r in self.runners.items()
+                        if r.client_status in (ALLOC_CLIENT_COMPLETE,
+                                               ALLOC_CLIENT_FAILED)
+                        and r.wait(timeout=0)]
+            excess = len(terminal) - MAX_TERMINAL_RUNNERS
+            victims = terminal[:excess] if excess > 0 else []
+            for aid, _ in victims:
+                self.runners.pop(aid, None)
+        for aid, runner in victims:
+            runner.destroy(timeout=1.0)
+            self.state_db.delete_alloc(aid)

@@ -1,0 +1,227 @@
+"""Node fingerprinting: populate Node.attributes + NodeResources.
+
+Semantic parity with /root/reference/client/fingerprint_manager.go and
+client/fingerprint/ (one fingerprinter per concern: arch, cpu, memory,
+storage, network, host, nomad version, env_*). TPU-first addition: an
+accelerator fingerprinter that surfaces jax-visible TPU/device topology as
+node attributes and a device resource group, the way the reference's
+env_aws/gce probes surface cloud metadata and device plugins surface GPUs
+(reference: client/fingerprint/env_gce.go, plugins/device/).
+"""
+from __future__ import annotations
+
+import os
+import platform
+import shutil
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import (
+    Node, NodeCpuResources, NodeDeviceResource, NodeDiskResources,
+    NodeMemoryResources, NodeResources, NetworkResource, generate_uuid,
+)
+
+VERSION = "0.1.0"
+
+
+class Fingerprinter:
+    """One concern's probe. Returns (attributes, mutate_fn|None)."""
+
+    name = "base"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        raise NotImplementedError
+
+
+class ArchFingerprinter(Fingerprinter):
+    name = "arch"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        return {"cpu.arch": platform.machine()}
+
+
+class OSFingerprinter(Fingerprinter):
+    name = "os"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        return {"os.name": platform.system().lower(),
+                "os.version": platform.release(),
+                "kernel.name": platform.system().lower(),
+                "kernel.version": platform.release()}
+
+
+class HostFingerprinter(Fingerprinter):
+    name = "host"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        return {"unique.hostname": socket.gethostname()}
+
+
+class CpuFingerprinter(Fingerprinter):
+    name = "cpu"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        cores = os.cpu_count() or 1
+        mhz = self._base_mhz()
+        total = int(cores * mhz)
+        node.node_resources.cpu = NodeCpuResources(
+            cpu_shares=total, total_core_count=cores,
+            reservable_cores=list(range(cores)))
+        return {"cpu.numcores": str(cores),
+                "cpu.frequency": str(int(mhz)),
+                "cpu.totalcompute": str(total)}
+
+    @staticmethod
+    def _base_mhz() -> float:
+        try:
+            with open("/proc/cpuinfo", encoding="utf-8") as fh:
+                for line in fh:
+                    if line.lower().startswith("cpu mhz"):
+                        return float(line.split(":", 1)[1])
+        except (OSError, ValueError):
+            pass
+        return 1000.0
+
+
+class MemoryFingerprinter(Fingerprinter):
+    name = "memory"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        total_mb = self._total_mb()
+        node.node_resources.memory = NodeMemoryResources(
+            memory_mb=total_mb)
+        return {"memory.totalbytes": str(total_mb << 20)}
+
+    @staticmethod
+    def _total_mb() -> int:
+        try:
+            with open("/proc/meminfo", encoding="utf-8") as fh:
+                for line in fh:
+                    if line.startswith("MemTotal:"):
+                        return int(line.split()[1]) >> 10
+        except (OSError, ValueError, IndexError):
+            pass
+        return 1024
+
+
+class StorageFingerprinter(Fingerprinter):
+    name = "storage"
+
+    def __init__(self, data_dir: str = "/tmp"):
+        self.data_dir = data_dir
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        try:
+            usage = shutil.disk_usage(self.data_dir)
+            free_mb = usage.free >> 20
+            total_mb = usage.total >> 20
+        except OSError:
+            free_mb = total_mb = 10240
+        node.node_resources.disk = NodeDiskResources(disk_mb=free_mb)
+        return {"unique.storage.volume": self.data_dir,
+                "unique.storage.bytestotal": str(total_mb << 20),
+                "unique.storage.bytesfree": str(free_mb << 20)}
+
+
+class NetworkFingerprinter(Fingerprinter):
+    name = "network"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        ip = "127.0.0.1"
+        try:
+            # UDP connect learns the outbound interface address; no traffic
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+            s.close()
+        except OSError:
+            pass
+        if not node.node_resources.networks:
+            node.node_resources.networks = [
+                NetworkResource(mode="host", device="eth0", ip=ip,
+                                mbits=1000)]
+        return {"unique.network.ip-address": ip}
+
+
+class NomadFingerprinter(Fingerprinter):
+    name = "nomad"
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        return {"nomad.version": VERSION,
+                "nomad.revision": "tpu-native"}
+
+
+class AcceleratorFingerprinter(Fingerprinter):
+    """Surfaces jax-visible accelerators as node attributes + a device
+    group, so jobs can constrain on `${attr.tpu.count}` or request
+    `device "tpu"` (the reference's device-plugin fingerprint path,
+    plugins/device/). Probing jax is optional and lazy: client agents on
+    CPU-only hosts skip it."""
+
+    name = "accelerator"
+
+    def __init__(self, probe_jax: bool = False):
+        self.probe_jax = probe_jax
+
+    def fingerprint(self, node: Node) -> Dict[str, str]:
+        if not self.probe_jax:
+            return {}
+        try:
+            import jax
+            devices = jax.devices()
+        except Exception:       # noqa: BLE001 - no accelerator runtime
+            return {}
+        kinds: Dict[str, List] = {}
+        for d in devices:
+            kinds.setdefault(getattr(d, "device_kind", d.platform), []) \
+                .append(d)
+        attrs = {"tpu.count": str(sum(len(v) for k, v in kinds.items()
+                                      if "tpu" in k.lower()))}
+        for kind, devs in kinds.items():
+            vendor = "google" if "tpu" in kind.lower() else devs[0].platform
+            node.node_resources.devices.append(NodeDeviceResource(
+                vendor=vendor, type="tpu" if "tpu" in kind.lower()
+                else devs[0].platform,
+                name=kind, instance_ids=[str(d.id) for d in devs]))
+            attrs[f"accelerator.{kind}.count"] = str(len(devs))
+        return attrs
+
+
+DEFAULT_FINGERPRINTERS = (
+    ArchFingerprinter, OSFingerprinter, HostFingerprinter, CpuFingerprinter,
+    MemoryFingerprinter, StorageFingerprinter, NetworkFingerprinter,
+    NomadFingerprinter,
+)
+
+
+class FingerprintManager:
+    """Runs every fingerprinter against a Node
+    (reference: client/fingerprint_manager.go setupFingerprinters)."""
+
+    def __init__(self, data_dir: str = "/tmp", probe_jax: bool = False,
+                 extra: Optional[List[Fingerprinter]] = None):
+        self.fingerprinters: List[Fingerprinter] = [
+            cls(data_dir) if cls is StorageFingerprinter else cls()
+            for cls in DEFAULT_FINGERPRINTERS]
+        self.fingerprinters.append(AcceleratorFingerprinter(probe_jax))
+        self.fingerprinters.extend(extra or [])
+
+    def fingerprint_node(self, node: Optional[Node] = None,
+                         name: str = "", datacenter: str = "dc1",
+                         node_class: str = "") -> Node:
+        if node is None:
+            node = Node(id=generate_uuid(), name=name or socket.gethostname(),
+                        datacenter=datacenter, node_class=node_class,
+                        node_resources=NodeResources())
+        applied = []
+        for fp in self.fingerprinters:
+            try:
+                attrs = fp.fingerprint(node)
+            except Exception:   # noqa: BLE001 - a probe must not kill boot
+                continue
+            node.attributes.update(attrs)
+            applied.append(fp.name)
+        node.attributes["fingerprinters"] = ",".join(applied)
+        node.compute_class()
+        return node

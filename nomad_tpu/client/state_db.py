@@ -1,0 +1,112 @@
+"""Client state persistence: alloc/task runner state surviving restarts.
+
+Semantic parity with /root/reference/client/state/ (boltdb state db of
+alloc runner + task runner state and driver handles; restore on agent boot
+re-attaches to live tasks, client.go:1215 restoreState). JSON-file-backed
+here; one file per client data dir, atomic replace on write.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from .drivers import TaskHandle
+from .task_runner import TaskEvent, TaskState
+
+
+class StateDB:
+    """(reference: client/state/db.go StateDB interface)"""
+
+    def __init__(self, data_dir: str):
+        self.path = os.path.join(data_dir, "client_state.json")
+        self._lock = threading.Lock()
+        self._data: dict = {"allocs": {}, "node_id": ""}
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, encoding="utf-8") as fh:
+                    self._data = json.load(fh)
+            except (json.JSONDecodeError, OSError):
+                pass
+
+    def _flush(self) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(self._data, fh, separators=(",", ":"))
+        os.replace(tmp, self.path)
+
+    # -- node identity -------------------------------------------------
+    def put_node_id(self, node_id: str) -> None:
+        with self._lock:
+            self._data["node_id"] = node_id
+            self._flush()
+
+    def node_id(self) -> str:
+        with self._lock:
+            return self._data.get("node_id", "")
+
+    # -- alloc/task state ----------------------------------------------
+    def put_alloc(self, alloc_id: str, modify_index: int) -> None:
+        with self._lock:
+            rec = self._data["allocs"].setdefault(
+                alloc_id, {"tasks": {}})
+            rec["modify_index"] = modify_index
+            self._flush()
+
+    def put_task_state(self, alloc_id: str, task_name: str,
+                       state: TaskState,
+                       handle: Optional[TaskHandle]) -> None:
+        with self._lock:
+            rec = self._data["allocs"].setdefault(
+                alloc_id, {"tasks": {}})
+            rec["tasks"][task_name] = {
+                "state": {
+                    "state": state.state, "failed": state.failed,
+                    "restarts": state.restarts,
+                    "started_at": state.started_at,
+                    "finished_at": state.finished_at,
+                    "events": [{"type": e.type, "time": e.time,
+                                "details": e.details}
+                               for e in state.events[-5:]],
+                },
+                "handle": None if handle is None else {
+                    "task_id": handle.task_id, "driver": handle.driver,
+                    "pid": handle.pid, "started_at": handle.started_at,
+                    "driver_state": handle.driver_state,
+                },
+            }
+            self._flush()
+
+    def delete_alloc(self, alloc_id: str) -> None:
+        with self._lock:
+            self._data["allocs"].pop(alloc_id, None)
+            self._flush()
+
+    def alloc_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._data["allocs"].keys())
+
+    def get_alloc_tasks(self, alloc_id: str
+                        ) -> Dict[str, tuple]:
+        """-> {task_name: (TaskState, TaskHandle|None)}"""
+        with self._lock:
+            rec = self._data["allocs"].get(alloc_id, {"tasks": {}})
+            out = {}
+            for name, t in rec["tasks"].items():
+                s = t["state"]
+                state = TaskState(
+                    state=s["state"], failed=s["failed"],
+                    restarts=s["restarts"], started_at=s["started_at"],
+                    finished_at=s["finished_at"],
+                    events=[TaskEvent(type=e["type"], time=e["time"],
+                                      details=e["details"])
+                            for e in s.get("events", [])])
+                h = t.get("handle")
+                handle = None if h is None else TaskHandle(
+                    task_id=h["task_id"], driver=h["driver"],
+                    pid=h["pid"], started_at=h["started_at"],
+                    driver_state=h.get("driver_state", {}))
+                out[name] = (state, handle)
+            return out

@@ -1,0 +1,361 @@
+"""TaskRunner: per-task state machine with a hook pipeline + restart loop.
+
+Semantic parity with /root/reference/client/allocrunner/taskrunner/
+(task_runner.go:533 Run -- the restart loop; :874 runDriver; hook manager
+task_runner_hooks.go; restart policy client/allocrunner/taskrunner/restarts/).
+Hooks here: validate, task_dir, env (taskenv build), logmon (file paths),
+artifacts (local-file fetch only; remote URLs are gated off in this
+environment), template (interpolated render to task dir), identity (signed
+workload identity when a keyring is wired). Each hook is
+prestart/poststart/exited/stop capable like the reference's interfaces.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import RestartPolicy, Task
+from .allocdir import AllocDir, TaskDir
+from .drivers import (
+    Driver, DriverError, ExitResult, TaskHandle, TASK_STATE_DEAD,
+    TASK_STATE_PENDING, TASK_STATE_RUNNING,
+)
+from .taskenv import build_env, interpolate
+
+
+@dataclass
+class TaskEvent:
+    """(reference: structs.TaskEvent)"""
+    type: str = ""
+    time: float = 0.0
+    details: str = ""
+
+
+@dataclass
+class TaskState:
+    """(reference: structs.TaskState)"""
+    state: str = TASK_STATE_PENDING
+    failed: bool = False
+    restarts: int = 0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    last_restart: float = 0.0
+    events: List[TaskEvent] = field(default_factory=list)
+
+    def successful(self) -> bool:
+        return self.state == TASK_STATE_DEAD and not self.failed
+
+
+class TaskHook:
+    name = "hook"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        pass
+
+    def poststart(self, runner: "TaskRunner") -> None:
+        pass
+
+    def exited(self, runner: "TaskRunner") -> None:
+        pass
+
+    def stop(self, runner: "TaskRunner") -> None:
+        pass
+
+
+class ValidateHook(TaskHook):
+    """(reference: taskrunner/validate_hook.go)"""
+    name = "validate"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        if not runner.task.name:
+            raise DriverError("task name required")
+        if not runner.task.driver:
+            raise DriverError("task driver required")
+
+
+class TaskDirHook(TaskHook):
+    """(reference: taskrunner/task_dir_hook.go)"""
+    name = "task_dir"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        runner.task_dir = runner.alloc_dir.new_task_dir(runner.task.name)
+
+
+class EnvHook(TaskHook):
+    """(reference: taskenv builder invocation in task_runner.go)"""
+    name = "env"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        runner.env = build_env(runner.alloc, runner.task, runner.node,
+                               runner.task_dir)
+
+
+class ArtifactHook(TaskHook):
+    """Fetch artifacts into the task dir. Only file:// and bare local
+    paths are supported -- remote getters (the reference's go-getter
+    sandbox, taskrunner/getter/) need egress this environment forbids."""
+    name = "artifacts"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        for art in runner.task.artifacts or []:
+            source = str(art.get("source", ""))
+            if source.startswith("file://"):
+                source = source[len("file://"):]
+            if not source or not os.path.exists(source):
+                raise DriverError(f"artifact not found: {source}")
+            dest = os.path.join(runner.task_dir.local_dir,
+                                str(art.get("destination", "")) or
+                                os.path.basename(source))
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            if os.path.isdir(source):
+                shutil.copytree(source, dest, dirs_exist_ok=True)
+            else:
+                shutil.copy2(source, dest)
+
+
+class TemplateHook(TaskHook):
+    """Render inline templates with ${...} interpolation
+    (reference: taskrunner/template/ consul-template integration; the
+    data-source half -- consul/vault watches -- is out of scope, env and
+    node interpolation is in)."""
+    name = "template"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        for tpl in runner.task.templates or []:
+            data = str(tpl.get("data", ""))
+            dest = str(tpl.get("destination", "local/template.out"))
+            rendered = interpolate(data, runner.alloc, runner.node,
+                                   runner.env)
+            path = os.path.join(runner.task_dir.dir, dest)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(rendered)
+
+
+class LogmonHook(TaskHook):
+    """(reference: taskrunner/logmon_hook.go -- here the driver writes
+    directly to the alloc log dir; the hook guarantees the dir exists)"""
+    name = "logmon"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        os.makedirs(runner.alloc_dir.log_dir(), exist_ok=True)
+
+
+class IdentityHook(TaskHook):
+    """Writes a signed workload identity JWT into secrets/
+    (reference: taskrunner/identity_hook.go + WorkloadIdentity claims)."""
+    name = "identity"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        signer = runner.identity_signer
+        if signer is None:
+            return
+        token = signer({
+            "sub": f"{runner.alloc.namespace}:{runner.alloc.job_id}:"
+                   f"{runner.alloc.task_group}:{runner.task.name}",
+            "alloc_id": runner.alloc.id,
+            "job_id": runner.alloc.job_id,
+            "task": runner.task.name,
+        })
+        path = os.path.join(runner.task_dir.secrets_dir, "nomad_token")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(token)
+
+
+DEFAULT_HOOKS = (ValidateHook, TaskDirHook, EnvHook, LogmonHook,
+                 ArtifactHook, TemplateHook, IdentityHook)
+
+
+class TaskRunner:
+    """(reference: taskrunner/task_runner.go:533 Run)"""
+
+    def __init__(self, alloc, task: Task, driver: Driver,
+                 alloc_dir: AllocDir, node=None,
+                 restart_policy: Optional[RestartPolicy] = None,
+                 on_state_change=None, identity_signer=None):
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.alloc_dir = alloc_dir
+        self.node = node
+        self.restart_policy = restart_policy or RestartPolicy()
+        self.on_state_change = on_state_change
+        self.identity_signer = identity_signer
+        self.task_dir: Optional[TaskDir] = None
+        self.env: Dict[str, str] = {}
+        self.state = TaskState()
+        self.handle: Optional[TaskHandle] = None
+        self.hooks = [cls() for cls in DEFAULT_HOOKS]
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"task-{self.alloc.id[:8]}-{self.task.name}")
+        self._thread.start()
+
+    def kill(self, timeout: float = 10.0) -> None:
+        self._kill.set()
+        if self.handle is not None:
+            try:
+                self.driver.stop_task(self.handle,
+                                      self.task.kill_timeout_s)
+            except DriverError:
+                pass
+        self._done.wait(timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    # -- main loop (reference: task_runner.go:533) ---------------------
+    def run(self) -> None:
+        try:
+            self._run_hooks("prestart")
+        except (DriverError, OSError) as e:
+            self._fail_terminal(f"prestart hook failed: {e}",
+                                "Setup Failure")
+            return
+        attempts_window_start = time.time()
+        attempts = 0
+        while not self._kill.is_set():
+            exit_result = self._run_once()
+            if self._kill.is_set():
+                self._mark_dead(failed=False, desc="task killed")
+                break
+            failed = exit_result is None or not exit_result.successful()
+            if not failed:
+                self._mark_dead(failed=False, desc="task completed")
+                break
+            # restart policy (reference: taskrunner/restarts/restarts.go)
+            now = time.time()
+            if now - attempts_window_start > self.restart_policy.interval_s:
+                attempts_window_start = now
+                attempts = 0
+            attempts += 1
+            if attempts > self.restart_policy.attempts:
+                if self.restart_policy.mode == "delay":
+                    self._event("Restart Delayed",
+                                "exceeded attempts, waiting interval")
+                    if self._kill.wait(self.restart_policy.interval_s):
+                        break
+                    attempts_window_start = time.time()
+                    attempts = 0
+                    continue
+                self._mark_dead(failed=True,
+                                desc="exceeded restart attempts")
+                break
+            self.state.restarts += 1
+            self.state.last_restart = now
+            self._event("Restarting",
+                        f"restart {self.state.restarts} in "
+                        f"{self.restart_policy.delay_s}s")
+            self._notify()
+            if self._kill.wait(self.restart_policy.delay_s):
+                break
+        self._run_hooks("stop")
+        self._done.set()
+        self._notify()
+
+    def _run_once(self) -> Optional[ExitResult]:
+        """One driver invocation (reference: task_runner.go:874 runDriver)."""
+        task_id = f"{self.alloc.id[:8]}-{self.task.name}-" \
+                  f"{self.state.restarts}"
+        try:
+            self.handle = self.driver.start_task(
+                task_id, self.task, self.env, self.task_dir)
+        except DriverError as e:
+            self._event("Driver Failure", str(e))
+            return ExitResult(err=str(e))
+        self.state.state = TASK_STATE_RUNNING
+        self.state.started_at = self.handle.started_at
+        self._event("Started", "")
+        self._notify()
+        self._run_hooks("poststart")
+        while True:
+            result = self.driver.wait_task(self.handle, timeout=0.2)
+            if result is not None:
+                break
+            if self._kill.is_set():
+                self.driver.stop_task(self.handle,
+                                      self.task.kill_timeout_s)
+                result = self.driver.wait_task(self.handle, timeout=5.0)
+                break
+        self._run_hooks("exited")
+        if result is not None and not result.successful():
+            self._event("Terminated",
+                        f"exit={result.exit_code} sig={result.signal} "
+                        f"{result.err}")
+        return result
+
+    # -- restore (reference: task_runner restore + driver reattach) ----
+    def restore(self, state: TaskState, handle: Optional[TaskHandle]) -> bool:
+        """Re-attach to a live task after agent restart. Returns True when
+        the task is still running under the recovered handle."""
+        self.state = state
+        if handle is None or state.state != TASK_STATE_RUNNING:
+            return False
+        if not self.driver.recover_task(handle):
+            self.state.state = TASK_STATE_DEAD
+            self.state.failed = True
+            self._event("Lost", "task not recoverable after restart")
+            return False
+        self.handle = handle
+        # resume supervision in the background
+        self.task_dir = TaskDir(self.alloc_dir, self.task.name)
+        self._thread = threading.Thread(
+            target=self._supervise_recovered, daemon=True,
+            name=f"task-recover-{self.alloc.id[:8]}-{self.task.name}")
+        self._thread.start()
+        return True
+
+    def _supervise_recovered(self) -> None:
+        while not self._kill.is_set():
+            result = self.driver.wait_task(self.handle, timeout=0.2)
+            if result is not None:
+                if result.successful():
+                    self._mark_dead(failed=False, desc="task completed")
+                else:
+                    self._mark_dead(failed=True,
+                                    desc=f"exit={result.exit_code}")
+                break
+        self._done.set()
+        self._notify()
+
+    # -- helpers -------------------------------------------------------
+    def _run_hooks(self, phase: str) -> None:
+        for hook in self.hooks:
+            getattr(hook, phase)(self)
+
+    def _event(self, etype: str, details: str) -> None:
+        self.state.events.append(TaskEvent(type=etype, time=time.time(),
+                                           details=details))
+        if len(self.state.events) > 10:     # reference caps task events
+            self.state.events = self.state.events[-10:]
+
+    def _mark_dead(self, failed: bool, desc: str) -> None:
+        self.state.state = TASK_STATE_DEAD
+        self.state.failed = failed
+        self.state.finished_at = time.time()
+        self._event("Killed" if self._kill.is_set() else "Finished", desc)
+
+    def _fail_terminal(self, desc: str, etype: str) -> None:
+        self._event(etype, desc)
+        self.state.state = TASK_STATE_DEAD
+        self.state.failed = True
+        self.state.finished_at = time.time()
+        self._done.set()
+        self._notify()
+
+    def _notify(self) -> None:
+        if self.on_state_change is not None:
+            try:
+                self.on_state_change(self)
+            except Exception:   # noqa: BLE001
+                pass

@@ -1,0 +1,100 @@
+"""Task environment: NOMAD_* variables + ${...} interpolation.
+
+Semantic parity with /root/reference/client/taskenv/ (env.go Builder --
+NOMAD_ALLOC_*, NOMAD_TASK_*, NOMAD_CPU_LIMIT..., node attr/meta
+interpolation ${node.*} ${attr.*} ${meta.*} ${env.*}, port variables
+NOMAD_PORT_<label> / NOMAD_ADDR_<label>).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from ..structs import Allocation, Node, Task
+
+_VAR_RE = re.compile(r"\$\{([^}]+)\}")
+
+
+def build_env(alloc: Allocation, task: Task, node: Optional[Node],
+              task_dir: Optional[object] = None) -> Dict[str, str]:
+    """(reference: taskenv/env.go Builder.Build)"""
+    env: Dict[str, str] = {}
+    env["NOMAD_ALLOC_ID"] = alloc.id
+    env["NOMAD_ALLOC_NAME"] = alloc.name
+    env["NOMAD_ALLOC_INDEX"] = str(_alloc_index(alloc.name))
+    env["NOMAD_GROUP_NAME"] = alloc.task_group
+    env["NOMAD_TASK_NAME"] = task.name
+    env["NOMAD_JOB_ID"] = alloc.job_id
+    env["NOMAD_JOB_NAME"] = alloc.job.name if alloc.job else alloc.job_id
+    env["NOMAD_NAMESPACE"] = alloc.namespace
+    env["NOMAD_DC"] = node.datacenter if node else ""
+    env["NOMAD_REGION"] = "global"
+    if task_dir is not None:
+        env["NOMAD_ALLOC_DIR"] = task_dir.alloc.shared_dir
+        env["NOMAD_TASK_DIR"] = task_dir.local_dir
+        env["NOMAD_SECRETS_DIR"] = task_dir.secrets_dir
+    if task.resources is not None:
+        env["NOMAD_CPU_LIMIT"] = str(task.resources.cpu)
+        env["NOMAD_MEMORY_LIMIT"] = str(task.resources.memory_mb)
+    # allocated ports (reference: env.go addPorts)
+    tr = (alloc.allocated_resources.tasks.get(task.name)
+          if alloc.allocated_resources else None)
+    if tr is not None:
+        for net in tr.networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                label = p.label.upper().replace("-", "_")
+                env[f"NOMAD_PORT_{label}"] = str(p.value)
+                env[f"NOMAD_IP_{label}"] = net.ip
+                env[f"NOMAD_ADDR_{label}"] = f"{net.ip}:{p.value}"
+    if alloc.allocated_resources is not None:
+        for pm in alloc.allocated_resources.shared.ports:
+            label = pm.label.upper().replace("-", "_")
+            env[f"NOMAD_PORT_{label}"] = str(pm.value)
+            env[f"NOMAD_HOST_PORT_{label}"] = str(pm.value)
+            env[f"NOMAD_IP_{label}"] = pm.host_ip
+            env[f"NOMAD_ADDR_{label}"] = f"{pm.host_ip}:{pm.value}"
+    # user-specified env wins, after interpolation
+    for k, v in (task.env or {}).items():
+        env[k] = interpolate(str(v), alloc, node, env)
+    return env
+
+
+def interpolate(s: str, alloc: Optional[Allocation], node: Optional[Node],
+                env: Optional[Dict[str, str]] = None) -> str:
+    """Replace ${node.*}, ${attr.*}, ${meta.*}, ${env.*}, ${NOMAD_*}
+    (reference: taskenv ReplaceEnv + client interpolation in drivers)."""
+
+    def repl(m: re.Match) -> str:
+        key = m.group(1).strip()
+        if node is not None:
+            if key == "node.unique.id":
+                return node.id
+            if key == "node.unique.name":
+                return node.name
+            if key == "node.datacenter":
+                return node.datacenter
+            if key == "node.class":
+                return node.node_class
+            if key == "node.pool":
+                return node.node_pool
+            if key == "node.region":
+                return "global"
+            if key.startswith("attr."):
+                return node.attributes.get(key[len("attr."):], "")
+            if key.startswith("meta."):
+                return node.meta.get(key[len("meta."):], "")
+        if key.startswith("env.") and env is not None:
+            return env.get(key[len("env."):], "")
+        if env is not None and key in env:
+            return env[key]
+        return m.group(0)        # leave unknown vars untouched
+
+    return _VAR_RE.sub(repl, s)
+
+
+def _alloc_index(name: str) -> int:
+    """job.group[3] -> 3 (reference: structs.AllocName index extraction)."""
+    try:
+        return int(name.rsplit("[", 1)[1].rstrip("]"))
+    except (IndexError, ValueError):
+        return 0

@@ -486,21 +486,26 @@ class RaftNode:
             self._maybe_snapshot()
 
     def _maybe_snapshot(self) -> None:
-        with self._lock:
-            log_len = self.log.last_index() - self.log.first_index() + 1
-            if (self.log.first_index() == 0
-                    or log_len < self.snapshot_threshold):
-                return
-            last = self.last_applied
-            term = self._term_at(last) or self.current_term
+        # _fsm_lock FIRST: a concurrent InstallSnapshot must not slip in
+        # between reading last_applied and dumping the FSM (the dump would
+        # carry newer state than its label, corrupting later restores).
         with self._fsm_lock:
+            with self._lock:
+                log_len = self.log.last_index() - self.log.first_index() + 1
+                if (self.log.first_index() == 0
+                        or log_len < self.snapshot_threshold):
+                    return
+                last = self.last_applied
+                if last <= self._snap_last_index:
+                    return
+                term = self._term_at(last) or self.current_term
             blob = self.fsm.snapshot()
-        self.snapshots.save(Snapshot(last_index=last, last_term=term,
-                                     state=blob))
-        with self._lock:
-            self._snap_last_index = last
-            self._snap_last_term = term
-            self.log.compact_to(last)
+            self.snapshots.save(Snapshot(last_index=last, last_term=term,
+                                         state=blob))
+            with self._lock:
+                self._snap_last_index = last
+                self._snap_last_term = term
+                self.log.compact_to(last)
 
     # -- RPC handlers (follower side) ----------------------------------
     def _handle_request_vote(self, msg: dict) -> dict:

@@ -204,8 +204,15 @@ class ClusterServer(Server):
                         "type": "server_rpc", "method": method,
                         "args": [codec.encode(a) for a in args],
                     }, timeout=min(5.0, timeout))
-                    if "error" not in reply:
+                    err = reply.get("error")
+                    if err is None:
                         return codec.decode(ret_spec, reply.get("result"))
+                    if "not leader" not in err and \
+                            "NotLeaderError" not in err:
+                        # a real leader-side failure: retrying would
+                        # re-execute non-idempotent writes -- surface it
+                        raise RuntimeError(
+                            f"forwarded {method} failed: {err}")
                 except (OSError, ConnectionError):
                     pass
             if time.monotonic() >= deadline:

@@ -1,0 +1,317 @@
+"""Client agent tests: fingerprinting, drivers, runners, restore, e2e.
+
+Mirrors the reference's client test patterns (client/client_test.go with
+TestClient against an in-process server; taskrunner tests driving hooks
+and restart policies; drivers/mock scripted behaviors).
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import (
+    AllocDir, Client, DriverRegistry, FingerprintManager, LocalServerConn,
+    MockDriver, RawExecDriver, StateDB, TaskRunner,
+)
+from nomad_tpu.client.alloc_runner import AllocRunner
+from nomad_tpu.client.taskenv import build_env, interpolate
+from nomad_tpu.server.core import Server
+from nomad_tpu.structs import (
+    Allocation, AllocatedResources, AllocatedSharedResources, Node, Task,
+    TaskGroup, RestartPolicy, generate_uuid,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
+)
+
+
+def _wait(pred, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _mk_alloc(job, node_id="node-1"):
+    tg = job.task_groups[0]
+    return Allocation(
+        id=generate_uuid(), name=f"{job.id}.{tg.name}[0]",
+        namespace="default", job_id=job.id, job=job,
+        task_group=tg.name, node_id=node_id,
+        allocated_resources=AllocatedResources(
+            shared=AllocatedSharedResources(disk_mb=100)))
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+
+def test_fingerprint_node(tmp_path):
+    node = FingerprintManager(data_dir=str(tmp_path)).fingerprint_node()
+    assert node.attributes["cpu.arch"]
+    assert int(node.attributes["cpu.numcores"]) >= 1
+    assert node.node_resources.cpu.cpu_shares > 0
+    assert node.node_resources.memory.memory_mb > 0
+    assert node.node_resources.disk.disk_mb > 0
+    assert node.attributes["nomad.version"]
+    assert node.computed_class
+
+
+# ---------------------------------------------------------------------------
+# task env
+
+def test_taskenv_interpolation(tmp_path):
+    job = mock.job(id="env-job")
+    alloc = _mk_alloc(job)
+    task = job.task_groups[0].tasks[0]
+    task.env = {"GREETING": "hello ${node.datacenter}",
+                "WHOAMI": "${NOMAD_ALLOC_ID}"}
+    node = Node(id="n1", name="node-1", datacenter="dc7")
+    env = build_env(alloc, task, node)
+    assert env["NOMAD_JOB_ID"] == "env-job"
+    assert env["NOMAD_ALLOC_INDEX"] == "0"
+    assert env["GREETING"] == "hello dc7"
+    assert env["WHOAMI"] == alloc.id
+    node.attributes["cpu.arch"] = "x86_64"
+    assert interpolate("arch=${attr.cpu.arch}", alloc, node) == "arch=x86_64"
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+def test_mock_driver_run_for():
+    d = MockDriver()
+    task = Task(name="t", driver="mock", config={"run_for": "100ms"})
+    h = d.start_task("t1", task, {}, None)
+    res = d.wait_task(h, timeout=3.0)
+    assert res is not None and res.successful()
+
+
+def test_mock_driver_exit_code_and_stop():
+    d = MockDriver()
+    task = Task(name="t", driver="mock",
+                config={"run_for": "50ms", "exit_code": 2})
+    h = d.start_task("t2", task, {}, None)
+    res = d.wait_task(h, timeout=3.0)
+    assert res.exit_code == 2
+    # infinite task is stoppable
+    h2 = d.start_task("t3", Task(name="t", driver="mock", config={}), {},
+                      None)
+    d.stop_task(h2, kill_timeout=1.0)
+    res2 = d.wait_task(h2, timeout=1.0)
+    assert res2 is not None and res2.signal != 0
+
+
+def test_raw_exec_driver_runs_real_process(tmp_path):
+    d = RawExecDriver()
+    adir = AllocDir(str(tmp_path), "alloc1")
+    adir.build()
+    tdir = adir.new_task_dir("t")
+    task = Task(name="t", driver="raw_exec",
+                config={"command": "/bin/sh",
+                        "args": ["-c", "echo out-$MARKER; echo err 1>&2"]})
+    h = d.start_task("rx1", task, {"MARKER": "42"}, tdir)
+    res = d.wait_task(h, timeout=5.0)
+    assert res is not None and res.successful(), res
+    with open(tdir.stdout_path()) as fh:
+        assert fh.read().strip() == "out-42"
+    with open(tdir.stderr_path()) as fh:
+        assert fh.read().strip() == "err"
+
+
+def test_raw_exec_driver_failure_and_kill(tmp_path):
+    d = RawExecDriver()
+    adir = AllocDir(str(tmp_path), "alloc2")
+    adir.build()
+    tdir = adir.new_task_dir("t")
+    h = d.start_task("rx2", Task(name="t", config={
+        "command": "/bin/sh", "args": ["-c", "exit 3"]}), {}, tdir)
+    res = d.wait_task(h, timeout=5.0)
+    assert res.exit_code == 3
+    # long-running process killed
+    h2 = d.start_task("rx3", Task(name="t", config={
+        "command": "/bin/sleep", "args": ["30"]}), {}, tdir)
+    d.stop_task(h2, kill_timeout=1.0)
+    res2 = d.wait_task(h2, timeout=2.0)
+    assert res2 is not None and not res2.successful()
+
+
+# ---------------------------------------------------------------------------
+# task runner
+
+def test_task_runner_restart_policy(tmp_path):
+    job = mock.job(id="restart-job")
+    alloc = _mk_alloc(job)
+    task = Task(name="flaky", driver="mock",
+                config={"run_for": "20ms", "exit_code": 1})
+    adir = AllocDir(str(tmp_path), alloc.id)
+    adir.build()
+    tr = TaskRunner(alloc, task, MockDriver(), adir,
+                    restart_policy=RestartPolicy(attempts=2, delay_s=0.02,
+                                                 interval_s=10.0))
+    tr.start()
+    assert tr.wait(timeout=8.0)
+    assert tr.state.failed
+    assert tr.state.restarts == 2       # 1 initial + 2 restarts, all failed
+
+
+def test_task_runner_artifact_and_template(tmp_path):
+    src = tmp_path / "artifact.txt"
+    src.write_text("payload")
+    job = mock.job(id="art-job")
+    alloc = _mk_alloc(job)
+    task = Task(name="t", driver="mock", config={"run_for": "10ms"},
+                artifacts=[{"source": f"file://{src}",
+                            "destination": "artifact.txt"}],
+                templates=[{"data": "dc=${node.datacenter}",
+                            "destination": "local/cfg.out"}])
+    node = Node(id="n1", name="n", datacenter="dc9")
+    adir = AllocDir(str(tmp_path / "allocs"), alloc.id)
+    adir.build()
+    tr = TaskRunner(alloc, task, MockDriver(), adir, node=node)
+    tr.start()
+    assert tr.wait(timeout=5.0)
+    assert not tr.state.failed
+    assert (tmp_path / "allocs" / alloc.id / "t" / "local" /
+            "artifact.txt").read_text() == "payload"
+    assert (tmp_path / "allocs" / alloc.id / "t" / "local" /
+            "cfg.out").read_text() == "dc=dc9"
+
+
+# ---------------------------------------------------------------------------
+# alloc runner
+
+def test_alloc_runner_lifecycle_ordering(tmp_path):
+    job = mock.job(id="lifecycle-job")
+    tg = job.task_groups[0]
+    tg.tasks = [
+        Task(name="init", driver="mock", config={"run_for": "30ms"},
+             lifecycle={"hook": "prestart"}),
+        Task(name="main", driver="mock", config={"run_for": "80ms"}),
+    ]
+    alloc = _mk_alloc(job)
+    ar = AllocRunner(alloc, DriverRegistry(), str(tmp_path))
+    ar.start()
+    assert ar.wait(timeout=8.0)
+    assert ar.client_status == ALLOC_CLIENT_COMPLETE
+    init_tr = ar.task_runners["init"]
+    main_tr = ar.task_runners["main"]
+    assert init_tr.state.finished_at <= main_tr.state.started_at + 0.01
+
+
+def test_alloc_runner_failed_task(tmp_path):
+    job = mock.job(id="fail-job")
+    job.task_groups[0].tasks[0].config = {"run_for": "20ms", "exit_code": 1}
+    job.task_groups[0].restart_policy = RestartPolicy(attempts=0,
+                                                      interval_s=10.0)
+    alloc = _mk_alloc(job)
+    ar = AllocRunner(alloc, DriverRegistry(), str(tmp_path))
+    ar.start()
+    assert ar.wait(timeout=8.0)
+    assert ar.client_status == ALLOC_CLIENT_FAILED
+
+
+# ---------------------------------------------------------------------------
+# full client against a dev server
+
+@pytest.fixture
+def dev_server():
+    s = Server(num_workers=1, heartbeat_ttl=2.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def test_client_end_to_end(dev_server, tmp_path):
+    client = Client(LocalServerConn(dev_server), str(tmp_path),
+                    name="real-client-1")
+    client.start()
+    assert _wait(lambda: dev_server.state.node_by_id(client.node.id)
+                 is not None)
+
+    job = mock.job(id="client-e2e-job")
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {"run_for": "150ms"}
+    dev_server.register_job(job)
+
+    # placements land on the fingerprinted node and complete
+    assert _wait(lambda: len([
+        a for a in dev_server.state.allocs_by_job("default", "client-e2e-job")
+        if a.client_status == ALLOC_CLIENT_COMPLETE]) == 2, timeout=10.0), \
+        [(a.client_status, a.node_id) for a in
+         dev_server.state.allocs_by_job("default", "client-e2e-job")]
+    client.shutdown()
+
+
+def test_client_runs_real_processes(dev_server, tmp_path):
+    client = Client(LocalServerConn(dev_server), str(tmp_path),
+                    name="real-client-2")
+    client.start()
+    assert _wait(lambda: dev_server.state.node_by_id(client.node.id)
+                 is not None)
+    job = mock.job(id="rawexec-job")
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].config = {
+        "command": "/bin/sh",
+        "args": ["-c", "echo from-$NOMAD_JOB_ID > $NOMAD_TASK_DIR/out"]}
+    dev_server.register_job(job)
+    assert _wait(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in dev_server.state.allocs_by_job("default", "rawexec-job")),
+        timeout=10.0)
+    alloc = dev_server.state.allocs_by_job("default", "rawexec-job")[0]
+    out = (tmp_path / alloc.id / tg.tasks[0].name / "local" / "out")
+    assert out.read_text().strip() == "from-rawexec-job"
+    client.shutdown()
+
+
+def test_client_restore_completes_after_restart(dev_server, tmp_path):
+    """Agent restart: persisted state lets the new client re-attach
+    (mock driver handles re-arm their script clocks)."""
+    client = Client(LocalServerConn(dev_server), str(tmp_path),
+                    name="restore-client")
+    client.start()
+    assert _wait(lambda: dev_server.state.node_by_id(client.node.id)
+                 is not None)
+    job = mock.job(id="restore-job")
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].config = {"run_for": "2s"}
+    dev_server.register_job(job)
+    assert _wait(lambda: any(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in dev_server.state.allocs_by_job("default", "restore-job")))
+
+    # hard-stop the agent (no graceful stop of tasks), then restart
+    client._shutdown.set()
+    time.sleep(0.2)
+
+    client2 = Client(LocalServerConn(dev_server), str(tmp_path),
+                     name="restore-client")
+    assert client2.node.id == client.node.id    # identity restored
+    client2.start()
+    assert _wait(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in dev_server.state.allocs_by_job("default", "restore-job")),
+        timeout=10.0), [a.client_status for a in
+                        dev_server.state.allocs_by_job("default",
+                                                       "restore-job")]
+    client2.shutdown()
+
+
+def test_state_db_roundtrip(tmp_path):
+    from nomad_tpu.client.task_runner import TaskState
+    from nomad_tpu.client.drivers import TaskHandle
+    db = StateDB(str(tmp_path))
+    db.put_node_id("node-abc")
+    st = TaskState(state="running", restarts=1, started_at=123.0)
+    db.put_alloc("a1", 7)
+    db.put_task_state("a1", "web", st,
+                      TaskHandle(task_id="t1", driver="mock", pid=42))
+    db2 = StateDB(str(tmp_path))
+    assert db2.node_id() == "node-abc"
+    tasks = db2.get_alloc_tasks("a1")
+    state, handle = tasks["web"]
+    assert state.state == "running" and state.restarts == 1
+    assert handle.pid == 42 and handle.driver == "mock"
