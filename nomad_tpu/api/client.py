@@ -1,0 +1,217 @@
+"""Python API client for the /v1/* HTTP surface.
+
+Semantic parity with /root/reference/api/ (the separate Go client module:
+api.go Client + one file per resource -- jobs.go, allocations.go, nodes.go,
+evaluations.go, operator.go, event_stream.go). Also provides
+`HttpServerConn`, the client-agent transport over this API -- making node
+agents deployable on separate hosts from the servers, like the reference's
+client->server RPC.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..structs import Allocation, Node, codec
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(f"HTTP {status}: {msg}")
+        self.status = status
+
+
+class ApiClient:
+    """(reference: api/api.go Client)"""
+
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 namespace: str = "default", token: str = "",
+                 timeout: float = 10.0):
+        self.address = address.rstrip("/")
+        self.namespace = namespace
+        self.token = token
+        self.timeout = timeout
+
+    # -- low-level -----------------------------------------------------
+    def _url(self, path: str, params: Optional[Dict[str, Any]] = None) -> str:
+        params = dict(params or {})
+        params.setdefault("namespace", self.namespace)
+        qs = urllib.parse.urlencode(params)
+        return f"{self.address}{path}?{qs}"
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[Dict[str, Any]] = None,
+                timeout: Optional[float] = None) -> Any:
+        req = urllib.request.Request(
+            self._url(path, params), method=method,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"X-Nomad-Token": self.token}
+                        if self.token else {})})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:   # noqa: BLE001
+                detail = str(e)
+            raise ApiError(e.code, detail) from e
+
+    def get(self, path: str, **params) -> Any:
+        return self.request("GET", path, params=params)
+
+    def post(self, path: str, body: Optional[dict] = None, **params) -> Any:
+        return self.request("POST", path, body=body, params=params)
+
+    def delete(self, path: str, **params) -> Any:
+        return self.request("DELETE", path, params=params)
+
+    # -- jobs (reference: api/jobs.go) ---------------------------------
+    def jobs(self) -> List[dict]:
+        return self.get("/v1/jobs")
+
+    def job(self, job_id: str) -> dict:
+        return self.get(f"/v1/job/{job_id}")
+
+    def register_job(self, job: dict) -> dict:
+        return self.post("/v1/jobs", {"job": job})
+
+    def register_job_hcl(self, hcl: str,
+                         variables: Optional[dict] = None) -> dict:
+        return self.post("/v1/jobs", {"job_hcl": hcl,
+                                      "variables": variables or {}})
+
+    def parse_job(self, hcl: str, variables: Optional[dict] = None) -> dict:
+        return self.post("/v1/jobs/parse", {"job_hcl": hcl,
+                                            "variables": variables or {}})
+
+    def plan_job(self, job_id: str, job: Optional[dict] = None,
+                 hcl: Optional[str] = None,
+                 variables: Optional[dict] = None) -> dict:
+        body: Dict[str, Any] = {}
+        if hcl is not None:
+            body["job_hcl"] = hcl
+            body["variables"] = variables or {}
+        else:
+            body["job"] = job or {}
+        return self.post(f"/v1/job/{job_id}/plan", body)
+
+    def deregister_job(self, job_id: str, purge: bool = False) -> dict:
+        return self.delete(f"/v1/job/{job_id}",
+                           purge="true" if purge else "false")
+
+    def job_allocations(self, job_id: str) -> List[dict]:
+        return self.get(f"/v1/job/{job_id}/allocations")
+
+    def job_evaluations(self, job_id: str) -> List[dict]:
+        return self.get(f"/v1/job/{job_id}/evaluations")
+
+    def job_deployment(self, job_id: str) -> Optional[dict]:
+        return self.get(f"/v1/job/{job_id}/deployment")
+
+    # -- nodes (reference: api/nodes.go) -------------------------------
+    def nodes(self) -> List[dict]:
+        return self.get("/v1/nodes")
+
+    def node(self, node_id: str) -> dict:
+        return self.get(f"/v1/node/{node_id}")
+
+    def drain_node(self, node_id: str, enable: bool = True,
+                   deadline_s: float = 3600.0) -> dict:
+        spec = {"deadline_s": deadline_s} if enable else None
+        return self.post(f"/v1/node/{node_id}/drain",
+                         {"drain_spec": spec})
+
+    def node_eligibility(self, node_id: str, eligible: bool) -> dict:
+        return self.post(f"/v1/node/{node_id}/eligibility",
+                         {"eligibility":
+                          "eligible" if eligible else "ineligible"})
+
+    # -- allocs / evals / deployments ----------------------------------
+    def allocations(self) -> List[dict]:
+        return self.get("/v1/allocations")
+
+    def allocation(self, alloc_id: str) -> dict:
+        return self.get(f"/v1/allocation/{alloc_id}")
+
+    def evaluations(self) -> List[dict]:
+        return self.get("/v1/evaluations")
+
+    def evaluation(self, eval_id: str) -> dict:
+        return self.get(f"/v1/evaluation/{eval_id}")
+
+    def deployments(self) -> List[dict]:
+        return self.get("/v1/deployments")
+
+    # -- operator / system (reference: api/operator.go) ----------------
+    def scheduler_config(self) -> dict:
+        return self.get("/v1/operator/scheduler/configuration")
+
+    def set_scheduler_config(self, **cfg) -> dict:
+        return self.post("/v1/operator/scheduler/configuration", cfg)
+
+    def members(self) -> dict:
+        return self.get("/v1/agent/members")
+
+    def leader(self) -> str:
+        return self.get("/v1/status/leader")
+
+    def system_gc(self) -> dict:
+        return self.post("/v1/system/gc")
+
+    def metrics(self) -> dict:
+        return self.get("/v1/metrics")
+
+    def events(self, index: int = 0) -> List[dict]:
+        return self.get("/v1/event/stream", index=index)
+
+
+class HttpServerConn:
+    """Client-agent transport over the HTTP API (the remote deployment
+    shape; reference: client->server msgpack RPC, nomad/client_rpc.go).
+    Implements the ServerConn interface from nomad_tpu.client.client."""
+
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 timeout: float = 10.0):
+        self.api = ApiClient(address, timeout=timeout)
+
+    def register_node(self, node: Node) -> None:
+        self.api.post("/v1/node/register", {"node": codec.encode(node)})
+
+    def heartbeat(self, node_id: str) -> float:
+        try:
+            reply = self.api.post(f"/v1/node/{node_id}/heartbeat")
+        except ApiError as e:
+            if e.status == 404:     # unknown node: caller must re-register
+                return 0.0
+            raise
+        return float(reply.get("heartbeat_ttl", 0.0))
+
+    def pull_allocs(self, node_id: str, min_index: int,
+                    timeout: float) -> tuple:
+        reply = self.api.request(
+            "GET", f"/v1/node/{node_id}/allocations",
+            params={"index": min_index, "wait": f"{timeout}s"},
+            timeout=timeout + 5.0)
+        allocs = codec.decode(List[Allocation], reply.get("allocs", []))
+        return allocs, int(reply.get("index", min_index))
+
+    def update_allocs(self, updates: List[Allocation]) -> None:
+        self.api.post("/v1/node/allocs-update",
+                      {"allocs": [codec.encode(a) for a in updates]})
+
+    def get_alloc(self, alloc_id: str) -> Optional[Allocation]:
+        try:
+            data = self.api.get(f"/v1/allocation/{alloc_id}")
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return codec.decode(Allocation, data)

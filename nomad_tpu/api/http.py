@@ -154,7 +154,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length) or b"{}")
 
-    def _blocking(self, query) -> int:
+    def _blocking(self, query, tables=()) -> int:
         """Apply ?index/?wait blocking semantics; returns current index."""
         q = parse_qs(query)
         if "index" in q:
@@ -162,7 +162,8 @@ class ApiHandler(BaseHTTPRequestHandler):
             wait = 5.0
             if "wait" in q:
                 wait = float(q["wait"][0].rstrip("s"))
-            return self.nomad.state.block_until(min_index, timeout=wait)
+            return self.nomad.state.block_until(min_index, timeout=wait,
+                                                tables=tables)
         return self.nomad.state.latest_index()
 
     # ------------------------------------------------------------------
@@ -171,7 +172,12 @@ class ApiHandler(BaseHTTPRequestHandler):
         parts = [p for p in url.path.split("/") if p]
         state = self.nomad.state
         try:
-            index = self._blocking(url.query)
+            # the node alloc watch blocks on the allocs table only, so
+            # unrelated writes don't wake every polling node
+            tables = (("allocs",) if parts[:2] == ["v1", "node"]
+                      and len(parts) == 4 and parts[3] == "allocations"
+                      else ())
+            index = self._blocking(url.query, tables)
             q = parse_qs(url.query)
             ns = q.get("namespace", ["default"])[0]
             if parts[:2] == ["v1", "jobs"] and len(parts) == 2:
@@ -219,9 +225,29 @@ class ApiHandler(BaseHTTPRequestHandler):
             elif parts == ["v1", "operator", "scheduler", "configuration"]:
                 self._send(200, state.scheduler_config(), index)
             elif parts == ["v1", "status", "leader"]:
-                self._send(200, "local")
+                raft = getattr(self.nomad, "raft", None)
+                if raft is None:
+                    self._send(200, "local")
+                else:
+                    lid, addr = raft.leader()
+                    self._send(200, f"{addr[0]}:{addr[1]}" if addr else lid)
+            elif parts == ["v1", "agent", "members"]:
+                serf = getattr(self.nomad, "serf", None)
+                if serf is None:
+                    self._send(200, {"members": [
+                        {"name": "local", "status": "alive"}]})
+                else:
+                    self._send(200, {"members": [
+                        m.to_wire() for m in serf.members()]})
             elif parts == ["v1", "agent", "health"]:
                 self._send(200, {"server": {"ok": True}})
+            elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
+                    parts[3] == "allocations":
+                from ..structs import codec
+                allocs = state.allocs_by_node(parts[2])
+                self._send(200, {"allocs": [codec.encode(a)
+                                            for a in allocs],
+                                 "index": index}, index)
             elif parts == ["v1", "event", "stream"]:
                 since = int(q.get("index", ["0"])[0])
                 self._send(200, self.nomad.events_since(since), index)
@@ -241,14 +267,51 @@ class ApiHandler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
-            if parts[:2] == ["v1", "jobs"]:
+            if parts == ["v1", "jobs", "parse"]:
+                # (reference: /v1/jobs/parse -- HCL -> api.Job JSON)
+                from ..jobspec import parse as parse_jobspec
                 body = self._body()
-                job = job_from_json(body.get("job", body))
+                job = parse_jobspec(body.get("job_hcl", ""),
+                                    body.get("variables") or {})
+                self._send(200, job)
+            elif parts == ["v1", "jobs"]:
+                body = self._body()
+                job = self._job_from_body(body)
                 if not job.id:
                     return self._error(400, "job id required")
                 ev = self.nomad.register_job(job)
                 self._send(200, {"eval_id": ev.id if ev else "",
                                  "job_modify_index": job.job_modify_index})
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "plan":
+                body = self._body()
+                job = self._job_from_body(body)
+                self._send(200, self.nomad.plan_job(job))
+            elif parts == ["v1", "node", "register"]:
+                from ..structs import Node, codec
+                node = codec.decode(Node, self._body().get("node", {}))
+                self.nomad.register_node(node)
+                self._send(200, {"node_id": node.id,
+                                 "heartbeat_ttl":
+                                     self.nomad.heartbeat_ttl})
+            elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
+                    parts[3] == "heartbeat":
+                ttl = self.nomad.heartbeat(parts[2])
+                if not ttl:
+                    # unknown node: force the client to re-register
+                    # (reference: heartbeats to unknown nodes error so the
+                    # client retries registration)
+                    return self._error(404, "node not found")
+                self._send(200, {"heartbeat_ttl": ttl})
+            elif parts == ["v1", "node", "allocs-update"]:
+                from ..structs import Allocation, codec
+                from typing import List as _L
+                allocs = codec.decode(_L[Allocation],
+                                      self._body().get("allocs", []))
+                self.nomad.update_allocs_from_client(allocs)
+                self._send(200, {"updated": len(allocs)})
+            elif parts == ["v1", "system", "gc"]:
+                self._send(200, self.nomad.run_gc_once())
             elif parts == ["v1", "operator", "scheduler", "configuration"]:
                 body = self._body()
                 cfg = SchedulerConfiguration(
@@ -292,6 +355,15 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._send(200, {"eval_id": ev.id})
         else:
             self._error(404, f"unknown path {url.path}")
+
+    def _job_from_body(self, body: dict):
+        """Accept either JSON jobspec or inline HCL
+        (reference: job endpoints accept api.Job; parse is separate)."""
+        if "job_hcl" in body:
+            from ..jobspec import parse as parse_jobspec
+            return parse_jobspec(body["job_hcl"],
+                                 body.get("variables") or {})
+        return job_from_json(body.get("job", body))
 
     # ------------------------------------------------------------------
     def _job_stub(self, j) -> dict:

@@ -1,0 +1,365 @@
+"""Operator CLI: `python -m nomad_tpu.cli <command> ...`.
+
+Semantic parity with /root/reference/command/ (mitchellh/cli commands,
+main.go:26): job run/plan/status/stop/inspect, node status/drain/
+eligibility, alloc status, eval list/status, deployment list/status,
+operator scheduler get-config/set-config, server members, system gc,
+agent -dev. Talks to the HTTP API through nomad_tpu.api.client.ApiClient,
+exactly as the reference CLI rides its api/ module.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .api.client import ApiClient, ApiError
+
+
+def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
+    cols = [headers] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in cols) for i in range(len(headers))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        lines.append("  ".join(str(c).ljust(w)
+                               for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def _client(args) -> ApiClient:
+    addr = args.address or os.environ.get("NOMAD_ADDR",
+                                          "http://127.0.0.1:4646")
+    return ApiClient(addr, namespace=args.namespace,
+                     token=os.environ.get("NOMAD_TOKEN", ""))
+
+
+def _parse_vars(pairs: List[str]) -> dict:
+    out = {}
+    for p in pairs or []:
+        if "=" not in p:
+            raise SystemExit(f"bad -var {p!r}, want key=value")
+        k, v = p.split("=", 1)
+        out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+def cmd_agent(args) -> int:
+    from .api.devagent import main as devagent_main
+    argv = ["--nodes", str(args.nodes), "--port", str(args.port),
+            "--workers", str(args.workers)]
+    if args.tpu:
+        argv.append("--tpu")
+    return devagent_main(argv)
+
+
+def cmd_job_run(args) -> int:
+    api = _client(args)
+    variables = _parse_vars(args.var)
+    path = args.file
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    if path.endswith(".json"):
+        reply = api.register_job(json.loads(src))
+    else:
+        reply = api.register_job_hcl(src, variables)
+    print(f"==> Evaluation {reply.get('eval_id', '')!r} submitted")
+    return 0
+
+
+def cmd_job_plan(args) -> int:
+    api = _client(args)
+    with open(args.file, encoding="utf-8") as fh:
+        src = fh.read()
+    if args.file.endswith(".json"):
+        job = json.loads(src)
+        job = job.get("job", job)       # accept the wrapped shape too
+        job_id = str(job.get("id", ""))
+        if not job_id:
+            print("Error: job spec has no 'id'", file=sys.stderr)
+            return 1
+        reply = api.plan_job(job_id, job=job)
+    else:
+        # send the HCL itself: the server parses it with the full jobspec
+        # mapper (devices/spreads/volumes survive; the JSON round-trip
+        # through job_from_json is lossier)
+        job = api.parse_job(src, _parse_vars(args.var))
+        job_id = job["id"]
+        reply = api.plan_job(job_id, hcl=src,
+                             variables=_parse_vars(args.var))
+    print(f"+/- Job: {job_id!r} ({reply.get('diff_type')})")
+    print(f"    placed: {reply.get('placed')}  "
+          f"stopped: {reply.get('stopped')}")
+    failed = reply.get("failed_tg_allocs") or {}
+    for tg, metric in failed.items():
+        print(f"    WARNING: group {tg!r} would fail placement: "
+              f"{metric.get('nodes_evaluated', 0)} nodes evaluated, "
+              f"{metric.get('nodes_filtered', 0)} filtered, "
+              f"exhausted: {metric.get('dimension_exhausted', {})}")
+    for tg, counts in (reply.get("annotations") or {}).get(
+            "desired_tg_updates", {}).items():
+        shown = {k: v for k, v in counts.items() if v}
+        print(f"    group {tg!r}: {shown}")
+    print(f"    job modify index: {reply.get('job_modify_index')}")
+    return 1 if failed else 0
+
+
+def cmd_job_status(args) -> int:
+    api = _client(args)
+    if not args.id:
+        jobs = api.jobs()
+        print(_fmt_table(
+            [[j["id"], j["type"], str(j["priority"]), j["status"]]
+             for j in jobs],
+            ["ID", "Type", "Priority", "Status"]))
+        return 0
+    job = api.job(args.id)
+    print(f"ID            = {job['id']}")
+    print(f"Name          = {job['name']}")
+    print(f"Type          = {job['type']}")
+    print(f"Priority      = {job['priority']}")
+    print(f"Status        = {job['status']}")
+    print(f"Version       = {job['version']}")
+    allocs = api.job_allocations(args.id)
+    if allocs:
+        print("\nAllocations")
+        print(_fmt_table(
+            [[a["id"][:8], a["task_group"], a["node_id"][:8],
+              a["desired_status"], a["client_status"]] for a in allocs],
+            ["ID", "Task Group", "Node", "Desired", "Status"]))
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    api = _client(args)
+    reply = api.deregister_job(args.id, purge=args.purge)
+    print(f"==> Evaluation {reply.get('eval_id', '')!r} submitted")
+    return 0
+
+
+def cmd_job_inspect(args) -> int:
+    print(json.dumps(_client(args).job(args.id), indent=2, default=str))
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    api = _client(args)
+    if not args.id:
+        nodes = api.nodes()
+        print(_fmt_table(
+            [[n["id"][:8], n["name"], n["datacenter"], n["node_class"],
+              "true" if n["drain"] else "false",
+              n["scheduling_eligibility"], n["status"]] for n in nodes],
+            ["ID", "Name", "DC", "Class", "Drain", "Eligibility",
+             "Status"]))
+        return 0
+    n = api.node(args.id)
+    print(json.dumps(n, indent=2, default=str))
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    api = _client(args)
+    api.drain_node(args.id, enable=args.enable,
+                   deadline_s=args.deadline)
+    print(f"Node {args.id!r} drain "
+          f"{'enabled' if args.enable else 'disabled'}")
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    api = _client(args)
+    api.node_eligibility(args.id, eligible=args.enable)
+    print(f"Node {args.id!r} marked "
+          f"{'eligible' if args.enable else 'ineligible'}")
+    return 0
+
+
+def cmd_alloc_status(args) -> int:
+    a = _client(args).allocation(args.id)
+    print(f"ID         = {a['id']}")
+    print(f"Name       = {a['name']}")
+    print(f"Node       = {a['node_id']}")
+    print(f"Job        = {a['job_id']}")
+    print(f"Desired    = {a['desired_status']}")
+    print(f"Status     = {a['client_status']}")
+    metrics = a.get("metrics") or {}
+    scores = metrics.get("scores") or {}
+    if scores:
+        print("\nPlacement Metrics")
+        for key, score in sorted(scores.items())[:8]:
+            print(f"  {key} = {score:.4f}"
+                  if isinstance(score, float) else f"  {key} = {score}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    api = _client(args)
+    if args.id:
+        print(json.dumps(api.evaluation(args.id), indent=2, default=str))
+    else:
+        evals = api.evaluations()
+        print(_fmt_table(
+            [[e["id"][:8], e["priority"], e["triggered_by"], e["job_id"],
+              e["status"]] for e in evals],
+            ["ID", "Priority", "Triggered By", "Job ID", "Status"]))
+    return 0
+
+
+def cmd_deployment(args) -> int:
+    api = _client(args)
+    deps = api.deployments()
+    print(_fmt_table(
+        [[d["id"][:8], d["job_id"], str(d["job_version"]), d["status"],
+          d["status_description"]] for d in deps],
+        ["ID", "Job ID", "Version", "Status", "Description"]))
+    return 0
+
+
+def cmd_operator_scheduler(args) -> int:
+    api = _client(args)
+    if args.algorithm:
+        api.set_scheduler_config(scheduler_algorithm=args.algorithm,
+                                 memory_oversubscription_enabled=args.memory_oversub)
+        print(f"Scheduler algorithm set to {args.algorithm!r}")
+    cfg = api.scheduler_config()
+    print(json.dumps(cfg, indent=2, default=str))
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    reply = _client(args).members()
+    print(_fmt_table(
+        [[m["name"], f"{m['addr'][0]}:{m['addr'][1]}"
+          if isinstance(m.get("addr"), list) else "-",
+          m["status"]] for m in reply.get("members", [])],
+        ["Name", "Address", "Status"]))
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    print(json.dumps(_client(args).system_gc()))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    print(json.dumps(_client(args).metrics(), indent=2, default=str))
+    return 0
+
+
+def cmd_version(args) -> int:
+    from .client.fingerprint import VERSION
+    print(f"nomad-tpu v{VERSION} (tpu-native cluster scheduler)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu")
+    p.add_argument("-address", dest="address", default="")
+    p.add_argument("-namespace", dest="namespace", default="default")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent", help="run the dev agent")
+    ag.add_argument("-dev", action="store_true", default=True)
+    ag.add_argument("--nodes", type=int, default=3)
+    ag.add_argument("--port", type=int, default=4646)
+    ag.add_argument("--workers", type=int, default=2)
+    ag.add_argument("--tpu", action="store_true")
+    ag.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands").add_subparsers(
+        dest="sub", required=True)
+    jr = job.add_parser("run")
+    jr.add_argument("file")
+    jr.add_argument("-var", action="append", default=[])
+    jr.set_defaults(fn=cmd_job_run)
+    jp = job.add_parser("plan")
+    jp.add_argument("file")
+    jp.add_argument("-var", action="append", default=[])
+    jp.set_defaults(fn=cmd_job_plan)
+    js = job.add_parser("status")
+    js.add_argument("id", nargs="?", default="")
+    js.set_defaults(fn=cmd_job_status)
+    jst = job.add_parser("stop")
+    jst.add_argument("id")
+    jst.add_argument("-purge", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+    ji = job.add_parser("inspect")
+    ji.add_argument("id")
+    ji.set_defaults(fn=cmd_job_inspect)
+
+    node = sub.add_parser("node", help="node commands").add_subparsers(
+        dest="sub", required=True)
+    ns = node.add_parser("status")
+    ns.add_argument("id", nargs="?", default="")
+    ns.set_defaults(fn=cmd_node_status)
+    nd = node.add_parser("drain")
+    nd.add_argument("id")
+    g = nd.add_mutually_exclusive_group(required=True)
+    g.add_argument("-enable", dest="enable", action="store_true")
+    g.add_argument("-disable", dest="enable", action="store_false")
+    nd.add_argument("-deadline", type=float, default=3600.0)
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = node.add_parser("eligibility")
+    ne.add_argument("id")
+    g = ne.add_mutually_exclusive_group(required=True)
+    g.add_argument("-enable", dest="enable", action="store_true")
+    g.add_argument("-disable", dest="enable", action="store_false")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    al = sub.add_parser("alloc", help="alloc commands").add_subparsers(
+        dest="sub", required=True)
+    als = al.add_parser("status")
+    als.add_argument("id")
+    als.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval", help="eval commands")
+    ev.add_argument("id", nargs="?", default="")
+    ev.set_defaults(fn=cmd_eval)
+
+    dep = sub.add_parser("deployment", help="deployment list")
+    dep.set_defaults(fn=cmd_deployment)
+
+    op = sub.add_parser("operator").add_subparsers(dest="sub",
+                                                   required=True)
+    osch = op.add_parser("scheduler")
+    osch.add_argument("-scheduler-algorithm", dest="algorithm", default="")
+    osch.add_argument("-memory-oversubscription", dest="memory_oversub",
+                      action="store_true")
+    osch.set_defaults(fn=cmd_operator_scheduler)
+
+    srv = sub.add_parser("server").add_subparsers(dest="sub",
+                                                  required=True)
+    sm = srv.add_parser("members")
+    sm.set_defaults(fn=cmd_server_members)
+
+    sysp = sub.add_parser("system").add_subparsers(dest="sub",
+                                                   required=True)
+    sg = sysp.add_parser("gc")
+    sg.set_defaults(fn=cmd_system_gc)
+
+    mt = sub.add_parser("metrics")
+    mt.set_defaults(fn=cmd_metrics)
+
+    vr = sub.add_parser("version")
+    vr.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,7 +170,12 @@ class Client:
                 ttl = self.conn.heartbeat(self.node.id)
                 if ttl:
                     self.heartbeat_ttl = ttl
-                self._last_ok_heartbeat = time.time()
+                    self._last_ok_heartbeat = time.time()
+                else:
+                    # server doesn't know us (restart/state loss):
+                    # re-register (reference: client retryRegisterNode on
+                    # heartbeat 'node not found')
+                    self.conn.register_node(self.node)
             except Exception:   # noqa: BLE001 - server unreachable
                 pass
 

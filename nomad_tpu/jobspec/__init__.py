@@ -1,0 +1,3 @@
+"""Job specification language (reference: /root/reference/jobspec2/)."""
+from .hcl import Block, HclError, parse_hcl  # noqa: F401
+from .parse import duration, parse, parse_file  # noqa: F401

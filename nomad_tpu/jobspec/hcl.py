@@ -1,0 +1,334 @@
+"""HCL tokenizer + parser (the generic half of the jobspec language).
+
+The reference parses job files with HCL2 (reference: jobspec2/parse.go:21
+using hashicorp/hcl/v2; legacy HCL1 in jobspec/). This is a from-scratch
+parser for the HCL subset job files actually use: blocks with string
+labels, attributes, strings with escape + ${...} interpolation (kept
+verbatim for runtime interpolation unless it's a resolvable var/local
+reference), numbers, bools, null, lists, objects, heredocs, and the three
+comment forms. Output is a generic tree (Body of Attribute|Block) that
+parse.py maps onto Job structs.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+
+class HclError(Exception):
+    def __init__(self, msg: str, line: int = 0):
+        super().__init__(f"line {line}: {msg}" if line else msg)
+        self.line = line
+
+
+@dataclass
+class Attribute:
+    name: str
+    value: Any
+    line: int = 0
+
+
+@dataclass
+class Block:
+    type: str
+    labels: List[str] = field(default_factory=list)
+    body: List[Union["Block", Attribute]] = field(default_factory=list)
+    line: int = 0
+
+    # -- conveniences used by the mapper -------------------------------
+    def attrs(self) -> Dict[str, Any]:
+        return {i.name: i.value for i in self.body
+                if isinstance(i, Attribute)}
+
+    def blocks(self, btype: Optional[str] = None) -> List["Block"]:
+        out = [i for i in self.body if isinstance(i, Block)]
+        if btype is not None:
+            out = [b for b in out if b.type == btype]
+        return out
+
+    def first(self, btype: str) -> Optional["Block"]:
+        bs = self.blocks(btype)
+        return bs[0] if bs else None
+
+    def label(self, k: int = 0, default: str = "") -> str:
+        return self.labels[k] if k < len(self.labels) else default
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>[ \t\r]+)
+  | (?P<comment>\#[^\n]*|//[^\n]*|/\*.*?\*/)
+  | (?P<heredoc><<-?(?P<hd_tag>[A-Za-z_][A-Za-z0-9_]*)\n)
+  | (?P<string>"(?:\\.|\$\{[^}]*\}|[^"\\])*")
+  | (?P<number>-?\d+(?:\.\d+)?(?![A-Za-z_]))
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.\-]*)
+  | (?P<punct>[={}\[\],:\n()])
+""", re.VERBOSE | re.DOTALL)
+
+
+@dataclass
+class Token:
+    kind: str
+    value: str
+    line: int
+
+
+def tokenize(src: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos, line = 0, 1
+    n = len(src)
+    while pos < n:
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise HclError(f"unexpected character {src[pos]!r}", line)
+        kind = m.lastgroup or ""
+        text = m.group(0)
+        if kind == "heredoc":
+            tag = m.group("hd_tag")
+            line += 1
+            end_re = re.compile(rf"^[ \t]*{re.escape(tag)}[ \t]*$",
+                                re.MULTILINE)
+            em = end_re.search(src, m.end())
+            if em is None:
+                raise HclError(f"heredoc {tag} unterminated", line)
+            content = src[m.end():em.start()]
+            tokens.append(Token("string", content, line))
+            line += content.count("\n") + 1
+            pos = em.end()
+            continue
+        if kind == "ws":
+            pass
+        elif kind == "comment":
+            line += text.count("\n")
+        elif kind == "punct" and text == "\n":
+            tokens.append(Token("newline", text, line))
+            line += 1
+        elif kind == "string":
+            tokens.append(Token("string", _unquote(text, line), line))
+        else:
+            tokens.append(Token(kind, text, line))
+        pos = m.end()
+    tokens.append(Token("eof", "", line))
+    return tokens
+
+
+def _unquote(text: str, line: int) -> str:
+    body = text[1:-1]
+    out = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            esc = body[i + 1]
+            out.append({"n": "\n", "t": "\t", '"': '"', "\\": "\\",
+                        "r": "\r"}.get(esc, esc))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# parser
+
+class Parser:
+    def __init__(self, tokens: List[Token],
+                 variables: Optional[Dict[str, Any]] = None):
+        self.tokens = tokens
+        self.i = 0
+        self.variables = variables if variables is not None else {}
+
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def skip_newlines(self) -> None:
+        while self.peek().kind == "newline":
+            self.next()
+
+    def parse_body(self, root: bool = False) -> List[Union[Block, Attribute]]:
+        items: List[Union[Block, Attribute]] = []
+        while True:
+            self.skip_newlines()
+            t = self.peek()
+            if t.kind == "eof":
+                if not root:
+                    raise HclError("unexpected EOF in block", t.line)
+                return items
+            if t.kind == "punct" and t.value == "}":
+                if root:
+                    raise HclError("unexpected '}'", t.line)
+                return items
+            if t.kind != "ident":
+                raise HclError(f"expected identifier, got {t.value!r}",
+                               t.line)
+            items.append(self.parse_item())
+
+    def parse_item(self) -> Union[Block, Attribute]:
+        name = self.next()
+        t = self.peek()
+        if t.kind == "punct" and t.value == "=":
+            self.next()
+            value = self.parse_expr()
+            return Attribute(name=name.value, value=value, line=name.line)
+        # block: labels then {
+        labels: List[str] = []
+        while self.peek().kind in ("string", "ident"):
+            labels.append(self.next().value)
+        t = self.peek()
+        if not (t.kind == "punct" and t.value == "{"):
+            raise HclError(f"expected '{{' after {name.value}", t.line)
+        self.next()
+        body = self.parse_body()
+        close = self.next()
+        if not (close.kind == "punct" and close.value == "}"):
+            raise HclError("expected '}'", close.line)
+        return Block(type=name.value, labels=labels, body=body,
+                     line=name.line)
+
+    def parse_expr(self) -> Any:
+        self.skip_newlines()
+        t = self.next()
+        if t.kind == "string":
+            return self._interp(t.value, t.line)
+        if t.kind == "number":
+            return float(t.value) if "." in t.value else int(t.value)
+        if t.kind == "ident":
+            if t.value == "true":
+                return True
+            if t.value == "false":
+                return False
+            if t.value == "null":
+                return None
+            return self._resolve_ref(t.value, t.line)
+        if t.kind == "punct" and t.value == "[":
+            return self._parse_list()
+        if t.kind == "punct" and t.value == "{":
+            return self._parse_object()
+        raise HclError(f"unexpected token {t.value!r} in expression",
+                       t.line)
+
+    def _parse_list(self) -> List[Any]:
+        out = []
+        while True:
+            self.skip_newlines()
+            t = self.peek()
+            if t.kind == "punct" and t.value == "]":
+                self.next()
+                return out
+            out.append(self.parse_expr())
+            self.skip_newlines()
+            if self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+
+    def _parse_object(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        while True:
+            self.skip_newlines()
+            t = self.peek()
+            if t.kind == "punct" and t.value == "}":
+                self.next()
+                return out
+            key = self.next()
+            if key.kind not in ("ident", "string"):
+                raise HclError(f"bad object key {key.value!r}", key.line)
+            sep = self.next()
+            if not (sep.kind == "punct" and sep.value in ("=", ":")):
+                raise HclError("expected '=' or ':' in object", sep.line)
+            out[key.value] = self.parse_expr()
+            self.skip_newlines()
+            if self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+
+    # -- references & interpolation ------------------------------------
+    def _resolve_ref(self, path: str, line: int) -> Any:
+        if path.startswith("var."):
+            name = path[len("var."):]
+            if name in self.variables:
+                return self.variables[name]
+            raise HclError(f"undefined variable {name!r}", line)
+        if path.startswith("local."):
+            name = path[len("local."):]
+            if name in self.variables:
+                return self.variables[name]
+            raise HclError(f"undefined local {name!r}", line)
+        # bare identifier (e.g. unquoted enum-ish value): keep as string
+        return path
+
+    _INTERP_RE = re.compile(r"\$\{(var|local)\.([A-Za-z0-9_\-]+)\}")
+
+    def _interp(self, s: str, line: int) -> str:
+        """Substitute ${var.x}/${local.x}; other ${...} (NOMAD_*, node.*,
+        attr.*) are runtime interpolations and pass through verbatim."""
+
+        def repl(m: re.Match) -> str:
+            name = m.group(2)
+            if name in self.variables:
+                return str(self.variables[name])
+            raise HclError(f"undefined variable {name!r}", line)
+
+        return self._INTERP_RE.sub(repl, s)
+
+
+def parse_hcl(src: str, variables: Optional[Dict[str, Any]] = None
+              ) -> Block:
+    """Parse source into a synthetic root Block. `variable` blocks at the
+    root supply defaults; caller `variables` override them
+    (reference: jobspec2 ParseWithConfig VarContent/ArgVars)."""
+    tokens = tokenize(src)
+    # first pass without variables to harvest variable/locals defaults
+    defaults: Dict[str, Any] = {}
+    probe = Parser(tokens, variables=_Everything())
+    try:
+        items = probe.parse_body(root=True)
+    except HclError:
+        items = None
+    if items is not None:
+        for it in items:
+            if isinstance(it, Block) and it.type == "variable" and it.labels:
+                attrs = it.attrs()
+                if "default" in attrs:
+                    defaults[it.labels[0]] = attrs["default"]
+    merged = dict(defaults)
+    merged.update(variables or {})
+    if items is not None and any(
+            isinstance(it, Block) and it.type == "locals" for it in items):
+        # locals may reference variables: re-evaluate them with the real
+        # variable values. Unknown refs (e.g. a local used elsewhere in
+        # the file) resolve to placeholders in THIS pass only.
+        lp = Parser(tokens, variables=_Fallback(merged))
+        for it in lp.parse_body(root=True):
+            if isinstance(it, Block) and it.type == "locals":
+                merged.update(it.attrs())
+    parser = Parser(tokens, variables=merged)
+    root = Block(type="root", body=parser.parse_body(root=True))
+    return root
+
+
+class _Fallback(dict):
+    """Resolves known names to their real values, everything else to ''."""
+
+    def __contains__(self, key) -> bool:
+        return True
+
+    def __getitem__(self, key):
+        return self.get(key, "")
+
+
+class _Everything(dict):
+    """Probe-pass variable context: resolves anything to a placeholder so
+    the first parse succeeds before defaults are known."""
+
+    def __contains__(self, key) -> bool:
+        return True
+
+    def __getitem__(self, key):
+        return ""

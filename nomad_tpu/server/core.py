@@ -205,6 +205,75 @@ class Server:
         self.publish_event("JobDeregistered", {"job_id": job_id})
         return ev
 
+    def plan_job(self, job: Job) -> dict:
+        """Dry-run the scheduler against a copy of current state
+        (reference: Job.Plan nomad/job_endpoint.go -- inserts the candidate
+        job into a state snapshot and runs the scheduler with AnnotatePlan,
+        capturing the plan instead of committing it)."""
+        from ..raft.fsm import dump_state, restore_state
+        from ..scheduler.harness import Harness
+        from ..state import StateStore
+
+        real = getattr(self.state, "_store", self.state)
+        temp = StateStore()
+        restore_state(temp, dump_state(real))
+        h = Harness(temp)
+        temp.upsert_job(job)
+        ev = Evaluation(
+            id=generate_uuid(), namespace=job.namespace,
+            priority=job.priority, type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=EVAL_STATUS_PENDING, annotate_plan=True)
+        temp.upsert_evals([ev])
+        sched_type = (job.type if job.type in
+                      ("service", "batch", "system", "sysbatch")
+                      else "service")
+        h.process(sched_type, ev)
+        placed = stopped = 0
+        # DesiredUpdates per task group (reference: scheduler/annotate.go
+        # Annotate -- place/stop/migrate/destructive/ignore counts)
+        tg_updates: Dict[str, Dict[str, int]] = {}
+
+        def bump(tg_name: str, key: str) -> None:
+            tg_updates.setdefault(tg_name, {
+                "place": 0, "stop": 0, "migrate": 0,
+                "preemptions": 0})[key] += 1
+
+        for plan in h.plans:
+            for allocs in plan.node_allocation.values():
+                placed += len(allocs)
+                for alloc in allocs:
+                    bump(alloc.task_group, "place")
+            for allocs in plan.node_update.values():
+                stopped += len(allocs)
+                for alloc in allocs:
+                    bump(alloc.task_group,
+                         "migrate" if (alloc.desired_transition and
+                                       alloc.desired_transition.migrate)
+                         else "stop")
+            for allocs in plan.node_preemptions.values():
+                for alloc in allocs:
+                    bump(alloc.task_group, "preemptions")
+        annotations = ({"desired_tg_updates": tg_updates}
+                       if tg_updates else None)
+        failed = {}
+        for pe in h.evals:
+            for tg_name, metric in (pe.failed_tg_allocs or {}).items():
+                failed[tg_name] = {
+                    "nodes_evaluated": metric.nodes_evaluated,
+                    "nodes_filtered": metric.nodes_filtered,
+                    "constraint_filtered": dict(metric.constraint_filtered),
+                    "dimension_exhausted": dict(metric.dimension_exhausted),
+                }
+        existing = self.state.job_by_id(job.namespace, job.id)
+        return {
+            "placed": placed, "stopped": stopped,
+            "annotations": annotations, "failed_tg_allocs": failed,
+            "job_modify_index":
+                existing.job_modify_index if existing else 0,
+            "diff_type": ("Edited" if existing is not None else "Added"),
+        }
+
     # ------------------------------------------------------------------
     # Node API (reference: nomad/node_endpoint.go)
     def register_node(self, node: Node) -> None:

@@ -1,0 +1,332 @@
+"""Jobspec HCL parsing, API client, and CLI tests.
+
+Mirrors the reference's jobspec2 parse tests (jobspec2/parse_test.go) and
+CLI/api integration patterns (command/ tests against a test agent,
+testutil/server.go black-box flavor -- here the in-process HTTP server).
+"""
+import json
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient, HttpServerConn
+from nomad_tpu.api.http import HttpServer
+from nomad_tpu.cli import main as cli_main
+from nomad_tpu.jobspec import HclError, duration, parse
+from nomad_tpu.server.core import Server
+
+SPEC = """
+variable "image_tag" {
+  default = "v1"
+}
+
+job "web" {
+  datacenters = ["dc1", "dc2"]
+  type        = "service"
+  priority    = 70
+
+  meta {
+    owner = "team-a"
+    tag   = "${var.image_tag}"
+  }
+
+  constraint {
+    attribute = "${attr.kernel.name}"
+    value     = "linux"
+  }
+
+  update {
+    max_parallel     = 2
+    min_healthy_time = "5s"
+    healthy_deadline = "2m"
+    auto_revert      = true
+    canary           = 1
+  }
+
+  group "frontend" {
+    count = 3
+
+    network {
+      mode = "host"
+      port "http" {
+        static = 8080
+      }
+      port "metrics" {}
+    }
+
+    restart {
+      attempts = 3
+      delay    = "10s"
+      interval = "5m"
+      mode     = "delay"
+    }
+
+    reschedule {
+      attempts  = 2
+      interval  = "1h"
+      unlimited = false
+    }
+
+    ephemeral_disk {
+      size = 500
+    }
+
+    spread {
+      attribute = "${node.datacenter}"
+      weight    = 80
+      target "dc1" {
+        percent = 70
+      }
+    }
+
+    task "server" {
+      driver = "raw_exec"
+      leader = true
+
+      config {
+        command = "/bin/httpd"
+        args    = ["-p", "8080"]
+      }
+
+      env {
+        PORT = "8080"
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+
+      template {
+        data        = <<EOF
+listen ${env.PORT}
+EOF
+        destination = "local/httpd.conf"
+      }
+
+      logs {
+        max_files     = 5
+        max_file_size = 20
+      }
+    }
+
+    task "sidecar" {
+      driver = "mock"
+      lifecycle {
+        hook    = "prestart"
+        sidecar = false
+      }
+      config {
+        run_for = "10ms"
+      }
+    }
+  }
+}
+"""
+
+
+def test_duration_parsing():
+    assert duration("30s") == 30.0
+    assert duration("5m") == 300.0
+    assert duration("1h30m") == 5400.0
+    assert duration("250ms") == 0.25
+    assert duration(42) == 42.0
+    assert duration(None, 7.0) == 7.0
+
+
+def test_parse_full_jobspec():
+    job = parse(SPEC)
+    assert job.id == "web" and job.type == "service"
+    assert job.priority == 70
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.meta == {"owner": "team-a", "tag": "v1"}
+    assert job.constraints[0].l_target == "${attr.kernel.name}"
+    assert job.constraints[0].r_target == "linux"
+    assert job.update.max_parallel == 2
+    assert job.update.min_healthy_time_s == 5.0
+    assert job.update.healthy_deadline_s == 120.0
+    assert job.update.auto_revert and job.update.canary == 1
+
+    tg = job.task_groups[0]
+    assert tg.name == "frontend" and tg.count == 3
+    assert tg.networks[0].reserved_ports[0].label == "http"
+    assert tg.networks[0].reserved_ports[0].value == 8080
+    assert tg.networks[0].dynamic_ports[0].label == "metrics"
+    assert tg.restart_policy.attempts == 3
+    assert tg.restart_policy.delay_s == 10.0
+    assert tg.restart_policy.mode == "delay"
+    assert tg.reschedule_policy.attempts == 2
+    assert not tg.reschedule_policy.unlimited
+    assert tg.ephemeral_disk.size_mb == 500
+    assert tg.spreads[0].weight == 80
+    assert tg.spreads[0].spread_target[0].value == "dc1"
+    assert tg.spreads[0].spread_target[0].percent == 70
+
+    server_task = tg.lookup_task("server")
+    assert server_task.driver == "raw_exec" and server_task.leader
+    assert server_task.config["command"] == "/bin/httpd"
+    assert server_task.config["args"] == ["-p", "8080"]
+    assert server_task.env == {"PORT": "8080"}
+    assert server_task.resources.cpu == 500
+    assert server_task.resources.memory_mb == 256
+    assert "listen ${env.PORT}" in server_task.templates[0]["data"]
+    assert server_task.log_config.max_files == 5
+    sidecar = tg.lookup_task("sidecar")
+    assert sidecar.lifecycle == {"hook": "prestart", "sidecar": False}
+
+
+def test_parse_variable_override():
+    job = parse(SPEC, {"image_tag": "v2-override"})
+    assert job.meta["tag"] == "v2-override"
+
+
+def test_parse_errors():
+    with pytest.raises(HclError):
+        parse("job web {")              # unterminated block
+    with pytest.raises(HclError):
+        parse('group "g" {}')           # no job block
+    with pytest.raises(HclError):
+        parse('job "x" { meta = ${var.missing} }')
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def agent():
+    server = Server(num_workers=1, heartbeat_ttl=3.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    yield server, f"http://127.0.0.1:{http.port}"
+    http.shutdown()
+    server.shutdown()
+
+
+def _wait(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+MINI_SPEC = """
+job "mini" {
+  group "g" {
+    count = 2
+    task "t" {
+      driver = "mock"
+      config {
+        run_for = "80ms"
+      }
+      resources {
+        cpu    = 100
+        memory = 64
+      }
+    }
+  }
+}
+"""
+
+
+def test_api_client_hcl_register_and_plan(agent):
+    server, addr = agent
+    from nomad_tpu.client import SimClient
+    clients = [SimClient(server, mock.node()) for _ in range(2)]
+    for c in clients:
+        c.start()
+    api = ApiClient(addr)
+
+    # plan first: job not yet in state
+    parsed = api.parse_job(MINI_SPEC)
+    assert parsed["id"] == "mini"
+    plan = api.plan_job("mini", job=None, hcl=MINI_SPEC)
+    assert plan["diff_type"] == "Added"
+    assert plan["placed"] == 2
+    assert not plan["failed_tg_allocs"]
+
+    reply = api.register_job_hcl(MINI_SPEC)
+    assert reply["eval_id"]
+    assert _wait(lambda: len(api.job_allocations("mini")) == 2)
+    assert _wait(lambda: all(
+        a["client_status"] == "complete"
+        for a in api.job_allocations("mini")))
+    assert api.job("mini")["id"] == "mini"
+    assert len(api.nodes()) == 2
+    ev = api.job_evaluations("mini")[0]
+    assert api.evaluation(ev["id"])["job_id"] == "mini"
+    for c in clients:
+        c.stop()
+
+
+def test_plan_reports_infeasible(agent):
+    server, addr = agent
+    api = ApiClient(addr)
+    # no nodes registered: plan must report failed placements, not place
+    plan = api.plan_job("mini", hcl=MINI_SPEC)
+    assert plan["placed"] == 0
+    assert "g" in plan["failed_tg_allocs"]
+    # and nothing was committed
+    assert api.jobs() == []
+
+
+def test_http_server_conn_real_client(agent, tmp_path):
+    """A real Client connected over HTTP -- the remote deployment shape."""
+    server, addr = agent
+    from nomad_tpu.client import Client
+    client = Client(HttpServerConn(addr), str(tmp_path), name="http-client")
+    client.start()
+    assert _wait(lambda: server.state.node_by_id(client.node.id)
+                 is not None)
+    api = ApiClient(addr)
+    api.register_job_hcl(MINI_SPEC)
+    assert _wait(lambda: len([
+        a for a in api.job_allocations("mini")
+        if a["client_status"] == "complete"]) == 2, timeout=10.0), \
+        [a["client_status"] for a in api.job_allocations("mini")]
+    client.shutdown()
+
+
+def test_cli_end_to_end(agent, capsys, tmp_path):
+    server, addr = agent
+    from nomad_tpu.client import SimClient
+    c = SimClient(server, mock.node())
+    c.start()
+
+    spec_file = tmp_path / "mini.hcl"
+    spec_file.write_text(MINI_SPEC)
+    assert cli_main(["-address", addr, "job", "run", str(spec_file)]) == 0
+    out = capsys.readouterr().out
+    assert "Evaluation" in out
+
+    assert _wait(lambda: len(
+        ApiClient(addr).job_allocations("mini")) == 2)
+
+    assert cli_main(["-address", addr, "job", "status"]) == 0
+    assert "mini" in capsys.readouterr().out
+    assert cli_main(["-address", addr, "job", "status", "mini"]) == 0
+    out = capsys.readouterr().out
+    assert "Allocations" in out
+    assert cli_main(["-address", addr, "node", "status"]) == 0
+    assert capsys.readouterr().out.count("ready") >= 1
+    assert cli_main(["-address", addr, "eval"]) == 0
+    capsys.readouterr()
+    assert cli_main(["-address", addr, "server", "members"]) == 0
+    capsys.readouterr()
+    assert cli_main(["-address", addr, "operator", "scheduler",
+                     "-scheduler-algorithm", "spread"]) == 0
+    assert "spread" in capsys.readouterr().out
+    assert server.state.scheduler_config().scheduler_algorithm == "spread"
+
+    alloc_id = ApiClient(addr).job_allocations("mini")[0]["id"]
+    assert cli_main(["-address", addr, "alloc", "status", alloc_id]) == 0
+    assert alloc_id in capsys.readouterr().out
+
+    assert cli_main(["-address", addr, "job", "stop", "mini"]) == 0
+    capsys.readouterr()
+    assert cli_main(["-address", addr, "system", "gc"]) == 0
+    capsys.readouterr()
+    assert cli_main(["-address", addr, "version"]) == 0
+    assert "nomad-tpu" in capsys.readouterr().out
+    c.stop()
