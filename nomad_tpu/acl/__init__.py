@@ -1,0 +1,64 @@
+"""ACL subsystem: policy language, compiled capability checks, resolution.
+
+(reference: /root/reference/acl/ + nomad/auth/; storage structs live in
+nomad_tpu/structs/acl.py, tables in the state store.)
+"""
+from .acl import ACL, ANONYMOUS_ACL, MANAGEMENT_ACL  # noqa: F401
+from .policy import (  # noqa: F401
+    CAP_ALLOC_EXEC, CAP_ALLOC_LIFECYCLE, CAP_CSI_LIST_VOLUME,
+    CAP_CSI_MOUNT_VOLUME, CAP_CSI_READ_VOLUME, CAP_CSI_REGISTER_PLUGIN,
+    CAP_CSI_WRITE_VOLUME, CAP_DISPATCH_JOB, CAP_LIST_JOBS,
+    CAP_LIST_SCALING_POLICIES, CAP_PARSE_JOB, CAP_READ_FS, CAP_READ_JOB,
+    CAP_READ_JOB_SCALING, CAP_READ_LOGS, CAP_READ_SCALING_POLICY,
+    CAP_SCALE_JOB, CAP_SUBMIT_JOB, CAP_VARIABLES_DESTROY, CAP_VARIABLES_LIST,
+    CAP_VARIABLES_READ, CAP_VARIABLES_WRITE,
+    POLICY_DENY, POLICY_LIST, POLICY_READ, POLICY_SCALE, POLICY_WRITE,
+    Policy, expand_namespace_policy, parse_policy,
+)
+
+
+class Resolver:
+    """Resolves request secrets to compiled ACLs with a cache keyed on the
+    ACL table indexes (reference: nomad/auth/auth.go + acl cache in
+    nomad/acl.go ResolveToken)."""
+
+    def __init__(self, state):
+        import threading
+        self.state = state
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._cache_key = (-1, -1)
+
+    def resolve_secret(self, secret_id):
+        """-> (ACL, token) or (None, None) for an unknown/expired secret."""
+        from ..structs import ACL_TOKEN_TYPE_MANAGEMENT
+
+        # snapshot the generation BEFORE reading token/policies, and only
+        # publish a compiled ACL under the generation it was built from --
+        # otherwise a concurrent policy write could cache a stale compile
+        # under a fresh key and serve revoked capabilities indefinitely
+        key = (self.state.table_index("acl_tokens"),
+               self.state.table_index("acl_policies"))
+        with self._lock:
+            if key != self._cache_key:
+                self._cache = {}
+                self._cache_key = key
+        token = self.state.acl_token_by_secret(secret_id)
+        if token is None or token.is_expired():
+            return None, None
+        if token.type == ACL_TOKEN_TYPE_MANAGEMENT:
+            return MANAGEMENT_ACL, token
+        cache_id = token.accessor_id
+        with self._lock:
+            if key == self._cache_key and cache_id in self._cache:
+                return self._cache[cache_id], token
+        policies = []
+        for name in token.policies:
+            stored = self.state.acl_policy_by_name(name)
+            if stored is not None:
+                policies.append(parse_policy(stored.name, stored.rules))
+        compiled = ACL(policies=policies)
+        with self._lock:
+            if key == self._cache_key:
+                self._cache[cache_id] = compiled
+        return compiled, token

@@ -19,6 +19,9 @@ def main(argv=None) -> int:
     parser.add_argument("--workers", type=int, default=2)
     parser.add_argument("--tpu", action="store_true",
                         help="enable the tpu-binpack scheduler algorithm")
+    parser.add_argument("--acl", action="store_true",
+                        help="enable ACL enforcement (bootstrap via "
+                             "POST /v1/acl/bootstrap)")
     args = parser.parse_args(argv)
 
     from .. import mock
@@ -27,7 +30,7 @@ def main(argv=None) -> int:
     from ..structs import SchedulerConfiguration, SCHED_ALG_TPU_BINPACK
     from .http import HttpServer
 
-    server = Server(num_workers=args.workers)
+    server = Server(num_workers=args.workers, acl_enabled=args.acl)
     if args.tpu:
         server.state.set_scheduler_config(SchedulerConfiguration(
             scheduler_algorithm=SCHED_ALG_TPU_BINPACK))

@@ -154,6 +154,24 @@ class ApiHandler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length) or b"{}")
 
+    # -- ACL enforcement (reference: command/agent/http.go wrap() pulls the
+    #    token; each RPC endpoint checks capabilities) ----------------------
+    def _acl(self):
+        secret = self.headers.get("X-Nomad-Token", "")
+        if not secret:
+            q = parse_qs(urlparse(self.path).query)
+            if "token" in q:
+                secret = q["token"][0]
+        compiled, _token = self.nomad.resolve_token(secret or None)
+        return compiled
+
+    def _check(self, allowed: bool) -> bool:
+        """False (and a 403 already sent) when the request is denied."""
+        if allowed:
+            return True
+        self._error(403, "Permission denied")
+        return False
+
     def _blocking(self, query, tables=()) -> int:
         """Apply ?index/?wait blocking semantics; returns current index."""
         q = parse_qs(query)
@@ -177,12 +195,52 @@ class ApiHandler(BaseHTTPRequestHandler):
             tables = (("allocs",) if parts[:2] == ["v1", "node"]
                       and len(parts) == 4 and parts[3] == "allocations"
                       else ())
-            index = self._blocking(url.query, tables)
             q = parse_qs(url.query)
             ns = q.get("namespace", ["default"])[0]
+            acl = self._acl()
+            from ..acl import CAP_LIST_JOBS, CAP_READ_JOB
+            # authorize BEFORE the blocking wait so a denied request can't
+            # pin a server thread for the full ?wait duration; namespaced
+            # single resources are re-checked against the RESOURCE's
+            # namespace after fetch (reference: endpoints resolve the
+            # object, then check caps in its namespace)
+            if parts[:2] == ["v1", "acl"]:
+                index = self._blocking(url.query, tables)
+                return self._acl_get(parts, acl, index)
+            if parts[1:2] == ["operator"]:
+                if not self._check(acl.allow_operator_read()):
+                    return
+            elif parts[:2] in (["v1", "nodes"], ["v1", "node"]):
+                if not self._check(acl.allow_node_read()):
+                    return
+            elif parts[:2] == ["v1", "job"]:
+                # job reads are namespaced lookups: query-ns == resource-ns
+                if not self._check(acl.allow_namespace_op(ns, CAP_READ_JOB)):
+                    return
+            elif parts[:2] in (["v1", "jobs"], ["v1", "evaluations"],
+                               ["v1", "allocations"], ["v1", "deployments"]):
+                # list endpoints: deny outright when the token has no access
+                # in the request namespace (unless asking for ns=*); matched
+                # results are additionally filtered per-item below
+                cap = (CAP_LIST_JOBS if parts[1] == "jobs" else CAP_READ_JOB)
+                allowed = (acl.allow_any_namespace(cap) if ns == "*"
+                           else acl.allow_namespace_op(ns, cap))
+                if not self._check(allowed):
+                    return
+            elif parts == ["v1", "event", "stream"]:
+                if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
+                    return
+            elif parts[:2] == ["v1", "agent"] and parts[2:3] != ["health"]:
+                if not self._check(acl.allow_agent_read()):
+                    return
+            elif parts == ["v1", "metrics"]:
+                if not self._check(acl.allow_agent_read()):
+                    return
+            index = self._blocking(url.query, tables)
             if parts[:2] == ["v1", "jobs"] and len(parts) == 2:
-                self._send(200, [self._job_stub(j) for j in state.jobs()],
-                           index)
+                self._send(200, [self._job_stub(j) for j in state.jobs()
+                                 if acl.allow_namespace_op(
+                                     j.namespace, CAP_LIST_JOBS)], index)
             elif parts[:2] == ["v1", "job"] and len(parts) == 3:
                 job = state.job_by_id(ns, parts[2])
                 if job is None:
@@ -199,18 +257,28 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, state.latest_deployment_by_job(ns, parts[2]),
                            index)
             elif parts[:2] == ["v1", "evaluations"]:
-                self._send(200, state.evals(), index)
+                self._send(200, [e for e in state.evals()
+                                 if acl.allow_namespace_op(
+                                     e.namespace, CAP_READ_JOB)], index)
             elif parts[:2] == ["v1", "evaluation"] and len(parts) == 3:
                 ev = state.eval_by_id(parts[2])
                 if ev is None:
                     return self._error(404, "eval not found")
+                if not self._check(acl.allow_namespace_op(ev.namespace,
+                                                          CAP_READ_JOB)):
+                    return
                 self._send(200, ev, index)
             elif parts[:2] == ["v1", "allocations"]:
-                self._send(200, state.allocs(), index)
+                self._send(200, [a for a in state.allocs()
+                                 if acl.allow_namespace_op(
+                                     a.namespace, CAP_READ_JOB)], index)
             elif parts[:2] == ["v1", "allocation"] and len(parts) == 3:
                 a = state.alloc_by_id(parts[2])
                 if a is None:
                     return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(a.namespace,
+                                                          CAP_READ_JOB)):
+                    return
                 self._send(200, a, index)
             elif parts[:2] == ["v1", "nodes"]:
                 self._send(200, [self._node_stub(n) for n in state.nodes()],
@@ -221,7 +289,9 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return self._error(404, "node not found")
                 self._send(200, n, index)
             elif parts[:2] == ["v1", "deployments"]:
-                self._send(200, state.deployments(), index)
+                self._send(200, [d for d in state.deployments()
+                                 if acl.allow_namespace_op(
+                                     d.namespace, CAP_READ_JOB)], index)
             elif parts == ["v1", "operator", "scheduler", "configuration"]:
                 self._send(200, state.scheduler_config(), index)
             elif parts == ["v1", "status", "leader"]:
@@ -267,6 +337,25 @@ class ApiHandler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
+            q = parse_qs(url.query)
+            ns = q.get("namespace", ["default"])[0]
+            acl = self._acl()
+            from ..acl import CAP_PARSE_JOB, CAP_SUBMIT_JOB
+            if parts[:2] == ["v1", "acl"]:
+                return self._acl_post(parts, acl)
+            if parts == ["v1", "jobs", "parse"]:
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_PARSE_JOB)):
+                    return
+            elif parts[1:2] == ["node"]:
+                # register/heartbeat/allocs-update are the client-agent
+                # paths (node secret in the reference); drain/eligibility
+                # are operator actions -- all require node:write
+                if not self._check(acl.allow_node_write()):
+                    return
+            elif parts[1:2] == ["operator"] or parts[1:2] == ["system"]:
+                if not self._check(acl.allow_operator_write()):
+                    return
             if parts == ["v1", "jobs", "parse"]:
                 # (reference: /v1/jobs/parse -- HCL -> api.Job JSON)
                 from ..jobspec import parse as parse_jobspec
@@ -279,6 +368,11 @@ class ApiHandler(BaseHTTPRequestHandler):
                 job = self._job_from_body(body)
                 if not job.id:
                     return self._error(400, "job id required")
+                # authorize against the JOB's namespace, not the query arg
+                # (reference: Job.Register checks submit-job in job.Namespace)
+                if not self._check(acl.allow_namespace_op(job.namespace,
+                                                          CAP_SUBMIT_JOB)):
+                    return
                 ev = self.nomad.register_job(job)
                 self._send(200, {"eval_id": ev.id if ev else "",
                                  "job_modify_index": job.job_modify_index})
@@ -286,6 +380,9 @@ class ApiHandler(BaseHTTPRequestHandler):
                     parts[3] == "plan":
                 body = self._body()
                 job = self._job_from_body(body)
+                if not self._check(acl.allow_namespace_op(job.namespace,
+                                                          CAP_SUBMIT_JOB)):
+                    return
                 self._send(200, self.nomad.plan_job(job))
             elif parts == ["v1", "node", "register"]:
                 from ..structs import Node, codec
@@ -345,16 +442,117 @@ class ApiHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):  # noqa: N802
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
-        q = parse_qs(url.query)
-        ns = q.get("namespace", ["default"])[0]
-        purge = q.get("purge", ["false"])[0] == "true"
-        if parts[:2] == ["v1", "job"] and len(parts) == 3:
-            ev = self.nomad.deregister_job(ns, parts[2], purge=purge)
-            if ev is None:
-                return self._error(404, "job not found")
-            self._send(200, {"eval_id": ev.id})
+        try:
+            q = parse_qs(url.query)
+            ns = q.get("namespace", ["default"])[0]
+            purge = q.get("purge", ["false"])[0] == "true"
+            acl = self._acl()
+            from ..acl import CAP_SUBMIT_JOB
+            if parts[:2] == ["v1", "job"] and len(parts) == 3:
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_SUBMIT_JOB)):
+                    return
+                ev = self.nomad.deregister_job(ns, parts[2], purge=purge)
+                if ev is None:
+                    return self._error(404, "job not found")
+                self._send(200, {"eval_id": ev.id})
+            elif parts[:3] == ["v1", "acl", "policy"] and len(parts) == 4:
+                if not self._check(acl.is_management()):
+                    return
+                self.nomad.state.delete_acl_policies([parts[3]])
+                self._send(200, {"deleted": True})
+            elif parts[:3] == ["v1", "acl", "token"] and len(parts) == 4:
+                if not self._check(acl.is_management()):
+                    return
+                self.nomad.state.delete_acl_tokens([parts[3]])
+                self._send(200, {"deleted": True})
+            else:
+                self._error(404, f"unknown path {url.path}")
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    # ------------------------------------------------------------------
+    # ACL endpoints (reference: nomad/acl_endpoint.go + command/agent/
+    # acl_endpoint.go)
+    def _token_stub(self, t) -> dict:
+        return {"accessor_id": t.accessor_id, "name": t.name,
+                "type": t.type, "policies": t.policies,
+                "global": t.global_token, "create_time": t.create_time}
+
+    def _acl_get(self, parts, acl, index) -> None:
+        state = self.nomad.state
+        if parts == ["v1", "acl", "policies"]:
+            if not self._check(acl.is_management()):
+                return
+            self._send(200, [{"name": p.name, "description": p.description}
+                             for p in state.acl_policies()], index)
+        elif parts[:3] == ["v1", "acl", "policy"] and len(parts) == 4:
+            if not self._check(acl.is_management()):
+                return
+            p = state.acl_policy_by_name(parts[3])
+            if p is None:
+                return self._error(404, "policy not found")
+            self._send(200, p, index)
+        elif parts == ["v1", "acl", "tokens"]:
+            if not self._check(acl.is_management()):
+                return
+            self._send(200, [self._token_stub(t)
+                             for t in state.acl_tokens()], index)
+        elif parts == ["v1", "acl", "token", "self"]:
+            secret = self.headers.get("X-Nomad-Token", "")
+            if not secret:
+                q = parse_qs(urlparse(self.path).query)
+                secret = q.get("token", [""])[0]
+            # resolve through the server so expired tokens are rejected
+            _compiled, token = self.nomad.resolve_token(secret or None)
+            if token is None:
+                return self._error(404, "token not found")
+            self._send(200, token, index)
+        elif parts[:3] == ["v1", "acl", "token"] and len(parts) == 4:
+            if not self._check(acl.is_management()):
+                return
+            t = state.acl_token_by_accessor(parts[3])
+            if t is None:
+                return self._error(404, "token not found")
+            self._send(200, t, index)
         else:
-            self._error(404, f"unknown path {url.path}")
+            self._error(404, "unknown acl path")
+
+    def _acl_post(self, parts, acl) -> None:
+        from ..acl import parse_policy
+        from ..structs import ACLPolicy, ACLToken
+        state = self.nomad.state
+        if parts == ["v1", "acl", "bootstrap"]:
+            token = self.nomad.bootstrap_acl()
+            if token is None:
+                return self._error(400, "ACL already bootstrapped")
+            self._send(200, token)
+        elif parts[:3] == ["v1", "acl", "policy"] and len(parts) == 4:
+            if not self._check(acl.is_management()):
+                return
+            body = self._body()
+            rules = body.get("rules", "")
+            try:
+                parse_policy(parts[3], rules)   # validate before storing
+            except Exception as e:
+                return self._error(400, f"invalid policy: {e}")
+            state.upsert_acl_policies([ACLPolicy(
+                name=parts[3], description=body.get("description", ""),
+                rules=rules)])
+            self._send(200, {"updated": True})
+        elif parts == ["v1", "acl", "token"]:
+            if not self._check(acl.is_management()):
+                return
+            body = self._body()
+            token = ACLToken.new(
+                name=body.get("name", ""),
+                type=body.get("type", "client"),
+                policies=body.get("policies", []),
+                ttl_s=body.get("ttl_s"))
+            state.upsert_acl_tokens([token])
+            self._send(200, token)
+        else:
+            self._error(404, "unknown acl path")
 
     def _job_from_body(self, body: dict):
         """Accept either JSON jobspec or inline HCL

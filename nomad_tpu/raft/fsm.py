@@ -14,8 +14,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..state.store import StateStore
 from ..structs import (
-    Allocation, Deployment, DrainStrategy, Evaluation, Job, Node, NodePool,
-    PlanResult, SchedulerConfiguration,
+    ACLPolicy, ACLToken, Allocation, Deployment, DrainStrategy, Evaluation,
+    Job, Node, NodePool, PlanResult, SchedulerConfiguration,
 )
 from ..structs import codec
 
@@ -42,6 +42,11 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "upsert_node_pool": [NodePool],
     "set_scheduler_config": [SchedulerConfiguration],
     "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
+    "upsert_acl_policies": [List[ACLPolicy]],
+    "delete_acl_policies": [List[str]],
+    "upsert_acl_tokens": [List[ACLToken]],
+    "delete_acl_tokens": [List[str]],
+    "bootstrap_acl_token": [ACLToken],
 }
 
 
@@ -94,6 +99,11 @@ def dump_state(store: StateStore) -> dict:
             "node_pools": [codec.encode(p)
                            for p in store._node_pools.values()],
             "scheduler_config": codec.encode(store._scheduler_config),
+            "acl_policies": [codec.encode(p)
+                             for p in store._acl_policies.values()],
+            "acl_tokens": [codec.encode(t)
+                           for t in store._acl_tokens.values()],
+            "acl_bootstrapped": store._acl_bootstrapped,
         }
 
 
@@ -107,7 +117,16 @@ def restore_state(store: StateStore, blob: dict) -> None:
     pools = [codec.decode(NodePool, p) for p in blob.get("node_pools", [])]
     sched_cfg = codec.decode(SchedulerConfiguration,
                              blob.get("scheduler_config") or {})
+    acl_policies = [codec.decode(ACLPolicy, p)
+                    for p in blob.get("acl_policies", [])]
+    acl_tokens = [codec.decode(ACLToken, t)
+                  for t in blob.get("acl_tokens", [])]
     with store._lock:
+        store._acl_policies = {p.name: p for p in acl_policies}
+        store._acl_tokens = {t.accessor_id: t for t in acl_tokens}
+        store._acl_tokens_by_secret = {t.secret_id: t.accessor_id
+                                       for t in acl_tokens}
+        store._acl_bootstrapped = blob.get("acl_bootstrapped", False)
         store._nodes = {n.id: n for n in nodes}
         store._jobs = {(j.namespace, j.id): j for j in jobs}
         store._job_versions = {}

@@ -105,6 +105,21 @@ class RaftBackedStateStore:
     def upsert_plan_results(self, result, eval_updates=None):
         return self._propose("upsert_plan_results", result, eval_updates)
 
+    def upsert_acl_policies(self, policies):
+        return self._propose("upsert_acl_policies", policies)
+
+    def delete_acl_policies(self, names):
+        return self._propose("delete_acl_policies", names)
+
+    def upsert_acl_tokens(self, tokens):
+        return self._propose("upsert_acl_tokens", tokens)
+
+    def delete_acl_tokens(self, accessor_ids):
+        return self._propose("delete_acl_tokens", accessor_ids)
+
+    def bootstrap_acl_token(self, token):
+        return self._propose("bootstrap_acl_token", token)
+
     # -- reads delegate to the applied local store ---------------------
     def __getattr__(self, name):
         return getattr(self._store, name)
@@ -131,7 +146,8 @@ class ClusterServer(Server):
                  = None, transport: Optional[TcpTransport] = None,
                  data_dir: Optional[str] = None, num_workers: int = 2,
                  heartbeat_ttl: float = 10.0,
-                 election_timeout: float = 0.25):
+                 election_timeout: float = 0.25,
+                 acl_enabled: bool = False):
         self.name = name
         self.transport = transport or TcpTransport()
         self.data_dir = data_dir
@@ -148,7 +164,8 @@ class ClusterServer(Server):
             data_dir=data_dir, election_timeout=election_timeout)
         super().__init__(num_workers=num_workers,
                          heartbeat_ttl=heartbeat_ttl,
-                         state=RaftBackedStateStore(self.raft, self.store))
+                         state=RaftBackedStateStore(self.raft, self.store),
+                         acl_enabled=acl_enabled)
         self.serf = Membership(name, self.transport,
                                tags={"role": "server", "raft": "true"})
         self.raft.on_leadership(self._on_leadership)

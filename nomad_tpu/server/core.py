@@ -42,10 +42,13 @@ class Server:
 
     def __init__(self, num_workers: Optional[int] = None,
                  heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
-                 logger=None, state=None):
+                 logger=None, state=None, acl_enabled: bool = False):
         import os
+        from ..acl import Resolver
         self.logger = logger
         self.state = state if state is not None else StateStore()
+        self.acl_enabled = acl_enabled
+        self.acl_resolver = Resolver(self.state)
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
         self.planner = Planner(self.state)
@@ -160,6 +163,33 @@ class Server:
         self.broker.set_enabled(False)
         self.broker.shutdown()
         self.planner.shutdown()
+
+    # ------------------------------------------------------------------
+    # ACL API (reference: nomad/acl_endpoint.go)
+    def bootstrap_acl(self):
+        """One-time creation of the initial management token
+        (reference: acl_endpoint.go Bootstrap)."""
+        from ..structs import ACL_TOKEN_TYPE_MANAGEMENT, ACLToken
+        token = ACLToken.new(name="Bootstrap Token",
+                             type=ACL_TOKEN_TYPE_MANAGEMENT)
+        token.global_token = True
+        if not self.state.bootstrap_acl_token(token):
+            return None
+        return token
+
+    def resolve_token(self, secret_id: Optional[str]):
+        """-> (ACL, token). With ACLs disabled every request is management;
+        with ACLs enabled a missing/unknown secret is anonymous deny-all
+        (reference: nomad/auth/auth.go ResolveToken)."""
+        from ..acl import ANONYMOUS_ACL, MANAGEMENT_ACL
+        if not self.acl_enabled:
+            return MANAGEMENT_ACL, None
+        if not secret_id:
+            return ANONYMOUS_ACL, None
+        compiled, token = self.acl_resolver.resolve_secret(secret_id)
+        if compiled is None:
+            return ANONYMOUS_ACL, None
+        return compiled, token
 
     # ------------------------------------------------------------------
     # Job API (reference: nomad/job_endpoint.go Job.Register :96)

@@ -15,8 +15,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .alloc_table import AllocTable
 from ..structs import (
-    Allocation, Deployment, Evaluation, Job, Node, NodePool, Plan, PlanResult,
-    SchedulerConfiguration,
+    ACLPolicy, ACLToken, Allocation, Deployment, Evaluation, Job, Node,
+    NodePool, Plan, PlanResult, SchedulerConfiguration,
     ALLOC_DESIRED_STOP, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_COMPLETE,
     EVAL_STATUS_BLOCKED, JOB_STATUS_DEAD, JOB_STATUS_PENDING,
@@ -24,7 +24,7 @@ from ..structs import (
 )
 
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
-          "scheduler_config", "job_versions")
+          "scheduler_config", "job_versions", "acl_policies", "acl_tokens")
 
 
 class StateSnapshot:
@@ -150,6 +150,11 @@ class StateStore:
         self._node_pools: Dict[str, NodePool] = {"default": NodePool(name="default"),
                                                  "all": NodePool(name="all")}
         self._scheduler_config = SchedulerConfiguration()
+        # ACL tables (reference: state_store.go ACLPolicy/ACLToken regions)
+        self._acl_policies: Dict[str, "ACLPolicy"] = {}
+        self._acl_tokens: Dict[str, "ACLToken"] = {}          # by accessor
+        self._acl_tokens_by_secret: Dict[str, str] = {}       # secret->accessor
+        self._acl_bootstrapped = False
         # secondary indexes
         self._allocs_by_node: Dict[str, List[str]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
@@ -462,6 +467,84 @@ class StateStore:
         with self._lock:
             self._node_pools[pool.name] = pool
             return self._bump("node_pools")
+
+    # -- ACL tables (reference: state_store.go UpsertACLPolicies /
+    #    UpsertACLTokens / BootstrapACLTokens regions) -----------------------
+    def upsert_acl_policies(self, policies: List[ACLPolicy]) -> int:
+        with self._lock:
+            for p in policies:
+                existing = self._acl_policies.get(p.name)
+                p.create_index = (existing.create_index if existing
+                                  else self._index + 1)
+                p.modify_index = self._index + 1
+                self._acl_policies[p.name] = p
+            return self._bump("acl_policies")
+
+    def delete_acl_policies(self, names: List[str]) -> int:
+        with self._lock:
+            for name in names:
+                self._acl_policies.pop(name, None)
+            return self._bump("acl_policies")
+
+    def acl_policy_by_name(self, name: str) -> Optional[ACLPolicy]:
+        with self._lock:
+            return self._acl_policies.get(name)
+
+    def acl_policies(self) -> List[ACLPolicy]:
+        with self._lock:
+            return list(self._acl_policies.values())
+
+    def upsert_acl_tokens(self, tokens: List[ACLToken]) -> int:
+        with self._lock:
+            for t in tokens:
+                existing = self._acl_tokens.get(t.accessor_id)
+                t.create_index = (existing.create_index if existing
+                                  else self._index + 1)
+                t.modify_index = self._index + 1
+                if existing is not None:
+                    self._acl_tokens_by_secret.pop(existing.secret_id, None)
+                self._acl_tokens[t.accessor_id] = t
+                self._acl_tokens_by_secret[t.secret_id] = t.accessor_id
+            return self._bump("acl_tokens")
+
+    def delete_acl_tokens(self, accessor_ids: List[str]) -> int:
+        with self._lock:
+            for acc in accessor_ids:
+                t = self._acl_tokens.pop(acc, None)
+                if t is not None:
+                    self._acl_tokens_by_secret.pop(t.secret_id, None)
+            return self._bump("acl_tokens")
+
+    def acl_token_by_accessor(self, accessor_id: str) -> Optional[ACLToken]:
+        with self._lock:
+            return self._acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str) -> Optional[ACLToken]:
+        with self._lock:
+            acc = self._acl_tokens_by_secret.get(secret_id)
+            return self._acl_tokens.get(acc) if acc else None
+
+    def acl_tokens(self) -> List[ACLToken]:
+        with self._lock:
+            return list(self._acl_tokens.values())
+
+    def bootstrap_acl_token(self, token: ACLToken) -> bool:
+        """One-shot management bootstrap (reference: state_store.go
+        BootstrapACLTokens -- guarded by the acl-token-bootstrap index)."""
+        with self._lock:
+            if self._acl_bootstrapped:
+                return False
+            self._acl_bootstrapped = True
+            token.create_index = self._index + 1
+            token.modify_index = self._index + 1
+            self._acl_tokens[token.accessor_id] = token
+            self._acl_tokens_by_secret[token.secret_id] = token.accessor_id
+            self._bump("acl_tokens")
+            return True
+
+    def acl_bootstrapped(self) -> bool:
+        with self._lock:
+            return self._acl_bootstrapped
 
     def set_scheduler_config(self, cfg: SchedulerConfiguration) -> int:
         with self._lock:

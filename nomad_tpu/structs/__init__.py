@@ -57,3 +57,8 @@ from .config import (  # noqa: F401
     SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU_BINPACK,
     SCHED_ALG_TPU_SPREAD,
 )
+from .acl import (  # noqa: F401
+    ACLPolicy, ACLToken,
+    ACL_TOKEN_TYPE_CLIENT, ACL_TOKEN_TYPE_MANAGEMENT,
+    ANONYMOUS_TOKEN_ACCESSOR,
+)

@@ -1,0 +1,63 @@
+"""ACL storage structs (reference: nomad/structs/structs.go ACLPolicy /
+ACLToken regions). The policy *rules* language lives in nomad_tpu/acl/.
+"""
+from __future__ import annotations
+
+import secrets
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+ACL_TOKEN_TYPE_CLIENT = "client"
+ACL_TOKEN_TYPE_MANAGEMENT = "management"
+
+# the anonymous token used when no token is supplied and ACLs are enabled
+ANONYMOUS_TOKEN_ACCESSOR = "anonymous"
+
+
+@dataclass
+class ACLPolicy:
+    """A named policy document as stored in state
+    (reference: structs.ACLPolicy)."""
+    name: str
+    description: str = ""
+    rules: str = ""              # the HCL source document
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class ACLToken:
+    """(reference: structs.ACLToken)"""
+    accessor_id: str = ""
+    secret_id: str = ""
+    name: str = ""
+    type: str = ACL_TOKEN_TYPE_CLIENT
+    policies: List[str] = field(default_factory=list)
+    global_token: bool = False
+    create_time: float = 0.0
+    expiration_time: Optional[float] = None
+    create_index: int = 0
+    modify_index: int = 0
+
+    @staticmethod
+    def new(name: str = "", type: str = ACL_TOKEN_TYPE_CLIENT,
+            policies: Optional[List[str]] = None,
+            ttl_s: Optional[float] = None) -> "ACLToken":
+        now = time.time()
+        return ACLToken(
+            accessor_id=str(uuid.uuid4()),
+            secret_id=str(uuid.UUID(bytes=secrets.token_bytes(16))),
+            name=name, type=type, policies=list(policies or []),
+            create_time=now,
+            expiration_time=(now + ttl_s) if ttl_s is not None else None)
+
+    def is_management(self) -> bool:
+        return self.type == ACL_TOKEN_TYPE_MANAGEMENT
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        if not self.expiration_time:
+            return False
+        return (now if now is not None else time.time()) >= \
+            self.expiration_time

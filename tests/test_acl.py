@@ -1,0 +1,221 @@
+"""ACL subsystem tests: policy language, compiled capability checks,
+token store, bootstrap, and HTTP enforcement (reference test analogs:
+acl/policy_test.go, acl/acl_test.go, nomad/acl_endpoint_test.go)."""
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu.acl import (
+    ACL, CAP_LIST_JOBS, CAP_READ_JOB, CAP_SUBMIT_JOB, CAP_VARIABLES_READ,
+    parse_policy,
+)
+from nomad_tpu.server import Server
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import ACLPolicy, ACLToken
+
+
+READONLY = """
+namespace "default" { policy = "read" }
+node  { policy = "read" }
+agent { policy = "read" }
+"""
+
+OPS = """
+namespace "ops-*" { capabilities = ["list-jobs", "read-job", "submit-job"] }
+namespace "ops-secret" { policy = "deny" }
+node { policy = "write" }
+"""
+
+VARS = """
+namespace "default" {
+  policy = "read"
+  variables {
+    path "nomad/jobs/*" { capabilities = ["read", "list"] }
+    path "secret/*"     { capabilities = ["deny"] }
+  }
+}
+"""
+
+
+def test_parse_policy_expansion():
+    pol = parse_policy("readonly", READONLY)
+    assert len(pol.namespaces) == 1
+    caps = pol.namespaces[0].all_capabilities()
+    assert CAP_LIST_JOBS in caps and CAP_READ_JOB in caps
+    assert CAP_SUBMIT_JOB not in caps
+    assert CAP_VARIABLES_READ in caps
+    assert pol.node == "read" and pol.agent == "read"
+
+
+def test_parse_policy_rejects_bad_level():
+    with pytest.raises(Exception):
+        parse_policy("bad", 'namespace "default" { policy = "admin" }')
+    with pytest.raises(Exception):
+        parse_policy("bad", 'node { policy = "scale" }')
+
+
+def test_acl_compile_and_checks():
+    acl = ACL(policies=[parse_policy("readonly", READONLY)])
+    assert acl.allow_namespace_op("default", CAP_READ_JOB)
+    assert not acl.allow_namespace_op("default", CAP_SUBMIT_JOB)
+    assert not acl.allow_namespace_op("other", CAP_READ_JOB)
+    assert acl.allow_node_read() and not acl.allow_node_write()
+    assert not acl.is_management()
+
+
+def test_acl_glob_and_deny_wins():
+    acl = ACL(policies=[parse_policy("ops", OPS)])
+    assert acl.allow_namespace_op("ops-east", CAP_SUBMIT_JOB)
+    # exact deny rule beats the glob grant
+    assert not acl.allow_namespace_op("ops-secret", CAP_READ_JOB)
+    assert not acl.allow_namespace_op("default", CAP_LIST_JOBS)
+    assert acl.allow_node_write()
+
+
+def test_acl_merge_multiple_policies():
+    acl = ACL(policies=[parse_policy("readonly", READONLY),
+                        parse_policy("ops", OPS)])
+    assert acl.allow_namespace_op("default", CAP_READ_JOB)
+    assert acl.allow_namespace_op("ops-1", CAP_SUBMIT_JOB)
+    assert acl.allow_node_write()      # write beats read on merge
+
+
+def test_variable_path_rules():
+    acl = ACL(policies=[parse_policy("vars", VARS)])
+    assert acl.allow_variable_op("default", "nomad/jobs/web", "read")
+    assert not acl.allow_variable_op("default", "nomad/jobs/web", "write")
+    assert not acl.allow_variable_op("default", "secret/db", "read")
+    # no path rule -> falls back to namespace variables-read from read level
+    assert acl.allow_variable_op("default", "other/path", "read")
+
+
+def test_management_acl():
+    acl = ACL(management=True)
+    assert acl.allow_namespace_op("anything", CAP_SUBMIT_JOB)
+    assert acl.allow_node_write() and acl.is_management()
+
+
+def test_token_store_and_bootstrap():
+    state = StateStore()
+    t = ACLToken.new(name="t1", policies=["readonly"])
+    state.upsert_acl_tokens([t])
+    assert state.acl_token_by_accessor(t.accessor_id).name == "t1"
+    assert state.acl_token_by_secret(t.secret_id).accessor_id == t.accessor_id
+    boot = ACLToken.new(name="boot", type="management")
+    assert state.bootstrap_acl_token(boot)
+    assert not state.bootstrap_acl_token(ACLToken.new(type="management"))
+    state.delete_acl_tokens([t.accessor_id])
+    assert state.acl_token_by_secret(t.secret_id) is None
+
+
+def test_resolver_and_server_resolution():
+    server = Server(num_workers=0, acl_enabled=True)
+    boot = server.bootstrap_acl()
+    assert boot is not None and boot.is_management()
+    # anonymous: deny-all
+    acl, _ = server.resolve_token(None)
+    assert not acl.allow_namespace_op("default", CAP_READ_JOB)
+    # management secret resolves to management
+    acl, tok = server.resolve_token(boot.secret_id)
+    assert acl.is_management() and tok.accessor_id == boot.accessor_id
+    # client token w/ stored policy
+    server.state.upsert_acl_policies([ACLPolicy(name="readonly",
+                                                rules=READONLY)])
+    t = ACLToken.new(name="ro", policies=["readonly"])
+    server.state.upsert_acl_tokens([t])
+    acl, _ = server.resolve_token(t.secret_id)
+    assert acl.allow_namespace_op("default", CAP_READ_JOB)
+    assert not acl.allow_namespace_op("default", CAP_SUBMIT_JOB)
+
+
+# ---------------------------------------------------------------------------
+# HTTP enforcement
+
+def _req(port, path, method="GET", body=None, token=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture
+def acl_server():
+    from nomad_tpu.api.http import HttpServer
+    server = Server(num_workers=0, acl_enabled=True)
+    http = HttpServer(server, port=0)
+    http.start()
+    yield server, http.port
+    http.shutdown()
+    server.shutdown()
+
+
+def test_http_acl_enforcement(acl_server):
+    server, port = acl_server
+    # anonymous is denied
+    code, _ = _req(port, "/v1/jobs")
+    assert code == 403
+    # bootstrap works once, anonymously
+    code, boot = _req(port, "/v1/acl/bootstrap", method="POST")
+    assert code == 200 and boot["type"] == "management"
+    code, _ = _req(port, "/v1/acl/bootstrap", method="POST")
+    assert code == 400
+    mgmt = boot["secret_id"]
+    # management can do anything
+    code, _ = _req(port, "/v1/jobs", token=mgmt)
+    assert code == 200
+    # create a read-only policy + client token over HTTP
+    code, _ = _req(port, "/v1/acl/policy/readonly", method="POST",
+                   body={"rules": READONLY}, token=mgmt)
+    assert code == 200
+    code, tok = _req(port, "/v1/acl/token", method="POST",
+                     body={"name": "ro", "policies": ["readonly"]},
+                     token=mgmt)
+    assert code == 200
+    ro = tok["secret_id"]
+    # read allowed, job submit denied
+    code, _ = _req(port, "/v1/jobs", token=ro)
+    assert code == 200
+    code, _ = _req(port, "/v1/jobs", method="POST",
+                   body={"job": {"id": "x", "task_groups": []}}, token=ro)
+    assert code == 403
+    # token self lookup
+    code, self_tok = _req(port, "/v1/acl/token/self", token=ro)
+    assert code == 200 and self_tok["name"] == "ro"
+    # non-management cannot list tokens
+    code, _ = _req(port, "/v1/acl/tokens", token=ro)
+    assert code == 403
+    code, listing = _req(port, "/v1/acl/tokens", token=mgmt)
+    assert code == 200 and len(listing) >= 2
+    # operator/system/node endpoints are gated (regression: the gate must
+    # match /v1/operator/... and /v1/node/register paths)
+    code, _ = _req(port, "/v1/operator/scheduler/configuration",
+                   method="POST", body={"scheduler_algorithm": "spread"})
+    assert code == 403
+    code, _ = _req(port, "/v1/system/gc", method="POST")
+    assert code == 403
+    code, _ = _req(port, "/v1/node/register", method="POST",
+                   body={"node": {"id": "x"}})
+    assert code == 403
+    code, _ = _req(port, "/v1/node/allocs-update", method="POST",
+                   body={"allocs": []})
+    assert code == 403
+    code, _ = _req(port, "/v1/operator/scheduler/configuration", token=ro)
+    assert code == 403
+    # cross-namespace submit escalation: ro token in 'default' cannot
+    # submit a job whose body says namespace 'prod' via ?namespace=default
+    code, _ = _req(port, "/v1/jobs?namespace=default", method="POST",
+                   body={"job": {"id": "x", "namespace": "prod",
+                                 "task_groups": []}}, token=ro)
+    assert code == 403
+
+
+def test_token_ttl_zero_expires():
+    t = ACLToken.new(name="t", ttl_s=0)
+    assert t.is_expired()
