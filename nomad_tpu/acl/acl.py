@@ -138,11 +138,13 @@ class ACL:
                 if best_rule is None or key > best_rule[0]:
                     best_rule = (key, rule)
         if best_rule is not None:
+            # capabilities are pre-expanded at parse time
+            # (policy.py expand_variables_capabilities), so membership is
+            # the whole check; deny is sticky
             caps = best_rule[1].capabilities
             if "deny" in caps:
                 return False
-            return cap in caps or "write" in caps or (
-                cap in ("read", "list") and "read" in caps)
+            return cap in caps
         # fall back to namespace-wide variables capabilities
         return self.allow_namespace_op(ns, f"variables-{cap}")
 

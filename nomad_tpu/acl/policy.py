@@ -99,6 +99,25 @@ class NamespaceRule:
         return caps
 
 
+def expand_variables_capabilities(caps: List[str]) -> List[str]:
+    """Expand the shorthand levels exactly like the reference
+    (acl/policy.go expandVariablesCapabilities: write -> list+read+write+
+    destroy, read -> list+read; deny is sticky)."""
+    if "deny" in caps:
+        return ["deny"]
+    out: List[str] = []
+    for cap in caps:
+        if cap == "write":
+            out.extend(("list", "read", "write", "destroy"))
+        elif cap == "read":
+            out.extend(("list", "read"))
+        else:
+            out.append(cap)
+    # stable dedup
+    seen: set = set()
+    return [c for c in out if not (c in seen or seen.add(c))]
+
+
 @dataclass
 class VariablePathRule:
     """`variables { path "nomad/jobs/*" { capabilities = [...] } }`"""
@@ -151,8 +170,8 @@ def parse_policy(name: str, src: str) -> Policy:
                 for pb in sub.blocks("path"):
                     rule.variables.append(VariablePathRule(
                         path=pb.label(default="*"),
-                        capabilities=list(
-                            pb.attrs().get("capabilities", []) or [])))
+                        capabilities=expand_variables_capabilities(
+                            list(pb.attrs().get("capabilities", []) or []))))
             pol.namespaces.append(rule)
         elif item.type == "host_volume":
             attrs = item.attrs()

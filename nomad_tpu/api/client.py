@@ -179,8 +179,13 @@ class HttpServerConn:
     Implements the ServerConn interface from nomad_tpu.client.client."""
 
     def __init__(self, address: str = "http://127.0.0.1:4646",
-                 timeout: float = 10.0):
-        self.api = ApiClient(address, timeout=timeout)
+                 timeout: float = 10.0, token: str = ""):
+        import os
+        # node endpoints need node:write when ACLs are on; agents take
+        # their token from config or NOMAD_TOKEN like the reference client
+        self.api = ApiClient(address, timeout=timeout,
+                             token=token or os.environ.get("NOMAD_TOKEN",
+                                                           ""))
 
     def register_node(self, node: Node) -> None:
         self.api.post("/v1/node/register", {"node": codec.encode(node)})

@@ -180,6 +180,9 @@ class ApiHandler(BaseHTTPRequestHandler):
             wait = 5.0
             if "wait" in q:
                 wait = float(q["wait"][0].rstrip("s"))
+            # cap like the reference's MaxBlockingRPCQueryTime so a client
+            # can't pin a handler thread arbitrarily long
+            wait = min(wait, 300.0)
             return self.nomad.state.block_until(min_index, timeout=wait,
                                                 tables=tables)
         return self.nomad.state.latest_index()
@@ -205,6 +208,11 @@ class ApiHandler(BaseHTTPRequestHandler):
             # namespace after fetch (reference: endpoints resolve the
             # object, then check caps in its namespace)
             if parts[:2] == ["v1", "acl"]:
+                # management pre-gate (except token/self) so denied ACL
+                # reads can't sit in the blocking wait
+                if parts != ["v1", "acl", "token", "self"] and \
+                        not self._check(acl.is_management()):
+                    return
                 index = self._blocking(url.query, tables)
                 return self._acl_get(parts, acl, index)
             if parts[1:2] == ["operator"]:
@@ -226,6 +234,11 @@ class ApiHandler(BaseHTTPRequestHandler):
                 allowed = (acl.allow_any_namespace(cap) if ns == "*"
                            else acl.allow_namespace_op(ns, cap))
                 if not self._check(allowed):
+                    return
+            elif parts[:2] in (["v1", "evaluation"], ["v1", "allocation"]):
+                # cheap pre-gate before the blocking wait; the exact
+                # resource-namespace check still runs after fetch
+                if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
                     return
             elif parts == ["v1", "event", "stream"]:
                 if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
@@ -294,6 +307,27 @@ class ApiHandler(BaseHTTPRequestHandler):
                                      d.namespace, CAP_READ_JOB)], index)
             elif parts == ["v1", "operator", "scheduler", "configuration"]:
                 self._send(200, state.scheduler_config(), index)
+            elif parts == ["v1", "operator", "keyring", "keys"]:
+                # metadata only -- key material never leaves the server
+                # (reference: operator_endpoint.go KeyringList)
+                self._send(200, [{"key_id": k.key_id, "state": k.state,
+                                  "create_time": k.create_time}
+                                 for k in state.root_keys()], index)
+            elif parts[:2] == ["v1", "vars"]:
+                prefix = q.get("prefix", [""])[0]
+                metas = self.nomad.var_list(
+                    None if ns == "*" else ns, prefix)
+                self._send(200, [m for m in metas
+                                 if acl.allow_variable_op(
+                                     m.namespace, m.path, "list")], index)
+            elif parts[:2] == ["v1", "var"] and len(parts) >= 3:
+                path = "/".join(parts[2:])
+                if not self._check(acl.allow_variable_op(ns, path, "read")):
+                    return
+                dec = self.nomad.var_get(ns, path)
+                if dec is None:
+                    return self._error(404, "variable not found")
+                self._send(200, dec, index)
             elif parts == ["v1", "status", "leader"]:
                 raft = getattr(self.nomad, "raft", None)
                 if raft is None:
@@ -409,6 +443,22 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, {"updated": len(allocs)})
             elif parts == ["v1", "system", "gc"]:
                 self._send(200, self.nomad.run_gc_once())
+            elif parts == ["v1", "operator", "keyring", "rotate"]:
+                key = self.nomad.encrypter.rotate()
+                self._send(200, {"key_id": key.key_id})
+            elif parts[:2] == ["v1", "var"] and len(parts) >= 3:
+                path = "/".join(parts[2:])
+                if not self._check(acl.allow_variable_op(ns, path, "write")):
+                    return
+                body = self._body()
+                cas = (int(q["cas"][0]) if "cas" in q else None)
+                ok, result = self.nomad.var_put(
+                    ns, path, body.get("items", body.get("Items", {})),
+                    cas_index=cas)
+                if not ok:
+                    return self._send(409, {"error": "cas conflict",
+                                            "conflict": result})
+                self._send(200, result)
             elif parts == ["v1", "operator", "scheduler", "configuration"]:
                 body = self._body()
                 cfg = SchedulerConfiguration(
@@ -465,6 +515,15 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if not self._check(acl.is_management()):
                     return
                 self.nomad.state.delete_acl_tokens([parts[3]])
+                self._send(200, {"deleted": True})
+            elif parts[:2] == ["v1", "var"] and len(parts) >= 3:
+                path = "/".join(parts[2:])
+                if not self._check(acl.allow_variable_op(ns, path,
+                                                         "destroy")):
+                    return
+                cas = (int(q["cas"][0]) if "cas" in q else None)
+                if not self.nomad.var_delete(ns, path, cas_index=cas):
+                    return self._send(409, {"error": "cas conflict"})
                 self._send(200, {"deleted": True})
             else:
                 self._error(404, f"unknown path {url.path}")

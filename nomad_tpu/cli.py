@@ -249,6 +249,83 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_var_put(args) -> int:
+    api = _client(args)
+    items = _parse_vars(args.items)
+    params = {}
+    if args.cas is not None:
+        params["cas"] = args.cas
+    out = api.request("PUT", f"/v1/var/{args.path}", body={"items": items},
+                      params=params)
+    print(f"Wrote {args.path} @ index "
+          f"{out.get('meta', {}).get('modify_index')}")
+    return 0
+
+
+def cmd_var_get(args) -> int:
+    out = _client(args).get(f"/v1/var/{args.path}")
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+def cmd_var_list(args) -> int:
+    out = _client(args).get("/v1/vars", prefix=args.prefix or "")
+    print(_fmt_table([[m["namespace"], m["path"], m["modify_index"]]
+                      for m in out],
+                     ["Namespace", "Path", "Index"]))
+    return 0
+
+
+def cmd_var_purge(args) -> int:
+    params = {}
+    if args.cas is not None:
+        params["cas"] = args.cas
+    _client(args).request("DELETE", f"/v1/var/{args.path}", params=params)
+    print(f"Purged {args.path}")
+    return 0
+
+
+def cmd_operator_keyring(args) -> int:
+    api = _client(args)
+    if args.sub2 == "rotate":
+        out = api.post("/v1/operator/keyring/rotate")
+        print(f"Rotated root key -> {out['key_id']}")
+        return 0
+    keys = api.get("/v1/operator/keyring/keys")
+    print(_fmt_table([[k["key_id"], k["state"]] for k in keys],
+                     ["Key ID", "State"]))
+    return 0
+
+
+def cmd_acl_bootstrap(args) -> int:
+    out = _client(args).post("/v1/acl/bootstrap")
+    print(f"Accessor ID = {out['accessor_id']}\n"
+          f"Secret ID   = {out['secret_id']}\n"
+          f"Type        = {out['type']}")
+    return 0
+
+
+def cmd_acl_policy_apply(args) -> int:
+    with open(args.file, encoding="utf-8") as fh:
+        rules = fh.read()
+    _client(args).post(f"/v1/acl/policy/{args.name}",
+                       body={"rules": rules,
+                             "description": args.description or ""})
+    print(f"Applied policy {args.name}")
+    return 0
+
+
+def cmd_acl_token_create(args) -> int:
+    out = _client(args).post(
+        "/v1/acl/token",
+        body={"name": args.name or "", "type": args.type,
+              "policies": args.policy or []})
+    print(f"Accessor ID = {out['accessor_id']}\n"
+          f"Secret ID   = {out['secret_id']}\n"
+          f"Policies    = {out['policies']}")
+    return 0
+
+
 def cmd_version(args) -> int:
     from .client.fingerprint import VERSION
     print(f"nomad-tpu v{VERSION} (tpu-native cluster scheduler)")
@@ -330,6 +407,10 @@ def build_parser() -> argparse.ArgumentParser:
     osch.add_argument("-memory-oversubscription", dest="memory_oversub",
                       action="store_true")
     osch.set_defaults(fn=cmd_operator_scheduler)
+    okr = op.add_parser("keyring").add_subparsers(dest="sub2",
+                                                  required=True)
+    okr.add_parser("list").set_defaults(fn=cmd_operator_keyring)
+    okr.add_parser("rotate").set_defaults(fn=cmd_operator_keyring)
 
     srv = sub.add_parser("server").add_subparsers(dest="sub",
                                                   required=True)
@@ -340,6 +421,44 @@ def build_parser() -> argparse.ArgumentParser:
                                                    required=True)
     sg = sysp.add_parser("gc")
     sg.set_defaults(fn=cmd_system_gc)
+
+    var = sub.add_parser("var", help="secure variables").add_subparsers(
+        dest="sub", required=True)
+    vp = var.add_parser("put")
+    vp.add_argument("path")
+    vp.add_argument("items", nargs="+", help="key=value ...")
+    vp.add_argument("-check-index", dest="cas", type=int, default=None)
+    vp.set_defaults(fn=cmd_var_put)
+    vg = var.add_parser("get")
+    vg.add_argument("path")
+    vg.set_defaults(fn=cmd_var_get)
+    vl = var.add_parser("list")
+    vl.add_argument("prefix", nargs="?", default="")
+    vl.set_defaults(fn=cmd_var_list)
+    vpu = var.add_parser("purge")
+    vpu.add_argument("path")
+    vpu.add_argument("-check-index", dest="cas", type=int, default=None)
+    vpu.set_defaults(fn=cmd_var_purge)
+
+    aclp = sub.add_parser("acl", help="ACL management").add_subparsers(
+        dest="sub", required=True)
+    ab = aclp.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl_bootstrap)
+    apol = aclp.add_parser("policy").add_subparsers(dest="sub2",
+                                                    required=True)
+    apa = apol.add_parser("apply")
+    apa.add_argument("name")
+    apa.add_argument("file")
+    apa.add_argument("-description", default="")
+    apa.set_defaults(fn=cmd_acl_policy_apply)
+    atok = aclp.add_parser("token").add_subparsers(dest="sub2",
+                                                   required=True)
+    atc = atok.add_parser("create")
+    atc.add_argument("-name", default="")
+    atc.add_argument("-type", default="client",
+                     choices=["client", "management"])
+    atc.add_argument("-policy", action="append")
+    atc.set_defaults(fn=cmd_acl_token_create)
 
     mt = sub.add_parser("metrics")
     mt.set_defaults(fn=cmd_metrics)

@@ -15,7 +15,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..state.store import StateStore
 from ..structs import (
     ACLPolicy, ACLToken, Allocation, Deployment, DrainStrategy, Evaluation,
-    Job, Node, NodePool, PlanResult, SchedulerConfiguration,
+    Job, Node, NodePool, PlanResult, RootKey, SchedulerConfiguration,
+    VariableEncrypted,
 )
 from ..structs import codec
 
@@ -47,6 +48,10 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "upsert_acl_tokens": [List[ACLToken]],
     "delete_acl_tokens": [List[str]],
     "bootstrap_acl_token": [ACLToken],
+    "upsert_root_key": [RootKey],
+    "delete_root_key": [str],
+    "upsert_variable": [VariableEncrypted, Optional[int]],
+    "delete_variable": [str, str, Optional[int]],
 }
 
 
@@ -104,6 +109,10 @@ def dump_state(store: StateStore) -> dict:
             "acl_tokens": [codec.encode(t)
                            for t in store._acl_tokens.values()],
             "acl_bootstrapped": store._acl_bootstrapped,
+            "root_keys": [codec.encode(k)
+                          for k in store._root_keys.values()],
+            "variables": [codec.encode(v)
+                          for v in store._variables.values()],
         }
 
 
@@ -121,7 +130,14 @@ def restore_state(store: StateStore, blob: dict) -> None:
                     for p in blob.get("acl_policies", [])]
     acl_tokens = [codec.decode(ACLToken, t)
                   for t in blob.get("acl_tokens", [])]
+    root_keys = [codec.decode(RootKey, k)
+                 for k in blob.get("root_keys", [])]
+    variables = [codec.decode(VariableEncrypted, v)
+                 for v in blob.get("variables", [])]
     with store._lock:
+        store._root_keys = {k.key_id: k for k in root_keys}
+        store._variables = {(v.meta.namespace, v.meta.path): v
+                            for v in variables}
         store._acl_policies = {p.name: p for p in acl_policies}
         store._acl_tokens = {t.accessor_id: t for t in acl_tokens}
         store._acl_tokens_by_secret = {t.secret_id: t.accessor_id

@@ -120,6 +120,18 @@ class RaftBackedStateStore:
     def bootstrap_acl_token(self, token):
         return self._propose("bootstrap_acl_token", token)
 
+    def upsert_root_key(self, key):
+        return self._propose("upsert_root_key", key)
+
+    def delete_root_key(self, key_id):
+        return self._propose("delete_root_key", key_id)
+
+    def upsert_variable(self, var, cas_index=None):
+        return self._propose("upsert_variable", var, cas_index)
+
+    def delete_variable(self, namespace, path, cas_index=None):
+        return self._propose("delete_variable", namespace, path, cas_index)
+
     # -- reads delegate to the applied local store ---------------------
     def __getattr__(self, name):
         return getattr(self._store, name)

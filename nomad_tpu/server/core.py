@@ -49,6 +49,8 @@ class Server:
         self.state = state if state is not None else StateStore()
         self.acl_enabled = acl_enabled
         self.acl_resolver = Resolver(self.state)
+        from .encrypter import Encrypter
+        self.encrypter = Encrypter(self.state)
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
         self.planner = Planner(self.state)
@@ -89,6 +91,9 @@ class Server:
                 return
             self.broker.set_enabled(True)
             self.blocked_evals.set_enabled(True)
+            # (reference: leader.go initializeKeyring -- first leader mints
+            # the root encryption key)
+            self.encrypter.initialize()
             self._restore_evals()
             self._initialize_heartbeat_timers()
             self._restore_periodic_launch_times()
@@ -190,6 +195,39 @@ class Server:
         if compiled is None:
             return ANONYMOUS_ACL, None
         return compiled, token
+
+    # ------------------------------------------------------------------
+    # Variables API (reference: nomad/variables_endpoint.go)
+    def var_put(self, namespace: str, path: str, items: Dict[str, str],
+                cas_index: Optional[int] = None):
+        """Encrypt+store. Returns (ok, VariableDecrypted-or-conflict)."""
+        from ..structs import VariableDecrypted, VariableMetadata
+        dec = VariableDecrypted(
+            meta=VariableMetadata(namespace=namespace, path=path),
+            items=dict(items))
+        enc = self.encrypter.encrypt_variable(dec)
+        ok, stored = self.state.upsert_variable(enc, cas_index)
+        if not ok:
+            return False, (self.encrypter.decrypt_variable(stored)
+                           if stored is not None else None)
+        dec.meta = stored.meta
+        return True, dec
+
+    def var_get(self, namespace: str, path: str):
+        enc = self.state.variable_by_path(namespace, path)
+        if enc is None:
+            return None
+        return self.encrypter.decrypt_variable(enc)
+
+    def var_list(self, namespace: Optional[str] = None, prefix: str = ""):
+        """Metadata only -- list never decrypts (reference:
+        variables_endpoint.go List returns VariableMetadata)."""
+        return [v.meta for v in self.state.variables(namespace, prefix)]
+
+    def var_delete(self, namespace: str, path: str,
+                   cas_index: Optional[int] = None) -> bool:
+        ok, _ = self.state.delete_variable(namespace, path, cas_index)
+        return ok
 
     # ------------------------------------------------------------------
     # Job API (reference: nomad/job_endpoint.go Job.Register :96)

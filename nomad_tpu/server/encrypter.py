@@ -1,0 +1,155 @@
+"""Encrypter: root-key keyring for Variables encryption + workload
+identity JWT signing.
+
+Semantic parity with /root/reference/nomad/encrypter.go (Encrypter :45,
+SignClaims :181, key rotation via Keyring.Rotate RPC); AEAD is AES-256-GCM
+exactly like the reference's cipher suite. JWTs are HS256 (the reference
+signs ed25519/RSA via the root key; the claim set -- alloc/job/task/ns --
+matches structs/workload_id.go IdentityClaims).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets
+import time
+from typing import Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..structs.variables import (
+    ROOT_KEY_STATE_ACTIVE, ROOT_KEY_STATE_INACTIVE, RootKey,
+    VariableDecrypted, VariableEncrypted, VariableMetadata,
+)
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64url(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+class Encrypter:
+    """(reference: nomad/encrypter.go:45 Encrypter). Keys live in state
+    (the raft snapshot is the keystore); this object caches AEAD ciphers
+    per key id."""
+
+    def __init__(self, state):
+        self.state = state
+        self._ciphers: Dict[str, AESGCM] = {}
+
+    # -- keyring -------------------------------------------------------
+    def initialize(self) -> RootKey:
+        """Create the initial root key if the keyring is empty
+        (reference: leader.go initializeKeyring)."""
+        active = self.active_key()
+        if active is not None:
+            return active
+        key = RootKey.new()
+        self.state.upsert_root_key(key)
+        return key
+
+    def active_key(self) -> Optional[RootKey]:
+        for k in self.state.root_keys():
+            if k.state == ROOT_KEY_STATE_ACTIVE:
+                return k
+        return None
+
+    def rotate(self) -> RootKey:
+        """New active key; old keys stay for decryption of existing data
+        (reference: Keyring.Rotate -> RootKeyMeta inactive)."""
+        import copy
+        for k in self.state.root_keys():
+            if k.state == ROOT_KEY_STATE_ACTIVE:
+                old = copy.copy(k)
+                old.state = ROOT_KEY_STATE_INACTIVE
+                self.state.upsert_root_key(old)
+        key = RootKey.new()
+        self.state.upsert_root_key(key)
+        return key
+
+    def _cipher(self, key_id: str) -> AESGCM:
+        if key_id not in self._ciphers:
+            key = self.state.root_key_by_id(key_id)
+            if key is None:
+                raise KeyError(f"unknown root key {key_id}")
+            self._ciphers[key_id] = AESGCM(key.material())
+        return self._ciphers[key_id]
+
+    # -- variables AEAD ------------------------------------------------
+    def encrypt_variable(self, dec: VariableDecrypted) -> VariableEncrypted:
+        key = self.active_key()
+        if key is None:
+            key = self.initialize()
+        nonce = secrets.token_bytes(12)
+        plaintext = json.dumps(dec.items, sort_keys=True).encode()
+        # bind ciphertext to its path+namespace (AEAD associated data), so
+        # a snapshot editor can't splice secrets across paths
+        aad = f"{dec.meta.namespace}\x00{dec.meta.path}".encode()
+        ct = self._cipher(key.key_id).encrypt(nonce, plaintext, aad)
+        return VariableEncrypted(
+            meta=dec.meta, key_id=key.key_id,
+            nonce_b64=base64.b64encode(nonce).decode(),
+            ciphertext_b64=base64.b64encode(ct).decode())
+
+    def decrypt_variable(self, enc: VariableEncrypted) -> VariableDecrypted:
+        nonce = base64.b64decode(enc.nonce_b64)
+        ct = base64.b64decode(enc.ciphertext_b64)
+        aad = f"{enc.meta.namespace}\x00{enc.meta.path}".encode()
+        plaintext = self._cipher(enc.key_id).decrypt(nonce, ct, aad)
+        return VariableDecrypted(meta=enc.meta,
+                                 items=json.loads(plaintext.decode()))
+
+    # -- workload identity JWTs ----------------------------------------
+    def sign_claims(self, claims: dict, ttl_s: float = 3600.0) -> str:
+        """(reference: encrypter.go:181 SignClaims)"""
+        key = self.active_key()
+        if key is None:
+            key = self.initialize()
+        now = time.time()
+        body = dict(claims)
+        body.setdefault("iat", int(now))
+        body.setdefault("exp", int(now + ttl_s))
+        body.setdefault("iss", "nomad-tpu")
+        header = {"alg": "HS256", "typ": "JWT", "kid": key.key_id}
+        signing_input = (_b64url(json.dumps(header, sort_keys=True).encode())
+                         + "." +
+                         _b64url(json.dumps(body, sort_keys=True).encode()))
+        sig = hmac.new(key.material(), signing_input.encode(),
+                       hashlib.sha256).digest()
+        return signing_input + "." + _b64url(sig)
+
+    def verify_claims(self, token: str) -> Optional[dict]:
+        """-> claims dict, or None when the signature/expiry is invalid."""
+        try:
+            head_b64, body_b64, sig_b64 = token.split(".")
+            header = json.loads(_unb64url(head_b64))
+            key = self.state.root_key_by_id(header.get("kid", ""))
+            if key is None or header.get("alg") != "HS256":
+                return None
+            signing_input = (head_b64 + "." + body_b64).encode()
+            expect = hmac.new(key.material(), signing_input,
+                              hashlib.sha256).digest()
+            if not hmac.compare_digest(expect, _unb64url(sig_b64)):
+                return None
+            claims = json.loads(_unb64url(body_b64))
+            if claims.get("exp", 0) < time.time():
+                return None
+            return claims
+        except Exception:
+            return None
+
+    def workload_identity(self, alloc, task_name: str) -> str:
+        """The claim set of structs/workload_id.go IdentityClaims."""
+        return self.sign_claims({
+            "nomad_namespace": alloc.namespace,
+            "nomad_job_id": alloc.job_id,
+            "nomad_allocation_id": alloc.id,
+            "nomad_task": task_name,
+            "sub": f"{alloc.namespace}:{alloc.job_id}:{task_name}",
+        })

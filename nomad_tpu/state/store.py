@@ -15,8 +15,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .alloc_table import AllocTable
 from ..structs import (
-    ACLPolicy, ACLToken, Allocation, Deployment, Evaluation, Job, Node,
-    NodePool, Plan, PlanResult, SchedulerConfiguration,
+    ACL_TOKEN_TYPE_MANAGEMENT, ACLPolicy, ACLToken, Allocation, Deployment,
+    Evaluation, Job, Node, NodePool, Plan, PlanResult, RootKey,
+    SchedulerConfiguration, VariableEncrypted,
     ALLOC_DESIRED_STOP, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_COMPLETE,
     EVAL_STATUS_BLOCKED, JOB_STATUS_DEAD, JOB_STATUS_PENDING,
@@ -24,7 +25,8 @@ from ..structs import (
 )
 
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
-          "scheduler_config", "job_versions", "acl_policies", "acl_tokens")
+          "scheduler_config", "job_versions", "acl_policies", "acl_tokens",
+          "root_keys", "variables")
 
 
 class StateSnapshot:
@@ -155,6 +157,10 @@ class StateStore:
         self._acl_tokens: Dict[str, "ACLToken"] = {}          # by accessor
         self._acl_tokens_by_secret: Dict[str, str] = {}       # secret->accessor
         self._acl_bootstrapped = False
+        # keyring + secure variables (reference: state_store.go RootKeyMeta
+        # and VariablesQuota regions; variables keyed (namespace, path))
+        self._root_keys: Dict[str, "RootKey"] = {}
+        self._variables: Dict[Tuple[str, str], "VariableEncrypted"] = {}
         # secondary indexes
         self._allocs_by_node: Dict[str, List[str]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
@@ -468,6 +474,81 @@ class StateStore:
             self._node_pools[pool.name] = pool
             return self._bump("node_pools")
 
+    # -- keyring + variables (reference: state_store.go UpsertRootKeyMeta,
+    #    VarSet/VarGet/VarDelete with check-and-set semantics) -------------
+    def upsert_root_key(self, key: "RootKey") -> int:
+        with self._lock:
+            existing = self._root_keys.get(key.key_id)
+            key.create_index = (existing.create_index if existing
+                                else self._index + 1)
+            key.modify_index = self._index + 1
+            self._root_keys[key.key_id] = key
+            return self._bump("root_keys")
+
+    def delete_root_key(self, key_id: str) -> int:
+        with self._lock:
+            self._root_keys.pop(key_id, None)
+            return self._bump("root_keys")
+
+    def root_key_by_id(self, key_id: str):
+        with self._lock:
+            return self._root_keys.get(key_id)
+
+    def root_keys(self) -> List:
+        with self._lock:
+            return list(self._root_keys.values())
+
+    def upsert_variable(self, var: "VariableEncrypted",
+                        cas_index: Optional[int] = None):
+        """Returns (ok, conflict_or_result). cas_index None = blind write;
+        0 = create-only; N = modify_index must equal N
+        (reference: VarSet CAS contract in nomad/variables_endpoint.go)."""
+        with self._lock:
+            key = (var.meta.namespace, var.meta.path)
+            existing = self._variables.get(key)
+            if cas_index is not None:
+                current = existing.meta.modify_index if existing else 0
+                if current != cas_index:
+                    return False, existing
+            import time as _time
+            now = _time.time()
+            if existing is not None:
+                var.meta.create_index = existing.meta.create_index
+                var.meta.create_time = existing.meta.create_time
+            else:
+                var.meta.create_index = self._index + 1
+                var.meta.create_time = now
+            var.meta.modify_index = self._index + 1
+            var.meta.modify_time = now
+            self._variables[key] = var
+            self._bump("variables")
+            return True, var
+
+    def delete_variable(self, namespace: str, path: str,
+                        cas_index: Optional[int] = None):
+        with self._lock:
+            key = (namespace, path)
+            existing = self._variables.get(key)
+            if cas_index is not None:
+                current = existing.meta.modify_index if existing else 0
+                if current != cas_index:
+                    return False, existing
+            if existing is not None:
+                del self._variables[key]
+                self._bump("variables")
+            return True, existing
+
+    def variable_by_path(self, namespace: str, path: str):
+        with self._lock:
+            return self._variables.get((namespace, path))
+
+    def variables(self, namespace: Optional[str] = None,
+                  prefix: str = "") -> List:
+        with self._lock:
+            return [v for (ns, path), v in sorted(self._variables.items())
+                    if (namespace is None or ns == namespace)
+                    and path.startswith(prefix)]
+
     # -- ACL tables (reference: state_store.go UpsertACLPolicies /
     #    UpsertACLTokens / BootstrapACLTokens regions) -----------------------
     def upsert_acl_policies(self, policies: List[ACLPolicy]) -> int:
@@ -530,9 +611,14 @@ class StateStore:
 
     def bootstrap_acl_token(self, token: ACLToken) -> bool:
         """One-shot management bootstrap (reference: state_store.go
-        BootstrapACLTokens -- guarded by the acl-token-bootstrap index)."""
+        BootstrapACLTokens -- guarded by the acl-token-bootstrap index).
+        Deleting every management token re-opens bootstrap (the escape
+        hatch the reference provides via bootstrap-reset)."""
         with self._lock:
-            if self._acl_bootstrapped:
+            have_mgmt = any(t.type == ACL_TOKEN_TYPE_MANAGEMENT
+                            and not t.is_expired()
+                            for t in self._acl_tokens.values())
+            if self._acl_bootstrapped and have_mgmt:
                 return False
             self._acl_bootstrapped = True
             token.create_index = self._index + 1

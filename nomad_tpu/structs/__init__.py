@@ -62,3 +62,7 @@ from .acl import (  # noqa: F401
     ACL_TOKEN_TYPE_CLIENT, ACL_TOKEN_TYPE_MANAGEMENT,
     ANONYMOUS_TOKEN_ACCESSOR,
 )
+from .variables import (  # noqa: F401
+    ROOT_KEY_STATE_ACTIVE, ROOT_KEY_STATE_INACTIVE, RootKey,
+    VariableDecrypted, VariableEncrypted, VariableMetadata,
+)

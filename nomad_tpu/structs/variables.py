@@ -1,0 +1,69 @@
+"""Secure Variables + root-key structs (reference: nomad/structs/
+variables.go VariableEncrypted/VariableDecrypted/VariableMetadata and
+structs/keyring.go RootKey/RootKeyMeta)."""
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+ROOT_KEY_STATE_ACTIVE = "active"
+ROOT_KEY_STATE_INACTIVE = "inactive"
+
+
+@dataclass
+class RootKey:
+    """A keyring entry. The reference splits metadata (raft-replicated,
+    RootKeyMeta) from material (on-disk keystore, replicated by the
+    KeyringReplicator encrypter.go:528); here both ride state with the
+    material base64-wrapped -- the snapshot IS the keystore."""
+    key_id: str = ""
+    state: str = ROOT_KEY_STATE_ACTIVE
+    material_b64: str = ""           # 32-byte AES-256 key, base64
+    create_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+
+    @staticmethod
+    def new() -> "RootKey":
+        import base64
+        import secrets
+        return RootKey(
+            key_id=str(uuid.uuid4()),
+            state=ROOT_KEY_STATE_ACTIVE,
+            material_b64=base64.b64encode(secrets.token_bytes(32)).decode(),
+            create_time=time.time())
+
+    def material(self) -> bytes:
+        import base64
+        return base64.b64decode(self.material_b64)
+
+
+@dataclass
+class VariableMetadata:
+    """(reference: structs.VariableMetadata)"""
+    namespace: str = "default"
+    path: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: float = 0.0
+    modify_time: float = 0.0
+
+
+@dataclass
+class VariableEncrypted:
+    """Ciphertext at rest; what raft replicates and snapshots contain
+    (reference: structs.VariableEncrypted -- Data + KeyID)."""
+    meta: VariableMetadata = field(default_factory=VariableMetadata)
+    key_id: str = ""
+    nonce_b64: str = ""
+    ciphertext_b64: str = ""
+
+
+@dataclass
+class VariableDecrypted:
+    """Plaintext view returned to authorized API callers
+    (reference: structs.VariableDecrypted -- Items map)."""
+    meta: VariableMetadata = field(default_factory=VariableMetadata)
+    items: Dict[str, str] = field(default_factory=dict)

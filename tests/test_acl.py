@@ -219,3 +219,35 @@ def test_http_acl_enforcement(acl_server):
 def test_token_ttl_zero_expires():
     t = ACLToken.new(name="t", ttl_s=0)
     assert t.is_expired()
+
+
+def test_bootstrap_reopens_when_management_tokens_gone():
+    """Deleting the last management token must not brick ACL admin."""
+    state = StateStore()
+    boot = ACLToken.new(name="boot", type="management")
+    assert state.bootstrap_acl_token(boot)
+    assert not state.bootstrap_acl_token(ACLToken.new(type="management"))
+    state.delete_acl_tokens([boot.accessor_id])
+    fresh = ACLToken.new(name="boot2", type="management")
+    assert state.bootstrap_acl_token(fresh)
+    assert state.acl_token_by_secret(fresh.secret_id) is not None
+
+
+def test_variable_write_only_path_cannot_read():
+    """Explicit expansion: a path granted only ["write"] expands to the
+    reference's write set (list/read/write/destroy); a custom cap list
+    without read stays write-only."""
+    acl = ACL(policies=[parse_policy("w", '''
+namespace "default" {
+  variables { path "drop/*" { capabilities = ["write"] } }
+}''')])
+    # reference semantics: write expands to read+list+write+destroy
+    assert acl.allow_variable_op("default", "drop/x", "write")
+    assert acl.allow_variable_op("default", "drop/x", "read")
+    # deny is sticky even when combined with write
+    acl2 = ACL(policies=[parse_policy("d", '''
+namespace "default" {
+  variables { path "drop/*" { capabilities = ["write", "deny"] } }
+}''')])
+    assert not acl2.allow_variable_op("default", "drop/x", "read")
+    assert not acl2.allow_variable_op("default", "drop/x", "write")

@@ -329,4 +329,22 @@ def test_cli_end_to_end(agent, capsys, tmp_path):
     capsys.readouterr()
     assert cli_main(["-address", addr, "version"]) == 0
     assert "nomad-tpu" in capsys.readouterr().out
+
+    # secure variables + keyring round trip
+    assert cli_main(["-address", addr, "var", "put", "app/config",
+                     "db=postgres", "user=admin"]) == 0
+    assert "app/config" in capsys.readouterr().out
+    assert cli_main(["-address", addr, "var", "get", "app/config"]) == 0
+    assert "postgres" in capsys.readouterr().out
+    assert cli_main(["-address", addr, "var", "list"]) == 0
+    assert "app/config" in capsys.readouterr().out
+    assert cli_main(["-address", addr, "operator", "keyring",
+                     "rotate"]) == 0
+    capsys.readouterr()
+    assert cli_main(["-address", addr, "operator", "keyring", "list"]) == 0
+    assert "active" in capsys.readouterr().out
+    assert cli_main(["-address", addr, "var", "get", "app/config"]) == 0
+    assert "postgres" in capsys.readouterr().out
+    assert cli_main(["-address", addr, "var", "purge", "app/config"]) == 0
+    capsys.readouterr()
     c.stop()

@@ -1,0 +1,209 @@
+"""Keyring/encrypter + secure Variables tests (reference analogs:
+nomad/encrypter_test.go, nomad/variables_endpoint_test.go)."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu.raft.fsm import dump_state, restore_state
+from nomad_tpu.server import Server
+from nomad_tpu.server.encrypter import Encrypter
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ROOT_KEY_STATE_ACTIVE, ROOT_KEY_STATE_INACTIVE,
+    VariableDecrypted, VariableMetadata,
+)
+
+
+@pytest.fixture
+def enc():
+    state = StateStore()
+    e = Encrypter(state)
+    e.initialize()
+    return e, state
+
+
+def test_encrypt_decrypt_roundtrip(enc):
+    e, _ = enc
+    dec = VariableDecrypted(
+        meta=VariableMetadata(namespace="default", path="nomad/jobs/web"),
+        items={"db_password": "hunter2", "api_key": "abc123"})
+    ct = e.encrypt_variable(dec)
+    assert ct.ciphertext_b64 and ct.key_id
+    assert "hunter2" not in ct.ciphertext_b64
+    out = e.decrypt_variable(ct)
+    assert out.items == dec.items
+
+
+def test_ciphertext_bound_to_path(enc):
+    """AEAD associated data: moving ciphertext to another path fails."""
+    e, _ = enc
+    dec = VariableDecrypted(
+        meta=VariableMetadata(namespace="default", path="a"),
+        items={"k": "v"})
+    ct = e.encrypt_variable(dec)
+    ct.meta.path = "b"
+    with pytest.raises(Exception):
+        e.decrypt_variable(ct)
+
+
+def test_rotation_keeps_old_keys_decrypting(enc):
+    e, state = enc
+    dec = VariableDecrypted(
+        meta=VariableMetadata(namespace="default", path="p"),
+        items={"k": "v"})
+    ct_old = e.encrypt_variable(dec)
+    old_key = e.active_key().key_id
+    new_key = e.rotate()
+    assert new_key.key_id != old_key
+    states = {k.key_id: k.state for k in state.root_keys()}
+    assert states[old_key] == ROOT_KEY_STATE_INACTIVE
+    assert states[new_key.key_id] == ROOT_KEY_STATE_ACTIVE
+    # old ciphertext still decrypts; new writes use the new key
+    assert e.decrypt_variable(ct_old).items == {"k": "v"}
+    ct_new = e.encrypt_variable(dec)
+    assert ct_new.key_id == new_key.key_id
+
+
+def test_jwt_sign_verify(enc):
+    e, _ = enc
+    tok = e.sign_claims({"sub": "ns:job:task"})
+    claims = e.verify_claims(tok)
+    assert claims["sub"] == "ns:job:task"
+    assert claims["iss"] == "nomad-tpu"
+    # tampered payload fails
+    head, body, sig = tok.split(".")
+    assert e.verify_claims(f"{head}.{body[:-2]}xx.{sig}") is None
+    # expired fails
+    expired = e.sign_claims({"sub": "x"}, ttl_s=-10)
+    assert e.verify_claims(expired) is None
+    # unknown kid fails
+    assert e.verify_claims("a.b.c") is None
+
+
+def test_variables_cas_semantics():
+    server = Server(num_workers=0)
+    server.encrypter.initialize()
+    # create-only (cas=0) succeeds then conflicts
+    ok, v1 = server.var_put("default", "app/cfg", {"a": "1"}, cas_index=0)
+    assert ok and v1.meta.modify_index > 0
+    ok, conflict = server.var_put("default", "app/cfg", {"a": "2"},
+                                  cas_index=0)
+    assert not ok and conflict.items == {"a": "1"}
+    # correct cas succeeds
+    ok, v2 = server.var_put("default", "app/cfg", {"a": "2"},
+                            cas_index=v1.meta.modify_index)
+    assert ok and v2.items == {"a": "2"}
+    # blind write succeeds
+    ok, v3 = server.var_put("default", "app/cfg", {"a": "3"})
+    assert ok
+    # delete with stale cas fails, with current succeeds
+    assert not server.var_delete("default", "app/cfg", cas_index=1)
+    assert server.var_delete("default", "app/cfg",
+                             cas_index=v3.meta.modify_index)
+    assert server.var_get("default", "app/cfg") is None
+
+
+def test_variables_list_and_prefix():
+    server = Server(num_workers=0)
+    server.encrypter.initialize()
+    for path in ("nomad/jobs/a", "nomad/jobs/b", "other/x"):
+        server.var_put("default", path, {"k": "v"})
+    server.var_put("prod", "nomad/jobs/a", {"k": "v"})
+    metas = server.var_list("default", prefix="nomad/jobs/")
+    assert sorted(m.path for m in metas) == ["nomad/jobs/a", "nomad/jobs/b"]
+    assert len(server.var_list(None)) == 4
+
+
+def test_variables_survive_snapshot_restore():
+    server = Server(num_workers=0)
+    server.encrypter.initialize()
+    server.var_put("default", "p", {"secret": "s3cr3t"})
+    blob = json.loads(json.dumps(dump_state(server.state)))
+    # ciphertext at rest: plaintext never appears in the snapshot
+    assert "s3cr3t" not in json.dumps(blob)
+    fresh = StateStore()
+    restore_state(fresh, blob)
+    server2 = Server(num_workers=0, state=fresh)
+    dec = server2.var_get("default", "p")
+    assert dec.items == {"secret": "s3cr3t"}
+
+
+def _req(port, path, method="GET", body=None, token=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=data, method=method)
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_http_variables_and_keyring():
+    from nomad_tpu.api.http import HttpServer
+    server = Server(num_workers=0, acl_enabled=True)
+    server.encrypter.initialize()
+    http = HttpServer(server, port=0)
+    http.start()
+    port = http.port
+    try:
+        code, boot = _req(port, "/v1/acl/bootstrap", method="POST")
+        mgmt = boot["secret_id"]
+        # put + get + list
+        code, out = _req(port, "/v1/var/nomad/jobs/web", method="PUT",
+                         body={"items": {"pw": "x"}}, token=mgmt)
+        assert code == 200, out
+        code, got = _req(port, "/v1/var/nomad/jobs/web", token=mgmt)
+        assert code == 200 and got["items"] == {"pw": "x"}
+        code, lst = _req(port, "/v1/vars?prefix=nomad/", token=mgmt)
+        assert code == 200 and lst[0]["path"] == "nomad/jobs/web"
+        # anonymous denied
+        assert _req(port, "/v1/var/nomad/jobs/web")[0] == 403
+        # path-scoped token: read-only on nomad/jobs/*
+        rules = ('namespace "default" { variables { '
+                 'path "nomad/jobs/*" { capabilities = ["read", "list"] } '
+                 '} }')
+        _req(port, "/v1/acl/policy/varread", method="POST",
+             body={"rules": rules}, token=mgmt)
+        code, tok = _req(port, "/v1/acl/token", method="POST",
+                         body={"policies": ["varread"]}, token=mgmt)
+        ro = tok["secret_id"]
+        assert _req(port, "/v1/var/nomad/jobs/web", token=ro)[0] == 200
+        assert _req(port, "/v1/var/nomad/jobs/web", method="PUT",
+                    body={"items": {}}, token=ro)[0] == 403
+        assert _req(port, "/v1/var/other/path", token=ro)[0] == 403
+        # cas conflict over HTTP
+        code, _ = _req(port, "/v1/var/nomad/jobs/web?cas=999",
+                       method="PUT", body={"items": {"pw": "y"}},
+                       token=mgmt)
+        assert code == 409
+        # keyring: list hides material, rotate works
+        code, keys = _req(port, "/v1/operator/keyring/keys", token=mgmt)
+        assert code == 200 and "material_b64" not in json.dumps(keys)
+        code, rot = _req(port, "/v1/operator/keyring/rotate",
+                         method="POST", token=mgmt)
+        assert code == 200
+        code, keys2 = _req(port, "/v1/operator/keyring/keys", token=mgmt)
+        assert len(keys2) == len(keys) + 1
+        # old variable still readable after rotation
+        code, got = _req(port, "/v1/var/nomad/jobs/web", token=mgmt)
+        assert code == 200 and got["items"] == {"pw": "x"}
+    finally:
+        http.shutdown()
+        server.shutdown()
+
+
+def test_workload_identity_for_alloc():
+    from nomad_tpu import mock
+    server = Server(num_workers=0)
+    server.encrypter.initialize()
+    alloc = mock.alloc_for(mock.job(), mock.node())
+    tok = server.encrypter.workload_identity(alloc, "web")
+    claims = server.encrypter.verify_claims(tok)
+    assert claims["nomad_allocation_id"] == alloc.id
+    assert claims["nomad_task"] == "web"
