@@ -116,6 +116,43 @@ class ApiClient:
     def job_deployment(self, job_id: str) -> Optional[dict]:
         return self.get(f"/v1/job/{job_id}/deployment")
 
+    def job_versions(self, job_id: str) -> dict:
+        return self.get(f"/v1/job/{job_id}/versions")
+
+    def revert_job(self, job_id: str, version: int,
+                   enforce_prior_version: Optional[int] = None) -> dict:
+        return self.post(f"/v1/job/{job_id}/revert",
+                         {"job_version": version,
+                          "enforce_prior_version": enforce_prior_version})
+
+    def stabilize_job(self, job_id: str, version: int,
+                      stable: bool = True) -> dict:
+        return self.post(f"/v1/job/{job_id}/stable",
+                         {"job_version": version, "stable": stable})
+
+    def dispatch_job(self, job_id: str, payload: bytes = b"",
+                     meta: Optional[dict] = None,
+                     idempotency_token: str = "") -> dict:
+        import base64
+        return self.post(f"/v1/job/{job_id}/dispatch", {
+            "payload": base64.b64encode(payload).decode(),
+            "meta": meta or {}, "idempotency_token": idempotency_token})
+
+    def scale_job(self, job_id: str, group: str, count: int,
+                  message: str = "") -> dict:
+        return self.post(f"/v1/job/{job_id}/scale", {
+            "count": count, "target": {"Group": group}, "message": message})
+
+    def job_scale_status(self, job_id: str) -> dict:
+        return self.get(f"/v1/job/{job_id}/scale")
+
+    def scaling_policies(self, job: Optional[str] = None) -> List[dict]:
+        params = {"job": job} if job else {}
+        return self.get("/v1/scaling/policies", **params)
+
+    def scaling_policy(self, policy_id: str) -> dict:
+        return self.get(f"/v1/scaling/policy/{policy_id}")
+
     # -- nodes (reference: api/nodes.go) -------------------------------
     def nodes(self) -> List[dict]:
         return self.get("/v1/nodes")

@@ -16,7 +16,8 @@ from urllib.parse import parse_qs, urlparse
 from ..structs import (
     Constraint, EphemeralDisk, Job, NetworkResource, Port, ReschedulePolicy,
     Resources, RestartPolicy, SchedulerConfiguration, Spread, SpreadTarget,
-    Task, TaskGroup, UpdateStrategy, Affinity, PeriodicConfig,
+    Task, TaskGroup, UpdateStrategy, Affinity, ParameterizedJobConfig,
+    PeriodicConfig,
 )
 
 
@@ -89,7 +90,7 @@ def job_from_json(data: dict) -> Job:
                                if tg_src.get("reschedule_policy") else None),
             ephemeral_disk=build(EphemeralDisk,
                                  tg_src.get("ephemeral_disk", {})),
-            volumes={}, scaling=None, migrate=None)
+            volumes={}, scaling=tg_src.get("scaling"), migrate=None)
         tgs.append(tg)
     job = Job(
         id=data.get("id", ""),
@@ -119,6 +120,11 @@ def job_from_json(data: dict) -> Job:
         fields = {f.name for f in dataclasses.fields(PeriodicConfig)}
         job.periodic = PeriodicConfig(
             **{k: v for k, v in data["periodic"].items() if k in fields})
+    if data.get("parameterized"):
+        fields = {f.name for f in dataclasses.fields(ParameterizedJobConfig)}
+        job.parameterized = ParameterizedJobConfig(
+            **{k: v for k, v in data["parameterized"].items()
+               if k in fields})
     return job
 
 
@@ -240,6 +246,13 @@ class ApiHandler(BaseHTTPRequestHandler):
                 # resource-namespace check still runs after fetch
                 if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
                     return
+            elif parts[:2] == ["v1", "scaling"]:
+                from ..acl import CAP_LIST_SCALING_POLICIES
+                allowed = (acl.allow_any_namespace(CAP_LIST_SCALING_POLICIES)
+                           if ns == "*" else acl.allow_namespace_op(
+                               ns, CAP_LIST_SCALING_POLICIES))
+                if not self._check(allowed):
+                    return
             elif parts == ["v1", "event", "stream"]:
                 if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
                     return
@@ -269,6 +282,35 @@ class ApiHandler(BaseHTTPRequestHandler):
                     parts[3] == "deployment":
                 self._send(200, state.latest_deployment_by_job(ns, parts[2]),
                            index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "versions":
+                versions = self.nomad.job_versions(ns, parts[2])
+                if not versions:
+                    return self._error(404, "job not found")
+                self._send(200, {"versions": versions}, index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "scale":
+                status = self.nomad.job_scale_status(ns, parts[2])
+                if status is None:
+                    return self._error(404, "job not found")
+                self._send(200, status, index)
+            elif parts == ["v1", "scaling", "policies"]:
+                job_filter = q.get("job", [None])[0]
+                pols = state.scaling_policies(None if ns == "*" else ns)
+                if job_filter:
+                    pols = [p for p in pols if p.job_id == job_filter]
+                self._send(200, pols, index)
+            elif parts[:3] == ["v1", "scaling", "policy"] and len(parts) == 4:
+                pol = state.scaling_policy_by_id(parts[3])
+                if pol is None:
+                    return self._error(404, "policy not found")
+                # re-check against the POLICY's namespace (ids are
+                # guessable; the pre-gate only saw the query namespace)
+                from ..acl import CAP_READ_SCALING_POLICY
+                if not self._check(acl.allow_namespace_op(
+                        pol.namespace, CAP_READ_SCALING_POLICY)):
+                    return
+                self._send(200, pol, index)
             elif parts[:2] == ["v1", "evaluations"]:
                 self._send(200, [e for e in state.evals()
                                  if acl.allow_namespace_op(
@@ -407,9 +449,75 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if not self._check(acl.allow_namespace_op(job.namespace,
                                                           CAP_SUBMIT_JOB)):
                     return
-                ev = self.nomad.register_job(job)
+                try:
+                    ev = self.nomad.register_job(job)
+                except ValueError as e:
+                    return self._error(400, str(e))
                 self._send(200, {"eval_id": ev.id if ev else "",
                                  "job_modify_index": job.job_modify_index})
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "revert":
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_SUBMIT_JOB)):
+                    return
+                body = self._body()
+                try:
+                    ev = self.nomad.revert_job(
+                        ns, parts[2], int(body.get("job_version", 0)),
+                        body.get("enforce_prior_version"))
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"eval_id": ev.id if ev else ""})
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "stable":
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_SUBMIT_JOB)):
+                    return
+                body = self._body()
+                try:
+                    self.nomad.set_job_stability(
+                        ns, parts[2], int(body.get("job_version", 0)),
+                        bool(body.get("stable", True)))
+                except (TypeError, ValueError) as e:
+                    return self._error(400, str(e))
+                self._send(200, {"updated": True})
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "dispatch":
+                from ..acl import CAP_DISPATCH_JOB
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_DISPATCH_JOB)):
+                    return
+                import base64
+                body = self._body()
+                try:
+                    payload = base64.b64decode(body.get("payload", "") or "")
+                    child, ev = self.nomad.dispatch_job(
+                        ns, parts[2], payload, body.get("meta") or {},
+                        body.get("idempotency_token", ""))
+                except ValueError as e:   # includes binascii.Error
+                    return self._error(400, str(e))
+                self._send(200, {"dispatched_job_id": child.id,
+                                 "eval_id": ev.id if ev else ""})
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "scale":
+                from ..acl import CAP_SCALE_JOB
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_SCALE_JOB)):
+                    return
+                body = self._body()
+                target = body.get("target") or {}
+                group = target.get("Group", target.get("group", ""))
+                try:
+                    ev = self.nomad.scale_job(
+                        ns, parts[2], group,
+                        count=(int(body["count"])
+                               if body.get("count") is not None else None),
+                        message=body.get("message", ""),
+                        error=bool(body.get("error", False)),
+                        meta=body.get("meta"))
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"eval_id": ev.id if ev else ""})
             elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
                     parts[3] == "plan":
                 body = self._body()

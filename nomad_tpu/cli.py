@@ -144,6 +144,40 @@ def cmd_job_inspect(args) -> int:
     return 0
 
 
+def cmd_job_history(args) -> int:
+    reply = _client(args).job_versions(args.id)
+    rows = [[str(v["version"]), "true" if v.get("stable") else "false",
+             v.get("status", "")] for v in reply.get("versions", [])]
+    print(_fmt_table(rows, ["Version", "Stable", "Status"]))
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    reply = _client(args).revert_job(args.id, args.version)
+    print(f"==> Evaluation {reply.get('eval_id', '')!r} submitted")
+    return 0
+
+
+def cmd_job_dispatch(args) -> int:
+    payload = b""
+    if args.payload_file:
+        with open(args.payload_file, "rb") as f:
+            payload = f.read()
+    meta = dict(kv.split("=", 1) for kv in (args.meta or []))
+    reply = _client(args).dispatch_job(args.id, payload, meta,
+                                       args.idempotency_token)
+    print(f"Dispatched Job ID = {reply.get('dispatched_job_id', '')}")
+    print(f"Evaluation ID     = {reply.get('eval_id', '')}")
+    return 0
+
+
+def cmd_job_scale(args) -> int:
+    reply = _client(args).scale_job(args.id, args.group, args.count,
+                                    message=args.message)
+    print(f"==> Evaluation {reply.get('eval_id', '')!r} submitted")
+    return 0
+
+
 def cmd_node_status(args) -> int:
     api = _client(args)
     if not args.id:
@@ -367,6 +401,26 @@ def build_parser() -> argparse.ArgumentParser:
     ji = job.add_parser("inspect")
     ji.add_argument("id")
     ji.set_defaults(fn=cmd_job_inspect)
+    jh = job.add_parser("history")
+    jh.add_argument("id")
+    jh.set_defaults(fn=cmd_job_history)
+    jrev = job.add_parser("revert")
+    jrev.add_argument("id")
+    jrev.add_argument("version", type=int)
+    jrev.set_defaults(fn=cmd_job_revert)
+    jd = job.add_parser("dispatch")
+    jd.add_argument("id")
+    jd.add_argument("payload_file", nargs="?", default="")
+    jd.add_argument("-meta", action="append", default=[])
+    jd.add_argument("-idempotency-token", dest="idempotency_token",
+                    default="")
+    jd.set_defaults(fn=cmd_job_dispatch)
+    jsc = job.add_parser("scale")
+    jsc.add_argument("id")
+    jsc.add_argument("group")
+    jsc.add_argument("count", type=int)
+    jsc.add_argument("-message", default="")
+    jsc.set_defaults(fn=cmd_job_scale)
 
     node = sub.add_parser("node", help="node commands").add_subparsers(
         dest="sub", required=True)

@@ -129,6 +129,16 @@ def parse_group(b: Block, job: Job) -> TaskGroup:
     tg.constraints = [_constraint(c) for c in b.blocks("constraint")]
     tg.affinities = [_affinity(c) for c in b.blocks("affinity")]
     tg.spreads = [_spread(s) for s in b.blocks("spread")]
+    sc = b.first("scaling")
+    if sc is not None:
+        sa = sc.attrs()
+        tg.scaling = {
+            "min": int(sa.get("min", 0)),
+            "max": int(sa.get("max", tg.count)),
+            "enabled": bool(sa.get("enabled", True)),
+            "policy": {blk.label(0) or "policy": blk.attrs()
+                       for blk in sc.blocks("policy")},
+        }
     tg.networks = [_network(n) for n in b.blocks("network")]
     tg.services = [_service(s) for s in b.blocks("service")]
     upd = b.first("update")

@@ -15,8 +15,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..state.store import StateStore
 from ..structs import (
     ACLPolicy, ACLToken, Allocation, Deployment, DrainStrategy, Evaluation,
-    Job, Node, NodePool, PlanResult, RootKey, SchedulerConfiguration,
-    VariableEncrypted,
+    Job, Node, NodePool, PlanResult, RootKey, ScalingEvent, ScalingPolicy,
+    SchedulerConfiguration, VariableEncrypted,
 )
 from ..structs import codec
 
@@ -30,7 +30,9 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "update_node_drain": [str, Optional[DrainStrategy], bool],
     "upsert_job": [Job],
     "update_job_status": [str, str, str],
+    "update_job_stability": [str, str, int, bool],
     "delete_job": [str, str],
+    "upsert_scaling_event": [str, str, ScalingEvent],
     "upsert_evals": [List[Evaluation]],
     "delete_evals": [List[str]],
     "upsert_allocs": [List[Allocation]],
@@ -113,6 +115,11 @@ def dump_state(store: StateStore) -> dict:
                           for k in store._root_keys.values()],
             "variables": [codec.encode(v)
                           for v in store._variables.values()],
+            "scaling_policies": [codec.encode(p)
+                                 for p in store._scaling_policies.values()],
+            "scaling_events": {
+                codec._encode_key(k): [codec.encode(e) for e in evs]
+                for k, evs in store._scaling_events.items()},
         }
 
 
@@ -168,6 +175,15 @@ def restore_state(store: StateStore, blob: dict) -> None:
             if stored is not None and a.job is not None and \
                     a.job.version == stored.version:
                 a.job = stored
+        store._scaling_policies = {
+            p.id: p for p in
+            (codec.decode(ScalingPolicy, raw)
+             for raw in blob.get("scaling_policies", []))}
+        store._scaling_events = {}
+        for k, evs in blob.get("scaling_events", {}).items():
+            ns, jid = k.split("\x1f")
+            store._scaling_events[(ns, jid)] = [
+                codec.decode(ScalingEvent, e) for e in evs]
         store._index = blob.get("index", 1)
         ti = blob.get("table_index", {})
         for t in store._table_index:

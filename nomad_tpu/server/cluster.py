@@ -102,6 +102,14 @@ class RaftBackedStateStore:
     def set_scheduler_config(self, cfg):
         return self._propose("set_scheduler_config", cfg)
 
+    def update_job_stability(self, namespace, job_id, version, stable):
+        return self._propose("update_job_stability", namespace, job_id,
+                             version, stable)
+
+    def upsert_scaling_event(self, namespace, job_id, event):
+        return self._propose("upsert_scaling_event", namespace, job_id,
+                             event)
+
     def upsert_plan_results(self, result, eval_updates=None):
         return self._propose("upsert_plan_results", result, eval_updates)
 

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 from ..state import StateStore
 from ..structs import (
     Allocation, Deployment, DeploymentStatusUpdate, Evaluation, Job, Node,
-    Plan, PlanResult, generate_uuid,
+    Plan, PlanResult, ScalingEvent, generate_uuid,
     ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_RUN,
     ALLOC_DESIRED_STOP, DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_RUNNING,
     DEPLOYMENT_STATUS_SUCCESSFUL, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
@@ -232,6 +232,7 @@ class Server:
     # ------------------------------------------------------------------
     # Job API (reference: nomad/job_endpoint.go Job.Register :96)
     def register_job(self, job: Job) -> Evaluation:
+        self._validate_job(job)
         self.state.upsert_job(job)
         if job.is_periodic() or job.is_parameterized():
             # periodic/parameterized jobs don't get an immediate eval
@@ -250,6 +251,29 @@ class Server:
         self.broker.enqueue(ev)
         self.publish_event("JobRegistered", {"job_id": job.id})
         return ev
+
+    @staticmethod
+    def _validate_job(job: Job) -> None:
+        """Admission validation before anything reaches replicated state
+        (reference: job_endpoint.go admission hooks / Job.Validate). Keeps
+        malformed user input out of the FSM apply path."""
+        for tg in job.task_groups:
+            sc = tg.scaling
+            if sc is None:
+                continue
+            if not isinstance(sc, dict):
+                raise ValueError(
+                    f"group {tg.name}: scaling must be a block/object")
+            try:
+                lo = int(sc.get("min", 0) or 0)
+                hi = int(sc.get("max", tg.count))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"group {tg.name}: scaling min/max must be integers")
+            if lo < 0 or hi < lo:
+                raise ValueError(
+                    f"group {tg.name}: scaling bounds invalid "
+                    f"(min={lo}, max={hi})")
 
     def deregister_job(self, namespace: str, job_id: str,
                        purge: bool = False) -> Optional[Evaluation]:
@@ -341,6 +365,167 @@ class Server:
                 existing.job_modify_index if existing else 0,
             "diff_type": ("Edited" if existing is not None else "Added"),
         }
+
+    # ------------------------------------------------------------------
+    # Job lifecycle (reference: nomad/job_endpoint.go Job.GetJobVersions,
+    # Job.Revert, Job.Stable, Job.Dispatch, Job.Scale)
+    def job_versions(self, namespace: str, job_id: str) -> List[Job]:
+        return self.state.job_versions_by_id(namespace, job_id)
+
+    def revert_job(self, namespace: str, job_id: str, version: int,
+                   enforce_prior_version: Optional[int] = None):
+        """Re-register the spec of a prior version as a NEW version
+        (reference: job_endpoint.go Job.Revert -- revert is a forward
+        operation, never a rollback of history)."""
+        import copy
+        current = self.state.job_by_id(namespace, job_id)
+        if current is None:
+            raise ValueError(f"job {job_id} not found")
+        if enforce_prior_version is not None and \
+                current.version != enforce_prior_version:
+            raise ValueError(
+                f"current version {current.version} != enforced "
+                f"{enforce_prior_version}")
+        if version == current.version:
+            raise ValueError("cannot revert to the current version")
+        prior = self.state.job_version(namespace, job_id, version)
+        if prior is None:
+            raise ValueError(f"version {version} not found")
+        revert = copy.deepcopy(prior)
+        revert.stop = False
+        # the NEW version must re-earn stability through a deployment
+        # (reference: Job.Revert registers with Stable=false)
+        revert.stable = False
+        return self.register_job(revert)
+
+    def set_job_stability(self, namespace: str, job_id: str,
+                          version: int, stable: bool) -> None:
+        """(reference: job_endpoint.go Job.Stable)"""
+        if self.state.job_version(namespace, job_id, version) is None:
+            raise ValueError(
+                f"job {job_id} version {version} not found")
+        self.state.update_job_stability(namespace, job_id, version, stable)
+
+    def dispatch_job(self, namespace: str, job_id: str,
+                     payload: bytes = b"", meta: Optional[Dict[str, str]] = None,
+                     idempotency_token: str = ""):
+        """Instantiate a parameterized job as a dispatched child
+        (reference: job_endpoint.go Job.Dispatch + validateDispatchRequest).
+        Returns (child_job, eval-or-None)."""
+        import copy
+        meta = dict(meta or {})
+        parent = self.state.job_by_id(namespace, job_id)
+        if parent is None:
+            raise ValueError(f"job {job_id} not found")
+        cfg = parent.parameterized
+        if cfg is None or parent.dispatched:
+            raise ValueError(f"job {job_id} is not parameterized")
+        if parent.stop:
+            raise ValueError(f"job {job_id} is stopped")
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload is required")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload is forbidden")
+        if len(payload) > 16 * 1024:
+            raise ValueError("payload exceeds 16KiB limit")
+        required = set(cfg.meta_required or [])
+        allowed = required | set(cfg.meta_optional or [])
+        missing = required - set(meta)
+        if missing:
+            raise ValueError(f"missing required meta: {sorted(missing)}")
+        extra = set(meta) - allowed
+        if extra:
+            raise ValueError(f"unpermitted meta keys: {sorted(extra)}")
+        if idempotency_token:
+            for j in self.state.jobs():
+                if j.namespace == parent.namespace and \
+                        j.parent_id == parent.id and \
+                        j.dispatch_idempotency_token == idempotency_token:
+                    return j, None
+        child = copy.deepcopy(parent)
+        child.id = (f"{parent.id}/dispatch-{int(time.time())}-"
+                    f"{generate_uuid()[:8]}")
+        child.name = child.id
+        child.parent_id = parent.id
+        child.dispatched = True
+        child.payload = payload
+        child.dispatch_idempotency_token = idempotency_token
+        child.meta = {**(parent.meta or {}), **meta}
+        ev = self.register_job(child)
+        self.publish_event("JobDispatched",
+                           {"job_id": parent.id, "dispatched_id": child.id})
+        return child, ev
+
+    def scale_job(self, namespace: str, job_id: str, group: str,
+                  count: Optional[int] = None, message: str = "",
+                  error: bool = False, meta: Optional[dict] = None):
+        """Set a group's count, recording a scaling event
+        (reference: job_endpoint.go Job.Scale). With error=True or
+        count=None only the event is recorded (the autoscaler's audit
+        path). Returns the eval (or None)."""
+        import copy
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id} not found")
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(f"group {group} not found in job {job_id}")
+        prev_count = tg.count
+        ev = None
+        if count is not None and not error:
+            if count < 0:
+                raise ValueError("count must be >= 0")
+            if tg.scaling:
+                lo = int(tg.scaling.get("min", 0) or 0)
+                hi = int(tg.scaling.get("max", count))
+                if count < lo or count > hi:
+                    raise ValueError(
+                        f"count {count} outside scaling bounds "
+                        f"[{lo}, {hi}]")
+            if job.stop:
+                raise ValueError(f"job {job_id} is stopped")
+            updated = copy.deepcopy(job)
+            updated.lookup_task_group(group).count = count
+            ev = self.register_job(updated)
+        self.state.upsert_scaling_event(
+            namespace, job_id,
+            ScalingEvent(
+                time=time.time(), task_group=group, count=count,
+                previous_count=prev_count, message=message, error=error,
+                meta=dict(meta or {}), eval_id=ev.id if ev else ""))
+        return ev
+
+    def job_scale_status(self, namespace: str, job_id: str) -> Optional[dict]:
+        """(reference: job_endpoint.go Job.ScaleStatus)"""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        allocs = self.state.allocs_by_job(namespace, job_id)
+        all_events = self.state.scaling_events_by_job(namespace, job_id)
+        groups = {}
+        for tg in job.task_groups:
+            tg_allocs = [a for a in allocs if a.task_group == tg.name]
+            groups[tg.name] = {
+                "desired": tg.count,
+                "placed": len([a for a in tg_allocs
+                               if not a.terminal_status()]),
+                "running": len([a for a in tg_allocs
+                                if a.client_status == ALLOC_CLIENT_RUNNING]),
+                "healthy": len([a for a in tg_allocs
+                                if a.deployment_status is not None
+                                and a.deployment_status.is_healthy()]),
+                "unhealthy": len([a for a in tg_allocs
+                                  if a.deployment_status is not None
+                                  and a.deployment_status.is_unhealthy()]),
+                "events": [
+                    {"time": e.time, "count": e.count,
+                     "previous_count": e.previous_count,
+                     "message": e.message, "error": e.error,
+                     "eval_id": e.eval_id}
+                    for e in all_events if e.task_group == tg.name],
+            }
+        return {"job_id": job_id, "namespace": namespace,
+                "job_stopped": job.stop, "task_groups": groups}
 
     # ------------------------------------------------------------------
     # Node API (reference: nomad/node_endpoint.go)
@@ -690,6 +875,11 @@ class Server:
             nd.status = DEPLOYMENT_STATUS_SUCCESSFUL
             nd.status_description = "Deployment completed successfully"
             changed = True
+            # a successful deployment marks the job version stable
+            # (reference: deploymentwatcher setLatestEval -> Job.Stable)
+            if job is not None and job.version == nd.job_version:
+                self.state.update_job_stability(
+                    nd.namespace, nd.job_id, nd.job_version, True)
         if changed:
             # CAS guards against a concurrent plan commit having advanced
             # the deployment while we computed counts (lost-update race);

@@ -17,7 +17,7 @@ from .alloc_table import AllocTable
 from ..structs import (
     ACL_TOKEN_TYPE_MANAGEMENT, ACLPolicy, ACLToken, Allocation, Deployment,
     Evaluation, Job, Node, NodePool, Plan, PlanResult, RootKey,
-    SchedulerConfiguration, VariableEncrypted,
+    ScalingEvent, ScalingPolicy, SchedulerConfiguration, VariableEncrypted,
     ALLOC_DESIRED_STOP, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_COMPLETE,
     EVAL_STATUS_BLOCKED, JOB_STATUS_DEAD, JOB_STATUS_PENDING,
@@ -26,7 +26,7 @@ from ..structs import (
 
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
           "scheduler_config", "job_versions", "acl_policies", "acl_tokens",
-          "root_keys", "variables")
+          "root_keys", "variables", "scaling_policies", "scaling_events")
 
 
 class StateSnapshot:
@@ -161,6 +161,10 @@ class StateStore:
         # and VariablesQuota regions; variables keyed (namespace, path))
         self._root_keys: Dict[str, "RootKey"] = {}
         self._variables: Dict[Tuple[str, str], "VariableEncrypted"] = {}
+        # scaling (reference: state_store.go ScalingPolicies/ScalingEvents
+        # regions; policies derived from jobs on UpsertJob)
+        self._scaling_policies: Dict[str, ScalingPolicy] = {}
+        self._scaling_events: Dict[Tuple[str, str], List[ScalingEvent]] = {}
         # secondary indexes
         self._allocs_by_node: Dict[str, List[str]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
@@ -287,7 +291,47 @@ class StateStore:
                 job.status = JOB_STATUS_PENDING
             self._jobs[key] = job
             self._job_versions[(job.namespace, job.id, job.version)] = job
+            self._update_job_scaling_policies_locked(job)
             return self._bump("jobs", "job_versions")
+
+    def _update_job_scaling_policies_locked(self, job: Job) -> None:
+        """Re-derive the job's scaling policies from its groups' scaling
+        blocks (reference: state_store.go updateJobScalingPolicies)."""
+        import hashlib
+        keep = set()
+        for tg in job.task_groups:
+            # defensive: never let a malformed block break FSM apply --
+            # validation belongs to admission (Server._validate_job)
+            if not tg.scaling or not isinstance(tg.scaling, dict):
+                continue
+            target = {"Namespace": job.namespace, "Job": job.id,
+                      "Group": tg.name}
+            pid = hashlib.sha1(
+                f"{job.namespace}\x1f{job.id}\x1f{tg.name}".encode()
+            ).hexdigest()[:36]
+            keep.add(pid)
+            existing = self._scaling_policies.get(pid)
+            try:
+                lo = int(tg.scaling.get("min", 0) or 0)
+                hi = int(tg.scaling.get("max", tg.count))
+            except (TypeError, ValueError):
+                continue
+            pol = ScalingPolicy(
+                id=pid, namespace=job.namespace, job_id=job.id,
+                type=str(tg.scaling.get("type", "horizontal")),
+                target=target,
+                min=lo, max=hi,
+                policy=dict(tg.scaling.get("policy") or {}),
+                enabled=bool(tg.scaling.get("enabled", True)),
+                create_index=(existing.create_index if existing
+                              else self._index + 1),
+                modify_index=self._index + 1)
+            self._scaling_policies[pid] = pol
+        for pid, pol in list(self._scaling_policies.items()):
+            if (pol.namespace, pol.job_id) == (job.namespace, job.id) and \
+                    pid not in keep:
+                del self._scaling_policies[pid]
+        self._table_index["scaling_policies"] = self._index + 1
 
     def update_job_status(self, namespace: str, job_id: str,
                           status: str) -> int:
@@ -312,12 +356,76 @@ class StateStore:
             for k in [k for k in self._job_versions
                       if k[0] == namespace and k[1] == job_id]:
                 del self._job_versions[k]
-            return self._bump("jobs", "job_versions")
+            for pid, pol in list(self._scaling_policies.items()):
+                if (pol.namespace, pol.job_id) == (namespace, job_id):
+                    del self._scaling_policies[pid]
+            self._scaling_events.pop((namespace, job_id), None)
+            return self._bump("jobs", "job_versions", "scaling_policies")
 
     def job_version(self, namespace: str, job_id: str,
                     version: int) -> Optional[Job]:
         with self._lock:
             return self._job_versions.get((namespace, job_id, version))
+
+    def job_versions_by_id(self, namespace: str, job_id: str) -> List[Job]:
+        """All tracked versions, newest first (reference:
+        state_store.go JobVersionsByID)."""
+        with self._lock:
+            versions = [v for (ns, jid, _), v in self._job_versions.items()
+                        if (ns, jid) == (namespace, job_id)]
+            return sorted(versions, key=lambda j: -j.version)
+
+    def update_job_stability(self, namespace: str, job_id: str,
+                             version: int, stable: bool) -> int:
+        """(reference: state_store.go UpdateJobStability)"""
+        with self._lock:
+            job = self._job_versions.get((namespace, job_id, version))
+            if job is None:
+                return self._index
+            import copy as _copy
+            updated = _copy.copy(job)
+            updated.stable = stable
+            updated.modify_index = self._index + 1
+            self._job_versions[(namespace, job_id, version)] = updated
+            current = self._jobs.get((namespace, job_id))
+            if current is not None and current.version == version:
+                self._jobs[(namespace, job_id)] = updated
+            return self._bump("jobs", "job_versions")
+
+    # -- scaling -------------------------------------------------------------
+    def scaling_policies(self, namespace: Optional[str] = None
+                         ) -> List[ScalingPolicy]:
+        with self._lock:
+            return [p for p in self._scaling_policies.values()
+                    if namespace is None or p.namespace == namespace]
+
+    def scaling_policy_by_id(self, policy_id: str
+                             ) -> Optional[ScalingPolicy]:
+        with self._lock:
+            return self._scaling_policies.get(policy_id)
+
+    def scaling_policies_by_job(self, namespace: str, job_id: str
+                                ) -> List[ScalingPolicy]:
+        with self._lock:
+            return [p for p in self._scaling_policies.values()
+                    if (p.namespace, p.job_id) == (namespace, job_id)]
+
+    def upsert_scaling_event(self, namespace: str, job_id: str,
+                             event: ScalingEvent) -> int:
+        """Append to the job's scaling audit trail, keeping the most recent
+        entries (reference: state_store.go UpsertScalingEvent, bounded by
+        structs.JobTrackedScalingEvents=20)."""
+        with self._lock:
+            events = self._scaling_events.setdefault((namespace, job_id), [])
+            events.append(event)
+            if len(events) > 20:
+                del events[:-20]
+            return self._bump("scaling_events")
+
+    def scaling_events_by_job(self, namespace: str, job_id: str
+                              ) -> List[ScalingEvent]:
+        with self._lock:
+            return list(self._scaling_events.get((namespace, job_id), []))
 
     # -- evals ---------------------------------------------------------------
     def upsert_evals(self, evals: List[Evaluation]) -> int:

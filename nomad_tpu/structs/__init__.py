@@ -10,6 +10,7 @@ from .resources import (  # noqa: F401
 from .job import (  # noqa: F401
     Affinity, Constraint, EphemeralDisk, Job, LogConfig, MigrateStrategy,
     ParameterizedJobConfig, PeriodicConfig, ReschedulePolicy, RestartPolicy,
+    ScalingEvent, ScalingPolicy,
     Service, Spread, SpreadTarget, Task, TaskGroup, UpdateStrategy,
     VolumeRequest, generate_uuid,
     JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM, JOB_TYPE_SYSBATCH,

@@ -315,3 +315,40 @@ class Job:
 
     def ns_id(self):
         return (self.namespace, self.id)
+
+
+@dataclass
+class ScalingPolicy:
+    """Horizontal scaling policy attached to a task group; derived from the
+    group's `scaling` block at job-register time
+    (reference: nomad/structs/structs.go ScalingPolicy + the state store's
+    updateJobScalingPolicies on UpsertJob)."""
+
+    id: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    job_id: str = ""
+    type: str = "horizontal"
+    # target identifies what the policy scales:
+    # {"Namespace": ns, "Job": id, "Group": group}
+    target: Dict[str, str] = field(default_factory=dict)
+    min: int = 0
+    max: int = 0
+    policy: Dict[str, object] = field(default_factory=dict)
+    enabled: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class ScalingEvent:
+    """One entry in a job's scaling audit trail
+    (reference: structs.ScalingEvent; recorded by Job.Scale)."""
+
+    time: float = 0.0
+    task_group: str = ""
+    count: Optional[int] = None
+    previous_count: int = 0
+    message: str = ""
+    error: bool = False
+    meta: Dict[str, object] = field(default_factory=dict)
+    eval_id: str = ""
