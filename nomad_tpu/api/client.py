@@ -153,6 +153,46 @@ class ApiClient:
     def scaling_policy(self, policy_id: str) -> dict:
         return self.get(f"/v1/scaling/policy/{policy_id}")
 
+    # -- namespaces + node pools (reference: api/namespace.go,
+    #    api/node_pools.go) --------------------------------------------
+    def namespaces(self) -> List[dict]:
+        return self.get("/v1/namespaces")
+
+    def get_namespace(self, name: str) -> dict:
+        # (named get_* because .namespace is the client's query namespace)
+        return self.get(f"/v1/namespace/{name}")
+
+    def upsert_namespace(self, name: str, **fields) -> dict:
+        return self.post(f"/v1/namespace/{name}",
+                         {"name": name, **fields})
+
+    def delete_namespace(self, name: str) -> dict:
+        return self.delete(f"/v1/namespace/{name}")
+
+    def node_pools(self) -> List[dict]:
+        return self.get("/v1/node/pools")
+
+    def node_pool(self, name: str) -> dict:
+        return self.get(f"/v1/node/pool/{name}")
+
+    def node_pool_nodes(self, name: str) -> List[dict]:
+        return self.get(f"/v1/node/pool/{name}/nodes")
+
+    def upsert_node_pool(self, name: str, **fields) -> dict:
+        return self.post(f"/v1/node/pool/{name}", {"name": name, **fields})
+
+    def delete_node_pool(self, name: str) -> dict:
+        return self.delete(f"/v1/node/pool/{name}")
+
+    # -- search (reference: api/search.go) -----------------------------
+    def search(self, prefix: str, context: str = "all") -> dict:
+        return self.post("/v1/search",
+                         {"prefix": prefix, "context": context})
+
+    def fuzzy_search(self, text: str, context: str = "all") -> dict:
+        return self.post("/v1/search/fuzzy",
+                         {"text": text, "context": context})
+
     # -- nodes (reference: api/nodes.go) -------------------------------
     def nodes(self) -> List[dict]:
         return self.get("/v1/nodes")

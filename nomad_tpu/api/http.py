@@ -338,7 +338,8 @@ class ApiHandler(BaseHTTPRequestHandler):
             elif parts[:2] == ["v1", "nodes"]:
                 self._send(200, [self._node_stub(n) for n in state.nodes()],
                            index)
-            elif parts[:2] == ["v1", "node"] and len(parts) == 3:
+            elif parts[:2] == ["v1", "node"] and len(parts) == 3 and \
+                    parts[2] not in ("pools", "pool"):
                 n = state.node_by_id(parts[2])
                 if n is None:
                     return self._error(404, "node not found")
@@ -347,6 +348,34 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, [d for d in state.deployments()
                                  if acl.allow_namespace_op(
                                      d.namespace, CAP_READ_JOB)], index)
+            elif parts == ["v1", "namespaces"]:
+                self._send(200, [n for n in state.namespaces()
+                                 if acl.allow_namespace(n.name)], index)
+            elif parts[:2] == ["v1", "namespace"] and len(parts) == 3:
+                # ACL first: a 403-vs-404 difference would leak existence
+                if not self._check(acl.allow_namespace(parts[2])):
+                    return
+                n = state.namespace_by_name(parts[2])
+                if n is None:
+                    return self._error(404, "namespace not found")
+                self._send(200, n, index)
+            elif parts == ["v1", "node", "pools"]:
+                if not self._check(acl.allow_node_read()):
+                    return
+                self._send(200, state.node_pools(), index)
+            elif parts[:3] == ["v1", "node", "pool"] and len(parts) == 4:
+                if not self._check(acl.allow_node_read()):
+                    return
+                p = state.node_pool_by_name(parts[3])
+                if p is None:
+                    return self._error(404, "node pool not found")
+                self._send(200, p, index)
+            elif parts[:3] == ["v1", "node", "pool"] and len(parts) == 5 \
+                    and parts[4] == "nodes":
+                if not self._check(acl.allow_node_read()):
+                    return
+                self._send(200, [self._node_stub(n) for n in state.nodes()
+                                 if n.node_pool == parts[3]], index)
             elif parts == ["v1", "operator", "scheduler", "configuration"]:
                 self._send(200, state.scheduler_config(), index)
             elif parts == ["v1", "operator", "keyring", "keys"]:
@@ -432,6 +461,30 @@ class ApiHandler(BaseHTTPRequestHandler):
             elif parts[1:2] == ["operator"] or parts[1:2] == ["system"]:
                 if not self._check(acl.allow_operator_write()):
                     return
+            if parts[:2] == ["v1", "search"]:
+                # (reference: command/agent/search_endpoint.go; context
+                # filtering per token caps as filteredSearchContexts)
+                body = self._body()
+                allowed = self._allowed_search_contexts(acl, ns)
+                from ..acl import CAP_READ_JOB as _READ
+                ns_allowed = (None if acl.is_management()
+                              else (lambda n: acl.allow_namespace_op(
+                                  n, _READ)))
+                if parts == ["v1", "search"]:
+                    reply = self.nomad.search(
+                        body.get("prefix", ""),
+                        body.get("context", "all") or "all",
+                        ns, allowed_contexts=allowed,
+                        ns_allowed=ns_allowed)
+                elif parts == ["v1", "search", "fuzzy"]:
+                    reply = self.nomad.fuzzy_search(
+                        body.get("text", ""),
+                        body.get("context", "all") or "all",
+                        ns, allowed_contexts=allowed,
+                        ns_allowed=ns_allowed)
+                else:
+                    return self._error(404, "unknown search path")
+                return self._send(200, reply)
             if parts == ["v1", "jobs", "parse"]:
                 # (reference: /v1/jobs/parse -- HCL -> api.Job JSON)
                 from ..jobspec import parse as parse_jobspec
@@ -525,7 +578,10 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if not self._check(acl.allow_namespace_op(job.namespace,
                                                           CAP_SUBMIT_JOB)):
                     return
-                self._send(200, self.nomad.plan_job(job))
+                try:
+                    self._send(200, self.nomad.plan_job(job))
+                except ValueError as e:
+                    return self._error(400, str(e))
             elif parts == ["v1", "node", "register"]:
                 from ..structs import Node, codec
                 node = codec.decode(Node, self._body().get("node", {}))
@@ -549,6 +605,46 @@ class ApiHandler(BaseHTTPRequestHandler):
                                       self._body().get("allocs", []))
                 self.nomad.update_allocs_from_client(allocs)
                 self._send(200, {"updated": len(allocs)})
+            elif parts == ["v1", "namespace"] or (
+                    parts[:2] == ["v1", "namespace"] and len(parts) == 3):
+                # upsert (reference: namespace_endpoint.go UpsertNamespaces;
+                # mutating namespaces is a management operation)
+                if not self._check(acl.is_management()):
+                    return
+                from ..structs import (Namespace,
+                                       NamespaceNodePoolConfiguration)
+                body = self._body()
+                npc_src = body.get("node_pool_configuration") or {}
+                namespace = Namespace(
+                    name=body.get("name", parts[2] if len(parts) == 3
+                                  else ""),
+                    description=body.get("description", ""),
+                    quota=body.get("quota", ""),
+                    meta=body.get("meta") or {},
+                    node_pool_configuration=NamespaceNodePoolConfiguration(
+                        default=npc_src.get("default", ""),
+                        allowed=npc_src.get("allowed") or [],
+                        denied=npc_src.get("denied") or []))
+                try:
+                    self.nomad.upsert_namespace(namespace)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"updated": True})
+            elif parts == ["v1", "node", "pools"] or (
+                    parts[:3] == ["v1", "node", "pool"] and len(parts) == 4):
+                from ..structs import NodePool
+                body = self._body()
+                pool = NodePool(
+                    name=body.get("name", parts[3] if len(parts) == 4
+                                  else ""),
+                    description=body.get("description", ""),
+                    meta=body.get("meta") or {},
+                    scheduler_algorithm=body.get("scheduler_algorithm", ""))
+                try:
+                    self.nomad.upsert_node_pool(pool)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"updated": True})
             elif parts == ["v1", "system", "gc"]:
                 self._send(200, self.nomad.run_gc_once())
             elif parts == ["v1", "operator", "keyring", "rotate"]:
@@ -623,6 +719,22 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if not self._check(acl.is_management()):
                     return
                 self.nomad.state.delete_acl_tokens([parts[3]])
+                self._send(200, {"deleted": True})
+            elif parts[:2] == ["v1", "namespace"] and len(parts) == 3:
+                if not self._check(acl.is_management()):
+                    return
+                try:
+                    self.nomad.delete_namespace(parts[2])
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"deleted": True})
+            elif parts[:3] == ["v1", "node", "pool"] and len(parts) == 4:
+                if not self._check(acl.allow_node_write()):
+                    return
+                try:
+                    self.nomad.delete_node_pool(parts[3])
+                except ValueError as e:
+                    return self._error(400, str(e))
                 self._send(200, {"deleted": True})
             elif parts[:2] == ["v1", "var"] and len(parts) >= 3:
                 path = "/".join(parts[2:])
@@ -720,6 +832,38 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._send(200, token)
         else:
             self._error(404, "unknown acl path")
+
+    def _allowed_search_contexts(self, acl, ns: str):
+        """Token-capability filter over searchable contexts (reference:
+        nomad/search_endpoint.go filteredSearchContexts / sufficientSearchPerms).
+        Management tokens see everything (None = unfiltered)."""
+        if acl.is_management():
+            return None
+        from ..acl import (CAP_LIST_JOBS, CAP_LIST_SCALING_POLICIES,
+                           CAP_READ_JOB)
+        from ..server.search import (
+            CONTEXT_ALLOCS, CONTEXT_DEPLOYMENTS, CONTEXT_EVALS,
+            CONTEXT_JOBS, CONTEXT_NAMESPACES, CONTEXT_NODE_POOLS,
+            CONTEXT_NODES, CONTEXT_PLUGINS, CONTEXT_SCALING_POLICIES,
+            CONTEXT_VARIABLES, CONTEXT_VOLUMES)
+        allowed = []
+        job_cap = (acl.allow_any_namespace(CAP_READ_JOB) if ns == "*"
+                   else acl.allow_namespace_op(ns, CAP_READ_JOB))
+        list_cap = (acl.allow_any_namespace(CAP_LIST_JOBS) if ns == "*"
+                    else acl.allow_namespace_op(ns, CAP_LIST_JOBS))
+        if job_cap or list_cap:
+            allowed += [CONTEXT_JOBS, CONTEXT_EVALS, CONTEXT_ALLOCS,
+                        CONTEXT_DEPLOYMENTS, CONTEXT_VOLUMES,
+                        CONTEXT_PLUGINS]
+            allowed += [CONTEXT_NAMESPACES]
+        if acl.allow_node_read():
+            allowed += [CONTEXT_NODES, CONTEXT_NODE_POOLS]
+        if (acl.allow_any_namespace(CAP_LIST_SCALING_POLICIES) if ns == "*"
+                else acl.allow_namespace_op(ns, CAP_LIST_SCALING_POLICIES)):
+            allowed += [CONTEXT_SCALING_POLICIES]
+        if acl.allow_variable_op(ns if ns != "*" else "default", "", "list"):
+            allowed += [CONTEXT_VARIABLES]
+        return allowed
 
     def _job_from_body(self, body: dict):
         """Accept either JSON jobspec or inline HCL

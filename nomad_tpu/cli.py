@@ -360,6 +360,58 @@ def cmd_acl_token_create(args) -> int:
     return 0
 
 
+def cmd_namespace(args) -> int:
+    api = _client(args)
+    if args.sub2 == "list":
+        print(_fmt_table([[n["name"], n.get("description", "")]
+                          for n in api.namespaces()],
+                         ["Name", "Description"]))
+    elif args.sub2 == "apply":
+        api.upsert_namespace(args.name, description=args.description)
+        print(f"Namespace {args.name!r} applied")
+    elif args.sub2 == "delete":
+        api.delete_namespace(args.name)
+        print(f"Namespace {args.name!r} deleted")
+    return 0
+
+
+def cmd_node_pool(args) -> int:
+    api = _client(args)
+    if args.sub2 == "list":
+        print(_fmt_table(
+            [[p["name"], p.get("scheduler_algorithm") or "(global)",
+              p.get("description", "")]
+             for p in api.node_pools()],
+            ["Name", "SchedulerAlgorithm", "Description"]))
+    elif args.sub2 == "apply":
+        api.upsert_node_pool(args.name, description=args.description,
+                             scheduler_algorithm=args.scheduler_algorithm)
+        print(f"Node pool {args.name!r} applied")
+    elif args.sub2 == "delete":
+        api.delete_node_pool(args.name)
+        print(f"Node pool {args.name!r} deleted")
+    elif args.sub2 == "nodes":
+        print(_fmt_table(
+            [[n["id"][:8], n["name"], n["status"]]
+             for n in api.node_pool_nodes(args.name)],
+            ["ID", "Name", "Status"]))
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Cross-object prefix search, like `nomad status <prefix>`."""
+    reply = _client(args).search(args.prefix)
+    rows = []
+    for ctx, ids in sorted(reply.get("matches", {}).items()):
+        for i in ids:
+            rows.append([ctx, i])
+    if not rows:
+        print(f"No matches for {args.prefix!r}")
+        return 1
+    print(_fmt_table(rows, ["Type", "ID"]))
+    return 0
+
+
 def cmd_version(args) -> int:
     from .client.fingerprint import VERSION
     print(f"nomad-tpu v{VERSION} (tpu-native cluster scheduler)")
@@ -516,6 +568,39 @@ def build_parser() -> argparse.ArgumentParser:
 
     mt = sub.add_parser("metrics")
     mt.set_defaults(fn=cmd_metrics)
+
+    nsp = sub.add_parser("namespace").add_subparsers(dest="sub2",
+                                                     required=True)
+    nsl = nsp.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace)
+    nsa = nsp.add_parser("apply")
+    nsa.add_argument("name")
+    nsa.add_argument("-description", default="")
+    nsa.set_defaults(fn=cmd_namespace)
+    nsd = nsp.add_parser("delete")
+    nsd.add_argument("name")
+    nsd.set_defaults(fn=cmd_namespace)
+
+    npp = sub.add_parser("node-pool").add_subparsers(dest="sub2",
+                                                     required=True)
+    npl = npp.add_parser("list")
+    npl.set_defaults(fn=cmd_node_pool)
+    npa = npp.add_parser("apply")
+    npa.add_argument("name")
+    npa.add_argument("-description", default="")
+    npa.add_argument("-scheduler-algorithm", dest="scheduler_algorithm",
+                     default="")
+    npa.set_defaults(fn=cmd_node_pool)
+    npd = npp.add_parser("delete")
+    npd.add_argument("name")
+    npd.set_defaults(fn=cmd_node_pool)
+    npn = npp.add_parser("nodes")
+    npn.add_argument("name")
+    npn.set_defaults(fn=cmd_node_pool)
+
+    st = sub.add_parser("status", help="prefix search across objects")
+    st.add_argument("prefix")
+    st.set_defaults(fn=cmd_status)
 
     vr = sub.add_parser("version")
     vr.set_defaults(fn=cmd_version)

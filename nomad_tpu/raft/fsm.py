@@ -15,8 +15,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..state.store import StateStore
 from ..structs import (
     ACLPolicy, ACLToken, Allocation, Deployment, DrainStrategy, Evaluation,
-    Job, Node, NodePool, PlanResult, RootKey, ScalingEvent, ScalingPolicy,
-    SchedulerConfiguration, VariableEncrypted,
+    Job, Namespace, Node, NodePool, PlanResult, RootKey, ScalingEvent,
+    ScalingPolicy, SchedulerConfiguration, VariableEncrypted,
 )
 from ..structs import codec
 
@@ -43,6 +43,9 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "upsert_deployment_cas": [Deployment, int],
     "delete_deployment": [str],
     "upsert_node_pool": [NodePool],
+    "delete_node_pool": [str],
+    "upsert_namespace": [Namespace],
+    "delete_namespace": [str],
     "set_scheduler_config": [SchedulerConfiguration],
     "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
     "upsert_acl_policies": [List[ACLPolicy]],
@@ -120,6 +123,8 @@ def dump_state(store: StateStore) -> dict:
             "scaling_events": {
                 codec._encode_key(k): [codec.encode(e) for e in evs]
                 for k, evs in store._scaling_events.items()},
+            "namespaces": [codec.encode(n)
+                           for n in store._namespaces.values()],
         }
 
 
@@ -184,6 +189,11 @@ def restore_state(store: StateStore, blob: dict) -> None:
             ns, jid = k.split("\x1f")
             store._scaling_events[(ns, jid)] = [
                 codec.decode(ScalingEvent, e) for e in evs]
+        restored_ns = [codec.decode(Namespace, n)
+                       for n in blob.get("namespaces", [])]
+        if restored_ns:
+            store._namespaces = {n.name: n for n in restored_ns}
+        store._namespaces.setdefault("default", Namespace(name="default"))
         store._index = blob.get("index", 1)
         ti = blob.get("table_index", {})
         for t in store._table_index:

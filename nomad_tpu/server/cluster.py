@@ -99,6 +99,15 @@ class RaftBackedStateStore:
     def upsert_node_pool(self, pool):
         return self._propose("upsert_node_pool", pool)
 
+    def delete_node_pool(self, name):
+        return self._propose("delete_node_pool", name)
+
+    def upsert_namespace(self, namespace):
+        return self._propose("upsert_namespace", namespace)
+
+    def delete_namespace(self, name):
+        return self._propose("delete_namespace", name)
+
     def set_scheduler_config(self, cfg):
         return self._propose("set_scheduler_config", cfg)
 

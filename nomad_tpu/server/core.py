@@ -252,11 +252,30 @@ class Server:
         self.publish_event("JobRegistered", {"job_id": job.id})
         return ev
 
-    @staticmethod
-    def _validate_job(job: Job) -> None:
+    def _validate_job(self, job: Job) -> None:
         """Admission validation before anything reaches replicated state
         (reference: job_endpoint.go admission hooks / Job.Validate). Keeps
         malformed user input out of the FSM apply path."""
+        ns = self.state.namespace_by_name(job.namespace)
+        if ns is None:
+            raise ValueError(f"namespace {job.namespace!r} does not exist")
+        # node-pool admission (reference: job_endpoint_hook_node_pool.go):
+        # the pool must exist and the namespace must allow it; an empty
+        # pool falls back to the namespace default.
+        npc = ns.node_pool_configuration
+        if (not job.node_pool or job.node_pool == "default") and npc.default:
+            job.node_pool = npc.default
+        if job.node_pool == "all":
+            # "all" is the built-in every-node pool for OPERATOR queries;
+            # jobs targeting it would bypass pool isolation (reference:
+            # structs/node_pool.go NodePoolAll invalid on jobs)
+            raise ValueError('jobs may not target the built-in "all" pool')
+        if self.state.node_pool_by_name(job.node_pool) is None:
+            raise ValueError(f"node pool {job.node_pool!r} does not exist")
+        if not npc.allows(job.node_pool):
+            raise ValueError(
+                f"namespace {job.namespace!r} does not allow node pool "
+                f"{job.node_pool!r}")
         for tg in job.task_groups:
             sc = tg.scaling
             if sc is None:
@@ -306,6 +325,9 @@ class Server:
         from ..scheduler.harness import Harness
         from ..state import StateStore
 
+        # same admission as register (including the namespace default-pool
+        # rewrite) so the dry-run matches what `job run` would do
+        self._validate_job(job)
         real = getattr(self.state, "_store", self.state)
         temp = StateStore()
         restore_state(temp, dump_state(real))
@@ -531,6 +553,14 @@ class Server:
     # Node API (reference: nomad/node_endpoint.go)
     def register_node(self, node: Node) -> None:
         """(reference: node_endpoint.go:99 Register)"""
+        # registering into an unknown pool creates it (reference:
+        # Node.Register -> NodePool upsert on missing pool)
+        if node.node_pool and \
+                self.state.node_pool_by_name(node.node_pool) is None:
+            from ..structs import NodePool
+            self.state.upsert_node_pool(NodePool(
+                name=node.node_pool,
+                description="created by node registration"))
         node.status = NODE_STATUS_READY
         self.state.upsert_node(node)
         self._reset_heartbeat(node.id)
@@ -694,6 +724,67 @@ class Server:
                 status = JOB_STATUS_DEAD
         if status != job.status:
             self.state.update_job_status(namespace, job_id, status)
+
+    # ------------------------------------------------------------------
+    # Namespaces + node pools (reference: nomad/namespace_endpoint.go,
+    # nomad/node_pool_endpoint.go)
+    def upsert_namespace(self, namespace) -> None:
+        if not namespace.name or "/" in namespace.name:
+            raise ValueError(f"invalid namespace name {namespace.name!r}")
+        self.state.upsert_namespace(namespace)
+        self.publish_event("NamespaceUpserted", {"name": namespace.name})
+
+    def delete_namespace(self, name: str) -> None:
+        if name == "default":
+            raise ValueError("default namespace cannot be deleted")
+        if self.state.namespace_by_name(name) is None:
+            raise ValueError(f"namespace {name!r} not found")
+        in_use = [j.id for j in self.state.jobs() if j.namespace == name]
+        if in_use:
+            raise ValueError(
+                f"namespace {name!r} has {len(in_use)} non-purged jobs")
+        if self.state.variables(name):
+            raise ValueError(f"namespace {name!r} has variables")
+        self.state.delete_namespace(name)
+        self.publish_event("NamespaceDeleted", {"name": name})
+
+    def upsert_node_pool(self, pool) -> None:
+        if not pool.name or pool.name == "all":
+            raise ValueError(f"invalid node pool name {pool.name!r}")
+        self.state.upsert_node_pool(pool)
+        self.publish_event("NodePoolUpserted", {"name": pool.name})
+
+    def delete_node_pool(self, name: str) -> None:
+        if name in ("default", "all"):
+            raise ValueError(f"built-in node pool {name!r} is undeletable")
+        if self.state.node_pool_by_name(name) is None:
+            raise ValueError(f"node pool {name!r} not found")
+        nodes = [n.id for n in self.state.nodes() if n.node_pool == name]
+        if nodes:
+            raise ValueError(f"node pool {name!r} has {len(nodes)} nodes")
+        jobs = [j.id for j in self.state.jobs() if j.node_pool == name]
+        if jobs:
+            raise ValueError(f"node pool {name!r} used by {len(jobs)} jobs")
+        self.state.delete_node_pool(name)
+        self.publish_event("NodePoolDeleted", {"name": name})
+
+    # ------------------------------------------------------------------
+    # Search (reference: nomad/search_endpoint.go)
+    def search(self, prefix: str, context: str = "all",
+               namespace: Optional[str] = None,
+               allowed_contexts: Optional[List[str]] = None,
+               ns_allowed=None) -> dict:
+        from .search import Searcher
+        return Searcher(self.state, ns_allowed).prefix_search(
+            prefix, context, namespace, allowed_contexts)
+
+    def fuzzy_search(self, text: str, context: str = "all",
+                     namespace: Optional[str] = None,
+                     allowed_contexts: Optional[List[str]] = None,
+                     ns_allowed=None) -> dict:
+        from .search import Searcher
+        return Searcher(self.state, ns_allowed).fuzzy_search(
+            text, context, namespace, allowed_contexts)
 
     # ------------------------------------------------------------------
     # Event stream (reference: nomad/stream/event_broker.go)

@@ -16,7 +16,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .alloc_table import AllocTable
 from ..structs import (
     ACL_TOKEN_TYPE_MANAGEMENT, ACLPolicy, ACLToken, Allocation, Deployment,
-    Evaluation, Job, Node, NodePool, Plan, PlanResult, RootKey,
+    Evaluation, Job, Namespace, Node, NodePool, Plan, PlanResult, RootKey,
     ScalingEvent, ScalingPolicy, SchedulerConfiguration, VariableEncrypted,
     ALLOC_DESIRED_STOP, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_COMPLETE,
@@ -26,7 +26,8 @@ from ..structs import (
 
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
           "scheduler_config", "job_versions", "acl_policies", "acl_tokens",
-          "root_keys", "variables", "scaling_policies", "scaling_events")
+          "root_keys", "variables", "scaling_policies", "scaling_events",
+          "namespaces")
 
 
 class StateSnapshot:
@@ -165,6 +166,10 @@ class StateStore:
         # regions; policies derived from jobs on UpsertJob)
         self._scaling_policies: Dict[str, ScalingPolicy] = {}
         self._scaling_events: Dict[Tuple[str, str], List[ScalingEvent]] = {}
+        # namespaces; "default" always exists (reference: structs/namespace)
+        self._namespaces: Dict[str, "Namespace"] = {
+            "default": Namespace(name="default",
+                                 description="Default shared namespace")}
         # secondary indexes
         self._allocs_by_node: Dict[str, List[str]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
@@ -579,8 +584,50 @@ class StateStore:
     # -- node pools / config -------------------------------------------------
     def upsert_node_pool(self, pool: NodePool) -> int:
         with self._lock:
+            existing = self._node_pools.get(pool.name)
+            pool.create_index = (existing.create_index if existing
+                                 else self._index + 1)
+            pool.modify_index = self._index + 1
             self._node_pools[pool.name] = pool
             return self._bump("node_pools")
+
+    def delete_node_pool(self, name: str) -> int:
+        """Built-in pools are undeletable; the caller enforces emptiness
+        (reference: node_pool_endpoint.go DeleteNodePools)."""
+        with self._lock:
+            if name in ("default", "all"):
+                return self._index
+            self._node_pools.pop(name, None)
+            return self._bump("node_pools")
+
+    def node_pools(self) -> List[NodePool]:
+        with self._lock:
+            return sorted(self._node_pools.values(), key=lambda p: p.name)
+
+    # -- namespaces (reference: state_store.go Namespace region) -----------
+    def upsert_namespace(self, namespace: "Namespace") -> int:
+        with self._lock:
+            existing = self._namespaces.get(namespace.name)
+            namespace.create_index = (existing.create_index if existing
+                                      else self._index + 1)
+            namespace.modify_index = self._index + 1
+            self._namespaces[namespace.name] = namespace
+            return self._bump("namespaces")
+
+    def delete_namespace(self, name: str) -> int:
+        with self._lock:
+            if name == "default":
+                return self._index
+            self._namespaces.pop(name, None)
+            return self._bump("namespaces")
+
+    def namespace_by_name(self, name: str) -> Optional["Namespace"]:
+        with self._lock:
+            return self._namespaces.get(name)
+
+    def namespaces(self) -> List["Namespace"]:
+        with self._lock:
+            return sorted(self._namespaces.values(), key=lambda n: n.name)
 
     # -- keyring + variables (reference: state_store.go UpsertRootKeyMeta,
     #    VarSet/VarGet/VarDelete with check-and-set semantics) -------------

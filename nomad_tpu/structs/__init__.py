@@ -54,6 +54,7 @@ from .funcs import (  # noqa: F401
     score_fit_spread, BINPACK_MAX_FIT_SCORE,
 )
 from .config import (  # noqa: F401
+    Namespace, NamespaceNodePoolConfiguration,
     PreemptionConfig, SchedulerConfiguration,
     SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU_BINPACK,
     SCHED_ALG_TPU_SPREAD,

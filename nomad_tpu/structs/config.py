@@ -52,3 +52,36 @@ class SchedulerConfiguration:
     def uses_tpu(self) -> bool:
         return self.scheduler_algorithm in (SCHED_ALG_TPU_BINPACK,
                                             SCHED_ALG_TPU_SPREAD)
+
+
+@dataclass
+class NamespaceNodePoolConfiguration:
+    """Which node pools a namespace's jobs may target
+    (reference: structs/namespace.go NamespaceNodePoolConfiguration)."""
+
+    default: str = ""                 # "" = no override
+    allowed: list = field(default_factory=list)   # empty = all allowed
+    denied: list = field(default_factory=list)
+
+    def allows(self, pool: str) -> bool:
+        if pool in self.denied:
+            return False
+        if self.allowed and pool not in self.allowed:
+            return False
+        return True
+
+
+@dataclass
+class Namespace:
+    """Multi-tenancy boundary: every job/alloc/eval/variable is namespaced
+    (reference: nomad/structs/namespace... structs.Namespace; CRUD at
+    nomad/namespace_endpoint.go)."""
+
+    name: str = "default"
+    description: str = ""
+    quota: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    node_pool_configuration: NamespaceNodePoolConfiguration = field(
+        default_factory=NamespaceNodePoolConfiguration)
+    create_index: int = 0
+    modify_index: int = 0

@@ -307,6 +307,8 @@ def test_stability_unknown_version_rejected(server):
 
 
 def test_dispatch_idempotency_is_namespace_scoped(server):
+    from nomad_tpu.structs import Namespace
+    server.upsert_namespace(Namespace(name="other"))
     for ns in ("default", "other"):
         job = mock.job(id="etl", type="batch")
         job.namespace = ns

@@ -1,0 +1,234 @@
+"""Namespaces + node pools: CRUD, admission enforcement, scheduling
+isolation (reference analogs: nomad/namespace_endpoint.go,
+nomad/node_pool_endpoint.go, job_endpoint_hook_node_pool.go)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    Namespace, NamespaceNodePoolConfiguration, NodePool,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1, heartbeat_ttl=5.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+# -- namespaces --------------------------------------------------------------
+
+def test_default_namespace_exists(server):
+    names = [n.name for n in server.state.namespaces()]
+    assert "default" in names
+
+
+def test_namespace_crud(server):
+    server.upsert_namespace(Namespace(name="team-a", description="a team"))
+    ns = server.state.namespace_by_name("team-a")
+    assert ns is not None and ns.description == "a team"
+    assert ns.create_index > 0
+    server.delete_namespace("team-a")
+    assert server.state.namespace_by_name("team-a") is None
+
+
+def test_default_namespace_undeletable(server):
+    with pytest.raises(ValueError):
+        server.delete_namespace("default")
+
+
+def test_namespace_with_jobs_undeletable(server):
+    server.upsert_namespace(Namespace(name="busy"))
+    job = mock.job(id="j1")
+    job.namespace = "busy"
+    server.register_job(job)
+    with pytest.raises(ValueError):
+        server.delete_namespace("busy")
+
+
+def test_job_register_requires_existing_namespace(server):
+    job = mock.job(id="ghost")
+    job.namespace = "nonexistent"
+    with pytest.raises(ValueError):
+        server.register_job(job)
+
+
+def test_namespace_node_pool_restrictions(server):
+    server.upsert_node_pool(NodePool(name="gpu"))
+    server.upsert_node_pool(NodePool(name="cheap"))
+    server.upsert_namespace(Namespace(
+        name="restricted",
+        node_pool_configuration=NamespaceNodePoolConfiguration(
+            default="cheap", denied=["gpu"])))
+    # default pool substituted from namespace config
+    job = mock.job(id="j-default")
+    job.namespace = "restricted"
+    job.node_pool = "default"
+    server.register_job(job)
+    assert server.state.job_by_id("restricted", "j-default").node_pool == \
+        "cheap"
+    # denied pool rejected
+    job2 = mock.job(id="j-gpu")
+    job2.namespace = "restricted"
+    job2.node_pool = "gpu"
+    with pytest.raises(ValueError):
+        server.register_job(job2)
+
+
+def test_namespace_allowed_list(server):
+    server.upsert_node_pool(NodePool(name="poolx"))
+    server.upsert_namespace(Namespace(
+        name="locked",
+        node_pool_configuration=NamespaceNodePoolConfiguration(
+            allowed=["poolx"])))
+    job = mock.job(id="j1")
+    job.namespace = "locked"
+    job.node_pool = "poolx"
+    server.register_job(job)         # allowed
+    job2 = mock.job(id="j2")
+    job2.namespace = "locked"
+    job2.node_pool = "default"
+    with pytest.raises(ValueError):
+        server.register_job(job2)    # not in allowed list
+
+
+# -- node pools --------------------------------------------------------------
+
+def test_node_pool_crud(server):
+    server.upsert_node_pool(NodePool(name="batch-pool",
+                                     scheduler_algorithm="spread"))
+    pool = server.state.node_pool_by_name("batch-pool")
+    assert pool.scheduler_algorithm == "spread"
+    assert [p.name for p in server.state.node_pools()] == \
+        ["all", "batch-pool", "default"]
+    server.delete_node_pool("batch-pool")
+    assert server.state.node_pool_by_name("batch-pool") is None
+
+
+def test_builtin_pools_undeletable(server):
+    for name in ("default", "all"):
+        with pytest.raises(ValueError):
+            server.delete_node_pool(name)
+
+
+def test_node_pool_in_use_undeletable(server):
+    server.upsert_node_pool(NodePool(name="used"))
+    node = mock.node()
+    node.node_pool = "used"
+    server.register_node(node)
+    with pytest.raises(ValueError):
+        server.delete_node_pool("used")
+
+
+def test_node_register_autocreates_pool(server):
+    node = mock.node()
+    node.node_pool = "edge-west"
+    server.register_node(node)
+    assert server.state.node_pool_by_name("edge-west") is not None
+
+
+def test_job_register_requires_existing_pool(server):
+    job = mock.job(id="jp")
+    job.node_pool = "missing-pool"
+    with pytest.raises(ValueError):
+        server.register_job(job)
+
+
+def test_pool_isolates_scheduling(server):
+    """Jobs in a pool only place on that pool's nodes."""
+    from nomad_tpu.client import SimClient
+    server.upsert_node_pool(NodePool(name="isolated"))
+    in_pool, out_pool = mock.node(), mock.node()
+    in_pool.node_pool = "isolated"
+    clients = []
+    for n in (in_pool, out_pool):
+        c = SimClient(server, n)
+        c.start()
+        clients.append(c)
+    try:
+        job = mock.job(id="pooled")
+        job.task_groups[0].count = 2
+        job.node_pool = "isolated"
+        server.register_job(job)
+        deadline = time.time() + 8
+        placed = []
+        while time.time() < deadline:
+            placed = [a for a in server.state.allocs_by_job(
+                "default", "pooled") if not a.terminal_status()]
+            if len(placed) == 2:
+                break
+            time.sleep(0.05)
+        assert placed, "nothing placed"
+        assert all(a.node_id == in_pool.id for a in placed)
+    finally:
+        for c in clients:
+            c.stop()
+
+
+def test_namespace_state_survives_snapshot(server):
+    from nomad_tpu.raft.fsm import dump_state, restore_state
+    from nomad_tpu.state import StateStore
+    import json
+
+    server.upsert_namespace(Namespace(name="persisted"))
+    server.upsert_node_pool(NodePool(name="persisted-pool"))
+    blob = json.loads(json.dumps(dump_state(server.state)))
+    fresh = StateStore()
+    restore_state(fresh, blob)
+    assert fresh.namespace_by_name("persisted") is not None
+    assert fresh.node_pool_by_name("persisted-pool") is not None
+    assert fresh.namespace_by_name("default") is not None
+
+
+def test_http_namespace_and_pool_endpoints(server):
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.api.http import HttpServer
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        api.upsert_namespace("web-team", description="frontend")
+        assert any(n["name"] == "web-team" for n in api.namespaces())
+        assert api.get_namespace("web-team")["description"] == "frontend"
+        api.upsert_node_pool("fast", scheduler_algorithm="binpack")
+        assert any(p["name"] == "fast" for p in api.node_pools())
+        assert api.node_pool("fast")["name"] == "fast"
+        assert api.node_pool_nodes("fast") == []
+        api.delete_node_pool("fast")
+        api.delete_namespace("web-team")
+        with pytest.raises(ApiError):
+            api.get_namespace("web-team")
+        with pytest.raises(ApiError):
+            api.delete_namespace("default")
+    finally:
+        http.shutdown()
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_jobs_cannot_target_all_pool(server):
+    job = mock.job(id="greedy")
+    job.node_pool = "all"
+    with pytest.raises(ValueError):
+        server.register_job(job)
+
+
+def test_plan_applies_same_admission_as_register(server):
+    job = mock.job(id="planned")
+    job.namespace = "nonexistent"
+    with pytest.raises(ValueError):
+        server.plan_job(job)
+    # default-pool rewrite also applies to plan
+    server.upsert_node_pool(NodePool(name="cheap"))
+    server.upsert_namespace(Namespace(
+        name="rewritten",
+        node_pool_configuration=NamespaceNodePoolConfiguration(
+            default="cheap")))
+    job2 = mock.job(id="planned2")
+    job2.namespace = "rewritten"
+    server.plan_job(job2)
+    assert job2.node_pool == "cheap"

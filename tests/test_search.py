@@ -1,0 +1,163 @@
+"""Search subsystem: prefix + fuzzy matching across contexts
+(reference analog: nomad/search_endpoint.go PrefixSearch/FuzzySearch)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.search import Searcher, fuzzy_index
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1, heartbeat_ttl=5.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def seed(server):
+    for i in range(3):
+        job = mock.job(id=f"web-app-{i}")
+        job.name = job.id
+        server.register_job(job)
+    db = mock.job(id="database")
+    db.name = db.id
+    server.register_job(db)
+    for i in range(2):
+        n = mock.node()
+        n.id = f"node-{i:04d}-aaaa-bbbb-cccc-dddddddddddd"[:36]
+        n.name = f"worker-{i}"
+        server.state.upsert_node(n)
+
+
+def test_prefix_search_jobs(server):
+    seed(server)
+    reply = server.search("web-", context="jobs")
+    assert reply["matches"]["jobs"] == ["web-app-0", "web-app-1",
+                                       "web-app-2"]
+    assert reply["truncations"] == {}
+
+
+def test_prefix_search_all_contexts(server):
+    seed(server)
+    reply = server.search("web-app-1")
+    assert reply["matches"]["jobs"] == ["web-app-1"]
+    # empty contexts are omitted in all-context mode
+    assert "nodes" not in reply["matches"]
+
+
+def test_prefix_search_truncation(server):
+    for i in range(25):
+        server.register_job(mock.job(id=f"bulk-{i:03d}"))
+    reply = server.search("bulk-", context="jobs")
+    assert len(reply["matches"]["jobs"]) == 20
+    assert reply["truncations"]["jobs"] is True
+
+
+def test_prefix_search_eval_and_alloc_ids(server):
+    seed(server)
+    evals = server.state.evals()
+    assert evals
+    prefix = evals[0].id[:8]
+    reply = server.search(prefix, context="evals")
+    assert evals[0].id in reply["matches"]["evals"]
+
+
+def test_fuzzy_index():
+    assert fuzzy_index("example-cache", "cach") == 8
+    assert fuzzy_index("Example", "exa") == 0
+    assert fuzzy_index("abc", "zzz") == -1
+
+
+def test_fuzzy_search_job_names_and_scopes(server):
+    seed(server)
+    reply = server.fuzzy_search("app", context="jobs")
+    ids = [m["id"] for m in reply["matches"]["jobs"]]
+    assert ids == ["web-app-0", "web-app-1", "web-app-2"]
+    assert reply["matches"]["jobs"][0]["scope"] == ["default", "web-app-0"]
+
+
+def test_fuzzy_search_digs_into_groups_and_tasks(server):
+    job = mock.job(id="svc")
+    job.task_groups[0].name = "cache-layer"
+    job.task_groups[0].tasks[0].name = "redis-task"
+    server.register_job(job)
+    reply = server.fuzzy_search("cache")
+    assert reply["matches"]["groups"][0]["id"] == "cache-layer"
+    assert reply["matches"]["groups"][0]["scope"] == ["default", "svc"]
+    reply = server.fuzzy_search("redis")
+    assert reply["matches"]["tasks"][0]["scope"] == \
+        ["default", "svc", "cache-layer"]
+
+
+def test_fuzzy_search_nodes_by_name(server):
+    seed(server)
+    reply = server.fuzzy_search("worker", context="nodes")
+    ids = [m["id"] for m in reply["matches"]["nodes"]]
+    assert sorted(ids) == ["worker-0", "worker-1"]
+    # scope carries the node id for navigation
+    assert reply["matches"]["nodes"][0]["scope"]
+
+
+def test_fuzzy_ordering_earliest_then_shortest(server):
+    for name in ("xx-match", "match", "a-match-long-name"):
+        j = mock.job(id=name)
+        j.name = name
+        server.register_job(j)
+    reply = server.fuzzy_search("match", context="jobs")
+    ids = [m["id"] for m in reply["matches"]["jobs"]]
+    # "match" matches at 0; others at 2/3 -> earliest first, then shortest
+    assert ids[0] == "match"
+
+
+def test_allowed_contexts_filter(server):
+    seed(server)
+    reply = server.search("web-", context="all",
+                          allowed_contexts=["nodes"])
+    assert "jobs" not in reply["matches"]
+
+
+def test_search_namespaced_objects(server):
+    job = mock.job(id="nsjob")
+    job.namespace = "team-a"
+    server.state.upsert_job(job)
+    assert server.search("nsjob", context="jobs",
+                         namespace="team-a")["matches"]["jobs"] == ["nsjob"]
+    assert server.search("nsjob", context="jobs",
+                         namespace="default")["matches"]["jobs"] == []
+    assert server.search("nsjob", context="jobs",
+                         namespace="*")["matches"]["jobs"] == ["nsjob"]
+
+
+def test_http_search_endpoints(server):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    seed(server)
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        reply = api.search("web-")
+        assert reply["matches"]["jobs"] == ["web-app-0", "web-app-1",
+                                           "web-app-2"]
+        reply = api.fuzzy_search("worker")
+        assert [m["id"] for m in reply["matches"]["nodes"]] == \
+            ["worker-0", "worker-1"]
+    finally:
+        http.shutdown()
+
+
+def test_search_respects_ns_allowed_filter(server):
+    """Per-object ACL filter hides other-namespace objects even with
+    namespace='*' (regression: cross-namespace id enumeration)."""
+    from nomad_tpu.structs import Namespace
+    server.upsert_namespace(Namespace(name="secret"))
+    job = mock.job(id="classified")
+    job.namespace = "secret"
+    server.state.upsert_job(job)
+    visible = server.search("classified", context="jobs", namespace="*",
+                            ns_allowed=lambda ns: ns == "default")
+    assert visible["matches"]["jobs"] == []
+    names = server.search("", context="namespaces", namespace="*",
+                          ns_allowed=lambda ns: ns == "default")
+    assert names["matches"]["namespaces"] == ["default"]
