@@ -184,6 +184,29 @@ class ApiClient:
     def delete_node_pool(self, name: str) -> dict:
         return self.delete(f"/v1/node/pool/{name}")
 
+    # -- CSI volumes + plugins (reference: api/csi.go) -----------------
+    def csi_volumes(self) -> List[dict]:
+        return self.get("/v1/volumes")
+
+    def csi_volume(self, vol_id: str) -> dict:
+        return self.get(f"/v1/volume/csi/{vol_id}")
+
+    def register_csi_volume(self, vol_id: str, plugin_id: str,
+                            **fields) -> dict:
+        return self.post(f"/v1/volume/csi/{vol_id}",
+                         {"plugin_id": plugin_id, **fields})
+
+    def deregister_csi_volume(self, vol_id: str,
+                              force: bool = False) -> dict:
+        return self.delete(f"/v1/volume/csi/{vol_id}",
+                           force="true" if force else "false")
+
+    def csi_plugins(self) -> List[dict]:
+        return self.get("/v1/plugins")
+
+    def csi_plugin(self, plugin_id: str) -> dict:
+        return self.get(f"/v1/plugin/csi/{plugin_id}")
+
     # -- search (reference: api/search.go) -----------------------------
     def search(self, prefix: str, context: str = "all") -> dict:
         return self.post("/v1/search",

@@ -348,6 +348,34 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, [d for d in state.deployments()
                                  if acl.allow_namespace_op(
                                      d.namespace, CAP_READ_JOB)], index)
+            elif parts == ["v1", "volumes"]:
+                from ..acl import CAP_CSI_LIST_VOLUME
+                allowed = (acl.allow_any_namespace(CAP_CSI_LIST_VOLUME)
+                           if ns == "*" else acl.allow_namespace_op(
+                               ns, CAP_CSI_LIST_VOLUME))
+                if not self._check(allowed):
+                    return
+                vols = state.csi_volumes(None if ns == "*" else ns)
+                self._send(200, [self._volume_stub(v) for v in vols
+                                 if acl.allow_namespace_op(
+                                     v.namespace, CAP_CSI_LIST_VOLUME)],
+                           index)
+            elif parts[:3] == ["v1", "volume", "csi"] and len(parts) == 4:
+                from ..acl import CAP_CSI_READ_VOLUME
+                if not self._check(acl.allow_namespace_op(
+                        ns, CAP_CSI_READ_VOLUME)):
+                    return
+                v = state.csi_volume_by_id(ns, parts[3])
+                if v is None:
+                    return self._error(404, "volume not found")
+                self._send(200, v, index)
+            elif parts == ["v1", "plugins"]:
+                self._send(200, state.csi_plugins(), index)
+            elif parts[:3] == ["v1", "plugin", "csi"] and len(parts) == 4:
+                p = state.csi_plugin_by_id(parts[3])
+                if p is None:
+                    return self._error(404, "plugin not found")
+                self._send(200, p, index)
             elif parts == ["v1", "namespaces"]:
                 self._send(200, [n for n in state.namespaces()
                                  if acl.allow_namespace(n.name)], index)
@@ -645,6 +673,33 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except ValueError as e:
                     return self._error(400, str(e))
                 self._send(200, {"updated": True})
+            elif parts[:3] == ["v1", "volume", "csi"] and len(parts) == 4:
+                from ..acl import CAP_CSI_WRITE_VOLUME
+                if not self._check(acl.allow_namespace_op(
+                        ns, CAP_CSI_WRITE_VOLUME)):
+                    return
+                from ..structs import CSITopology, CSIVolume
+                body = self._body()
+                try:
+                    vol = CSIVolume(
+                        id=parts[3], namespace=ns,
+                        name=body.get("name", parts[3]),
+                        external_id=body.get("external_id", ""),
+                        plugin_id=body.get("plugin_id", ""),
+                        access_mode=body.get("access_mode",
+                                             "single-node-writer"),
+                        attachment_mode=body.get("attachment_mode",
+                                                 "file-system"),
+                        capacity_min_mb=int(body.get("capacity_min_mb", 0)),
+                        capacity_max_mb=int(body.get("capacity_max_mb", 0)),
+                        parameters=body.get("parameters") or {},
+                        topologies=[
+                            CSITopology(segments=t.get("segments", {}))
+                            for t in body.get("topologies", [])])
+                    self.nomad.register_csi_volume(vol)
+                except (TypeError, ValueError) as e:
+                    return self._error(400, str(e))
+                self._send(200, {"registered": True})
             elif parts == ["v1", "system", "gc"]:
                 self._send(200, self.nomad.run_gc_once())
             elif parts == ["v1", "operator", "keyring", "rotate"]:
@@ -720,6 +775,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return
                 self.nomad.state.delete_acl_tokens([parts[3]])
                 self._send(200, {"deleted": True})
+            elif parts[:3] == ["v1", "volume", "csi"] and len(parts) == 4:
+                from ..acl import CAP_CSI_WRITE_VOLUME
+                if not self._check(acl.allow_namespace_op(
+                        ns, CAP_CSI_WRITE_VOLUME)):
+                    return
+                force = q.get("force", ["false"])[0] == "true"
+                try:
+                    self.nomad.deregister_csi_volume(ns, parts[3], force)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"deregistered": True})
             elif parts[:2] == ["v1", "namespace"] and len(parts) == 3:
                 if not self._check(acl.is_management()):
                     return
@@ -879,6 +945,13 @@ class ApiHandler(BaseHTTPRequestHandler):
         return {"id": j.id, "name": j.name, "namespace": j.namespace,
                 "type": j.type, "priority": j.priority, "status": j.status,
                 "version": j.version, "stop": j.stop}
+
+    def _volume_stub(self, v) -> dict:
+        return {"id": v.id, "namespace": v.namespace, "name": v.name,
+                "plugin_id": v.plugin_id, "access_mode": v.access_mode,
+                "schedulable": v.schedulable,
+                "read_claims": len(v.read_claims),
+                "write_claims": len(v.write_claims)}
 
     def _node_stub(self, n) -> dict:
         return {"id": n.id, "name": n.name, "datacenter": n.datacenter,

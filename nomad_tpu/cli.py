@@ -398,6 +398,43 @@ def cmd_node_pool(args) -> int:
     return 0
 
 
+def cmd_volume(args) -> int:
+    api = _client(args)
+    if args.sub2 == "status":
+        if getattr(args, "id", ""):
+            v = api.csi_volume(args.id)
+            print(json.dumps(v, indent=2, default=str))
+        else:
+            print(_fmt_table(
+                [[v["id"], v["plugin_id"], v["access_mode"],
+                  str(v["schedulable"]),
+                  f'{v["read_claims"]}r/{v["write_claims"]}w']
+                 for v in api.csi_volumes()],
+                ["ID", "Plugin", "AccessMode", "Schedulable", "Claims"]))
+    elif args.sub2 == "register":
+        with open(args.file) as f:
+            body = json.load(f)
+        api.register_csi_volume(body["id"], body.get("plugin_id", ""),
+                                **{k: v for k, v in body.items()
+                                   if k not in ("id", "plugin_id")})
+        print(f"Volume {body['id']!r} registered")
+    elif args.sub2 == "deregister":
+        api.deregister_csi_volume(args.id, force=args.force)
+        print(f"Volume {args.id!r} deregistered")
+    return 0
+
+
+def cmd_plugin(args) -> int:
+    api = _client(args)
+    if getattr(args, "id", ""):
+        print(json.dumps(api.csi_plugin(args.id), indent=2, default=str))
+    else:
+        print(_fmt_table(
+            [[p["id"], str(p["nodes_healthy"])] for p in api.csi_plugins()],
+            ["ID", "NodesHealthy"]))
+    return 0
+
+
 def cmd_status(args) -> int:
     """Cross-object prefix search, like `nomad status <prefix>`."""
     reply = _client(args).search(args.prefix)
@@ -597,6 +634,25 @@ def build_parser() -> argparse.ArgumentParser:
     npn = npp.add_parser("nodes")
     npn.add_argument("name")
     npn.set_defaults(fn=cmd_node_pool)
+
+    vol = sub.add_parser("volume").add_subparsers(dest="sub2",
+                                                  required=True)
+    vs = vol.add_parser("status")
+    vs.add_argument("id", nargs="?", default="")
+    vs.set_defaults(fn=cmd_volume)
+    vreg = vol.add_parser("register")
+    vreg.add_argument("file")
+    vreg.set_defaults(fn=cmd_volume)
+    vdereg = vol.add_parser("deregister")
+    vdereg.add_argument("id")
+    vdereg.add_argument("-force", action="store_true")
+    vdereg.set_defaults(fn=cmd_volume)
+
+    plg = sub.add_parser("plugin").add_subparsers(dest="sub2",
+                                                  required=True)
+    ps = plg.add_parser("status")
+    ps.add_argument("id", nargs="?", default="")
+    ps.set_defaults(fn=cmd_plugin)
 
     st = sub.add_parser("status", help="prefix search across objects")
     st.add_argument("prefix")

@@ -14,9 +14,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..state.store import StateStore
 from ..structs import (
-    ACLPolicy, ACLToken, Allocation, Deployment, DrainStrategy, Evaluation,
-    Job, Namespace, Node, NodePool, PlanResult, RootKey, ScalingEvent,
-    ScalingPolicy, SchedulerConfiguration, VariableEncrypted,
+    ACLPolicy, ACLToken, Allocation, CSIVolume, Deployment, DrainStrategy,
+    Evaluation, Job, Namespace, Node, NodePool, PlanResult, RootKey,
+    ScalingEvent, ScalingPolicy, SchedulerConfiguration, VariableEncrypted,
 )
 from ..structs import codec
 
@@ -46,6 +46,9 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "delete_node_pool": [str],
     "upsert_namespace": [Namespace],
     "delete_namespace": [str],
+    "upsert_csi_volume": [CSIVolume],
+    "delete_csi_volume": [str, str],
+    "csi_volume_release": [str, str, str],
     "set_scheduler_config": [SchedulerConfiguration],
     "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
     "upsert_acl_policies": [List[ACLPolicy]],
@@ -125,6 +128,8 @@ def dump_state(store: StateStore) -> dict:
                 for k, evs in store._scaling_events.items()},
             "namespaces": [codec.encode(n)
                            for n in store._namespaces.values()],
+            "csi_volumes": [codec.encode(v)
+                            for v in store._csi_volumes.values()],
         }
 
 
@@ -194,6 +199,11 @@ def restore_state(store: StateStore, blob: dict) -> None:
         if restored_ns:
             store._namespaces = {n.name: n for n in restored_ns}
         store._namespaces.setdefault("default", Namespace(name="default"))
+        store._csi_volumes = {
+            (v.namespace, v.id): v for v in
+            (codec.decode(CSIVolume, raw)
+             for raw in blob.get("csi_volumes", []))}
+        store._recompute_csi_plugins_locked()
         store._index = blob.get("index", 1)
         ti = blob.get("table_index", {})
         for t in store._table_index:

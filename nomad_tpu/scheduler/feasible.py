@@ -31,6 +31,8 @@ from .util import resolve_target, shuffle_nodes
 FILTER_CONSTRAINT_HOST_VOLUMES = "missing compatible host volumes"
 FILTER_CONSTRAINT_DRIVERS = "missing drivers"
 FILTER_CONSTRAINT_DEVICES = "missing devices"
+FILTER_CONSTRAINT_CSI_VOLUMES = "CSI volume has exhausted its available writer claims"
+FILTER_CONSTRAINT_CSI_PLUGINS = "CSI plugin is missing or unhealthy"
 
 
 class FeasibleIterator:
@@ -341,12 +343,7 @@ class HostVolumeChecker:
         for name, req in (volumes or {}).items():
             if req.type != "host":
                 continue
-            source = req.source
-            if req.per_alloc and alloc_name:
-                # volume per alloc index: source[i]
-                idx = alloc_name[alloc_name.rfind("["):] if "[" in alloc_name else ""
-                source = f"{source}{idx}"
-            self.volumes[name] = (source, req.read_only)
+            self.volumes[name] = (req.source_for(alloc_name), req.read_only)
 
     def feasible(self, node: Node) -> bool:
         for name, (source, read_only) in self.volumes.items():
@@ -358,6 +355,57 @@ class HostVolumeChecker:
             if cfg.read_only and not read_only:
                 self.ctx.metrics.filter_node(
                     node.computed_class, FILTER_CONSTRAINT_HOST_VOLUMES)
+                return False
+        return True
+
+
+class CSIVolumeChecker:
+    """Volume exists + schedulable + claimable + node runs a healthy
+    instance of its plugin (reference: feasible.go:230 CSIVolumeChecker)."""
+
+    def __init__(self, ctx: EvalContext):
+        self.ctx = ctx
+        self.namespace = "default"
+        self.volumes: Dict[str, object] = {}
+
+    def set_namespace(self, namespace: str) -> None:
+        self.namespace = namespace
+
+    def set_volumes(self, alloc_name: str, volumes: Dict[str, object]) -> None:
+        self.volumes = {}
+        for name, req in (volumes or {}).items():
+            if req.type != "csi":
+                continue
+            self.volumes[name] = (req.source_for(alloc_name), req.read_only)
+
+    def feasible(self, node: Node) -> bool:
+        if not self.volumes:
+            return True
+        from ..structs.csi import plugin_healthy
+        snap = self.ctx.state
+        for name, (source, read_only) in self.volumes.items():
+            vol = (snap.csi_volume_by_id(self.namespace, source)
+                   if hasattr(snap, "csi_volume_by_id") else None)
+            if vol is None or not vol.schedulable:
+                self.ctx.metrics.filter_node(
+                    node.computed_class, FILTER_CONSTRAINT_CSI_VOLUMES)
+                return False
+            mode = "read" if read_only else "write"
+            # claims held by THIS node's allocs don't block re-placement
+            # onto the same node, for reads and writes alike (reference:
+            # feasible.go claim checks via WriteFreeClaims w/ ownership)
+            if not vol.claim_ok(mode):
+                holders = set(c.node_id for c in vol.write_claims.values())
+                holders |= set(c.node_id for c in vol.read_claims.values())
+                if holders != {node.id}:
+                    self.ctx.metrics.filter_node(
+                        node.computed_class, FILTER_CONSTRAINT_CSI_VOLUMES)
+                    return False
+            # plugin presence on the node, healthy
+            if not plugin_healthy(
+                    (node.csi_node_plugins or {}).get(vol.plugin_id)):
+                self.ctx.metrics.filter_node(
+                    node.computed_class, FILTER_CONSTRAINT_CSI_PLUGINS)
                 return False
         return True
 

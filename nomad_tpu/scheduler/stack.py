@@ -18,7 +18,7 @@ from .context import EvalContext
 from .feasible import (
     ConstraintChecker, DeviceChecker, DistinctHostsIterator,
     DistinctPropertyIterator, DriverChecker, FeasibilityWrapper,
-    HostVolumeChecker, NetworkChecker, StaticIterator,
+    CSIVolumeChecker, HostVolumeChecker, NetworkChecker, StaticIterator,
 )
 from .rank import (
     BinPackIterator, FeasibleRankIterator, JobAntiAffinityIterator,
@@ -67,13 +67,14 @@ class GenericStack:
         self.tg_constraint = ConstraintChecker(ctx, [])
         self.tg_devices = DeviceChecker(ctx)
         self.tg_host_volumes = HostVolumeChecker(ctx)
+        self.tg_csi_volumes = CSIVolumeChecker(ctx)
         self.tg_network = NetworkChecker(ctx)
         self.wrapped_checks = FeasibilityWrapper(
             ctx, self.source,
             job_checkers=[self.job_constraint],
             tg_checkers=[self.tg_drivers, self.tg_constraint,
                          self.tg_devices, self.tg_network],
-            avail_checkers=[self.tg_host_volumes])
+            avail_checkers=[self.tg_host_volumes, self.tg_csi_volumes])
         self.distinct_hosts = DistinctHostsIterator(ctx, self.wrapped_checks)
         self.distinct_property = DistinctPropertyIterator(
             ctx, self.distinct_hosts)
@@ -109,6 +110,7 @@ class GenericStack:
         if self.job_version is not None and self.job_version == job.version:
             return
         self.job_version = job.version
+        self.tg_csi_volumes.set_namespace(job.namespace)
         self.job_constraint.set_constraints(job.constraints)
         self.distinct_hosts.set_job(job)
         self.distinct_property.set_job(job)
@@ -146,6 +148,7 @@ class GenericStack:
         self.tg_constraint.set_constraints(constraints)
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
+        self.tg_csi_volumes.set_volumes(options.alloc_name, tg.volumes)
         if tg.networks:
             self.tg_network.set_network(tg.networks[0])
         else:
@@ -187,13 +190,14 @@ class SystemStack:
         self.tg_constraint = ConstraintChecker(ctx, [])
         self.tg_devices = DeviceChecker(ctx)
         self.tg_host_volumes = HostVolumeChecker(ctx)
+        self.tg_csi_volumes = CSIVolumeChecker(ctx)
         self.tg_network = NetworkChecker(ctx)
         self.wrapped_checks = FeasibilityWrapper(
             ctx, self.source,
             job_checkers=[self.job_constraint],
             tg_checkers=[self.tg_drivers, self.tg_constraint,
                          self.tg_devices, self.tg_network],
-            avail_checkers=[self.tg_host_volumes])
+            avail_checkers=[self.tg_host_volumes, self.tg_csi_volumes])
         self.distinct_property = DistinctPropertyIterator(
             ctx, self.wrapped_checks)
         rank_source = FeasibleRankIterator(ctx, self.distinct_property)
@@ -204,6 +208,7 @@ class SystemStack:
         self.source.set_nodes(list(base_nodes))
 
     def set_job(self, job: Job) -> None:
+        self.tg_csi_volumes.set_namespace(job.namespace)
         self.job_constraint.set_constraints(job.constraints)
         self.distinct_property.set_job(job)
         self.binpack.set_job(job)
@@ -222,6 +227,7 @@ class SystemStack:
         self.tg_constraint.set_constraints(constraints)
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
+        self.tg_csi_volumes.set_volumes(options.alloc_name, tg.volumes)
         if tg.networks:
             self.tg_network.set_network(tg.networks[0])
         else:

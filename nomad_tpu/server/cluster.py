@@ -108,6 +108,16 @@ class RaftBackedStateStore:
     def delete_namespace(self, name):
         return self._propose("delete_namespace", name)
 
+    def upsert_csi_volume(self, vol):
+        return self._propose("upsert_csi_volume", vol)
+
+    def delete_csi_volume(self, namespace, vol_id):
+        return self._propose("delete_csi_volume", namespace, vol_id)
+
+    def csi_volume_release(self, namespace, vol_id, alloc_id):
+        return self._propose("csi_volume_release", namespace, vol_id,
+                             alloc_id)
+
     def set_scheduler_config(self, cfg):
         return self._propose("set_scheduler_config", cfg)
 

@@ -78,7 +78,8 @@ class Server:
         for fn, name in ((self._run_heartbeat_watcher, "heartbeat"),
                          (self._run_gc, "core-gc"),
                          (self._run_periodic, "periodic"),
-                         (self._run_deployment_watcher, "deploy-watch")):
+                         (self._run_deployment_watcher, "deploy-watch"),
+                         (self._run_volume_watcher, "volume-watch")):
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -767,6 +768,43 @@ class Server:
             raise ValueError(f"node pool {name!r} used by {len(jobs)} jobs")
         self.state.delete_node_pool(name)
         self.publish_event("NodePoolDeleted", {"name": name})
+
+    # ------------------------------------------------------------------
+    # CSI volumes (reference: nomad/csi_endpoint.go)
+    def register_csi_volume(self, vol) -> None:
+        if not vol.id or not vol.plugin_id:
+            raise ValueError("volume id and plugin_id are required")
+        if self.state.namespace_by_name(vol.namespace) is None:
+            raise ValueError(f"namespace {vol.namespace!r} does not exist")
+        self.state.upsert_csi_volume(vol)
+        self.publish_event("CSIVolumeRegistered",
+                           {"volume_id": vol.id, "namespace": vol.namespace})
+
+    def deregister_csi_volume(self, namespace: str, vol_id: str,
+                              force: bool = False) -> None:
+        vol = self.state.csi_volume_by_id(namespace, vol_id)
+        if vol is None:
+            raise ValueError(f"volume {vol_id!r} not found")
+        if not force and (vol.read_claims or vol.write_claims):
+            raise ValueError(
+                f"volume {vol_id!r} has active claims (use force)")
+        self.state.delete_csi_volume(namespace, vol_id)
+        self.publish_event("CSIVolumeDeregistered",
+                           {"volume_id": vol_id, "namespace": namespace})
+
+    def _run_volume_watcher(self) -> None:
+        """Release claims held by terminal allocs so writers can move
+        (reference: nomad/volumewatcher/volumes_watcher.go)."""
+        while not self._shutdown.wait(0.5):
+            if not self._leader_active.is_set():
+                continue
+            for vol in self.state.csi_volumes():
+                for alloc_id in (list(vol.read_claims)
+                                 + list(vol.write_claims)):
+                    alloc = self.state.alloc_by_id(alloc_id)
+                    if alloc is None or alloc.terminal_status():
+                        self.state.csi_volume_release(
+                            vol.namespace, vol.id, alloc_id)
 
     # ------------------------------------------------------------------
     # Search (reference: nomad/search_endpoint.go)

@@ -27,7 +27,7 @@ from ..structs import (
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
           "scheduler_config", "job_versions", "acl_policies", "acl_tokens",
           "root_keys", "variables", "scaling_policies", "scaling_events",
-          "namespaces")
+          "namespaces", "csi_volumes", "csi_plugins")
 
 
 class StateSnapshot:
@@ -54,6 +54,8 @@ class StateSnapshot:
             self._store = store
             self._allocs_by_node = {k: list(v) for k, v in store._allocs_by_node.items()}
             self._allocs_by_job = {k: list(v) for k, v in store._allocs_by_job.items()}
+            self._csi_volumes = dict(store._csi_volumes)
+            self._csi_plugins = dict(store._csi_plugins)
 
     # -- read API shared with the live store ---------------------------------
     def latest_index(self) -> int:
@@ -134,6 +136,21 @@ class StateSnapshot:
     def scheduler_config(self) -> SchedulerConfiguration:
         return self._scheduler_config
 
+    def csi_volume_by_id(self, namespace: str, vol_id: str):
+        return self._csi_volumes.get((namespace, vol_id))
+
+    def csi_volumes(self, namespace: Optional[str] = None):
+        return sorted(
+            (v for v in self._csi_volumes.values()
+             if namespace in (None, "*", v.namespace)),
+            key=lambda v: (v.namespace, v.id))
+
+    def csi_plugin_by_id(self, plugin_id: str):
+        return self._csi_plugins.get(plugin_id)
+
+    def csi_plugins(self):
+        return sorted(self._csi_plugins.values(), key=lambda p: p.id)
+
 
 class StateStore:
     """The live, writable store. All writes go through raft in the reference
@@ -170,6 +187,10 @@ class StateStore:
         self._namespaces: Dict[str, "Namespace"] = {
             "default": Namespace(name="default",
                                  description="Default shared namespace")}
+        # CSI (reference: state_store.go CSIVolume/CSIPlugin regions;
+        # plugins derived from node fingerprints)
+        self._csi_volumes: Dict[Tuple[str, str], "CSIVolume"] = {}
+        self._csi_plugins: Dict[str, "CSIPlugin"] = {}
         # secondary indexes
         self._allocs_by_node: Dict[str, List[str]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
@@ -228,12 +249,16 @@ class StateStore:
                 node.compute_class()
             self._nodes[node.id] = node
             self.alloc_table.register_node(node)
-            return self._bump("nodes")
+            idx = self._bump("nodes")
+            self._recompute_csi_plugins_locked()
+            return idx
 
     def delete_node(self, node_id: str) -> int:
         with self._lock:
             self._nodes.pop(node_id, None)
-            return self._bump("nodes")
+            idx = self._bump("nodes")
+            self._recompute_csi_plugins_locked()
+            return idx
 
     def update_node_status(self, node_id: str, status: str,
                            updated_at: float = 0.0) -> int:
@@ -247,7 +272,9 @@ class StateStore:
             node.status_updated_at = updated_at
             node.modify_index = self._index + 1
             self._nodes[node_id] = node
-            return self._bump("nodes")
+            idx = self._bump("nodes")
+            self._recompute_csi_plugins_locked()
+            return idx
 
     def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
         with self._lock:
@@ -259,7 +286,9 @@ class StateStore:
             node.scheduling_eligibility = eligibility
             node.modify_index = self._index + 1
             self._nodes[node_id] = node
-            return self._bump("nodes")
+            idx = self._bump("nodes")
+            self._recompute_csi_plugins_locked()
+            return idx
 
     def update_node_drain(self, node_id: str, drain_strategy,
                           mark_eligible: bool = False) -> int:
@@ -277,7 +306,9 @@ class StateStore:
                 node.scheduling_eligibility = NODE_SCHED_ELIGIBLE
             node.modify_index = self._index + 1
             self._nodes[node_id] = node
-            return self._bump("nodes")
+            idx = self._bump("nodes")
+            self._recompute_csi_plugins_locked()
+            return idx
 
     # -- jobs ----------------------------------------------------------------
     def upsert_job(self, job: Job) -> int:
@@ -629,6 +660,120 @@ class StateStore:
         with self._lock:
             return sorted(self._namespaces.values(), key=lambda n: n.name)
 
+    # -- CSI volumes + plugins (reference: state_store.go CSIVolume region,
+    #    volumewatcher claim release) --------------------------------------
+    def upsert_csi_volume(self, vol: "CSIVolume") -> int:
+        with self._lock:
+            key = (vol.namespace, vol.id)
+            existing = self._csi_volumes.get(key)
+            if existing is not None:
+                vol.create_index = existing.create_index
+                # claims survive re-registration
+                vol.read_claims = dict(existing.read_claims)
+                vol.write_claims = dict(existing.write_claims)
+            else:
+                vol.create_index = self._index + 1
+            vol.modify_index = self._index + 1
+            self._csi_volumes[key] = vol
+            return self._bump("csi_volumes")
+
+    def delete_csi_volume(self, namespace: str, vol_id: str) -> int:
+        """Caller enforces no-claims; built to be idempotent."""
+        with self._lock:
+            self._csi_volumes.pop((namespace, vol_id), None)
+            return self._bump("csi_volumes")
+
+    def csi_volume_by_id(self, namespace: str, vol_id: str
+                         ) -> Optional["CSIVolume"]:
+        with self._lock:
+            return self._csi_volumes.get((namespace, vol_id))
+
+    def csi_volumes(self, namespace: Optional[str] = None
+                    ) -> List["CSIVolume"]:
+        with self._lock:
+            return sorted(
+                (v for v in self._csi_volumes.values()
+                 if namespace in (None, "*", v.namespace)),
+                key=lambda v: (v.namespace, v.id))
+
+    def csi_volume_release(self, namespace: str, vol_id: str,
+                           alloc_id: str) -> int:
+        """Drop an alloc's claims (reference: CSIVolumeClaim w/ release
+        state, driven by the volume watcher)."""
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is None:
+                return self._index
+            import copy as _copy
+            nv = _copy.copy(vol)
+            nv.read_claims = {k: c for k, c in vol.read_claims.items()
+                              if k != alloc_id}
+            nv.write_claims = {k: c for k, c in vol.write_claims.items()
+                               if k != alloc_id}
+            if (len(nv.read_claims), len(nv.write_claims)) == \
+                    (len(vol.read_claims), len(vol.write_claims)):
+                return self._index
+            nv.modify_index = self._index + 1
+            self._csi_volumes[(namespace, vol_id)] = nv
+            return self._bump("csi_volumes")
+
+    def _csi_claim_locked(self, alloc: Allocation) -> None:
+        """Claim the CSI volumes an alloc's group requests; called from
+        upsert_plan_results so claims replicate deterministically with the
+        placement itself (reference: csi_hook + CSIVolume.Claim RPC)."""
+        from ..structs.csi import CLAIM_READ, CLAIM_WRITE, CSIVolumeClaim
+        job = alloc.job
+        if job is None:
+            return
+        tg = job.lookup_task_group(alloc.task_group)
+        if tg is None:
+            return
+        for req in (tg.volumes or {}).values():
+            if req.type != "csi":
+                continue
+            source = req.source_for(alloc.name)
+            vol = self._csi_volumes.get((job.namespace, source))
+            if vol is None:
+                continue
+            import copy as _copy
+            nv = _copy.copy(vol)
+            nv.read_claims = dict(vol.read_claims)
+            nv.write_claims = dict(vol.write_claims)
+            claim = CSIVolumeClaim(
+                alloc_id=alloc.id, node_id=alloc.node_id,
+                mode=CLAIM_READ if req.read_only else CLAIM_WRITE)
+            if req.read_only:
+                nv.read_claims[alloc.id] = claim
+            else:
+                nv.write_claims[alloc.id] = claim
+            nv.modify_index = self._index + 1
+            self._csi_volumes[(job.namespace, source)] = nv
+            self._table_index["csi_volumes"] = self._index + 1
+
+    def _recompute_csi_plugins_locked(self) -> None:
+        """Aggregate per-node fingerprints into fleet-wide plugin rows
+        (reference: state_store.go updateNodeCSIPlugins)."""
+        from ..structs.csi import CSIPlugin, plugin_healthy
+        plugins: Dict[str, CSIPlugin] = {}
+        for node in self._nodes.values():
+            if not node.ready():
+                continue
+            for pid, info in (node.csi_node_plugins or {}).items():
+                p = plugins.setdefault(pid, CSIPlugin(id=pid))
+                if plugin_healthy(info):
+                    p.nodes_healthy += 1
+                    p.node_ids.append(node.id)
+        self._csi_plugins = plugins
+        self._table_index["csi_plugins"] = self._index
+
+    def csi_plugins(self) -> List["CSIPlugin"]:
+        with self._lock:
+            return sorted(self._csi_plugins.values(), key=lambda p: p.id)
+
+    def csi_plugin_by_id(self, plugin_id: str) -> Optional["CSIPlugin"]:
+        with self._lock:
+            return self._csi_plugins.get(plugin_id)
+
     # -- keyring + variables (reference: state_store.go UpsertRootKeyMeta,
     #    VarSet/VarGet/VarDelete with check-and-set semantics) -------------
     def upsert_root_key(self, key: "RootKey") -> int:
@@ -834,6 +979,8 @@ class StateStore:
                 self._allocs[alloc.id] = alloc
 
             self._insert_allocs_locked(placements)
+            for alloc in placements:
+                self._csi_claim_locked(alloc)
 
             if result.deployment is not None:
                 d = result.deployment

@@ -53,6 +53,14 @@ from .funcs import (  # noqa: F401
     allocs_fit, devices_fit, compute_free_percentage, score_fit_binpack,
     score_fit_spread, BINPACK_MAX_FIT_SCORE,
 )
+from .csi import (  # noqa: F401
+    CSIPlugin, CSIPluginInfo, CSITopology, CSIVolume, CSIVolumeClaim,
+    ACCESS_MODE_SINGLE_NODE_READER, ACCESS_MODE_SINGLE_NODE_WRITER,
+    ACCESS_MODE_MULTI_NODE_READER, ACCESS_MODE_MULTI_NODE_SINGLE_WRITER,
+    ACCESS_MODE_MULTI_NODE_MULTI_WRITER,
+    ATTACHMENT_MODE_FILE_SYSTEM, ATTACHMENT_MODE_BLOCK_DEVICE,
+    CLAIM_READ, CLAIM_WRITE,
+)
 from .config import (  # noqa: F401
     Namespace, NamespaceNodePoolConfiguration,
     PreemptionConfig, SchedulerConfiguration,

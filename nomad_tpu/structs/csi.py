@@ -1,0 +1,138 @@
+"""CSI (Container Storage Interface) data model.
+
+Semantic parity with /root/reference/nomad/structs/csi.go (CSIVolume,
+CSIPlugin, claim modes) at reduced scope: volumes are registered via the
+API, plugins are derived from node fingerprints, and the claim lifecycle
+(claim on placement, release on terminal alloc via the volume watcher)
+follows nomad/state/state_store.go CSIVolumeClaim + nomad/volumewatcher/.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# access modes (reference: structs/csi.go CSIVolumeAccessMode)
+ACCESS_MODE_SINGLE_NODE_READER = "single-node-reader-only"
+ACCESS_MODE_SINGLE_NODE_WRITER = "single-node-writer"
+ACCESS_MODE_MULTI_NODE_READER = "multi-node-reader-only"
+ACCESS_MODE_MULTI_NODE_SINGLE_WRITER = "multi-node-single-writer"
+ACCESS_MODE_MULTI_NODE_MULTI_WRITER = "multi-node-multi-writer"
+
+# attachment modes (reference: structs/csi.go CSIVolumeAttachmentMode)
+ATTACHMENT_MODE_FILE_SYSTEM = "file-system"
+ATTACHMENT_MODE_BLOCK_DEVICE = "block-device"
+
+CLAIM_READ = "read"
+CLAIM_WRITE = "write"
+
+
+@dataclass
+class CSITopology:
+    """(reference: structs/csi.go CSITopology)"""
+
+    segments: Dict[str, str] = field(default_factory=dict)
+
+    def matches(self, other: "CSITopology") -> bool:
+        """True when every segment here equals the other's segment."""
+        return all(other.segments.get(k) == v
+                   for k, v in self.segments.items())
+
+
+@dataclass
+class CSIVolumeClaim:
+    alloc_id: str = ""
+    node_id: str = ""
+    mode: str = CLAIM_READ          # read | write
+
+
+@dataclass
+class CSIVolume:
+    """(reference: structs/csi.go CSIVolume)"""
+
+    id: str = ""
+    namespace: str = "default"
+    name: str = ""
+    external_id: str = ""
+    plugin_id: str = ""
+    access_mode: str = ACCESS_MODE_SINGLE_NODE_WRITER
+    attachment_mode: str = ATTACHMENT_MODE_FILE_SYSTEM
+    capacity_min_mb: int = 0
+    capacity_max_mb: int = 0
+    mount_options: Dict[str, object] = field(default_factory=dict)
+    secrets: Dict[str, str] = field(default_factory=dict)
+    parameters: Dict[str, str] = field(default_factory=dict)
+    topologies: List[CSITopology] = field(default_factory=list)
+    # claim state
+    read_claims: Dict[str, CSIVolumeClaim] = field(default_factory=dict)
+    write_claims: Dict[str, CSIVolumeClaim] = field(default_factory=dict)
+    schedulable: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+    # -- claim math (reference: csi.go WriteFreeClaims/ReadSchedulable) ----
+    def supports_writes(self) -> bool:
+        return self.access_mode in (
+            ACCESS_MODE_SINGLE_NODE_WRITER,
+            ACCESS_MODE_MULTI_NODE_SINGLE_WRITER,
+            ACCESS_MODE_MULTI_NODE_MULTI_WRITER)
+
+    def supports_multi_node(self) -> bool:
+        return self.access_mode in (
+            ACCESS_MODE_MULTI_NODE_READER,
+            ACCESS_MODE_MULTI_NODE_SINGLE_WRITER,
+            ACCESS_MODE_MULTI_NODE_MULTI_WRITER)
+
+    def write_free(self) -> bool:
+        """Can one more writer claim the volume?"""
+        if not self.supports_writes():
+            return False
+        if self.access_mode == ACCESS_MODE_MULTI_NODE_MULTI_WRITER:
+            return True
+        return len(self.write_claims) == 0
+
+    def read_free(self) -> bool:
+        if self.supports_multi_node():
+            return True
+        # single-node volume: readable only while unclaimed or on the
+        # claiming node (simplified single-claim rule)
+        return len(self.read_claims) + len(self.write_claims) == 0
+
+    def claim_ok(self, mode: str) -> bool:
+        if not self.schedulable:
+            return False
+        return self.write_free() if mode == CLAIM_WRITE else self.read_free()
+
+
+def plugin_healthy(info) -> bool:
+    """Decode a node's csi_node_plugins entry (dict from fingerprint wire
+    format, or CSIPluginInfo). None means the plugin is absent."""
+    if info is None:
+        return False
+    if isinstance(info, dict):
+        return bool(info.get("healthy", True))
+    return bool(getattr(info, "healthy", True))
+
+
+@dataclass
+class CSIPluginInfo:
+    """Per-node plugin presence, reported by fingerprinting
+    (reference: structs/csi.go CSIInfo on the Node)."""
+
+    plugin_id: str = ""
+    healthy: bool = True
+    requires_controller: bool = False
+    node_topology: CSITopology = field(default_factory=CSITopology)
+
+
+@dataclass
+class CSIPlugin:
+    """Aggregated view over the fleet (reference: structs/csi.go CSIPlugin,
+    derived by the state store from node upserts)."""
+
+    id: str = ""
+    controller_required: bool = False
+    controllers_healthy: int = 0
+    nodes_healthy: int = 0
+    node_ids: List[str] = field(default_factory=list)
+    create_index: int = 0
+    modify_index: int = 0

@@ -156,6 +156,16 @@ class VolumeRequest:
     attachment_mode: str = ""
     per_alloc: bool = False
 
+    def source_for(self, alloc_name: str) -> str:
+        """Effective volume source: per_alloc volumes append the alloc's
+        bracket index, e.g. source[3] (reference: structs.VolumeRequest
+        + alloc name indexing). The ONE place this rule lives -- the
+        scheduler's checkers and the state store's claim writer must
+        agree on it."""
+        if self.per_alloc and alloc_name and "[" in alloc_name:
+            return f"{self.source}{alloc_name[alloc_name.rfind('['):]}"
+        return self.source
+
 
 @dataclass
 class Service:

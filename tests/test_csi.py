@@ -1,0 +1,276 @@
+"""CSI: volume registration, claim lifecycle, scheduling feasibility,
+volume watcher release (reference analogs: nomad/csi_endpoint.go,
+scheduler/feasible.go:230 CSIVolumeChecker, nomad/volumewatcher/)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import SimClient
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    CSIVolume, VolumeRequest,
+    ACCESS_MODE_MULTI_NODE_MULTI_WRITER, ACCESS_MODE_SINGLE_NODE_WRITER,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1, heartbeat_ttl=5.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def csi_node(plugin="ebs"):
+    n = mock.node()
+    n.csi_node_plugins = {plugin: {"healthy": True}}
+    return n
+
+
+def csi_job(vol_source="vol0", read_only=False, count=1, job_id="dbjob"):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.volumes = {"data": VolumeRequest(
+        name="data", type="csi", source=vol_source, read_only=read_only)}
+    return job
+
+
+def wait(cond, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# -- registration ------------------------------------------------------------
+
+def test_volume_register_deregister(server):
+    server.register_csi_volume(CSIVolume(id="vol0", plugin_id="ebs"))
+    vol = server.state.csi_volume_by_id("default", "vol0")
+    assert vol is not None and vol.schedulable
+    server.deregister_csi_volume("default", "vol0")
+    assert server.state.csi_volume_by_id("default", "vol0") is None
+
+
+def test_volume_register_validation(server):
+    with pytest.raises(ValueError):
+        server.register_csi_volume(CSIVolume(id="", plugin_id="p"))
+    with pytest.raises(ValueError):
+        server.register_csi_volume(
+            CSIVolume(id="v", plugin_id="p", namespace="ghost"))
+
+
+def test_plugins_derived_from_nodes(server):
+    server.register_node(csi_node("ebs"))
+    server.register_node(csi_node("ebs"))
+    server.register_node(csi_node("efs"))
+    plugins = {p.id: p for p in server.state.csi_plugins()}
+    assert plugins["ebs"].nodes_healthy == 2
+    assert plugins["efs"].nodes_healthy == 1
+
+
+# -- scheduling feasibility --------------------------------------------------
+
+def test_csi_job_places_on_plugin_node(server):
+    server.register_csi_volume(CSIVolume(id="vol0", plugin_id="ebs"))
+    with_plugin, without = csi_node("ebs"), mock.node()
+    clients = [SimClient(server, n) for n in (with_plugin, without)]
+    for c in clients:
+        c.start()
+    try:
+        server.register_job(csi_job())
+        assert wait(lambda: [
+            a for a in server.state.allocs_by_job("default", "dbjob")
+            if not a.terminal_status()])
+        allocs = [a for a in server.state.allocs_by_job("default", "dbjob")
+                  if not a.terminal_status()]
+        assert all(a.node_id == with_plugin.id for a in allocs)
+    finally:
+        for c in clients:
+            c.stop()
+
+
+def test_missing_volume_blocks_placement(server):
+    c = SimClient(server, csi_node("ebs"))
+    c.start()
+    try:
+        server.register_job(csi_job(vol_source="nonexistent"))
+        time.sleep(1.0)
+        assert [a for a in server.state.allocs_by_job("default", "dbjob")
+                if not a.terminal_status()] == []
+    finally:
+        c.stop()
+
+
+def test_single_writer_volume_serializes_claims(server):
+    """Two jobs writing the same single-node-writer volume: the second
+    must not place until the first's claim releases."""
+    server.register_csi_volume(CSIVolume(
+        id="vol0", plugin_id="ebs",
+        access_mode=ACCESS_MODE_SINGLE_NODE_WRITER))
+    c1 = SimClient(server, csi_node("ebs"))
+    c2 = SimClient(server, csi_node("ebs"))
+    c1.start(), c2.start()
+    try:
+        server.register_job(csi_job(job_id="writer1"))
+        assert wait(lambda: server.state.csi_volume_by_id(
+            "default", "vol0").write_claims)
+        vol = server.state.csi_volume_by_id("default", "vol0")
+        assert len(vol.write_claims) == 1
+        holder_node = list(vol.write_claims.values())[0].node_id
+
+        # second writer: can only land on the claim-holding node
+        server.register_job(csi_job(job_id="writer2"))
+        time.sleep(1.0)
+        for a in server.state.allocs_by_job("default", "writer2"):
+            if not a.terminal_status():
+                assert a.node_id == holder_node
+    finally:
+        c1.stop(), c2.stop()
+
+
+def test_multi_writer_volume_allows_concurrent_claims(server):
+    server.register_csi_volume(CSIVolume(
+        id="shared", plugin_id="ebs",
+        access_mode=ACCESS_MODE_MULTI_NODE_MULTI_WRITER))
+    clients = [SimClient(server, csi_node("ebs")) for _ in range(2)]
+    for c in clients:
+        c.start()
+    try:
+        server.register_job(csi_job(vol_source="shared", count=2,
+                                    job_id="multi"))
+        assert wait(lambda: len(server.state.csi_volume_by_id(
+            "default", "shared").write_claims) == 2)
+    finally:
+        for c in clients:
+            c.stop()
+
+
+def test_volume_watcher_releases_terminal_claims(server):
+    server.register_csi_volume(CSIVolume(id="vol0", plugin_id="ebs"))
+    c = SimClient(server, csi_node("ebs"))
+    c.start()
+    try:
+        server.register_job(csi_job())
+        assert wait(lambda: server.state.csi_volume_by_id(
+            "default", "vol0").write_claims)
+        server.deregister_job("default", "dbjob")
+        # watcher must release the claim once the alloc goes terminal
+        assert wait(lambda: not server.state.csi_volume_by_id(
+            "default", "vol0").write_claims, timeout=10)
+    finally:
+        c.stop()
+
+
+def test_volume_claims_survive_snapshot(server):
+    from nomad_tpu.raft.fsm import dump_state, restore_state
+    from nomad_tpu.state import StateStore
+    import json
+
+    server.register_csi_volume(CSIVolume(id="vol0", plugin_id="ebs"))
+    c = SimClient(server, csi_node("ebs"))
+    c.start()
+    try:
+        server.register_job(csi_job())
+        assert wait(lambda: server.state.csi_volume_by_id(
+            "default", "vol0").write_claims)
+    finally:
+        c.stop()
+    blob = json.loads(json.dumps(dump_state(server.state)))
+    fresh = StateStore()
+    restore_state(fresh, blob)
+    vol = fresh.csi_volume_by_id("default", "vol0")
+    assert vol is not None and vol.write_claims
+    assert fresh.csi_plugins()       # plugins recomputed on restore
+
+
+def test_deregister_with_claims_requires_force(server):
+    server.register_csi_volume(CSIVolume(id="vol0", plugin_id="ebs"))
+    c = SimClient(server, csi_node("ebs"))
+    c.start()
+    try:
+        server.register_job(csi_job())
+        assert wait(lambda: server.state.csi_volume_by_id(
+            "default", "vol0").write_claims)
+        with pytest.raises(ValueError):
+            server.deregister_csi_volume("default", "vol0")
+        server.deregister_csi_volume("default", "vol0", force=True)
+        assert server.state.csi_volume_by_id("default", "vol0") is None
+    finally:
+        c.stop()
+
+
+def test_http_volume_endpoints(server):
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.api.http import HttpServer
+    server.register_node(csi_node("ebs"))
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        api.register_csi_volume("volA", "ebs",
+                                access_mode="multi-node-reader-only")
+        vols = api.csi_volumes()
+        assert [v["id"] for v in vols] == ["volA"]
+        assert api.csi_volume("volA")["plugin_id"] == "ebs"
+        assert [p["id"] for p in api.csi_plugins()] == ["ebs"]
+        assert api.csi_plugin("ebs")["nodes_healthy"] == 1
+        api.deregister_csi_volume("volA")
+        with pytest.raises(ApiError):
+            api.csi_volume("volA")
+    finally:
+        http.shutdown()
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_read_claim_same_node_replacement_allowed(server):
+    """A read claim held by this node's alloc must not block a
+    replacement reader on the same node (regression)."""
+    from nomad_tpu.structs import ACCESS_MODE_SINGLE_NODE_READER
+    server.register_csi_volume(CSIVolume(
+        id="ro", plugin_id="ebs",
+        access_mode=ACCESS_MODE_SINGLE_NODE_READER))
+    c = SimClient(server, csi_node("ebs"))
+    c.start()
+    try:
+        server.register_job(csi_job(vol_source="ro", read_only=True,
+                                    count=2, job_id="readers"))
+        assert wait(lambda: len([
+            a for a in server.state.allocs_by_job("default", "readers")
+            if not a.terminal_status()]) == 2)
+    finally:
+        c.stop()
+
+
+def test_drain_updates_plugin_health(server):
+    from nomad_tpu.structs import DrainStrategy
+    node = csi_node("ebs")
+    server.register_node(node)
+    assert server.state.csi_plugin_by_id("ebs").nodes_healthy == 1
+    server.state.update_node_drain(node.id, DrainStrategy(deadline_s=60),
+                                   mark_eligible=False)
+    plugin = server.state.csi_plugin_by_id("ebs")
+    assert plugin is None or plugin.nodes_healthy == 0
+
+
+def test_volume_register_bad_capacity_is_400(server):
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.api.http import HttpServer
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        with pytest.raises(ApiError) as err:
+            api.register_csi_volume("v", "ebs", capacity_min_mb="10GB")
+        assert err.value.status == 400
+        # subroutes are 404, not silent re-register
+        with pytest.raises(ApiError) as err:
+            api.post("/v1/volume/csi/v/detach", {})
+        assert err.value.status == 404
+    finally:
+        http.shutdown()
