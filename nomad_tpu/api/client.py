@@ -184,6 +184,16 @@ class ApiClient:
     def delete_node_pool(self, name: str) -> dict:
         return self.delete(f"/v1/node/pool/{name}")
 
+    # -- native service discovery (reference: api/services.go) ---------
+    def services(self) -> List[dict]:
+        return self.get("/v1/services")
+
+    def service(self, name: str) -> List[dict]:
+        return self.get(f"/v1/service/{name}")
+
+    def delete_service_registration(self, name: str, reg_id: str) -> dict:
+        return self.delete(f"/v1/service/{name}/{reg_id}")
+
     # -- CSI volumes + plugins (reference: api/csi.go) -----------------
     def csi_volumes(self) -> List[dict]:
         return self.get("/v1/volumes")
@@ -311,6 +321,10 @@ class HttpServerConn:
     def update_allocs(self, updates: List[Allocation]) -> None:
         self.api.post("/v1/node/allocs-update",
                       {"allocs": [codec.encode(a) for a in updates]})
+
+    def register_services(self, regs) -> None:
+        self.api.post("/v1/node/services-register",
+                      {"services": [codec.encode(r) for r in regs]})
 
     def get_alloc(self, alloc_id: str) -> Optional[Allocation]:
         try:

@@ -348,6 +348,29 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, [d for d in state.deployments()
                                  if acl.allow_namespace_op(
                                      d.namespace, CAP_READ_JOB)], index)
+            elif parts == ["v1", "services"]:
+                if not self._check(acl.allow_any_namespace(CAP_READ_JOB)
+                                   if ns == "*" else
+                                   acl.allow_namespace_op(ns, CAP_READ_JOB)):
+                    return
+                names = self.nomad.service_names(None if ns == "*" else ns)
+                self._send(200, [n for n in names
+                                 if acl.allow_namespace_op(
+                                     n["namespace"], CAP_READ_JOB)], index)
+            elif parts[:2] == ["v1", "service"] and len(parts) == 3:
+                if ns == "*":
+                    if not self._check(
+                            acl.allow_any_namespace(CAP_READ_JOB)):
+                        return
+                    regs = [r for r in state.service_registrations(None)
+                            if r.service_name == parts[2]
+                            and acl.allow_namespace_op(r.namespace,
+                                                       CAP_READ_JOB)]
+                    return self._send(200, regs, index)
+                if not self._check(acl.allow_namespace_op(ns,
+                                                          CAP_READ_JOB)):
+                    return
+                self._send(200, state.services_by_name(ns, parts[2]), index)
             elif parts == ["v1", "volumes"]:
                 from ..acl import CAP_CSI_LIST_VOLUME
                 allowed = (acl.allow_any_namespace(CAP_CSI_LIST_VOLUME)
@@ -626,6 +649,14 @@ class ApiHandler(BaseHTTPRequestHandler):
                     # client retries registration)
                     return self._error(404, "node not found")
                 self._send(200, {"heartbeat_ttl": ttl})
+            elif parts == ["v1", "node", "services-register"]:
+                # client-agent path (pre-gated by allow_node_write above)
+                from ..structs import ServiceRegistration, codec
+                from typing import List as _L
+                regs = codec.decode(_L[ServiceRegistration],
+                                    self._body().get("services", []))
+                self.nomad.upsert_services(regs)
+                self._send(200, {"registered": len(regs)})
             elif parts == ["v1", "node", "allocs-update"]:
                 from ..structs import Allocation, codec
                 from typing import List as _L
@@ -774,6 +805,22 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if not self._check(acl.is_management()):
                     return
                 self.nomad.state.delete_acl_tokens([parts[3]])
+                self._send(200, {"deleted": True})
+            elif parts[:2] == ["v1", "service"] and len(parts) == 4:
+                from ..acl import CAP_SUBMIT_JOB as _SUBMIT
+                # resolve the registration, then authorize against ITS
+                # namespace (ids are guessable -- query-ns is not enough)
+                reg = next(
+                    (r for r in self.nomad.state.service_registrations(None)
+                     if r.id == parts[3]), None)
+                if reg is None or reg.service_name != parts[2]:
+                    if not self._check(acl.allow_namespace_op(ns, _SUBMIT)):
+                        return
+                    return self._error(404, "registration not found")
+                if not self._check(acl.allow_namespace_op(reg.namespace,
+                                                          _SUBMIT)):
+                    return
+                self.nomad.state.delete_service_registrations([parts[3]])
                 self._send(200, {"deleted": True})
             elif parts[:3] == ["v1", "volume", "csi"] and len(parts) == 4:
                 from ..acl import CAP_CSI_WRITE_VOLUME

@@ -398,6 +398,22 @@ def cmd_node_pool(args) -> int:
     return 0
 
 
+def cmd_service(args) -> int:
+    api = _client(args)
+    if args.sub2 == "list":
+        print(_fmt_table(
+            [[s["service_name"], ",".join(s["tags"]) or "-"]
+             for s in api.services()],
+            ["Service", "Tags"]))
+    elif args.sub2 == "info":
+        regs = api.service(args.name)
+        print(_fmt_table(
+            [[r["id"][:24], f'{r["address"]}:{r["port"]}',
+              r["alloc_id"][:8], r["node_id"][:8]] for r in regs],
+            ["ID", "Address", "Alloc", "Node"]))
+    return 0
+
+
 def cmd_volume(args) -> int:
     api = _client(args)
     if args.sub2 == "status":
@@ -634,6 +650,14 @@ def build_parser() -> argparse.ArgumentParser:
     npn = npp.add_parser("nodes")
     npn.add_argument("name")
     npn.set_defaults(fn=cmd_node_pool)
+
+    svc = sub.add_parser("service").add_subparsers(dest="sub2",
+                                                   required=True)
+    svl = svc.add_parser("list")
+    svl.set_defaults(fn=cmd_service)
+    svi = svc.add_parser("info")
+    svi.add_argument("name")
+    svi.set_defaults(fn=cmd_service)
 
     vol = sub.add_parser("volume").add_subparsers(dest="sub2",
                                                   required=True)

@@ -136,6 +136,12 @@ class SimClient(threading.Thread):
         will_fail = int(cfg.get("exit_code", 0) or 0) != 0
         self._tasks[alloc.id] = _TaskState(now, run_for, will_fail,
                                            min_healthy)
+        # native service discovery: the workload's services enter the
+        # catalog as it starts (reference: client serviceregistration)
+        from .serviceregistration import build_registrations
+        regs = build_registrations(alloc, self.node)
+        if regs:
+            self.server.upsert_services(regs)
         return [self._mk_update(alloc, ALLOC_CLIENT_RUNNING)]
 
     def _advance_task(self, alloc: Allocation,

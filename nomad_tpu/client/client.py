@@ -47,6 +47,10 @@ class ServerConn:
     def get_alloc(self, alloc_id: str) -> Optional[Allocation]:
         raise NotImplementedError
 
+    def register_services(self, regs) -> None:
+        """(reference: ServiceRegistration.Upsert RPC)"""
+        raise NotImplementedError
+
 
 class LocalServerConn(ServerConn):
     """In-process server (dev agent topology)."""
@@ -71,6 +75,9 @@ class LocalServerConn(ServerConn):
 
     def get_alloc(self, alloc_id: str) -> Optional[Allocation]:
         return self.server.state.alloc_by_id(alloc_id)
+
+    def register_services(self, regs) -> None:
+        self.server.upsert_services(regs)
 
 
 MAX_TERMINAL_RUNNERS = 50     # client GC watermark (reference: client/gc.go)
@@ -105,6 +112,7 @@ class Client:
             self.state_db.put_node_id(self.node.id)
 
         self.runners: Dict[str, AllocRunner] = {}
+        self._services_registered: set = set()
         self._runner_lock = threading.Lock()
         self._last_index = 0
         self._last_ok_heartbeat = time.time()
@@ -170,14 +178,39 @@ class Client:
                 ttl = self.conn.heartbeat(self.node.id)
                 if ttl:
                     self.heartbeat_ttl = ttl
-                    self._last_ok_heartbeat = time.time()
+                    now = time.time()
+                    if now - self._last_ok_heartbeat > self.heartbeat_ttl:
+                        # we likely missed our TTL: the server may have
+                        # swept our services on node-down -- re-register
+                        self._services_registered.clear()
+                    self._last_ok_heartbeat = now
+                    self._reconcile_services()
                 else:
                     # server doesn't know us (restart/state loss):
                     # re-register (reference: client retryRegisterNode on
-                    # heartbeat 'node not found')
+                    # heartbeat 'node not found'); the server's node-down
+                    # sweep dropped our services, so re-register them too
                     self.conn.register_node(self.node)
+                    self._services_registered.clear()
             except Exception:   # noqa: BLE001 - server unreachable
                 pass
+
+    def _reconcile_services(self) -> None:
+        """Register services for running allocs not yet in the catalog
+        (idempotent ids; covers recovery after a node-down sweep)."""
+        from .serviceregistration import build_registrations
+        with self._runner_lock:
+            runners = [r for r in self.runners.values()
+                       if r.client_status == "running"
+                       and r.alloc.id not in self._services_registered]
+        for r in runners:
+            regs = build_registrations(r.alloc, self.node)
+            self._services_registered.add(r.alloc.id)
+            if regs:
+                try:
+                    self.conn.register_services(regs)
+                except Exception:   # noqa: BLE001
+                    self._services_registered.discard(r.alloc.id)
 
     # -- watch loop (reference: watchAllocations :2280) ----------------
     def _watch_allocations(self) -> None:
@@ -208,6 +241,7 @@ class Client:
                 runner.destroy(timeout=2.0)
                 with self._runner_lock:
                     self.runners.pop(alloc_id, None)
+                self._services_registered.discard(alloc_id)
                 self.state_db.delete_alloc(alloc_id)
             elif a.desired_status != ALLOC_DESIRED_RUN and \
                     runner.client_status not in (ALLOC_CLIENT_COMPLETE,
@@ -238,6 +272,9 @@ class Client:
         for name, tr in runner.task_runners.items():
             self.state_db.put_task_state(runner.alloc.id, name,
                                          tr.state, tr.handle)
+        # native service discovery: register once the alloc is running
+        # (deregistration is the server's terminal-status sweep)
+        self._reconcile_services()
         self._push_updates([runner.client_update()])
 
     def _push_updates(self, updates: List[Allocation]) -> None:
@@ -297,6 +334,7 @@ class Client:
             victims = terminal[:excess] if excess > 0 else []
             for aid, _ in victims:
                 self.runners.pop(aid, None)
+                self._services_registered.discard(aid)
         for aid, runner in victims:
             runner.destroy(timeout=1.0)
             self.state_db.delete_alloc(aid)

@@ -1,0 +1,75 @@
+"""Workload service registration: builds catalog entries for an alloc's
+group + task services.
+
+Semantic parity with /root/reference/client/serviceregistration/ (the
+"nomad" provider path, nsd/): when a workload starts, its services with
+provider "nomad" register in the server's native catalog; they deregister
+when the alloc stops. Address comes from the node, port from the alloc's
+allocated ports by label (reference: serviceregistration/workload.go).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..structs import Allocation, Node, ServiceRegistration
+
+
+def _node_address(node: Node) -> str:
+    for key in ("unique.network.ip-address", "network.ip-address"):
+        addr = (node.attributes or {}).get(key)
+        if addr:
+            return addr
+    return "127.0.0.1"
+
+
+def _port_by_label(alloc: Allocation, label: str) -> int:
+    """Resolve a service's port label against the alloc's assigned ports
+    (reference: taskenv port interpolation over AllocatedPorts)."""
+    if not label:
+        return 0
+    res = alloc.allocated_resources
+    networks = []
+    if res is not None:
+        networks.extend(res.shared.networks or [])
+        for tr in res.tasks.values():
+            networks.extend(tr.networks or [])
+    for net in networks:
+        for port in list(net.reserved_ports or []) + \
+                list(net.dynamic_ports or []):
+            if port.label == label:
+                return port.value
+    return 0
+
+
+def build_registrations(alloc: Allocation, node: Node
+                        ) -> List[ServiceRegistration]:
+    """Registrations for every provider="nomad" service of the alloc's
+    group and its tasks. Deterministic ids (alloc+service name) so
+    re-registration after a client restart is idempotent
+    (reference: serviceregistration id scheme `_nomad-task-<alloc>-...`)."""
+    job = alloc.job
+    if job is None:
+        return []
+    tg = job.lookup_task_group(alloc.task_group)
+    if tg is None:
+        return []
+    services = [(s, "group") for s in (tg.services or [])]
+    for task in tg.tasks:
+        services.extend((s, task.name) for s in (task.services or []))
+    out: List[ServiceRegistration] = []
+    for svc, scope in services:
+        if svc.provider != "nomad":
+            continue   # consul-provider services are out of catalog scope
+        out.append(ServiceRegistration(
+            id=f"_nomad-{scope}-{alloc.id}-{svc.name}",
+            service_name=svc.name,
+            namespace=job.namespace,
+            node_id=alloc.node_id or node.id,
+            datacenter=node.datacenter,
+            job_id=job.id,
+            alloc_id=alloc.id,
+            provider="nomad",
+            tags=list(svc.tags),
+            address=_node_address(node),
+            port=_port_by_label(alloc, svc.port_label)))
+    return out

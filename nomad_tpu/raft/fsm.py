@@ -16,7 +16,8 @@ from ..state.store import StateStore
 from ..structs import (
     ACLPolicy, ACLToken, Allocation, CSIVolume, Deployment, DrainStrategy,
     Evaluation, Job, Namespace, Node, NodePool, PlanResult, RootKey,
-    ScalingEvent, ScalingPolicy, SchedulerConfiguration, VariableEncrypted,
+    ScalingEvent, ScalingPolicy, SchedulerConfiguration,
+    ServiceRegistration, VariableEncrypted,
 )
 from ..structs import codec
 
@@ -49,6 +50,10 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "upsert_csi_volume": [CSIVolume],
     "delete_csi_volume": [str, str],
     "csi_volume_release": [str, str, str],
+    "upsert_service_registrations": [List[ServiceRegistration]],
+    "delete_service_registrations": [List[str]],
+    "delete_services_by_alloc": [str],
+    "delete_services_by_node": [str],
     "set_scheduler_config": [SchedulerConfiguration],
     "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
     "upsert_acl_policies": [List[ACLPolicy]],
@@ -130,6 +135,8 @@ def dump_state(store: StateStore) -> dict:
                            for n in store._namespaces.values()],
             "csi_volumes": [codec.encode(v)
                             for v in store._csi_volumes.values()],
+            "services": [codec.encode(s)
+                         for s in store._services.values()],
         }
 
 
@@ -204,6 +211,10 @@ def restore_state(store: StateStore, blob: dict) -> None:
             (codec.decode(CSIVolume, raw)
              for raw in blob.get("csi_volumes", []))}
         store._recompute_csi_plugins_locked()
+        store._services = {
+            s.id: s for s in
+            (codec.decode(ServiceRegistration, raw)
+             for raw in blob.get("services", []))}
         store._index = blob.get("index", 1)
         ti = blob.get("table_index", {})
         for t in store._table_index:

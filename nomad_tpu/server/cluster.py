@@ -118,6 +118,18 @@ class RaftBackedStateStore:
         return self._propose("csi_volume_release", namespace, vol_id,
                              alloc_id)
 
+    def upsert_service_registrations(self, regs):
+        return self._propose("upsert_service_registrations", regs)
+
+    def delete_service_registrations(self, reg_ids):
+        return self._propose("delete_service_registrations", reg_ids)
+
+    def delete_services_by_alloc(self, alloc_id):
+        return self._propose("delete_services_by_alloc", alloc_id)
+
+    def delete_services_by_node(self, node_id):
+        return self._propose("delete_services_by_node", node_id)
+
     def set_scheduler_config(self, cfg):
         return self._propose("set_scheduler_config", cfg)
 

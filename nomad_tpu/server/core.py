@@ -585,6 +585,10 @@ class Server:
             with self._hb_lock:
                 self._heartbeat_deadlines.pop(node_id, None)
             self._create_node_evals(node_id)
+            # a dead node's services must leave the catalog (reference:
+            # state store sweep on node down) -- one node-keyed write
+            if status == NODE_STATUS_DOWN:
+                self.state.delete_services_by_node(node_id)
         self.publish_event("NodeStatusUpdate",
                            {"node_id": node_id, "status": status})
 
@@ -657,6 +661,11 @@ class Server:
     def update_allocs_from_client(self, allocs: List[Allocation]) -> None:
         """(reference: node_endpoint.go:1322 UpdateAlloc)"""
         self.state.update_allocs_from_client(allocs)
+        # terminal allocs leave the service catalog (reference: the state
+        # store deletes service registrations in UpdateAllocsFromClient)
+        for a in allocs:
+            if a.client_terminal_status():
+                self.state.delete_services_by_alloc(a.id)
         # allocs going terminal can complete the job
         for key in {(a.namespace, a.job_id) for a in allocs}:
             self._refresh_job_status(*key)
@@ -768,6 +777,28 @@ class Server:
             raise ValueError(f"node pool {name!r} used by {len(jobs)} jobs")
         self.state.delete_node_pool(name)
         self.publish_event("NodePoolDeleted", {"name": name})
+
+    # ------------------------------------------------------------------
+    # Native service discovery (reference:
+    # nomad/service_registration_endpoint.go)
+    def upsert_services(self, regs) -> None:
+        regs = [r for r in regs if r.provider == "nomad" and r.service_name]
+        if regs:
+            self.state.upsert_service_registrations(regs)
+
+    def service_names(self, namespace: Optional[str] = None) -> List[dict]:
+        """Catalog listing: name + tag union per service
+        (reference: ServiceRegistration.List)."""
+        byname: Dict[tuple, dict] = {}
+        for reg in self.state.service_registrations(namespace):
+            entry = byname.setdefault(
+                (reg.namespace, reg.service_name),
+                {"namespace": reg.namespace,
+                 "service_name": reg.service_name, "tags": []})
+            for t in reg.tags:
+                if t not in entry["tags"]:
+                    entry["tags"].append(t)
+        return list(byname.values())
 
     # ------------------------------------------------------------------
     # CSI volumes (reference: nomad/csi_endpoint.go)

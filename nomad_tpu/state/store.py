@@ -27,7 +27,7 @@ from ..structs import (
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
           "scheduler_config", "job_versions", "acl_policies", "acl_tokens",
           "root_keys", "variables", "scaling_policies", "scaling_events",
-          "namespaces", "csi_volumes", "csi_plugins")
+          "namespaces", "csi_volumes", "csi_plugins", "services")
 
 
 class StateSnapshot:
@@ -191,6 +191,9 @@ class StateStore:
         # plugins derived from node fingerprints)
         self._csi_volumes: Dict[Tuple[str, str], "CSIVolume"] = {}
         self._csi_plugins: Dict[str, "CSIPlugin"] = {}
+        # native service catalog (reference: state_store.go
+        # service_registration region), keyed by registration id
+        self._services: Dict[str, "ServiceRegistration"] = {}
         # secondary indexes
         self._allocs_by_node: Dict[str, List[str]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
@@ -773,6 +776,61 @@ class StateStore:
     def csi_plugin_by_id(self, plugin_id: str) -> Optional["CSIPlugin"]:
         with self._lock:
             return self._csi_plugins.get(plugin_id)
+
+    # -- native service catalog (reference: state_store.go
+    #    UpsertServiceRegistrations / DeleteServiceRegistrationByID) ------
+    def upsert_service_registrations(
+            self, regs: List["ServiceRegistration"]) -> int:
+        with self._lock:
+            for reg in regs:
+                existing = self._services.get(reg.id)
+                reg.create_index = (existing.create_index if existing
+                                    else self._index + 1)
+                reg.modify_index = self._index + 1
+                self._services[reg.id] = reg
+            return self._bump("services")
+
+    def delete_service_registrations(self, reg_ids: List[str]) -> int:
+        with self._lock:
+            for rid in reg_ids:
+                self._services.pop(rid, None)
+            return self._bump("services")
+
+    def delete_services_by_alloc(self, alloc_id: str) -> int:
+        """All of one alloc's registrations at once (reference:
+        DeleteServiceRegistrationByAllocID, the client-restart sweep)."""
+        with self._lock:
+            gone = [rid for rid, r in self._services.items()
+                    if r.alloc_id == alloc_id]
+            for rid in gone:
+                del self._services[rid]
+            return self._bump("services") if gone else self._index
+
+    def delete_services_by_node(self, node_id: str) -> int:
+        """One-pass sweep of a dead node's registrations (reference:
+        DeleteServiceRegistrationByNodeID)."""
+        with self._lock:
+            gone = [rid for rid, r in self._services.items()
+                    if r.node_id == node_id]
+            for rid in gone:
+                del self._services[rid]
+            return self._bump("services") if gone else self._index
+
+    def service_registrations(self, namespace: Optional[str] = None
+                              ) -> List["ServiceRegistration"]:
+        with self._lock:
+            return sorted(
+                (s for s in self._services.values()
+                 if namespace in (None, "*", s.namespace)),
+                key=lambda s: (s.namespace, s.service_name, s.id))
+
+    def services_by_name(self, namespace: str, name: str
+                         ) -> List["ServiceRegistration"]:
+        with self._lock:
+            return sorted(
+                (s for s in self._services.values()
+                 if s.namespace == namespace and s.service_name == name),
+                key=lambda s: s.id)
 
     # -- keyring + variables (reference: state_store.go UpsertRootKeyMeta,
     #    VarSet/VarGet/VarDelete with check-and-set semantics) -------------

@@ -11,7 +11,8 @@ from .job import (  # noqa: F401
     Affinity, Constraint, EphemeralDisk, Job, LogConfig, MigrateStrategy,
     ParameterizedJobConfig, PeriodicConfig, ReschedulePolicy, RestartPolicy,
     ScalingEvent, ScalingPolicy,
-    Service, Spread, SpreadTarget, Task, TaskGroup, UpdateStrategy,
+    Service, ServiceRegistration, Spread, SpreadTarget, Task, TaskGroup,
+    UpdateStrategy,
     VolumeRequest, generate_uuid,
     JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM, JOB_TYPE_SYSBATCH,
     JOB_TYPE_CORE, JOB_STATUS_PENDING, JOB_STATUS_RUNNING, JOB_STATUS_DEAD,

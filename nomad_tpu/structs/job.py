@@ -177,6 +177,29 @@ class Service:
 
 
 @dataclass
+class ServiceRegistration:
+    """One task/group service instance in the native service catalog
+    (reference: nomad/structs/service_registration.go ServiceRegistration;
+    written by clients as workloads start, read via /v1/services)."""
+
+    id: str = ""
+    service_name: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    node_id: str = ""
+    datacenter: str = ""
+    job_id: str = ""
+    alloc_id: str = ""
+    provider: str = "nomad"
+    tags: List[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    # simplified check health: pending | passing | failing
+    status: str = "passing"
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
 class LogConfig:
     max_files: int = 10
     max_file_size_mb: int = 10

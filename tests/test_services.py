@@ -1,0 +1,259 @@
+"""Native service discovery: registration lifecycle, catalog queries,
+terminal/node-down sweeps (reference analogs:
+nomad/service_registration_endpoint.go, client/serviceregistration/)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import SimClient
+from nomad_tpu.client.serviceregistration import build_registrations
+from nomad_tpu.server import Server
+from nomad_tpu.structs import NODE_STATUS_DOWN, Service
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1, heartbeat_ttl=1.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def svc_job(job_id="web", count=1, provider="nomad", tags=()):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.services = [Service(name=f"{job_id}-svc", provider=provider,
+                           tags=list(tags))]
+    return job
+
+
+def wait(cond, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_build_registrations_nomad_provider_only():
+    node = mock.node()
+    job = svc_job()
+    job.task_groups[0].tasks[0].services = [
+        Service(name="consul-svc", provider="consul"),
+        Service(name="task-svc", provider="nomad", tags=["t"])]
+    from nomad_tpu.structs import Allocation
+    alloc = Allocation(id="a1", name="web.web[0]", job=job, job_id=job.id,
+                       task_group=job.task_groups[0].name,
+                       node_id=node.id)
+    regs = build_registrations(alloc, node)
+    names = sorted(r.service_name for r in regs)
+    assert names == ["task-svc", "web-svc"]    # consul provider excluded
+    assert all(r.alloc_id == "a1" for r in regs)
+    assert all(r.address for r in regs)
+    # deterministic ids -> idempotent re-registration
+    assert {r.id for r in build_registrations(alloc, node)} == \
+        {r.id for r in regs}
+
+
+def test_services_register_as_alloc_runs(server):
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        server.register_job(svc_job(count=2))
+        assert wait(lambda: len(server.state.services_by_name(
+            "default", "web-svc")) == 2)
+        names = server.service_names()
+        assert names[0]["service_name"] == "web-svc"
+    finally:
+        c.stop()
+
+
+def test_services_deregister_on_job_stop(server):
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        server.register_job(svc_job())
+        assert wait(lambda: server.state.services_by_name(
+            "default", "web-svc"))
+        server.deregister_job("default", "web")
+        assert wait(lambda: not server.state.services_by_name(
+            "default", "web-svc"))
+    finally:
+        c.stop()
+
+
+def test_services_deregister_on_task_completion(server):
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        job = svc_job(job_id="batchy")
+        job.type = "batch"
+        job.task_groups[0].tasks[0].config = {"run_for": "300ms"}
+        server.register_job(job)
+        assert wait(lambda: server.state.services_by_name(
+            "default", "batchy-svc"))
+        assert wait(lambda: not server.state.services_by_name(
+            "default", "batchy-svc"))
+    finally:
+        c.stop()
+
+
+def test_services_swept_on_node_down(server):
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        server.register_job(svc_job())
+        assert wait(lambda: server.state.services_by_name(
+            "default", "web-svc"))
+        c.freeze()     # stop heartbeating -> node down
+        assert wait(lambda: not server.state.services_by_name(
+            "default", "web-svc"), timeout=10)
+    finally:
+        c.stop()
+
+
+def test_consul_provider_not_in_catalog(server):
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        server.register_job(svc_job(job_id="legacy", provider="consul"))
+        assert wait(lambda: [
+            a for a in server.state.allocs_by_job("default", "legacy")
+            if not a.terminal_status()])
+        time.sleep(0.3)
+        assert server.state.services_by_name("default", "legacy-svc") == []
+    finally:
+        c.stop()
+
+
+def test_tag_union_in_catalog_listing(server):
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        server.register_job(svc_job(count=2, tags=("prod", "http")))
+        assert wait(lambda: len(server.state.services_by_name(
+            "default", "web-svc")) == 2)
+        names = server.service_names()
+        assert sorted(names[0]["tags"]) == ["http", "prod"]
+    finally:
+        c.stop()
+
+
+def test_services_survive_snapshot(server):
+    import json
+    from nomad_tpu.raft.fsm import dump_state, restore_state
+    from nomad_tpu.state import StateStore
+
+    c = SimClient(server, mock.node())
+    c.start()
+    try:
+        server.register_job(svc_job())
+        assert wait(lambda: server.state.services_by_name(
+            "default", "web-svc"))
+    finally:
+        c.stop()
+    blob = json.loads(json.dumps(dump_state(server.state)))
+    fresh = StateStore()
+    restore_state(fresh, blob)
+    assert fresh.services_by_name("default", "web-svc")
+
+
+def test_http_service_endpoints(server):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    c = SimClient(server, mock.node())
+    c.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        server.register_job(svc_job(tags=("v1",)))
+        assert wait(lambda: server.state.services_by_name(
+            "default", "web-svc"))
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        listing = api.services()
+        assert listing[0]["service_name"] == "web-svc"
+        regs = api.service("web-svc")
+        assert len(regs) == 1 and regs[0]["tags"] == ["v1"]
+        api.delete_service_registration("web-svc", regs[0]["id"])
+        assert api.service("web-svc") == []
+    finally:
+        http.shutdown()
+        c.stop()
+
+
+def test_full_client_registers_services(server, tmp_path):
+    """The full client agent (not SimClient) also drives registration."""
+    from nomad_tpu.client.client import Client, LocalServerConn
+
+    client = Client(LocalServerConn(server), str(tmp_path), name="svc-node")
+    client.start()
+    try:
+        job = svc_job(job_id="fullc")
+        job.task_groups[0].tasks[0].driver = "mock"
+        job.task_groups[0].tasks[0].config = {"run_for": "30s"}
+        server.register_job(job)
+        assert wait(lambda: server.state.services_by_name(
+            "default", "fullc-svc"), timeout=10)
+    finally:
+        client.shutdown()
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_full_client_reregisters_after_node_down_sweep(server, tmp_path):
+    """Node misses TTL -> down -> services swept; on reconnection the
+    client must re-register its running workloads' services."""
+    from nomad_tpu.client.client import Client, LocalServerConn
+
+    client = Client(LocalServerConn(server), str(tmp_path), name="flaky")
+    client.start()
+    try:
+        job = svc_job(job_id="comeback")
+        job.task_groups[0].tasks[0].config = {"run_for": "60s"}
+        server.register_job(job)
+        assert wait(lambda: server.state.services_by_name(
+            "default", "comeback-svc"), timeout=10)
+        client.freeze()
+        assert wait(lambda: not server.state.services_by_name(
+            "default", "comeback-svc"), timeout=10)
+        client.thaw()
+        assert wait(lambda: server.state.services_by_name(
+            "default", "comeback-svc"), timeout=10)
+    finally:
+        client.shutdown()
+
+
+def test_delete_services_by_node_single_sweep(server):
+    from nomad_tpu.structs import ServiceRegistration
+    for i in range(3):
+        server.state.upsert_service_registrations([ServiceRegistration(
+            id=f"r{i}", service_name="s", node_id="nodeA",
+            alloc_id=f"a{i}")])
+    server.state.upsert_service_registrations([ServiceRegistration(
+        id="other", service_name="s", node_id="nodeB", alloc_id="b0")])
+    server.state.delete_services_by_node("nodeA")
+    left = server.state.service_registrations()
+    assert [r.id for r in left] == ["other"]
+
+
+def test_wildcard_namespace_service_lookup(server):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.structs import Namespace, ServiceRegistration
+    server.upsert_namespace(Namespace(name="other"))
+    server.state.upsert_service_registrations([
+        ServiceRegistration(id="r1", service_name="api", namespace="default",
+                            alloc_id="a1"),
+        ServiceRegistration(id="r2", service_name="api", namespace="other",
+                            alloc_id="a2")])
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}", namespace="*")
+        regs = api.service("api")
+        assert sorted(r["namespace"] for r in regs) == ["default", "other"]
+    finally:
+        http.shutdown()
