@@ -279,8 +279,60 @@ class ApiClient:
     def metrics(self) -> dict:
         return self.get("/v1/metrics")
 
+    def event_stream(self, topics: Optional[List[str]] = None,
+                     index: int = 0):
+        """Generator over the live NDJSON event stream
+        (reference: api/event_stream.go). topics: ["Topic:Key", ...]."""
+        params = [("namespace", self.namespace), ("index", str(index))]
+        params += [("topic", t) for t in (topics or [])]
+        qs = urllib.parse.urlencode(params)
+        req = urllib.request.Request(
+            f"{self.address}/v1/event/stream?{qs}",
+            headers={**({"X-Nomad-Token": self.token}
+                        if self.token else {})})
+        resp = urllib.request.urlopen(req)
+        try:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue           # heartbeat
+                yield json.loads(line)
+        finally:
+            resp.close()
+
+    def request_raw(self, method: str, path: str,
+                    data: Optional[bytes] = None,
+                    content_type: str = "application/octet-stream"
+                    ) -> bytes:
+        """Binary-body variant of request() with the same header and
+        error-translation behavior."""
+        req = urllib.request.Request(
+            f"{self.address}{path}", method=method, data=data,
+            headers={**({"Content-Type": content_type}
+                        if data is not None else {}),
+                     **({"X-Nomad-Token": self.token}
+                        if self.token else {})})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:   # noqa: BLE001
+                detail = str(e)
+            raise ApiError(e.code, detail) from e
+
+    def snapshot_save(self) -> bytes:
+        """(reference: api/operator.go SnapshotSave)"""
+        return self.request_raw("GET", "/v1/operator/snapshot")
+
+    def snapshot_restore(self, data: bytes) -> dict:
+        return json.loads(
+            self.request_raw("POST", "/v1/operator/snapshot", data)
+            or b"null")
+
     def events(self, index: int = 0) -> List[dict]:
-        return self.get("/v1/event/stream", index=index)
+        return self.get("/v1/event/stream", index=index, poll="true")
 
 
 class HttpServerConn:

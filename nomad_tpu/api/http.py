@@ -246,6 +246,14 @@ class ApiHandler(BaseHTTPRequestHandler):
                 # resource-namespace check still runs after fetch
                 if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
                     return
+            elif parts[:2] in (["v1", "services"], ["v1", "service"]):
+                # pre-gate before the blocking wait (like the list
+                # endpoints above); exact per-object checks run after
+                allowed = (acl.allow_any_namespace(CAP_READ_JOB)
+                           if ns == "*" else
+                           acl.allow_namespace_op(ns, CAP_READ_JOB))
+                if not self._check(allowed):
+                    return
             elif parts[:2] == ["v1", "scaling"]:
                 from ..acl import CAP_LIST_SCALING_POLICIES
                 allowed = (acl.allow_any_namespace(CAP_LIST_SCALING_POLICIES)
@@ -256,6 +264,11 @@ class ApiHandler(BaseHTTPRequestHandler):
             elif parts == ["v1", "event", "stream"]:
                 if not self._check(acl.allow_any_namespace(CAP_READ_JOB)):
                     return
+                if q.get("poll", ["false"])[0] != "true":
+                    # live stream: ?index is the replay point, NOT a
+                    # blocking-query parameter -- dispatch immediately
+                    return self._stream_events(
+                        q, int(q.get("index", ["0"])[0]))
             elif parts[:2] == ["v1", "agent"] and parts[2:3] != ["health"]:
                 if not self._check(acl.allow_agent_read()):
                     return
@@ -475,8 +488,23 @@ class ApiHandler(BaseHTTPRequestHandler):
                                             for a in allocs],
                                  "index": index}, index)
             elif parts == ["v1", "event", "stream"]:
+                # polling mode (stream mode dispatched before _blocking)
                 since = int(q.get("index", ["0"])[0])
                 self._send(200, self.nomad.events_since(since), index)
+            elif parts == ["v1", "operator", "snapshot"]:
+                # the archive contains ACL token secrets + root keys:
+                # management only (reference: operator_endpoint.go
+                # SnapshotSave requires IsManagement)
+                if not self._check(acl.is_management()):
+                    return
+                data = self.nomad.snapshot_save()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             elif parts == ["v1", "metrics"]:
                 self._send(200, self._metrics())
             else:
@@ -733,6 +761,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, {"registered": True})
             elif parts == ["v1", "system", "gc"]:
                 self._send(200, self.nomad.run_gc_once())
+            elif parts == ["v1", "operator", "snapshot"]:
+                # restoring installs arbitrary ACL state: management only
+                if not self._check(acl.is_management()):
+                    return
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    meta = self.nomad.snapshot_restore(raw)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"restored": True, "index": meta["index"]})
             elif parts == ["v1", "operator", "keyring", "rotate"]:
                 key = self.nomad.encrypter.rotate()
                 self._send(200, {"key_id": key.key_id})
@@ -945,6 +984,44 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._send(200, token)
         else:
             self._error(404, "unknown acl path")
+
+    def _stream_events(self, q, since: int) -> None:
+        """Chunked NDJSON event stream with topic filters (reference:
+        command/agent/event_endpoint.go + nomad/stream/ndjson.go).
+        ?topic=Topic:Key repeatable; heartbeat {} every 10s."""
+        topics: dict = {}
+        for t in q.get("topic", []):
+            name, _, key = t.partition(":")
+            topics.setdefault(name or "*", []).append(key or "*")
+        sub = self.nomad.subscribe_events(topics or None, since)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(payload: bytes) -> None:
+                self.wfile.write(f"{len(payload):x}\r\n".encode())
+                self.wfile.write(payload + b"\r\n")
+                self.wfile.flush()
+
+            last_beat = time.time()
+            while True:
+                event = sub.next(timeout=0.5)
+                if event is not None:
+                    chunk(json.dumps(to_jsonable(event)).encode() + b"\n")
+                elif time.time() - last_beat >= 10.0:
+                    chunk(b"{}\n")           # heartbeat frame
+                    last_beat = time.time()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            sub.closed = True
+            self.nomad.unsubscribe_events(sub)
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
 
     def _allowed_search_contexts(self, acl, ns: str):
         """Token-capability filter over searchable contexts (reference:

@@ -398,6 +398,20 @@ def cmd_node_pool(args) -> int:
     return 0
 
 
+def cmd_operator_snapshot(args) -> int:
+    api = _client(args)
+    if args.sub2 == "save":
+        data = api.snapshot_save()
+        with open(args.file, "wb") as f:
+            f.write(data)
+        print(f"Snapshot written to {args.file} ({len(data)} bytes)")
+    elif args.sub2 == "restore":
+        with open(args.file, "rb") as f:
+            reply = api.snapshot_restore(f.read())
+        print(f"Snapshot restored (index {reply.get('index')})")
+    return 0
+
+
 def cmd_service(args) -> int:
     api = _client(args)
     if args.sub2 == "list":
@@ -566,6 +580,14 @@ def build_parser() -> argparse.ArgumentParser:
     osch.add_argument("-memory-oversubscription", dest="memory_oversub",
                       action="store_true")
     osch.set_defaults(fn=cmd_operator_scheduler)
+    osn = op.add_parser("snapshot").add_subparsers(dest="sub2",
+                                                   required=True)
+    osns = osn.add_parser("save")
+    osns.add_argument("file")
+    osns.set_defaults(fn=cmd_operator_snapshot)
+    osnr = osn.add_parser("restore")
+    osnr.add_argument("file")
+    osnr.set_defaults(fn=cmd_operator_snapshot)
     okr = op.add_parser("keyring").add_subparsers(dest="sub2",
                                                   required=True)
     okr.add_parser("list").set_defaults(fn=cmd_operator_keyring)

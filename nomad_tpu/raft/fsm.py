@@ -53,7 +53,9 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "upsert_service_registrations": [List[ServiceRegistration]],
     "delete_service_registrations": [List[str]],
     "delete_services_by_alloc": [str],
+    "delete_services_by_allocs": [List[str]],
     "delete_services_by_node": [str],
+    "restore_from_snapshot": [Any],
     "set_scheduler_config": [SchedulerConfiguration],
     "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
     "upsert_acl_policies": [List[ACLPolicy]],
@@ -158,6 +160,31 @@ def restore_state(store: StateStore, blob: dict) -> None:
                  for k in blob.get("root_keys", [])]
     variables = [codec.decode(VariableEncrypted, v)
                  for v in blob.get("variables", [])]
+    # decode EVERYTHING before touching the store, so a malformed blob
+    # raises here and leaves state untouched (restore must be atomic)
+    job_versions = {}
+    for k, v in blob.get("job_versions", {}).items():
+        ns, jid, ver = k.split("\x1f")
+        job_versions[(ns, jid, int(ver))] = codec.decode(Job, v)
+    scaling_policies = {
+        pol.id: pol for pol in
+        (codec.decode(ScalingPolicy, raw)
+         for raw in blob.get("scaling_policies", []))}
+    scaling_events = {}
+    for k, evs in blob.get("scaling_events", {}).items():
+        ns, jid = k.split("\x1f")
+        scaling_events[(ns, jid)] = [
+            codec.decode(ScalingEvent, e) for e in evs]
+    restored_ns = [codec.decode(Namespace, n)
+                   for n in blob.get("namespaces", [])]
+    csi_volumes = {
+        (v.namespace, v.id): v for v in
+        (codec.decode(CSIVolume, raw)
+         for raw in blob.get("csi_volumes", []))}
+    services = {
+        svc.id: svc for svc in
+        (codec.decode(ServiceRegistration, raw)
+         for raw in blob.get("services", []))}
     with store._lock:
         store._root_keys = {k.key_id: k for k in root_keys}
         store._variables = {(v.meta.namespace, v.meta.path): v
@@ -169,10 +196,7 @@ def restore_state(store: StateStore, blob: dict) -> None:
         store._acl_bootstrapped = blob.get("acl_bootstrapped", False)
         store._nodes = {n.id: n for n in nodes}
         store._jobs = {(j.namespace, j.id): j for j in jobs}
-        store._job_versions = {}
-        for k, v in blob.get("job_versions", {}).items():
-            ns, jid, ver = k.split("\x1f")
-            store._job_versions[(ns, jid, int(ver))] = codec.decode(Job, v)
+        store._job_versions = job_versions
         store._evals = {e.id: e for e in evals}
         store._allocs = {a.id: a for a in allocs}
         store._deployments = {d.id: d for d in deployments}
@@ -192,29 +216,16 @@ def restore_state(store: StateStore, blob: dict) -> None:
             if stored is not None and a.job is not None and \
                     a.job.version == stored.version:
                 a.job = stored
-        store._scaling_policies = {
-            p.id: p for p in
-            (codec.decode(ScalingPolicy, raw)
-             for raw in blob.get("scaling_policies", []))}
-        store._scaling_events = {}
-        for k, evs in blob.get("scaling_events", {}).items():
-            ns, jid = k.split("\x1f")
-            store._scaling_events[(ns, jid)] = [
-                codec.decode(ScalingEvent, e) for e in evs]
-        restored_ns = [codec.decode(Namespace, n)
-                       for n in blob.get("namespaces", [])]
+        store._scaling_policies = scaling_policies
+        store._scaling_events = scaling_events
         if restored_ns:
             store._namespaces = {n.name: n for n in restored_ns}
+        else:
+            store._namespaces = {"default": Namespace(name="default")}
         store._namespaces.setdefault("default", Namespace(name="default"))
-        store._csi_volumes = {
-            (v.namespace, v.id): v for v in
-            (codec.decode(CSIVolume, raw)
-             for raw in blob.get("csi_volumes", []))}
+        store._csi_volumes = csi_volumes
         store._recompute_csi_plugins_locked()
-        store._services = {
-            s.id: s for s in
-            (codec.decode(ServiceRegistration, raw)
-             for raw in blob.get("services", []))}
+        store._services = services
         store._index = blob.get("index", 1)
         ti = blob.get("table_index", {})
         for t in store._table_index:

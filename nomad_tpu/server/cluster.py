@@ -127,8 +127,14 @@ class RaftBackedStateStore:
     def delete_services_by_alloc(self, alloc_id):
         return self._propose("delete_services_by_alloc", alloc_id)
 
+    def delete_services_by_allocs(self, alloc_ids):
+        return self._propose("delete_services_by_allocs", alloc_ids)
+
     def delete_services_by_node(self, node_id):
         return self._propose("delete_services_by_node", node_id)
+
+    def restore_from_snapshot(self, blob):
+        return self._propose("restore_from_snapshot", blob)
 
     def set_scheduler_config(self, cfg):
         return self._propose("set_scheduler_config", cfg)

@@ -37,6 +37,46 @@ GC_EVAL_THRESHOLD = 3600.0
 GC_INTERVAL = 60.0
 
 
+class EventSubscription:
+    """One consumer's filtered live event queue (reference:
+    nomad/stream/event_broker.go Subscription)."""
+
+    MAX_PENDING = 1024
+
+    def __init__(self, topics: Optional[Dict[str, List[str]]] = None):
+        import queue
+        self.topics = topics or {"*": ["*"]}
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.MAX_PENDING)
+        self.closed = False
+
+    def matches(self, event: dict) -> bool:
+        for topic, keys in self.topics.items():
+            if topic not in ("*", event["topic"]):
+                continue
+            if not keys or "*" in keys or event.get("key") in keys:
+                return True
+        return False
+
+    def offer(self, event: dict) -> None:
+        if self.closed or not self.matches(event):
+            return
+        try:
+            self._q.put_nowait(event)
+        except Exception:   # noqa: BLE001 -- slow consumer: drop oldest
+            try:
+                self._q.get_nowait()
+                self._q.put_nowait(event)
+            except Exception:   # noqa: BLE001
+                pass
+
+    def next(self, timeout: float = 1.0) -> Optional[dict]:
+        import queue
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
 class Server:
     """(reference: nomad/server.go:105 Server)"""
 
@@ -63,6 +103,7 @@ class Server:
         self._threads: List[threading.Thread] = []
         self._events: List[dict] = []
         self._events_lock = threading.Lock()
+        self._event_subs: List["EventSubscription"] = []
         self._periodic_last: Dict[tuple, float] = {}
         self._leader_active = threading.Event()
         self._leader_lock = threading.Lock()
@@ -661,11 +702,12 @@ class Server:
     def update_allocs_from_client(self, allocs: List[Allocation]) -> None:
         """(reference: node_endpoint.go:1322 UpdateAlloc)"""
         self.state.update_allocs_from_client(allocs)
-        # terminal allocs leave the service catalog (reference: the state
-        # store deletes service registrations in UpdateAllocsFromClient)
-        for a in allocs:
-            if a.client_terminal_status():
-                self.state.delete_services_by_alloc(a.id)
+        # terminal allocs leave the service catalog in ONE replicated
+        # write (reference: the state store deletes service registrations
+        # in UpdateAllocsFromClient)
+        terminal = [a.id for a in allocs if a.client_terminal_status()]
+        if terminal:
+            self.state.delete_services_by_allocs(terminal)
         # allocs going terminal can complete the job
         for key in {(a.namespace, a.job_id) for a in allocs}:
             self._refresh_job_status(*key)
@@ -856,18 +898,77 @@ class Server:
             text, context, namespace, allowed_contexts)
 
     # ------------------------------------------------------------------
-    # Event stream (reference: nomad/stream/event_broker.go)
+    # Operator snapshot (reference: nomad/operator_endpoint.go
+    # SnapshotSave/SnapshotRestore + helper/snapshot/)
+    def snapshot_save(self) -> bytes:
+        from ..raft.fsm import dump_state
+        from .snapshot import save_archive
+        real = getattr(self.state, "_store", self.state)
+        blob = dump_state(real)
+        return save_archive(blob, blob.get("index", 0))
+
+    def snapshot_restore(self, data: bytes) -> dict:
+        """Verify + install an archive, then rebuild leader-side volatile
+        state from the restored tables (reference: the leader restores the
+        raft snapshot and re-establishes leadership services)."""
+        from .snapshot import load_archive
+        meta, blob = load_archive(data)
+        was_leader = self.is_leader()
+        if was_leader:
+            self.revoke_leadership()
+        self.state.restore_from_snapshot(blob)
+        if was_leader:
+            self.establish_leadership()
+        self.publish_event("SnapshotRestored", {"index": meta["index"]})
+        return meta
+
+    # ------------------------------------------------------------------
+    # Event stream (reference: nomad/stream/event_broker.go EventBroker --
+    # ring buffer + per-subscription queues with topic filters)
+    @staticmethod
+    def _event_key(payload: dict) -> str:
+        for k in ("job_id", "node_id", "eval_id", "volume_id",
+                  "dispatched_id", "name"):
+            if payload.get(k):
+                return str(payload[k])
+        return ""
+
     def publish_event(self, topic: str, payload: dict) -> None:
+        event = {"topic": topic, "key": self._event_key(payload),
+                 "index": self.state.latest_index(),
+                 "time": time.time(), "payload": payload}
         with self._events_lock:
-            self._events.append({
-                "topic": topic, "index": self.state.latest_index(),
-                "time": time.time(), "payload": payload})
+            self._events.append(event)
             if len(self._events) > 4096:     # ring buffer semantics
                 self._events = self._events[-2048:]
+            subs = list(self._event_subs)
+        for sub in subs:
+            sub.offer(event)
 
     def events_since(self, index: int) -> List[dict]:
         with self._events_lock:
             return [e for e in self._events if e["index"] > index]
+
+    def subscribe_events(self, topics: Optional[Dict[str, List[str]]] = None,
+                         since_index: int = 0) -> "EventSubscription":
+        """topics: {topic-or-*: [keys-or-*]} (reference: stream
+        SubscribeRequest.Topics). Replays the ring buffer from
+        since_index, then live."""
+        sub = EventSubscription(topics)
+        # backlog + registration under ONE lock acquisition, else an event
+        # published in between lands in neither (lost-event gap)
+        with self._events_lock:
+            backlog = ([e for e in self._events if e["index"] > since_index]
+                       if since_index else [])
+            self._event_subs.append(sub)
+        for e in backlog:
+            sub.offer(e)
+        return sub
+
+    def unsubscribe_events(self, sub: "EventSubscription") -> None:
+        with self._events_lock:
+            if sub in self._event_subs:
+                self._event_subs.remove(sub)
 
     # ------------------------------------------------------------------
     # Background loops

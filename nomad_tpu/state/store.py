@@ -799,12 +799,30 @@ class StateStore:
     def delete_services_by_alloc(self, alloc_id: str) -> int:
         """All of one alloc's registrations at once (reference:
         DeleteServiceRegistrationByAllocID, the client-restart sweep)."""
+        return self.delete_services_by_allocs([alloc_id])
+
+    def delete_services_by_allocs(self, alloc_ids: List[str]) -> int:
+        """Batch sweep: one pass, one index bump, one raft entry."""
         with self._lock:
+            ids = set(alloc_ids)
             gone = [rid for rid, r in self._services.items()
-                    if r.alloc_id == alloc_id]
+                    if r.alloc_id in ids]
             for rid in gone:
                 del self._services[rid]
             return self._bump("services") if gone else self._index
+
+    def restore_from_snapshot(self, blob: dict) -> int:
+        """Atomically replace ALL state with a snapshot's contents; a
+        replicated write so every peer swaps identically (reference: raft
+        snapshot install -> FSM Restore)."""
+        from ..raft.fsm import restore_state
+        with self._lock:
+            prior = self._index
+            restore_state(self, blob)
+            # indexes must stay monotonic for blocking-query watchers even
+            # when restoring an older snapshot
+            self._index = max(self._index, prior)
+            return self._bump(*TABLES)
 
     def delete_services_by_node(self, node_id: str) -> int:
         """One-pass sweep of a dead node's registrations (reference:

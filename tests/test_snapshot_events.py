@@ -1,0 +1,305 @@
+"""Operator snapshot save/restore + streaming event broker
+(reference analogs: helper/snapshot/snapshot.go, nomad/operator_endpoint.go
+SnapshotSave/Restore, nomad/stream/event_broker.go + ndjson.go)."""
+import json
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.snapshot import load_archive, save_archive
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1, heartbeat_ttl=5.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+# -- snapshot archive format -------------------------------------------------
+
+def test_archive_roundtrip():
+    blob = {"index": 42, "jobs": [{"id": "x"}]}
+    data = save_archive(blob, 42)
+    meta, restored = load_archive(data)
+    assert restored == blob
+    assert meta["index"] == 42
+
+
+def test_archive_detects_corruption():
+    data = bytearray(save_archive({"index": 1}, 1))
+    import gzip
+    framed = bytearray(gzip.decompress(bytes(data)))
+    framed[-3] ^= 0xFF                      # flip a payload byte
+    with pytest.raises(ValueError, match="checksum"):
+        load_archive(gzip.compress(bytes(framed)))
+    with pytest.raises(ValueError):
+        load_archive(b"not an archive")
+
+
+# -- server save/restore -----------------------------------------------------
+
+def test_snapshot_save_restore_roundtrip(server):
+    job = mock.job(id="snapjob")
+    server.register_job(job)
+    node = mock.node()
+    server.register_node(node)
+    data = server.snapshot_save()
+
+    # wipe: restore into a FRESH server
+    other = Server(num_workers=1)
+    other.start()
+    try:
+        meta = other.snapshot_restore(data)
+        assert meta["index"] > 0
+        assert other.state.job_by_id("default", "snapjob") is not None
+        assert other.state.node_by_id(node.id) is not None
+    finally:
+        other.shutdown()
+
+
+def test_snapshot_restore_reinitializes_leadership(server):
+    """Evals pending in the snapshot must re-enter the broker."""
+    from nomad_tpu.structs import EVAL_STATUS_PENDING, Evaluation, generate_uuid
+    server.register_job(mock.job(id="j1"))
+    ev = Evaluation(id=generate_uuid(), namespace="default", priority=50,
+                    type="service", job_id="j1",
+                    status=EVAL_STATUS_PENDING, triggered_by="test")
+    server.state.upsert_evals([ev])
+    data = server.snapshot_save()
+
+    other = Server(num_workers=1)
+    other.start()
+    try:
+        other.snapshot_restore(data)
+        # the restored eval re-enters the broker and gets processed
+        # (no nodes -> it parks as blocked or completes)
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            stored = other.state.eval_by_id(ev.id)
+            if stored is not None and stored.status != "pending":
+                break
+            if other.blocked_evals.stats()["total_blocked"]:
+                break
+            time.sleep(0.05)
+        stored = other.state.eval_by_id(ev.id)
+        assert (stored is not None and stored.status != "pending") or \
+            other.blocked_evals.stats()["total_blocked"]
+    finally:
+        other.shutdown()
+
+
+def test_snapshot_restore_rejects_garbage(server):
+    with pytest.raises(ValueError):
+        server.snapshot_restore(b"garbage")
+
+
+def test_raft_cluster_snapshot_restore():
+    from nomad_tpu.server.cluster import make_cluster, wait_for_leader
+
+    servers = make_cluster(3)
+    try:
+        leader = wait_for_leader(servers)
+        leader.register_job(mock.job(id="replicated-snap"))
+        data = leader.snapshot_save()
+        # wipe the job, then restore the snapshot cluster-wide
+        leader.state.delete_job("default", "replicated-snap")
+        leader.snapshot_restore(data)
+
+        def converged():
+            return all(
+                s.store.job_by_id("default", "replicated-snap") is not None
+                for s in servers)
+        deadline = time.time() + 10
+        while time.time() < deadline and not converged():
+            time.sleep(0.1)
+        assert converged()
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+# -- event broker subscriptions ----------------------------------------------
+
+def test_subscription_topic_filters(server):
+    sub_all = server.subscribe_events()
+    sub_jobs = server.subscribe_events({"JobRegistered": ["*"]})
+    sub_keyed = server.subscribe_events({"JobRegistered": ["target"]})
+    server.register_job(mock.job(id="target"))
+    server.register_job(mock.job(id="other"))
+    server.register_node(mock.node())
+
+    def drain(sub):
+        out = []
+        while True:
+            e = sub.next(timeout=0.2)
+            if e is None:
+                return out
+            out.append(e)
+
+    all_topics = {e["topic"] for e in drain(sub_all)}
+    assert "JobRegistered" in all_topics and "NodeRegistered" in all_topics
+    jobs = drain(sub_jobs)
+    assert {e["topic"] for e in jobs} == {"JobRegistered"}
+    assert len(jobs) == 2
+    keyed = drain(sub_keyed)
+    assert [e["key"] for e in keyed] == ["target"]
+    for s in (sub_all, sub_jobs, sub_keyed):
+        server.unsubscribe_events(s)
+
+
+def test_subscription_replay_from_index(server):
+    server.register_job(mock.job(id="early"))
+    idx = server.state.latest_index()
+    server.register_job(mock.job(id="late"))
+    sub = server.subscribe_events({"JobRegistered": ["*"]}, since_index=idx)
+    got = []
+    while True:
+        e = sub.next(timeout=0.2)
+        if e is None:
+            break
+        got.append(e["key"])
+    assert got == ["late"]
+    server.unsubscribe_events(sub)
+
+
+def test_http_ndjson_stream(server):
+    """Live chunked NDJSON with topic filter over real HTTP."""
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        got = []
+        done = threading.Event()
+
+        def consume():
+            for event in api.event_stream(topics=["JobRegistered:*"]):
+                got.append(event)
+                if len(got) >= 2:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)          # let the subscription attach
+        server.register_job(mock.job(id="s1"))
+        server.register_node(mock.node())     # filtered out
+        server.register_job(mock.job(id="s2"))
+        assert done.wait(timeout=8), f"only got {got}"
+        assert [e["key"] for e in got] == ["s1", "s2"]
+        assert all(e["topic"] == "JobRegistered" for e in got)
+    finally:
+        http.shutdown()
+
+
+def test_http_snapshot_endpoints(server):
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.api.http import HttpServer
+    server.register_job(mock.job(id="httpjob"))
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        data = api.snapshot_save()
+        assert len(data) > 100
+        server.state.delete_job("default", "httpjob")
+        reply = api.snapshot_restore(data)
+        assert reply["restored"] is True
+        assert server.state.job_by_id("default", "httpjob") is not None
+        with pytest.raises(ApiError) as err:
+            api.snapshot_restore(b"junk")
+        assert err.value.status == 400
+    finally:
+        http.shutdown()
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_snapshot_requires_management_token(server):
+    """The archive carries ACL secrets: operator read/write is NOT enough."""
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.acl import parse_policy
+    from nomad_tpu.structs import ACLPolicy, ACLToken
+
+    server.acl_enabled = True
+    boot = server.bootstrap_acl()
+    server.state.upsert_acl_policies([ACLPolicy(
+        name="oper", rules='operator { policy = "write" }')])
+    token = ACLToken.new(name="op", type="client", policies=["oper"])
+    server.state.upsert_acl_tokens([token])
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        addr = f"http://127.0.0.1:{http.port}"
+        op_api = ApiClient(addr, token=token.secret_id)
+        with pytest.raises(ApiError) as err:
+            op_api.snapshot_save()
+        assert err.value.status == 403
+        with pytest.raises(ApiError) as err:
+            op_api.snapshot_restore(b"anything")
+        assert err.value.status == 403
+        mgmt_api = ApiClient(addr, token=boot.secret_id)
+        assert len(mgmt_api.snapshot_save()) > 100
+    finally:
+        http.shutdown()
+        server.acl_enabled = False
+
+
+def test_restore_atomic_on_malformed_blob(server):
+    """A checksum-valid archive with undecodable content must leave the
+    store untouched (regression: partial restore)."""
+    from nomad_tpu.raft.fsm import dump_state
+    from nomad_tpu.server.snapshot import save_archive
+
+    server.register_job(mock.job(id="survivor"))
+    blob = dump_state(server.state)
+    blob["job_versions"] = {"no-separators-here": {}}   # undecodable
+    bad = save_archive(blob, blob["index"])
+    with pytest.raises(Exception):
+        server.snapshot_restore(bad)
+    # prior state fully intact
+    assert server.state.job_by_id("default", "survivor") is not None
+
+
+def test_no_event_lost_between_backlog_and_subscribe(server):
+    """Subscribe with replay while events are published concurrently:
+    every JobRegistered key must arrive exactly once."""
+    stop = threading.Event()
+    keys = [f"race-{i}" for i in range(50)]
+
+    def publisher():
+        for k in keys:
+            server.publish_event("JobRegistered", {"job_id": k})
+        stop.set()
+
+    t = threading.Thread(target=publisher)
+    t.start()
+    sub = server.subscribe_events({"JobRegistered": ["*"]}, since_index=1)
+    t.join()
+    got = set()
+    while True:
+        e = sub.next(timeout=0.3)
+        if e is None:
+            break
+        if e["key"].startswith("race-"):
+            got.add(e["key"])
+    server.unsubscribe_events(sub)
+    assert got == set(keys)
+
+
+def test_batch_service_sweep(server):
+    from nomad_tpu.structs import ServiceRegistration
+    server.state.upsert_service_registrations([
+        ServiceRegistration(id=f"r{i}", service_name="s",
+                            alloc_id=f"a{i % 2}") for i in range(4)])
+    before = server.state.latest_index()
+    server.state.delete_services_by_allocs(["a0", "a1"])
+    assert server.state.service_registrations() == []
+    assert server.state.latest_index() == before + 1   # ONE bump
