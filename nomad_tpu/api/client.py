@@ -43,6 +43,20 @@ class ApiClient:
         qs = urllib.parse.urlencode(params)
         return f"{self.address}{path}?{qs}"
 
+    def _do(self, req: urllib.request.Request,
+            timeout: Optional[float] = None) -> bytes:
+        """Shared urlopen + HTTPError->ApiError translation."""
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:   # noqa: BLE001
+                detail = str(e)
+            raise ApiError(e.code, detail) from e
+
     def request(self, method: str, path: str,
                 body: Optional[dict] = None,
                 params: Optional[Dict[str, Any]] = None,
@@ -53,16 +67,7 @@ class ApiClient:
             headers={"Content-Type": "application/json",
                      **({"X-Nomad-Token": self.token}
                         if self.token else {})})
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as e:
-            try:
-                detail = json.loads(e.read()).get("error", str(e))
-            except Exception:   # noqa: BLE001
-                detail = str(e)
-            raise ApiError(e.code, detail) from e
+        return json.loads(self._do(req, timeout) or b"null")
 
     def get(self, path: str, **params) -> Any:
         return self.request("GET", path, params=params)
@@ -183,6 +188,32 @@ class ApiClient:
 
     def delete_node_pool(self, name: str) -> dict:
         return self.delete(f"/v1/node/pool/{name}")
+
+    # -- client fs/logs/stats (reference: api/fs.go, api/nodes.go) -----
+    def fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:
+        return self.request("GET", f"/v1/client/fs/ls/{alloc_id}",
+                            params={"path": path})
+
+    def fs_stat(self, alloc_id: str, path: str) -> dict:
+        return self.request("GET", f"/v1/client/fs/stat/{alloc_id}",
+                            params={"path": path})
+
+    def fs_cat(self, alloc_id: str, path: str) -> bytes:
+        qs = urllib.parse.urlencode({"path": path,
+                                     "namespace": self.namespace})
+        return self.request_raw(
+            "GET", f"/v1/client/fs/cat/{alloc_id}?{qs}")
+
+    def alloc_logs(self, alloc_id: str, task: str,
+                   log_type: str = "stdout", offset: int = 0) -> bytes:
+        qs = urllib.parse.urlencode({"type": log_type,
+                                     "offset": str(offset),
+                                     "namespace": self.namespace})
+        return self.request_raw(
+            "GET", f"/v1/client/fs/logs/{alloc_id}/{task}?{qs}")
+
+    def client_stats(self, node_id: str = "") -> dict:
+        return self.get("/v1/client/stats", node_id=node_id)
 
     # -- native service discovery (reference: api/services.go) ---------
     def services(self) -> List[dict]:
@@ -312,15 +343,7 @@ class ApiClient:
                         if data is not None else {}),
                      **({"X-Nomad-Token": self.token}
                         if self.token else {})})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            try:
-                detail = json.loads(e.read()).get("error", str(e))
-            except Exception:   # noqa: BLE001
-                detail = str(e)
-            raise ApiError(e.code, detail) from e
+        return self._do(req)
 
     def snapshot_save(self) -> bytes:
         """(reference: api/operator.go SnapshotSave)"""

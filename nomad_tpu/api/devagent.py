@@ -22,6 +22,12 @@ def main(argv=None) -> int:
     parser.add_argument("--acl", action="store_true",
                         help="enable ACL enforcement (bootstrap via "
                              "POST /v1/acl/bootstrap)")
+    parser.add_argument("--real-clients", action="store_true",
+                        help="run full client agents with allocdirs "
+                             "(enables /v1/client/fs endpoints)")
+    parser.add_argument("--data-dir", default="",
+                        help="client data dir (with --real-clients; "
+                             "default: a temp dir)")
     args = parser.parse_args(argv)
 
     from .. import mock
@@ -37,12 +43,25 @@ def main(argv=None) -> int:
     server.start()
 
     clients = []
-    for _ in range(args.nodes):
-        c = SimClient(server, mock.node())
-        c.start()
-        clients.append(c)
+    if args.real_clients:
+        import os
+        import tempfile
+        from ..client.client import Client, LocalServerConn
+        base = args.data_dir or tempfile.mkdtemp(prefix="nomad-tpu-dev-")
+        for i in range(args.nodes):
+            c = Client(LocalServerConn(server),
+                       os.path.join(base, f"client{i}"),
+                       name=f"dev-client-{i}")
+            c.start()
+            clients.append(c)
+    else:
+        for _ in range(args.nodes):
+            c = SimClient(server, mock.node())
+            c.start()
+            clients.append(c)
 
-    http = HttpServer(server, port=args.port)
+    http = HttpServer(server, port=args.port,
+                      clients=clients if args.real_clients else None)
     http.start()
     print(f"==> nomad-tpu dev agent: http://127.0.0.1:{http.port} "
           f"({args.nodes} simulated nodes, "
@@ -57,7 +76,7 @@ def main(argv=None) -> int:
     finally:
         http.shutdown()
         for c in clients:
-            c.stop()
+            (c.stop if hasattr(c, "stop") else c.shutdown)()
         server.shutdown()
     return 0
 

@@ -140,6 +140,16 @@ class ApiHandler(BaseHTTPRequestHandler):
     def nomad(self):
         return self.server.nomad_server
 
+    def _client_for_alloc(self, alloc_id: str):
+        """-> (client, alloc) serving the alloc's fs, or (None, alloc)."""
+        alloc = self.nomad.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None, None
+        for c in getattr(self.server, "local_clients", []):
+            if c.node.id == alloc.node_id:
+                return c, alloc
+        return None, alloc
+
     # ------------------------------------------------------------------
     def _send(self, code: int, payload, index: Optional[int] = None) -> None:
         body = json.dumps(to_jsonable(payload)).encode()
@@ -361,6 +371,85 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, [d for d in state.deployments()
                                  if acl.allow_namespace_op(
                                      d.namespace, CAP_READ_JOB)], index)
+            elif parts[:3] == ["v1", "client", "fs"] and len(parts) == 5:
+                # /v1/client/fs/{ls|cat|readat|stat}/:alloc (reference:
+                # command/agent/fs_endpoint.go over client forwarding)
+                from ..acl import CAP_READ_FS
+                op, alloc_id = parts[3], parts[4]
+                client, alloc = self._client_for_alloc(alloc_id)
+                if alloc is None:
+                    return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(
+                        alloc.namespace, CAP_READ_FS)):
+                    return
+                if client is None:
+                    return self._error(
+                        501, "alloc's node is not served by this agent")
+                path = q.get("path", ["/"])[0]
+                try:
+                    if op == "ls":
+                        return self._send(200, client.fs_list(alloc_id,
+                                                              path))
+                    if op == "stat":
+                        return self._send(200, client.fs_stat(alloc_id,
+                                                              path))
+                    if op in ("cat", "readat"):
+                        offset = int(q.get("offset", ["0"])[0])
+                        limit = int(q.get("limit", [str(1 << 20)])[0])
+                        data = client.fs_read(alloc_id, path, offset,
+                                              limit)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
+                    return self._error(404, f"unknown fs op {op}")
+                except KeyError as e:
+                    return self._error(404, str(e))
+                except PermissionError as e:
+                    return self._error(403, str(e))
+                except (OSError, ValueError) as e:
+                    return self._error(400, str(e))
+            elif parts[:3] == ["v1", "client", "fs"] and len(parts) == 6 \
+                    and parts[3] == "logs":
+                from ..acl import CAP_READ_LOGS
+                alloc_id, task = parts[4], parts[5]
+                client, alloc = self._client_for_alloc(alloc_id)
+                if alloc is None:
+                    return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(
+                        alloc.namespace, CAP_READ_LOGS)):
+                    return
+                if client is None:
+                    return self._error(
+                        501, "alloc's node is not served by this agent")
+                try:
+                    data = client.fs_logs(
+                        alloc_id, task,
+                        q.get("type", ["stdout"])[0],
+                        int(q.get("offset", ["0"])[0]),
+                        int(q.get("limit", [str(1 << 20)])[0]))
+                except KeyError as e:
+                    return self._error(404, str(e))
+                except (OSError, ValueError, PermissionError) as e:
+                    return self._error(400, str(e))
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            elif parts == ["v1", "client", "stats"]:
+                if not self._check(acl.allow_node_read()):
+                    return
+                node_id = q.get("node_id", [""])[0]
+                for c in getattr(self.server, "local_clients", []):
+                    if not node_id or c.node.id == node_id:
+                        return self._send(200, c.client_stats())
+                return self._error(
+                    501, "no matching client served by this agent")
             elif parts == ["v1", "services"]:
                 if not self._check(acl.allow_any_namespace(CAP_READ_JOB)
                                    if ns == "*" else
@@ -1095,13 +1184,20 @@ class ApiHandler(BaseHTTPRequestHandler):
 
 
 class HttpServer:
-    """(reference: command/agent/http.go:179)"""
+    """(reference: command/agent/http.go:179). `clients` are in-process
+    client agents whose allocdirs back the /v1/client/fs endpoints (the
+    reference reaches them via server->client RPC forwarding)."""
 
-    def __init__(self, nomad_server, host: str = "127.0.0.1", port: int = 4646):
+    def __init__(self, nomad_server, host: str = "127.0.0.1",
+                 port: int = 4646, clients=None):
         self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
         self.httpd.nomad_server = nomad_server
+        self.httpd.local_clients = list(clients or [])
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def add_client(self, client) -> None:
+        self.httpd.local_clients.append(client)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,

@@ -229,6 +229,34 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_fs(args) -> int:
+    api = _client(args)
+    path = args.path or "/"
+    st = api.fs_stat(args.id, path)
+    if st["is_dir"]:
+        entries = api.fs_list(args.id, path)
+        print(_fmt_table(
+            [[("d" if e["is_dir"] else "-"), str(e["size"]), e["name"]]
+             for e in entries],
+            ["Mode", "Size", "Name"]))
+    else:
+        sys.stdout.buffer.write(api.fs_cat(args.id, path))
+    return 0
+
+
+def cmd_alloc_logs(args) -> int:
+    data = _client(args).alloc_logs(
+        args.id, args.task, "stderr" if args.stderr else "stdout")
+    sys.stdout.buffer.write(data)
+    return 0
+
+
+def cmd_node_stats(args) -> int:
+    stats = _client(args).client_stats(args.id)
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
 def cmd_eval(args) -> int:
     api = _client(args)
     if args.id:
@@ -546,6 +574,9 @@ def build_parser() -> argparse.ArgumentParser:
     ns = node.add_parser("status")
     ns.add_argument("id", nargs="?", default="")
     ns.set_defaults(fn=cmd_node_status)
+    nst = node.add_parser("stats")
+    nst.add_argument("id", nargs="?", default="")
+    nst.set_defaults(fn=cmd_node_stats)
     nd = node.add_parser("drain")
     nd.add_argument("id")
     g = nd.add_mutually_exclusive_group(required=True)
@@ -565,6 +596,15 @@ def build_parser() -> argparse.ArgumentParser:
     als = al.add_parser("status")
     als.add_argument("id")
     als.set_defaults(fn=cmd_alloc_status)
+    alfs = al.add_parser("fs")
+    alfs.add_argument("id")
+    alfs.add_argument("path", nargs="?", default="/")
+    alfs.set_defaults(fn=cmd_alloc_fs)
+    allog = al.add_parser("logs")
+    allog.add_argument("id")
+    allog.add_argument("task")
+    allog.add_argument("-stderr", action="store_true")
+    allog.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval", help="eval commands")
     ev.add_argument("id", nargs="?", default="")

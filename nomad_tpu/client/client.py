@@ -212,6 +212,97 @@ class Client:
                 except Exception:   # noqa: BLE001
                     self._services_registered.discard(r.alloc.id)
 
+    # -- fs + logs API (reference: client/fs_endpoint.go List/Stat/
+    #    ReadAt + logs; served on the client, reached via agent HTTP) ---
+    def _alloc_root(self, alloc_id: str) -> str:
+        import os
+        with self._runner_lock:
+            runner = self.runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc {alloc_id} not found on this node")
+        return os.path.normpath(runner.alloc_dir.alloc_dir)
+
+    def _safe_path(self, alloc_id: str, rel: str) -> str:
+        """Resolve rel against the alloc dir, refusing escapes -- both
+        lexical (..) and via symlinks inside the alloc dir
+        (reference: fs_endpoint.go path sandboxing)."""
+        import os
+        root = os.path.realpath(self._alloc_root(alloc_id))
+        full = os.path.realpath(os.path.join(root, rel.lstrip("/")))
+        if not (full == root or full.startswith(root + os.sep)):
+            raise PermissionError(f"path escapes alloc dir: {rel}")
+        return full
+
+    def fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:
+        import os
+        full = self._safe_path(alloc_id, path)
+        out = []
+        for name in sorted(os.listdir(full)):
+            p = os.path.join(full, name)
+            st = os.stat(p)
+            out.append({"name": name, "is_dir": os.path.isdir(p),
+                        "size": st.st_size, "mod_time": st.st_mtime})
+        return out
+
+    def fs_stat(self, alloc_id: str, path: str) -> dict:
+        import os
+        full = self._safe_path(alloc_id, path)
+        st = os.stat(full)
+        return {"name": os.path.basename(full),
+                "is_dir": os.path.isdir(full),
+                "size": st.st_size, "mod_time": st.st_mtime}
+
+    def fs_read(self, alloc_id: str, path: str, offset: int = 0,
+                limit: int = 1 << 20) -> bytes:
+        with open(self._safe_path(alloc_id, path), "rb") as f:
+            f.seek(max(0, offset))
+            return f.read(max(0, min(limit, 1 << 24)))
+
+    def fs_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
+                offset: int = 0, limit: int = 1 << 20) -> bytes:
+        """Rotated log frames for a task, sliced WITHOUT loading the full
+        history (reference: fs_endpoint.go logs path:
+        alloc/logs/<task>.<type>.<index>)."""
+        import os
+        if log_type not in ("stdout", "stderr"):
+            raise ValueError(f"invalid log type {log_type!r}")
+        log_dir = self._safe_path(alloc_id, "alloc/logs")
+        frames = sorted(
+            f for f in os.listdir(log_dir)
+            if f.startswith(f"{task}.{log_type}."))
+        out = []
+        pos, want = 0, max(0, limit)
+        skip = max(0, offset)
+        for frame in frames:
+            path = os.path.join(log_dir, frame)
+            size = os.path.getsize(path)
+            if pos + size <= skip:
+                pos += size
+                continue
+            with open(path, "rb") as f:
+                f.seek(max(0, skip - pos))
+                chunk = f.read(want)
+            out.append(chunk)
+            want -= len(chunk)
+            pos += size
+            skip = max(skip, pos)
+            if want <= 0:
+                break
+        return b"".join(out)
+
+    # -- host stats (reference: client/hoststats/) ---------------------
+    def client_stats(self) -> dict:
+        if not hasattr(self, "_hoststats"):
+            from .hoststats import HostStatsCollector
+            self._hoststats = HostStatsCollector(self.data_dir)
+        stats = self._hoststats.collect()
+        stats["node_id"] = self.node.id
+        with self._runner_lock:
+            stats["allocs_running"] = len([
+                r for r in self.runners.values()
+                if r.client_status == "running"])
+        return stats
+
     # -- watch loop (reference: watchAllocations :2280) ----------------
     def _watch_allocations(self) -> None:
         while not self._shutdown.is_set():

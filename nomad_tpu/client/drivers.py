@@ -142,6 +142,12 @@ class MockDriver(Driver):
             run_for=parse_duration(cfg.get("run_for")),
             exit_code=int(cfg.get("exit_code", 0) or 0),
             kill_after=parse_duration(cfg.get("kill_after")))
+        # scripted output lands in the task's log files (reference:
+        # drivers/mock stdout_string/stdout_repeat)
+        if task_dir is not None and cfg.get("stdout_string"):
+            repeat = int(cfg.get("stdout_repeat", 1) or 1)
+            with open(task_dir.stdout_path(), "ab") as f:
+                f.write((str(cfg["stdout_string"]) * repeat).encode())
         with self._lock:
             self._instances[task_id] = inst
         timer = threading.Thread(target=self._run, args=(task_id, inst),

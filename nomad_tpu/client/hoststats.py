@@ -1,0 +1,83 @@
+"""Host resource usage collection from /proc.
+
+Semantic parity with /root/reference/client/hoststats/ (HostStatsCollector:
+cpu, memory, disk, uptime sampled on an interval and served through the
+ClientStats endpoint). Linux /proc readers with graceful fallbacks so the
+collector never breaks the agent on exotic hosts.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+
+def _read_meminfo() -> dict:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0].rstrip(":")] = int(parts[1]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def _read_cpu_ticks() -> Optional[tuple]:
+    """-> (busy, total) jiffies across all cpus."""
+    try:
+        with open("/proc/stat") as f:
+            fields = f.readline().split()[1:]
+        nums = [int(x) for x in fields]
+        idle = nums[3] + (nums[4] if len(nums) > 4 else 0)
+        total = sum(nums)
+        return total - idle, total
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+class HostStatsCollector:
+    """(reference: hoststats/host.go HostStatsCollector.Collect)"""
+
+    def __init__(self, data_dir: str = "/"):
+        self.data_dir = data_dir
+        self._prev_ticks = _read_cpu_ticks()
+
+    def collect(self) -> dict:
+        mem = _read_meminfo()
+        ticks = _read_cpu_ticks()
+        cpu_pct = 0.0
+        if ticks and self._prev_ticks and ticks[1] > self._prev_ticks[1]:
+            busy = ticks[0] - self._prev_ticks[0]
+            total = ticks[1] - self._prev_ticks[1]
+            cpu_pct = 100.0 * busy / total if total else 0.0
+        self._prev_ticks = ticks
+        try:
+            st = os.statvfs(self.data_dir)
+            disk_total = st.f_blocks * st.f_frsize
+            disk_free = st.f_bavail * st.f_frsize
+        except OSError:
+            disk_total = disk_free = 0
+        return {
+            "timestamp": time.time(),
+            "cpu_percent": round(cpu_pct, 2),
+            "memory": {
+                "total": mem.get("MemTotal", 0),
+                "available": mem.get("MemAvailable", 0),
+                "used": max(0, mem.get("MemTotal", 0)
+                            - mem.get("MemAvailable", 0)),
+            },
+            "disk": {"total": disk_total, "free": disk_free,
+                     "used": max(0, disk_total - disk_free)},
+            "uptime_s": self._host_uptime(),
+        }
+
+    @staticmethod
+    def _host_uptime() -> float:
+        try:
+            with open("/proc/uptime") as f:
+                return round(float(f.read().split()[0]), 1)
+        except (OSError, ValueError, IndexError):
+            return 0.0

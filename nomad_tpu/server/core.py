@@ -955,14 +955,16 @@ class Server:
         SubscribeRequest.Topics). Replays the ring buffer from
         since_index, then live."""
         sub = EventSubscription(topics)
-        # backlog + registration under ONE lock acquisition, else an event
-        # published in between lands in neither (lost-event gap)
+        # Replay THEN register, all under one lock acquisition: publishers
+        # append+snapshot subs under this lock, so no event can land in
+        # neither (lost-event gap) nor jump ahead of the backlog
+        # (out-of-order delivery).
         with self._events_lock:
-            backlog = ([e for e in self._events if e["index"] > since_index]
-                       if since_index else [])
+            if since_index:
+                for e in self._events:
+                    if e["index"] > since_index:
+                        sub.offer(e)
             self._event_subs.append(sub)
-        for e in backlog:
-            sub.offer(e)
         return sub
 
     def unsubscribe_events(self, sub: "EventSubscription") -> None:

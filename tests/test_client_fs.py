@@ -1,0 +1,174 @@
+"""Client fs/logs/stats endpoints (reference analogs:
+client/fs_endpoint.go List/Stat/ReadAt + logs, client/hoststats/)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+
+
+@pytest.fixture
+def env(tmp_path):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.client.client import Client, LocalServerConn
+
+    server = Server(num_workers=1, heartbeat_ttl=5.0)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path), name="fs-node")
+    client.start()
+    http = HttpServer(server, port=0, clients=[client])
+    http.start()
+    api = ApiClient(f"http://127.0.0.1:{http.port}")
+    yield server, client, api
+    http.shutdown()
+    client.shutdown()
+    server.shutdown()
+
+
+def run_logged_job(server, job_id="logged", stdout="hello from task\n"):
+    job = mock.job(id=job_id)
+    task = job.task_groups[0].tasks[0]
+    task.driver = "mock"
+    task.config = {"run_for": "30s", "stdout_string": stdout}
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    return job
+
+
+def wait_running(server, job_id, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        allocs = [a for a in server.state.allocs_by_job("default", job_id)
+                  if a.client_status == "running"]
+        if allocs:
+            return allocs[0]
+        time.sleep(0.05)
+    raise AssertionError("alloc never ran")
+
+
+def test_fs_list_and_stat(env):
+    server, client, api = env
+    run_logged_job(server)
+    alloc = wait_running(server, "logged")
+    entries = api.fs_list(alloc.id, "/")
+    names = [e["name"] for e in entries]
+    assert "alloc" in names          # shared dir
+
+    logs = api.fs_list(alloc.id, "alloc/logs/")
+    task = server.state.alloc_by_id(alloc.id).job.task_groups[0].tasks[0]
+    assert any(e["name"].startswith(f"{task.name}.stdout")
+               for e in logs)
+    st = api.fs_stat(alloc.id, "alloc/logs")
+    assert st["is_dir"] is True
+
+
+def test_fs_cat_and_logs(env):
+    server, client, api = env
+    run_logged_job(server, stdout="line-one\nline-two\n")
+    alloc = wait_running(server, "logged")
+    task_name = alloc.job.task_groups[0].tasks[0].name
+    data = api.alloc_logs(alloc.id, task_name)
+    assert b"line-one" in data and b"line-two" in data
+    # offset slicing
+    assert api.alloc_logs(alloc.id, task_name, offset=5) == data[5:]
+    # direct cat of the same file
+    cat = api.fs_cat(alloc.id, f"alloc/logs/{task_name}.stdout.0")
+    assert cat == data
+
+
+def test_fs_path_escape_rejected(env):
+    server, client, api = env
+    run_logged_job(server)
+    alloc = wait_running(server, "logged")
+    from nomad_tpu.api.client import ApiError
+    with pytest.raises(ApiError) as err:
+        api.fs_list(alloc.id, "../../")
+    assert err.value.status == 403
+    with pytest.raises(PermissionError):
+        client.fs_read(alloc.id, "../../../etc/passwd")
+
+
+def test_fs_unknown_alloc_404(env):
+    server, client, api = env
+    from nomad_tpu.api.client import ApiError
+    with pytest.raises(ApiError) as err:
+        api.fs_list("no-such-alloc", "/")
+    assert err.value.status == 404
+
+
+def test_client_stats(env):
+    server, client, api = env
+    stats = api.client_stats()
+    assert stats["node_id"] == client.node.id
+    assert stats["memory"]["total"] > 0
+    assert "cpu_percent" in stats
+    assert stats["disk"]["total"] > 0
+
+
+def test_hoststats_collector_standalone():
+    from nomad_tpu.client.hoststats import HostStatsCollector
+    c = HostStatsCollector("/")
+    first = c.collect()
+    time.sleep(0.05)
+    second = c.collect()
+    assert second["memory"]["total"] == first["memory"]["total"]
+    assert 0.0 <= second["cpu_percent"] <= 100.0
+
+
+def test_mock_driver_writes_stdout(tmp_path):
+    from nomad_tpu.client.allocdir import AllocDir
+    from nomad_tpu.client.drivers import MockDriver
+    from nomad_tpu.structs import Task
+
+    adir = AllocDir(str(tmp_path), "alloc1")
+    adir.build()
+    tdir = adir.new_task_dir("t1")
+    drv = MockDriver()
+    task = Task(name="t1", driver="mock",
+                config={"run_for": "10s", "stdout_string": "xyz",
+                        "stdout_repeat": 3})
+    drv.start_task("task-1", task, {}, tdir)
+    with open(tdir.stdout_path(), "rb") as f:
+        assert f.read() == b"xyzxyzxyz"
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_fs_symlink_escape_rejected(env, tmp_path):
+    server, client, api = env
+    run_logged_job(server)
+    alloc = wait_running(server, "logged")
+    # plant a symlink inside the alloc dir pointing outside it
+    import os
+    root = client._alloc_root(alloc.id)
+    os.symlink("/etc", os.path.join(root, "alloc", "evil"))
+    with pytest.raises(PermissionError):
+        client.fs_list(alloc.id, "alloc/evil")
+    with pytest.raises(PermissionError):
+        client.fs_read(alloc.id, "alloc/evil/hostname")
+
+
+def test_fs_logs_offset_across_frames(env):
+    server, client, api = env
+    run_logged_job(server, stdout="0123456789")
+    alloc = wait_running(server, "logged")
+    task_name = alloc.job.task_groups[0].tasks[0].name
+    # add a second rotated frame directly
+    import os
+    log_dir = client._safe_path(alloc.id, "alloc/logs")
+    with open(os.path.join(log_dir, f"{task_name}.stdout.1"), "wb") as f:
+        f.write(b"ABCDEFGHIJ")
+    full = client.fs_logs(alloc.id, task_name)
+    assert full == b"0123456789ABCDEFGHIJ"
+    # offset in frame 0, limit spanning into frame 1
+    assert client.fs_logs(alloc.id, task_name, offset=8, limit=4) == b"89AB"
+    # offset entirely in frame 1
+    assert client.fs_logs(alloc.id, task_name, offset=12, limit=3) == b"CDE"
+
+
+def test_host_uptime_is_real():
+    from nomad_tpu.client.hoststats import HostStatsCollector
+    up = HostStatsCollector._host_uptime()
+    assert up > 1.0     # the host has been up longer than this test
