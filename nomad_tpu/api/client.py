@@ -30,18 +30,29 @@ class ApiClient:
 
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  namespace: str = "default", token: str = "",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, region: str = ""):
         self.address = address.rstrip("/")
         self.namespace = namespace
         self.token = token
         self.timeout = timeout
+        self.region = region
 
     # -- low-level -----------------------------------------------------
     def _url(self, path: str, params: Optional[Dict[str, Any]] = None) -> str:
         params = dict(params or {})
         params.setdefault("namespace", self.namespace)
+        if self.region:
+            params.setdefault("region", self.region)
         qs = urllib.parse.urlencode(params)
         return f"{self.address}{path}?{qs}"
+
+    # -- regions (reference: api/regions.go) ---------------------------
+    def list_regions(self) -> List[str]:
+        return self.get("/v1/regions")
+
+    def join_region(self, region: str, address: str) -> dict:
+        return self.post("/v1/regions/join",
+                         {"region": region, "address": address})
 
     def _do(self, req: urllib.request.Request,
             timeout: Optional[float] = None) -> bytes:
@@ -199,18 +210,16 @@ class ApiClient:
                             params={"path": path})
 
     def fs_cat(self, alloc_id: str, path: str) -> bytes:
-        qs = urllib.parse.urlencode({"path": path,
-                                     "namespace": self.namespace})
-        return self.request_raw(
-            "GET", f"/v1/client/fs/cat/{alloc_id}?{qs}")
+        # _url applies namespace + region so forwarding works like the
+        # JSON methods
+        url = self._url(f"/v1/client/fs/cat/{alloc_id}", {"path": path})
+        return self.request_raw("GET", url[len(self.address):])
 
     def alloc_logs(self, alloc_id: str, task: str,
                    log_type: str = "stdout", offset: int = 0) -> bytes:
-        qs = urllib.parse.urlencode({"type": log_type,
-                                     "offset": str(offset),
-                                     "namespace": self.namespace})
-        return self.request_raw(
-            "GET", f"/v1/client/fs/logs/{alloc_id}/{task}?{qs}")
+        url = self._url(f"/v1/client/fs/logs/{alloc_id}/{task}",
+                        {"type": log_type, "offset": str(offset)})
+        return self.request_raw("GET", url[len(self.address):])
 
     def client_stats(self, node_id: str = "") -> dict:
         return self.get("/v1/client/stats", node_id=node_id)

@@ -22,6 +22,10 @@ def main(argv=None) -> int:
     parser.add_argument("--acl", action="store_true",
                         help="enable ACL enforcement (bootstrap via "
                              "POST /v1/acl/bootstrap)")
+    parser.add_argument("--region", default="global")
+    parser.add_argument("--join", action="append", default=[],
+                        metavar="REGION=ADDR",
+                        help="federate with another region's agent")
     parser.add_argument("--real-clients", action="store_true",
                         help="run full client agents with allocdirs "
                              "(enables /v1/client/fs endpoints)")
@@ -36,7 +40,12 @@ def main(argv=None) -> int:
     from ..structs import SchedulerConfiguration, SCHED_ALG_TPU_BINPACK
     from .http import HttpServer
 
-    server = Server(num_workers=args.workers, acl_enabled=args.acl)
+    server = Server(num_workers=args.workers, acl_enabled=args.acl,
+                    region=args.region)
+    for spec in args.join:
+        region, _, addr = spec.partition("=")
+        if region and addr:
+            server.join_federation(region, addr)
     if args.tpu:
         server.state.set_scheduler_config(SchedulerConfiguration(
             scheduler_algorithm=SCHED_ALG_TPU_BINPACK))

@@ -140,6 +140,57 @@ class ApiHandler(BaseHTTPRequestHandler):
     def nomad(self):
         return self.server.nomad_server
 
+    def _maybe_forward(self) -> bool:
+        """Cross-region forwarding: ?region=X for a foreign region relays
+        the whole request to a server of that region and streams the
+        response back (reference: nomad/rpc.go forwardRegion). Returns
+        True when the request was handled here."""
+        q = parse_qs(urlparse(self.path).query)
+        region = q.get("region", [None])[0]
+        if not region or region == self.nomad.region:
+            return False
+        addr = self.nomad.forward_address(region)
+        if addr is None:
+            self._error(404, f"unknown region {region!r}")
+            return True
+        # unbounded streams can't be relayed through the buffering
+        # forwarder -- clients must connect to that region directly
+        parsed = urlparse(self.path)
+        if parsed.path == "/v1/event/stream" and \
+                q.get("poll", ["false"])[0] != "true":
+            self._error(
+                400, f"event stream cannot be forwarded; connect to "
+                     f"region {region!r} at {addr} directly")
+            return True
+        import urllib.error
+        import urllib.request
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length else None
+        req = urllib.request.Request(
+            f"{addr}{self.path}", method=self.command, data=body,
+            headers={k: v for k, v in self.headers.items()
+                     if k.lower() in ("content-type", "x-nomad-token")})
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                data = resp.read()
+                self.send_response(resp.status)
+                ctype = resp.headers.get("Content-Type",
+                                         "application/json")
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+        except urllib.error.HTTPError as e:
+            data = e.read()
+            self.send_response(e.code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except OSError as e:
+            self._error(502, f"region {region!r} unreachable: {e}")
+        return True
+
     def _client_for_alloc(self, alloc_id: str):
         """-> (client, alloc) serving the alloc's fs, or (None, alloc)."""
         alloc = self.nomad.state.alloc_by_id(alloc_id)
@@ -205,6 +256,8 @@ class ApiHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------------
     def do_GET(self):  # noqa: N802
+        if self._maybe_forward():
+            return
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         state = self.nomad.state
@@ -552,6 +605,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if dec is None:
                     return self._error(404, "variable not found")
                 self._send(200, dec, index)
+            elif parts == ["v1", "regions"]:
+                self._send(200, self.nomad.regions())
             elif parts == ["v1", "status", "leader"]:
                 raft = getattr(self.nomad, "raft", None)
                 if raft is None:
@@ -607,6 +662,8 @@ class ApiHandler(BaseHTTPRequestHandler):
         self.do_POST()
 
     def do_POST(self):  # noqa: N802
+        if self._maybe_forward():
+            return
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
@@ -626,7 +683,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                 # are operator actions -- all require node:write
                 if not self._check(acl.allow_node_write()):
                     return
-            elif parts[1:2] == ["operator"] or parts[1:2] == ["system"]:
+            elif parts[1:2] in (["operator"], ["system"], ["regions"]):
                 if not self._check(acl.allow_operator_write()):
                     return
             if parts[:2] == ["v1", "search"]:
@@ -848,6 +905,13 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except (TypeError, ValueError) as e:
                     return self._error(400, str(e))
                 self._send(200, {"registered": True})
+            elif parts == ["v1", "regions", "join"]:
+                # federation join (operator; pre-gated operator_write)
+                body = self._body()
+                if not body.get("region") or not body.get("address"):
+                    return self._error(400, "region and address required")
+                self.nomad.join_federation(body["region"], body["address"])
+                self._send(200, {"joined": body["region"]})
             elif parts == ["v1", "system", "gc"]:
                 self._send(200, self.nomad.run_gc_once())
             elif parts == ["v1", "operator", "snapshot"]:
@@ -908,6 +972,8 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._error(500, f"{type(e).__name__}: {e}")
 
     def do_DELETE(self):  # noqa: N802
+        if self._maybe_forward():
+            return
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
@@ -997,14 +1063,16 @@ class ApiHandler(BaseHTTPRequestHandler):
     def _token_stub(self, t) -> dict:
         return {"accessor_id": t.accessor_id, "name": t.name,
                 "type": t.type, "policies": t.policies,
-                "global": t.global_token, "create_time": t.create_time}
+                "global": t.global_token, "create_time": t.create_time,
+                "modify_index": t.modify_index}
 
     def _acl_get(self, parts, acl, index) -> None:
         state = self.nomad.state
         if parts == ["v1", "acl", "policies"]:
             if not self._check(acl.is_management()):
                 return
-            self._send(200, [{"name": p.name, "description": p.description}
+            self._send(200, [{"name": p.name, "description": p.description,
+                              "modify_index": p.modify_index}
                              for p in state.acl_policies()], index)
         elif parts[:3] == ["v1", "acl", "policy"] and len(parts) == 4:
             if not self._check(acl.is_management()):

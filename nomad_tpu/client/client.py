@@ -239,7 +239,8 @@ class Client:
         out = []
         for name in sorted(os.listdir(full)):
             p = os.path.join(full, name)
-            st = os.stat(p)
+            # lstat: a dangling symlink must not break the whole listing
+            st = os.lstat(p)
             out.append({"name": name, "is_dir": os.path.isdir(p),
                         "size": st.st_size, "mod_time": st.st_mtime})
         return out
@@ -267,9 +268,19 @@ class Client:
         if log_type not in ("stdout", "stderr"):
             raise ValueError(f"invalid log type {log_type!r}")
         log_dir = self._safe_path(alloc_id, "alloc/logs")
+
+        def frame_idx(name: str) -> int:
+            try:
+                return int(name.rsplit(".", 1)[1])
+            except ValueError:
+                return 0
+
+        # numeric rotation order: .2 before .10 (lexicographic would
+        # scramble content past ten frames)
         frames = sorted(
-            f for f in os.listdir(log_dir)
-            if f.startswith(f"{task}.{log_type}."))
+            (f for f in os.listdir(log_dir)
+             if f.startswith(f"{task}.{log_type}.")),
+            key=frame_idx)
         out = []
         pos, want = 0, max(0, limit)
         skip = max(0, offset)

@@ -82,10 +82,18 @@ class Server:
 
     def __init__(self, num_workers: Optional[int] = None,
                  heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
-                 logger=None, state=None, acl_enabled: bool = False):
+                 logger=None, state=None, acl_enabled: bool = False,
+                 region: str = "global"):
         import os
         from ..acl import Resolver
         self.logger = logger
+        self.region = region
+        # federation: region name -> a peer region server's HTTP address
+        # (reference: multi-region RPC forwarding, nomad/rpc.go forward;
+        # regions discover each other via WAN serf there, via explicit
+        # join here)
+        self.federation: Dict[str, str] = {}
+        self._acl_replication_thread: Optional[threading.Thread] = None
         self.state = state if state is not None else StateStore()
         self.acl_enabled = acl_enabled
         self.acl_resolver = Resolver(self.state)
@@ -896,6 +904,94 @@ class Server:
         from .search import Searcher
         return Searcher(self.state, ns_allowed).fuzzy_search(
             text, context, namespace, allowed_contexts)
+
+    # ------------------------------------------------------------------
+    # Multi-region federation (reference: nomad/rpc.go cross-region
+    # forwarding + leader.go ACL replication from the authoritative region)
+    def join_federation(self, region: str, address: str) -> None:
+        """Register a peer region's HTTP address for request forwarding."""
+        if region == self.region:
+            return
+        self.federation[region] = address.rstrip("/")
+        self.publish_event("RegionJoined", {"name": region})
+
+    def regions(self) -> List[str]:
+        return sorted([self.region] + list(self.federation))
+
+    def forward_address(self, region: str) -> Optional[str]:
+        return self.federation.get(region)
+
+    def start_acl_replication(self, authoritative_region: str,
+                              token: str = "",
+                              interval: float = 5.0) -> None:
+        """Pull ACL policies + global tokens from the authoritative
+        region (reference: leader.go:486 replicateACLPolicies/
+        replicateACLTokens). No-op when WE are authoritative."""
+        if authoritative_region == self.region:
+            return
+
+        def loop():
+            from ..api.client import ApiClient
+            from ..structs import ACLPolicy, ACLToken
+            from ..structs import codec as _codec
+            # upstream modify_index per item: fetch only what changed
+            # (reference: minIndex-based replication, leader.go:486)
+            seen_policies: Dict[str, int] = {}
+            seen_tokens: Dict[str, int] = {}
+            while not self._shutdown.wait(interval):
+                addr = self.federation.get(authoritative_region)
+                if addr is None:
+                    continue
+                try:
+                    api = ApiClient(addr, token=token)
+                    remote_pols = api.get("/v1/acl/policies")
+                    remote_names = {p["name"] for p in remote_pols}
+                    for p in remote_pols:
+                        idx = int(p.get("modify_index", 0))
+                        if seen_policies.get(p["name"]) == idx:
+                            continue
+                        full = api.get(f"/v1/acl/policy/{p['name']}")
+                        self.state.upsert_acl_policies(
+                            [_codec.decode(ACLPolicy, full)])
+                        seen_policies[p["name"]] = idx
+                    # deletions propagate (reference: replication deletes
+                    # rows absent from the authoritative set)
+                    gone = [pl.name for pl in self.state.acl_policies()
+                            if pl.name not in remote_names]
+                    if gone:
+                        self.state.delete_acl_policies(gone)
+                        for name in gone:
+                            seen_policies.pop(name, None)
+
+                    remote_toks = api.get("/v1/acl/tokens")
+                    remote_global = {t["accessor_id"] for t in remote_toks
+                                     if t.get("global")}
+                    for t in remote_toks:
+                        if not t.get("global"):
+                            continue   # only global tokens replicate
+                        idx = int(t.get("modify_index", 0))
+                        if seen_tokens.get(t["accessor_id"]) == idx:
+                            continue
+                        full = api.get(
+                            f"/v1/acl/token/{t['accessor_id']}")
+                        self.state.upsert_acl_tokens(
+                            [_codec.decode(ACLToken, full)])
+                        seen_tokens[t["accessor_id"]] = idx
+                    gone_toks = [
+                        tk.accessor_id for tk in self.state.acl_tokens()
+                        if tk.global_token
+                        and tk.accessor_id not in remote_global]
+                    if gone_toks:
+                        self.state.delete_acl_tokens(gone_toks)
+                        for acc in gone_toks:
+                            seen_tokens.pop(acc, None)
+                except Exception:   # noqa: BLE001 -- peer down: retry
+                    continue
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="acl-replication")
+        t.start()
+        self._acl_replication_thread = t
 
     # ------------------------------------------------------------------
     # Operator snapshot (reference: nomad/operator_endpoint.go

@@ -1,0 +1,187 @@
+"""Multi-region federation: cross-region HTTP forwarding, regions API,
+ACL replication from the authoritative region (reference analogs:
+nomad/rpc.go forwardRegion, leader.go replicateACLPolicies/Tokens)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient, ApiError
+from nomad_tpu.api.http import HttpServer
+from nomad_tpu.server import Server
+
+
+@pytest.fixture
+def regions():
+    """Two federated single-server regions with HTTP agents."""
+    setups = {}
+    for name in ("east", "west"):
+        s = Server(num_workers=1, heartbeat_ttl=5.0, region=name)
+        s.start()
+        h = HttpServer(s, port=0)
+        h.start()
+        setups[name] = (s, h, f"http://127.0.0.1:{h.port}")
+    east, west = setups["east"], setups["west"]
+    east[0].join_federation("west", west[2])
+    west[0].join_federation("east", east[2])
+    yield setups
+    for s, h, _ in setups.values():
+        h.shutdown()
+        s.shutdown()
+
+
+def test_regions_listing(regions):
+    east_api = ApiClient(regions["east"][2])
+    assert east_api.list_regions() == ["east", "west"]
+
+
+def test_cross_region_read_forwarding(regions):
+    east_server = regions["east"][0]
+    west_server = regions["west"][0]
+    east_server.register_job(mock.job(id="east-job"))
+    west_server.register_job(mock.job(id="west-job"))
+
+    east_api = ApiClient(regions["east"][2])
+    # local query sees only east
+    assert [j["id"] for j in east_api.jobs()] == ["east-job"]
+    # ?region=west via the EAST agent returns west's jobs
+    west_view = ApiClient(regions["east"][2], region="west")
+    assert [j["id"] for j in west_view.jobs()] == ["west-job"]
+
+
+def test_cross_region_write_forwarding(regions):
+    west_server = regions["west"][0]
+    west_via_east = ApiClient(regions["east"][2], region="west")
+    west_via_east.register_job({
+        "id": "forwarded", "task_groups": [{
+            "name": "g", "count": 1,
+            "tasks": [{"name": "t", "driver": "mock",
+                       "resources": {"cpu": 50, "memory_mb": 32}}]}]})
+    assert west_server.state.job_by_id("default", "forwarded") is not None
+    # and it did NOT land in east
+    assert regions["east"][0].state.job_by_id(
+        "default", "forwarded") is None
+
+
+def test_unknown_region_404(regions):
+    api = ApiClient(regions["east"][2], region="mars")
+    with pytest.raises(ApiError) as err:
+        api.jobs()
+    assert err.value.status == 404
+
+
+def test_same_region_not_forwarded(regions):
+    east_server = regions["east"][0]
+    east_server.register_job(mock.job(id="local"))
+    api = ApiClient(regions["east"][2], region="east")
+    assert [j["id"] for j in api.jobs()] == ["local"]
+
+
+def test_acl_replication_from_authoritative(regions):
+    from nomad_tpu.structs import ACLPolicy, ACLToken
+    east_server = regions["east"][0]     # authoritative
+    west_server = regions["west"][0]
+    east_server.state.upsert_acl_policies([ACLPolicy(
+        name="shared-policy", rules='namespace "default" '
+                                    '{ policy = "read" }')])
+    token = ACLToken.new(name="global-tok", type="client",
+                         policies=["shared-policy"])
+    token.global_token = True
+    local = ACLToken.new(name="local-tok", type="client")
+    east_server.state.upsert_acl_tokens([token, local])
+
+    west_server.start_acl_replication("east", interval=0.2)
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        if west_server.state.acl_policy_by_name("shared-policy") and \
+                west_server.state.acl_token_by_accessor(token.accessor_id):
+            break
+        time.sleep(0.1)
+    assert west_server.state.acl_policy_by_name("shared-policy") is not None
+    replicated = west_server.state.acl_token_by_accessor(token.accessor_id)
+    assert replicated is not None
+    # non-global tokens do NOT replicate
+    assert west_server.state.acl_token_by_accessor(
+        local.accessor_id) is None
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_acl_replication_propagates_deletions(regions):
+    from nomad_tpu.structs import ACLPolicy, ACLToken
+    east_server = regions["east"][0]
+    west_server = regions["west"][0]
+    east_server.state.upsert_acl_policies([ACLPolicy(
+        name="doomed", rules='namespace "default" { policy = "read" }')])
+    tok = ACLToken.new(name="doomed-tok", type="client")
+    tok.global_token = True
+    east_server.state.upsert_acl_tokens([tok])
+    west_server.start_acl_replication("east", interval=0.2)
+
+    def wait_for(cond, timeout=8):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(0.1)
+        return False
+
+    assert wait_for(lambda: west_server.state.acl_policy_by_name("doomed"))
+    assert wait_for(lambda: west_server.state.acl_token_by_accessor(
+        tok.accessor_id))
+    # now revoke upstream: the replica must drop both
+    east_server.state.delete_acl_policies(["doomed"])
+    east_server.state.delete_acl_tokens([tok.accessor_id])
+    assert wait_for(lambda: west_server.state.acl_policy_by_name(
+        "doomed") is None)
+    assert wait_for(lambda: west_server.state.acl_token_by_accessor(
+        tok.accessor_id) is None)
+
+
+def test_event_stream_not_forwarded(regions):
+    import urllib.error
+    import urllib.request
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(
+            f'{regions["east"][2]}/v1/event/stream?region=west',
+            timeout=5)
+    assert err.value.code == 400
+
+
+def test_fs_log_frames_numeric_order(tmp_path):
+    from nomad_tpu.client.client import Client, LocalServerConn
+    from nomad_tpu.server import Server
+    import os
+    import time as _t
+
+    server = Server(num_workers=1)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path), name="n")
+    client.start()
+    try:
+        job = mock.job(id="rot")
+        job.task_groups[0].tasks[0].config = {"run_for": "30s"}
+        job.task_groups[0].count = 1
+        server.register_job(job)
+        deadline = _t.time() + 10
+        alloc = None
+        while _t.time() < deadline:
+            allocs = [a for a in server.state.allocs_by_job("default",
+                                                            "rot")
+                      if a.client_status == "running"]
+            if allocs:
+                alloc = allocs[0]
+                break
+            _t.sleep(0.05)
+        assert alloc is not None
+        log_dir = client._safe_path(alloc.id, "alloc/logs")
+        task = alloc.job.task_groups[0].tasks[0].name
+        for i in range(12):
+            with open(os.path.join(log_dir, f"{task}.stdout.{i}"),
+                      "wb") as f:
+                f.write(f"[{i:02d}]".encode())
+        data = client.fs_logs(alloc.id, task)
+        assert data == b"".join(f"[{i:02d}]".encode() for i in range(12))
+    finally:
+        client.shutdown()
+        server.shutdown()
