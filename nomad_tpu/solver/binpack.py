@@ -173,6 +173,76 @@ def _select_window(score, fit, limit, dtype):
         jnp.sum(yielded.astype(jnp.int32))
 
 
+# The selection window only ever yields the first `limit` (<= ~14 for 10K
+# nodes) counted options in shuffled order, plus up to MAX_SKIP skips. So
+# whenever the first FAST_T shuffled positions contain >= limit counted
+# options, the outcome is fully determined by those FAST_T nodes -- the
+# common case on healthy fleets. The scan step then runs O(FAST_T) work
+# instead of O(N), falling back to the full pass via lax.cond otherwise.
+FAST_T = 1024
+
+
+def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
+                      spread_alg: bool, lo: int, hi: Optional[int]):
+    """One Stack.Select over node positions [lo:hi) (static slice).
+    Returns (chosen global index, score, n_yield, counted_in_slice)."""
+    (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
+     penalty_idx, active) = b
+    sl = slice(lo, hi)
+    cpu_cap = const.cpu_cap[sl]
+    mem_cap = const.mem_cap[sl]
+    n = cpu_cap.shape[0]
+
+    new_cpu = state.used_cpu[sl] + ask_cpu
+    new_mem = state.used_mem[sl] + ask_mem
+    new_disk = state.used_disk[sl] + ask_disk
+
+    distinct_count = jnp.where(const.distinct_job_level,
+                               state.placed_job[sl], state.placed[sl])
+    fit = (const.feasible[sl]
+           & (new_cpu <= cpu_cap)
+           & (new_mem <= mem_cap)
+           & (new_disk <= const.disk_cap[sl])
+           & (state.dyn_avail[sl] >= n_dyn)
+           & (state.static_free[sl] | ~has_static)
+           & (~const.distinct_hosts | (distinct_count == 0)))
+
+    free_cpu = 1.0 - new_cpu / jnp.maximum(cpu_cap, 1e-9)
+    free_mem = 1.0 - new_mem / jnp.maximum(mem_cap, 1e-9)
+    binpack = _binpack_score(free_cpu, free_mem, spread_alg)
+
+    collisions = state.placed[sl]
+    anti = jnp.where(
+        collisions > 0,
+        -(collisions.astype(dtype) + 1.0) / jnp.maximum(
+            count.astype(dtype), 1.0),
+        0.0)
+    idx = jnp.arange(lo, lo + n)
+    is_penalty = idx == penalty_idx
+    resched = jnp.where(is_penalty, -1.0, 0.0)
+    aff = jnp.where(const.has_affinity, const.affinity[sl], 0.0)
+    aff_present = aff != 0.0
+    sliced_state = state._replace(spread_counts=state.spread_counts)
+    sliced_const = const._replace(spread_vidx=const.spread_vidx[:, sl])
+    spread_total = _spread_score(sliced_state, sliced_const, dtype)
+    spread_present = spread_total != 0.0
+
+    nscores = (1
+               + (collisions > 0).astype(dtype)
+               + is_penalty.astype(dtype)
+               + aff_present.astype(dtype)
+               + spread_present.astype(dtype))
+    final = (binpack + anti + resched + aff + spread_total) / nscores
+
+    chosen, cscore, n_yield = _select_window(final, fit, limit, dtype)
+    low = fit & (final <= SKIP_THRESHOLD)
+    skip_rank = jnp.cumsum(low.astype(jnp.int32))
+    skipped = low & (skip_rank <= MAX_SKIP)
+    counted_total = jnp.sum((fit & ~skipped).astype(jnp.int32))
+    chosen = jnp.where(chosen >= 0, chosen + lo, -1)
+    return chosen, cscore, n_yield, counted_total
+
+
 @functools.partial(jax.jit, static_argnames=("spread_alg", "dtype_name"))
 def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
                      spread_alg: bool = False, dtype_name: str = "float32"):
@@ -184,77 +254,57 @@ def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
     n_yielded (P,), final NodeState).
     """
     dtype = jnp.dtype(dtype_name)
+    n_total = const.cpu_cap.shape[0]
+    use_fast = n_total > 2 * FAST_T
 
     def step(state: NodeState, b):
         (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
          penalty_idx, active) = b
-        n = const.cpu_cap.shape[0]
 
-        new_cpu = state.used_cpu + ask_cpu
-        new_mem = state.used_mem + ask_mem
-        new_disk = state.used_disk + ask_disk
+        if use_fast:
+            # fast path: the window resolved within the first FAST_T
+            # shuffled positions -- valid iff they contain >= limit
+            # counted options (then the full-pass window is identical)
+            f_chosen, f_score, f_yield, f_counted = _score_and_select(
+                state, const, b, dtype, spread_alg, 0, FAST_T)
 
-        distinct_count = jnp.where(const.distinct_job_level,
-                                   state.placed_job, state.placed)
-        fit = (const.feasible
-               & (new_cpu <= const.cpu_cap)
-               & (new_mem <= const.mem_cap)
-               & (new_disk <= const.disk_cap)
-               & (state.dyn_avail >= n_dyn)
-               & (state.static_free | ~has_static)
-               & (~const.distinct_hosts | (distinct_count == 0)))
+            def full(_):
+                c, s, y, _cnt = _score_and_select(
+                    state, const, b, dtype, spread_alg, 0, None)
+                return c, s, y
 
-        cap_cpu = jnp.maximum(const.cpu_cap, 1e-9)
-        cap_mem = jnp.maximum(const.mem_cap, 1e-9)
-        free_cpu = 1.0 - new_cpu / cap_cpu
-        free_mem = 1.0 - new_mem / cap_mem
-        binpack = _binpack_score(free_cpu, free_mem, spread_alg)
+            def fast(_):
+                return f_chosen, f_score, f_yield
 
-        collisions = state.placed
-        anti = jnp.where(
-            collisions > 0,
-            -(collisions.astype(dtype) + 1.0) / jnp.maximum(
-                count.astype(dtype), 1.0),
-            0.0)
-        idx = jnp.arange(n)
-        is_penalty = idx == penalty_idx
-        resched = jnp.where(is_penalty, -1.0, 0.0)
-        aff = jnp.where(const.has_affinity, const.affinity, 0.0)
-        aff_present = aff != 0.0
-        spread_total = _spread_score(state, const, dtype)
-        spread_present = spread_total != 0.0
+            chosen, cscore, n_yield = jax.lax.cond(
+                f_counted >= limit, fast, full, operand=None)
+        else:
+            chosen, cscore, n_yield, _ = _score_and_select(
+                state, const, b, dtype, spread_alg, 0, None)
 
-        nscores = (1
-                   + (collisions > 0).astype(dtype)
-                   + is_penalty.astype(dtype)
-                   + aff_present.astype(dtype)
-                   + spread_present.astype(dtype))
-        final = (binpack + anti + resched + aff + spread_total) / nscores
-
-        chosen, cscore, n_yield = _select_window(final, fit, limit, dtype)
         do = active & (chosen >= 0)
         safe = jnp.maximum(chosen, 0)
-        onehot = (idx == safe) & do
-
+        # O(1) scatter updates: only the winner's usage changes
+        add_f = do.astype(dtype)
+        add_i = do.astype(jnp.int32)
+        new_state = NodeState(
+            used_cpu=state.used_cpu.at[safe].add(add_f * ask_cpu),
+            used_mem=state.used_mem.at[safe].add(add_f * ask_mem),
+            used_disk=state.used_disk.at[safe].add(add_f * ask_disk),
+            placed=state.placed.at[safe].add(add_i),
+            placed_job=state.placed_job.at[safe].add(add_i),
+            static_free=state.static_free.at[safe].set(
+                state.static_free[safe] & ~(do & has_static)),
+            dyn_avail=state.dyn_avail.at[safe].add(-add_i * n_dyn),
+            spread_counts=state.spread_counts,
+        )
         sel_vidx = const.spread_vidx[:, safe]               # (S,)
         S, V = state.spread_counts.shape
         if S > 0:
             upd = ((jnp.arange(V)[None, :] == jnp.maximum(sel_vidx, 0)[:, None])
                    & (sel_vidx >= 0)[:, None] & do)
-            new_counts = state.spread_counts + upd.astype(jnp.int32)
-        else:
-            new_counts = state.spread_counts
-
-        new_state = NodeState(
-            used_cpu=jnp.where(onehot, new_cpu, state.used_cpu),
-            used_mem=jnp.where(onehot, new_mem, state.used_mem),
-            used_disk=jnp.where(onehot, new_disk, state.used_disk),
-            placed=state.placed + onehot.astype(jnp.int32),
-            placed_job=state.placed_job + onehot.astype(jnp.int32),
-            static_free=state.static_free & ~(onehot & has_static),
-            dyn_avail=state.dyn_avail - onehot.astype(jnp.int32) * n_dyn,
-            spread_counts=new_counts,
-        )
+            new_state = new_state._replace(
+                spread_counts=state.spread_counts + upd.astype(jnp.int32))
         chosen_out = jnp.where(do, chosen, -1)
         return new_state, (chosen_out, cscore, n_yield)
 
