@@ -3,19 +3,30 @@
 
 Measures the north-star hot loop (BASELINE.json): per-placement feasibility +
 bin-pack scoring + selection over a 10K-node fleet (config tier 3/4 shape:
-cpu+mem+disk+port constraints), comparing
+cpu+mem+disk constraints), comparing
   - host oracle: the faithful reimplementation of Nomad's iterator stack
     (scheduler/rank.go BinPackIterator + selection), one Stack.Select per
     placement -- the reference algorithm at reference semantics;
   - TPU solver: the same placements solved as one dense lax.scan dispatch
     (nomad_tpu/solver/binpack.py), verified to produce IDENTICAL placements.
 
-Prints ONE JSON line {"metric","value","unit","vs_baseline"}. vs_baseline is
-the solver's speedup over the host oracle's inner loop at equal, verified
-work (the reference repo publishes no absolute numbers -- BASELINE.md).
+Both paths run the SAME number of placements from the same initial world, so
+vs_baseline compares equal, parity-verified work. Parity is GATING: any
+placement mismatch prints the JSON line (for the record) and exits non-zero.
+
+Platform selection: this image's jax mis-handles the JAX_PLATFORMS env var
+(the axon TPU plugin hijacks init whenever the var is set, and a broken
+tunnel can HANG backend init forever, not just fail). So the var is removed,
+TPU availability is probed in a subprocess with a hard timeout, and the main
+process falls back to the CPU backend when the probe fails or times out.
+
+Prints ONE JSON line {"metric","value","unit","vs_baseline",...} on stdout;
+all diagnostics go to stderr.
 """
 import json
 import os
+import statistics
+import subprocess
 import sys
 import time
 
@@ -23,7 +34,88 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
 N_PLACEMENTS = int(os.environ.get("BENCH_PLACEMENTS", "2000"))
-ORACLE_PLACEMENTS = int(os.environ.get("BENCH_ORACLE_PLACEMENTS", "300"))
+N_REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "5")))
+N_ORACLE_RUNS = max(1, int(os.environ.get("BENCH_ORACLE_RUNS", "2")))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+
+_PROBE_SRC = """
+import os
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+devs = jax.devices()
+print("PLATFORM:" + devs[0].platform)
+"""
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def _probe_tpu() -> str:
+    """Probe backend init in its own process GROUP with a hard timeout.
+    Output goes to temp files (not pipes): a hung axon init can fork helper
+    processes that inherit pipe write-ends, and subprocess.run's post-kill
+    communicate() would then block on EOF forever. Killing the whole group
+    and reading files makes the timeout actually hard."""
+    import signal
+    import tempfile
+
+    platform = ""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    with tempfile.TemporaryFile(mode="w+") as fout, \
+            tempfile.TemporaryFile(mode="w+") as ferr:
+        t0 = time.time()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC], stdout=fout, stderr=ferr,
+            env=env, start_new_session=True)
+        try:
+            rc = proc.wait(timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            log(f"bench: TPU probe timed out after {PROBE_TIMEOUT_S}s; "
+                "falling back to CPU backend")
+            return ""
+        fout.seek(0)
+        for line in fout.read().splitlines():
+            if line.startswith("PLATFORM:"):
+                platform = line.split(":", 1)[1].strip().lower()
+        log(f"bench: probe rc={rc} platform={platform!r} "
+            f"in {time.time() - t0:.1f}s")
+        if rc != 0:
+            ferr.seek(0)
+            log("bench: probe stderr tail:",
+                ferr.read().strip().splitlines()[-1:] or "")
+    return platform
+
+
+def pick_platform() -> str:
+    """Returns the platform the main process should use ('tpu' or 'cpu'),
+    configuring jax accordingly BEFORE its first backend touch."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
+    platform = ""
+    if forced:
+        platform = forced
+        log(f"bench: BENCH_PLATFORM={forced} (probe skipped)")
+    else:
+        platform = _probe_tpu()
+    import jax
+    if platform != "tpu":
+        platform = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        actual = jax.devices()[0].platform
+    except RuntimeError as e:
+        log(f"bench: backend init failed ({e}); forcing CPU")
+        platform = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        actual = jax.devices()[0].platform
+    log(f"bench: running on {actual} ({len(jax.devices())} device(s))")
+    return actual
 
 
 def build_world():
@@ -49,7 +141,6 @@ def build_world():
 def time_host_inner_loop(h, job, nodes, n_placements):
     """One Stack.Select per placement, usage carried via the plan --
     exactly the reference's per-eval inner loop."""
-    from nomad_tpu import mock
     from nomad_tpu.scheduler.context import EvalContext
     from nomad_tpu.scheduler.stack import GenericStack, SelectOptions
     from nomad_tpu.structs import (
@@ -70,6 +161,7 @@ def time_host_inner_loop(h, job, nodes, n_placements):
         name = f"{job.id}.{tg.name}[{i}]"
         option = stack.select(tg, SelectOptions(alloc_name=name))
         if option is None:
+            placed[name] = None
             continue
         alloc = Allocation(
             id=generate_uuid(), name=name, job_id=job.id, job=job,
@@ -84,17 +176,14 @@ def time_host_inner_loop(h, job, nodes, n_placements):
     return dt, placed
 
 
-def time_tpu_inner_loop(h, job, nodes, n_placements):
-    """All placements in one dense dispatch. The timed region is one full
-    service.solve() call: host-side packing (O(nodes) numpy) + the solver
-    dispatch + the single device->host result fetch -- i.e. the complete
-    per-eval p50 latency path, conservatively including costs a production
-    deployment amortizes with incremental usage tensors."""
+def solve_once(h, job, nodes, n_placements):
+    """One full TPU-path eval: host-side packing + one dense solver dispatch
+    + the single device->host result fetch -- the complete per-eval latency
+    path a production worker pays."""
     from nomad_tpu.scheduler.context import EvalContext
     from nomad_tpu.scheduler.reconcile import AllocPlaceResult
     from nomad_tpu.solver.service import TpuPlacementService
     from nomad_tpu.structs import Plan
-    import jax
 
     plan = Plan(eval_id="bench-eval-0000000000000001", priority=50, job=job)
     snap = h.state.snapshot()
@@ -104,43 +193,82 @@ def time_tpu_inner_loop(h, job, nodes, n_placements):
               for i in range(n_placements)]
     service = TpuPlacementService(ctx, job, batch_mode=False,
                                   spread_alg=False)
-
-    # Warmup compiles the (n_pad, P) program.
-    service.solve(tg, places, nodes)
-
     t0 = time.perf_counter()
     solved = service.solve(tg, places, nodes)
     dt = time.perf_counter() - t0
-    placed = {sp.place.name: sp.node.id for sp in solved
-              if sp.node is not None}
+    placed = {sp.place.name: (sp.node.id if sp.node is not None else None)
+              for sp in solved}
     return dt, placed
 
 
 def main():
+    platform = pick_platform()
+    t0 = time.time()
     h, job, nodes = build_world()
+    log(f"bench: world built ({N_NODES} nodes) in {time.time() - t0:.1f}s")
 
-    oracle_dt, oracle_placed = time_host_inner_loop(
-        h, job, nodes, ORACLE_PLACEMENTS)
-    host_per_place = oracle_dt / max(len(oracle_placed), 1)
+    # --- host oracle: full workload, equal work to the solver path.
+    # min over N_ORACLE_RUNS filters one-off GC/cold-cache noise from the
+    # baseline side the same way median-of-repeats does for the solver.
+    oracle_dt = None
+    for _ in range(N_ORACLE_RUNS):
+        run_dt, oracle_placed = time_host_inner_loop(
+            h, job, nodes, N_PLACEMENTS)
+        oracle_dt = run_dt if oracle_dt is None else min(oracle_dt, run_dt)
+    n_oracle_ok = sum(1 for v in oracle_placed.values() if v is not None)
+    log(f"bench: oracle placed {n_oracle_ok}/{N_PLACEMENTS} "
+        f"in {oracle_dt:.3f}s ({oracle_dt / max(n_oracle_ok, 1) * 1e3:.3f} "
+        f"ms/placement, min of {N_ORACLE_RUNS})")
 
-    tpu_dt, tpu_placed = time_tpu_inner_loop(h, job, nodes, N_PLACEMENTS)
-    tpu_per_place = tpu_dt / max(len(tpu_placed), 1)
+    # --- TPU solver: warmup (compile) then repeated timed evals for p50
+    warm_dt, tpu_placed = solve_once(h, job, nodes, N_PLACEMENTS)
+    log(f"bench: solver warmup (incl. compile) {warm_dt:.3f}s")
+    times = []
+    for r in range(N_REPEATS):
+        dt, rep_placed = solve_once(h, job, nodes, N_PLACEMENTS)
+        times.append(dt)
+        if rep_placed != tpu_placed:
+            log("bench: FATAL: solver output unstable across repeats")
+            _emit(platform, 0.0, -1, oracle_dt)
+            sys.exit(1)
+    p50 = statistics.median(times)
+    n_tpu_ok = sum(1 for v in tpu_placed.values() if v is not None)
+    log(f"bench: solver p50 {p50 * 1e3:.1f}ms over {N_REPEATS} evals "
+        f"(placed {n_tpu_ok}/{N_PLACEMENTS})")
 
-    # parity spot-check on the overlapping prefix
+    # --- GATING parity over the FULL workload: same keys, same nodes
     mismatch = sum(
-        1 for k in list(oracle_placed)[:ORACLE_PLACEMENTS]
-        if k in tpu_placed and tpu_placed[k] != oracle_placed[k])
+        1 for k, v in oracle_placed.items() if tpu_placed.get(k) != v)
+    mismatch += sum(1 for k in tpu_placed if k not in oracle_placed)
+    if mismatch:
+        for k, v in list(oracle_placed.items()):
+            tv = tpu_placed.get(k)
+            if tv != v:
+                log(f"bench: PARITY MISMATCH {k}: oracle={v} tpu={tv}")
+                break
 
-    placements_per_sec = len(tpu_placed) / tpu_dt if tpu_dt > 0 else 0.0
-    speedup = host_per_place / tpu_per_place if tpu_per_place else 0.0
+    _emit(platform, p50, mismatch, oracle_dt, n_placed=n_tpu_ok)
+    if mismatch:
+        log(f"bench: FAILED parity gate: {mismatch} mismatches")
+        sys.exit(1)
 
+
+def _emit(platform, p50, mismatch, oracle_total, n_placed=0):
+    placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
+    per_place_tpu = p50 / n_placed if n_placed else 0.0
+    per_place_host = oracle_total / max(n_placed, 1)
+    speedup = (per_place_host / per_place_tpu) if per_place_tpu else 0.0
     print(json.dumps({
         "metric": "placements_per_sec_10k_nodes",
         "value": round(placements_per_sec, 2),
-        "unit": (f"placements/s ({N_NODES} nodes, {len(tpu_placed)} placed, "
-                 f"parity_mismatch={mismatch})"),
+        "unit": (f"placements/s ({N_NODES} nodes, {n_placed} placed, "
+                 f"platform={platform}, parity_mismatch={mismatch})"),
         "vs_baseline": round(speedup, 2),
-    }))
+        "p50_eval_ms": round(p50 * 1e3, 2),
+        "host_oracle_eval_ms": round(oracle_total * 1e3, 2),
+        "platform": platform,
+        "parity_mismatch": mismatch,
+    }), flush=True)
 
 
 if __name__ == "__main__":
