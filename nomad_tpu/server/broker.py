@@ -40,6 +40,7 @@ class EvalBroker:
         self._seq = 0
         self._stats = {"total_ready": 0, "total_unacked": 0,
                        "total_blocked": 0, "total_waiting": 0}
+        self._enqueued_at: Dict[str, float] = {}   # eval id -> ready time
         self._timer_thread: Optional[threading.Thread] = None
         self._shutdown = False
 
@@ -133,6 +134,7 @@ class EvalBroker:
         self._ready.setdefault(sched, [])
         heapq.heappush(self._ready[sched], (-ev.priority, self._seq, ev))
         self._evals.setdefault(ev.id, 0)
+        self._enqueued_at.setdefault(ev.id, time.time())
 
     # ------------------------------------------------------------------
     def dequeue(self, schedulers: List[str], timeout: Optional[float] = None
@@ -162,6 +164,13 @@ class EvalBroker:
                     self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
                     self._unack[ev.id] = (ev, token,
                                           time.time() + self.nack_timeout)
+                    t_ready = self._enqueued_at.pop(ev.id, None)
+                    if t_ready is not None:
+                        # time-to-dequeue (reference: eval_broker stats /
+                        # `nomad.broker.*_ready` age tracking)
+                        from .telemetry import metrics
+                        metrics.sample_ms("nomad.broker.eval_wait",
+                                          (time.time() - t_ready) * 1e3)
                     return ev, token
                 if deadline is not None:
                     remaining = deadline - time.time()

@@ -21,6 +21,7 @@ from ..structs import (
     Allocation, Evaluation, Plan, PlanResult, allocs_fit,
     NODE_STATUS_DISCONNECTED, NODE_STATUS_DOWN, NODE_STATUS_READY,
 )
+from .telemetry import metrics
 
 
 class BadNodeTracker:
@@ -68,6 +69,7 @@ class Planner:
                                         thread_name_prefix="plan-verify")
         self.plans_applied = 0
         self.plans_rejected = 0
+        self._depth_lock_free = 0  # approximate gauge; benign data race
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
@@ -78,20 +80,34 @@ class Planner:
               ) -> PlanResult:
         """Verify against latest state, commit what fits
         (reference: planApply plan_apply.go:96 + evaluatePlan :468)."""
-        with self._serial:
-            snapshot = self.state.snapshot()
+        # queue depth = submissions currently waiting on the serialized
+        # applier (reference: `nomad.plan.queue_depth`, plan_queue.go stats)
+        self._depth_lock_free += 1
+        metrics.sample_ms("nomad.plan.queue_depth", float(
+            self._depth_lock_free - 1))
+        try:
+            with self._serial:
+                return self._apply_locked(plan, eval_updates)
+        finally:
+            self._depth_lock_free -= 1
+
+    def _apply_locked(self, plan: Plan,
+                      eval_updates: Optional[List[Evaluation]] = None
+                      ) -> PlanResult:
+        snapshot = self.state.snapshot()
+        with metrics.measure("nomad.plan.evaluate"):
             result = self._evaluate_plan(snapshot, plan)
-            if result.is_no_op() and not plan.is_no_op():
-                # everything was rejected; hand back a refresh index
-                result.refresh_index = self.state.latest_index()
-                self.plans_rejected += 1
-                return result
-            index = self.state.upsert_plan_results(result, eval_updates)
-            result.alloc_index = index
-            if result.rejected_nodes:
-                result.refresh_index = index
-            self.plans_applied += 1
+        if result.is_no_op() and not plan.is_no_op():
+            # everything was rejected; hand back a refresh index
+            result.refresh_index = self.state.latest_index()
+            self.plans_rejected += 1
             return result
+        index = self.state.upsert_plan_results(result, eval_updates)
+        result.alloc_index = index
+        if result.rejected_nodes:
+            result.refresh_index = index
+        self.plans_applied += 1
+        return result
 
     # ------------------------------------------------------------------
     def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:

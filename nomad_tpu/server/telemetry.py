@@ -1,0 +1,118 @@
+"""In-process telemetry: the scheduler series the reference publishes.
+
+Semantic parity with the go-metrics instrumentation sites in
+/root/reference/nomad/worker.go:501,535,592,611,656 and plan_apply.go:218,469
+and the series documented in
+website/content/docs/operations/metrics-reference.mdx:105-115
+(`nomad.plan.evaluate`, `nomad.plan.submit`, `nomad.worker.wait_for_index`,
+`nomad.worker.invoke_scheduler_<type>`, `nomad.plan.queue_depth`).
+
+These series are the measurable proxies BASELINE.md defines for the perf
+claim, plus the TPU-specific `nomad.scheduler.placements_tpu` /
+`placements_host` ratio that makes solver-fallback regressions visible.
+
+Design: a process-global registry of counters + sample series (ring buffer
+of the most recent samples with running count/sum/min/max; percentiles are
+computed over the buffer at snapshot time). Everything is thread-safe and
+cheap enough to sit in the hot path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+_BUF = 2048
+
+
+class _Series:
+    __slots__ = ("count", "total", "vmin", "vmax", "buf", "pos")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.buf: List[float] = []
+        self.pos = 0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+        if len(self.buf) < _BUF:
+            self.buf.append(v)
+        else:
+            self.buf[self.pos] = v
+            self.pos = (self.pos + 1) % _BUF
+
+    def snapshot(self) -> dict:
+        out = {"count": self.count,
+               "mean_ms": (self.total / self.count) if self.count else 0.0,
+               "min_ms": self.vmin if self.count else 0.0,
+               "max_ms": self.vmax if self.count else 0.0}
+        if self.buf:
+            s = sorted(self.buf)
+            n = len(s)
+            out["p50_ms"] = s[n // 2]
+            out["p95_ms"] = s[min(n - 1, int(n * 0.95))]
+            out["p99_ms"] = s[min(n - 1, int(n * 0.99))]
+        return out
+
+
+class Telemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+        self._counters: Dict[str, int] = {}
+
+    def sample_ms(self, name: str, ms: float) -> None:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                s = self._series[name] = _Series()
+            s.add(ms)
+
+    def measure(self, name: str):
+        """Context manager timing a block into `name` (milliseconds)."""
+        return _Timer(self, name)
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "samples": {k: v.snapshot()
+                            for k, v in self._series.items()},
+                "counters": dict(self._counters),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._counters.clear()
+
+
+class _Timer:
+    __slots__ = ("t", "name", "t0")
+
+    def __init__(self, t: Telemetry, name: str):
+        self.t = t
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.t.sample_ms(self.name, (time.perf_counter() - self.t0) * 1e3)
+        return False
+
+
+# Process-global registry, like go-metrics' global sink fanout.
+metrics = Telemetry()

@@ -13,6 +13,7 @@ from ..scheduler.factory import new_scheduler
 from ..structs import (
     Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
 )
+from .telemetry import metrics
 
 ALL_SCHEDULERS = ["service", "batch", "system", "sysbatch", "_core"]
 
@@ -26,7 +27,10 @@ class WorkerPlanner:
         self.eval_token = eval_token
 
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], object]:
-        result = self.server.planner.apply(plan)
+        # (reference: worker.go:656 `nomad.plan.submit` -- wall time of the
+        # whole submission incl. queue wait at the serialized applier)
+        with metrics.measure("nomad.plan.submit"):
+            result = self.server.planner.apply(plan)
         new_state = None
         if result.rejected_nodes or (result.is_no_op() and not plan.is_no_op()):
             # partial/failed commit: scheduler refreshes its snapshot
@@ -84,11 +88,13 @@ class Worker(threading.Thread):
     def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
         """(reference: worker.go:610 invokeScheduler). The snapshot must be
         at least as fresh as the eval's creation (snapshotMinIndex :591)."""
-        self.server.state.block_until(ev.modify_index - 1, timeout=2.0)
+        with metrics.measure("nomad.worker.wait_for_index"):
+            self.server.state.block_until(ev.modify_index - 1, timeout=2.0)
         snapshot = self.server.state.snapshot()
         planner = WorkerPlanner(self.server, token)
-        sched = new_scheduler(ev.type if ev.type in
-                              ("service", "batch", "system", "sysbatch")
-                              else "service",
-                              snapshot, planner)
-        sched.process(ev)
+        sched_type = (ev.type if ev.type in
+                      ("service", "batch", "system", "sysbatch")
+                      else "service")
+        sched = new_scheduler(sched_type, snapshot, planner)
+        with metrics.measure(f"nomad.worker.invoke_scheduler_{sched_type}"):
+            sched.process(ev)

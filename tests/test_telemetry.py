@@ -1,0 +1,75 @@
+"""Scheduler telemetry series (reference: nomad/worker.go:501-656 and
+plan_apply.go:218,469 instrumentation; series names from
+website/content/docs/operations/metrics-reference.mdx:105-115)."""
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.client import SimClient
+from nomad_tpu.server import Server
+from nomad_tpu.server.telemetry import Telemetry, metrics
+
+
+def wait_until(cond, timeout=10.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_series_stats():
+    t = Telemetry()
+    for v in [1.0, 2.0, 3.0, 4.0, 100.0]:
+        t.sample_ms("x", v)
+    t.incr("c")
+    t.incr("c", 2)
+    snap = t.snapshot()
+    s = snap["samples"]["x"]
+    assert s["count"] == 5
+    assert s["min_ms"] == 1.0
+    assert s["max_ms"] == 100.0
+    assert s["p50_ms"] == 3.0
+    assert snap["counters"]["c"] == 3
+    t.reset()
+    assert t.snapshot() == {"samples": {}, "counters": {}}
+
+
+def test_measure_context_manager():
+    t = Telemetry()
+    with t.measure("block"):
+        time.sleep(0.01)
+    s = t.snapshot()["samples"]["block"]
+    assert s["count"] == 1
+    assert s["mean_ms"] >= 5.0
+
+
+def test_scheduler_series_emitted_end_to_end():
+    """Processing one job through the dev server must emit the reference's
+    scheduler series: plan.evaluate, plan.submit, worker.wait_for_index,
+    invoke_scheduler_<type>, broker.eval_wait."""
+    metrics.reset()
+    server = Server(num_workers=2, heartbeat_ttl=5.0)
+    server.start()
+    try:
+        c = SimClient(server, mock.node())
+        c.start()
+        wait_until(lambda: len(server.state.nodes()) == 1,
+                   msg="node registered")
+        job = mock.job()
+        job.task_groups[0].count = 2
+        server.register_job(job)
+        wait_until(lambda: len(server.state.allocs_by_job(
+            job.namespace, job.id)) == 2, msg="allocs placed")
+        snap = metrics.snapshot()
+        for name in ("nomad.plan.evaluate", "nomad.plan.submit",
+                     "nomad.worker.wait_for_index",
+                     "nomad.worker.invoke_scheduler_service",
+                     "nomad.broker.eval_wait",
+                     "nomad.plan.queue_depth"):
+            assert name in snap["samples"], (name, sorted(snap["samples"]))
+            assert snap["samples"][name]["count"] >= 1
+        assert snap["counters"]["nomad.scheduler.placements_host"] >= 2
+        c.stop()
+    finally:
+        server.shutdown()
