@@ -47,11 +47,16 @@ class SetStatusError(Exception):
 class GenericScheduler:
     """(reference: generic_sched.go:101 GenericScheduler)"""
 
-    def __init__(self, state, planner, batch: bool = False, logger=None):
+    def __init__(self, state, planner, batch: bool = False, logger=None,
+                 solve_hook=None):
         self.state = state
         self.planner = planner
         self.batch = batch
         self.logger = logger
+        # Batched-dispatch rendezvous (solver/batch.py make_solve_hook):
+        # when set, dense solves route through the coordinator so many
+        # evals fuse into one device dispatch. None = solo dispatch.
+        self.solve_hook = solve_hook
 
         self.eval: Optional[Evaluation] = None
         self.job: Optional[Job] = None
@@ -392,7 +397,11 @@ class GenericScheduler:
                 {p.previous_alloc.node_id} if (p.reschedule and
                                                p.previous_alloc) else set()
                 for p in tg_places]
-            solved = service.solve(tg, tg_places, base_nodes, penalties)
+            if self.solve_hook is not None:
+                solved = self.solve_hook(service, tg, tg_places, base_nodes,
+                                         penalties)
+            else:
+                solved = service.solve(tg, tg_places, base_nodes, penalties)
             if solved is None:
                 fallback.extend(tg_places)
                 continue

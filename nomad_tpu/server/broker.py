@@ -147,31 +147,9 @@ class EvalBroker:
                 if not self.enabled:
                     return None, ""
                 self._check_nack_timeouts_locked()
-                best = None
-                best_key = None
-                for sched in schedulers:
-                    heap = self._ready.get(sched)
-                    while heap and heap[0][2].id in self._unack:
-                        heapq.heappop(heap)
-                    if heap:
-                        key = heap[0][:2]
-                        if best is None or key < best_key:
-                            best = sched
-                            best_key = key
-                if best is not None:
-                    _, _, ev = heapq.heappop(self._ready[best])
-                    token = f"token-{ev.id}-{self._evals.get(ev.id, 0)}"
-                    self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
-                    self._unack[ev.id] = (ev, token,
-                                          time.time() + self.nack_timeout)
-                    t_ready = self._enqueued_at.pop(ev.id, None)
-                    if t_ready is not None:
-                        # time-to-dequeue (reference: eval_broker stats /
-                        # `nomad.broker.*_ready` age tracking)
-                        from .telemetry import metrics
-                        metrics.sample_ms("nomad.broker.eval_wait",
-                                          (time.time() - t_ready) * 1e3)
-                    return ev, token
+                popped = self._pop_ready_locked(schedulers)
+                if popped is not None:
+                    return popped
                 if deadline is not None:
                     remaining = deadline - time.time()
                     if remaining <= 0:
@@ -179,6 +157,69 @@ class EvalBroker:
                     self._lock.wait(min(remaining, 0.5))
                 else:
                     self._lock.wait(0.5)
+
+    def _pop_ready_locked(self, schedulers: List[str],
+                          exclude_jobs: Optional[Set[Tuple[str, str]]] = None
+                          ) -> Optional[Tuple[Evaluation, str]]:
+        """Pop the highest-priority ready eval across the given scheduler
+        queues, mint its ack token, and move it to unacked. Shared by
+        dequeue() and dequeue_batch(); `exclude_jobs` implements the
+        batched path's distinct-jobs rule."""
+        best, best_key = None, None
+        for sched in schedulers:
+            heap = self._ready.get(sched)
+            while heap and heap[0][2].id in self._unack:
+                heapq.heappop(heap)
+            if not heap:
+                continue
+            if exclude_jobs is not None and (
+                    heap[0][2].namespace, heap[0][2].job_id) in exclude_jobs:
+                continue
+            key = heap[0][:2]
+            if best is None or key < best_key:
+                best, best_key = sched, key
+        if best is None:
+            return None
+        _, _, ev = heapq.heappop(self._ready[best])
+        token = f"token-{ev.id}-{self._evals.get(ev.id, 0)}"
+        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        self._unack[ev.id] = (ev, token, time.time() + self.nack_timeout)
+        t_ready = self._enqueued_at.pop(ev.id, None)
+        if t_ready is not None:
+            # time-to-dequeue (reference: eval_broker stats /
+            # `nomad.broker.*_ready` age tracking)
+            from .telemetry import metrics
+            metrics.sample_ms("nomad.broker.eval_wait",
+                              (time.time() - t_ready) * 1e3)
+        return ev, token
+
+    def dequeue_batch(self, schedulers: List[str], max_k: int,
+                      timeout: Optional[float] = None
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Dequeue up to max_k ready evals in one call: block for the
+        first, then greedily drain whatever else is immediately ready.
+        Distinct jobs only -- two evals of one job must not run
+        concurrently (the reference broker's pending-per-job invariant).
+        This is the coalescing entry point the batched solver needs
+        (SURVEY.md section 7 hard part 5); the reference contract is
+        one-eval-per-dequeue (eval_broker.go:354)."""
+        out: List[Tuple[Evaluation, str]] = []
+        ev, token = self.dequeue(schedulers, timeout=timeout)
+        if ev is None:
+            return out
+        out.append((ev, token))
+        jobs = {(ev.namespace, ev.job_id)}
+        with self._lock:
+            while len(out) < max_k:
+                self._check_nack_timeouts_locked()
+                popped = self._pop_ready_locked(schedulers,
+                                                exclude_jobs=jobs)
+                if popped is None:
+                    break
+                nxt, tok = popped
+                jobs.add((nxt.namespace, nxt.job_id))
+                out.append((nxt, tok))
+        return out
 
     def _check_nack_timeouts_locked(self) -> None:
         now = time.time()

@@ -30,7 +30,7 @@ from ..structs import (
 )
 from .broker import BlockedEvals, EvalBroker
 from .plan_apply import Planner
-from .worker import Worker
+from .worker import BatchWorker, Worker
 
 DEFAULT_HEARTBEAT_TTL = 10.0
 GC_EVAL_THRESHOLD = 3600.0
@@ -83,7 +83,8 @@ class Server:
     def __init__(self, num_workers: Optional[int] = None,
                  heartbeat_ttl: float = DEFAULT_HEARTBEAT_TTL,
                  logger=None, state=None, acl_enabled: bool = False,
-                 region: str = "global"):
+                 region: str = "global", eval_batching: bool = True,
+                 batch_width: Optional[int] = None):
         import os
         from ..acl import Resolver
         self.logger = logger
@@ -103,6 +104,11 @@ class Server:
         self.blocked_evals = BlockedEvals(self.broker)
         self.planner = Planner(self.state)
         self.num_workers = num_workers or max(2, (os.cpu_count() or 4))
+        # Eval coalescing (solver/batch.py): one BatchWorker running
+        # num_workers eval threads per batch replaces the plain worker
+        # pool; dense solves fuse into one device dispatch per rendezvous.
+        self.eval_batching = eval_batching
+        self.batch_width = batch_width or self.num_workers
         self.workers: List[Worker] = []
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_deadlines: Dict[str, float] = {}
@@ -129,9 +135,25 @@ class Server:
                          (self._run_periodic, "periodic"),
                          (self._run_deployment_watcher, "deploy-watch"),
                          (self._run_volume_watcher, "volume-watch")):
-            t = threading.Thread(target=fn, daemon=True, name=name)
+            t = threading.Thread(target=self._supervised, args=(fn, name),
+                                 daemon=True, name=name)
             t.start()
             self._threads.append(t)
+
+    def _supervised(self, fn, name: str) -> None:
+        """Background watchers must survive a bad iteration: a dead watcher
+        silently stops deployments/GC/heartbeats (the reference's leader
+        goroutines log and keep running). Restart the loop on error."""
+        import traceback
+        while not self._shutdown.is_set():
+            try:
+                fn()
+                return          # clean exit (shutdown)
+            except Exception:
+                print(f"[nomad-tpu] {name} watcher error (restarting):",
+                      file=__import__("sys").stderr)
+                traceback.print_exc()
+                self._shutdown.wait(0.5)
 
     def establish_leadership(self) -> None:
         """(reference: leader.go:357 establishLeadership -- enable broker
@@ -147,10 +169,20 @@ class Server:
             self._restore_evals()
             self._initialize_heartbeat_timers()
             self._restore_periodic_launch_times()
-            for i in range(self.num_workers):
-                w = Worker(self, i)
-                w.start()
-                self.workers.append(w)
+            if self.eval_batching:
+                # TWO overlapping batch workers: a straggler eval convoys
+                # only its own batch while the other worker keeps draining
+                # the queue (and packs the next dispatch while the device
+                # is busy with the current one).
+                for i in range(2):
+                    w = BatchWorker(self, i, width=self.batch_width)
+                    w.start()
+                    self.workers.append(w)
+            else:
+                for i in range(self.num_workers):
+                    w = Worker(self, i)
+                    w.start()
+                    self.workers.append(w)
             self._leader_active.set()
 
     def revoke_leadership(self) -> None:

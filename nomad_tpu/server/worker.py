@@ -88,13 +88,99 @@ class Worker(threading.Thread):
     def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
         """(reference: worker.go:610 invokeScheduler). The snapshot must be
         at least as fresh as the eval's creation (snapshotMinIndex :591)."""
-        with metrics.measure("nomad.worker.wait_for_index"):
-            self.server.state.block_until(ev.modify_index - 1, timeout=2.0)
-        snapshot = self.server.state.snapshot()
-        planner = WorkerPlanner(self.server, token)
-        sched_type = (ev.type if ev.type in
-                      ("service", "batch", "system", "sysbatch")
-                      else "service")
-        sched = new_scheduler(sched_type, snapshot, planner)
-        with metrics.measure(f"nomad.worker.invoke_scheduler_{sched_type}"):
-            sched.process(ev)
+        invoke_scheduler(self.server, ev, token)
+
+
+def invoke_scheduler(server, ev: Evaluation, token: str,
+                     solve_hook=None) -> None:
+    """(reference: worker.go:610 invokeScheduler)"""
+    with metrics.measure("nomad.worker.wait_for_index"):
+        server.state.block_until(ev.modify_index - 1, timeout=2.0)
+    snapshot = server.state.snapshot()
+    planner = WorkerPlanner(server, token)
+    sched_type = (ev.type if ev.type in
+                  ("service", "batch", "system", "sysbatch")
+                  else "service")
+    kwargs = {}
+    if solve_hook is not None and sched_type in ("service", "batch"):
+        kwargs["solve_hook"] = solve_hook
+    sched = new_scheduler(sched_type, snapshot, planner, **kwargs)
+    with metrics.measure(f"nomad.worker.invoke_scheduler_{sched_type}"):
+        sched.process(ev)
+
+
+class BatchWorker(threading.Thread):
+    """Eval-coalescing worker: dequeues up to `width` compatible evals and
+    runs their schedulers concurrently, rendezvousing dense solves into ONE
+    fused device dispatch (solver/batch.py SolveBarrier).
+
+    This replaces the reference's one-eval-per-worker contract
+    (nomad/worker.go:397 + scheduler/scheduler.go:59-68) with the
+    TPU-native amortized form: per-eval semantics are unchanged (each eval
+    runs the stock GenericScheduler against its own snapshot; the
+    serialized plan applier resolves cross-eval conflicts), only the device
+    dispatch is shared. With zero or one dense-eligible eval per batch it
+    degrades to exactly the old behavior."""
+
+    def __init__(self, server, worker_id: int, width: int = 8,
+                 schedulers: Optional[List[str]] = None,
+                 use_mesh: bool = True):
+        super().__init__(daemon=True, name=f"batch-worker-{worker_id}")
+        self.server = server
+        self.worker_id = worker_id
+        self.width = max(1, width)
+        self.schedulers = schedulers or ["service", "batch", "system",
+                                         "sysbatch"]
+        self.use_mesh = use_mesh
+        self._stop = threading.Event()
+        self.evals_processed = 0
+        self.batches_processed = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        # This thread may be the server's only scheduling path: one bad
+        # iteration must not silently halt all scheduling (same rationale
+        # as Server._supervised for watcher threads).
+        while not self._stop.is_set():
+            try:
+                self._run_batch()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                self._stop.wait(0.5)
+
+    def _run_batch(self) -> None:
+        from ..solver.batch import SolveBarrier, make_solve_hook
+
+        batch = self.server.broker.dequeue_batch(
+            self.schedulers, self.width, timeout=0.5)
+        if not batch:
+            return
+        metrics.sample_ms("nomad.worker.batch_width", float(len(batch)))
+        barrier = SolveBarrier(len(batch), use_mesh=self.use_mesh)
+        hook = make_solve_hook(barrier)
+        threads = [
+            threading.Thread(
+                target=self._run_one, args=(ev, token, barrier, hook),
+                daemon=True, name=f"batch-eval-{ev.id[:8]}")
+            for ev, token in batch]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.evals_processed += len(batch)
+        self.batches_processed += 1
+
+    def _run_one(self, ev: Evaluation, token: str, barrier, hook) -> None:
+        try:
+            invoke_scheduler(self.server, ev, token, solve_hook=hook)
+            self.server.broker.ack(ev.id, token)
+        except Exception:
+            self.server.broker.nack(ev.id, token)
+            if self.server.logger:
+                import traceback
+                traceback.print_exc()
+        finally:
+            barrier.done()

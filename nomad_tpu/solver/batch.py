@@ -1,0 +1,243 @@
+"""Eval-batching coordinator: fuse many evals' placements into one dispatch.
+
+This is the production form of SURVEY.md section 7 hard part 5: a 10K-node
+matrix is tiny, so the TPU win comes from coalescing many evaluations per
+device dispatch. The reference's contract is one eval per Scheduler.Process
+call (scheduler/scheduler.go:59-68) driven by one worker each
+(nomad/worker.go:397); here K workers' schedulers run concurrently and
+rendezvous at the solve point:
+
+  - each eval's GenericScheduler runs UNCHANGED on its own thread (retries,
+    blocked evals, multi-TG sequencing, plan submission all keep reference
+    semantics);
+  - when a scheduler reaches a dense solve it submits its PackedLane to the
+    barrier and blocks;
+  - when every active thread is either blocked at the barrier or finished,
+    the coordinator fuses compatible lanes (equal static shapes) into one
+    (E, ...) solve_eval_batch dispatch -- vmapped over the eval axis, and
+    sharded over an (evals, nodes) device mesh when more than one chip is
+    attached (parallel/mesh.py) -- then wakes each thread with its slice.
+
+Evals never see each other's in-flight placements; the serialized plan
+applier resolves conflicts exactly as nomad/plan_apply.go does (optimistic
+concurrency, SURVEY.md section 2.6.1).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..server.telemetry import metrics
+from .service import PackedLane
+
+# Pad the fused eval axis to these sizes so XLA compiles one program per
+# bucket, not one per batch size.
+E_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+# Safety valve: if a straggler thread neither finishes nor reaches the
+# barrier within this window (a bug, not a normal state), dispatch without
+# it rather than wedge every blocked eval.
+BARRIER_TIMEOUT_S = 10.0
+
+
+def _e_bucket(e: int) -> int:
+    for b in E_BUCKETS:
+        if e <= b:
+            return b
+    return int(2 ** np.ceil(np.log2(e)))
+
+
+def _pad_placement_axis(batch, p_pad: int):
+    """Grow a lane's placement axis to p_pad with inert (active=False)
+    steps so different-sized evals share one compiled program."""
+    p = batch.ask_cpu.shape[0]
+    if p == p_pad:
+        return batch
+
+    def grow(arr, fill=0):
+        out = np.full((p_pad,) + arr.shape[1:], fill, dtype=arr.dtype)
+        out[:p] = arr
+        return out
+
+    return type(batch)(
+        ask_cpu=grow(batch.ask_cpu), ask_mem=grow(batch.ask_mem),
+        ask_disk=grow(batch.ask_disk),
+        n_dyn_ports=grow(batch.n_dyn_ports),
+        has_static=grow(batch.has_static, False),
+        limit=grow(batch.limit), count=grow(batch.count, 1),
+        penalty_idx=grow(batch.penalty_idx, -1),
+        active=grow(batch.active, False))
+
+
+def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
+                   ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Group lanes by static-shape signature (placement axes pad to a
+    common bucket), solve each group as ONE batched dispatch, return
+    per-lane (chosen, scores, n_yielded) in input order."""
+    results: List = [None] * len(lanes)
+    groups: Dict[tuple, List[int]] = {}
+    for i, lane in enumerate(lanes):
+        n_pad, p, S, V, dtype_name, spread_alg = lane.signature()
+        groups.setdefault((n_pad, S, V, dtype_name, spread_alg),
+                          []).append(i)
+
+    for (n_pad, S, V, dtype_name, spread_alg), idxs in groups.items():
+        e_real = len(idxs)
+        e_pad = _e_bucket(e_real)
+        p_pad = _e_bucket(max(
+            lanes[i].batch.ask_cpu.shape[0] for i in idxs))
+        metrics.sample_ms("nomad.solver.batch_lanes", float(e_real))
+        padded = {i: _pad_placement_axis(lanes[i].batch, p_pad)
+                  for i in idxs}
+
+        def stack(attr_get):
+            first = np.asarray(attr_get(idxs[0]))
+            out = np.empty((e_pad,) + first.shape, dtype=first.dtype)
+            out[0] = first
+            for j, li in enumerate(idxs[1:], start=1):
+                out[j] = attr_get(li)
+            for j in range(e_real, e_pad):
+                out[j] = first          # padding lane: replica of lane 0
+            return out
+
+        lane0 = lanes[idxs[0]]
+        const = type(lane0.const)(*[
+            stack(lambda i, k=k: getattr(lanes[i].const, k))
+            for k in lane0.const._fields])
+        init = type(lane0.init)(*[
+            stack(lambda i, k=k: getattr(lanes[i].init, k))
+            for k in lane0.init._fields])
+        batch = type(lane0.batch)(*[
+            stack(lambda i, k=k: getattr(padded[i], k))
+            for k in lane0.batch._fields])
+        # padding lanes must not place anything
+        if e_pad > e_real:
+            batch.active[e_real:] = False
+
+        chosen, scores, n_yielded = _dispatch(
+            const, init, batch, spread_alg, dtype_name, use_mesh)
+        for j, li in enumerate(idxs):
+            p_real = lanes[li].batch.ask_cpu.shape[0]
+            results[li] = (
+                np.asarray(chosen[j][:p_real]).astype(np.int64),
+                np.asarray(scores[j][:p_real]),
+                np.asarray(n_yielded[j][:p_real]).astype(np.int64))
+    return results
+
+
+def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
+              use_mesh: bool):
+    """One solve_eval_batch call; shards over an (evals, nodes) mesh when
+    multiple devices are attached and the shapes divide the mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    E = const.cpu_cap.shape[0]
+    N = const.cpu_cap.shape[1]
+    mesh = None
+    if use_mesh and jax.device_count() > 1:
+        from ..parallel.mesh import make_mesh, shard_solver_inputs
+        cand = make_mesh()
+        e_par, n_par = cand.devices.shape
+        if E % e_par == 0 and N % n_par == 0:
+            mesh = cand
+
+    from .binpack import solve_eval_batch
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        with mesh:
+            s_const, s_init, s_batch = shard_solver_inputs(
+                mesh, const, init, batch)
+            fn = jax.jit(
+                lambda c, i, b: solve_eval_batch(
+                    c, i, b, spread_alg=spread_alg, dtype_name=dtype_name),
+                out_shardings=NamedSharding(mesh, P()))
+            chosen, scores, n_yielded, _ = fn(s_const, s_init, s_batch)
+    else:
+        chosen, scores, n_yielded, _ = solve_eval_batch(
+            const, init, batch, spread_alg=spread_alg,
+            dtype_name=dtype_name)
+    combined = np.asarray(jnp.concatenate([
+        chosen.astype(scores.dtype)[None], scores[None],
+        n_yielded.astype(scores.dtype)[None]], axis=0))
+    return combined[0], combined[1], combined[2]
+
+
+class SolveBarrier:
+    """Rendezvous point for one batch of eval threads.
+
+    Threads call solve() (blocking) or done() (on exit). The LAST thread to
+    arrive -- when arrivals + finished == participants -- performs the fused
+    dispatch for everyone and wakes them (baton-passing, no extra
+    dispatcher thread)."""
+
+    def __init__(self, participants: int, use_mesh: bool = True):
+        self._cv = threading.Condition()
+        self._participants = participants
+        self._finished = 0
+        self._waiting: List[Tuple[PackedLane, dict]] = []
+        self._use_mesh = use_mesh
+        self._generation = 0
+
+    def done(self) -> None:
+        """Thread finished its eval (no more solves coming)."""
+        with self._cv:
+            self._finished += 1
+            if self._ready_locked():
+                self._dispatch_locked()
+
+    def solve(self, lane: PackedLane):
+        """Block until the batch dispatches; returns this lane's
+        (chosen, scores, n_yielded). A dispatch failure re-raises in EVERY
+        participating thread (each eval then nacks independently)."""
+        cell: dict = {}
+        with self._cv:
+            self._waiting.append((lane, cell))
+            if self._ready_locked():
+                self._dispatch_locked()
+            else:
+                gen = self._generation
+                while "result" not in cell and "error" not in cell:
+                    if not self._cv.wait(timeout=BARRIER_TIMEOUT_S):
+                        # straggler safety valve: dispatch what we have
+                        if self._generation == gen:
+                            self._dispatch_locked()
+                        break
+            if "error" in cell:
+                raise cell["error"]
+            return cell["result"]
+
+    def _ready_locked(self) -> bool:
+        return (self._waiting
+                and len(self._waiting) + self._finished
+                >= self._participants)
+
+    def _dispatch_locked(self) -> None:
+        batch = self._waiting
+        self._waiting = []
+        self._generation += 1
+        lanes = [lane for lane, _ in batch]
+        try:
+            results = fuse_and_solve(lanes, use_mesh=self._use_mesh)
+            for (lane, cell), res in zip(batch, results):
+                cell["result"] = res
+        except Exception as e:  # noqa: BLE001 -- waiters must not strand
+            for _, cell in batch:
+                cell["error"] = e
+        finally:
+            self._cv.notify_all()
+
+
+def make_solve_hook(barrier: SolveBarrier):
+    """The hook GenericScheduler calls instead of service.solve(): pack on
+    the calling thread, solve at the barrier, materialize on the calling
+    thread."""
+    def hook(service, tg, places, nodes, penalties):
+        lane = service.pack(tg, places, nodes, penalties)
+        if lane is None:
+            return None          # not solver-eligible -> host fallback
+        chosen, scores, n_yielded = barrier.solve(lane)
+        return service.materialize(lane, chosen, scores, n_yielded)
+    return hook

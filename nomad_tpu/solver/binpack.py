@@ -363,18 +363,20 @@ def make_node_const(matrix, feasible: np.ndarray, affinity,
         weights = np.zeros(0, dtype=dtype)
         sum_w = np.asarray(0.0, dtype=dtype)
         n_s = 0
+    # numpy-backed on purpose: lanes from many evals are np.stack'ed into
+    # one (E, ...) batch before any device transfer (solver/batch.py)
     return NodeConst(
-        cpu_cap=jnp.asarray(cpu), mem_cap=jnp.asarray(mem),
-        disk_cap=jnp.asarray(disk), feasible=jnp.asarray(feas),
-        affinity=jnp.asarray(aff),
-        has_affinity=jnp.asarray(affinity is not None),
-        distinct_hosts=jnp.asarray(bool(distinct_hosts)),
-        distinct_job_level=jnp.asarray(bool(distinct_job_level)),
-        spread_vidx=jnp.asarray(vidx), spread_desired=jnp.asarray(desired),
-        spread_has_targets=jnp.asarray(has_t),
-        spread_weights=jnp.asarray(weights),
-        spread_sum_weights=jnp.asarray(sum_w),
-        n_spreads=jnp.asarray(n_s, dtype=jnp.int32))
+        cpu_cap=cpu, mem_cap=mem,
+        disk_cap=disk, feasible=np.asarray(feas),
+        affinity=aff,
+        has_affinity=np.asarray(affinity is not None),
+        distinct_hosts=np.asarray(bool(distinct_hosts)),
+        distinct_job_level=np.asarray(bool(distinct_job_level)),
+        spread_vidx=np.asarray(vidx), spread_desired=np.asarray(desired),
+        spread_has_targets=np.asarray(has_t),
+        spread_weights=np.asarray(weights),
+        spread_sum_weights=np.asarray(sum_w),
+        n_spreads=np.asarray(n_s, dtype=np.int32))
 
 
 def make_node_state(usage, matrix, static_ports_free: np.ndarray,
@@ -384,12 +386,11 @@ def make_node_state(usage, matrix, static_ports_free: np.ndarray,
     counts = (spread_counts if spread_counts is not None
               else np.zeros((n_spreads, max(n_values, 1)), dtype=np.int32))
     return NodeState(
-        used_cpu=jnp.asarray(usage.used_cpu[perm].astype(dtype)),
-        used_mem=jnp.asarray(usage.used_mem[perm].astype(dtype)),
-        used_disk=jnp.asarray(usage.used_disk[perm].astype(dtype)),
-        placed=jnp.asarray(usage.placed_jobtg[perm]),
-        placed_job=jnp.asarray(usage.placed_job[perm]),
-        static_free=jnp.asarray(static_ports_free[perm]),
-        dyn_avail=jnp.asarray(
-            (matrix.dyn_free - usage.dyn_used)[perm].astype(np.int32)),
-        spread_counts=jnp.asarray(counts))
+        used_cpu=usage.used_cpu[perm].astype(dtype),
+        used_mem=usage.used_mem[perm].astype(dtype),
+        used_disk=usage.used_disk[perm].astype(dtype),
+        placed=np.asarray(usage.placed_jobtg[perm], dtype=np.int32),
+        placed_job=np.asarray(usage.placed_job[perm], dtype=np.int32),
+        static_free=np.asarray(static_ports_free[perm]),
+        dyn_avail=(matrix.dyn_free - usage.dyn_used)[perm].astype(np.int32),
+        spread_counts=np.asarray(counts))

@@ -41,6 +41,38 @@ class TpuPlacement:
         self.n_yielded = n_yielded
 
 
+class PackedLane:
+    """One (eval, task-group) batch fully marshalled for the dense solver:
+    the unit the batch coordinator fuses across evals (solve_eval_batch's
+    leading axis). Holds the numpy-backed solver inputs plus everything
+    materialize() needs to map solved indexes back to structs."""
+
+    __slots__ = ("service", "tg", "places", "nodes", "order", "const",
+                 "init", "batch", "dtype_name", "spread_alg")
+
+    def __init__(self, service, tg, places, nodes, order, const, init,
+                 batch, dtype_name, spread_alg):
+        self.service = service
+        self.tg = tg
+        self.places = places
+        self.nodes = nodes
+        self.order = order
+        self.const = const
+        self.init = init
+        self.batch = batch
+        self.dtype_name = dtype_name
+        self.spread_alg = spread_alg
+
+    def signature(self) -> tuple:
+        """Lanes with equal signatures can fuse into one vmapped dispatch
+        (identical static shapes + static jit args)."""
+        return (self.const.cpu_cap.shape[0],          # n_pad
+                self.batch.ask_cpu.shape[0],          # P (pre-padded)
+                self.const.spread_vidx.shape[0],      # S
+                self.const.spread_desired.shape[1],   # V
+                self.dtype_name, self.spread_alg)
+
+
 def tg_solver_eligible(tg, job=None) -> bool:
     """Does the dense path model everything this TG asks for? Anything it
     does not (devices, reserved cores, per-task networks, distinct_property,
@@ -65,6 +97,27 @@ def tg_solver_eligible(tg, job=None) -> bool:
         if any(t.percent == 0 for t in s.spread_target):
             return False
     return True
+
+
+def dispatch_lane(lane: PackedLane):
+    """Solve ONE lane in its own device dispatch; returns host-side numpy
+    (chosen, scores, n_yielded). The batched path fuses many lanes through
+    solver.batch instead."""
+    import jax.numpy as jnp
+    from .binpack import solve_placements
+
+    chosen, scores, n_yielded, _ = solve_placements(
+        lane.const, lane.init, lane.batch, spread_alg=lane.spread_alg,
+        dtype_name=lane.dtype_name)
+    # Single device->host fetch: individual fetches each pay the full
+    # host<->device round trip (severe over a tunneled TPU), so stack all
+    # outputs and read once. int32 values are exact in f32/f64 here
+    # (node indexes < 2^24).
+    combined = np.asarray(jnp.stack([
+        chosen.astype(scores.dtype), scores,
+        n_yielded.astype(scores.dtype)]))
+    return (combined[0].astype(np.int64), combined[1],
+            combined[2].astype(np.int64))
 
 
 class TpuPlacementService:
@@ -94,10 +147,20 @@ class TpuPlacementService:
               ) -> Optional[List[TpuPlacement]]:
         """Returns one TpuPlacement per place (node=None for failures), or
         None when the TG is not solver-eligible (caller falls back)."""
+        lane = self.pack(tg, places, nodes, penalty_nodes_per_place)
+        if lane is None:
+            return None
+        chosen, scores, n_yielded = dispatch_lane(lane)
+        return self.materialize(lane, chosen, scores, n_yielded)
+
+    def pack(self, tg, places, nodes, penalty_nodes_per_place=None
+             ) -> Optional[PackedLane]:
+        """Marshal one TG's placements into a PackedLane (numpy-backed, no
+        device dispatch). Returns None when the TG is not solver-eligible.
+        (Placement-axis padding for cross-eval fusing happens in
+        solver/batch.py _pad_placement_axis.)"""
         from .binpack import (
-            PlacementBatch, make_node_const, make_node_state,
-            solve_placements)
-        import jax.numpy as jnp
+            PlacementBatch, make_node_const, make_node_state)
 
         if not tg_solver_eligible(tg, self.job) or not places:
             return None
@@ -181,35 +244,26 @@ class TpuPlacementService:
                     pos = id_to_pos.get(next(iter(pen)))
                     if pos is not None:
                         penalty[pi] = pos
-
         batch = PlacementBatch(
-            ask_cpu=jnp.full(P, float(ask.cpu), dtype=dtype),
-            ask_mem=jnp.full(P, float(ask.memory_mb), dtype=dtype),
-            ask_disk=jnp.full(P, float(ask.disk_mb), dtype=dtype),
-            n_dyn_ports=jnp.full(P, n_dyn, dtype=jnp.int32),
-            has_static=jnp.full(P, bool(static_ports)),
-            limit=jnp.full(P, limit, dtype=jnp.int32),
-            count=jnp.full(P, tg.count, dtype=jnp.int32),
-            penalty_idx=jnp.asarray(penalty),
-            active=jnp.ones(P, dtype=bool),
+            ask_cpu=np.full(P, float(ask.cpu), dtype=dtype),
+            ask_mem=np.full(P, float(ask.memory_mb), dtype=dtype),
+            ask_disk=np.full(P, float(ask.disk_mb), dtype=dtype),
+            n_dyn_ports=np.full(P, n_dyn, dtype=np.int32),
+            has_static=np.full(P, bool(static_ports)),
+            limit=np.full(P, limit, dtype=np.int32),
+            count=np.full(P, tg.count, dtype=np.int32),
+            penalty_idx=penalty,
+            active=np.ones(P, dtype=bool),
         )
+        return PackedLane(self, tg, places, nodes, order, const, init,
+                          batch, np.dtype(dtype).name, self.spread_alg)
 
-        chosen, scores, n_yielded, _ = solve_placements(
-            const, init, batch, spread_alg=self.spread_alg,
-            dtype_name=np.dtype(dtype).name)
-        # Single device->host fetch: individual fetches each pay the full
-        # host<->device round trip (severe over a tunneled TPU), so stack all
-        # outputs and read once. int32 values are exact in f32/f64 here
-        # (node indexes < 2^24).
-        combined = np.asarray(jnp.stack([
-            chosen.astype(scores.dtype), scores,
-            n_yielded.astype(scores.dtype)]))
-        chosen = combined[0].astype(np.int64)
-        scores = combined[1]
-        n_yielded = combined[2].astype(np.int64)
-
-        # Materialize: map shuffled positions back to nodes, assign real
-        # ports by replaying the deterministic NetworkIndex per node.
+    def materialize(self, lane: PackedLane, chosen, scores, n_yielded
+                    ) -> List[TpuPlacement]:
+        """Map solved shuffled positions back to nodes, assigning real
+        ports by replaying the deterministic NetworkIndex per node."""
+        tg, places, nodes, order = (lane.tg, lane.places, lane.nodes,
+                                    lane.order)
         out: List[TpuPlacement] = []
         net_indexes: Dict[str, NetworkIndex] = {}
         for pi, place in enumerate(places):
