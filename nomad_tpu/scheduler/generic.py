@@ -378,7 +378,8 @@ class GenericScheduler:
 
         fallback: List[AllocPlaceResult] = []
         service = TpuPlacementService(
-            self.ctx, self.job, self.batch, spread_alg)
+            self.ctx, self.job, self.batch, spread_alg,
+            preempt=self._preemption_enabled())
         # the solver derives the same shuffle the stack applied from the
         # eval id, so hand it the pre-shuffle base ordering
         base_nodes = getattr(self, "base_nodes", None) or \
@@ -389,8 +390,8 @@ class GenericScheduler:
             tg = tg_places[0].task_group
             sticky = tg.ephemeral_disk.sticky and any(
                 p.previous_alloc is not None for p in tg_places)
-            if (self._preemption_enabled() or sticky
-                    or not tg_solver_eligible(tg, self.job)):
+            if (sticky or not tg_solver_eligible(
+                    tg, self.job, preempt=self._preemption_enabled())):
                 fallback.extend(tg_places)
                 continue
             penalties = [
@@ -428,6 +429,13 @@ class GenericScheduler:
         metrics = self.ctx.metrics.copy()
         metrics.nodes_evaluated = sp.n_yielded
         metrics.score_node(sp.node.id, "normalized-score", sp.score)
+        if sp.preempted_allocs:
+            # same component the host records (rank.py:575
+            # PreemptionScoringIterator -> preemption_score(net_priority))
+            from .rank import net_priority, preemption_score
+            metrics.score_node(
+                sp.node.id, "preemption",
+                preemption_score(net_priority(sp.preempted_allocs)))
         alloc = Allocation(
             id=generate_uuid(),
             namespace=self.job.namespace,
@@ -457,6 +465,9 @@ class GenericScheduler:
                     prev_alloc_id=prev.id,
                     prev_node_id=prev.node_id))
                 alloc.reschedule_tracker = tracker
+        if sp.preempted_allocs:
+            for p in sp.preempted_allocs:
+                self.plan.append_preempted_alloc(p, alloc.id)
         from ..server.telemetry import metrics as _tm
         _tm.incr("nomad.scheduler.placements_tpu")
         self.plan.append_alloc(alloc)

@@ -79,11 +79,11 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
     results: List = [None] * len(lanes)
     groups: Dict[tuple, List[int]] = {}
     for i, lane in enumerate(lanes):
-        n_pad, p, S, V, dtype_name, spread_alg = lane.signature()
-        groups.setdefault((n_pad, S, V, dtype_name, spread_alg),
+        n_pad, p, S, V, A, G, dtype_name, spread_alg = lane.signature()
+        groups.setdefault((n_pad, S, V, A, G, dtype_name, spread_alg),
                           []).append(i)
 
-    for (n_pad, S, V, dtype_name, spread_alg), idxs in groups.items():
+    for (n_pad, S, V, A, G, dtype_name, spread_alg), idxs in groups.items():
         e_real = len(idxs)
         e_pad = _e_bucket(e_real)
         p_pad = _e_bucket(max(
@@ -116,23 +116,51 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
         if e_pad > e_real:
             batch.active[e_real:] = False
 
-        chosen, scores, n_yielded = _dispatch(
-            const, init, batch, spread_alg, dtype_name, use_mesh)
+        ptab = pinit = None
+        if A > 0:
+            ptab = type(lane0.ptab)(*[
+                stack(lambda i, k=k: getattr(lanes[i].ptab, k))
+                for k in lane0.ptab._fields])
+            pinit = type(lane0.pinit)(*[
+                stack(lambda i, k=k: getattr(lanes[i].pinit, k))
+                for k in lane0.pinit._fields])
+
+        out = _dispatch(const, init, batch, spread_alg, dtype_name,
+                        use_mesh, ptab=ptab, pinit=pinit)
+        if A > 0:
+            chosen, scores, n_yielded, evict_rows = out
+        else:
+            chosen, scores, n_yielded = out
         for j, li in enumerate(idxs):
             p_real = lanes[li].batch.ask_cpu.shape[0]
-            results[li] = (
-                np.asarray(chosen[j][:p_real]).astype(np.int64),
-                np.asarray(scores[j][:p_real]),
-                np.asarray(n_yielded[j][:p_real]).astype(np.int64))
+            res = [np.asarray(chosen[j][:p_real]).astype(np.int64),
+                   np.asarray(scores[j][:p_real]),
+                   np.asarray(n_yielded[j][:p_real]).astype(np.int64)]
+            if A > 0:
+                res.append(np.asarray(evict_rows[j][:p_real]))
+            results[li] = tuple(res)
     return results
 
 
 def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
-              use_mesh: bool):
-    """One solve_eval_batch call; shards over an (evals, nodes) mesh when
-    multiple devices are attached and the shapes divide the mesh."""
+              use_mesh: bool, ptab=None, pinit=None):
+    """One solve_eval_batch[_preempt] call; shards over an (evals, nodes)
+    mesh when multiple devices are attached and the shapes divide the
+    mesh (non-preempt path only; preemption tables stay single-device)."""
     import jax
     import jax.numpy as jnp
+
+    from .binpack import solve_eval_batch, solve_eval_batch_preempt
+
+    if ptab is not None:
+        chosen, scores, n_yielded, evict_rows, _ = solve_eval_batch_preempt(
+            const, init, batch, ptab, pinit, spread_alg=spread_alg,
+            dtype_name=dtype_name)
+        combined = np.asarray(jnp.concatenate([
+            chosen.astype(scores.dtype)[None], scores[None],
+            n_yielded.astype(scores.dtype)[None]], axis=0))
+        return (combined[0], combined[1], combined[2],
+                np.asarray(evict_rows))
 
     E = const.cpu_cap.shape[0]
     N = const.cpu_cap.shape[1]
@@ -144,7 +172,6 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         if E % e_par == 0 and N % n_par == 0:
             mesh = cand
 
-    from .binpack import solve_eval_batch
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         with mesh:
@@ -238,6 +265,5 @@ def make_solve_hook(barrier: SolveBarrier):
         lane = service.pack(tg, places, nodes, penalties)
         if lane is None:
             return None          # not solver-eligible -> host fallback
-        chosen, scores, n_yielded = barrier.solve(lane)
-        return service.materialize(lane, chosen, scores, n_yielded)
+        return service.materialize(lane, *barrier.solve(lane))
     return hook

@@ -173,6 +173,154 @@ def _select_window(score, fit, limit, dtype):
         jnp.sum(yielded.astype(jnp.int32))
 
 
+class PreemptTables(NamedTuple):
+    """Per-eval candidate-eviction tables for dense preemption
+    (reference: scheduler/preemption.go PreemptForTaskGroup :201-271,
+    filterAndGroupPreemptibleAllocs :666, basicResourceDistance :611,
+    filterSuperset :705). Candidate axis A = padded max allocs/node; rows
+    are in the SAME order as ctx.proposed_allocs so float-tie argmins break
+    identically to the host's first-strictly-smaller scan."""
+
+    cpu: jnp.ndarray         # (N, A) comparable usage per candidate
+    mem: jnp.ndarray         # (N, A)
+    disk: jnp.ndarray        # (N, A)
+    prio: jnp.ndarray        # (N, A) int32 job priority
+    maxp: jnp.ndarray        # (N, A) int32 migrate.max_parallel
+    grp: jnp.ndarray         # (N, A) int32 index into counts, -1 none
+    dyn_ports: jnp.ndarray   # (N, A) int32 dynamic-range ports held
+    static_rel: jnp.ndarray  # (N, A) bool holds an asked static port
+    valid: jnp.ndarray       # (N, A) bool eligible candidate
+    job_prio: jnp.ndarray    # () int32 scheduling job's priority
+
+
+class PreemptState(NamedTuple):
+    """Preemption scan carry: which candidates this eval already evicted,
+    and per-(job,tg) eviction counts feeding the max_parallel penalty
+    (reference: preemption.go scoreForTaskGroup / currentPreemptions)."""
+
+    evicted: jnp.ndarray     # (N, A) bool
+    counts: jnp.ndarray      # (G,) int32
+
+
+MAX_PARALLEL_PENALTY = 50.0  # preemption.go:16
+PREEMPT_SCORE_RATE = 0.0048  # rank.go preemptionScore
+PREEMPT_SCORE_ORIGIN = 2048.0
+
+
+def _distance(need_c, need_m, need_d, used_c, used_m, used_d):
+    """basicResourceDistance (preemption.go:611): component is 0 when the
+    corresponding ask dimension is <= 0."""
+    dc = jnp.where(need_c > 0, (need_c - used_c) / jnp.maximum(need_c, 1e-9),
+                   0.0)
+    dm = jnp.where(need_m > 0, (need_m - used_m) / jnp.maximum(need_m, 1e-9),
+                   0.0)
+    dd = jnp.where(need_d > 0, (need_d - used_d) / jnp.maximum(need_d, 1e-9),
+                   0.0)
+    return jnp.sqrt(dc * dc + dm * dm + dd * dd)
+
+
+def _preempt_search(state: NodeState, pstate: PreemptState,
+                    ptab: PreemptTables, const: NodeConst,
+                    ask_cpu, ask_mem, ask_disk, dtype,
+                    lo: int, hi: Optional[int]):
+    """Vectorized PreemptForTaskGroup over node positions [lo:hi).
+
+    Per node: greedily pick eligible candidates (ascending priority group,
+    then minimal distance+penalty) until the freed+free resources superset
+    the ask, then filterSuperset. Returns per-node (met, evict_mask (n,A),
+    freed_cpu/mem/disk, net_prio) for the slice."""
+    sl = slice(lo, hi)
+    used_c = ptab.cpu[sl].astype(dtype)
+    used_m = ptab.mem[sl].astype(dtype)
+    used_d = ptab.disk[sl].astype(dtype)
+    prio = ptab.prio[sl]
+    maxp = ptab.maxp[sl]
+    grp = ptab.grp[sl]
+    n, A = used_c.shape
+
+    eligible = (ptab.valid[sl] & ~pstate.evicted[sl]
+                & (ptab.job_prio - prio >= 10))
+    # free-after-all-current-allocs = capacity - carried usage
+    avail_c0 = const.cpu_cap[sl] - state.used_cpu[sl]
+    avail_m0 = const.mem_cap[sl] - state.used_mem[sl]
+    avail_d0 = const.disk_cap[sl] - state.used_disk[sl]
+
+    # max_parallel penalty from preemptions committed earlier in this eval
+    n_pre = jnp.where(grp >= 0, pstate.counts[jnp.maximum(grp, 0)], 0)
+    penalty = jnp.where((maxp > 0) & (n_pre >= maxp),
+                        ((n_pre + 1 - maxp).astype(dtype)
+                         * MAX_PARALLEL_PENALTY), 0.0)
+
+    big_i = jnp.iinfo(jnp.int32).max
+    inf = jnp.array(jnp.inf, dtype=dtype)
+
+    def cond(carry):
+        picked, av_c, av_m, av_d, _, _, _ = carry
+        met = (av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+        cand = eligible & ~picked
+        return jnp.any(~met & jnp.any(cand, axis=1))
+
+    def body(carry):
+        picked, av_c, av_m, av_d, ne_c, ne_m, ne_d = carry
+        met = (av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+        cand = eligible & ~picked
+        # ascending priority-group gating (preemption.go:666): only the
+        # lowest remaining priority is pickable this round
+        cur_prio = jnp.min(jnp.where(cand, prio, big_i), axis=1)
+        in_group = cand & (prio == cur_prio[:, None])
+        dist = _distance(ne_c[:, None], ne_m[:, None], ne_d[:, None],
+                         used_c, used_m, used_d) + penalty
+        key = jnp.where(in_group, dist, inf)
+        pick = jnp.argmin(key, axis=1)          # first-min ties = host order
+        do = ~met & jnp.any(in_group, axis=1)
+        onehot = (jnp.arange(A)[None, :] == pick[:, None]) & do[:, None]
+        pc = jnp.sum(jnp.where(onehot, used_c, 0.0), axis=1)
+        pm = jnp.sum(jnp.where(onehot, used_m, 0.0), axis=1)
+        pd = jnp.sum(jnp.where(onehot, used_d, 0.0), axis=1)
+        return (picked | onehot, av_c + pc, av_m + pm, av_d + pd,
+                ne_c - pc, ne_m - pm, ne_d - pd)
+
+    init = (jnp.zeros((n, A), dtype=bool), avail_c0, avail_m0, avail_d0,
+            jnp.full(n, ask_cpu, dtype=dtype),
+            jnp.full(n, ask_mem, dtype=dtype),
+            jnp.full(n, ask_disk, dtype=dtype))
+    picked, av_c, av_m, av_d, _, _, _ = jax.lax.while_loop(cond, body, init)
+    met = (av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+
+    # filterSuperset (preemption.go:705): re-add picked in DESCENDING
+    # distance-to-original-ask order until the ask is covered again.
+    d0 = _distance(ask_cpu, ask_mem, ask_disk, used_c, used_m, used_d)
+    sort_key = jnp.where(picked, -d0, inf)       # ascending(-d) = desc(d)
+    order = jnp.argsort(sort_key, axis=1, stable=True)
+    oc = jnp.take_along_axis(jnp.where(picked, used_c, 0.0), order, axis=1)
+    om = jnp.take_along_axis(jnp.where(picked, used_m, 0.0), order, axis=1)
+    od = jnp.take_along_axis(jnp.where(picked, used_d, 0.0), order, axis=1)
+    cum_c = avail_c0[:, None] + jnp.cumsum(oc, axis=1)
+    cum_m = avail_m0[:, None] + jnp.cumsum(om, axis=1)
+    cum_d = avail_d0[:, None] + jnp.cumsum(od, axis=1)
+    met_at = ((cum_c >= ask_cpu) & (cum_m >= ask_mem) & (cum_d >= ask_disk))
+    # first position (in sorted order) where cumulative covers the ask;
+    # keep sorted positions 0..first_met inclusive
+    first_met = jnp.argmax(met_at, axis=1)
+    keep_sorted = (jnp.arange(A)[None, :] <= first_met[:, None])
+    in_picked_sorted = jnp.take_along_axis(picked, order, axis=1)
+    keep_sorted = keep_sorted & in_picked_sorted
+    evict = jnp.zeros_like(picked)
+    evict = jax.vmap(lambda e, o, k: e.at[o].set(k))(evict, order,
+                                                     keep_sorted)
+
+    freed_c = jnp.sum(jnp.where(evict, used_c, 0.0), axis=1)
+    freed_m = jnp.sum(jnp.where(evict, used_m, 0.0), axis=1)
+    freed_d = jnp.sum(jnp.where(evict, used_d, 0.0), axis=1)
+
+    # netPriority (rank.go): max prio + sum/max over the evicted set
+    prio_f = prio.astype(dtype)
+    mx = jnp.max(jnp.where(evict, prio_f, 0.0), axis=1)
+    sm = jnp.sum(jnp.where(evict, prio_f, 0.0), axis=1)
+    net_prio = jnp.where(mx > 0, mx + sm / jnp.maximum(mx, 1e-9), 0.0)
+    return met, evict, freed_c, freed_m, freed_d, net_prio
+
+
 # The selection window only ever yields the first `limit` (<= ~14 for 10K
 # nodes) counted options in shuffled order, plus up to MAX_SKIP skips. So
 # whenever the first FAST_T shuffled positions contain >= limit counted
@@ -182,10 +330,10 @@ def _select_window(score, fit, limit, dtype):
 FAST_T = 1024
 
 
-def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
-                      spread_alg: bool, lo: int, hi: Optional[int]):
-    """One Stack.Select over node positions [lo:hi) (static slice).
-    Returns (chosen global index, score, n_yield, counted_in_slice)."""
+def _scoring_parts(state: NodeState, const: NodeConst, b, dtype,
+                   spread_alg: bool, lo: int, hi: Optional[int]):
+    """Shared per-node fit + scoring over positions [lo:hi): returns
+    (fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem)."""
     (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
      penalty_idx, active) = b
     sl = slice(lo, hi)
@@ -199,13 +347,16 @@ def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
 
     distinct_count = jnp.where(const.distinct_job_level,
                                state.placed_job[sl], state.placed[sl])
-    fit = (const.feasible[sl]
+    # non-resource feasibility (constraints/ports/distinct) -- the part a
+    # successful preemption cannot rescue
+    feas_nonres = (const.feasible[sl]
+                   & (state.dyn_avail[sl] >= n_dyn)
+                   & (state.static_free[sl] | ~has_static)
+                   & (~const.distinct_hosts | (distinct_count == 0)))
+    fit = (feas_nonres
            & (new_cpu <= cpu_cap)
            & (new_mem <= mem_cap)
-           & (new_disk <= const.disk_cap[sl])
-           & (state.dyn_avail[sl] >= n_dyn)
-           & (state.static_free[sl] | ~has_static)
-           & (~const.distinct_hosts | (distinct_count == 0)))
+           & (new_disk <= const.disk_cap[sl]))
 
     free_cpu = 1.0 - new_cpu / jnp.maximum(cpu_cap, 1e-9)
     free_mem = 1.0 - new_mem / jnp.maximum(mem_cap, 1e-9)
@@ -222,9 +373,8 @@ def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
     resched = jnp.where(is_penalty, -1.0, 0.0)
     aff = jnp.where(const.has_affinity, const.affinity[sl], 0.0)
     aff_present = aff != 0.0
-    sliced_state = state._replace(spread_counts=state.spread_counts)
     sliced_const = const._replace(spread_vidx=const.spread_vidx[:, sl])
-    spread_total = _spread_score(sliced_state, sliced_const, dtype)
+    spread_total = _spread_score(state, sliced_const, dtype)
     spread_present = spread_total != 0.0
 
     nscores = (1
@@ -232,8 +382,12 @@ def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
                + is_penalty.astype(dtype)
                + aff_present.astype(dtype)
                + spread_present.astype(dtype))
-    final = (binpack + anti + resched + aff + spread_total) / nscores
+    other_sum = anti + resched + aff + spread_total
+    final = (binpack + other_sum) / nscores
+    return fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem
 
+
+def _window_outputs(final, fit, limit, dtype, lo):
     chosen, cscore, n_yield = _select_window(final, fit, limit, dtype)
     low = fit & (final <= SKIP_THRESHOLD)
     skip_rank = jnp.cumsum(low.astype(jnp.int32))
@@ -241,6 +395,63 @@ def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
     counted_total = jnp.sum((fit & ~skipped).astype(jnp.int32))
     chosen = jnp.where(chosen >= 0, chosen + lo, -1)
     return chosen, cscore, n_yield, counted_total
+
+
+def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
+                      spread_alg: bool, lo: int, hi: Optional[int]):
+    """One Stack.Select over node positions [lo:hi) (static slice).
+    Returns (chosen global index, score, n_yield, counted_in_slice)."""
+    limit = b[5]
+    fit, final, _, _, _, _, _ = _scoring_parts(
+        state, const, b, dtype, spread_alg, lo, hi)
+    return _window_outputs(final, fit, limit, dtype, lo)
+
+
+def _score_and_select_preempt(state: NodeState, pstate: PreemptState,
+                              ptab: PreemptTables, const: NodeConst, b,
+                              dtype, spread_alg: bool,
+                              lo: int, hi: Optional[int]):
+    """Stack.Select with eviction enabled (BinPackIterator evict=True,
+    rank.go:545-565): nodes that fail the resource fit but have a
+    successful preemption search are yielded with the post-eviction
+    binpack score plus the preemption penalty (rank.go:851 logistic on
+    netPriority), exactly like the host chain. Returns the plain window
+    outputs plus the chosen node's eviction row and freed resources."""
+    (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
+     penalty_idx, active) = b
+    sl = slice(lo, hi)
+    fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem = \
+        _scoring_parts(state, const, b, dtype, spread_alg, lo, hi)
+
+    met, evict, freed_c, freed_m, freed_d, net_prio = _preempt_search(
+        state, pstate, ptab, const, ask_cpu, ask_mem, ask_disk, dtype,
+        lo, hi)
+
+    fit_p = feas_nonres & ~fit & met
+    free_cpu_p = 1.0 - (new_cpu - freed_c) / jnp.maximum(
+        const.cpu_cap[sl], 1e-9)
+    free_mem_p = 1.0 - (new_mem - freed_m) / jnp.maximum(
+        const.mem_cap[sl], 1e-9)
+    binpack_p = _binpack_score(free_cpu_p, free_mem_p, spread_alg)
+    pscore = 1.0 / (1.0 + jnp.exp(
+        PREEMPT_SCORE_RATE * (net_prio - PREEMPT_SCORE_ORIGIN)))
+    final_p = (binpack_p + other_sum + pscore) / (nscores + 1.0)
+
+    fit_c = fit | fit_p
+    final_c = jnp.where(fit_p, final_p, final)
+    chosen, cscore, n_yield, counted = _window_outputs(
+        final_c, fit_c, limit, dtype, lo)
+
+    # Gather the chosen node's eviction info (slice-local index)
+    local = jnp.clip(chosen - lo, 0, evict.shape[0] - 1)
+    was_preempt = (chosen >= 0) & fit_p[local]
+    evict_row = jnp.where(was_preempt, evict[local],
+                          jnp.zeros_like(evict[0]))
+    freed = jnp.where(
+        was_preempt,
+        jnp.stack([freed_c[local], freed_m[local], freed_d[local]]),
+        jnp.zeros(3, dtype=dtype))
+    return chosen, cscore, n_yield, counted, evict_row, freed
 
 
 @functools.partial(jax.jit, static_argnames=("spread_alg", "dtype_name"))
@@ -314,6 +525,119 @@ def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
          batch.has_static, batch.limit, batch.count, batch.penalty_idx,
          batch.active))
     return chosen, scores, n_yielded, final_state
+
+
+@functools.partial(jax.jit, static_argnames=("spread_alg", "dtype_name"))
+def solve_placements_preempt(const: NodeConst, init: NodeState,
+                             batch: PlacementBatch, ptab: PreemptTables,
+                             pinit: PreemptState, spread_alg: bool = False,
+                             dtype_name: str = "float32"):
+    """solve_placements with dense preemption: each scan step runs the
+    eviction-enabled select; committing a preempting winner releases the
+    evicted candidates' resources and ports into the carry and bumps the
+    per-(job,tg) eviction counts (the reference's plan.NodePreemptions +
+    currentPreemptions bookkeeping, generic_sched.go:924 + preemption.go).
+
+    Extra outputs: evict_rows (P, A) bool -- candidate rows evicted by each
+    placement on its chosen node."""
+    dtype = jnp.dtype(dtype_name)
+    n_total = const.cpu_cap.shape[0]
+    use_fast = n_total > 2 * FAST_T
+    G = pinit.counts.shape[0]
+    A = ptab.cpu.shape[1]
+
+    def step(carry, b):
+        state, pstate = carry
+        (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
+         penalty_idx, active) = b
+
+        if use_fast:
+            f = _score_and_select_preempt(
+                state, pstate, ptab, const, b, dtype, spread_alg,
+                0, FAST_T)
+
+            def full(_):
+                return _score_and_select_preempt(
+                    state, pstate, ptab, const, b, dtype, spread_alg,
+                    0, None)
+
+            def fast(_):
+                return f
+
+            chosen, cscore, n_yield, _cnt, evict_row, freed = jax.lax.cond(
+                f[3] >= limit, fast, full, operand=None)
+        else:
+            chosen, cscore, n_yield, _cnt, evict_row, freed = \
+                _score_and_select_preempt(
+                    state, pstate, ptab, const, b, dtype, spread_alg,
+                    0, None)
+
+        do = active & (chosen >= 0)
+        safe = jnp.maximum(chosen, 0)
+        add_f = do.astype(dtype)
+        add_i = do.astype(jnp.int32)
+        evict_row = evict_row & do
+
+        # release evicted usage + ports, then charge the placement
+        dyn_back = jnp.sum(
+            jnp.where(evict_row, ptab.dyn_ports[safe], 0)).astype(jnp.int32)
+        static_back = jnp.any(evict_row & ptab.static_rel[safe])
+        new_state = NodeState(
+            used_cpu=state.used_cpu.at[safe].add(
+                add_f * ask_cpu - freed[0]),
+            used_mem=state.used_mem.at[safe].add(
+                add_f * ask_mem - freed[1]),
+            used_disk=state.used_disk.at[safe].add(
+                add_f * ask_disk - freed[2]),
+            placed=state.placed.at[safe].add(add_i),
+            placed_job=state.placed_job.at[safe].add(add_i),
+            static_free=state.static_free.at[safe].set(
+                (state.static_free[safe] | static_back)
+                & ~(do & has_static)),
+            dyn_avail=state.dyn_avail.at[safe].add(
+                dyn_back - add_i * n_dyn),
+            spread_counts=state.spread_counts,
+        )
+        sel_vidx = const.spread_vidx[:, safe]
+        S, V = state.spread_counts.shape
+        if S > 0:
+            upd = ((jnp.arange(V)[None, :]
+                    == jnp.maximum(sel_vidx, 0)[:, None])
+                   & (sel_vidx >= 0)[:, None] & do)
+            new_state = new_state._replace(
+                spread_counts=state.spread_counts + upd.astype(jnp.int32))
+
+        grp_row = ptab.grp[safe]                      # (A,)
+        grp_hot = ((jnp.arange(G, dtype=jnp.int32)[None, :]
+                    == jnp.maximum(grp_row, 0)[:, None])
+                   & (grp_row >= 0)[:, None] & evict_row[:, None])
+        new_counts = (pstate.counts
+                      + jnp.sum(grp_hot, axis=0)).astype(jnp.int32)
+        new_pstate = PreemptState(
+            evicted=pstate.evicted.at[safe].set(
+                pstate.evicted[safe] | evict_row),
+            counts=new_counts)
+        chosen_out = jnp.where(do, chosen, -1)
+        return (new_state, new_pstate), (chosen_out, cscore, n_yield,
+                                         evict_row)
+
+    (final_state, final_pstate), (chosen, scores, n_yielded, evict_rows) = \
+        jax.lax.scan(
+            step, (init, pinit),
+            (batch.ask_cpu, batch.ask_mem, batch.ask_disk,
+             batch.n_dyn_ports, batch.has_static, batch.limit, batch.count,
+             batch.penalty_idx, batch.active))
+    return chosen, scores, n_yielded, evict_rows, final_state
+
+
+def solve_eval_batch_preempt(const, init, batch, ptab, pinit,
+                             spread_alg: bool = False,
+                             dtype_name: str = "float32"):
+    """Batched-eval form of solve_placements_preempt (leading (E, ...)
+    axis), mirroring solve_eval_batch."""
+    inner = functools.partial(solve_placements_preempt,
+                              spread_alg=spread_alg, dtype_name=dtype_name)
+    return jax.vmap(inner)(const, init, batch, ptab, pinit)
 
 
 def solve_eval_batch(const: NodeConst, init: NodeState, batch: PlacementBatch,

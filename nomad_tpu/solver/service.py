@@ -29,16 +29,17 @@ class TpuPlacement:
     """One solved placement returned to the scheduler."""
 
     __slots__ = ("place", "node", "task_resources", "alloc_resources",
-                 "score", "n_yielded")
+                 "score", "n_yielded", "preempted_allocs")
 
     def __init__(self, place, node, task_resources, alloc_resources, score,
-                 n_yielded):
+                 n_yielded, preempted_allocs=None):
         self.place = place
         self.node = node
         self.task_resources = task_resources
         self.alloc_resources = alloc_resources
         self.score = score
         self.n_yielded = n_yielded
+        self.preempted_allocs = preempted_allocs
 
 
 class PackedLane:
@@ -48,10 +49,12 @@ class PackedLane:
     materialize() needs to map solved indexes back to structs."""
 
     __slots__ = ("service", "tg", "places", "nodes", "order", "const",
-                 "init", "batch", "dtype_name", "spread_alg")
+                 "init", "batch", "dtype_name", "spread_alg", "ptab",
+                 "pinit", "cand_allocs")
 
     def __init__(self, service, tg, places, nodes, order, const, init,
-                 batch, dtype_name, spread_alg):
+                 batch, dtype_name, spread_alg, ptab=None, pinit=None,
+                 cand_allocs=None):
         self.service = service
         self.tg = tg
         self.places = places
@@ -62,6 +65,11 @@ class PackedLane:
         self.batch = batch
         self.dtype_name = dtype_name
         self.spread_alg = spread_alg
+        # preemption tables (solve_placements_preempt) + the shuffled-order
+        # candidate->Allocation mapping materialize() needs for evictions
+        self.ptab = ptab
+        self.pinit = pinit
+        self.cand_allocs = cand_allocs
 
     def signature(self) -> tuple:
         """Lanes with equal signatures can fuse into one vmapped dispatch
@@ -70,20 +78,27 @@ class PackedLane:
                 self.batch.ask_cpu.shape[0],          # P (pre-padded)
                 self.const.spread_vidx.shape[0],      # S
                 self.const.spread_desired.shape[1],   # V
+                self.ptab.cpu.shape[1] if self.ptab is not None else 0,
+                self.pinit.counts.shape[0] if self.pinit is not None else 0,
                 self.dtype_name, self.spread_alg)
 
 
-def tg_solver_eligible(tg, job=None) -> bool:
+def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
     """Does the dense path model everything this TG asks for? Anything it
     does not (devices, reserved cores, per-task networks, distinct_property,
     0%-spread targets whose stateful lowest-boost scoring is host-only)
-    falls back to the host iterator stack."""
+    falls back to the host iterator stack. With preemption enabled, TGs
+    asking for ports also fall back: network preemption is a subset search
+    over existing port sets (preemption.go:273) the dense path does not
+    model."""
     for task in tg.tasks:
         if task.resources.devices or task.resources.cores > 0:
             return False
         if task.resources.networks:
             return False
     if len(tg.networks) > 1:
+        return False
+    if preempt and tg.networks:
         return False
     constraints = list(tg.constraints) + [
         c for t in tg.tasks for c in t.constraints]
@@ -101,10 +116,20 @@ def tg_solver_eligible(tg, job=None) -> bool:
 
 def dispatch_lane(lane: PackedLane):
     """Solve ONE lane in its own device dispatch; returns host-side numpy
-    (chosen, scores, n_yielded). The batched path fuses many lanes through
-    solver.batch instead."""
+    (chosen, scores, n_yielded[, evict_rows]). The batched path fuses many
+    lanes through solver.batch instead."""
     import jax.numpy as jnp
-    from .binpack import solve_placements
+    from .binpack import solve_placements, solve_placements_preempt
+
+    if lane.ptab is not None:
+        chosen, scores, n_yielded, evict_rows, _ = solve_placements_preempt(
+            lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
+            spread_alg=lane.spread_alg, dtype_name=lane.dtype_name)
+        combined = np.asarray(jnp.stack([
+            chosen.astype(scores.dtype), scores,
+            n_yielded.astype(scores.dtype)]))
+        return (combined[0].astype(np.int64), combined[1],
+                combined[2].astype(np.int64), np.asarray(evict_rows))
 
     chosen, scores, n_yielded, _ = solve_placements(
         lane.const, lane.init, lane.batch, spread_alg=lane.spread_alg,
@@ -125,11 +150,12 @@ class TpuPlacementService:
     (amortizing host->TPU latency, SURVEY.md section 7 hard part 5)."""
 
     def __init__(self, ctx, job, batch_mode: bool, spread_alg: bool,
-                 dtype: Optional[str] = None):
+                 dtype: Optional[str] = None, preempt: bool = False):
         self.ctx = ctx
         self.job = job
         self.batch_mode = batch_mode
         self.spread_alg = spread_alg
+        self.preempt = preempt
         if dtype is None:
             # float64 on CPU (exact parity with the host oracle's float64
             # math); float32 on TPU where f64 is emulated and the MXU wants
@@ -150,8 +176,7 @@ class TpuPlacementService:
         lane = self.pack(tg, places, nodes, penalty_nodes_per_place)
         if lane is None:
             return None
-        chosen, scores, n_yielded = dispatch_lane(lane)
-        return self.materialize(lane, chosen, scores, n_yielded)
+        return self.materialize(lane, *dispatch_lane(lane))
 
     def pack(self, tg, places, nodes, penalty_nodes_per_place=None
              ) -> Optional[PackedLane]:
@@ -162,7 +187,8 @@ class TpuPlacementService:
         from .binpack import (
             PlacementBatch, make_node_const, make_node_state)
 
-        if not tg_solver_eligible(tg, self.job) or not places:
+        if (not tg_solver_eligible(tg, self.job, preempt=self.preempt)
+                or not places):
             return None
 
         n = len(nodes)
@@ -178,12 +204,22 @@ class TpuPlacementService:
         inv = np.empty(n_pad, dtype=np.int64)
         inv[perm] = np.arange(n_pad)
 
-        table = getattr(self.ctx.state, "alloc_table", None)
-        if table is not None and not table.has_port_overflow:
-            usage = self._pack_usage_from_table(table, matrix, nodes, tg)
-        else:
+        # With preemption on, the candidate tables need every node's
+        # proposed allocs anyway -- do that walk ONCE and reuse it for
+        # usage packing too (instead of the alloc-table fast path).
+        proposed_by_node = None
+        if self.preempt:
             proposed_by_node = {
                 node.id: self.ctx.proposed_allocs(node.id) for node in nodes}
+        table = getattr(self.ctx.state, "alloc_table", None)
+        if (table is not None and not table.has_port_overflow
+                and proposed_by_node is None):
+            usage = self._pack_usage_from_table(table, matrix, nodes, tg)
+        else:
+            if proposed_by_node is None:
+                proposed_by_node = {
+                    node.id: self.ctx.proposed_allocs(node.id)
+                    for node in nodes}
             usage = pack_usage(matrix, proposed_by_node, self.job.id, tg.name,
                                self.job.namespace, nodes)
 
@@ -255,13 +291,99 @@ class TpuPlacementService:
             penalty_idx=penalty,
             active=np.ones(P, dtype=bool),
         )
+        ptab = pinit = cand_allocs = None
+        if self.preempt:
+            ptab, pinit, cand_allocs = self._pack_preemption(
+                tg, nodes, order, n_pad, dtype, proposed_by_node)
         return PackedLane(self, tg, places, nodes, order, const, init,
-                          batch, np.dtype(dtype).name, self.spread_alg)
+                          batch, np.dtype(dtype).name, self.spread_alg,
+                          ptab=ptab, pinit=pinit, cand_allocs=cand_allocs)
 
-    def materialize(self, lane: PackedLane, chosen, scores, n_yielded
-                    ) -> List[TpuPlacement]:
+    def _pack_preemption(self, tg, nodes, order, n_pad, dtype,
+                         proposed_by_node):
+        """Build PreemptTables in shuffled node order: every proposed alloc
+        becomes a candidate row (rows keep proposed_allocs order so dense
+        argmin ties break like the host's in-order scan); ineligible rows
+        (own job, terminal) are masked invalid
+        (reference: preemption.go setCandidates/filterAndGroup :666)."""
+        from .binpack import PreemptState, PreemptTables
+        import jax.numpy as jnp
+
+        per_node = []          # shuffled order: list of candidate allocs
+        max_a = 1
+        for pos in range(n_pad):
+            if pos < len(order):
+                allocs = proposed_by_node[nodes[order[pos]].id]
+            else:
+                allocs = []
+            per_node.append(allocs)
+            max_a = max(max_a, len(allocs))
+        A = int(2 ** np.ceil(np.log2(max(max_a, 8))))
+
+        cpu = np.zeros((n_pad, A), dtype=dtype)
+        mem = np.zeros((n_pad, A), dtype=dtype)
+        disk = np.zeros((n_pad, A), dtype=dtype)
+        prio = np.zeros((n_pad, A), dtype=np.int32)
+        maxp = np.zeros((n_pad, A), dtype=np.int32)
+        grp = np.full((n_pad, A), -1, dtype=np.int32)
+        dyn_ports = np.zeros((n_pad, A), dtype=np.int32)
+        static_rel = np.zeros((n_pad, A), dtype=bool)
+        valid = np.zeros((n_pad, A), dtype=bool)
+
+        group_idx: Dict[Tuple[str, str, str], int] = {}
+        # dyn_ports/static_rel stay zero: preempt-eligible TGs never ask
+        # for networks (tg_solver_eligible), so there are no port asks to
+        # release toward; the kernel columns exist for a future dense
+        # network-preemption path (preemption.go:273).
+
+        for pos, allocs in enumerate(per_node):
+            for a_i, alloc in enumerate(allocs[:A]):
+                cr = alloc.allocated_resources.comparable()
+                cpu[pos, a_i] = cr.cpu_shares
+                mem[pos, a_i] = cr.memory_mb
+                disk[pos, a_i] = cr.disk_mb
+                p = alloc.job.priority if alloc.job is not None else 50
+                prio[pos, a_i] = p
+                mp = 0
+                if alloc.job is not None:
+                    atg = alloc.job.lookup_task_group(alloc.task_group)
+                    if atg is not None and atg.migrate is not None:
+                        mp = atg.migrate.max_parallel
+                maxp[pos, a_i] = mp
+                key = (alloc.namespace, alloc.job_id, alloc.task_group)
+                if key not in group_idx:
+                    group_idx[key] = len(group_idx)
+                grp[pos, a_i] = group_idx[key]
+                # host set_candidates/filter skips own-job, terminal and
+                # job-less allocs (scheduler/preemption.py:58,91-94)
+                valid[pos, a_i] = (
+                    alloc.job is not None
+                    and (alloc.namespace, alloc.job_id)
+                    != (self.job.namespace, self.job.id)
+                    and not alloc.terminal_status())
+
+        G = int(2 ** np.ceil(np.log2(max(len(group_idx), 4))))
+        counts = np.zeros(G, dtype=np.int32)
+        for na in self.ctx.plan.node_preemptions.values():
+            for a in na:
+                key = (a.namespace, a.job_id, a.task_group)
+                gi = group_idx.get(key)
+                if gi is not None:
+                    counts[gi] += 1
+
+        ptab = PreemptTables(
+            cpu=cpu, mem=mem, disk=disk, prio=prio, maxp=maxp, grp=grp,
+            dyn_ports=dyn_ports, static_rel=static_rel, valid=valid,
+            job_prio=np.asarray(self.job.priority, dtype=np.int32))
+        pinit = PreemptState(
+            evicted=np.zeros((n_pad, A), dtype=bool), counts=counts)
+        return ptab, pinit, per_node
+
+    def materialize(self, lane: PackedLane, chosen, scores, n_yielded,
+                    evict_rows=None) -> List[TpuPlacement]:
         """Map solved shuffled positions back to nodes, assigning real
-        ports by replaying the deterministic NetworkIndex per node."""
+        ports by replaying the deterministic NetworkIndex per node; map
+        eviction rows back to the Allocations to preempt."""
         tg, places, nodes, order = (lane.tg, lane.places, lane.nodes,
                                     lane.order)
         out: List[TpuPlacement] = []
@@ -273,6 +395,13 @@ class TpuPlacementService:
                                         int(n_yielded[pi])))
                 continue
             node = nodes[order[pos]]
+            preempted = None
+            if evict_rows is not None and lane.cand_allocs is not None:
+                row = evict_rows[pi]
+                if row.any():
+                    cands = lane.cand_allocs[pos]
+                    preempted = [cands[ai] for ai in np.nonzero(row)[0]
+                                 if ai < len(cands)]
             task_resources = {}
             for task in tg.tasks:
                 task_resources[task.name] = AllocatedTaskResources(
@@ -299,7 +428,8 @@ class TpuPlacementService:
                     disk_mb=tg.ephemeral_disk.size_mb, ports=offer.ports)
             out.append(TpuPlacement(place, node, task_resources,
                                     alloc_resources, float(scores[pi]),
-                                    int(n_yielded[pi])))
+                                    int(n_yielded[pi]),
+                                    preempted_allocs=preempted))
         return out
 
     def _pack_usage_from_table(self, table, matrix, nodes, tg):
