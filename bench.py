@@ -201,8 +201,51 @@ def solve_once(h, job, nodes, n_placements):
     return dt, placed
 
 
+def main_tier(platform: str, tier: int):
+    """BENCH_TIER mode: run the BASELINE tier shape end-to-end (full
+    scheduler pipeline via the harness) host vs tpu with gating parity --
+    the same nomad_tpu/benchkit generators tests/test_parity_scale.py
+    gates at CI scale."""
+    from nomad_tpu.benchkit import run_tier_placements
+
+    n_nodes = N_NODES
+    count = N_PLACEMENTS
+    t0 = time.time()
+    host, host_ev = run_tier_placements(tier, n_nodes, count, seed=1,
+                                        alg="binpack", with_evictions=True)
+    host_dt = time.time() - t0
+    log(f"bench[tier{tier}]: host {len(host)} placements in {host_dt:.2f}s")
+    run_tier_placements(tier, n_nodes, count, seed=1, alg="tpu-binpack")
+    t0 = time.time()
+    tpu, tpu_ev = run_tier_placements(tier, n_nodes, count, seed=1,
+                                      alg="tpu-binpack",
+                                      with_evictions=True)
+    tpu_dt = time.time() - t0
+    log(f"bench[tier{tier}]: tpu {len(tpu)} placements in {tpu_dt:.2f}s")
+    # bidirectional placement parity + eviction-set parity (tier 5 exists
+    # to exercise preemption)
+    keys = set(host) | set(tpu)
+    mismatch = sum(1 for k in keys if host.get(k) != tpu.get(k))
+    mismatch += sum(1 for k in keys if host_ev.get(k) != tpu_ev.get(k))
+    placements_per_sec = len(tpu) / tpu_dt if tpu_dt else 0.0
+    print(json.dumps({
+        "metric": f"tier{tier}_eval_placements_per_sec",
+        "value": round(placements_per_sec, 2),
+        "unit": (f"placements/s ({n_nodes} nodes end-to-end eval, "
+                 f"platform={platform}, parity_mismatch={mismatch})"),
+        "vs_baseline": round(host_dt / tpu_dt, 2) if tpu_dt else 0.0,
+        "platform": platform,
+        "parity_mismatch": mismatch,
+    }), flush=True)
+    sys.exit(1 if mismatch else 0)
+
+
 def main():
     platform = pick_platform()
+    tier = os.environ.get("BENCH_TIER", "").strip()
+    if tier:
+        main_tier(platform, int(tier))
+        return
     t0 = time.time()
     h, job, nodes = build_world()
     log(f"bench: world built ({N_NODES} nodes) in {time.time() - t0:.1f}s")

@@ -1,0 +1,159 @@
+"""Tier-shaped benchmark/parity worlds (BASELINE.md config tiers 2-5).
+
+Shared by tests/test_parity_scale.py (CI scale, CPU) and bench.py (full
+scale, TPU): the same generators build the same world shapes at any size,
+so the parity CI gates exactly what the bench measures
+(reference sweep analog: scheduler/benchmarks/benchmarks_test.go:36-79).
+
+Tiers (BASELINE.md "Targets"):
+  2: batch allocs over uniform nodes, binpack vs spread algorithm
+  3: C1M-replay shape -- cpu+mem+dynamic-port asks, node-class mix,
+     kernel/class constraints
+  4: C2M shape -- affinity + anti-affinity (implicit) + spread mixes
+  5: preemption-heavy -- high utilization, priority tiers (see
+     tests/test_preemption_tpu.py for the parity harness)
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Dict, List, Tuple
+
+from . import mock
+from .structs import (
+    Affinity, Constraint, NetworkResource, Port, PreemptionConfig,
+    SchedulerConfiguration, Spread, SpreadTarget,
+    ALLOC_CLIENT_RUNNING,
+)
+
+RACK_COUNT = 25   # reference sweep uses {10,25,50,75} racks
+
+
+def make_fleet(rng: random.Random, h, n_nodes: int,
+               racks: int = RACK_COUNT) -> List:
+    """Heterogeneous fleet: 3 machine classes, rack + datacenter spread
+    attributes (the reference bench's rack axis)."""
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.id = f"tier-node-{i:06d}"
+        node.node_resources.cpu.cpu_shares = (4000, 8000, 16000)[i % 3]
+        node.node_resources.memory.memory_mb = (8192, 16384, 32768)[i % 3]
+        node.datacenter = f"dc{i % 2 + 1}"
+        node.attributes["platform.rack"] = f"rack-{i % racks:03d}"
+        node.compute_class()
+        h.state.upsert_node(node)
+        nodes.append(node)
+    return nodes
+
+
+def seed_utilization(rng: random.Random, h, nodes, frac: float,
+                     priorities=(50,)) -> None:
+    """Fill ~frac of each node's cpu with existing allocs."""
+    for node in nodes:
+        cap = node.node_resources.cpu.cpu_shares
+        target = int(cap * frac)
+        used = 0
+        while used + 500 <= target:
+            j = mock.job(priority=rng.choice(priorities))
+            j.id = f"filler-{node.id}-{used}"
+            j.task_groups[0].tasks[0].resources.cpu = 500
+            j.task_groups[0].tasks[0].resources.memory_mb = rng.choice(
+                [512, 1024])
+            h.state.upsert_job(j)
+            a = mock.alloc_for(j, node)
+            a.client_status = ALLOC_CLIENT_RUNNING
+            h.state.upsert_allocs([a])
+            used += 500
+
+
+def tier_job(tier: int, rng: random.Random, count: int):
+    """The job each tier schedules."""
+    job = mock.job(type="batch" if tier == 2 else "service")
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.resources.cpu = rng.choice([250, 500, 1000])
+    task.resources.memory_mb = rng.choice([256, 512, 1024])
+
+    if tier == 3:
+        # C1M shape: ports + constraints (cpu+mem+port per BASELINE tier 3)
+        tg.networks = [NetworkResource(
+            dynamic_ports=[Port(label="http"), Port(label="rpc")])]
+        job.constraints = [Constraint(l_target="${attr.kernel.name}",
+                                      r_target="linux", operand="=")]
+        tg.constraints = [Constraint(l_target="${attr.cpu.numcores}",
+                                     r_target="2", operand=">=")]
+    elif tier == 4:
+        # C2M shape: affinity/anti-affinity/spread mixes
+        job.affinities = [Affinity(l_target="${node.datacenter}",
+                                   r_target="dc1", operand="=",
+                                   weight=rng.choice([50, 100]))]
+        tg.spreads = [Spread(attribute="${meta.platform.rack}", weight=50)]
+    return job
+
+
+def run_tier_placements(tier: int, n_nodes: int, count: int, seed: int,
+                        alg: str, spread_variant: bool = False,
+                        with_evictions: bool = False):
+    """Build one world, schedule one tier-shaped eval with the given
+    algorithm, return {alloc name -> node id} (plus, with_evictions,
+    {alloc name -> sorted evicted alloc names})."""
+    from .scheduler import Harness
+
+    rng = random.Random(seed)
+    mock._counter = itertools.count()
+    h = Harness()
+    cfg = SchedulerConfiguration(scheduler_algorithm=alg)
+    if tier == 5:
+        cfg.preemption_config = PreemptionConfig(
+            service_scheduler_enabled=True, batch_scheduler_enabled=True)
+    h.state.set_scheduler_config(cfg)
+    nodes = make_fleet(rng, h, n_nodes)
+    if tier == 5:
+        seed_utilization(rng, h, nodes, 0.95, priorities=(10, 20, 30, 40))
+    elif tier in (3, 4):
+        seed_utilization(rng, h, nodes, 0.25)
+
+    job = tier_job(tier, rng, count)
+    job.id = f"tier{tier}-job-{seed}"
+    if tier == 5:
+        job.priority = 70
+        job.task_groups[0].tasks[0].resources.cpu = 1000
+    h.state.upsert_job(job)
+    ev = mock.evaluation(job_id=job.id, type=job.type,
+                         priority=job.priority)
+    ev.id = f"tier{tier}-eval-{seed:08d}"
+    err = h.process(job.type if job.type in ("service", "batch")
+                    else "service", ev)
+    assert err is None, err
+    placed: Dict[str, str] = {}
+    evicted: Dict[str, List[str]] = {}
+    for plan in h.plans:
+        pre_by_id: Dict[str, List[str]] = {}
+        for node_id, allocs in plan.node_preemptions.items():
+            for a in allocs:
+                pre_by_id.setdefault(a.preempted_by_allocation,
+                                     []).append(a.name)
+        for node_id, allocs in plan.node_allocation.items():
+            for a in allocs:
+                if a.eval_id == ev.id:
+                    placed[a.name] = node_id
+                    evicted[a.name] = sorted(pre_by_id.get(a.id, []))
+    if with_evictions:
+        return placed, evicted
+    return placed
+
+
+def run_tier_parity(tier: int, n_nodes: int, count: int, seed: int,
+                    spread_variant: bool = False
+                    ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """host-oracle vs tpu placements for one tier world; caller asserts
+    equality."""
+    host_alg = "spread" if spread_variant else "binpack"
+    tpu_alg = "tpu-spread" if spread_variant else "tpu-binpack"
+    host = run_tier_placements(tier, n_nodes, count, seed, host_alg,
+                               spread_variant)
+    tpu = run_tier_placements(tier, n_nodes, count, seed, tpu_alg,
+                              spread_variant)
+    return host, tpu

@@ -238,12 +238,20 @@ def _preempt_search(state: NodeState, pstate: PreemptState,
     grp = ptab.grp[sl]
     n, A = used_c.shape
 
-    eligible = (ptab.valid[sl] & ~pstate.evicted[sl]
-                & (ptab.job_prio - prio >= 10))
-    # free-after-all-current-allocs = capacity - carried usage
-    avail_c0 = const.cpu_cap[sl] - state.used_cpu[sl]
-    avail_m0 = const.mem_cap[sl] - state.used_mem[sl]
-    avail_d0 = const.disk_cap[sl] - state.used_disk[sl]
+    valid_now = ptab.valid[sl] & ~pstate.evicted[sl]
+    eligible = valid_now & (ptab.job_prio - prio >= 10)
+    # The host Preemptor's nodeRemaining subtracts only the CANDIDATE
+    # allocs (own-job and terminal allocs are filtered before the
+    # subtraction, preemption.go setCandidates) -- NOT the full carried
+    # usage. An eviction set that "covers" the ask by this accounting can
+    # still fail the authoritative AllocsFit re-check (rank.go:541), which
+    # the caller models as the fit2 clamp.
+    avail_c0 = const.cpu_cap[sl] - jnp.sum(
+        jnp.where(valid_now, used_c, 0.0), axis=1)
+    avail_m0 = const.mem_cap[sl] - jnp.sum(
+        jnp.where(valid_now, used_m, 0.0), axis=1)
+    avail_d0 = const.disk_cap[sl] - jnp.sum(
+        jnp.where(valid_now, used_d, 0.0), axis=1)
 
     # max_parallel penalty from preemptions committed earlier in this eval
     n_pre = jnp.where(grp >= 0, pstate.counts[jnp.maximum(grp, 0)], 0)
@@ -256,13 +264,17 @@ def _preempt_search(state: NodeState, pstate: PreemptState,
 
     def cond(carry):
         picked, av_c, av_m, av_d, _, _, _ = carry
-        met = (av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+        # allMet starts False in the host loop: the first pick is
+        # unconditional even when available already covers the ask
+        met = ((av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+               & jnp.any(picked, axis=1))
         cand = eligible & ~picked
         return jnp.any(~met & jnp.any(cand, axis=1))
 
     def body(carry):
         picked, av_c, av_m, av_d, ne_c, ne_m, ne_d = carry
-        met = (av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+        met = ((av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+               & jnp.any(picked, axis=1))
         cand = eligible & ~picked
         # ascending priority-group gating (preemption.go:666): only the
         # lowest remaining priority is pickable this round
@@ -285,7 +297,8 @@ def _preempt_search(state: NodeState, pstate: PreemptState,
             jnp.full(n, ask_mem, dtype=dtype),
             jnp.full(n, ask_disk, dtype=dtype))
     picked, av_c, av_m, av_d, _, _, _ = jax.lax.while_loop(cond, body, init)
-    met = (av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+    met = ((av_c >= ask_cpu) & (av_m >= ask_mem) & (av_d >= ask_disk)
+           & jnp.any(picked, axis=1))
 
     # filterSuperset (preemption.go:705): re-add picked in DESCENDING
     # distance-to-original-ask order until the ask is covered again.
@@ -384,7 +397,8 @@ def _scoring_parts(state: NodeState, const: NodeConst, b, dtype,
                + spread_present.astype(dtype))
     other_sum = anti + resched + aff + spread_total
     final = (binpack + other_sum) / nscores
-    return fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem
+    return (fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem,
+            new_disk)
 
 
 def _window_outputs(final, fit, limit, dtype, lo):
@@ -402,8 +416,8 @@ def _score_and_select(state: NodeState, const: NodeConst, b, dtype,
     """One Stack.Select over node positions [lo:hi) (static slice).
     Returns (chosen global index, score, n_yield, counted_in_slice)."""
     limit = b[5]
-    fit, final, _, _, _, _, _ = _scoring_parts(
-        state, const, b, dtype, spread_alg, lo, hi)
+    fit, final = _scoring_parts(state, const, b, dtype, spread_alg,
+                                lo, hi)[:2]
     return _window_outputs(final, fit, limit, dtype, lo)
 
 
@@ -420,14 +434,21 @@ def _score_and_select_preempt(state: NodeState, pstate: PreemptState,
     (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
      penalty_idx, active) = b
     sl = slice(lo, hi)
-    fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem = \
-        _scoring_parts(state, const, b, dtype, spread_alg, lo, hi)
+    (fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem,
+     new_disk) = _scoring_parts(state, const, b, dtype, spread_alg, lo, hi)
 
     met, evict, freed_c, freed_m, freed_d, net_prio = _preempt_search(
         state, pstate, ptab, const, ask_cpu, ask_mem, ask_disk, dtype,
         lo, hi)
 
-    fit_p = feas_nonres & ~fit & met
+    # fit2: the authoritative re-check after eviction (rank.go:541 ->
+    # preemption insufficient under FULL usage -> node exhausted). The
+    # search's candidates-only accounting can overstate availability when
+    # this eval already placed on the node.
+    fit2 = ((new_cpu - freed_c <= const.cpu_cap[sl])
+            & (new_mem - freed_m <= const.mem_cap[sl])
+            & (new_disk - freed_d <= const.disk_cap[sl]))
+    fit_p = feas_nonres & ~fit & met & fit2
     free_cpu_p = 1.0 - (new_cpu - freed_c) / jnp.maximum(
         const.cpu_cap[sl], 1e-9)
     free_mem_p = 1.0 - (new_mem - freed_m) / jnp.maximum(
