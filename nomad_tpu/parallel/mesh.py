@@ -59,7 +59,11 @@ def shard_solver_inputs(mesh, const, init, batch):
             spread_vidx=P("evals", None, "nodes"),
             spread_desired=P("evals"), spread_has_targets=P("evals"),
             spread_weights=P("evals"), spread_sum_weights=P("evals"),
-            n_spreads=P("evals"))
+            n_spreads=P("evals"),
+            dp_vidx=P("evals", None, "nodes"), dp_limit=P("evals"),
+            dp_tg_scope=P("evals"),
+            dev_aff=P("evals", None, None, "nodes"),
+            dev_count=P("evals"), dev_sum_weight=P("evals"))
         return jax.tree.map(
             lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
             c, specs)
@@ -70,7 +74,9 @@ def shard_solver_inputs(mesh, const, init, batch):
             used_disk=P("evals", "nodes"), placed=P("evals", "nodes"),
             placed_job=P("evals", "nodes"),
             static_free=P("evals", "nodes"), dyn_avail=P("evals", "nodes"),
-            spread_counts=P("evals"))
+            spread_counts=P("evals"),
+            dp_counts=P("evals"),
+            dev_free=P("evals", None, None, "nodes"))
         return jax.tree.map(
             lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
             s, specs)

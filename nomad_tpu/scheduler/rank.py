@@ -106,7 +106,6 @@ class DeviceAllocator:
             if len(free) < req.count:
                 continue
             if req.constraints:
-                from .feasible import DeviceChecker
                 if not DeviceChecker._check_device_constraints(
                         _DeviceCheckerShim(self.ctx), group, req.constraints):
                     continue

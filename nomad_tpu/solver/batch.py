@@ -79,11 +79,11 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
     results: List = [None] * len(lanes)
     groups: Dict[tuple, List[int]] = {}
     for i, lane in enumerate(lanes):
-        n_pad, p, S, V, A, G, dtype_name, spread_alg = lane.signature()
-        groups.setdefault((n_pad, S, V, A, G, dtype_name, spread_alg),
-                          []).append(i)
+        groups.setdefault(lane.fuse_key(), []).append(i)
 
-    for (n_pad, S, V, A, G, dtype_name, spread_alg), idxs in groups.items():
+    for key, idxs in groups.items():
+        dtype_name, spread_alg = key[-2], key[-1]
+        A = 1 if lanes[idxs[0]].ptab is not None else 0
         e_real = len(idxs)
         e_pad = _e_bucket(e_real)
         p_pad = _e_bucket(max(

@@ -29,6 +29,12 @@ MAX_SKIP = 3               # select.go maxSkip
 SKIP_THRESHOLD = 0.0       # select.go skipScoreThreshold
 BINPACK_MAX = 18.0
 
+_EMPTY_I2 = np.zeros((0, 0), dtype=np.int32)
+_EMPTY_I1 = np.zeros(0, dtype=np.int32)
+_EMPTY_B1 = np.zeros(0, dtype=bool)
+_EMPTY_F3 = np.zeros((0, 0, 0), dtype=np.float32)
+_EMPTY_I3 = np.zeros((0, 0, 0), dtype=np.int32)
+
 
 class PlacementBatch(NamedTuple):
     """Per-placement (scan-step) inputs, each shaped (P,)."""
@@ -55,10 +61,15 @@ class NodeState(NamedTuple):
     static_free: jnp.ndarray    # bool: TG's static ports still free
     dyn_avail: jnp.ndarray      # int32: free dynamic-range ports
     spread_counts: jnp.ndarray  # (S, V) int32
+    dp_counts: jnp.ndarray = _EMPTY_I2     # (Dp, Vd) int32 allocs per value
+    dev_free: jnp.ndarray = _EMPTY_I3      # (R, Gd, N) int32 free
+                                           # instances; -1 = no match
 
 
 class NodeConst(NamedTuple):
-    """Static per-eval node arrays, shaped (N,) (+ spread tables)."""
+    """Static per-eval node arrays, shaped (N,) (+ spread/distinct/device
+    tables; the trailing fields default to 0-size axes, statically skipped
+    at trace time)."""
 
     cpu_cap: jnp.ndarray
     mem_cap: jnp.ndarray
@@ -77,6 +88,16 @@ class NodeConst(NamedTuple):
     spread_weights: jnp.ndarray      # (S,) float
     spread_sum_weights: jnp.ndarray  # float scalar
     n_spreads: jnp.ndarray      # int32 scalar (0 = no spreads)
+    # distinct_property (feasible.go:661, propertyset.go): per constraint
+    # d, value index per node (-1 = attr missing -> infeasible) + limit
+    dp_vidx: jnp.ndarray = _EMPTY_I2       # (Dp, N) int32
+    dp_limit: jnp.ndarray = _EMPTY_I1       # (Dp,) int32
+    dp_tg_scope: jnp.ndarray = _EMPTY_B1   # (Dp,) bool (info only)
+    # devices (feasible.go:1270, scheduler/device.go): per TG device
+    # request r and matching node device-group g
+    dev_aff: jnp.ndarray = _EMPTY_F3       # (R, Gd, N) affinity score
+    dev_count: jnp.ndarray = _EMPTY_I1     # (R,) int32 asked count
+    dev_sum_weight: jnp.ndarray = np.float32(0.0)  # scalar sum |weights|
 
 
 def _binpack_score(free_cpu, free_mem, spread_alg: bool):
@@ -366,6 +387,36 @@ def _scoring_parts(state: NodeState, const: NodeConst, b, dtype,
                    & (state.dyn_avail[sl] >= n_dyn)
                    & (state.static_free[sl] | ~has_static)
                    & (~const.distinct_hosts | (distinct_count == 0)))
+
+    # distinct_property (feasible.go:661): attr must resolve and the
+    # job/tg's alloc count at this node's value must be under the limit
+    Dp = const.dp_vidx.shape[0]
+    if Dp > 0:
+        vidx_d = const.dp_vidx[:, sl]
+        safe_d = jnp.maximum(vidx_d, 0)
+        cnt_d = jnp.take_along_axis(state.dp_counts, safe_d, axis=1)
+        feas_nonres &= jnp.all(
+            (vidx_d >= 0) & (cnt_d < const.dp_limit[:, None]), axis=0)
+
+    # devices (feasible.go:1270 + device.go): every request needs a
+    # matching group with enough free instances; affinity score of the
+    # best group per request contributes one normalized score component
+    R = const.dev_aff.shape[0]
+    dev_score = None
+    if R > 0:
+        free_g = state.dev_free[:, :, sl]
+        ok_g = free_g >= const.dev_count[:, None, None]
+        feas_nonres &= jnp.all(jnp.any(ok_g, axis=1), axis=0)
+        neg_inf = jnp.array(-jnp.inf, dtype=dtype)
+        aff_g = jnp.where(ok_g, const.dev_aff[:, :, sl].astype(dtype),
+                          neg_inf)
+        best_aff = jnp.max(aff_g, axis=1)                   # (R, n)
+        sum_aff = jnp.sum(jnp.where(jnp.any(ok_g, axis=1), best_aff, 0.0),
+                          axis=0)
+        dev_present = const.dev_sum_weight > 0
+        dev_score = jnp.where(
+            dev_present,
+            sum_aff / jnp.maximum(const.dev_sum_weight, 1e-9), 0.0)
     fit = (feas_nonres
            & (new_cpu <= cpu_cap)
            & (new_mem <= mem_cap)
@@ -396,6 +447,10 @@ def _scoring_parts(state: NodeState, const: NodeConst, b, dtype,
                + aff_present.astype(dtype)
                + spread_present.astype(dtype))
     other_sum = anti + resched + aff + spread_total
+    if dev_score is not None:
+        dev_present_f = (const.dev_sum_weight > 0).astype(dtype)
+        nscores = nscores + dev_present_f
+        other_sum = other_sum + dev_score
     final = (binpack + other_sum) / nscores
     return (fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem,
             new_disk)
@@ -475,6 +530,43 @@ def _score_and_select_preempt(state: NodeState, pstate: PreemptState,
     return chosen, cscore, n_yield, counted, evict_row, freed
 
 
+def _commit_tables(state: NodeState, new_state: NodeState,
+                   const: NodeConst, do, safe) -> NodeState:
+    """Shared per-step commit of the spread / distinct_property / device
+    carry tables for the winning node."""
+    sel_vidx = const.spread_vidx[:, safe]               # (S,)
+    S, V = state.spread_counts.shape
+    if S > 0:
+        upd = ((jnp.arange(V)[None, :] == jnp.maximum(sel_vidx, 0)[:, None])
+               & (sel_vidx >= 0)[:, None] & do)
+        new_state = new_state._replace(
+            spread_counts=state.spread_counts + upd.astype(jnp.int32))
+
+    Dp = const.dp_vidx.shape[0]
+    if Dp > 0:
+        dvidx = const.dp_vidx[:, safe]                  # (Dp,)
+        Vd = state.dp_counts.shape[1]
+        upd = ((jnp.arange(Vd)[None, :] == jnp.maximum(dvidx, 0)[:, None])
+               & (dvidx >= 0)[:, None] & do)
+        new_state = new_state._replace(
+            dp_counts=state.dp_counts + upd.astype(jnp.int32))
+
+    R = const.dev_aff.shape[0]
+    if R > 0:
+        Gd = state.dev_free.shape[1]
+        free_c = state.dev_free[:, :, safe]             # (R, Gd)
+        ok_gc = free_c >= const.dev_count[:, None]
+        neg_inf = jnp.array(-jnp.inf, dtype=const.dev_aff.dtype)
+        aff_c = jnp.where(ok_gc, const.dev_aff[:, :, safe], neg_inf)
+        g_star = jnp.argmax(aff_c, axis=1)              # (R,) first-max
+        oh = (jnp.arange(Gd)[None, :] == g_star[:, None])
+        dec = (oh & do) * const.dev_count[:, None]
+        new_state = new_state._replace(
+            dev_free=state.dev_free.at[:, :, safe].add(
+                -dec.astype(jnp.int32)))
+    return new_state
+
+
 @functools.partial(jax.jit, static_argnames=("spread_alg", "dtype_name"))
 def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
                      spread_alg: bool = False, dtype_name: str = "float32"):
@@ -519,7 +611,7 @@ def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
         # O(1) scatter updates: only the winner's usage changes
         add_f = do.astype(dtype)
         add_i = do.astype(jnp.int32)
-        new_state = NodeState(
+        new_state = state._replace(
             used_cpu=state.used_cpu.at[safe].add(add_f * ask_cpu),
             used_mem=state.used_mem.at[safe].add(add_f * ask_mem),
             used_disk=state.used_disk.at[safe].add(add_f * ask_disk),
@@ -528,15 +620,8 @@ def solve_placements(const: NodeConst, init: NodeState, batch: PlacementBatch,
             static_free=state.static_free.at[safe].set(
                 state.static_free[safe] & ~(do & has_static)),
             dyn_avail=state.dyn_avail.at[safe].add(-add_i * n_dyn),
-            spread_counts=state.spread_counts,
         )
-        sel_vidx = const.spread_vidx[:, safe]               # (S,)
-        S, V = state.spread_counts.shape
-        if S > 0:
-            upd = ((jnp.arange(V)[None, :] == jnp.maximum(sel_vidx, 0)[:, None])
-                   & (sel_vidx >= 0)[:, None] & do)
-            new_state = new_state._replace(
-                spread_counts=state.spread_counts + upd.astype(jnp.int32))
+        new_state = _commit_tables(state, new_state, const, do, safe)
         chosen_out = jnp.where(do, chosen, -1)
         return new_state, (chosen_out, cscore, n_yield)
 
@@ -603,7 +688,7 @@ def solve_placements_preempt(const: NodeConst, init: NodeState,
         dyn_back = jnp.sum(
             jnp.where(evict_row, ptab.dyn_ports[safe], 0)).astype(jnp.int32)
         static_back = jnp.any(evict_row & ptab.static_rel[safe])
-        new_state = NodeState(
+        new_state = state._replace(
             used_cpu=state.used_cpu.at[safe].add(
                 add_f * ask_cpu - freed[0]),
             used_mem=state.used_mem.at[safe].add(
@@ -617,16 +702,8 @@ def solve_placements_preempt(const: NodeConst, init: NodeState,
                 & ~(do & has_static)),
             dyn_avail=state.dyn_avail.at[safe].add(
                 dyn_back - add_i * n_dyn),
-            spread_counts=state.spread_counts,
         )
-        sel_vidx = const.spread_vidx[:, safe]
-        S, V = state.spread_counts.shape
-        if S > 0:
-            upd = ((jnp.arange(V)[None, :]
-                    == jnp.maximum(sel_vidx, 0)[:, None])
-                   & (sel_vidx >= 0)[:, None] & do)
-            new_state = new_state._replace(
-                spread_counts=state.spread_counts + upd.astype(jnp.int32))
+        new_state = _commit_tables(state, new_state, const, do, safe)
 
         grp_row = ptab.grp[safe]                      # (A,)
         grp_hot = ((jnp.arange(G, dtype=jnp.int32)[None, :]

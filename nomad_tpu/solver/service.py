@@ -71,41 +71,44 @@ class PackedLane:
         self.pinit = pinit
         self.cand_allocs = cand_allocs
 
-    def signature(self) -> tuple:
-        """Lanes with equal signatures can fuse into one vmapped dispatch
-        (identical static shapes + static jit args)."""
+    def fuse_key(self) -> tuple:
+        """Lanes with equal keys can fuse into one vmapped dispatch: every
+        static table shape except the placement axis (which pads), plus
+        the static jit args."""
         return (self.const.cpu_cap.shape[0],          # n_pad
-                self.batch.ask_cpu.shape[0],          # P (pre-padded)
                 self.const.spread_vidx.shape[0],      # S
                 self.const.spread_desired.shape[1],   # V
+                self.const.dp_vidx.shape[0],          # Dp
+                self.init.dp_counts.shape[1] if
+                self.const.dp_vidx.shape[0] else 0,   # Vd
+                self.const.dev_aff.shape[:2],         # (R, Gd)
                 self.ptab.cpu.shape[1] if self.ptab is not None else 0,
                 self.pinit.counts.shape[0] if self.pinit is not None else 0,
                 self.dtype_name, self.spread_alg)
 
 
 def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
-    """Does the dense path model everything this TG asks for? Anything it
-    does not (devices, reserved cores, per-task networks, distinct_property,
-    0%-spread targets whose stateful lowest-boost scoring is host-only)
-    falls back to the host iterator stack. With preemption enabled, TGs
-    asking for ports also fall back: network preemption is a subset search
-    over existing port sets (preemption.go:273) the dense path does not
-    model."""
+    """Does the dense path model everything this TG asks for? The
+    remaining carve-outs (host iterator fallback):
+      - reserved cores (no NUMA/core-id model on the dense path yet)
+      - per-task networks (multi-NetworkIndex asks)
+      - multiple TG networks
+      - preemption combined with ports or devices (network/device
+        preemption are subset searches, preemption.go:273,475)
+      - 0%-spread targets (stateful lowest-boost scoring is host-only)
+    Devices and distinct_property ARE modeled densely (VERDICT r1 next #5).
+    """
+    has_devices = False
     for task in tg.tasks:
-        if task.resources.devices or task.resources.cores > 0:
+        if task.resources.cores > 0:
             return False
         if task.resources.networks:
             return False
+        if task.resources.devices:
+            has_devices = True
     if len(tg.networks) > 1:
         return False
-    if preempt and tg.networks:
-        return False
-    constraints = list(tg.constraints) + [
-        c for t in tg.tasks for c in t.constraints]
-    if job is not None:
-        constraints += list(job.constraints)
-    from ..structs import CONSTRAINT_DISTINCT_PROPERTY
-    if any(c.operand == CONSTRAINT_DISTINCT_PROPERTY for c in constraints):
+    if preempt and (tg.networks or has_devices):
         return False
     spreads = list(tg.spreads) + (list(job.spreads) if job is not None else [])
     for s in spreads:
@@ -143,6 +146,13 @@ def dispatch_lane(lane: PackedLane):
         n_yielded.astype(scores.dtype)]))
     return (combined[0].astype(np.int64), combined[1],
             combined[2].astype(np.int64))
+
+
+class _DeviceShim:
+    """Adapter so device packing reuses DeviceChecker's static helpers."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
 
 
 class TpuPlacementService:
@@ -291,6 +301,24 @@ class TpuPlacementService:
             penalty_idx=penalty,
             active=np.ones(P, dtype=bool),
         )
+        dp = self._pack_distinct_property(tg, nodes, order, n_pad)
+        if dp is not None:
+            const = const._replace(dp_vidx=dp[0], dp_limit=dp[1],
+                                   dp_tg_scope=dp[2])
+            init = init._replace(dp_counts=dp[3])
+
+        requests = [r for t in tg.tasks for r in t.resources.devices]
+        if requests:
+            if proposed_by_node is None:
+                proposed_by_node = {
+                    node.id: self.ctx.proposed_allocs(node.id)
+                    for node in nodes}
+            dev = self._pack_devices(tg, requests, nodes, order, n_pad,
+                                     proposed_by_node, dtype)
+            const = const._replace(dev_aff=dev[0], dev_count=dev[1],
+                                   dev_sum_weight=dev[2])
+            init = init._replace(dev_free=dev[3])
+
         ptab = pinit = cand_allocs = None
         if self.preempt:
             ptab, pinit, cand_allocs = self._pack_preemption(
@@ -298,6 +326,140 @@ class TpuPlacementService:
         return PackedLane(self, tg, places, nodes, order, const, init,
                           batch, np.dtype(dtype).name, self.spread_alg,
                           ptab=ptab, pinit=pinit, cand_allocs=cand_allocs)
+
+    def _pack_distinct_property(self, tg, nodes, order, n_pad):
+        """distinct_property tables (feasible.go:661, propertyset.go):
+        per constraint, a value index per node (-1 = attr missing ->
+        infeasible) and current alloc counts per value, seeded from the
+        job's existing allocs +/- plan deltas."""
+        from ..structs import CONSTRAINT_DISTINCT_PROPERTY
+        from ..scheduler.util import resolve_target
+
+        csets = ([(c, False) for c in self.job.constraints
+                  if c.operand == CONSTRAINT_DISTINCT_PROPERTY]
+                 + [(c, True) for c in tg.constraints
+                    if c.operand == CONSTRAINT_DISTINCT_PROPERTY])
+        if not csets:
+            return None
+        Dp = len(csets)
+
+        # the job's live allocs incl. plan placements, minus stops
+        # (mirrors DistinctPropertyIterator._satisfies)
+        allocs = [a for a in self.ctx.state.allocs_by_job(
+            self.job.namespace, self.job.id) if not a.terminal_status()]
+        removed = set()
+        for na in self.ctx.plan.node_update.values():
+            removed.update(a.id for a in na)
+        allocs = [a for a in allocs if a.id not in removed]
+        for na in self.ctx.plan.node_allocation.values():
+            allocs.extend(na)
+
+        vidx = np.full((Dp, n_pad), -1, dtype=np.int32)
+        limits = np.ones(Dp, dtype=np.int32)
+        tg_scope = np.zeros(Dp, dtype=bool)
+        value_maps = []
+        for d, (c, is_tg) in enumerate(csets):
+            tg_scope[d] = is_tg
+            try:
+                limits[d] = max(1, int(c.r_target)) if c.r_target else 1
+            except ValueError:
+                limits[d] = 1
+            vmap: Dict[str, int] = {}
+            for pos in range(len(order)):
+                val, ok = resolve_target(c.l_target, nodes[order[pos]])
+                if not ok:
+                    continue
+                key = str(val)
+                if key not in vmap:
+                    vmap[key] = len(vmap)
+                vidx[d, pos] = vmap[key]
+            value_maps.append(vmap)
+
+        Vd = max(2, int(2 ** np.ceil(np.log2(max(
+            max((len(m) for m in value_maps), default=1), 1)))))
+        counts = np.zeros((Dp, Vd), dtype=np.int32)
+        node_cache: Dict[str, object] = {}
+        for a in allocs:
+            node = node_cache.get(a.node_id)
+            if node is None:
+                node = self.ctx.state.node_by_id(a.node_id)
+                node_cache[a.node_id] = node
+            if node is None:
+                continue
+            for d, (c, is_tg) in enumerate(csets):
+                if is_tg and a.task_group != tg.name:
+                    continue
+                val, ok = resolve_target(c.l_target, node)
+                if ok:
+                    gi = value_maps[d].get(str(val))
+                    if gi is not None:
+                        counts[d, gi] += 1
+        return vidx, limits, tg_scope, counts
+
+    def _pack_devices(self, tg, requests, nodes, order, n_pad,
+                      proposed_by_node, dtype):
+        """Device tables (feasible.go:1270 DeviceChecker + device.go
+        allocator): per request r and matching node group g, the affinity
+        score and free instance count (capacity minus proposed usage)."""
+        from ..scheduler.rank import DeviceAllocator
+
+        R = len(requests)
+        # per node: count matching groups to size the Gd axis
+        per_node_groups = []
+        max_g = 1
+        for pos in range(len(order)):
+            node = nodes[order[pos]]
+            groups = list(node.node_resources.devices)
+            per_node_groups.append(groups)
+            max_g = max(max_g, len(groups))
+        Gd = int(2 ** np.ceil(np.log2(max(max_g, 1))))
+
+        aff = np.zeros((R, Gd, n_pad), dtype=dtype)
+        free = np.full((R, Gd, n_pad), -1, dtype=np.int32)
+        counts = np.asarray([r.count for r in requests], dtype=np.int32)
+        sum_w = 0.0
+        for r in requests:
+            if r.affinities:
+                sum_w += sum(abs(float(a.weight)) for a in r.affinities)
+
+        for pos, groups in enumerate(per_node_groups):
+            if not groups:
+                continue
+            node = nodes[order[pos]]
+            allocator = DeviceAllocator(self.ctx, node)
+            allocator.add_allocs(proposed_by_node[node.id])
+            for g_i, group in enumerate(groups):
+                used = allocator.used.get(group.id_string(), set())
+                n_free = sum(1 for i in group.instance_ids if i not in used)
+                for r_i, req in enumerate(requests):
+                    if not group.matches_request(req.name):
+                        continue
+                    if req.constraints and not self._dev_constraints_ok(
+                            group, req.constraints):
+                        continue
+                    free[r_i, g_i, pos] = n_free
+                    aff[r_i, g_i, pos] = self._dev_affinity_score(
+                        group, req)
+        return aff, counts, np.asarray(sum_w, dtype=dtype), free
+
+    def _dev_constraints_ok(self, group, constraints) -> bool:
+        from ..scheduler.feasible import DeviceChecker
+        return DeviceChecker._check_device_constraints(
+            _DeviceShim(self.ctx), group, constraints)
+
+    def _dev_affinity_score(self, group, req) -> float:
+        from ..scheduler.feasible import DeviceChecker, check_constraint
+        score = 0.0
+        if req.affinities:
+            for a in req.affinities:
+                lval, l_ok = DeviceChecker._resolve_device_target(
+                    a.l_target, group)
+                rval, r_ok = DeviceChecker._resolve_device_target(
+                    a.r_target, group)
+                if check_constraint(self.ctx, a.operand, lval, rval,
+                                    l_ok, r_ok):
+                    score += float(a.weight)
+        return score
 
     def _pack_preemption(self, tg, nodes, order, n_pad, dtype,
                          proposed_by_node):
@@ -388,6 +550,8 @@ class TpuPlacementService:
                                     lane.order)
         out: List[TpuPlacement] = []
         net_indexes: Dict[str, NetworkIndex] = {}
+        dev_allocators: Dict[str, object] = {}
+        has_devices = any(t.resources.devices for t in tg.tasks)
         for pi, place in enumerate(places):
             pos = int(chosen[pi])
             if pos < 0:
@@ -403,10 +567,35 @@ class TpuPlacementService:
                     preempted = [cands[ai] for ai in np.nonzero(row)[0]
                                  if ai < len(cands)]
             task_resources = {}
+            dev_failed = False
             for task in tg.tasks:
-                task_resources[task.name] = AllocatedTaskResources(
+                tr = AllocatedTaskResources(
                     cpu_shares=task.resources.cpu,
                     memory_mb=task.resources.memory_mb)
+                if has_devices and task.resources.devices:
+                    # replay the deterministic DeviceAllocator on the
+                    # chosen node for exact instance ids (device.go)
+                    from ..scheduler.rank import DeviceAllocator
+                    allocator = dev_allocators.get(node.id)
+                    if allocator is None:
+                        allocator = DeviceAllocator(self.ctx, node)
+                        allocator.add_allocs(
+                            self.ctx.proposed_allocs(node.id))
+                        dev_allocators[node.id] = allocator
+                    for req in task.resources.devices:
+                        offer, _sum_aff, derr = allocator.assign_device(req)
+                        if offer is None:
+                            dev_failed = True
+                            break
+                        allocator.add_reserved(offer)
+                        tr.devices.append(offer)
+                    if dev_failed:
+                        break
+                task_resources[task.name] = tr
+            if dev_failed:
+                out.append(TpuPlacement(place, None, None, None, 0.0,
+                                        int(n_yielded[pi])))
+                continue
             alloc_resources = None
             if tg.networks:
                 idx = net_indexes.get(node.id)
