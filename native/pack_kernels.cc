@@ -11,6 +11,7 @@
 // Build: cmake -S native -B native/build && cmake --build native/build
 
 #include <cstdint>
+#include <cmath>
 #include <cstring>
 
 extern "C" {
@@ -117,6 +118,148 @@ void nt_verify_fit(const double* cpu_cap, const double* mem_cap,
   }
 }
 
-int32_t nt_abi_version() { return 1; }
+// ---------------------------------------------------------------------------
+// Compiled host-baseline oracle: the reference scheduler's per-eval inner
+// loop (reference: scheduler/rank.go:205 BinPackIterator.Next,
+// scheduler/stack.go:82-95 log2 candidate limit, scheduler/select.go
+// LimitIterator/MaxScoreIterator, scheduler/util.go:167 seeded shuffle,
+// nomad/structs/funcs.go:236 ScoreFitBinPack) as straight C++ over packed
+// node arrays. This is the compiled-host number the TPU solver's
+// vs_native_host is measured against: same shuffle, same window semantics,
+// same double-precision score math, same tie-breaks as the Python oracle
+// (parity-gated in tests/test_native_oracle.py).
+//
+// Scope: cpu/mem/disk fit + binpack/spread scoring + job anti-affinity +
+// eligibility mask. Port/device/core asks route to the host oracle in
+// production and are out of the bench workload this baseline times.
+
+static inline uint64_t nt_splitmix64(uint64_t* state, uint64_t* out) {
+  *state += 0x9E3779B97F4A7C15ull;
+  uint64_t z = *state;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  *out = z ^ (z >> 31);
+  return *state;
+}
+
+static const double kBinPackMaxFitScore = 18.0;
+
+void nt_solve_eval(int32_t n_nodes, const double* cpu_cap,
+                   const double* mem_cap, const double* disk_cap,
+                   double* used_cpu, double* used_mem, double* used_disk,
+                   int32_t* placed_jobtg, const uint8_t* eligible,
+                   uint64_t shuffle_seed, double ask_cpu, double ask_mem,
+                   double ask_disk, int32_t desired_count, int32_t limit,
+                   int32_t max_skip, double skip_threshold,
+                   int32_t n_placements, int32_t spread_alg, int32_t* order,
+                   int32_t* out_choice) {
+  // Deterministic Fisher-Yates over the base node order, identical to
+  // scheduler/util.py shuffle_nodes (splitmix64, j = out % (i+1)).
+  for (int32_t i = 0; i < n_nodes; ++i) order[i] = i;
+  uint64_t state = shuffle_seed;
+  for (int32_t i = n_nodes - 1; i > 0; --i) {
+    uint64_t out;
+    nt_splitmix64(&state, &out);
+    const int32_t j = static_cast<int32_t>(out % (uint64_t)(i + 1));
+    const int32_t tmp = order[i];
+    order[i] = order[j];
+    order[j] = tmp;
+  }
+
+  struct Option {
+    int32_t node;
+    double final_score;
+  };
+  // LimitIterator defers at most max_skip low-score options; bounded small.
+  Option skipped[16];
+  if (max_skip > 16) max_skip = 16;
+
+  for (int32_t p = 0; p < n_placements; ++p) {
+    int32_t pos = 0;  // source iterator restarts each Select
+    int32_t seen = 0, n_skipped = 0, skipped_idx = 0;
+    Option best;
+    bool have_best = false;
+
+    // source.next(): next shuffled node passing eligibility + fit, scored.
+    auto source_next = [&](Option* opt) -> bool {
+      while (pos < n_nodes) {
+        const int32_t k = order[pos++];
+        if (!eligible[k]) continue;
+        const double ucpu = used_cpu[k] + ask_cpu;
+        const double umem = used_mem[k] + ask_mem;
+        const double udisk = used_disk[k] + ask_disk;
+        if (ucpu > cpu_cap[k] || umem > mem_cap[k] || udisk > disk_cap[k])
+          continue;  // exhausted: BinPackIterator skips, no window slot used
+        double score = 0.0;
+        if (cpu_cap[k] > 0.0 && mem_cap[k] > 0.0) {
+          const double free_cpu = 1.0 - ucpu / cpu_cap[k];
+          const double free_ram = 1.0 - umem / mem_cap[k];
+          const double total =
+              std::pow(10.0, free_cpu) + std::pow(10.0, free_ram);
+          score = spread_alg ? (total - 2.0) : (20.0 - total);
+          if (score > kBinPackMaxFitScore) score = kBinPackMaxFitScore;
+          if (score < 0.0) score = 0.0;
+        }
+        double final_score = score / kBinPackMaxFitScore;
+        const int32_t collisions = placed_jobtg[k];
+        if (collisions > 0 && desired_count > 0) {
+          const double penalty =
+              -1.0 * (double)(collisions + 1) / (double)desired_count;
+          final_score = (final_score + penalty) / 2.0;  // mean of 2 scores
+        }
+        opt->node = k;
+        opt->final_score = final_score;
+        return true;
+      }
+      return false;
+    };
+    // LimitIterator._next_option(): source first, then deferred skips.
+    auto next_option = [&](Option* opt) -> bool {
+      if (source_next(opt)) return true;
+      if (skipped_idx < n_skipped) {
+        *opt = skipped[skipped_idx++];
+        return true;
+      }
+      return false;
+    };
+
+    // MaxScoreIterator over LimitIterator (select.go semantics, verbatim).
+    while (true) {
+      if (seen == limit) break;
+      Option opt;
+      bool have = next_option(&opt);
+      if (!have) break;
+      if (n_skipped < max_skip) {
+        while (have && opt.final_score <= skip_threshold &&
+               n_skipped < max_skip) {
+          skipped[n_skipped++] = opt;
+          have = source_next(&opt);
+        }
+      }
+      seen += 1;
+      if (!have) {
+        have = next_option(&opt);
+        if (!have) break;  // LimitIterator returned None
+      }
+      if (!have_best || opt.final_score > best.final_score) {
+        best = opt;
+        have_best = true;
+      }
+    }
+
+    if (have_best) {
+      const int32_t k = best.node;
+      used_cpu[k] += ask_cpu;
+      used_mem[k] += ask_mem;
+      used_disk[k] += ask_disk;
+      placed_jobtg[k] += 1;
+      out_choice[p] = k;
+    } else {
+      out_choice[p] = -1;
+    }
+  }
+}
+
+int32_t nt_abi_version() { return 2; }
 
 }  // extern "C"

@@ -40,7 +40,7 @@ def load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(path)
-        if lib.nt_abi_version() != 1:
+        if lib.nt_abi_version() != 2:
             return None
         d = ctypes.POINTER(ctypes.c_double)
         i32 = ctypes.POINTER(ctypes.c_int32)
@@ -57,6 +57,12 @@ def load() -> Optional[ctypes.CDLL]:
             u32, ctypes.c_int64, i32, ctypes.c_int32, u8]
         lib.nt_verify_fit.argtypes = [d, d, d, d, d, d, d, d, d,
                                       ctypes.c_int64, i32]
+        lib.nt_solve_eval.argtypes = [
+            ctypes.c_int32, d, d, d, d, d, d, i32, u8,
+            ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_int32, i32, i32]
         _lib = lib
     except OSError:
         _lib = None
@@ -65,6 +71,69 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def ensure_built(timeout_s: int = 120) -> bool:
+    """Build the native library if absent (g++ one-liner, matching the
+    CMake flags). Used by bench.py so the compiled-host baseline exists on
+    whatever machine runs the bench."""
+    global _load_attempted
+    if available():
+        return True
+    import subprocess
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(here, "native", "pack_kernels.cc")
+    out_dir = os.path.join(here, "native", "build")
+    out = os.path.join(out_dir, "libnomad_tpu_native.so")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(out_dir, exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             "-o", out, src],
+            check=True, capture_output=True, timeout=timeout_s)
+    except (subprocess.SubprocessError, OSError):
+        return False
+    _load_attempted = False
+    return available()
+
+
+def solve_eval(cpu_cap: np.ndarray, mem_cap: np.ndarray, disk_cap: np.ndarray,
+               used_cpu: np.ndarray, used_mem: np.ndarray,
+               used_disk: np.ndarray, placed_jobtg: np.ndarray,
+               eligible: np.ndarray, shuffle_seed: int,
+               ask_cpu: float, ask_mem: float, ask_disk: float,
+               desired_count: int, limit: int, n_placements: int,
+               spread_alg: bool = False, max_skip: int = 3,
+               skip_threshold: float = 0.0) -> Optional[np.ndarray]:
+    """Run the compiled host-baseline oracle: n_placements sequential
+    window-limited binpack selections with usage carry (the reference's
+    per-eval inner loop, scheduler/rank.go:205 + stack.go:82-95). Mutates
+    used_* and placed_jobtg in place; returns chosen node index per
+    placement (-1 = no placement), or None when the library is absent."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(cpu_cap)
+    for arr, dt in ((cpu_cap, np.float64), (mem_cap, np.float64),
+                    (disk_cap, np.float64), (used_cpu, np.float64),
+                    (used_mem, np.float64), (used_disk, np.float64),
+                    (placed_jobtg, np.int32), (eligible, np.uint8)):
+        if arr.dtype != dt or not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("solve_eval requires contiguous typed arrays")
+    order = np.empty(n, dtype=np.int32)
+    out_choice = np.empty(n_placements, dtype=np.int32)
+    lib.nt_solve_eval(
+        n, _ptr(cpu_cap, ctypes.c_double), _ptr(mem_cap, ctypes.c_double),
+        _ptr(disk_cap, ctypes.c_double), _ptr(used_cpu, ctypes.c_double),
+        _ptr(used_mem, ctypes.c_double), _ptr(used_disk, ctypes.c_double),
+        _ptr(placed_jobtg, ctypes.c_int32), _ptr(eligible, ctypes.c_uint8),
+        shuffle_seed, float(ask_cpu), float(ask_mem), float(ask_disk),
+        desired_count, limit, max_skip, skip_threshold, n_placements,
+        1 if spread_alg else 0, _ptr(order, ctypes.c_int32),
+        _ptr(out_choice, ctypes.c_int32))
+    return out_choice
 
 
 def _ptr(arr, ctype):
