@@ -63,6 +63,8 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
             ctypes.c_int32, i32, i32]
+        lib.nt_shuffled_order.argtypes = [ctypes.c_uint64, ctypes.c_int32,
+                                          i32]
         _lib = lib
     except OSError:
         _lib = None
@@ -97,6 +99,18 @@ def ensure_built(timeout_s: int = 120) -> bool:
         return False
     _load_attempted = False
     return available()
+
+
+def shuffled_order(seed: int, n: int) -> Optional[np.ndarray]:
+    """The deterministic per-eval Fisher-Yates permutation (identical to
+    scheduler/util.py shuffled_order) computed natively; None when the
+    library is absent."""
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(n, dtype=np.int32)
+    lib.nt_shuffled_order(seed, n, _ptr(out, ctypes.c_int32))
+    return out
 
 
 def solve_eval(cpu_cap: np.ndarray, mem_cap: np.ndarray, disk_cap: np.ndarray,

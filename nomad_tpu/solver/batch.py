@@ -150,17 +150,12 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
     import jax
     import jax.numpy as jnp
 
-    from .binpack import solve_eval_batch, solve_eval_batch_preempt
+    from .binpack import solve_eval_batch, solve_lane_fused
 
     if ptab is not None:
-        chosen, scores, n_yielded, evict_rows, _ = solve_eval_batch_preempt(
-            const, init, batch, ptab, pinit, spread_alg=spread_alg,
-            dtype_name=dtype_name)
-        combined = np.asarray(jnp.concatenate([
-            chosen.astype(scores.dtype)[None], scores[None],
-            n_yielded.astype(scores.dtype)[None]], axis=0))
-        return (combined[0], combined[1], combined[2],
-                np.asarray(evict_rows))
+        return solve_lane_fused(const, init, batch, ptab, pinit,
+                                spread_alg=spread_alg,
+                                dtype_name=dtype_name, batched=True)
 
     E = const.cpu_cap.shape[0]
     N = const.cpu_cap.shape[1]
@@ -182,14 +177,12 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
                     c, i, b, spread_alg=spread_alg, dtype_name=dtype_name),
                 out_shardings=NamedSharding(mesh, P()))
             chosen, scores, n_yielded, _ = fn(s_const, s_init, s_batch)
-    else:
-        chosen, scores, n_yielded, _ = solve_eval_batch(
-            const, init, batch, spread_alg=spread_alg,
-            dtype_name=dtype_name)
-    combined = np.asarray(jnp.concatenate([
-        chosen.astype(scores.dtype)[None], scores[None],
-        n_yielded.astype(scores.dtype)[None]], axis=0))
-    return combined[0], combined[1], combined[2]
+        combined = np.asarray(jnp.concatenate([
+            chosen.astype(scores.dtype)[None], scores[None],
+            n_yielded.astype(scores.dtype)[None]], axis=0))
+        return combined[0], combined[1], combined[2]
+    return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
+                            dtype_name=dtype_name, batched=True)
 
 
 class SolveBarrier:

@@ -120,32 +120,15 @@ def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
 def dispatch_lane(lane: PackedLane):
     """Solve ONE lane in its own device dispatch; returns host-side numpy
     (chosen, scores, n_yielded[, evict_rows]). The batched path fuses many
-    lanes through solver.batch instead."""
-    import jax.numpy as jnp
-    from .binpack import solve_placements, solve_placements_preempt
+    lanes through solver.batch instead. Transfers are fused (one
+    device_put, one fetch -- binpack.solve_lane_fused): per-leaf transfers
+    each pay a host<->device round trip, which over a tunneled TPU costs
+    more than the entire compiled scan."""
+    from .binpack import solve_lane_fused
 
-    if lane.ptab is not None:
-        chosen, scores, n_yielded, evict_rows, _ = solve_placements_preempt(
-            lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
-            spread_alg=lane.spread_alg, dtype_name=lane.dtype_name)
-        combined = np.asarray(jnp.stack([
-            chosen.astype(scores.dtype), scores,
-            n_yielded.astype(scores.dtype)]))
-        return (combined[0].astype(np.int64), combined[1],
-                combined[2].astype(np.int64), np.asarray(evict_rows))
-
-    chosen, scores, n_yielded, _ = solve_placements(
-        lane.const, lane.init, lane.batch, spread_alg=lane.spread_alg,
-        dtype_name=lane.dtype_name)
-    # Single device->host fetch: individual fetches each pay the full
-    # host<->device round trip (severe over a tunneled TPU), so stack all
-    # outputs and read once. int32 values are exact in f32/f64 here
-    # (node indexes < 2^24).
-    combined = np.asarray(jnp.stack([
-        chosen.astype(scores.dtype), scores,
-        n_yielded.astype(scores.dtype)]))
-    return (combined[0].astype(np.int64), combined[1],
-            combined[2].astype(np.int64))
+    return solve_lane_fused(
+        lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
+        spread_alg=lane.spread_alg, dtype_name=lane.dtype_name)
 
 
 class _DeviceShim:
@@ -203,12 +186,20 @@ class TpuPlacementService:
 
         n = len(nodes)
         state_index = self.ctx.state.latest_index()
-        matrix = pack_nodes(nodes)
+        from ..tensor.pack import pack_nodes_cached
+        matrix = pack_nodes_cached(
+            nodes, getattr(self.ctx.state, "node_table_index", None))
         n_pad = matrix.n_pad
 
         # Same permutation the host stack applies in set_nodes
-        # (scheduler/util.py shuffle_nodes seeded by eval id + index).
-        order = shuffled_order(self.ctx.plan.eval_id, state_index, n)
+        # (scheduler/util.py shuffle_nodes seeded by eval id + index);
+        # native Fisher-Yates when the library is built.
+        from .. import native as _nat
+        from ..scheduler.util import shuffle_seed
+        order = _nat.shuffled_order(
+            shuffle_seed(self.ctx.plan.eval_id, state_index), n)
+        if order is None:
+            order = shuffled_order(self.ctx.plan.eval_id, state_index, n)
         perm = np.concatenate([np.asarray(order, dtype=np.int64),
                                np.arange(n, n_pad, dtype=np.int64)])
         inv = np.empty(n_pad, dtype=np.int64)

@@ -40,6 +40,9 @@ class StateSnapshot:
     def __init__(self, store: "StateStore"):
         with store._lock:
             self.index = store._index
+            # node-table version: cache key for tensorized fleet tables
+            # (tensor/pack.py pack_nodes_cached)
+            self.node_table_index = store._table_index.get("nodes", 0)
             self._nodes = dict(store._nodes)
             self._jobs = dict(store._jobs)
             self._evals = dict(store._evals)

@@ -81,6 +81,37 @@ def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
                       dyn_free=dyn_free, valid=valid)
 
 
+# pack_nodes is ~20ms at 10K nodes but its inputs only change when the
+# node table does; cache per (node-table version, node-id tuple). The id
+# tuple guards against different filtered subsets (datacenter/pool
+# eligibility differs per job) sharing a table version. Concurrent eval
+# workers hit this, hence the lock.
+import threading as _threading
+
+_NODE_MATRIX_CACHE: Dict[tuple, NodeMatrix] = {}
+_NODE_MATRIX_CACHE_MAX = 8
+_NODE_MATRIX_LOCK = _threading.Lock()
+
+
+def pack_nodes_cached(nodes, node_table_index: Optional[int]) -> NodeMatrix:
+    """pack_nodes memoized by node-table version. Callers must treat the
+    result as immutable (service.py copies the port bitmap before
+    seeding)."""
+    if node_table_index is None:
+        return pack_nodes(nodes)
+    key = (node_table_index, tuple(n.id for n in nodes))
+    with _NODE_MATRIX_LOCK:
+        hit = _NODE_MATRIX_CACHE.get(key)
+    if hit is not None:
+        return hit
+    matrix = pack_nodes(nodes)
+    with _NODE_MATRIX_LOCK:
+        while len(_NODE_MATRIX_CACHE) >= _NODE_MATRIX_CACHE_MAX:
+            _NODE_MATRIX_CACHE.pop(next(iter(_NODE_MATRIX_CACHE)))
+        _NODE_MATRIX_CACHE[key] = matrix
+    return matrix
+
+
 @dataclass
 class UsageState:
     """Dynamic usage on the node axis: what proposed allocs consume

@@ -162,3 +162,46 @@ def test_alloc_table_pack_equals_direct_pack():
     np.testing.assert_array_equal(placed, want.placed_jobtg)
     np.testing.assert_array_equal(placed_job, want.placed_job)
     np.testing.assert_array_equal(packed["port_words"], want.port_bitmap)
+
+
+def test_native_shuffled_order_matches_python():
+    from nomad_tpu import native
+    from nomad_tpu.scheduler.util import shuffle_seed, shuffled_order
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    for eval_id, idx, n in (("native-parity-eval-0001", 7, 1),
+                            ("native-parity-eval-0001", 7, 97),
+                            ("another-eval-fffe", 123, 1000)):
+        want = shuffled_order(eval_id, idx, n)
+        got = native.shuffled_order(shuffle_seed(eval_id, idx), n)
+        assert list(got) == want
+
+
+def test_pack_nodes_cached_invalidates_on_table_change():
+    from nomad_tpu import mock
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.tensor.pack import pack_nodes_cached
+
+    store = StateStore()
+    n1 = mock.node()
+    store.upsert_node(n1)
+    snap = store.snapshot()
+    nodes = snap.nodes()
+    m1 = pack_nodes_cached(nodes, snap.node_table_index)
+    assert pack_nodes_cached(nodes, snap.node_table_index) is m1
+    # capacity change bumps the nodes table -> new matrix
+    n1.node_resources.cpu.cpu_shares = 12345
+    store.upsert_node(n1)
+    snap2 = store.snapshot()
+    nodes2 = snap2.nodes()
+    m2 = pack_nodes_cached(nodes2, snap2.node_table_index)
+    assert m2 is not m1
+    assert m2.cpu_cap[0] == 12345
+    # a different filtered subset must not hit the same entry
+    n3 = mock.node()
+    store.upsert_node(n3)
+    snap3 = store.snapshot()
+    sub = [n for n in snap3.nodes() if n.id == n3.id]
+    m3 = pack_nodes_cached(sub, snap3.node_table_index)
+    assert m3.n_real == 1
