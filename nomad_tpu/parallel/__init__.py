@@ -1,2 +1,2 @@
 """Multi-chip sharding of the solver over a jax.sharding.Mesh."""
-from .mesh import make_mesh, shard_solver_inputs  # noqa: F401
+from .mesh import make_mesh, pick_mesh, shard_solver_inputs  # noqa: F401

@@ -44,6 +44,29 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(dev_grid, ("evals", "nodes"))
 
 
+def pick_mesh(e: int, n: int, n_devices: Optional[int] = None):
+    """Choose an (evals, nodes) grid that divides THIS batch's shapes:
+    e_par = largest divisor of the eval axis that fits the device count,
+    n_par = largest divisor of the (padded) node axis using the remaining
+    devices. Falls back to pure node-sharding for E=1, so a single big
+    eval still spreads over all chips. Returns None when fewer than 2
+    devices can be used."""
+    import jax
+
+    d = n_devices if n_devices is not None else jax.device_count()
+    if d <= 1 or e < 1 or n < 1:
+        return None
+
+    def largest_divisor(x: int, cap: int) -> int:
+        return next(c for c in range(min(x, cap), 0, -1) if x % c == 0)
+
+    e_par = largest_divisor(e, d)
+    n_par = largest_divisor(n, d // e_par)
+    if e_par * n_par < 2:
+        return None
+    return make_mesh(e_par * n_par, eval_parallel=e_par)
+
+
 def shard_solver_inputs(mesh, const, init, batch):
     """NamedShardings for solve_eval_batch inputs: leading axis (E) on
     'evals'; node-axis (last dim of per-node arrays) on 'nodes'."""

@@ -161,14 +161,12 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
     N = const.cpu_cap.shape[1]
     mesh = None
     if use_mesh and jax.device_count() > 1:
-        from ..parallel.mesh import make_mesh, shard_solver_inputs
-        cand = make_mesh()
-        e_par, n_par = cand.devices.shape
-        if E % e_par == 0 and N % n_par == 0:
-            mesh = cand
+        from ..parallel.mesh import pick_mesh, shard_solver_inputs
+        mesh = pick_mesh(E, N)
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
+        metrics.incr("nomad.solver.mesh_dispatches")
         with mesh:
             s_const, s_init, s_batch = shard_solver_inputs(
                 mesh, const, init, batch)

@@ -42,6 +42,76 @@ def test_eval_batch_unsharded_matches_sharded():
                                np.asarray(sharded[1]), rtol=0, atol=0)
 
 
+def test_sharded_parity_at_padded_scale():
+    """Sharded vs unsharded equality at a real padded fleet shape (4096
+    nodes), the bucket the 4K-node BASELINE tiers use -- this is the CI
+    stand-in for multi-chip hardware (VERDICT r2 next #4)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh(8)
+    E, N, P = mesh.devices.shape[0], 4096, 16
+    const, init, batch = _inputs(E, N, P)
+    plain = solve_eval_batch(const, init, batch, dtype_name="float64")
+    with mesh:
+        s_const, s_init, s_batch = shard_solver_inputs(mesh, const, init,
+                                                       batch)
+        sharded = solve_eval_batch(s_const, s_init, s_batch,
+                                   dtype_name="float64")
+    np.testing.assert_array_equal(np.asarray(plain[0]),
+                                  np.asarray(sharded[0]))
+    np.testing.assert_allclose(np.asarray(plain[1]),
+                               np.asarray(sharded[1]), rtol=0, atol=0)
+
+
+def test_batch_worker_mesh_branch_end_to_end():
+    """BatchWorker(use_mesh=True) over the virtual mesh: the fused batch
+    must dispatch through solver/batch.py's mesh branch (asserted via the
+    mesh_dispatches counter) and place every alloc correctly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import time as _time
+
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.telemetry import metrics
+    from nomad_tpu.structs import SchedulerConfiguration
+
+    metrics.reset()
+    server = Server(num_workers=4, heartbeat_ttl=30.0, eval_batching=True,
+                    batch_width=4)
+    server.state.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm="tpu-binpack"))
+    server.start()
+    try:
+        for i in range(8):
+            n = mock.node()
+            n.id = f"mesh-node-{i:04d}"
+            n.compute_class()
+            server.register_node(n)
+        jobs = []
+        for i in range(4):
+            job = mock.job(id=f"mesh-job-{i}")
+            job.task_groups[0].count = 3
+            jobs.append(job)
+        for job in jobs:
+            server.register_job(job)
+
+        def placed():
+            return sum(
+                1 for job in jobs
+                for a in server.state.allocs_by_job(job.namespace, job.id)
+                if a.desired_status == "run")
+
+        deadline = _time.time() + 30
+        while _time.time() < deadline and placed() < 12:
+            _time.sleep(0.05)
+        assert placed() == 12
+        snap = metrics.snapshot()
+        assert snap["counters"].get("nomad.solver.mesh_dispatches", 0) >= 1
+    finally:
+        server.shutdown()
+
+
 def test_eval_batch_independence():
     # each eval in the batch sees ONLY its own usage (optimistic concurrency)
     E, N, P = 2, 32, 3
