@@ -1,0 +1,175 @@
+"""Cgroup editor: resource-limit enforcement for isolated tasks.
+
+Semantic parity with /root/reference/client/lib/cgroupslib (the v1/v2
+editor the executor uses) and the limits drivers/shared/executor applies
+(executor_linux.go:35 region: cpu shares + memory limits via
+libcontainer). Pure-file implementation: v2 (unified hierarchy) preferred,
+v1 (split memory/cpu controllers) fallback -- this build environment
+mounts v1 with a controller-less unified dir, so both paths are real.
+
+The root is injectable so tests can drive the v2 path against a fake
+filesystem even on a v1 host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+PARENT = "nomad_tpu"
+
+
+def _write(path: str, value: str) -> bool:
+    try:
+        with open(path, "w") as f:
+            f.write(value)
+        return True
+    except OSError:
+        return False
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def shares_to_weight(shares: int) -> int:
+    """cgroup v1 cpu.shares [2, 262144] -> v2 cpu.weight [1, 10000]
+    (the kernel's documented mapping, used by the reference's cpuparts)."""
+    shares = max(2, min(int(shares), 262144))
+    return 1 + ((shares - 2) * 9999) // 262142
+
+
+class Cgroup:
+    """One task's cgroup: v2 = a single directory, v1 = one directory per
+    controller."""
+
+    def __init__(self, version: int, paths: List[str]):
+        self.version = version
+        self.paths = paths          # v2: [dir]; v1: [memory_dir, cpu_dir]
+
+    def add_pid(self, pid: int) -> bool:
+        ok = True
+        for p in self.paths:
+            ok = _write(os.path.join(p, "cgroup.procs"), str(pid)) and ok
+        return ok
+
+    def procs(self) -> List[int]:
+        out: List[int] = []
+        for p in self.paths:
+            raw = _read(os.path.join(p, "cgroup.procs")) or ""
+            for line in raw.splitlines():
+                if line.strip():
+                    out.append(int(line))
+            break               # one controller's view is authoritative
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        """Memory bytes + cpu usage usec, whichever files exist."""
+        out: Dict[str, int] = {}
+        for p in self.paths:
+            cur = _read(os.path.join(p, "memory.current")) \
+                or _read(os.path.join(p, "memory.usage_in_bytes"))
+            if cur is not None:
+                out["memory_bytes"] = int(cur)
+            stat = _read(os.path.join(p, "cpu.stat"))
+            if stat:
+                for line in stat.splitlines():
+                    k, _, v = line.partition(" ")
+                    if k == "usage_usec":
+                        out["cpu_usec"] = int(v)
+            usage = _read(os.path.join(p, "cpuacct.usage"))
+            if usage is not None:
+                out["cpu_usec"] = int(usage) // 1000
+        return out
+
+    def kill(self) -> None:
+        """Kill every process in the group (v2: cgroup.kill; v1: signal
+        each pid)."""
+        import signal
+        for p in self.paths:
+            if _write(os.path.join(p, "cgroup.kill"), "1"):
+                return
+        for pid in self.procs():
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def destroy(self) -> None:
+        for p in self.paths:
+            try:
+                os.rmdir(p)
+            except OSError:
+                pass
+
+
+class CgroupManager:
+    """Creates per-task cgroups under <root>/.../nomad_tpu/<scope>."""
+
+    def __init__(self, root: str = CGROUP_ROOT):
+        self.root = root
+        self.version = self._detect()
+
+    def _detect(self) -> int:
+        """v2 iff the root itself is the unified hierarchy WITH usable
+        controllers (a bare hybrid-mode unified mount doesn't count)."""
+        ctrl = _read(os.path.join(self.root, "cgroup.controllers"))
+        if ctrl is not None and ("memory" in ctrl or "cpu" in ctrl):
+            return 2
+        if os.path.isdir(os.path.join(self.root, "memory")) \
+                or os.path.isdir(os.path.join(self.root, "cpu")):
+            return 1
+        return 0
+
+    def available(self) -> bool:
+        if self.version == 0:
+            return False
+        probe = (os.path.join(self.root, PARENT) if self.version == 2
+                 else os.path.join(self.root, "memory", PARENT))
+        try:
+            os.makedirs(probe, exist_ok=True)
+            return True
+        except OSError:
+            return False
+
+    def create(self, scope: str, cpu_shares: int = 0,
+               memory_mb: int = 0) -> Optional[Cgroup]:
+        """Create + configure a task cgroup; None when unsupported."""
+        if self.version == 2:
+            path = os.path.join(self.root, PARENT, scope)
+            try:
+                os.makedirs(path, exist_ok=True)
+            except OSError:
+                return None
+            # enable controllers on the parent for child delegation
+            _write(os.path.join(self.root, PARENT, "cgroup.subtree_control"),
+                   "+cpu +memory")
+            if memory_mb > 0:
+                _write(os.path.join(path, "memory.max"),
+                       str(memory_mb * 1024 * 1024))
+            if cpu_shares > 0:
+                _write(os.path.join(path, "cpu.weight"),
+                       str(shares_to_weight(cpu_shares)))
+            return Cgroup(2, [path])
+        if self.version == 1:
+            paths = []
+            mem = os.path.join(self.root, "memory", PARENT, scope)
+            cpu = os.path.join(self.root, "cpu", PARENT, scope)
+            try:
+                os.makedirs(mem, exist_ok=True)
+                os.makedirs(cpu, exist_ok=True)
+            except OSError:
+                return None
+            if memory_mb > 0:
+                _write(os.path.join(mem, "memory.limit_in_bytes"),
+                       str(memory_mb * 1024 * 1024))
+            if cpu_shares > 0:
+                _write(os.path.join(cpu, "cpu.shares"),
+                       str(max(2, int(cpu_shares))))
+            paths = [mem, cpu]
+            return Cgroup(1, paths)
+        return None

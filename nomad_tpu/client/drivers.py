@@ -308,12 +308,212 @@ class RawExecDriver(Driver):
 
 class ExecDriver(RawExecDriver):
     """Isolated fork/exec (reference: drivers/exec via libcontainer,
-    executor_linux.go:35). Best-effort isolation without root: own session
-    + rlimits; cgroup/namespace isolation requires privileges the test
-    environment lacks, so it degrades to raw_exec semantics with the same
-    driver contract."""
+    executor_linux.go:35). With root + namespaces available the payload
+    runs chrooted into the task dir (read-only binds of the host
+    toolchain, the reference's allocdir chroot file map) inside fresh
+    mount+PID namespaces with cpu/memory cgroup limits
+    (client/executor.py). Without privileges it degrades to raw_exec
+    semantics under the same driver contract, exactly like the
+    reference's non-Linux executor."""
 
     name = "exec"
+
+    def __init__(self):
+        super().__init__()
+        self._cgroups: Dict[str, object] = {}
+
+    def fingerprint(self) -> Dict[str, object]:
+        from .executor import probe_caps
+        caps = probe_caps()
+        return {"detected": True, "healthy": True,
+                "attributes": {"driver.exec.isolation":
+                               "chroot+ns+cgroup" if caps.namespaces
+                               else "none"}}
+
+    def start_task(self, task_id: str, task: Task, env: Dict[str, str],
+                   task_dir) -> TaskHandle:
+        from .executor import probe_caps
+        caps = probe_caps()
+        if not caps.namespaces or task_dir is None:
+            return super().start_task(task_id, task, env, task_dir)
+        cfg = task.config or {}
+        command = str(cfg.get("command", ""))
+        if not command:
+            raise DriverError("exec requires config.command")
+        args = [interpolate(str(a), None, None, env)
+                for a in cfg.get("args", [])]
+        # the shared alloc dir lives outside the task dir -> bind it in
+        from .executor import DEFAULT_CHROOT_BINDS
+        binds = list(DEFAULT_CHROOT_BINDS)
+        binds.append(f"{task_dir.alloc.shared_dir}:/alloc")
+        return self._start_isolated(
+            task_id, [command] + args, env, task_dir,
+            root=task_dir.dir, workdir="/local",
+            cpu_shares=task.resources.cpu,
+            memory_mb=task.resources.memory_mb, binds=binds)
+
+    def _start_isolated(self, task_id, argv, env, task_dir, root, workdir,
+                        cpu_shares, memory_mb, binds) -> TaskHandle:
+        from .executor import launch_isolated
+        # sandbox env vars must name CHROOT paths, not host paths
+        env = dict(env)
+        env.update({"NOMAD_TASK_DIR": "/local",
+                    "NOMAD_ALLOC_DIR": "/alloc",
+                    "NOMAD_SECRETS_DIR": "/secrets"})
+        try:
+            proc, cgroup = launch_isolated(
+                task_id, argv, env, root=root,
+                launcher_dir=task_dir.tmp_dir,
+                stdout_path=task_dir.stdout_path(),
+                stderr_path=task_dir.stderr_path(),
+                cpu_shares=cpu_shares, memory_mb=memory_mb,
+                binds=binds, workdir=workdir)
+        except OSError as e:
+            raise DriverError(f"failed to start isolated task: {e}") from e
+        state: Dict[str, object] = {"isolated": True}
+        with self._lock:
+            self._procs[task_id] = proc
+            if cgroup is not None:
+                self._cgroups[task_id] = cgroup
+                state["cgroup_version"] = cgroup.version
+                state["cgroup_paths"] = list(cgroup.paths)
+        return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid,
+                          started_at=time.time(), driver_state=state)
+
+    def wait_task(self, handle: TaskHandle,
+                  timeout: Optional[float] = None) -> Optional[ExitResult]:
+        result = super().wait_task(handle, timeout)
+        if result is not None:
+            self._cleanup_cgroup(handle.task_id)
+        return result
+
+    def stop_task(self, handle: TaskHandle, kill_timeout: float = 5.0) -> None:
+        if not handle.driver_state.get("isolated"):
+            return super().stop_task(handle, kill_timeout)
+        # Graceful stop must reach the PAYLOAD, not the unshare
+        # supervisor: SIGTERM to the supervisor kills it and --kill-child
+        # SIGKILLs the payload instantly, zeroing the kill_timeout grace
+        # window. The cgroup lists exactly the payload tree (the
+        # supervisor never joins it).
+        proc = self._procs.get(handle.task_id)
+        cgroup = self._cgroups.get(handle.task_id)
+        delivered = False
+        if cgroup is not None:
+            for pid in cgroup.procs():
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                    delivered = True
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if delivered and proc is not None:
+            try:
+                proc.wait(kill_timeout)
+            except subprocess.TimeoutExpired:
+                pass
+        super().stop_task(handle, kill_timeout if not delivered else 1.0)
+        self._cleanup_cgroup(handle.task_id)
+
+    def _cleanup_cgroup(self, task_id: str) -> None:
+        cgroup = self._cgroups.pop(task_id, None)
+        if cgroup is not None:
+            cgroup.kill()       # reap any escaped descendants
+            cgroup.destroy()
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        """Re-attach after agent restart; rebuild the cgroup handle from
+        driver_state so exit-time cleanup still happens."""
+        ok = super().recover_task(handle)
+        paths = handle.driver_state.get("cgroup_paths")
+        if paths:
+            from .cgroups import Cgroup
+            cgroup = Cgroup(int(handle.driver_state.get(
+                "cgroup_version", 1)), list(paths))
+            if ok:
+                with self._lock:
+                    self._cgroups[handle.task_id] = cgroup
+            else:
+                cgroup.kill()
+                cgroup.destroy()
+        return ok
+
+    def task_cgroup(self, task_id: str):
+        """The live Cgroup for a task (stats + tests)."""
+        return self._cgroups.get(task_id)
+
+
+class ContainerDriver(ExecDriver):
+    """Minimal container driver (reference: drivers/docker, scoped to the
+    oci-rootfs essentials): config.image names a rootfs directory or a
+    .tar/.tar.gz unpacked into the task sandbox; the payload chroots into
+    that rootfs inside mount+PID namespaces with NO host binds -- only the
+    task's /local, /alloc and /secrets sandbox dirs and a fresh /proc are
+    mounted in, with cpu/memory cgroup limits applied."""
+
+    name = "container"
+
+    def fingerprint(self) -> Dict[str, object]:
+        from .executor import probe_caps
+        caps = probe_caps()
+        return {"detected": caps.namespaces, "healthy": caps.namespaces,
+                "attributes": {"driver.container.rootfs": "chroot"}}
+
+    def start_task(self, task_id: str, task: Task, env: Dict[str, str],
+                   task_dir) -> TaskHandle:
+        from .executor import probe_caps
+        if not probe_caps().namespaces:
+            raise DriverError("container driver requires namespace support")
+        if task_dir is None:
+            raise DriverError("container driver requires a task dir")
+        cfg = task.config or {}
+        image = str(cfg.get("image", ""))
+        command = str(cfg.get("command", ""))
+        if not image or not command:
+            raise DriverError("container requires config.image and "
+                              "config.command")
+        rootfs = self._materialize_rootfs(image, task_dir)
+        args = [interpolate(str(a), None, None, env)
+                for a in cfg.get("args", [])]
+        binds = [] if not cfg.get("host_binds") \
+            else [str(b) for b in cfg["host_binds"]]
+        # sandbox dirs appear at the nomad-standard mount points
+        for sub, target in ((task_dir.local_dir, "/local"),
+                            (task_dir.secrets_dir, "/secrets"),
+                            (task_dir.alloc.shared_dir, "/alloc")):
+            binds.append(f"{sub}:{target}")
+        return self._start_isolated(
+            task_id, [command] + args, env, task_dir,
+            root=rootfs, workdir="/",
+            cpu_shares=task.resources.cpu,
+            memory_mb=task.resources.memory_mb, binds=binds)
+
+    @staticmethod
+    def _materialize_rootfs(image: str, task_dir) -> str:
+        """Copy/unpack the image into the task sandbox so container
+        writes never mutate the shared image (reference: docker's
+        per-container layer)."""
+        import tarfile
+
+        rootfs = os.path.join(task_dir.dir, "rootfs")
+        if os.path.isdir(rootfs):
+            return rootfs           # restart: reuse the materialized fs
+        # materialize into a scratch dir and rename into place so a crash
+        # mid-copy can never leave a half-built rootfs that a restart
+        # would silently trust
+        partial = rootfs + ".partial"
+        import shutil
+        shutil.rmtree(partial, ignore_errors=True)
+        if os.path.isdir(image):
+            shutil.copytree(image, partial, symlinks=True)
+        elif os.path.isfile(image) and (
+                image.endswith(".tar") or image.endswith(".tar.gz")
+                or image.endswith(".tgz")):
+            os.makedirs(partial, exist_ok=True)
+            with tarfile.open(image) as tf:
+                tf.extractall(partial, filter="tar")
+        else:
+            raise DriverError(f"container image not found: {image}")
+        os.rename(partial, rootfs)
+        return rootfs
 
 
 def _pid_alive(pid: int) -> bool:
@@ -333,7 +533,8 @@ class DriverRegistry:
 
     def __init__(self, enabled: Optional[List[str]] = None):
         all_drivers = {d.name: d for d in
-                       (MockDriver(), RawExecDriver(), ExecDriver())}
+                       (MockDriver(), RawExecDriver(), ExecDriver(),
+                        ContainerDriver())}
         if enabled is not None:
             all_drivers = {k: v for k, v in all_drivers.items()
                            if k in enabled}

@@ -60,8 +60,17 @@ def pick_mesh(e: int, n: int, n_devices: Optional[int] = None):
     def largest_divisor(x: int, cap: int) -> int:
         return next(c for c in range(min(x, cap), 0, -1) if x % c == 0)
 
-    e_par = largest_divisor(e, d)
-    n_par = largest_divisor(n, d // e_par)
+    # choose the split that uses the MOST devices (a greedy eval-first
+    # pick can strand chips, e.g. E=3 on 8 devices -> 3x2 when 1x8 uses
+    # all); prefer eval-parallelism among equals (perfectly parallel)
+    best = (1, 1)
+    for e_par in range(min(e, d), 0, -1):
+        if e % e_par:
+            continue
+        n_par = largest_divisor(n, d // e_par)
+        if e_par * n_par > best[0] * best[1]:
+            best = (e_par, n_par)
+    e_par, n_par = best
     if e_par * n_par < 2:
         return None
     return make_mesh(e_par * n_par, eval_parallel=e_par)

@@ -1,0 +1,319 @@
+"""Real workload isolation: chroot + namespaces + cgroup limits
+(reference: drivers/shared/executor/executor_linux.go:35 libcontainer
+isolation, drivers/exec, drivers/docker; VERDICT r2 next #5).
+
+Tests skip on hosts without root/namespace support; this build
+environment has both, so they run in CI.
+"""
+import os
+import shutil
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.cgroups import CgroupManager, shares_to_weight
+from nomad_tpu.client.drivers import (
+    ContainerDriver, DriverError, ExecDriver,
+)
+from nomad_tpu.client.executor import probe_caps
+from nomad_tpu.structs import Resources, Task
+
+CAPS = probe_caps()
+
+needs_isolation = pytest.mark.skipif(
+    not CAPS.namespaces, reason="requires root + namespace support")
+needs_cgroups = pytest.mark.skipif(
+    not CAPS.cgroups, reason="requires writable cgroups")
+
+
+def make_task_dir(tmp_path, name="t1"):
+    ad = AllocDir(str(tmp_path), "alloc-isolation-0001")
+    ad.build()
+    td = ad.new_task_dir(name)
+    td.build()
+    return td
+
+
+def exec_task(command, args, cpu=100, memory_mb=32):
+    return Task(name="t1", driver="exec",
+                config={"command": command, "args": args},
+                resources=Resources(cpu=cpu, memory_mb=memory_mb))
+
+
+@needs_isolation
+def test_exec_cannot_see_host_filesystem(tmp_path):
+    """The chrooted payload must not see the agent's host paths -- the
+    round-1/2 exec driver was raw_exec with no isolation."""
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c",
+                                 "ls /root/repo >/dev/null 2>&1 "
+                                 "&& echo VISIBLE || echo ISOLATED; "
+                                 "pwd; ls /"])
+    handle = drv.start_task("iso-task-0001", task, {"NOMAD_TASK_NAME": "t1"},
+                            td)
+    result = drv.wait_task(handle, timeout=15.0)
+    assert result is not None and result.exit_code == 0, result
+    out = open(td.stdout_path(), "rb").read().decode()
+    assert "ISOLATED" in out, out
+    assert "VISIBLE" not in out
+    # the sandbox root contains the task layout, not the host root
+    assert "/local" in out or "local" in out.split()
+
+
+@needs_isolation
+def test_exec_sandbox_dirs_writable_and_host_ro(tmp_path):
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c",
+                                 "echo sandboxed > /local/out.txt && "
+                                 "(touch /usr/its-ro 2>/dev/null "
+                                 "&& echo WROTE_HOST || echo HOST_RO)"])
+    handle = drv.start_task("iso-task-0002", task, {}, td)
+    result = drv.wait_task(handle, timeout=15.0)
+    assert result is not None and result.exit_code == 0, result
+    # the write landed in the real task dir through the chroot
+    assert open(os.path.join(td.local_dir, "out.txt")).read().strip() \
+        == "sandboxed"
+    out = open(td.stdout_path(), "rb").read().decode()
+    assert "HOST_RO" in out, out
+
+
+@needs_isolation
+def test_exec_pid_namespace(tmp_path):
+    """The payload is PID 1's child in a fresh PID namespace: it must not
+    see the agent's processes."""
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c", "ls /proc | grep -c '^[0-9]'"])
+    handle = drv.start_task("iso-task-0003", task, {}, td)
+    result = drv.wait_task(handle, timeout=15.0)
+    assert result is not None and result.exit_code == 0, result
+    n_procs = int(open(td.stdout_path()).read().strip())
+    assert n_procs <= 4, f"saw {n_procs} processes -- no PID namespace?"
+
+
+@needs_isolation
+@needs_cgroups
+def test_exec_cgroup_limits_written(tmp_path):
+    """The VERDICT's done-condition: the cgroup file must carry the
+    task's memory limit while it runs, and the payload pid must be in
+    cgroup.procs."""
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c", "sleep 30"], cpu=250, memory_mb=64)
+    handle = drv.start_task("iso-task-0004", task, {}, td)
+    try:
+        cgroup = None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            cgroup = drv.task_cgroup("iso-task-0004")
+            if cgroup is not None and cgroup.procs():
+                break
+            time.sleep(0.1)
+        assert cgroup is not None
+        assert cgroup.procs(), "no pid joined the cgroup"
+        if cgroup.version == 1:
+            limit = open(os.path.join(
+                cgroup.paths[0], "memory.limit_in_bytes")).read().strip()
+            shares = open(os.path.join(
+                cgroup.paths[1], "cpu.shares")).read().strip()
+            assert int(limit) == 64 * 1024 * 1024
+            assert int(shares) == 250
+        else:
+            limit = open(os.path.join(
+                cgroup.paths[0], "memory.max")).read().strip()
+            assert int(limit) == 64 * 1024 * 1024
+    finally:
+        drv.stop_task(handle, kill_timeout=2.0)
+        drv.wait_task(handle, timeout=5.0)
+    # cgroup destroyed after exit
+    for p in (cgroup.paths if cgroup else []):
+        assert not os.path.isdir(p)
+
+
+@needs_isolation
+def test_exec_stop_kills_namespace(tmp_path):
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c", "sleep 300"])
+    handle = drv.start_task("iso-task-0005", task, {}, td)
+    assert drv.inspect_task(handle) == "running"
+    t0 = time.time()
+    drv.stop_task(handle, kill_timeout=3.0)
+    result = drv.wait_task(handle, timeout=5.0)
+    assert result is not None
+    assert time.time() - t0 < 10
+    assert drv.inspect_task(handle) == "dead"
+
+
+def _build_tiny_rootfs(path):
+    """A from-scratch rootfs: sh + coreutils bits + libc."""
+    binaries = ["/bin/sh", "/usr/bin/echo", "/usr/bin/cat", "/usr/bin/ls"]
+    libs = ["/lib/x86_64-linux-gnu/libc.so.6",
+            "/lib64/ld-linux-x86-64.so.2",
+            "/lib/x86_64-linux-gnu/libselinux.so.1",
+            "/lib/x86_64-linux-gnu/libpcre2-8.so.0"]
+    os.makedirs(os.path.join(path, "bin"), exist_ok=True)
+    os.makedirs(os.path.join(path, "lib", "x86_64-linux-gnu"), exist_ok=True)
+    os.makedirs(os.path.join(path, "lib64"), exist_ok=True)
+    for b in binaries:
+        if os.path.exists(b):
+            shutil.copy2(b, os.path.join(path, "bin",
+                                         os.path.basename(b)))
+    for lib in libs:
+        if os.path.exists(lib):
+            dst = path + lib
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy2(lib, dst)
+    return path
+
+
+@needs_isolation
+def test_container_driver_runs_image_rootfs(tmp_path):
+    image = _build_tiny_rootfs(str(tmp_path / "image"))
+    td = make_task_dir(tmp_path, "c1")
+    drv = ContainerDriver()
+    assert drv.fingerprint()["detected"]
+    task = Task(
+        name="c1", driver="container",
+        config={"image": image, "command": "/bin/sh",
+                "args": ["-c",
+                         "echo from-container > /local/proof.txt; "
+                         "ls /bin; ls /usr 2>/dev/null || echo NO_USR"]},
+        resources=Resources(cpu=100, memory_mb=32))
+    handle = drv.start_task("ct-task-0001", task, {}, td)
+    result = drv.wait_task(handle, timeout=20.0)
+    assert result is not None and result.exit_code == 0, result
+    out = open(td.stdout_path()).read()
+    # container sees ONLY its image (no /usr bind from the host)
+    assert "NO_USR" in out, out
+    assert open(os.path.join(td.local_dir, "proof.txt")).read().strip() \
+        == "from-container"
+    # container writes stayed in the materialized copy, not the image
+    assert not os.path.exists(os.path.join(image, "local"))
+
+
+@needs_isolation
+def test_container_requires_image(tmp_path):
+    td = make_task_dir(tmp_path, "c2")
+    drv = ContainerDriver()
+    task = Task(name="c2", driver="container",
+                config={"command": "/bin/sh"},
+                resources=Resources(cpu=100, memory_mb=32))
+    with pytest.raises(DriverError):
+        drv.start_task("ct-task-0002", task, {}, td)
+
+
+def test_cgroup_manager_v2_layout(tmp_path):
+    """Drive the v2 code path against a fake root (this host is v1)."""
+    root = tmp_path / "cg2"
+    root.mkdir()
+    (root / "cgroup.controllers").write_text("cpu memory pids\n")
+    mgr = CgroupManager(str(root))
+    assert mgr.version == 2
+    cg = mgr.create("task-x", cpu_shares=500, memory_mb=128)
+    assert cg is not None and cg.version == 2
+    path = cg.paths[0]
+    assert open(os.path.join(path, "memory.max")).read() \
+        == str(128 * 1024 * 1024)
+    assert open(os.path.join(path, "cpu.weight")).read() \
+        == str(shares_to_weight(500))
+    # destroy() uses rmdir, which only works on real cgroupfs dirs (their
+    # virtual files don't block removal); on the fake root it is a no-op
+    cg.destroy()
+
+
+def test_shares_to_weight_bounds():
+    assert shares_to_weight(2) == 1
+    assert shares_to_weight(262144) == 10000
+    assert 1 <= shares_to_weight(1024) <= 10000
+
+
+@needs_isolation
+def test_exec_job_end_to_end_through_server(tmp_path):
+    """Full pipeline: job with driver=exec -> scheduler -> client ->
+    isolated chroot payload; output lands in the task sandbox."""
+    import time as _time
+
+    from nomad_tpu.client import Client, LocalServerConn
+    from nomad_tpu.server import Server
+
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path),
+                    name="iso-client-1")
+    client.start()
+    try:
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                server.state.node_by_id(client.node.id) is None:
+            _time.sleep(0.05)
+        job = mock.job(id="isolated-exec-job")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "exec"
+        tg.tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "ls /root/repo >/dev/null 2>&1 && v=VISIBLE || "
+                     "v=ISOLATED; echo $v > /local/verdict"]}
+        server.register_job(job)
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            allocs = server.state.allocs_by_job("default",
+                                                "isolated-exec-job")
+            if any(a.client_status == "complete" for a in allocs):
+                break
+            _time.sleep(0.1)
+        allocs = server.state.allocs_by_job("default", "isolated-exec-job")
+        assert any(a.client_status == "complete" for a in allocs), \
+            [(a.client_status,) for a in allocs]
+        alloc = allocs[0]
+        verdict = (tmp_path / alloc.id / tg.tasks[0].name / "local"
+                   / "verdict")
+        assert verdict.read_text().strip() == "ISOLATED"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+@needs_isolation
+@needs_cgroups
+def test_exec_graceful_stop_reaches_payload(tmp_path):
+    """kill_timeout grace: SIGTERM must reach the payload (whose trap
+    runs), not just SIGKILL the supervisor."""
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task(
+        "/bin/sh",
+        ["-c", "trap 'echo GRACEFUL > /local/trap.txt; exit 0' TERM; "
+               "while :; do sleep 0.1; done"])
+    handle = drv.start_task("iso-task-0006", task, {}, td)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        cg = drv.task_cgroup("iso-task-0006")
+        if cg is not None and cg.procs():
+            break
+        time.sleep(0.1)
+    drv.stop_task(handle, kill_timeout=5.0)
+    drv.wait_task(handle, timeout=5.0)
+    assert open(os.path.join(td.local_dir, "trap.txt")).read().strip() \
+        == "GRACEFUL"
+
+
+@needs_isolation
+def test_exec_alloc_dir_shared_between_tasks(tmp_path):
+    """/alloc is bound into the chroot and NOMAD_ALLOC_DIR points at it."""
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh",
+                     ["-c", "echo shared > $NOMAD_ALLOC_DIR/handoff"])
+    handle = drv.start_task("iso-task-0007", task,
+                            {"NOMAD_ALLOC_DIR": "/wrong-host-path"}, td)
+    result = drv.wait_task(handle, timeout=15.0)
+    assert result is not None and result.exit_code == 0, result
+    assert open(os.path.join(td.alloc.shared_dir,
+                             "handoff")).read().strip() == "shared"
