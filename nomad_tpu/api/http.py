@@ -255,11 +255,55 @@ class ApiHandler(BaseHTTPRequestHandler):
         return self.nomad.state.latest_index()
 
     # ------------------------------------------------------------------
+    # -- web UI (reference: /root/reference/ui/ Ember app served by the
+    #    agent; here a no-build vanilla-JS SPA in nomad_tpu/ui/) ----------
+    _UI_TYPES = {".html": "text/html; charset=utf-8",
+                 ".js": "application/javascript; charset=utf-8",
+                 ".css": "text/css; charset=utf-8",
+                 ".svg": "image/svg+xml"}
+
+    def _serve_ui(self, parts) -> None:
+        import os
+        ui_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ui")
+        name = parts[1] if len(parts) > 1 else "index.html"
+        # flat directory, no traversal
+        name = os.path.basename(name)
+        path = os.path.join(ui_dir, name)
+        if not os.path.isfile(path):
+            # all client routing lives under '#', so only the bare /ui
+            # (or /) ever legitimately wants index.html -- a missing
+            # asset must 404, not masquerade as HTML
+            if len(parts) > 1 and name != "index.html":
+                self._error(404, f"no such ui asset: {name}")
+                return
+            path = os.path.join(ui_dir, "index.html")
+            name = "index.html"
+        ext = os.path.splitext(name)[1]
+        try:
+            with open(path, "rb") as f:
+                body = f.read()
+        except OSError:
+            self._error(404, "ui not bundled")
+            return
+        try:
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                self._UI_TYPES.get(ext, "application/octet-stream"))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                # browser aborted mid-transfer; routine
+
     def do_GET(self):  # noqa: N802
         if self._maybe_forward():
             return
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
+        if not parts or parts[0] == "ui":
+            return self._serve_ui(parts)
         state = self.nomad.state
         try:
             # the node alloc watch blocks on the allocs table only, so

@@ -1,0 +1,345 @@
+/* nomad-tpu UI: hash-routed SPA over the /v1/* API (reference surface:
+ * /root/reference/ui/app -- jobs/nodes/allocs/evals/deployments +
+ * event stream + metrics, scoped sanely). */
+"use strict";
+
+const $main = document.getElementById("main");
+let refreshTimer = null;
+let eventAbort = null;
+
+function api(path) {
+  return fetch(path).then((r) => {
+    if (!r.ok) throw new Error(path + " -> " + r.status);
+    return r.json();
+  });
+}
+
+function h(html) { return html; }
+
+function esc(s) {
+  return String(s ?? "").replace(/[&<>"]/g, (c) =>
+    ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+}
+
+function badge(status) {
+  return `<span class="badge ${esc(status)}">${esc(status || "?")}</span>`;
+}
+
+function shortId(id) {
+  return `<span class="mono" title="${esc(id)}">${esc(String(id).slice(0, 8))}</span>`;
+}
+
+function when(ts) {
+  if (!ts) return "";
+  const d = new Date(ts * 1000);
+  return d.toLocaleTimeString();
+}
+
+function table(headers, rows) {
+  const ths = headers.map((x) => `<th>${x}</th>`).join("");
+  const trs = rows.map((r) =>
+    `<tr>${r.map((c) => `<td>${c}</td>`).join("")}</tr>`).join("");
+  return `<table><thead><tr>${ths}</tr></thead><tbody>${trs}</tbody></table>`;
+}
+
+// ids land in hrefs: URI-encode for the hash route, esc for the HTML
+function idLink(kind, id, label) {
+  return `<a href="#/${kind}/${encodeURIComponent(id)}">${label}</a>`;
+}
+
+function bar(used, total, hotAt = 0.85) {
+  const pct = total > 0 ? Math.min(100, (100 * used) / total) : 0;
+  const cls = pct / 100 >= hotAt ? "hot" : "";
+  return `<div class="bar" title="${used}/${total}"><i class="${cls}" style="width:${pct}%"></i></div>`;
+}
+
+function setNav(route) {
+  document.querySelectorAll("#nav a").forEach((a) => {
+    a.classList.toggle("active", a.getAttribute("href") === "#/" + route);
+  });
+}
+
+async function clusterStat() {
+  try {
+    const [nodes, jobs] = await Promise.all([api("/v1/nodes"), api("/v1/jobs")]);
+    document.getElementById("cluster-stat").textContent =
+      `${nodes.length} nodes · ${jobs.length} jobs`;
+  } catch (e) { /* agent restarting */ }
+}
+
+/* ----- views ----- */
+
+async function viewJobs() {
+  const jobs = await api("/v1/jobs");
+  const rows = jobs.map((j) => [
+    idLink("job", j.id, esc(j.id)),
+    esc(j.type), badge(j.status), esc(j.priority), esc(j.version ?? ""),
+  ]);
+  return h(`<h1>Jobs</h1>` +
+    table(["ID", "Type", "Status", "Priority", "Version"], rows));
+}
+
+async function viewJob(id) {
+  const [job, allocs, evals] = await Promise.all([
+    api(`/v1/job/${id}`),
+    api(`/v1/job/${id}/allocations`).catch(() => []),
+    api(`/v1/job/${id}/evaluations`).catch(() => []),
+  ]);
+  const tgRows = (job.task_groups || []).map((tg) => [
+    esc(tg.name), esc(tg.count),
+    (tg.tasks || []).map((t) => `${esc(t.name)} <span class="muted">(${esc(t.driver)})</span>`).join(", "),
+    esc(tg.tasks?.[0]?.resources?.cpu ?? ""), esc(tg.tasks?.[0]?.resources?.memory_mb ?? ""),
+  ]);
+  const alRows = allocs.map((a) => [
+    `${idLink("allocation", a.id, `${shortId(a.id)}`)}`,
+    esc(a.task_group), badge(a.client_status), badge(a.desired_status),
+    `${idLink("node", a.node_id, `${shortId(a.node_id)}`)}`,
+    when(a.modify_time || a.create_time),
+  ]);
+  const evRows = evals.map((e) => [
+    shortId(e.id), badge(e.status), esc(e.triggered_by), esc(e.type),
+  ]);
+  return h(`<h1>${esc(job.id)} ${badge(job.status)}</h1>
+    <p class="muted">${esc(job.type)} · priority ${esc(job.priority)} · v${esc(job.version)}</p>
+    <h2>Task groups</h2>` +
+    table(["Name", "Count", "Tasks", "CPU", "Mem MB"], tgRows) +
+    `<h2>Allocations (${allocs.length})</h2>` +
+    table(["ID", "Group", "Client", "Desired", "Node", "Updated"], alRows) +
+    `<h2>Evaluations</h2>` + table(["ID", "Status", "Triggered", "Type"], evRows));
+}
+
+async function viewNodes() {
+  const nodes = await api("/v1/nodes");
+  const rows = nodes.map((n) => [
+    `${idLink("node", n.id, `${shortId(n.id)}`)}`,
+    esc(n.name), esc(n.datacenter), esc(n.node_pool || "default"),
+    badge(n.status), esc(n.node_class || "—"),
+  ]);
+  return h(`<h1>Nodes</h1>` +
+    table(["ID", "Name", "DC", "Pool", "Status", "Class"], rows));
+}
+
+async function viewNode(id) {
+  const node = await api(`/v1/node/${id}`);
+  // the endpoint wraps the list: {"allocs": [...], "index": N}
+  const allocsResp = await api(`/v1/node/${id}/allocations`)
+    .catch(() => ({allocs: []}));
+  const allocs = Array.isArray(allocsResp)
+    ? allocsResp : (allocsResp.allocs || []);
+  const res = node.node_resources || {};
+  const cpuTotal = res.cpu?.cpu_shares || 0;
+  const memTotal = res.memory?.memory_mb || 0;
+  let cpuUsed = 0, memUsed = 0;
+  const live = allocs.filter((a) => a.desired_status === "run" &&
+    !["complete", "failed", "lost"].includes(a.client_status));
+  live.forEach((a) => {
+    Object.values(a.allocated_resources?.tasks || {}).forEach((t) => {
+      cpuUsed += t.cpu_shares || 0; memUsed += t.memory_mb || 0;
+    });
+  });
+  const alRows = allocs.map((a) => [
+    `${idLink("allocation", a.id, `${shortId(a.id)}`)}`,
+    esc(a.job_id), esc(a.task_group), badge(a.client_status),
+    badge(a.desired_status),
+  ]);
+  const attrs = Object.entries(node.attributes || {}).map(
+    ([k, v]) => [esc(k), `<span class="mono">${esc(v)}</span>`]);
+  return h(`<h1>${esc(node.name)} ${badge(node.status)}</h1>
+    <p class="muted mono">${esc(node.id)}</p>
+    <div class="cards">
+      <div class="card"><div class="num">${cpuUsed}/${cpuTotal}</div>
+        <div class="lbl">cpu MHz</div>${bar(cpuUsed, cpuTotal)}</div>
+      <div class="card"><div class="num">${memUsed}/${memTotal}</div>
+        <div class="lbl">memory MB</div>${bar(memUsed, memTotal)}</div>
+      <div class="card"><div class="num">${live.length}</div>
+        <div class="lbl">live allocs</div></div>
+    </div>
+    <h2>Allocations</h2>` +
+    table(["ID", "Job", "Group", "Client", "Desired"], alRows) +
+    `<h2>Attributes</h2><table class="kv">` +
+    attrs.map(([k, v]) => `<tr><td>${k}</td><td>${v}</td></tr>`).join("") +
+    `</table>`);
+}
+
+async function viewAllocs() {
+  const allocs = await api("/v1/allocations");
+  const rows = allocs.map((a) => [
+    `${idLink("allocation", a.id, `${shortId(a.id)}`)}`,
+    esc(a.job_id), esc(a.task_group), badge(a.client_status),
+    badge(a.desired_status),
+    `${idLink("node", a.node_id, `${shortId(a.node_id)}`)}`,
+  ]);
+  return h(`<h1>Allocations</h1>` +
+    table(["ID", "Job", "Group", "Client", "Desired", "Node"], rows));
+}
+
+async function viewAlloc(id) {
+  const a = await api(`/v1/allocation/${id}`);
+  const tasks = Object.entries(a.task_states || {}).map(([name, st]) => [
+    esc(name), badge(st.state), esc(st.failed ? "yes" : "no"),
+    (st.events || []).slice(-3).map((e) => esc(e.type)).join(" → "),
+  ]);
+  const metrics = a.metrics || {};
+  const scores = Object.entries(metrics.scores || {}).slice(0, 12).map(
+    ([k, v]) => [`<span class="mono">${esc(k)}</span>`,
+                 esc(typeof v === "number" ? v.toFixed(4) : v)]);
+  return h(`<h1>${esc(a.name || a.id)} ${badge(a.client_status)}</h1>
+    <table class="kv">
+      <tr><td>ID</td><td class="mono">${esc(a.id)}</td></tr>
+      <tr><td>Job</td><td>${idLink("job", a.job_id, `${esc(a.job_id)}`)}</td></tr>
+      <tr><td>Node</td><td>${idLink("node", a.node_id, `${esc(a.node_id)}`)}</td></tr>
+      <tr><td>Desired</td><td>${badge(a.desired_status)}</td></tr>
+      <tr><td>Eval</td><td class="mono">${esc(a.eval_id || "")}</td></tr>
+    </table>
+    <h2>Tasks</h2>` + table(["Task", "State", "Failed", "Recent events"], tasks) +
+    (scores.length ? `<h2>Placement scores</h2>` + table(["Node/score", "Value"], scores) : ""));
+}
+
+async function viewEvals() {
+  const evals = await api("/v1/evaluations");
+  const rows = evals.map((e) => [
+    shortId(e.id), esc(e.job_id), badge(e.status), esc(e.type),
+    esc(e.triggered_by), esc(e.priority),
+  ]);
+  return h(`<h1>Evaluations</h1>` +
+    table(["ID", "Job", "Status", "Type", "Triggered by", "Priority"], rows));
+}
+
+async function viewDeployments() {
+  const deps = await api("/v1/deployments");
+  const rows = deps.map((d) => [
+    shortId(d.id), esc(d.job_id), badge(d.status),
+    esc(d.status_description || ""),
+  ]);
+  return h(`<h1>Deployments</h1>` +
+    table(["ID", "Job", "Status", "Description"], rows));
+}
+
+async function viewMetrics() {
+  const m = await api("/v1/metrics");
+  const counters = m.counters || {};
+  const samples = m.samples || {};
+  const tpu = counters["nomad.scheduler.placements_tpu"] || 0;
+  const host = counters["nomad.scheduler.placements_host_fallback"] || 0;
+  // the server computes the authoritative ratio (tpu_placement_ratio)
+  const ratio = m.tpu_placement_ratio != null
+    ? (100 * m.tpu_placement_ratio).toFixed(1) : "—";
+  const sampleRows = Object.entries(samples).map(([k, v]) => [
+    `<span class="mono">${esc(k)}</span>`, esc(v.count),
+    esc((v.mean_ms ?? 0).toFixed?.(2) ?? v.mean_ms),
+    esc((v.p50_ms ?? v.last_ms ?? 0).toFixed?.(2) ?? ""),
+    esc((v.max_ms ?? 0).toFixed?.(2) ?? ""),
+  ]);
+  const counterRows = Object.entries(counters).map(([k, v]) => [
+    `<span class="mono">${esc(k)}</span>`, esc(v)]);
+  return h(`<h1>Scheduler metrics</h1>
+    <div class="cards">
+      <div class="card"><div class="num">${ratio}%</div>
+        <div class="lbl">TPU placement ratio</div></div>
+      <div class="card"><div class="num">${tpu}</div>
+        <div class="lbl">dense placements</div></div>
+      <div class="card"><div class="num">${host}</div>
+        <div class="lbl">host fallbacks</div></div>
+    </div>
+    <h2>Series</h2>` +
+    table(["Series", "Count", "Mean ms", "P50 ms", "Max ms"], sampleRows) +
+    `<h2>Counters</h2>` + table(["Counter", "Value"], counterRows));
+}
+
+function viewEvents() {
+  // live stream: render shell now, then attach the NDJSON reader
+  setTimeout(attachEventStream, 0);
+  return h(`<h1>Event stream <span class="muted" id="evt-state">connecting…</span></h1>
+    <div class="controls"><input type="text" id="evt-filter"
+      placeholder="filter (topic or payload substring)"></div>
+    <div id="evt-list"></div>`);
+}
+
+async function attachEventStream() {
+  if (eventAbort) eventAbort.abort();
+  eventAbort = new AbortController();
+  const list = document.getElementById("evt-list");
+  const state = document.getElementById("evt-state");
+  if (!list) return;
+  try {
+    const resp = await fetch("/v1/event/stream", {signal: eventAbort.signal});
+    state.textContent = "live";
+    const reader = resp.body.getReader();
+    const dec = new TextDecoder();
+    let buf = "";
+    for (;;) {
+      const {value, done} = await reader.read();
+      if (done) break;
+      buf += dec.decode(value, {stream: true});
+      const lines = buf.split("\n");
+      buf = lines.pop();
+      for (const line of lines) {
+        if (!line.trim()) continue;
+        let evt;
+        try { evt = JSON.parse(line); } catch { continue; }
+        const f = (document.getElementById("evt-filter")?.value || "").toLowerCase();
+        const text = JSON.stringify(evt).toLowerCase();
+        if (f && !text.includes(f)) continue;
+        const div = document.createElement("div");
+        div.className = "evt";
+        div.innerHTML = `<div class="t">${esc(evt.topic || evt.Topic || "event")}
+          · index ${esc(evt.index ?? "")}</div>
+          <span class="mono">${esc(JSON.stringify(evt.payload ?? evt))}</span>`;
+        list.prepend(div);
+        while (list.children.length > 200) list.removeChild(list.lastChild);
+      }
+    }
+  } catch (e) {
+    if (state) state.textContent = "disconnected";
+  }
+}
+
+/* ----- router ----- */
+
+const routes = [
+  [/^#\/jobs$/, () => viewJobs(), "jobs"],
+  [/^#\/job\/(.+)$/, (m) => viewJob(m[1]), "jobs"],
+  [/^#\/nodes$/, () => viewNodes(), "nodes"],
+  [/^#\/node\/(.+)$/, (m) => viewNode(m[1]), "nodes"],
+  [/^#\/allocations$/, () => viewAllocs(), "allocations"],
+  [/^#\/allocation\/(.+)$/, (m) => viewAlloc(m[1]), "allocations"],
+  [/^#\/evaluations$/, () => viewEvals(), "evaluations"],
+  [/^#\/deployments$/, () => viewDeployments(), "deployments"],
+  [/^#\/metrics$/, () => viewMetrics(), "metrics"],
+  [/^#\/events$/, () => viewEvents(), "events"],
+];
+
+let renderEpoch = 0;
+
+async function render() {
+  const hash = location.hash || "#/jobs";
+  const epoch = ++renderEpoch;   // stale fetches must not clobber the view
+  if (eventAbort && !hash.startsWith("#/events")) {
+    eventAbort.abort();
+    eventAbort = null;
+  }
+  for (const [re, fn, nav] of routes) {
+    const m = hash.match(re);
+    if (!m) continue;
+    setNav(nav);
+    try {
+      const out = await fn(m);
+      if (epoch !== renderEpoch) return;
+      if (out !== undefined) $main.innerHTML = out;
+    } catch (e) {
+      if (epoch !== renderEpoch) return;
+      $main.innerHTML = `<p class="badge error">error</p>
+        <pre class="log">${esc(e.message || e)}</pre>`;
+    }
+    clusterStat();
+    return;
+  }
+  location.hash = "#/jobs";
+}
+
+window.addEventListener("hashchange", render);
+render();
+// light auto-refresh for list views (the event stream page is live)
+refreshTimer = setInterval(() => {
+  if (!location.hash.startsWith("#/events")) render();
+}, 5000);
