@@ -62,15 +62,18 @@ class CpuFingerprinter(Fingerprinter):
     name = "cpu"
 
     def fingerprint(self, node: Node) -> Dict[str, str]:
-        cores = os.cpu_count() or 1
+        from . import numalib
+        topo = numalib.scan()
+        cores = topo.core_count or os.cpu_count() or 1
         mhz = self._base_mhz()
         total = int(cores * mhz)
         node.node_resources.cpu = NodeCpuResources(
             cpu_shares=total, total_core_count=cores,
-            reservable_cores=list(range(cores)))
+            reservable_cores=topo.all_cores() or list(range(cores)))
         return {"cpu.numcores": str(cores),
                 "cpu.frequency": str(int(mhz)),
-                "cpu.totalcompute": str(total)}
+                "cpu.totalcompute": str(total),
+                "numa.node_count": str(topo.node_count)}
 
     @staticmethod
     def _base_mhz() -> float:

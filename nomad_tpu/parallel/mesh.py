@@ -95,7 +95,8 @@ def shard_solver_inputs(mesh, const, init, batch):
             dp_vidx=P("evals", None, "nodes"), dp_limit=P("evals"),
             dp_tg_scope=P("evals"),
             dev_aff=P("evals", None, None, "nodes"),
-            dev_count=P("evals"), dev_sum_weight=P("evals"))
+            dev_count=P("evals"), dev_sum_weight=P("evals"),
+            mhz_per_core=P("evals", "nodes"))
         return jax.tree.map(
             lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
             c, specs)
@@ -108,7 +109,8 @@ def shard_solver_inputs(mesh, const, init, batch):
             static_free=P("evals", "nodes"), dyn_avail=P("evals", "nodes"),
             spread_counts=P("evals"),
             dp_counts=P("evals"),
-            dev_free=P("evals", None, None, "nodes"))
+            dev_free=P("evals", None, None, "nodes"),
+            cores_free=P("evals", "nodes"))
         return jax.tree.map(
             lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
             s, specs)

@@ -142,6 +142,21 @@ class _DeviceCheckerShim:
 from .feasible import DeviceChecker  # noqa: E402  (cycle-free tail import)
 
 
+def select_reserved_cores(node: Node, consumed, count: int):
+    """Deterministic lowest-id selection of free reservable cores
+    (reference: rank.go:481-524, simplified from NUMA-preferring to
+    lowest-id). Excludes agent-reserved cores (the same availability rule
+    allocs_fit enforces, structs/funcs.py) and anything in ``consumed``.
+    Returns the core ids, or None when fewer than ``count`` are free.
+    BOTH the host BinPackIterator and the dense path's materialize replay
+    use this helper -- core-id parity depends on there being one copy."""
+    usable = (set(node.node_resources.cpu.reservable_cores)
+              - set(node.reserved_resources.cores) - set(consumed))
+    if len(usable) < count:
+        return None
+    return sorted(usable)[:count]
+
+
 class BinPackIterator(RankIterator):
     """The hot inner loop (reference: rank.go:156-598)."""
 
@@ -321,21 +336,19 @@ class BinPackIterator(RankIterator):
                 # Reserved cores (reference: rank.go:481-524; NUMA-aware
                 # selection simplified to lowest-id free cores)
                 if task.resources.cores > 0:
-                    node_cores = set(
-                        option.node.node_resources.cpu.reservable_cores)
                     consumed = set()
                     for alloc in proposed:
                         consumed.update(
                             alloc.allocated_resources.comparable().reserved_cores)
                     for tr in total.tasks.values():
                         consumed.update(tr.reserved_cores)
-                    available = sorted(node_cores - consumed)
-                    if len(available) < task.resources.cores:
+                    cores = select_reserved_cores(
+                        option.node, consumed, task.resources.cores)
+                    if cores is None:
                         self.ctx.metrics.exhausted_node(
                             option.node.id, option.node.computed_class, "cores")
                         exhausted = True
                         break
-                    cores = available[:task.resources.cores]
                     task_res.reserved_cores = cores
                     total_cores = option.node.node_resources.cpu.total_core_count
                     if total_cores:

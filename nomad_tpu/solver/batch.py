@@ -68,7 +68,10 @@ def _pad_placement_axis(batch, p_pad: int):
         has_static=grow(batch.has_static, False),
         limit=grow(batch.limit), count=grow(batch.count, 1),
         penalty_idx=grow(batch.penalty_idx, -1),
-        active=grow(batch.active, False))
+        active=grow(batch.active, False),
+        # 0-size means "no core asks" (a static-shape branch): keep empty
+        ask_cores=(batch.ask_cores if batch.ask_cores.shape[0] == 0
+                   else grow(batch.ask_cores)))
 
 
 def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True

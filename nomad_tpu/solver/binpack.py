@@ -32,6 +32,7 @@ BINPACK_MAX = 18.0
 _EMPTY_I2 = np.zeros((0, 0), dtype=np.int32)
 _EMPTY_I1 = np.zeros(0, dtype=np.int32)
 _EMPTY_B1 = np.zeros(0, dtype=bool)
+_EMPTY_F1 = np.zeros(0, dtype=np.float32)
 _EMPTY_F3 = np.zeros((0, 0, 0), dtype=np.float32)
 _EMPTY_I3 = np.zeros((0, 0, 0), dtype=np.int32)
 
@@ -48,6 +49,9 @@ class PlacementBatch(NamedTuple):
     count: jnp.ndarray          # int32 TG desired count (anti-affinity denom)
     penalty_idx: jnp.ndarray    # int32 node index to penalize, -1 = none
     active: jnp.ndarray         # bool: real placement vs padding
+    # reserved-core ask (rank.go:481-524): effective cpu becomes
+    # ask_cpu + ask_cores * mhz_per_core[node]; zeros when no core asks
+    ask_cores: jnp.ndarray = _EMPTY_I1
 
 
 class NodeState(NamedTuple):
@@ -64,6 +68,8 @@ class NodeState(NamedTuple):
     dp_counts: jnp.ndarray = _EMPTY_I2     # (Dp, Vd) int32 allocs per value
     dev_free: jnp.ndarray = _EMPTY_I3      # (R, Gd, N) int32 free
                                            # instances; -1 = no match
+    cores_free: jnp.ndarray = _EMPTY_I1    # (N,) int32 free reservable
+                                           # cores; 0-size when no core ask
 
 
 class NodeConst(NamedTuple):
@@ -98,6 +104,9 @@ class NodeConst(NamedTuple):
     dev_aff: jnp.ndarray = _EMPTY_F3       # (R, Gd, N) affinity score
     dev_count: jnp.ndarray = _EMPTY_I1     # (R,) int32 asked count
     dev_sum_weight: jnp.ndarray = np.float32(0.0)  # scalar sum |weights|
+    # cores (rank.go:340-344): per-node MHz per reservable core; 0-size
+    # when the lane carries no core asks (statically skipped at trace time)
+    mhz_per_core: jnp.ndarray = _EMPTY_F1  # (N,) float
 
 
 def _binpack_score(free_cpu, free_mem, spread_alg: bool):
@@ -369,13 +378,19 @@ def _scoring_parts(state: NodeState, const: NodeConst, b, dtype,
     """Shared per-node fit + scoring over positions [lo:hi): returns
     (fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem)."""
     (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
-     penalty_idx, active) = b
+     penalty_idx, active, ask_cores) = b
     sl = slice(lo, hi)
     cpu_cap = const.cpu_cap[sl]
     mem_cap = const.mem_cap[sl]
     n = cpu_cap.shape[0]
 
-    new_cpu = state.used_cpu[sl] + ask_cpu
+    # reserved cores (rank.go:481-524): core-asking tasks' cpu becomes
+    # mhz_per_core * cores on the candidate node, so the effective cpu
+    # ask is node-dependent; count-exact core availability gates fit
+    has_cores = const.mhz_per_core.shape[0] > 0
+    eff_cpu = (ask_cpu + ask_cores.astype(dtype) * const.mhz_per_core[sl]
+               if has_cores else ask_cpu)
+    new_cpu = state.used_cpu[sl] + eff_cpu
     new_mem = state.used_mem[sl] + ask_mem
     new_disk = state.used_disk[sl] + ask_disk
 
@@ -417,6 +432,8 @@ def _scoring_parts(state: NodeState, const: NodeConst, b, dtype,
         dev_score = jnp.where(
             dev_present,
             sum_aff / jnp.maximum(const.dev_sum_weight, 1e-9), 0.0)
+    if has_cores:
+        feas_nonres &= state.cores_free[sl] >= ask_cores
     fit = (feas_nonres
            & (new_cpu <= cpu_cap)
            & (new_mem <= mem_cap)
@@ -487,7 +504,7 @@ def _score_and_select_preempt(state: NodeState, pstate: PreemptState,
     netPriority), exactly like the host chain. Returns the plain window
     outputs plus the chosen node's eviction row and freed resources."""
     (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
-     penalty_idx, active) = b
+     penalty_idx, active, ask_cores) = b
     sl = slice(lo, hi)
     (fit, final, feas_nonres, other_sum, nscores, new_cpu, new_mem,
      new_disk) = _scoring_parts(state, const, b, dtype, spread_alg, lo, hi)
@@ -580,10 +597,11 @@ def _solve_placements_impl(const: NodeConst, init: NodeState,
     dtype = jnp.dtype(dtype_name)
     n_total = const.cpu_cap.shape[0]
     use_fast = n_total > 2 * FAST_T
+    has_cores = const.mhz_per_core.shape[0] > 0
 
     def step(state: NodeState, b):
         (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
-         penalty_idx, active) = b
+         penalty_idx, active, ask_cores) = b
 
         if use_fast:
             # fast path: the window resolved within the first FAST_T
@@ -611,8 +629,10 @@ def _solve_placements_impl(const: NodeConst, init: NodeState,
         # O(1) scatter updates: only the winner's usage changes
         add_f = do.astype(dtype)
         add_i = do.astype(jnp.int32)
+        eff_cpu = (ask_cpu + ask_cores.astype(dtype)
+                   * const.mhz_per_core[safe] if has_cores else ask_cpu)
         new_state = state._replace(
-            used_cpu=state.used_cpu.at[safe].add(add_f * ask_cpu),
+            used_cpu=state.used_cpu.at[safe].add(add_f * eff_cpu),
             used_mem=state.used_mem.at[safe].add(add_f * ask_mem),
             used_disk=state.used_disk.at[safe].add(add_f * ask_disk),
             placed=state.placed.at[safe].add(add_i),
@@ -621,15 +641,21 @@ def _solve_placements_impl(const: NodeConst, init: NodeState,
                 state.static_free[safe] & ~(do & has_static)),
             dyn_avail=state.dyn_avail.at[safe].add(-add_i * n_dyn),
         )
+        if has_cores:
+            new_state = new_state._replace(
+                cores_free=state.cores_free.at[safe].add(
+                    -add_i * ask_cores))
         new_state = _commit_tables(state, new_state, const, do, safe)
         chosen_out = jnp.where(do, chosen, -1)
         return new_state, (chosen_out, cscore, n_yield)
 
+    ask_cores_xs = (batch.ask_cores if batch.ask_cores.shape[0]
+                    else jnp.zeros_like(batch.count))
     final_state, (chosen, scores, n_yielded) = jax.lax.scan(
         step, init,
         (batch.ask_cpu, batch.ask_mem, batch.ask_disk, batch.n_dyn_ports,
          batch.has_static, batch.limit, batch.count, batch.penalty_idx,
-         batch.active))
+         batch.active, ask_cores_xs))
     return chosen, scores, n_yielded, final_state
 
 
@@ -661,7 +687,7 @@ def _solve_placements_preempt_impl(const: NodeConst, init: NodeState,
     def step(carry, b):
         state, pstate = carry
         (ask_cpu, ask_mem, ask_disk, n_dyn, has_static, limit, count,
-         penalty_idx, active) = b
+         penalty_idx, active, ask_cores) = b
 
         if use_fast:
             f = _score_and_select_preempt(
@@ -725,12 +751,14 @@ def _solve_placements_preempt_impl(const: NodeConst, init: NodeState,
         return (new_state, new_pstate), (chosen_out, cscore, n_yield,
                                          evict_row)
 
+    ask_cores_xs = (batch.ask_cores if batch.ask_cores.shape[0]
+                    else jnp.zeros_like(batch.count))
     (final_state, final_pstate), (chosen, scores, n_yielded, evict_rows) = \
         jax.lax.scan(
             step, (init, pinit),
             (batch.ask_cpu, batch.ask_mem, batch.ask_disk,
              batch.n_dyn_ports, batch.has_static, batch.limit, batch.count,
-             batch.penalty_idx, batch.active))
+             batch.penalty_idx, batch.active, ask_cores_xs))
     return chosen, scores, n_yielded, evict_rows, final_state
 
 

@@ -76,6 +76,7 @@ class PackedLane:
         static table shape except the placement axis (which pads), plus
         the static jit args."""
         return (self.const.cpu_cap.shape[0],          # n_pad
+                self.batch.ask_cores.shape[0] > 0,    # core-ask lanes
                 self.const.spread_vidx.shape[0],      # S
                 self.const.spread_desired.shape[1],   # V
                 self.const.dp_vidx.shape[0],          # Dp
@@ -90,25 +91,28 @@ class PackedLane:
 def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
     """Does the dense path model everything this TG asks for? The
     remaining carve-outs (host iterator fallback):
-      - reserved cores (no NUMA/core-id model on the dense path yet)
       - per-task networks (multi-NetworkIndex asks)
       - multiple TG networks
-      - preemption combined with ports or devices (network/device
-        preemption are subset searches, preemption.go:273,475)
+      - preemption combined with ports, devices or cores (network/device
+        preemption are subset searches, preemption.go:273,475; core
+        release needs id-level accounting)
       - 0%-spread targets (stateful lowest-boost scoring is host-only)
-    Devices and distinct_property ARE modeled densely (VERDICT r1 next #5).
+    Devices, distinct_property AND reserved cores are modeled densely
+    (cores: count-exact fit + node-dependent effective cpu, with core ids
+    replayed deterministically at materialize -- VERDICT r2 next #7).
     """
     has_devices = False
+    has_cores = False
     for task in tg.tasks:
         if task.resources.cores > 0:
-            return False
+            has_cores = True
         if task.resources.networks:
             return False
         if task.resources.devices:
             has_devices = True
     if len(tg.networks) > 1:
         return False
-    if preempt and (tg.networks or has_devices):
+    if preempt and (tg.networks or has_devices or has_cores):
         return False
     spreads = list(tg.spreads) + (list(job.spreads) if job is not None else [])
     for s in spreads:
@@ -205,11 +209,13 @@ class TpuPlacementService:
         inv = np.empty(n_pad, dtype=np.int64)
         inv[perm] = np.arange(n_pad)
 
-        # With preemption on, the candidate tables need every node's
-        # proposed allocs anyway -- do that walk ONCE and reuse it for
-        # usage packing too (instead of the alloc-table fast path).
+        # With preemption on (candidate tables) or core asks (per-node
+        # reserved-core accounting), every node's proposed allocs are
+        # needed anyway -- do that walk ONCE and reuse it for usage
+        # packing too (instead of the alloc-table fast path).
+        ask_cores_total = sum(t.resources.cores for t in tg.tasks)
         proposed_by_node = None
-        if self.preempt:
+        if self.preempt or ask_cores_total > 0:
             proposed_by_node = {
                 node.id: self.ctx.proposed_allocs(node.id) for node in nodes}
         table = getattr(self.ctx.state, "alloc_table", None)
@@ -272,6 +278,11 @@ class TpuPlacementService:
 
         P = len(places)
         ask = tg.total_resources()
+        # core-asking tasks' cpu is REPLACED by mhz_per_core * cores on
+        # the candidate node (rank.go:340-344): only non-core tasks
+        # contribute to the fixed cpu ask
+        ask_cpu_fixed = float(sum(
+            t.resources.cpu for t in tg.tasks if t.resources.cores == 0))
         penalty = np.full(P, -1, dtype=np.int32)
         if penalty_nodes_per_place:
             id_to_pos = {nid: int(inv[i])
@@ -282,7 +293,9 @@ class TpuPlacementService:
                     if pos is not None:
                         penalty[pi] = pos
         batch = PlacementBatch(
-            ask_cpu=np.full(P, float(ask.cpu), dtype=dtype),
+            ask_cpu=np.full(
+                P, ask_cpu_fixed if ask_cores_total else float(ask.cpu),
+                dtype=dtype),
             ask_mem=np.full(P, float(ask.memory_mb), dtype=dtype),
             ask_disk=np.full(P, float(ask.disk_mb), dtype=dtype),
             n_dyn_ports=np.full(P, n_dyn, dtype=np.int32),
@@ -291,7 +304,29 @@ class TpuPlacementService:
             count=np.full(P, tg.count, dtype=np.int32),
             penalty_idx=penalty,
             active=np.ones(P, dtype=bool),
+            ask_cores=(np.full(P, ask_cores_total, dtype=np.int32)
+                       if ask_cores_total
+                       else np.zeros(0, dtype=np.int32)),
         )
+        if ask_cores_total:
+            mhz = np.zeros(n_pad, dtype=dtype)
+            cores_free = np.zeros(n_pad, dtype=np.int32)
+            for pos in range(n):
+                node = nodes[order[pos]]
+                cpu_res = node.node_resources.cpu
+                total_cores = cpu_res.total_core_count
+                mhz[pos] = (cpu_res.cpu_shares // total_cores
+                            if total_cores else 0)
+                # same availability rule as allocs_fit + the selection
+                # helper: agent-reserved cores are never free
+                reservable = (set(cpu_res.reservable_cores)
+                              - set(node.reserved_resources.cores))
+                for alloc in proposed_by_node[node.id]:
+                    for tr in alloc.allocated_resources.tasks.values():
+                        reservable.difference_update(tr.reserved_cores)
+                cores_free[pos] = len(reservable)
+            const = const._replace(mhz_per_core=mhz)
+            init = init._replace(cores_free=cores_free)
         dp = self._pack_distinct_property(tg, nodes, order, n_pad)
         if dp is not None:
             const = const._replace(dp_vidx=dp[0], dp_limit=dp[1],
@@ -542,6 +577,7 @@ class TpuPlacementService:
         out: List[TpuPlacement] = []
         net_indexes: Dict[str, NetworkIndex] = {}
         dev_allocators: Dict[str, object] = {}
+        core_used: Dict[str, set] = {}
         has_devices = any(t.resources.devices for t in tg.tasks)
         for pi, place in enumerate(places):
             pos = int(chosen[pi])
@@ -563,6 +599,29 @@ class TpuPlacementService:
                 tr = AllocatedTaskResources(
                     cpu_shares=task.resources.cpu,
                     memory_mb=task.resources.memory_mb)
+                if task.resources.cores > 0:
+                    # replay the host's deterministic core selection (the
+                    # SHARED helper -- core-id parity depends on it)
+                    from ..scheduler.rank import select_reserved_cores
+                    used = core_used.get(node.id)
+                    if used is None:
+                        used = set()
+                        for al in self.ctx.proposed_allocs(node.id):
+                            used.update(al.allocated_resources
+                                        .comparable().reserved_cores)
+                        core_used[node.id] = used
+                    cores = select_reserved_cores(
+                        node, used, task.resources.cores)
+                    if cores is None:
+                        dev_failed = True   # count-exact fit should
+                        break               # prevent this; stay safe
+                    used.update(cores)
+                    tr.reserved_cores = cores
+                    cpu_res = node.node_resources.cpu
+                    if cpu_res.total_core_count:
+                        tr.cpu_shares = (
+                            cpu_res.cpu_shares
+                            // cpu_res.total_core_count) * len(cores)
                 if has_devices and task.resources.devices:
                     # replay the deterministic DeviceAllocator on the
                     # chosen node for exact instance ids (device.go)

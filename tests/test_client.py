@@ -315,3 +315,25 @@ def test_state_db_roundtrip(tmp_path):
     state, handle = tasks["web"]
     assert state.state == "running" and state.restarts == 1
     assert handle.pid == 42 and handle.driver == "mock"
+
+
+def test_numalib_topology_scan(tmp_path):
+    """numalib sysfs scan (reference: client/lib/numalib)."""
+    from nomad_tpu.client import numalib
+
+    root = tmp_path / "node"
+    (root / "node0").mkdir(parents=True)
+    (root / "node0" / "cpulist").write_text("0-3,8\n")
+    (root / "node1").mkdir()
+    (root / "node1" / "cpulist").write_text("4-7\n")
+    topo = numalib.scan(str(root))
+    assert topo.node_count == 2
+    assert topo.nodes[0] == [0, 1, 2, 3, 8]
+    assert topo.nodes[1] == [4, 5, 6, 7]
+    assert topo.core_count == 9
+    assert topo.node_of(5) == 1
+    assert topo.all_cores() == [0, 1, 2, 3, 4, 5, 6, 7, 8]
+    # absent tree -> synthetic single node
+    topo2 = numalib.scan(str(tmp_path / "missing"))
+    assert topo2.node_count == 1 and topo2.core_count >= 1
+    assert numalib.parse_cpulist("0-2,5, 7-8") == [0, 1, 2, 5, 7, 8]
