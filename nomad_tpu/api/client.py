@@ -406,6 +406,22 @@ class HttpServerConn:
         self.api.post("/v1/node/allocs-update",
                       {"allocs": [codec.encode(a) for a in updates]})
 
+    def sign_identity(self, claims: dict):
+        reply = self.api.post("/v1/node/identity-sign", {"claims": claims})
+        return reply.get("token")
+
+    def workload_variable(self, jwt: str, path: str):
+        try:
+            reply = self.api.post("/v1/workload/variable",
+                                  {"identity": jwt, "path": path})
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            if e.status == 403:
+                raise PermissionError(str(e)) from e
+            raise
+        return reply.get("items")
+
     def register_services(self, regs) -> None:
         self.api.post("/v1/node/services-register",
                       {"services": [codec.encode(r) for r in regs]})

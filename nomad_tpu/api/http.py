@@ -858,6 +858,24 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, {"node_id": node.id,
                                  "heartbeat_ttl":
                                      self.nomad.heartbeat_ttl})
+            elif parts == ["v1", "node", "identity-sign"]:
+                # client-agent path (node:write pre-gated above): mint a
+                # workload identity JWT for a task the node runs
+                token = self.nomad.sign_workload_identity(
+                    dict(self._body().get("claims", {})))
+                self._send(200, {"token": token})
+            elif parts == ["v1", "workload", "variable"]:
+                # authorization IS the workload identity JWT itself
+                body = self._body()
+                try:
+                    items = self.nomad.workload_variable(
+                        str(body.get("identity", "")),
+                        str(body.get("path", "")))
+                except PermissionError as e:
+                    return self._error(403, str(e))
+                if items is None:
+                    return self._error(404, "variable not found")
+                self._send(200, {"items": items})
             elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
                     parts[3] == "heartbeat":
                 ttl = self.nomad.heartbeat(parts[2])

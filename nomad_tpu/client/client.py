@@ -51,6 +51,16 @@ class ServerConn:
         """(reference: ServiceRegistration.Upsert RPC)"""
         raise NotImplementedError
 
+    def sign_identity(self, claims: dict) -> Optional[str]:
+        """Mint a workload identity JWT (reference: the server-side
+        signing the identity hook relies on). None = unsupported."""
+        return None
+
+    def workload_variable(self, jwt: str, path: str):
+        """Fetch a decrypted Variable with a workload identity
+        (reference analog: DeriveVaultToken -> native Variables)."""
+        raise NotImplementedError
+
 
 class LocalServerConn(ServerConn):
     """In-process server (dev agent topology)."""
@@ -79,6 +89,12 @@ class LocalServerConn(ServerConn):
     def register_services(self, regs) -> None:
         self.server.upsert_services(regs)
 
+    def sign_identity(self, claims: dict) -> Optional[str]:
+        return self.server.sign_workload_identity(claims)
+
+    def workload_variable(self, jwt: str, path: str):
+        return self.server.workload_variable(jwt, path)
+
 
 MAX_TERMINAL_RUNNERS = 50     # client GC watermark (reference: client/gc.go)
 
@@ -94,7 +110,11 @@ class Client:
         self.data_dir = data_dir
         self.drivers = drivers or DriverRegistry()
         self.state_db = StateDB(data_dir)
+        if identity_signer is None:
+            def identity_signer(claims, _c=conn):
+                return _c.sign_identity(claims)
         self.identity_signer = identity_signer
+        self.secrets_fetcher = conn.workload_variable
         fm = FingerprintManager(data_dir=data_dir, probe_jax=probe_jax)
         self.node = fm.fingerprint_node(node=node, name=name)
         # driver fingerprints -> node.drivers (reference: drivermanager)
@@ -159,7 +179,8 @@ class Client:
             runner = AllocRunner(
                 alloc, self.drivers, self.data_dir, node=self.node,
                 on_update=self._on_runner_update,
-                identity_signer=self.identity_signer)
+                identity_signer=self.identity_signer,
+                secrets_fetcher=self.secrets_fetcher)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             states = {name: st for name, (st, _h) in tasks.items()}
@@ -360,7 +381,8 @@ class Client:
             runner = AllocRunner(
                 a, self.drivers, self.data_dir, node=self.node,
                 on_update=self._on_runner_update,
-                identity_signer=self.identity_signer)
+                identity_signer=self.identity_signer,
+                secrets_fetcher=self.secrets_fetcher)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             self.state_db.put_alloc(alloc_id, a.modify_index)

@@ -118,22 +118,73 @@ class ArtifactHook(TaskHook):
 
 
 class TemplateHook(TaskHook):
-    """Render inline templates with ${...} interpolation
-    (reference: taskrunner/template/ consul-template integration; the
-    data-source half -- consul/vault watches -- is out of scope, env and
-    node interpolation is in)."""
+    """Render inline templates with ${...} interpolation plus
+    {{nomad_var "path" "field"}} secret resolution via the task's
+    workload identity (reference: taskrunner/template/ consul-template
+    integration -- the nomadVar data source re-based on native Variables;
+    external consul/vault watches are replaced by the workload-identity
+    Variables model, nomad/vault.go analog)."""
     name = "template"
+
+    from ..structs.variables import NOMAD_VAR_RE as _VAR_RE
 
     def prestart(self, runner: "TaskRunner") -> None:
         for tpl in runner.task.templates or []:
             data = str(tpl.get("data", ""))
             dest = str(tpl.get("destination", "local/template.out"))
-            rendered = interpolate(data, runner.alloc, runner.node,
-                                   runner.env)
+            vault_path = tpl.get("__vault")
+            if vault_path:
+                # admission-injected vault block: the whole variable
+                # renders as KEY=VALUE lines (secrets/vault.env)
+                items = self._fetch(runner, str(vault_path))
+                if items is None:
+                    raise DriverError(
+                        f"vault variable {vault_path!r} does not exist")
+                rendered = "".join(f"{k}={v}\n"
+                                   for k, v in sorted(items.items()))
+            else:
+                # interpolate FIRST (paths may use ${...}), then inject
+                # secrets -- secret VALUES must never be re-interpolated
+                rendered = interpolate(data, runner.alloc, runner.node,
+                                       runner.env)
+                rendered = self._resolve_vars(runner, rendered)
             path = os.path.join(runner.task_dir.dir, dest)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(path, "w", encoding="utf-8") as fh:
                 fh.write(rendered)
+
+    def _resolve_vars(self, runner: "TaskRunner", data: str) -> str:
+        cache: Dict[str, Optional[dict]] = {}
+
+        def sub(m: "re.Match") -> str:
+            path, field_name = m.group(1), m.group(2)
+            if path not in cache:
+                cache[path] = self._fetch(runner, path)
+            items = cache[path]
+            if items is None or field_name not in items:
+                raise DriverError(
+                    f"template references missing secret "
+                    f"{path!r}.{field_name!r}")
+            return str(items[field_name])
+
+        return self._VAR_RE.sub(sub, data)
+
+    @staticmethod
+    def _fetch(runner: "TaskRunner", path: str) -> Optional[dict]:
+        if runner.secrets_fetcher is None:
+            raise DriverError("no secrets fetcher configured")
+        jwt = runner.identity_token
+        if not jwt:
+            raise DriverError("task has no workload identity token")
+        try:
+            return runner.secrets_fetcher(jwt, path)
+        except PermissionError as e:
+            raise DriverError(f"secret access denied: {e}") from e
+        except DriverError:
+            raise
+        except Exception as e:  # noqa: BLE001 -- transport errors (HTTP
+            # 5xx etc.) must fail the TASK, not kill the runner thread
+            raise DriverError(f"secret fetch failed: {e}") from e
 
 
 class LogmonHook(TaskHook):
@@ -146,7 +197,8 @@ class LogmonHook(TaskHook):
 
 
 class IdentityHook(TaskHook):
-    """Writes a signed workload identity JWT into secrets/
+    """Writes a signed workload identity JWT into secrets/ and onto the
+    runner for the template hook's secret fetches
     (reference: taskrunner/identity_hook.go + WorkloadIdentity claims)."""
     name = "identity"
 
@@ -154,20 +206,25 @@ class IdentityHook(TaskHook):
         signer = runner.identity_signer
         if signer is None:
             return
-        token = signer({
-            "sub": f"{runner.alloc.namespace}:{runner.alloc.job_id}:"
-                   f"{runner.alloc.task_group}:{runner.task.name}",
-            "alloc_id": runner.alloc.id,
-            "job_id": runner.alloc.job_id,
-            "task": runner.task.name,
-        })
+        try:
+            token = signer({
+                "alloc_id": runner.alloc.id,
+                "task": runner.task.name,
+            })
+        except PermissionError as e:
+            raise DriverError(f"identity denied: {e}") from e
+        if not token:
+            return
+        runner.identity_token = token
         path = os.path.join(runner.task_dir.secrets_dir, "nomad_token")
         with open(path, "w", encoding="utf-8") as fh:
             fh.write(token)
 
 
+# identity runs BEFORE templates: nomad_var resolution needs the token
+# (reference ordering: taskrunner identity_hook precedes template)
 DEFAULT_HOOKS = (ValidateHook, TaskDirHook, EnvHook, LogmonHook,
-                 ArtifactHook, TemplateHook, IdentityHook)
+                 ArtifactHook, IdentityHook, TemplateHook)
 
 
 class TaskRunner:
@@ -176,7 +233,8 @@ class TaskRunner:
     def __init__(self, alloc, task: Task, driver: Driver,
                  alloc_dir: AllocDir, node=None,
                  restart_policy: Optional[RestartPolicy] = None,
-                 on_state_change=None, identity_signer=None):
+                 on_state_change=None, identity_signer=None,
+                 secrets_fetcher=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -185,6 +243,8 @@ class TaskRunner:
         self.restart_policy = restart_policy or RestartPolicy()
         self.on_state_change = on_state_change
         self.identity_signer = identity_signer
+        self.secrets_fetcher = secrets_fetcher
+        self.identity_token: Optional[str] = None
         self.task_dir: Optional[TaskDir] = None
         self.env: Dict[str, str] = {}
         self.state = TaskState()

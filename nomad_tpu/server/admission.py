@@ -1,0 +1,142 @@
+"""Job admission pipeline: mutate-then-validate hooks applied before a job
+reaches replicated state.
+
+Semantic parity with /root/reference/nomad/job_endpoint_hooks.go
+(jobImpliedConstraints, jobValidate, jobVaultHook, jobImplicitIdentitiesHook
+-- the chain Job.Register runs at nomad/job_endpoint.go:96). The reference's
+Vault/Consul token-derivation integrations map to this framework's NATIVE
+secrets model: workload-identity JWTs granting read access to the job's own
+Variables subtree (nomad/jobs/<job_id>...), the same design Nomad 1.4+
+ships as "workload identity + Variables".
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..structs import Job
+from ..structs.variables import NOMAD_VAR_RE
+
+WORKLOAD_VAR_PREFIX = "nomad/jobs/"
+
+
+def job_variable_prefix(job_id: str) -> str:
+    """The Variables subtree a job's workload identity may read."""
+    return f"{WORKLOAD_VAR_PREFIX}{job_id}"
+
+
+class AdmissionHook:
+    name = "hook"
+
+    def mutate(self, job: Job) -> Tuple[Job, List[str]]:
+        """-> (job, warnings)"""
+        return job, []
+
+    def validate(self, job: Job, server) -> List[str]:
+        """-> warnings; raise ValueError to reject."""
+        return []
+
+
+class ImplicitIdentityHook(AdmissionHook):
+    """Tasks that consume secrets (a vault block or nomad_var template
+    references) get an implicit identity requirement (reference:
+    job_endpoint_hooks.go jobImplicitIdentitiesHook)."""
+
+    name = "implicit-identity"
+
+    def mutate(self, job: Job) -> Tuple[Job, List[str]]:
+        for tg in job.task_groups:
+            for task in tg.tasks:
+                needs = task.vault is not None or any(
+                    NOMAD_VAR_RE.search(str(t.get("data", "")))
+                    for t in (task.templates or []))
+                if needs and not getattr(task, "identity", None):
+                    task.identity = {"file": True, "env": False}
+        return job, []
+
+
+class VaultHook(AdmissionHook):
+    """The vault-block equivalent: ``task.vault = {"path": ...,
+    "destination": ...}`` materializes that Variables path into the task's
+    secrets dir via an injected template (reference: nomad/vault.go token
+    derivation + taskrunner/template -- re-based on native Variables, so
+    no external Vault is involved)."""
+
+    name = "vault"
+
+    def mutate(self, job: Job) -> Tuple[Job, List[str]]:
+        warnings: List[str] = []
+        for tg in job.task_groups:
+            for task in tg.tasks:
+                if task.vault is None:
+                    continue
+                # mutators run before validators: malformed blocks must
+                # reject HERE with the 400-mapped error, not AttributeError
+                if not isinstance(task.vault, dict):
+                    raise ValueError(
+                        f"task {task.name!r}: vault block must be a map")
+                path = str(task.vault.get("path", "")
+                           or job_variable_prefix(job.id))
+                dest = str(task.vault.get("destination", "secrets/vault.env"))
+                marker = f"__vault:{path}"
+                templates = task.templates or []
+                if any(t.get("__vault") == path for t in templates):
+                    continue
+                templates.append({
+                    "__vault": path,
+                    "data": marker,
+                    "destination": dest,
+                    "env_format": True,
+                })
+                task.templates = templates
+        return job, warnings
+
+
+
+class WorkloadVarScopeHook(AdmissionHook):
+    """Templates may only reference the job's OWN Variables subtree --
+    the implicit workload policy would deny anything else at runtime, so
+    reject it at admission where the error is actionable (reference:
+    the implicit workload-identity ACL of variables_endpoint.go)."""
+
+    name = "workload-var-scope"
+
+    def validate(self, job: Job, server) -> List[str]:
+        own = job_variable_prefix(job.id)
+        for tg in job.task_groups:
+            for task in tg.tasks:
+                for tpl in task.templates or []:
+                    for path, _field in NOMAD_VAR_RE.findall(
+                            str(tpl.get("data", ""))):
+                        # the implicit policy denies EVERYTHING outside
+                        # the job's own subtree -- any other literal path
+                        # is a guaranteed runtime denial
+                        if "${" in path:
+                            continue    # interpolated: checked at runtime
+                        if path != own and not path.startswith(own + "/"):
+                            raise ValueError(
+                                f"task {task.name!r} template references "
+                                f"{path!r}, outside this job's workload "
+                                f"scope {own!r}")
+        return []
+
+
+DEFAULT_ADMISSION_HOOKS = (ImplicitIdentityHook, VaultHook,
+                           WorkloadVarScopeHook)
+
+
+class AdmissionPipeline:
+    """(reference: job_endpoint.go admissionControllers: all mutators,
+    then all validators)."""
+
+    def __init__(self, server, hooks=DEFAULT_ADMISSION_HOOKS):
+        self.server = server
+        self.hooks = [cls() for cls in hooks]
+
+    def apply(self, job: Job) -> Tuple[Job, List[str]]:
+        warnings: List[str] = []
+        for hook in self.hooks:
+            job, warns = hook.mutate(job)
+            warnings.extend(warns)
+        for hook in self.hooks:
+            warnings.extend(hook.validate(job, self.server))
+        return job, warnings

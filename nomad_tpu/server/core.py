@@ -267,16 +267,102 @@ class Server:
     def resolve_token(self, secret_id: Optional[str]):
         """-> (ACL, token). With ACLs disabled every request is management;
         with ACLs enabled a missing/unknown secret is anonymous deny-all
-        (reference: nomad/auth/auth.go ResolveToken)."""
+        (reference: nomad/auth/auth.go ResolveToken). Workload-identity
+        JWTs are accepted in place of ACL tokens and compile to the
+        implicit own-job variables policy (the reference's
+        Variables-with-workload-identity model)."""
         from ..acl import ANONYMOUS_ACL, MANAGEMENT_ACL
         if not self.acl_enabled:
             return MANAGEMENT_ACL, None
         if not secret_id:
             return ANONYMOUS_ACL, None
+        if secret_id.count(".") == 2:       # JWT-shaped: try identity
+            acl = self._workload_identity_acl(secret_id)
+            if acl is not None:
+                return acl, None
         compiled, token = self.acl_resolver.resolve_secret(secret_id)
         if compiled is None:
             return ANONYMOUS_ACL, None
         return compiled, token
+
+    def _workload_identity_acl(self, jwt: str):
+        """Compile a verified workload JWT into the implicit policy: read
+        access to the job's own Variables subtree, nothing else."""
+        claims = self._verify_workload_claims(jwt)
+        if claims is None:
+            return None
+        from ..acl.acl import ACL
+        from ..acl.policy import VariablePathRule
+        from .admission import job_variable_prefix
+        ns, job_id = claims["_ns"], claims["job_id"]
+        prefix = job_variable_prefix(job_id)
+        acl = ACL()
+        acl._ns_variables[ns] = [
+            VariablePathRule(path=prefix, capabilities=["read", "list"]),
+            VariablePathRule(path=prefix + "/*",
+                             capabilities=["read", "list"])]
+        return acl
+
+    def _verify_workload_claims(self, jwt: str):
+        """Verify signature + liveness of a workload identity JWT;
+        returns claims with '_ns' resolved, or None."""
+        claims = self.encrypter.verify_claims(jwt)
+        if claims is None or "alloc_id" not in claims:
+            return None
+        alloc = self.state.alloc_by_id(claims["alloc_id"])
+        if alloc is None or alloc.server_terminal_status():
+            return None
+        if alloc.job_id != claims.get("job_id"):
+            return None
+        claims["_ns"] = alloc.namespace
+        return claims
+
+    def sign_workload_identity(self, claims: dict) -> str:
+        """Mint a workload identity JWT (client identity hook path).
+
+        Claims are SERVER-AUTHORITATIVE: the caller only names an
+        (alloc_id, task); everything else -- job, namespace, task group,
+        expiry -- is rebuilt from replicated state, so a caller can
+        neither forge another job's identity from a live alloc id of its
+        own nor extend the TTL (reference: the server-side minting in
+        Node.DeriveSIToken / identity signing). Raises PermissionError
+        for unknown/terminal allocs or tasks not in the alloc's TG.
+        Full node-binding (per-node secret IDs) is the remaining gap."""
+        alloc_id = str(claims.get("alloc_id", ""))
+        task_name = str(claims.get("task", ""))
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None or alloc.server_terminal_status():
+            raise PermissionError("unknown or terminal allocation")
+        job = alloc.job or self.state.job_by_id(alloc.namespace,
+                                                alloc.job_id)
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if tg is None or not any(t.name == task_name for t in tg.tasks):
+            raise PermissionError(
+                f"task {task_name!r} not in allocation {alloc_id[:8]}")
+        return self.encrypter.sign_claims({
+            "sub": f"{alloc.namespace}:{alloc.job_id}:"
+                   f"{alloc.task_group}:{task_name}",
+            "alloc_id": alloc.id,
+            "job_id": alloc.job_id,
+            "task": task_name,
+        })
+
+    def workload_variable(self, jwt: str, path: str):
+        """Read a decrypted Variable on behalf of a workload
+        (reference analog: nomad/vault.go DeriveVaultToken ->
+        re-based on native Variables + workload identity). Raises
+        PermissionError for invalid identities or out-of-scope paths;
+        returns None when the variable simply doesn't exist."""
+        from .admission import job_variable_prefix
+        claims = self._verify_workload_claims(jwt)
+        if claims is None:
+            raise PermissionError("invalid workload identity")
+        prefix = job_variable_prefix(claims["job_id"])
+        if path != prefix and not path.startswith(prefix + "/"):
+            raise PermissionError(
+                f"path {path!r} outside workload scope {prefix!r}")
+        dec = self.var_get(claims["_ns"], path)
+        return dict(dec.items) if dec is not None else None
 
     # ------------------------------------------------------------------
     # Variables API (reference: nomad/variables_endpoint.go)
@@ -315,6 +401,10 @@ class Server:
     # Job API (reference: nomad/job_endpoint.go Job.Register :96)
     def register_job(self, job: Job) -> Evaluation:
         self._validate_job(job)
+        # admission hooks: mutate (implicit identity, vault->template
+        # injection) then validate (reference: job_endpoint_hooks.go)
+        from .admission import AdmissionPipeline
+        job, _warnings = AdmissionPipeline(self).apply(job)
         self.state.upsert_job(job)
         if job.is_periodic() or job.is_parameterized():
             # periodic/parameterized jobs don't get an immediate eval

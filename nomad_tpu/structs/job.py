@@ -224,6 +224,9 @@ class Task:
     artifacts: List[dict] = field(default_factory=list)
     templates: List[dict] = field(default_factory=list)
     vault: Optional[dict] = None
+    # workload identity requirement (reference: structs.WorkloadIdentity);
+    # injected by admission for secret-consuming tasks
+    identity: Optional[dict] = None
     meta: Dict[str, str] = field(default_factory=dict)
     lifecycle: Optional[dict] = None   # {"hook": "prestart", "sidecar": False}
     kind: str = ""

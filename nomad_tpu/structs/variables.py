@@ -40,6 +40,16 @@ class RootKey:
         return base64.b64decode(self.material_b64)
 
 
+# template references like {{nomad_var "nomad/jobs/<job>" "field"}} --
+# ONE definition shared by admission scope-checking (server/admission.py)
+# and client-side rendering (client/task_runner.py): drift between what
+# admission vets and what the client resolves must be impossible.
+import re
+
+NOMAD_VAR_RE = re.compile(
+    r"\{\{\s*nomad_var\s+\"([^\"]+)\"\s+\"([^\"]+)\"\s*\}\}")
+
+
 @dataclass
 class VariableMetadata:
     """(reference: structs.VariableMetadata)"""

@@ -1,0 +1,172 @@
+"""Vault/Consul-equivalent workload secrets (VERDICT r2 next #8):
+admission hooks inject identity/secret requirements, the client derives
+scoped access from the task's workload-identity JWT, and secrets
+materialize in the task sandbox -- the reference's Vault token derivation
+(nomad/vault.go, job_endpoint_hooks.go) re-based on native Variables +
+workload identity (Nomad 1.4's model)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, LocalServerConn
+from nomad_tpu.server import Server
+
+
+def wait(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path), name="sec-c1")
+    client.start()
+    wait(lambda: server.state.node_by_id(client.node.id) is not None)
+    yield server, client, tmp_path
+    client.shutdown()
+    server.shutdown()
+
+
+def run_job(server, job):
+    server.register_job(job)
+
+    def done():
+        allocs = server.state.allocs_by_job(job.namespace, job.id)
+        return allocs and all(a.client_status in ("complete", "failed")
+                              for a in allocs)
+    wait(done, msg=f"{job.id} finished")
+    return server.state.allocs_by_job(job.namespace, job.id)
+
+
+def test_template_nomad_var_end_to_end(cluster):
+    """A task reads a secret materialized via workload identity."""
+    server, client, tmp_path = cluster
+    ok, _ = server.var_put("default", "nomad/jobs/secret-job",
+                           {"db_password": "hunter2", "api_key": "k-123"})
+    assert ok
+    job = mock.job(id="secret-job")
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].config = {
+        "command": "/bin/sh",
+        "args": ["-c", "cat $NOMAD_SECRETS_DIR/db.env > "
+                       "$NOMAD_TASK_DIR/readback"]}
+    tg.tasks[0].templates = [{
+        "data": ('password={{nomad_var "nomad/jobs/secret-job" '
+                 '"db_password"}}'),
+        "destination": "secrets/db.env"}]
+    allocs = run_job(server, job)
+    assert allocs[0].client_status == "complete", \
+        allocs[0].task_states
+    readback = (tmp_path / allocs[0].id / "web" / "local" / "readback")
+    assert readback.read_text().strip() == "password=hunter2"
+    # admission injected the implicit identity requirement
+    stored = server.state.job_by_id("default", "secret-job")
+    assert stored.task_groups[0].tasks[0].identity is not None
+
+
+def test_vault_block_materializes_env_file(cluster):
+    """task.vault -> admission injects a template -> the whole variable
+    lands as KEY=VALUE in secrets/ (the DeriveVaultToken analog)."""
+    server, client, tmp_path = cluster
+    server.var_put("default", "nomad/jobs/vault-job/db",
+                   {"user": "svc", "pass": "s3cr3t"})
+    job = mock.job(id="vault-job")
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].vault = {"path": "nomad/jobs/vault-job/db"}
+    tg.tasks[0].config = {
+        "command": "/bin/sh",
+        "args": ["-c", "cp $NOMAD_SECRETS_DIR/vault.env "
+                       "$NOMAD_TASK_DIR/env-copy"]}
+    allocs = run_job(server, job)
+    assert allocs[0].client_status == "complete", allocs[0].task_states
+    copied = (tmp_path / allocs[0].id / "web" / "local" / "env-copy")
+    assert copied.read_text() == "pass=s3cr3t\nuser=svc\n"
+
+
+def test_cross_job_secret_rejected_at_admission(cluster):
+    server, client, _ = cluster
+    job = mock.job(id="snooper")
+    job.task_groups[0].tasks[0].templates = [{
+        "data": '{{nomad_var "nomad/jobs/other-job" "x"}}',
+        "destination": "secrets/stolen"}]
+    with pytest.raises(ValueError, match="outside this job's workload"):
+        server.register_job(job)
+
+
+def test_missing_secret_fails_task(cluster):
+    server, client, _ = cluster
+    job = mock.job(id="missing-secret-job")
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "raw_exec"
+    tg.tasks[0].config = {"command": "/bin/true", "args": []}
+    tg.tasks[0].templates = [{
+        "data": '{{nomad_var "nomad/jobs/missing-secret-job" "nope"}}',
+        "destination": "secrets/x"}]
+    allocs = run_job(server, job)
+    assert allocs[0].client_status == "failed"
+
+
+def test_workload_variable_scope_enforced(cluster):
+    """Direct server API: a forged/expired/out-of-scope identity is
+    denied; in-scope reads decrypt."""
+    server, client, _ = cluster
+    server.var_put("default", "nomad/jobs/scoped-job", {"k": "v"})
+    server.var_put("default", "nomad/jobs/other", {"k": "other"})
+    job = mock.job(id="scoped-job")
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].config = {"run_for": "5s"}
+    server.register_job(job)
+    wait(lambda: server.state.allocs_by_job("default", "scoped-job"))
+    alloc = server.state.allocs_by_job("default", "scoped-job")[0]
+    jwt = server.sign_workload_identity({
+        "alloc_id": alloc.id, "job_id": "scoped-job", "task": "web"})
+    assert server.workload_variable(jwt, "nomad/jobs/scoped-job") \
+        == {"k": "v"}
+    with pytest.raises(PermissionError):
+        server.workload_variable(jwt, "nomad/jobs/other")
+    with pytest.raises(PermissionError):
+        server.workload_variable("not.a.jwt", "nomad/jobs/scoped-job")
+
+
+def test_workload_jwt_accepted_as_acl_token(tmp_path):
+    """With ACLs enabled, a workload JWT resolves to the implicit
+    own-job variables policy (reference: Variables + workload identity)."""
+    server = Server(num_workers=1, heartbeat_ttl=30.0, acl_enabled=True)
+    server.start()
+    try:
+        n = mock.node()
+        n.compute_class()
+        server.register_node(n)
+        server.var_put("default", "nomad/jobs/acl-job", {"k": "v"})
+        job = mock.job(id="acl-job")
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].config = {"run_for": "5s"}
+        server.register_job(job)
+        wait(lambda: server.state.allocs_by_job("default", "acl-job"))
+        alloc = server.state.allocs_by_job("default", "acl-job")[0]
+        jwt = server.sign_workload_identity({
+            "alloc_id": alloc.id, "job_id": "acl-job", "task": "web"})
+        acl, _ = server.resolve_token(jwt)
+        assert acl.allow_variable_op("default", "nomad/jobs/acl-job",
+                                     "read")
+        assert not acl.allow_variable_op("default", "nomad/jobs/other",
+                                         "read")
+        # anonymous stays deny-all
+        anon, _ = server.resolve_token("bogus")
+        assert not anon.allow_variable_op("default", "nomad/jobs/acl-job",
+                                          "read")
+    finally:
+        server.shutdown()
