@@ -51,63 +51,195 @@ class BadNodeTracker:
             return len(self._hits.get(node_id, ()))
 
 
+class _OverlaySnapshot:
+    """A state snapshot with an in-flight (submitted, not yet committed)
+    plan result overlaid -- what the reference's optimistic snapshot gives
+    verify(N+1) while apply(N) replicates (plan_apply.go:96-118 pipeline).
+    Only the two reads plan verification performs are overlaid."""
+
+    def __init__(self, snapshot, inflight: PlanResult):
+        self._snap = snapshot
+        self._inflight = inflight
+        self._removed = set()
+        for allocs in inflight.node_update.values():
+            self._removed.update(a.id for a in allocs)
+        for allocs in inflight.node_preemptions.values():
+            self._removed.update(a.id for a in allocs)
+
+    def node_by_id(self, node_id: str):
+        return self._snap.node_by_id(node_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        out = [a for a in self._snap.allocs_by_node(node_id)
+               if a.id not in self._removed]
+        have = {a.id for a in out}
+        for a in self._inflight.node_allocation.get(node_id, ()):
+            if a.id not in have:
+                out.append(a)
+        return out
+
+
+class _Pending:
+    """One queued plan submission moving through the pipeline."""
+
+    __slots__ = ("plan", "eval_updates", "event", "result", "error", "seq")
+
+    def __init__(self, plan, eval_updates, seq):
+        self.plan = plan
+        self.eval_updates = eval_updates
+        self.event = threading.Event()
+        self.result: Optional[PlanResult] = None
+        self.error: Optional[BaseException] = None
+        self.seq = seq
+
+    def resolve(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self.event.set()
+
+
 class Planner:
     """The leader's plan applier (reference: plan_apply.go:24 planner).
 
-    apply() is called by workers (via the plan queue's serialization lock);
-    verification fans out per node across a pool sized NumCPU/2 like the
-    reference's EvaluatePool (plan_apply.go:113-118).
+    Pipelined (plan_apply.go:96-118): a priority queue feeds a dispatcher
+    that verifies plan N+1 against an optimistic overlay snapshot WHILE
+    plan N's commit (raft propose on clustered servers) is still in
+    flight -- one outstanding commit, exactly the reference's window. A
+    failed commit invalidates the overlay, so the already-verified
+    successor is re-verified against clean state before committing
+    (conservative: overlays can only over-count usage... except freed
+    capacity from stops, which the re-verify covers). Verification fans
+    out per node across a pool sized NumCPU/2 like the reference's
+    EvaluatePool (plan_apply.go:113-118).
     """
 
     def __init__(self, state: StateStore, pool_size: Optional[int] = None):
         import os
         self.state = state
         self.bad_nodes = BadNodeTracker()
-        self._serial = threading.Lock()   # the single serialized queue
         pool_size = pool_size or max(1, (os.cpu_count() or 2) // 2)
         self._pool = ThreadPoolExecutor(max_workers=pool_size,
                                         thread_name_prefix="plan-verify")
+        self._committer = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="plan-commit")
         self.plans_applied = 0
         self.plans_rejected = 0
-        self._depth_lock_free = 0  # approximate gauge; benign data race
+        # priority plan queue (reference: plan_queue.go:99)
+        self._cv = threading.Condition()
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._shutdown = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="plan-dispatch")
+        self._dispatcher.start()
 
     def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        # let the dispatcher drain queued plans BEFORE killing the pools
+        # it verifies/commits on, or every drained waiter errors out
+        self._dispatcher.join(timeout=10.0)
         self._pool.shutdown(wait=False)
+        self._committer.shutdown(wait=False)
 
     # ------------------------------------------------------------------
     def apply(self, plan: Plan,
               eval_updates: Optional[List[Evaluation]] = None
               ) -> PlanResult:
-        """Verify against latest state, commit what fits
-        (reference: planApply plan_apply.go:96 + evaluatePlan :468)."""
-        # queue depth = submissions currently waiting on the serialized
-        # applier (reference: `nomad.plan.queue_depth`, plan_queue.go stats)
-        self._depth_lock_free += 1
-        metrics.sample_ms("nomad.plan.queue_depth", float(
-            self._depth_lock_free - 1))
-        try:
-            with self._serial:
-                return self._apply_locked(plan, eval_updates)
-        finally:
-            self._depth_lock_free -= 1
+        """Enqueue + wait (the worker-facing contract is unchanged:
+        blocking submit, reference worker.go:650 SubmitPlan)."""
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("planner is shut down")
+            self._seq += 1
+            pending = _Pending(plan, eval_updates, self._seq)
+            heapq.heappush(self._heap,
+                           (-plan.priority, pending.seq, pending))
+            metrics.sample_ms("nomad.plan.queue_depth",
+                              float(len(self._heap)))
+            self._cv.notify()
+        pending.event.wait()
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
 
-    def _apply_locked(self, plan: Plan,
-                      eval_updates: Optional[List[Evaluation]] = None
-                      ) -> PlanResult:
+    # ------------------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        # (future, PlanResult, _Pending); commits resolve their own
+        # waiters (success AND failure), so the dispatcher never has to
+        # drain eagerly -- it keeps verifying new arrivals while the
+        # commit replicates, which is the pipeline
+        inflight: Optional[tuple] = None
+        while True:
+            with self._cv:
+                while not self._heap and not self._shutdown:
+                    self._cv.wait(0.5)
+                if self._shutdown and not self._heap:
+                    break
+                item = heapq.heappop(self._heap)[2]
+            try:
+                inflight = self._process(item, inflight)
+            except BaseException as e:  # noqa: BLE001 -- waiter must wake
+                item.resolve(error=e)
+        if inflight is not None:
+            try:
+                inflight[0].result()
+            except BaseException:  # noqa: BLE001 -- shutdown drain
+                pass
+
+    def _process(self, item: _Pending, inflight):
+        """Verify one plan (overlaying the in-flight commit), then submit
+        its commit asynchronously. Returns the new in-flight tuple."""
         snapshot = self.state.snapshot()
+        overlaid = (_OverlaySnapshot(snapshot, inflight[1])
+                    if inflight is not None else snapshot)
         with metrics.measure("nomad.plan.evaluate"):
-            result = self._evaluate_plan(snapshot, plan)
-        if result.is_no_op() and not plan.is_no_op():
-            # everything was rejected; hand back a refresh index
+            result = self._evaluate_plan(overlaid, item.plan)
+
+        # serialize commits: wait for the previous one (its replication
+        # overlapped this verification, which is the whole point)
+        if inflight is not None:
+            prev_future = inflight[0]
+            try:
+                prev_future.result()    # waiter resolved inside commit()
+                prev_ok = True
+            except BaseException:  # noqa: BLE001
+                prev_ok = False
+            if not prev_ok:
+                # the overlay assumed a commit that never landed --
+                # freed-capacity assumptions may be wrong: re-verify clean
+                with metrics.measure("nomad.plan.evaluate"):
+                    result = self._evaluate_plan(self.state.snapshot(),
+                                                 item.plan)
+
+        # bad-node hits are recorded ONCE, for the result that actually
+        # decides the plan (a discarded overlay pass must not count)
+        for node_id in result.rejected_nodes:
+            self.bad_nodes.add(node_id)
+
+        if result.is_no_op() and not item.plan.is_no_op():
             result.refresh_index = self.state.latest_index()
             self.plans_rejected += 1
-            return result
-        index = self.state.upsert_plan_results(result, eval_updates)
-        result.alloc_index = index
-        if result.rejected_nodes:
-            result.refresh_index = index
-        self.plans_applied += 1
-        return result
+            item.resolve(result=result)
+            return None
+
+        def commit(item=item, result=result):
+            try:
+                index = self.state.upsert_plan_results(result,
+                                                       item.eval_updates)
+            except BaseException as e:  # noqa: BLE001 -- waiter must wake
+                item.resolve(error=e)
+                raise
+            result.alloc_index = index
+            if result.rejected_nodes:
+                result.refresh_index = index
+            self.plans_applied += 1
+            item.resolve(result=result)
+            return index
+
+        future = self._committer.submit(commit)
+        return (future, result, item)
 
     # ------------------------------------------------------------------
     def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
@@ -147,7 +279,6 @@ class Planner:
                     plan.node_allocation[node_id])
             else:
                 rejected.append(node_id)
-                self.bad_nodes.add(node_id)
 
         if rejected and plan.all_at_once:
             # all-or-nothing (reference: evaluatePlan AllAtOnce handling)
