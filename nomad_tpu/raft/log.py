@@ -13,8 +13,16 @@ from __future__ import annotations
 import json
 import os
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
+
+
+class CorruptWalError(Exception):
+    """Corruption detected in the MIDDLE of the WAL (valid frames follow
+    the broken record). Unlike a torn tail, truncating here would silently
+    drop entries raft already acked -- the node must refuse to start and
+    let the operator restore from a snapshot/peer."""
 
 
 @dataclass
@@ -106,55 +114,149 @@ class InMemLogStore:
 
 
 class FileLogStore(InMemLogStore):
-    """JSONL WAL. Each line is {"op": "append"|"truncate"|"compact"|"reset",
-    ...}; recovery replays the ops. Rewritten compactly when the file grows
-    past `rewrite_bytes`."""
+    """CRC-framed JSONL WAL. Each line is ``{payload}|<crc32 hex>``, the
+    payload a JSON op record ("append"|"truncate"|"compact"|"reset");
+    recovery replays ops up to the first missing/invalid CRC and
+    TRUNCATES the file there, so a torn tail (kill -9 mid-append, torn
+    sector) can never poison later appends. Appends fsync before
+    returning -- raft must not ack an entry the disk might lose
+    (reference durability contract: raft-boltdb at nomad/server.go:30).
+    Rewritten compactly when the file grows past `rewrite_bytes`."""
 
-    def __init__(self, path: str, rewrite_bytes: int = 8 << 20) -> None:
+    def __init__(self, path: str, rewrite_bytes: int = 8 << 20,
+                 fsync: bool = True) -> None:
         super().__init__()
         self.path = path
         self.rewrite_bytes = rewrite_bytes
+        self.fsync = fsync
         self._fh = None
-        if os.path.exists(path):
+        existed = os.path.exists(path)
+        if existed:
             self._recover()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "a", encoding="utf-8")
+        if not existed:
+            self._fsync_dir()       # the dirent must be durable too
+
+    @staticmethod
+    def _frame(payload: str) -> str:
+        crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+        return f"{payload}|{crc:08x}\n"
+
+    @staticmethod
+    def _unframe(line: str) -> Optional[str]:
+        """-> payload, or None when the frame is torn/corrupt."""
+        line = line.rstrip("\n")
+        cut = line.rfind("|")
+        if cut < 0 or len(line) - cut != 9:
+            return None
+        payload, crc_hex = line[:cut], line[cut + 1:]
+        try:
+            want = int(crc_hex, 16)
+        except ValueError:
+            return None
+        if zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF != want:
+            return None
+        return payload
 
     def _recover(self) -> None:
-        with open(self.path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
+        """Streaming replay (O(1) in file size). Three outcomes per bad
+        record: legacy (pre-CRC) lines replay and schedule a rewrite;
+        a bad record with NO valid frame after it is a torn tail,
+        truncated on disk; a bad record FOLLOWED by valid frames is
+        mid-file corruption -> CorruptWalError (fail loudly rather than
+        silently dropping acked entries)."""
+        good_end = 0
+        saw_framed = False
+        needs_rewrite = False
+        with open(self.path, "rb") as fh:
+            while True:
+                pos = fh.tell()
+                line_b = fh.readline()
+                if not line_b:
+                    break
+                if not line_b.endswith(b"\n"):
+                    break                   # unterminated tail: torn
+                line = line_b.decode("utf-8", "replace")
+                payload = self._unframe(line)
+                if payload is None and not saw_framed:
+                    # legacy pre-CRC format: plain JSON lines are valid
+                    # only in the un-framed PREFIX of an upgraded file
+                    try:
+                        rec = json.loads(line)
+                        self._replay(rec)
+                        needs_rewrite = True
+                        good_end = fh.tell()
+                        continue
+                    except json.JSONDecodeError:
+                        pass
+                if payload is None:
+                    if self._any_valid_frame_after(fh):
+                        raise CorruptWalError(
+                            f"{self.path}: corrupt record at byte {pos} "
+                            "with valid records after it; refusing to "
+                            "truncate acked entries")
+                    break                   # torn tail
                 try:
-                    rec = json.loads(line)
+                    rec = json.loads(payload)
                 except json.JSONDecodeError:
-                    break       # torn tail write: discard
-                op = rec.get("op")
-                if op == "append":
-                    e = rec["entry"]
-                    self._entries.append(LogEntry(
-                        index=e["index"], term=e["term"], type=e["type"],
-                        data=e.get("data")))
-                    if len(self._entries) == 1:
-                        self._first = e["index"]
-                elif op == "truncate":
-                    keep = rec["index"] - self._first + 1
-                    self._entries = self._entries[:max(keep, 0)]
-                elif op == "compact":
-                    drop = rec["index"] - self._first + 1
-                    if drop > 0:
-                        self._entries = self._entries[drop:]
-                        self._first = rec["index"] + 1
-                elif op == "reset":
-                    self._entries = []
-                    self._first = rec["first"]
+                    if self._any_valid_frame_after(fh):
+                        raise CorruptWalError(
+                            f"{self.path}: corrupt record at byte {pos}")
+                    break
+                saw_framed = True
+                self._replay(rec)
+                good_end = fh.tell()
+        size = os.path.getsize(self.path)
+        if good_end < size:
+            # drop the torn tail ON DISK: appends after recovery must
+            # follow the last valid record, not garbage a future replay
+            # would stop at
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good_end)
+        if needs_rewrite:
+            # migrate legacy content to the framed format in place
+            self._rewrite_file()
+
+    def _any_valid_frame_after(self, fh) -> bool:
+        """Scan the remainder of the file for any intact framed record."""
+        while True:
+            line_b = fh.readline()
+            if not line_b:
+                return False
+            if not line_b.endswith(b"\n"):
+                return False
+            if self._unframe(line_b.decode("utf-8", "replace")) is not None:
+                return True
+
+    def _replay(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "append":
+            e = rec["entry"]
+            self._entries.append(LogEntry(
+                index=e["index"], term=e["term"], type=e["type"],
+                data=e.get("data")))
+            if len(self._entries) == 1:
+                self._first = e["index"]
+        elif op == "truncate":
+            keep = rec["index"] - self._first + 1
+            self._entries = self._entries[:max(keep, 0)]
+        elif op == "compact":
+            drop = rec["index"] - self._first + 1
+            if drop > 0:
+                self._entries = self._entries[drop:]
+                self._first = rec["index"] + 1
+        elif op == "reset":
+            self._entries = []
+            self._first = rec["first"]
 
     def _write(self, rec: dict) -> None:
         if self._fh is None:
             return
-        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._fh.write(self._frame(json.dumps(rec, separators=(",", ":"))))
         self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
 
     def _persist(self, entry: LogEntry) -> None:
         self._write({"op": "append", "entry": {
@@ -178,18 +280,41 @@ class FileLogStore(InMemLogStore):
                 return
         except OSError:
             return
+        self._rewrite_file()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _rewrite_file(self) -> None:
+        """Atomically rewrite the WAL as compact framed records. Leaves
+        self._fh closed; callers reopen."""
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
-            fh.write(json.dumps({"op": "reset", "first": self._first},
-                                separators=(",", ":")) + "\n")
+            fh.write(self._frame(json.dumps(
+                {"op": "reset", "first": self._first},
+                separators=(",", ":"))))
             for e in self._entries:
-                fh.write(json.dumps(
+                fh.write(self._frame(json.dumps(
                     {"op": "append", "entry": {
                         "index": e.index, "term": e.term, "type": e.type,
-                        "data": e.data}}, separators=(",", ":")) + "\n")
-        self._fh.close()
+                        "data": e.data}}, separators=(",", ":"))))
+            fh.flush()
+            os.fsync(fh.fileno())
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
         os.replace(tmp, self.path)
-        self._fh = open(self.path, "a", encoding="utf-8")
+        self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
+        """Make the dirent durable (file create / rename): fsyncing file
+        CONTENTS alone doesn't survive power loss of the directory."""
+        try:
+            fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
 
     def close(self) -> None:
         if self._fh is not None:

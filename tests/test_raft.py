@@ -333,3 +333,129 @@ def test_cluster_persistence(tmp_path):
     finally:
         for s in servers2:
             s.shutdown()
+
+
+def test_wal_recovers_valid_prefix_under_random_truncation(tmp_path):
+    """Property: truncating the WAL at ANY byte length recovers exactly a
+    prefix of the appended entries, never garbage, and the store stays
+    appendable (VERDICT r2 next #10; reference durability contract:
+    raft-boltdb, nomad/server.go:30)."""
+    import random
+
+    from nomad_tpu.raft.log import FileLogStore, LogEntry
+
+    path = str(tmp_path / "wal.log")
+    store = FileLogStore(path, fsync=False)
+    for i in range(1, 41):
+        store.append(LogEntry(index=i, term=1, type="command",
+                              data={"n": i, "pad": "x" * (i % 17)}))
+    store.close()
+    full = open(path, "rb").read()
+    rng = random.Random(7)
+    cuts = sorted(rng.sample(range(1, len(full)), 25)) + [len(full)]
+    for cut in cuts:
+        p = str(tmp_path / f"wal-{cut}.log")
+        with open(p, "wb") as fh:
+            fh.write(full[:cut])
+        s = FileLogStore(p, fsync=False)
+        n = s.last_index()
+        # a prefix: entries 1..n, all intact
+        assert 0 <= n <= 40
+        for i in range(1, n + 1):
+            e = s.get(i)
+            assert e is not None and e.data["n"] == i
+        # the torn tail was truncated on disk: appending + re-recovering
+        # must keep every entry
+        s.append(LogEntry(index=n + 1, term=2, type="command",
+                          data={"n": n + 1}))
+        s.close()
+        s2 = FileLogStore(p, fsync=False)
+        assert s2.last_index() == n + 1
+        assert s2.get(n + 1).term == 2
+        s2.close()
+
+
+def test_wal_mid_file_corruption_fails_loudly(tmp_path):
+    """Bit-flip inside an earlier record with valid records after it:
+    truncating would silently drop ACKED entries, so recovery must refuse
+    to start instead (CorruptWalError)."""
+    from nomad_tpu.raft.log import CorruptWalError, FileLogStore, LogEntry
+
+    path = str(tmp_path / "wal.log")
+    store = FileLogStore(path, fsync=False)
+    for i in range(1, 11):
+        store.append(LogEntry(index=i, term=1, type="command", data=i))
+    store.close()
+    raw = bytearray(open(path, "rb").read())
+    lines = raw.split(b"\n")
+    # flip a byte in the 5th record's payload
+    target = lines[4]
+    lines[4] = target[:10] + bytes([target[10] ^ 0xFF]) + target[11:]
+    open(path, "wb").write(b"\n".join(lines))
+    with pytest.raises(CorruptWalError):
+        FileLogStore(path, fsync=False)
+
+
+def test_wal_migrates_legacy_unframed_format(tmp_path):
+    """Pre-CRC WALs (plain JSON lines) recover fully and are rewritten
+    framed in place -- an in-place upgrade must never wipe the log."""
+    import json as _json
+
+    from nomad_tpu.raft.log import FileLogStore, LogEntry
+
+    path = str(tmp_path / "wal.log")
+    with open(path, "w") as fh:
+        for i in range(1, 6):
+            fh.write(_json.dumps({"op": "append", "entry": {
+                "index": i, "term": 1, "type": "command",
+                "data": {"n": i}}}) + "\n")
+    store = FileLogStore(path, fsync=False)
+    assert store.last_index() == 5
+    assert store.get(3).data["n"] == 3
+    store.append(LogEntry(index=6, term=2, type="command", data={"n": 6}))
+    store.close()
+    # after migration every line is framed; a fresh recovery sees all 6
+    for line in open(path):
+        assert "|" in line
+    s2 = FileLogStore(path, fsync=False)
+    assert s2.last_index() == 6
+    s2.close()
+
+
+def test_wal_survives_kill9_mid_append(tmp_path):
+    """A real process killed with SIGKILL mid-append stream: the surviving
+    prefix recovers cleanly and the raft node keeps working on it."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "wal.log")
+    writer = (
+        "import sys, os\n"
+        "sys.path.insert(0, %r)\n"
+        "from nomad_tpu.raft.log import FileLogStore, LogEntry\n"
+        "store = FileLogStore(%r, fsync=False)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    i += 1\n"
+        "    store.append(LogEntry(index=i, term=1, type='command',\n"
+        "                          data={'n': i}))\n"
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), path)
+    proc = subprocess.Popen([sys.executable, "-c", writer])
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            if os.path.exists(path) and os.path.getsize(path) > 4096:
+                break
+        except OSError:
+            pass
+        time.sleep(0.01)
+    proc.kill()
+    proc.wait()
+    store = FileLogStore(path, fsync=False)
+    n = store.last_index()
+    assert n >= 1
+    for i in range(1, n + 1):
+        e = store.get(i)
+        assert e is not None and e.data["n"] == i
+    store.append(LogEntry(index=n + 1, term=2, type="command", data={}))
+    store.close()
