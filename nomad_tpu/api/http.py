@@ -694,7 +694,10 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self.wfile.write(data)
                 return
             elif parts == ["v1", "metrics"]:
-                self._send(200, self._metrics())
+                if q.get("format", [""])[0] == "prometheus":
+                    self._send_prometheus()
+                else:
+                    self._send(200, self._metrics())
             else:
                 self._error(404, f"unknown path {url.path}")
         except BrokenPipeError:
@@ -858,6 +861,23 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, {"node_id": node.id,
                                  "heartbeat_ttl":
                                      self.nomad.heartbeat_ttl})
+            elif parts[:3] == ["v1", "deployment", "promote"] and \
+                    len(parts) == 4:
+                # (reference: deployment_endpoint.go Promote)
+                from ..acl import CAP_SUBMIT_JOB
+                d = self.nomad.state.deployment_by_id(parts[3])
+                if d is None:
+                    return self._error(404, "unknown deployment")
+                if not self._check(acl.allow_namespace_op(
+                        d.namespace, CAP_SUBMIT_JOB)):
+                    return
+                body = self._body()
+                groups = body.get("groups")
+                try:
+                    self.nomad.promote_deployment(parts[3], groups)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"promoted": True})
             elif parts == ["v1", "node", "identity-sign"]:
                 # client-agent path (node:write pre-gated above): mint a
                 # workload identity JWT for a task the node runs
@@ -1301,6 +1321,48 @@ class ApiHandler(BaseHTTPRequestHandler):
                 "status": n.status, "node_class": n.node_class,
                 "scheduling_eligibility": n.scheduling_eligibility,
                 "drain": n.drain}
+
+    def _send_prometheus(self) -> None:
+        """Prometheus text exposition of the telemetry registry
+        (reference: go-metrics prometheus sink fanout,
+        command/agent/command.go:1164-1253)."""
+        m = self._metrics()
+
+        def norm(name: str) -> str:
+            out = []
+            for ch in name:
+                out.append(ch if ch.isalnum() or ch == "_" else "_")
+            return "".join(out)
+
+        lines = []
+        for name, value in sorted(m["counters"].items()):
+            p = norm(name)
+            lines.append(f"# TYPE {p} counter")
+            lines.append(f"{p} {value}")
+        for name, s in sorted(m["samples"].items()):
+            p = norm(name)
+            # derived series are NOT a prometheus summary (that family
+            # only allows _sum/_count/quantile) -- expose each as a gauge
+            for k in ("count", "mean_ms", "p50_ms", "p95_ms", "max_ms",
+                      "last_ms"):
+                if k in s:
+                    lines.append(f"# TYPE {p}_{k} gauge")
+                    lines.append(f"{p}_{k} {s[k]}")
+        for k in ("plans_applied", "plans_rejected", "state_index"):
+            p = norm(f"nomad.{k}")
+            lines.append(f"# TYPE {p} gauge")
+            lines.append(f"{p} {m[k]}")
+        if m.get("tpu_placement_ratio") is not None:
+            lines.append("# TYPE nomad_scheduler_tpu_placement_ratio gauge")
+            lines.append("nomad_scheduler_tpu_placement_ratio "
+                         f"{m['tpu_placement_ratio']}")
+        body = ("\n".join(lines) + "\n").encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _metrics(self) -> dict:
         from ..server.telemetry import metrics

@@ -179,5 +179,7 @@ class SimClient(threading.Thread):
         if alloc.deployment_id and (healthy or status == ALLOC_CLIENT_FAILED):
             upd.deployment_status = AllocDeploymentStatus(
                 healthy=(status != ALLOC_CLIENT_FAILED),
-                timestamp=time.time())
+                timestamp=time.time(),
+                canary=(alloc.deployment_status.canary
+                        if alloc.deployment_status is not None else False))
         return upd

@@ -292,5 +292,11 @@ class AllocRunner:
             upd.client_terminal_time = time.time()
         if self.alloc.deployment_id and self.deployment_health is not None:
             upd.deployment_status = AllocDeploymentStatus(
-                healthy=self.deployment_health, timestamp=time.time())
+                healthy=self.deployment_health, timestamp=time.time(),
+                # health reports must not erase the canary marking the
+                # scheduler placed (the reconciler's promotion bookkeeping
+                # and the watcher's canary counts key on it)
+                canary=(self.alloc.deployment_status.canary
+                        if self.alloc.deployment_status is not None
+                        else False))
         return upd

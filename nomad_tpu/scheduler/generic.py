@@ -316,6 +316,9 @@ class GenericScheduler:
                 client_status="pending",
                 metrics=self.ctx.metrics.copy(),
             )
+            if place.canary:
+                from ..structs import AllocDeploymentStatus
+                alloc.deployment_status = AllocDeploymentStatus(canary=True)
             if prev is not None:
                 alloc.previous_allocation = prev.id
                 if place.reschedule:
@@ -453,6 +456,9 @@ class GenericScheduler:
             client_status="pending",
             metrics=metrics,
         )
+        if place.canary:
+            from ..structs import AllocDeploymentStatus
+            alloc.deployment_status = AllocDeploymentStatus(canary=True)
         prev = place.previous_alloc
         if prev is not None:
             alloc.previous_allocation = prev.id

@@ -451,6 +451,43 @@ class AllocReconciler:
                 du.reconnect_updates += 1
             untainted.extend(reconnecting)
 
+        # Canary separation (reference: reconcile.go cancelUnneededCanaries
+        # runs BEFORE the shrink): while the deployment is unpromoted,
+        # canary allocs live OUTSIDE the count -- they must not trigger
+        # the excess-shrink of old-version allocs, and the canary gate
+        # below owns their placement/replacement entirely.
+        update = tg.update or (self.job.update if self.job else None)
+        canaries_desired = (update.canary
+                            if update is not None and not update.is_empty()
+                            else 0)
+        dep_state = (self.deployment.task_groups.get(tg.name)
+                     if self.deployment is not None else None)
+        promoted = bool(dep_state.promoted) if dep_state is not None \
+            else False
+        canary_live: List[Allocation] = []
+        canary_lost: List[Allocation] = []
+        if canaries_desired and not promoted and self.deployment is not None:
+            def is_canary(a):
+                return (a.deployment_status is not None
+                        and a.deployment_status.canary
+                        and a.deployment_id == self.deployment.id
+                        and a.job_version == self.job.version)
+
+            keep = []
+            for a in untainted:
+                (canary_live if is_canary(a) else keep).append(a)
+            untainted = keep
+            keep = []
+            for a in migrate:
+                # a migrating canary is replaced via the gate, not the
+                # generic migrate path (which would drop the flag)
+                (canary_lost if is_canary(a) else keep).append(a)
+            migrate = keep
+            keep = []
+            for a in lost:
+                (canary_lost if is_canary(a) else keep).append(a)
+            lost = keep
+
         # Determine stops for count shrink; name index over live allocs
         # (+ completed batch allocs, whose names stay reserved)
         live = untainted + migrate
@@ -460,19 +497,40 @@ class AllocReconciler:
         n_live = len(untainted) + len(migrate)
         if n_live > tg.count:
             excess = n_live - tg.count
-            remove_idx = name_index.unset_highest(excess)
-            removed = 0
+            # OLD-version allocs shrink first: after a canary promotion
+            # the surviving canaries ARE the new version and the excess
+            # is exactly the old allocs they replace -- index-order alone
+            # could stop a canary instead (duplicate canary indexes)
+            old_first = sorted(
+                (a for a in untainted
+                 if a.job_version != self.job.version),
+                key=lambda a: -a.index())[:excess]
+            stop_ids = {a.id for a in old_first}
             new_untainted = []
             for a in untainted:
-                if removed < excess and a.index() in remove_idx:
+                if a.id in stop_ids:
                     self.result.stop.append(AllocStopResult(
                         alloc=a, status_description=ALLOC_NOT_NEEDED))
                     du.stop += 1
                     name_index.b.discard(a.index())
-                    removed += 1
                 else:
                     new_untainted.append(a)
             untainted = new_untainted
+            excess -= len(stop_ids)
+            if excess > 0:
+                remove_idx = name_index.unset_highest(excess)
+                removed = 0
+                new_untainted = []
+                for a in untainted:
+                    if removed < excess and a.index() in remove_idx:
+                        self.result.stop.append(AllocStopResult(
+                            alloc=a, status_description=ALLOC_NOT_NEEDED))
+                        du.stop += 1
+                        name_index.b.discard(a.index())
+                        removed += 1
+                    else:
+                        new_untainted.append(a)
+                untainted = new_untainted
 
         # In-place vs destructive updates for allocs on old job versions
         inplace: List[Allocation] = []
@@ -494,12 +552,49 @@ class AllocReconciler:
             updated.job_version = self.job.version
             self.result.inplace_update.append(updated)
 
+        # Canary gate (reference: reconcile.go computeCanaries): with
+        # update.canary > 0 and an unpromoted deployment, destructive
+        # updates are BLOCKED; up to `canary` new-version allocs place
+        # ALONGSIDE the old ones. Lost/migrating canaries stop and are
+        # re-placed HERE (fresh canary indexes, the reference's
+        # NextCanaries) so replacements keep the canary marking. After
+        # promotion the surviving canaries count toward the new version,
+        # so an equal number of old allocs stop outright and the rest
+        # roll through the max_parallel gate.
+        # update-needed count BEFORE any gating: completion must reflect
+        # outstanding work, not what this round deferred
+        destructive_total = len(destructive)
+        # the gate applies even before the deployment object exists (the
+        # FIRST eval of a canary update creates it via du.canary)
+        if canaries_desired and not promoted and \
+                (destructive or canary_live or canary_lost):
+            for a in canary_lost:
+                du.stop += 1
+                self.result.stop.append(AllocStopResult(
+                    alloc=a, client_status=ALLOC_CLIENT_LOST,
+                    status_description=ALLOC_LOST))
+            canary_missing = canaries_desired - len(canary_live)
+            used_idx = {a.index() for a in canary_live}
+            next_idx = 0
+            for _ in range(max(0, canary_missing)):
+                while next_idx in used_idx:
+                    next_idx += 1
+                used_idx.add(next_idx)
+                du.canary += 1
+                self.result.place.append(AllocPlaceResult(
+                    name=f"{self.job_id}.{tg.name}[{next_idx}]",
+                    task_group=tg, canary=True))
+            du.ignore += len(destructive) + len(canary_live)
+            destructive = []
+        # post-promotion no special stop pass is needed: promoted
+        # canaries rejoin `untainted` as current-version allocs and the
+        # old-first count shrink above retires the old allocs they
+        # replaced; the remaining old allocs roll via max_parallel
+
         # Rolling-update gate: with an update strategy, at most max_parallel
         # destructive updates per round; in-flight (placed-but-unhealthy)
         # deployment allocs consume slots (reference: reconcile.go
         # computeUpdates + getDeploymentLimit).
-        update = tg.update or (self.job.update if self.job else None)
-        destructive_total = len(destructive)
         if destructive and update is not None and not update.is_empty():
             in_flight = 0
             if self.deployment is not None:
@@ -658,6 +753,7 @@ class AllocReconciler:
                     auto_promote=update.auto_promote,
                     progress_deadline_s=update.progress_deadline_s,
                     desired_total=tg.count,
+                    desired_canaries=update.canary,
                 )
                 self.deployment.task_groups[tg.name] = st
 

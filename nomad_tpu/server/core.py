@@ -1313,6 +1313,54 @@ class Server:
                     continue
                 self._watch_deployment(d)
 
+    def promote_deployment(self, deployment_id: str,
+                           groups: Optional[List[str]] = None) -> None:
+        """Promote canaries (reference: deployment_endpoint.go Promote ->
+        deploymentwatcher PromoteDeployment): every targeted group must
+        have its desired canaries HEALTHY; promotion unblocks the
+        reconciler's canary gate so the rollout proceeds."""
+        import copy
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise ValueError(f"unknown deployment {deployment_id!r}")
+        if d.status != DEPLOYMENT_STATUS_RUNNING:
+            raise ValueError("deployment is not running")
+        allocs = [a for a in self.state.allocs()
+                  if a.deployment_id == d.id]
+        nd = copy.deepcopy(d)
+        targets = groups or list(nd.task_groups)
+        for tg_name in targets:
+            st = nd.task_groups.get(tg_name)
+            if st is None:
+                raise ValueError(f"unknown task group {tg_name!r}")
+            if st.desired_canaries <= 0 or st.promoted:
+                continue
+            healthy_canaries = sum(
+                1 for a in allocs
+                if a.task_group == tg_name
+                and a.deployment_status is not None
+                and a.deployment_status.canary
+                and a.deployment_status.is_healthy())
+            if healthy_canaries < st.desired_canaries:
+                raise ValueError(
+                    f"group {tg_name!r}: {healthy_canaries}/"
+                    f"{st.desired_canaries} canaries healthy")
+            st.promoted = True
+        if not self.state.upsert_deployment_cas(nd, d.modify_index):
+            raise ValueError("deployment changed concurrently; retry")
+        job = self.state.job_by_id(nd.namespace, nd.job_id)
+        if job is not None and not job.stop:
+            ev = Evaluation(
+                id=generate_uuid(), namespace=nd.namespace,
+                priority=nd.eval_priority, type=job.type,
+                triggered_by=TRIGGER_DEPLOYMENT_WATCHER,
+                job_id=nd.job_id, deployment_id=nd.id,
+                status=EVAL_STATUS_PENDING)
+            self.state.upsert_evals([ev])
+            self.broker.enqueue(ev)
+        self.publish_event("DeploymentPromoted",
+                           {"deployment_id": nd.id, "groups": targets})
+
     def _watch_deployment(self, d: Deployment) -> None:
         import copy
         allocs = [a for a in self.state.allocs()
@@ -1378,6 +1426,15 @@ class Server:
                     status=EVAL_STATUS_PENDING)
                 self.state.upsert_evals([ev])
                 self.broker.enqueue(ev)
+        # auto_promote: healthy canaries promote without operator action
+        # (reference: deploymentwatcher auto-promotion)
+        cur = self.state.deployment_by_id(d.id)
+        if cur is not None and cur.status == DEPLOYMENT_STATUS_RUNNING \
+                and cur.requires_promotion() and cur.has_auto_promote():
+            try:
+                self.promote_deployment(cur.id)
+            except ValueError:
+                pass            # canaries not healthy yet; retry next tick
 
     def _revert_job(self, d: Deployment) -> None:
         """Auto-revert to the last stable version

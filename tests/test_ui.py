@@ -80,3 +80,20 @@ def test_ui_data_endpoints_shape(http):
     _, _, body = get(http, "/v1/metrics")
     metrics = json.loads(body)
     assert "counters" in metrics and "samples" in metrics
+
+
+def test_metrics_prometheus_format(http):
+    """?format=prometheus renders the text exposition format
+    (reference: go-metrics prometheus sink, command.go:1164-1253)."""
+    status, ctype, body = get(http, "/v1/metrics?format=prometheus")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE nomad_state_index gauge" in text
+    assert "nomad_state_index" in text
+    # counters/samples render when present; lines are "name value"
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, _, value = line.partition(" ")
+        float(value)    # parseable
