@@ -30,12 +30,26 @@ class ApiClient:
 
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  namespace: str = "default", token: str = "",
-                 timeout: float = 10.0, region: str = ""):
+                 timeout: float = 10.0, region: str = "",
+                 ca_cert: str = "", client_cert: str = "",
+                 client_key: str = ""):
+        import os as _os
         self.address = address.rstrip("/")
         self.namespace = namespace
         self.token = token
         self.timeout = timeout
         self.region = region
+        # TLS to an https agent (reference: api/api.go TLSConfig +
+        # NOMAD_CACERT/NOMAD_CLIENT_CERT/NOMAD_CLIENT_KEY env)
+        ca_cert = ca_cert or _os.environ.get("NOMAD_CACERT", "")
+        client_cert = client_cert or _os.environ.get("NOMAD_CLIENT_CERT", "")
+        client_key = client_key or _os.environ.get("NOMAD_CLIENT_KEY", "")
+        self._ssl_ctx = None
+        if self.address.startswith("https"):
+            from ..tlsutil import TLSConfig, client_context
+            self._ssl_ctx = client_context(TLSConfig(
+                ca_file=ca_cert, cert_file=client_cert,
+                key_file=client_key))
 
     # -- low-level -----------------------------------------------------
     def _url(self, path: str, params: Optional[Dict[str, Any]] = None) -> str:
@@ -58,8 +72,7 @@ class ApiClient:
             timeout: Optional[float] = None) -> bytes:
         """Shared urlopen + HTTPError->ApiError translation."""
         try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
+            with urllib.request.urlopen(req, context=self._ssl_ctx, timeout=timeout or self.timeout) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             try:
@@ -330,7 +343,7 @@ class ApiClient:
             f"{self.address}/v1/event/stream?{qs}",
             headers={**({"X-Nomad-Token": self.token}
                         if self.token else {})})
-        resp = urllib.request.urlopen(req)
+        resp = urllib.request.urlopen(req, context=self._ssl_ctx)
         try:
             for line in resp:
                 line = line.strip()

@@ -1,0 +1,110 @@
+"""Agent configuration files (reference:
+/root/reference/command/agent/config_parse.go + config.go defaults/merge):
+HCL config parsed with the in-repo HCL parser, merged over defaults, with
+CLI flags taking final precedence (the reference's merge order).
+
+Supported surface (the operational core):
+
+    region       = "global"
+    datacenter   = "dc1"
+    ports        { http = 4646 }
+    server       { enabled = true  workers = 4  eval_batching = true
+                   batch_width = 8  acl_enabled = false
+                   scheduler_algorithm = "tpu-binpack" }
+    client       { enabled = true  simulated_nodes = 3  data_dir = "..." }
+    tls          { http = true  rpc = true  ca_file = "..."
+                   cert_file = "..."  key_file = "..." }
+
+(prometheus needs no config: /v1/metrics?format=prometheus always serves)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..jobspec.hcl import Block, HclError, parse_hcl
+from ..tlsutil import TLSConfig
+
+
+@dataclass
+class ServerConfig:
+    enabled: bool = True
+    workers: int = 2
+    eval_batching: bool = False
+    batch_width: int = 0
+    acl_enabled: bool = False
+    scheduler_algorithm: str = ""
+
+
+@dataclass
+class ClientConfig:
+    enabled: bool = True
+    simulated_nodes: int = 3
+    real_clients: bool = False
+    data_dir: str = ""
+
+
+@dataclass
+class AgentConfig:
+    region: str = "global"
+    datacenter: str = "dc1"
+    http_port: int = 4646
+    server: ServerConfig = field(default_factory=ServerConfig)
+    client: ClientConfig = field(default_factory=ClientConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
+
+
+def _apply(obj, attrs: Dict[str, Any], mapping: Dict[str, str]) -> None:
+    for key, attr in mapping.items():
+        if key in attrs:
+            setattr(obj, attr, attrs[key])
+
+
+def parse_agent_config(src: str) -> AgentConfig:
+    """Parse one agent config document. Raises HclError/ValueError on
+    malformed input (admission-style: bad config must not half-apply)."""
+    root = parse_hcl(src)
+    cfg = AgentConfig()
+    attrs = root.attrs()
+    _apply(cfg, attrs, {"region": "region", "datacenter": "datacenter"})
+
+    ports = root.first("ports")
+    if ports is not None:
+        p = ports.attrs()
+        if "http" in p:
+            cfg.http_port = int(p["http"])
+
+    srv = root.first("server")
+    if srv is not None:
+        a = srv.attrs()
+        _apply(cfg.server, a, {
+            "enabled": "enabled", "workers": "workers",
+            "eval_batching": "eval_batching", "batch_width": "batch_width",
+            "acl_enabled": "acl_enabled",
+            "scheduler_algorithm": "scheduler_algorithm"})
+        cfg.server.workers = int(cfg.server.workers)
+        cfg.server.batch_width = int(cfg.server.batch_width)
+
+    cli = root.first("client")
+    if cli is not None:
+        a = cli.attrs()
+        _apply(cfg.client, a, {
+            "enabled": "enabled", "simulated_nodes": "simulated_nodes",
+            "real_clients": "real_clients", "data_dir": "data_dir"})
+        cfg.client.simulated_nodes = int(cfg.client.simulated_nodes)
+
+    tls = root.first("tls")
+    if tls is not None:
+        a = tls.attrs()
+        _apply(cfg.tls, a, {
+            "http": "enable_http", "rpc": "enable_rpc",
+            "ca_file": "ca_file", "cert_file": "cert_file",
+            "key_file": "key_file", "verify_incoming": "verify_incoming"})
+        if cfg.tls.any and (not cfg.tls.cert_file or not cfg.tls.key_file):
+            raise ValueError("tls block requires cert_file and key_file")
+    return cfg
+
+
+def load_agent_config(path: str) -> AgentConfig:
+    with open(path, encoding="utf-8") as fh:
+        return parse_agent_config(fh.read())

@@ -32,6 +32,39 @@ def main(argv=None) -> int:
     parser.add_argument("--data-dir", default="",
                         help="client data dir (with --real-clients; "
                              "default: a temp dir)")
+    parser.add_argument("--config", default="",
+                        help="HCL agent config file (reference: "
+                             "command/agent/config_parse.go); CLI flags "
+                             "override file values")
+    parser.add_argument("--eval-batching", action="store_true",
+                        dest="eval_batching",
+                        help="coalesce evals into fused solver dispatches")
+    parser.add_argument("--batch-width", type=int, default=0,
+                        dest="batch_width")
+    parser.add_argument("--datacenter", default="dc1")
+    # config file supplies DEFAULTS; explicitly-passed flags win
+    pre, _ = parser.parse_known_args(argv)
+    tls_cfg = None
+    file_cfg = None
+    if pre.config:
+        from .config import load_agent_config
+        file_cfg = load_agent_config(pre.config)
+        parser.set_defaults(
+            region=file_cfg.region,
+            datacenter=file_cfg.datacenter,
+            port=file_cfg.http_port,
+            workers=file_cfg.server.workers,
+            acl=file_cfg.server.acl_enabled,
+            eval_batching=file_cfg.server.eval_batching,
+            batch_width=file_cfg.server.batch_width,
+            nodes=(file_cfg.client.simulated_nodes
+                   if file_cfg.client.enabled else 0),
+            real_clients=file_cfg.client.real_clients,
+            data_dir=file_cfg.client.data_dir,
+            tpu=(file_cfg.server.scheduler_algorithm
+                 in ("tpu-binpack", "tpu-spread")))
+        if file_cfg.tls.any:
+            tls_cfg = file_cfg.tls
     args = parser.parse_args(argv)
 
     from .. import mock
@@ -41,7 +74,9 @@ def main(argv=None) -> int:
     from .http import HttpServer
 
     server = Server(num_workers=args.workers, acl_enabled=args.acl,
-                    region=args.region)
+                    region=args.region,
+                    eval_batching=args.eval_batching,
+                    batch_width=args.batch_width or None)
     for spec in args.join:
         region, _, addr = spec.partition("=")
         if region and addr:
@@ -65,14 +100,17 @@ def main(argv=None) -> int:
             clients.append(c)
     else:
         for _ in range(args.nodes):
-            c = SimClient(server, mock.node())
+            c = SimClient(server, mock.node(datacenter=args.datacenter))
             c.start()
             clients.append(c)
 
     http = HttpServer(server, port=args.port,
-                      clients=clients if args.real_clients else None)
+                      clients=clients if args.real_clients else None,
+                      tls=tls_cfg)
     http.start()
-    print(f"==> nomad-tpu dev agent: http://127.0.0.1:{http.port} "
+    scheme = "https" if tls_cfg is not None and tls_cfg.enable_http \
+        else "http"
+    print(f"==> nomad-tpu dev agent: {scheme}://127.0.0.1:{http.port} "
           f"({args.nodes} simulated nodes, "
           f"algorithm={server.state.scheduler_config().scheduler_algorithm})")
 

@@ -1392,10 +1392,16 @@ class HttpServer:
     reference reaches them via server->client RPC forwarding)."""
 
     def __init__(self, nomad_server, host: str = "127.0.0.1",
-                 port: int = 4646, clients=None):
+                 port: int = 4646, clients=None, tls=None):
         self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
         self.httpd.nomad_server = nomad_server
         self.httpd.local_clients = list(clients or [])
+        self.tls = tls
+        if tls is not None and tls.enable_http:
+            # (reference: command/agent/http.go TLS listener wrap)
+            from ..tlsutil import server_context
+            self.httpd.socket = server_context(tls).wrap_socket(
+                self.httpd.socket, server_side=True)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import socket
+import ssl
 import struct
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -46,7 +47,16 @@ class TcpTransport:
     """Listener + dispatcher. `register(msg_type, handler)` wires a
     callable(dict) -> dict; `send(addr, msg)` performs one blocking RPC."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tls=None) -> None:
+        # mutual TLS on every server<->server conn when configured
+        # (reference: nomad/rpc.go:31 TLS wrapping of the RPC listener)
+        self.tls = tls if tls is not None and tls.enable_rpc else None
+        self._server_ctx = self._client_ctx = None
+        if self.tls is not None:
+            from ..tlsutil import client_context, server_context
+            self._server_ctx = server_context(self.tls)
+            self._client_ctx = client_context(self.tls)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -91,12 +101,26 @@ class TcpTransport:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # the TLS handshake happens in the per-connection thread
+            # with a timeout: a stalled or plaintext peer must neither
+            # kill nor block the accept loop
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
+            if self._server_ctx is not None:
+                conn.settimeout(10.0)
+                try:
+                    conn = self._server_ctx.wrap_socket(conn,
+                                                        server_side=True)
+                except (ssl.SSLError, OSError):
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return          # reject this peer only
             with conn:
                 conn.settimeout(30.0)
                 while not self._shutdown.is_set():
@@ -121,6 +145,12 @@ class TcpTransport:
             pass
 
     # ------------------------------------------------------------------
+    def _connect(self, addr: Addr, timeout: float):
+        sock = socket.create_connection(addr, timeout=timeout)
+        if self._client_ctx is not None:
+            sock = self._client_ctx.wrap_socket(sock)
+        return sock
+
     def send(self, addr: Addr, msg: dict, timeout: float = 5.0) -> dict:
         """One blocking request/response RPC to `addr`. Reuses a pooled
         connection per peer; a busy pooled conn falls back to an ephemeral
@@ -135,7 +165,7 @@ class TcpTransport:
         if lock.acquire(blocking=False):
             try:
                 if sock is None:
-                    sock = socket.create_connection(addr, timeout=timeout)
+                    sock = self._connect(addr, timeout)
                     with self._pool_lock:
                         self._pool[addr] = (sock, lock)
                 try:
@@ -148,7 +178,7 @@ class TcpTransport:
                         sock.close()
                     except OSError:
                         pass
-                    sock = socket.create_connection(addr, timeout=timeout)
+                    sock = self._connect(addr, timeout)
                     with self._pool_lock:
                         self._pool[addr] = (sock, lock)
                     sock.settimeout(timeout)
@@ -157,7 +187,7 @@ class TcpTransport:
             finally:
                 lock.release()
         # pooled conn busy: ephemeral connection
-        with socket.create_connection(addr, timeout=timeout) as tmp:
+        with self._connect(addr, timeout) as tmp:
             tmp.settimeout(timeout)
             _send_frame(tmp, msg)
             return _recv_frame(tmp)

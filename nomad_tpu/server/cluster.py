@@ -204,9 +204,11 @@ class ClusterServer(Server):
                  data_dir: Optional[str] = None, num_workers: int = 2,
                  heartbeat_ttl: float = 10.0,
                  election_timeout: float = 0.25,
-                 acl_enabled: bool = False):
+                 acl_enabled: bool = False, tls=None):
         self.name = name
-        self.transport = transport or TcpTransport()
+        # mutual TLS on raft RPC when the agent config asks for it
+        # (reference: nomad/rpc.go:31)
+        self.transport = transport or TcpTransport(tls=tls)
         self.data_dir = data_dir
         self.store = StateStore()           # FSM-applied local store
         self.fsm = StateFSM(self.store)
@@ -337,9 +339,10 @@ class ClusterServer(Server):
 # TestJoin :184 -- multi-server clusters in one process)
 
 def make_cluster(n: int, data_dirs: Optional[List[str]] = None,
+                 tls=None,
                  num_workers: int = 1,
                  election_timeout: float = 0.15) -> List[ClusterServer]:
-    transports = [TcpTransport() for _ in range(n)]
+    transports = [TcpTransport(tls=tls) for _ in range(n)]
     peers = {f"server-{i}": t.addr for i, t in enumerate(transports)}
     servers = []
     for i in range(n):

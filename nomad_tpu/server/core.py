@@ -113,6 +113,10 @@ class Server:
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_deadlines: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
+        # serializes drain pacing rounds (API thread vs drainer loop):
+        # both read-compute-mark, so racing ticks could overshoot
+        # migrate.max_parallel
+        self._drain_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
         self._events: List[dict] = []
@@ -134,7 +138,8 @@ class Server:
                          (self._run_gc, "core-gc"),
                          (self._run_periodic, "periodic"),
                          (self._run_deployment_watcher, "deploy-watch"),
-                         (self._run_volume_watcher, "volume-watch")):
+                         (self._run_volume_watcher, "volume-watch"),
+                         (self._run_drainer, "drainer")):
             t = threading.Thread(target=self._supervised, args=(fn, name),
                                  daemon=True, name=name)
             t.start()
@@ -810,24 +815,90 @@ class Server:
             self.broker.enqueue_all(evals)
 
     def drain_node(self, node_id: str, strategy) -> None:
-        """Start/stop a drain: mark the node ineligible, request migration
-        of its allocs, and evaluate affected jobs (reference:
-        nomad/drainer/ NodeDrainer + watch_nodes.go, condensed: the
-        deadline/batched-update machinery collapses because desired
-        transitions commit through the same state API)."""
+        """Start/stop a drain: mark the node ineligible and let the
+        drainer pace migrations per each task group's migrate.max_parallel
+        until the deadline, after which everything remaining force-drains
+        (reference: nomad/drainer/ NodeDrainer + drain_heap.go deadlines
+        + watch_jobs.go per-TG batching)."""
+        if strategy is not None:
+            strategy.started_at = strategy.started_at or time.time()
+            if strategy.deadline_s > 0 and not strategy.force_deadline:
+                strategy.force_deadline = (strategy.started_at
+                                           + strategy.deadline_s)
         self.state.update_node_drain(node_id, strategy,
                                      mark_eligible=strategy is None)
         if strategy is None:
             return
-        alloc_ids = [a.id for a in self.state.allocs_by_node(node_id)
+        self._drain_tick(node_id, strategy)
+        self.publish_event("NodeDrain", {"node_id": node_id})
+
+    def _run_drainer(self) -> None:
+        """(reference: nomad/drainer/drainer.go run loop)"""
+        while not self._shutdown.wait(0.3):
+            if not self._leader_active.is_set():
+                continue
+            for node in self.state.nodes():
+                if node.drain and node.drain_strategy is not None:
+                    self._drain_tick(node.id, node.drain_strategy)
+
+    def _drain_tick(self, node_id: str, strategy) -> None:
+        """One pacing round for a draining node: per (job, tg), mark at
+        most migrate.max_parallel allocs for migration at a time; past
+        the force deadline everything remaining drains at once."""
+        with self._drain_lock:
+            self._drain_tick_locked(node_id, strategy)
+
+    def _drain_tick_locked(self, node_id: str, strategy) -> None:
+        remaining = [a for a in self.state.allocs_by_node(node_id)
                      if not a.terminal_status()
                      and (a.job is None or not strategy.ignore_system_jobs
-                          or a.job.type not in (JOB_TYPE_SYSTEM, "sysbatch"))]
-        if alloc_ids:
-            self.state.update_alloc_desired_transition(alloc_ids,
+                          or a.job.type not in (JOB_TYPE_SYSTEM,
+                                                "sysbatch"))]
+        if not remaining:
+            # drain complete: node stays ineligible, strategy clears
+            # (reference: drainer marks the node done)
+            node = self.state.node_by_id(node_id)
+            if node is not None and node.drain:
+                self.state.update_node_drain(node_id, None,
+                                             mark_eligible=False)
+                self.publish_event("NodeDrainComplete",
+                                   {"node_id": node_id})
+            return
+        forced = (strategy.force_deadline
+                  and time.time() >= strategy.force_deadline)
+        to_mark: List[str] = []
+        by_group: Dict[tuple, List[Allocation]] = {}
+        for a in remaining:
+            by_group.setdefault((a.namespace, a.job_id, a.task_group),
+                                []).append(a)
+        for (ns, job_id, tg_name), allocs in by_group.items():
+            if forced:
+                to_mark.extend(a.id for a in allocs
+                               if not a.desired_transition.migrate)
+                continue
+            job = self.state.job_by_id(ns, job_id)
+            tg = job.lookup_task_group(tg_name) if job is not None else None
+            limit = (tg.migrate.max_parallel
+                     if tg is not None and tg.migrate is not None else 1)
+            # slots busy = this group's allocs anywhere still migrating
+            # (marked but not yet terminal) -- a freed slot means the
+            # migrated alloc stopped (its replacement placed elsewhere)
+            in_flight = sum(
+                1 for a in self.state.allocs_by_job(ns, job_id)
+                if a.task_group == tg_name
+                and a.desired_transition.migrate
+                and not a.terminal_status())
+            room = max(0, limit - in_flight)
+            for a in allocs:
+                if room <= 0:
+                    break
+                if not a.desired_transition.migrate:
+                    to_mark.append(a.id)
+                    room -= 1
+        if to_mark:
+            self.state.update_alloc_desired_transition(to_mark,
                                                        migrate=True)
-        self._create_node_evals(node_id)
-        self.publish_event("NodeDrain", {"node_id": node_id})
+            self._create_node_evals(node_id)
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> None:
         """(reference: node_endpoint.go:1322 UpdateAlloc)"""

@@ -1,0 +1,59 @@
+"""TLS configuration for the HTTP API and server-to-server transport
+(reference: /root/reference/nomad/rpc.go:31 TLS wrapping + helper/tlsutil;
+agent tls{} config block, command/agent/config.go).
+
+Mutual TLS: when a CA is configured, both sides verify peers against it
+(the reference's verify_incoming/verify_outgoing model).
+"""
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class TLSConfig:
+    """(reference: config.TLSConfig -- the tls{} agent block)"""
+
+    enable_http: bool = False
+    enable_rpc: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    verify_incoming: bool = True
+
+    @property
+    def any(self) -> bool:
+        return self.enable_http or self.enable_rpc
+
+
+def server_context(cfg: TLSConfig) -> ssl.SSLContext:
+    """Context for listeners: presents the server cert; requires client
+    certs signed by the CA when verify_incoming."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.cert_file, cfg.key_file)
+    if cfg.ca_file:
+        ctx.load_verify_locations(cfg.ca_file)
+        if cfg.verify_incoming:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_context(cfg: TLSConfig,
+                   server_hostname: Optional[str] = None) -> ssl.SSLContext:
+    """Context for outbound connections: verifies the server against the
+    configured CA and presents our cert (mutual TLS). Without a CA the
+    SYSTEM trust store applies with full hostname verification -- "no CA
+    configured" must never mean "no verification"."""
+    if cfg.ca_file:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(cfg.ca_file)
+        # cluster-internal certs use fixed SANs, not per-host names
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    else:
+        ctx = ssl.create_default_context()
+    if cfg.cert_file:
+        ctx.load_cert_chain(cfg.cert_file, cfg.key_file)
+    return ctx

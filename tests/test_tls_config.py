@@ -1,0 +1,164 @@
+"""TLS on the HTTP API and raft transport + the HCL agent config file
+(reference: nomad/rpc.go:31 TLS wrapping, command/agent/config_parse.go;
+VERDICT r2 missing #8)."""
+import os
+import ssl
+import subprocess
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.config import parse_agent_config
+from nomad_tpu.api.http import HttpServer
+from nomad_tpu.server import Server
+from nomad_tpu.tlsutil import TLSConfig, client_context
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed CA + a cert it signs, via the openssl CLI."""
+    d = tmp_path_factory.mktemp("tls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    srv_key, srv_csr, srv_crt = d / "srv.key", d / "srv.csr", d / "srv.crt"
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", "/CN=nomad-tpu-test-ca")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(srv_key), "-out", str(srv_csr),
+        "-subj", "/CN=server.global.nomad")
+    run("openssl", "x509", "-req", "-in", str(srv_csr),
+        "-CA", str(ca_crt), "-CAkey", str(ca_key), "-CAcreateserial",
+        "-out", str(srv_crt), "-days", "1")
+    return {"ca": str(ca_crt), "cert": str(srv_crt), "key": str(srv_key)}
+
+
+def tls_config(certs, **kw):
+    return TLSConfig(ca_file=certs["ca"], cert_file=certs["cert"],
+                     key_file=certs["key"], **kw)
+
+
+def test_https_api_end_to_end(certs):
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    cfg = tls_config(certs, enable_http=True)
+    http = HttpServer(server, port=0, tls=cfg)
+    http.start()
+    try:
+        n = mock.node()
+        n.compute_class()
+        server.register_node(n)
+        ctx = client_context(cfg)
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{http.port}/v1/nodes",
+                context=ctx, timeout=5) as r:
+            assert r.status == 200
+        # plain TLS without a client cert: rejected (mutual TLS)
+        bare = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        bare.check_hostname = False
+        bare.verify_mode = ssl.CERT_NONE
+        with pytest.raises((urllib.error.URLError, ssl.SSLError, OSError)):
+            urllib.request.urlopen(
+                f"https://127.0.0.1:{http.port}/v1/nodes",
+                context=bare, timeout=5).read()
+    finally:
+        http.shutdown()
+        server.shutdown()
+
+
+def test_api_client_speaks_tls(certs):
+    from nomad_tpu.api.client import ApiClient
+
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    cfg = tls_config(certs, enable_http=True)
+    http = HttpServer(server, port=0, tls=cfg)
+    http.start()
+    try:
+        api = ApiClient(f"https://127.0.0.1:{http.port}",
+                        ca_cert=certs["ca"], client_cert=certs["cert"],
+                        client_key=certs["key"])
+        assert api.get("/v1/agent/health")["server"]["ok"]
+    finally:
+        http.shutdown()
+        server.shutdown()
+
+
+def test_raft_transport_tls(certs):
+    from nomad_tpu.raft.transport import TcpTransport
+
+    cfg = tls_config(certs, enable_rpc=True)
+    a = TcpTransport(port=0, tls=cfg)
+    b = TcpTransport(port=0, tls=cfg)
+    a.register("ping", lambda msg: {"pong": msg["n"]})
+    a.start()
+    b.start()
+    try:
+        assert b.send(a.addr, {"type": "ping", "n": 7}) == {"pong": 7}
+        # a non-TLS peer can't talk to a TLS listener: either the send
+        # errors out, or whatever comes back is NOT a valid reply --
+        # assert OUTSIDE the except so a regression can actually fail
+        plain = TcpTransport(port=0)
+        got_pong = False
+        try:
+            reply = plain.send(a.addr, {"type": "ping", "n": 1},
+                               timeout=2.0)
+            got_pong = reply == {"pong": 1}
+        except Exception:  # noqa: BLE001 -- rejection is the success case
+            pass
+        finally:
+            plain.shutdown()
+        assert not got_pong, "plaintext peer spoke to a TLS raft listener"
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_agent_config_parse_and_defaults():
+    cfg = parse_agent_config("""
+region     = "emea"
+datacenter = "dc2"
+ports { http = 5757 }
+server {
+  enabled             = true
+  workers             = 7
+  eval_batching       = true
+  batch_width         = 16
+  scheduler_algorithm = "tpu-binpack"
+}
+client { simulated_nodes = 9 }
+""")
+    assert cfg.region == "emea"
+    assert cfg.datacenter == "dc2"
+    assert cfg.http_port == 5757
+    assert cfg.server.workers == 7
+    assert cfg.server.eval_batching and cfg.server.batch_width == 16
+    assert cfg.server.scheduler_algorithm == "tpu-binpack"
+    assert cfg.client.simulated_nodes == 9
+    # defaults when absent
+    empty = parse_agent_config("")
+    assert empty.region == "global" and empty.http_port == 4646
+
+
+def test_agent_config_tls_requires_cert():
+    with pytest.raises(ValueError, match="cert_file"):
+        parse_agent_config('tls { http = true ca_file = "x" }')
+
+
+def test_agent_config_tls_block(certs):
+    cfg = parse_agent_config(f"""
+tls {{
+  http      = true
+  rpc       = true
+  ca_file   = "{certs['ca']}"
+  cert_file = "{certs['cert']}"
+  key_file  = "{certs['key']}"
+}}
+""")
+    assert cfg.tls.enable_http and cfg.tls.enable_rpc
+    assert cfg.tls.ca_file == certs["ca"]
