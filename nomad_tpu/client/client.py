@@ -105,10 +105,16 @@ class Client:
     def __init__(self, conn: ServerConn, data_dir: str,
                  node: Optional[Node] = None, name: str = "",
                  drivers: Optional[DriverRegistry] = None,
-                 probe_jax: bool = False, identity_signer=None):
+                 probe_jax: bool = False, identity_signer=None,
+                 device_plugins=None):
         self.conn = conn
         self.data_dir = data_dir
         self.drivers = drivers or DriverRegistry()
+        # device plugins feed node devices (reference: devicemanager)
+        self.device_manager = None
+        if device_plugins:
+            from ..plugins.device import DeviceManager
+            self.device_manager = DeviceManager(device_plugins)
         self.state_db = StateDB(data_dir)
         if identity_signer is None:
             def identity_signer(claims, _c=conn):
@@ -123,6 +129,9 @@ class Client:
             self.node.drivers[dname] = DriverInfo(
                 detected=bool(fp.get("detected")),
                 healthy=bool(fp.get("healthy")))
+        if self.device_manager is not None:
+            self.node.node_resources.devices.extend(
+                self.device_manager.all_devices())
         self.node.compute_class()
         # restore node identity across restarts
         prev = self.state_db.node_id()
@@ -160,6 +169,10 @@ class Client:
             runners = list(self.runners.values())
         for r in runners:
             r.stop(timeout=2.0)
+        # plugin subprocesses must not outlive the client
+        if self.device_manager is not None:
+            self.device_manager.shutdown()
+        self.drivers.shutdown()
 
     # -- fault injection (parity with SimClient for tests) -------------
     def freeze(self) -> None:

@@ -531,14 +531,35 @@ class DriverRegistry:
     """Per-client driver instances (reference: client/pluginmanager/
     drivermanager -- instance lifecycle + fingerprint aggregation)."""
 
-    def __init__(self, enabled: Optional[List[str]] = None):
+    def __init__(self, enabled: Optional[List[str]] = None,
+                 external: Optional[List[List[str]]] = None):
         all_drivers = {d.name: d for d in
                        (MockDriver(), RawExecDriver(), ExecDriver(),
                         ContainerDriver())}
         if enabled is not None:
             all_drivers = {k: v for k, v in all_drivers.items()
                            if k in enabled}
+        # out-of-process plugins (reference: plugins/base go-plugin
+        # subprocesses); a plugin that fails its handshake is skipped --
+        # never fatal to the client, but always diagnosed
+        for argv in external or []:
+            try:
+                from ..plugins.driver import ExternalDriver
+                drv = ExternalDriver(argv)
+                all_drivers[drv.name] = drv
+            except Exception as e:  # noqa: BLE001
+                import sys
+                print(f"[nomad-tpu] external driver plugin {argv!r} "
+                      f"failed to start: {e}", file=sys.stderr)
         self._drivers = all_drivers
+
+    def shutdown(self) -> None:
+        """Stop plugin subprocesses (in-process drivers have no-op
+        shutdowns)."""
+        for d in self._drivers.values():
+            stop = getattr(d, "shutdown", None)
+            if stop is not None:
+                stop()
 
     def get(self, name: str) -> Driver:
         d = self._drivers.get(name)

@@ -11,6 +11,7 @@ parse.py maps onto Job structs.
 """
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -135,6 +136,43 @@ def _unquote(text: str, line: int) -> str:
 # ---------------------------------------------------------------------------
 # parser
 
+def _fn_format(fmt, *args):
+    """HCL2 format(): %s/%d/%v/%q/%.Nf via Python's printf."""
+    out = str(fmt).replace("%v", "%s").replace("%q", '"%s"')
+    return out % tuple(args)
+
+
+# the HCL2 stdlib subset jobspecs actually use
+# (reference: jobspec2/types.variables.go + hcl2 ext stdlib funcs)
+FUNCTIONS: Dict[str, Any] = {
+    "upper": lambda s: str(s).upper(),
+    "lower": lambda s: str(s).lower(),
+    "title": lambda s: str(s).title(),
+    "trimspace": lambda s: str(s).strip(),
+    "format": _fn_format,
+    "join": lambda sep, xs: str(sep).join(str(x) for x in xs),
+    "split": lambda sep, s: str(s).split(str(sep)),
+    "replace": lambda s, a, b: str(s).replace(str(a), str(b)),
+    "substr": lambda s, off, n: str(s)[int(off):int(off) + int(n)],
+    "length": lambda x: len(x),
+    "concat": lambda *ls: [x for sub in ls for x in sub],
+    "contains": lambda xs, v: v in xs,
+    "min": lambda *xs: min(xs),
+    "max": lambda *xs: max(xs),
+    "abs": lambda x: abs(x),
+    "ceil": lambda x: math.ceil(float(x)),
+    "floor": lambda x: math.floor(float(x)),
+    "coalesce": lambda *xs: next((x for x in xs
+                                  if x is not None and x != ""), None),
+    "tostring": lambda x: str(x),
+    "tonumber": lambda x: float(x) if "." in str(x) else int(x),
+    "keys": lambda m: sorted(m.keys()),
+    "values": lambda m: [m[k] for k in sorted(m.keys())],
+    "merge": lambda *ms: {k: v for m in ms for k, v in m.items()},
+    "range": lambda *a: list(range(*(int(x) for x in a))),
+}
+
+
 class Parser:
     def __init__(self, tokens: List[Token],
                  variables: Optional[Dict[str, Any]] = None):
@@ -208,6 +246,9 @@ class Parser:
                 return False
             if t.value == "null":
                 return None
+            nxt = self.peek()
+            if nxt.kind == "punct" and nxt.value == "(":
+                return self._parse_call(t.value, t.line)
             return self._resolve_ref(t.value, t.line)
         if t.kind == "punct" and t.value == "[":
             return self._parse_list()
@@ -248,6 +289,30 @@ class Parser:
             if self.peek().kind == "punct" and self.peek().value == ",":
                 self.next()
 
+    def _parse_call(self, name: str, line: int) -> Any:
+        """HCL2 function call (reference: jobspec2's hcl2 stdlib)."""
+        self.next()                                 # consume '('
+        args: List[Any] = []
+        while True:
+            self.skip_newlines()
+            t = self.peek()
+            if t.kind == "punct" and t.value == ")":
+                self.next()
+                break
+            args.append(self.parse_expr())
+            self.skip_newlines()
+            if self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+        fn = FUNCTIONS.get(name)
+        if fn is None:
+            raise HclError(f"unknown function {name!r}", line)
+        try:
+            return fn(*args)
+        except HclError:
+            raise
+        except Exception as e:  # noqa: BLE001 -- user input
+            raise HclError(f"{name}(): {e}", line)
+
     # -- references & interpolation ------------------------------------
     def _resolve_ref(self, path: str, line: int) -> Any:
         if path.startswith("var."):
@@ -264,10 +329,12 @@ class Parser:
         return path
 
     _INTERP_RE = re.compile(r"\$\{(var|local)\.([A-Za-z0-9_\-]+)\}")
+    _INTERP_EXPR_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*\([^{}]*\))\}")
 
     def _interp(self, s: str, line: int) -> str:
-        """Substitute ${var.x}/${local.x}; other ${...} (NOMAD_*, node.*,
-        attr.*) are runtime interpolations and pass through verbatim."""
+        """Substitute ${var.x}/${local.x} and parse-time function calls
+        like ${upper(var.x)}; other ${...} (NOMAD_*, node.*, attr.*) are
+        runtime interpolations and pass through verbatim."""
 
         def repl(m: re.Match) -> str:
             name = m.group(2)
@@ -275,7 +342,31 @@ class Parser:
                 return str(self.variables[name])
             raise HclError(f"undefined variable {name!r}", line)
 
-        return self._INTERP_RE.sub(repl, s)
+        s = self._INTERP_RE.sub(repl, s)
+
+        def repl_fn(m: re.Match) -> str:
+            inner = m.group(1)
+            fname = inner.split("(", 1)[0]
+            if fname not in FUNCTIONS:
+                return m.group(0)     # not ours: runtime interpolation
+            # every identifier argument must be a parse-time value
+            # (var./local./literal); runtime refs like NOMAD_* or node.*
+            # must pass through VERBATIM, not evaluate to their own name
+            toks = tokenize(inner)
+            for k, tok in enumerate(toks):
+                if tok.kind != "ident":
+                    continue
+                nxt = toks[k + 1] if k + 1 < len(toks) else None
+                is_call = (nxt is not None and nxt.kind == "punct"
+                           and nxt.value == "(")
+                if is_call or tok.value in ("true", "false", "null") \
+                        or tok.value.startswith(("var.", "local.")):
+                    continue
+                return m.group(0)     # runtime reference: untouched
+            sub = Parser(toks, variables=self.variables)
+            return str(sub.parse_expr())
+
+        return self._INTERP_EXPR_RE.sub(repl_fn, s)
 
 
 def parse_hcl(src: str, variables: Optional[Dict[str, Any]] = None

@@ -1,0 +1,6 @@
+"""Plugin framework: out-of-process drivers and device plugins over a
+handshaked stdio JSON-RPC boundary (reference: /root/reference/plugins/
+-- go-plugin subprocesses, base/plugin.go:12)."""
+from .base import MAGIC_ENV, MAGIC_VALUE, PluginClient, PluginError, serve  # noqa: F401
+from .device import DeviceManager, DevicePluginClient  # noqa: F401
+from .driver import ExternalDriver  # noqa: F401

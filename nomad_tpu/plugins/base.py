@@ -1,0 +1,201 @@
+"""Plugin subprocess boundary: out-of-process drivers and device plugins.
+
+Semantic parity with /root/reference/plugins/base/plugin.go (go-plugin
+handshake: magic-cookie env + protocol version, plugin.go:12-40) and the
+dispense model. Where the reference speaks gRPC over a unix socket to a
+go-plugin subprocess, this boundary speaks length-prefixed JSON-RPC over
+the child's stdio -- same isolation property (third-party plugin code
+runs in its own process and cannot crash the agent), no extra deps.
+
+Wire format: 4-byte big-endian length + JSON object per message.
+Requests: {"id": n, "method": str, "params": {...}}
+Replies:  {"id": n, "result": ...} or {"id": n, "error": str}
+
+Handshake (reference: base.proto Handshake): the agent sets
+NOMAD_TPU_PLUGIN_MAGIC in the child env; the plugin's first message must
+be {"handshake": {"magic": ..., "proto": 1, "type": "driver"|"device",
+"name": ...}} or the agent kills it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+MAGIC_ENV = "NOMAD_TPU_PLUGIN_MAGIC"
+MAGIC_VALUE = "nomad-tpu-plugin-7f1c"
+PROTO_VERSION = 1
+
+
+def _write_msg(fh, obj: dict) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    fh.write(struct.pack(">I", len(data)) + data)
+    fh.flush()
+
+
+def _read_msg(fh) -> Optional[dict]:
+    head = fh.read(4)
+    if len(head) < 4:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > 64 << 20:
+        return None
+    data = fh.read(n)
+    if len(data) < n:
+        return None
+    return json.loads(data)
+
+
+class PluginError(Exception):
+    pass
+
+
+class PluginClient:
+    """Agent-side handle to one plugin subprocess (reference:
+    plugins/base plugin client + go-plugin reattach/kill lifecycle)."""
+
+    def __init__(self, argv: List[str], plugin_type: str,
+                 env: Optional[Dict[str, str]] = None,
+                 handshake_timeout: float = 10.0):
+        self.argv = list(argv)
+        self.plugin_type = plugin_type
+        self.name = ""
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.proc = subprocess.Popen(
+            self.argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env={**os.environ, **(env or {}), MAGIC_ENV: MAGIC_VALUE},
+            start_new_session=True)
+        self._handshake(handshake_timeout)
+
+    def _handshake(self, timeout: float) -> None:
+        result: Dict[str, Any] = {}
+
+        def read():
+            result["msg"] = _read_msg(self.proc.stdout)
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout)
+        msg = result.get("msg")
+        hs = (msg or {}).get("handshake") or {}
+        if (not t.is_alive() and msg is not None
+                and hs.get("magic") == MAGIC_VALUE
+                and hs.get("proto") == PROTO_VERSION
+                and hs.get("type") == self.plugin_type):
+            self.name = str(hs.get("name", ""))
+            return
+        self.kill()
+        raise PluginError(
+            f"plugin handshake failed for {self.argv[0]!r}: {msg!r}")
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def _recv(self, timeout: float) -> Optional[dict]:
+        """Frame read with a REAL deadline (select on the pipe): a hung
+        plugin must not wedge the calling task-runner thread."""
+        import select
+        import time as _t
+
+        fd = self.proc.stdout.fileno()
+        buf = b""
+        deadline = _t.monotonic() + timeout
+        want = 4
+        length: Optional[int] = None
+        while True:
+            remaining = deadline - _t.monotonic()
+            if remaining <= 0:
+                raise PluginError(f"plugin rpc timed out after {timeout}s")
+            ready, _, _ = select.select([fd], [], [], min(remaining, 1.0))
+            if not ready:
+                continue
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                return None
+            buf += chunk
+            if length is None and len(buf) >= 4:
+                (length,) = struct.unpack(">I", buf[:4])
+                if length > 64 << 20:
+                    raise PluginError("plugin frame too large")
+                want = 4 + length
+            if length is not None and len(buf) >= want:
+                return json.loads(buf[4:want])
+
+    def call(self, method: str, timeout: float = 30.0, **params) -> Any:
+        """One blocking RPC with a deadline. Any protocol failure
+        (timeout, desync, oversized frame, io error) KILLS the plugin so
+        the supervisor's liveness check triggers a clean restart -- a
+        poisoned stream can never wedge the boundary."""
+        with self._lock:
+            if not self.alive():
+                raise PluginError("plugin process is dead")
+            self._next_id += 1
+            rid = self._next_id
+            try:
+                _write_msg(self.proc.stdin,
+                           {"id": rid, "method": method, "params": params})
+                reply = self._recv(timeout)
+            except PluginError:
+                self.kill()
+                raise
+            except (OSError, ValueError) as e:
+                self.kill()
+                raise PluginError(f"plugin io error: {e}") from e
+            if reply is not None and reply.get("id") != rid:
+                self.kill()
+                raise PluginError(f"plugin protocol desync: {reply!r}")
+        if reply is None:
+            raise PluginError("plugin closed its pipe")
+        if "error" in reply:
+            raise PluginError(str(reply["error"]))
+        return reply.get("result")
+
+    def kill(self) -> None:
+        import signal
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        try:
+            self.proc.wait(5.0)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def serve(handlers: Dict[str, Any], plugin_type: str, name: str) -> None:
+    """Plugin-side main loop: verify the magic cookie env, emit the
+    handshake, then answer RPCs until stdin closes
+    (reference: the plugin half of go-plugin's Serve)."""
+    import sys
+
+    if os.environ.get(MAGIC_ENV) != MAGIC_VALUE:
+        print("this binary is a nomad-tpu plugin and must be launched "
+              "by the agent", file=sys.stderr)
+        raise SystemExit(1)
+    out = sys.stdout.buffer
+    inp = sys.stdin.buffer
+    _write_msg(out, {"handshake": {
+        "magic": MAGIC_VALUE, "proto": PROTO_VERSION,
+        "type": plugin_type, "name": name}})
+    while True:
+        msg = _read_msg(inp)
+        if msg is None:
+            return
+        rid = msg.get("id")
+        method = msg.get("method", "")
+        handler = handlers.get(method)
+        if handler is None:
+            _write_msg(out, {"id": rid, "error": f"no method {method!r}"})
+            continue
+        try:
+            result = handler(**(msg.get("params") or {}))
+            _write_msg(out, {"id": rid, "result": result})
+        except Exception as e:  # noqa: BLE001 -- plugin must not die
+            _write_msg(out, {"id": rid,
+                             "error": f"{type(e).__name__}: {e}"})

@@ -1,0 +1,105 @@
+"""Device plugins: out-of-process device discovery + reservation
+(reference: /root/reference/plugins/device -- Fingerprint/Reserve/Stats
+over go-plugin gRPC, proto/device.proto; here over plugins/base JSON-RPC).
+
+A device plugin reports device groups that land in the node's
+NodeResources.devices (feeding the scheduler's dense device tables), and
+Reserve() returns the env vars / mounts a task needs to use the reserved
+instances (the reference's ContainerReservation)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..structs import NodeDeviceResource
+from .base import PluginClient, PluginError
+
+
+class DevicePluginClient:
+    """Agent-side handle to one device plugin."""
+
+    def __init__(self, argv: List[str]):
+        self.argv = list(argv)
+        self._lock = threading.Lock()
+        self._client = PluginClient(argv, "device")
+        self.name = self._client.name or "device"
+
+    def _rpc(self, method: str, **params):
+        with self._lock:
+            if not self._client.alive():
+                self._client.kill()
+                self._client = PluginClient(self.argv, "device")
+        return self._client.call(method, **params)
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        """-> device groups for NodeResources.devices
+        (reference: device.proto FingerprintResponse)."""
+        try:
+            groups = self._rpc("fingerprint") or []
+        except PluginError:
+            return []
+        out = []
+        for g in groups:
+            out.append(NodeDeviceResource(
+                vendor=str(g.get("vendor", "")),
+                type=str(g.get("type", "")),
+                name=str(g.get("name", "")),
+                instance_ids=[str(i) for i in g.get("instance_ids", [])],
+                attributes=dict(g.get("attributes", {}))))
+        return out
+
+    def reserve(self, instance_ids: List[str]) -> Dict[str, object]:
+        """-> {"envs": {...}, "mounts": [...], "devices": [...]}
+        (reference: device.proto ReserveResponse ContainerReservation)."""
+        return self._rpc("reserve", instance_ids=list(instance_ids)) or {}
+
+    def stats(self) -> List[dict]:
+        try:
+            return self._rpc("stats") or []
+        except PluginError:
+            return []
+
+    def shutdown(self) -> None:
+        self._client.kill()
+
+
+class DeviceManager:
+    """Aggregates device plugins into the node fingerprint (reference:
+    client/devicemanager)."""
+
+    def __init__(self, plugin_argvs: Optional[List[List[str]]] = None):
+        self.plugins: List[DevicePluginClient] = []
+        # (vendor, type, name) -> owning plugin, filled by all_devices();
+        # reserve() is on the placement hot path and must not re-RPC
+        # every plugin to find the owner
+        self._owners: Dict[tuple, DevicePluginClient] = {}
+        for argv in plugin_argvs or []:
+            try:
+                self.plugins.append(DevicePluginClient(argv))
+            except PluginError as e:
+                import sys
+                print(f"[nomad-tpu] device plugin {argv!r} failed: {e}",
+                      file=sys.stderr)
+
+    def all_devices(self) -> List[NodeDeviceResource]:
+        out: List[NodeDeviceResource] = []
+        for p in self.plugins:
+            for g in p.fingerprint():
+                self._owners[(g.vendor, g.type, g.name)] = p
+                out.append(g)
+        return out
+
+    def reserve(self, group: NodeDeviceResource,
+                instance_ids: List[str]) -> Dict[str, object]:
+        key = (group.vendor, group.type, group.name)
+        owner = self._owners.get(key)
+        if owner is None:
+            self.all_devices()          # refresh the owner map once
+            owner = self._owners.get(key)
+        if owner is None:
+            return {}
+        return owner.reserve(instance_ids)
+
+    def shutdown(self) -> None:
+        for p in self.plugins:
+            p.shutdown()

@@ -1,0 +1,123 @@
+"""External task drivers: the DriverPlugin interface over the subprocess
+boundary (reference: /root/reference/plugins/drivers/driver.go:51
+DriverPlugin -- Fingerprint/StartTask/WaitTask/StopTask/InspectTask over
+go-plugin gRPC; here the same methods over plugins/base JSON-RPC).
+
+The agent-side `ExternalDriver` satisfies the in-process Driver contract
+(client/drivers.py), so alloc/task runners use external plugins
+transparently. Reattach survives AGENT restarts: the plugin owns the task
+processes, and the handle carries enough state for the plugin (relaunched
+by the manager) to recover by pid, exactly like the reference's executor
+reattach."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..client.drivers import (
+    Driver, DriverError, ExitResult, TaskHandle, TASK_STATE_DEAD,
+)
+from ..structs import Task
+from .base import PluginClient, PluginError
+
+
+class ExternalDriver(Driver):
+    """One external driver plugin, supervised: a dead plugin process is
+    relaunched and reports unhealthy until the restart lands (reference:
+    client/pluginmanager/drivermanager instance lifecycle)."""
+
+    def __init__(self, argv: List[str], name: Optional[str] = None):
+        self.argv = list(argv)
+        self._lock = threading.Lock()
+        self._client: Optional[PluginClient] = None
+        self._client = PluginClient(argv, "driver")
+        self.name = name or self._client.name or "external"
+
+    # -- supervision ----------------------------------------------------
+    def _rpc(self, method: str, **params):
+        with self._lock:
+            client = self._client
+            if client is None or not client.alive():
+                client = self._restart_locked()
+        return client.call(method, **params)
+
+    def _restart_locked(self) -> PluginClient:
+        if self._client is not None:
+            self._client.kill()
+        self._client = PluginClient(self.argv, "driver")
+        return self._client
+
+    def healthy(self) -> bool:
+        with self._lock:
+            return self._client is not None and self._client.alive()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._client is not None:
+                self._client.kill()
+
+    # -- DriverPlugin surface ------------------------------------------
+    def fingerprint(self) -> Dict[str, object]:
+        try:
+            fp = self._rpc("fingerprint")
+        except PluginError:
+            return {"detected": True, "healthy": False, "attributes": {}}
+        return {"detected": bool(fp.get("detected", True)),
+                "healthy": bool(fp.get("healthy", True)),
+                "attributes": dict(fp.get("attributes", {}))}
+
+    def start_task(self, task_id: str, task: Task, env: Dict[str, str],
+                   task_dir) -> TaskHandle:
+        try:
+            res = self._rpc(
+                "start_task", task_id=task_id, config=task.config or {},
+                env=dict(env),
+                task_dir=(task_dir.dir if task_dir is not None else ""),
+                stdout=(task_dir.stdout_path() if task_dir else ""),
+                stderr=(task_dir.stderr_path() if task_dir else ""))
+        except PluginError as e:
+            raise DriverError(str(e)) from e
+        return TaskHandle(task_id=task_id, driver=self.name,
+                          pid=int(res.get("pid", 0)),
+                          started_at=time.time(),
+                          driver_state=dict(res.get("state", {})))
+
+    def wait_task(self, handle: TaskHandle,
+                  timeout: Optional[float] = None) -> Optional[ExitResult]:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            try:
+                res = self._rpc("wait_task", task_id=handle.task_id,
+                                timeout_s=2.0,
+                                timeout=10.0)
+            except PluginError as e:
+                return ExitResult(err=str(e))
+            if res is not None:
+                return ExitResult(exit_code=int(res.get("exit_code", 0)),
+                                  signal=int(res.get("signal", 0)),
+                                  err=str(res.get("err", "")))
+            if deadline is not None and time.time() >= deadline:
+                return None
+
+    def stop_task(self, handle: TaskHandle, kill_timeout: float = 5.0) -> None:
+        try:
+            self._rpc("stop_task", task_id=handle.task_id,
+                      kill_timeout=kill_timeout,
+                      timeout=kill_timeout + 10.0)
+        except PluginError:
+            pass
+
+    def inspect_task(self, handle: TaskHandle) -> str:
+        try:
+            return str(self._rpc("inspect_task", task_id=handle.task_id))
+        except PluginError:
+            return TASK_STATE_DEAD
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        try:
+            return bool(self._rpc("recover_task", task_id=handle.task_id,
+                                  pid=handle.pid,
+                                  state=handle.driver_state))
+        except PluginError:
+            return False

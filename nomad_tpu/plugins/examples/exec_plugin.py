@@ -1,0 +1,161 @@
+"""Example external driver plugin: exec-style task runner as a separate
+process (reference analog: any third-party task driver served via
+go-plugin, plugins/drivers/driver.go:51). Launch via the agent; running
+it by hand prints the go-plugin-style cookie error.
+
+Run: python -m nomad_tpu.plugins.examples.exec_plugin
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict
+
+from ..base import serve
+
+_procs: Dict[str, subprocess.Popen] = {}
+_recovered: Dict[str, int] = {}     # task_id -> reattached pid
+_results: Dict[str, dict] = {}
+_lock = threading.Lock()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def fingerprint():
+    return {"detected": True, "healthy": True,
+            "attributes": {"driver.plugin_exec.version": "1.0"}}
+
+
+def start_task(task_id, config, env, task_dir, stdout, stderr):
+    command = str(config.get("command", ""))
+    if not command:
+        raise ValueError("plugin_exec requires config.command")
+    args = [str(a) for a in config.get("args", [])]
+    out = open(stdout, "ab") if stdout else subprocess.DEVNULL
+    err = open(stderr, "ab") if stderr else subprocess.DEVNULL
+    try:
+        proc = subprocess.Popen(
+            [command] + args, env={**os.environ, **env},
+            cwd=task_dir or None, stdout=out, stderr=err,
+            start_new_session=True)
+    finally:
+        for fh in (out, err):
+            if hasattr(fh, "close"):
+                fh.close()
+    with _lock:
+        _procs[task_id] = proc
+    return {"pid": proc.pid, "state": {"pid": proc.pid}}
+
+
+def wait_task(task_id, timeout_s=2.0):
+    with _lock:
+        proc = _procs.get(task_id)
+        rec_pid = _recovered.get(task_id)
+    if proc is None and rec_pid is not None:
+        # reattached after a plugin restart: the task is not our child,
+        # so poll liveness; the true exit status is lost (same contract
+        # as a crashed reference executor)
+        deadline = time.time() + float(timeout_s)
+        while time.time() < deadline:
+            if not _pid_alive(rec_pid):
+                return {"exit_code": 0,
+                        "err": "exit status unknown "
+                               "(recovered after plugin restart)"}
+            time.sleep(0.05)
+        return None
+    if proc is None:
+        return _results.get(task_id, {"exit_code": 0,
+                                      "err": "unknown task"})
+    try:
+        code = proc.wait(timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    result = ({"exit_code": code} if code >= 0
+              else {"exit_code": 0, "signal": -code})
+    with _lock:
+        _results[task_id] = result
+    return result
+
+
+def stop_task(task_id, kill_timeout=5.0):
+    with _lock:
+        proc = _procs.get(task_id)
+        rec_pid = _recovered.get(task_id)
+    if proc is None and rec_pid is not None:
+        try:
+            os.killpg(os.getpgid(rec_pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        deadline = time.time() + kill_timeout
+        while time.time() < deadline and _pid_alive(rec_pid):
+            time.sleep(0.05)
+        if _pid_alive(rec_pid):
+            try:
+                os.killpg(os.getpgid(rec_pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return True
+    if proc is None or proc.poll() is not None:
+        return True
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        pass
+    deadline = time.time() + kill_timeout
+    while time.time() < deadline and proc.poll() is None:
+        time.sleep(0.05)
+    if proc.poll() is None:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    return True
+
+
+def inspect_task(task_id):
+    with _lock:
+        proc = _procs.get(task_id)
+        rec_pid = _recovered.get(task_id)
+    if proc is not None:
+        return "dead" if proc.poll() is not None else "running"
+    if rec_pid is not None:
+        return "running" if _pid_alive(rec_pid) else "dead"
+    return "dead"
+
+
+def recover_task(task_id, pid, state):
+    """After a plugin restart the Popen handle is gone; re-attach by pid
+    and TRACK it so wait/inspect/stop keep working (the task process
+    itself survived, reference: executor reattach)."""
+    pid = int(state.get("pid", pid) or 0)
+    if not pid or not _pid_alive(pid):
+        return False
+    with _lock:
+        _recovered[task_id] = pid
+    return True
+
+
+def main() -> None:
+    serve({
+        "fingerprint": fingerprint,
+        "start_task": start_task,
+        "wait_task": wait_task,
+        "stop_task": stop_task,
+        "inspect_task": inspect_task,
+        "recover_task": recover_task,
+    }, plugin_type="driver", name="plugin_exec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Example device plugin: a fake accelerator vendor
+(reference analog: a GPU device plugin over go-plugin,
+plugins/device/proto/device.proto).
+
+Run: python -m nomad_tpu.plugins.examples.fake_device_plugin
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import serve
+
+N = int(os.environ.get("FAKE_DEVICE_COUNT", "4"))
+IDS = [f"fake-tpu-{i}" for i in range(N)]
+
+
+def fingerprint():
+    return [{
+        "vendor": "examplecorp", "type": "tpu", "name": "v0",
+        "instance_ids": IDS,
+        "attributes": {"memory_gb": 16, "cores": 2},
+    }]
+
+
+def reserve(instance_ids):
+    unknown = [i for i in instance_ids if i not in IDS]
+    if unknown:
+        raise ValueError(f"unknown instances: {unknown}")
+    return {
+        "envs": {"FAKE_TPU_VISIBLE_DEVICES": ",".join(instance_ids)},
+        "mounts": [],
+        "devices": [f"/dev/fake-tpu/{i}" for i in instance_ids],
+    }
+
+
+def stats():
+    return [{"instance_id": i, "utilization": 0.0} for i in IDS]
+
+
+def main() -> None:
+    serve({"fingerprint": fingerprint, "reserve": reserve,
+           "stats": stats}, plugin_type="device", name="fake-tpu")
+
+
+if __name__ == "__main__":
+    main()

@@ -348,3 +348,64 @@ def test_cli_end_to_end(agent, capsys, tmp_path):
     assert cli_main(["-address", addr, "var", "purge", "app/config"]) == 0
     capsys.readouterr()
     c.stop()
+
+
+def test_hcl2_functions():
+    """HCL2 stdlib functions in jobspecs (reference: jobspec2's hcl2
+    function table, jobspec2/parse.go; VERDICT r2 layer 13 partial)."""
+    from nomad_tpu.jobspec import parse
+
+    job = parse("""
+variable "env" { default = "prod" }
+variable "dcs" { default = ["dc1"] }
+job "fn-job" {
+  datacenters = concat(var.dcs, ["dc2"])
+  meta {
+    env_u    = upper(var.env)
+    banner   = format("svc-%s-%d", var.env, 3)
+    joined   = join(",", ["a", "b", "c"])
+    short    = substr("abcdefgh", 2, 3)
+    via_tpl  = "name=${upper(var.env)}"
+    runtime  = "${NOMAD_TASK_DIR}/x"
+  }
+  group "g" {
+    count = max(2, length(var.dcs))
+    task "t" {
+      driver = "mock"
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+""")
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.meta["env_u"] == "PROD"
+    assert job.meta["banner"] == "svc-prod-3"
+    assert job.meta["joined"] == "a,b,c"
+    assert job.meta["short"] == "cde"
+    assert job.meta["via_tpl"] == "name=PROD"
+    # runtime interpolations pass through untouched
+    assert job.meta["runtime"] == "${NOMAD_TASK_DIR}/x"
+    assert job.task_groups[0].count == 2
+
+
+def test_hcl2_unknown_function_rejected():
+    from nomad_tpu.jobspec import parse
+    from nomad_tpu.jobspec.hcl import HclError
+
+    with pytest.raises(HclError, match="unknown function"):
+        parse('job "x" { datacenters = bogus_fn("a") \n'
+              ' group "g" { task "t" { driver = "mock" } } }')
+
+
+def test_hcl2_function_with_runtime_ref_passes_through():
+    """${upper(NOMAD_ALLOC_ID)} must stay verbatim for runtime
+    substitution, never evaluate to the literal identifier name."""
+    from nomad_tpu.jobspec import parse
+
+    job = parse('job "x" {\n'
+                '  meta { v = "${upper(NOMAD_ALLOC_ID)}" '
+                'ok = "${upper("abc")}" }\n'
+                '  group "g" { task "t" { driver = "mock" } }\n'
+                '}')
+    assert job.meta["v"] == "${upper(NOMAD_ALLOC_ID)}"
+    assert job.meta["ok"] == "ABC"
