@@ -21,6 +21,53 @@ from ..structs import (
 )
 
 
+def _thread_stacks():
+    """Every thread's current stack (the pprof 'goroutine' analog,
+    reference: command/agent/pprof/pprof.go)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append({
+            "thread": names.get(ident, str(ident)),
+            "frames": [f"{f.filename}:{f.lineno} {f.name}"
+                       for f in traceback.extract_stack(frame)],
+        })
+    return out
+
+
+def _sample_profile(seconds: float, hz: int):
+    """Statistical CPU profile: sample every thread's stack at `hz` for
+    `seconds`, aggregate by innermost frames (the pprof 'profile'
+    analog). Pure-Python sampling, no signals -- safe under threads."""
+    import sys
+    import time as _t
+    from collections import Counter
+
+    counts: Counter = Counter()
+    interval = 1.0 / max(hz, 1)
+    deadline = _t.monotonic() + seconds
+    n = 0
+    while _t.monotonic() < deadline:
+        for frame in sys._current_frames().values():
+            key_parts = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 3:
+                key_parts.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                                 f":{f.f_lineno} {f.f_code.co_name}")
+                f = f.f_back
+                depth += 1
+            counts[" < ".join(key_parts)] += 1
+        n += 1
+        _t.sleep(interval)
+    top = counts.most_common(50)
+    return {"samples": n, "hz": hz, "seconds": seconds,
+            "top": [{"stack": k, "count": v} for k, v in top]}
+
+
 def to_jsonable(obj):
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: to_jsonable(v)
@@ -668,6 +715,21 @@ class ApiHandler(BaseHTTPRequestHandler):
                         m.to_wire() for m in serf.members()]})
             elif parts == ["v1", "agent", "health"]:
                 self._send(200, {"server": {"ok": True}})
+            elif parts == ["v1", "agent", "pprof", "goroutine"]:
+                # thread-stack dump (reference: command/agent/pprof/ --
+                # gated on agent:write like the reference's enableDebug)
+                if not self._check(acl.allow_agent_write()):
+                    return
+                self._send(200, {"stacks": _thread_stacks()})
+            elif parts == ["v1", "agent", "pprof", "profile"]:
+                if not self._check(acl.allow_agent_write()):
+                    return
+                try:
+                    seconds = min(float(q.get("seconds", ["1"])[0]), 10.0)
+                    hz = min(int(q.get("hz", ["100"])[0]), 250)
+                except ValueError:
+                    return self._error(400, "seconds/hz must be numeric")
+                self._send(200, _sample_profile(seconds, hz))
             elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
                     parts[3] == "allocations":
                 from ..structs import codec
@@ -878,6 +940,29 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except ValueError as e:
                     return self._error(400, str(e))
                 self._send(200, {"promoted": True})
+            elif parts == ["v1", "agent", "jax-profile"]:
+                # JAX profiler hooks (SURVEY 5.1): capture a device trace
+                # for the solver's dispatches. Mutating + writes to a
+                # caller-named path: agent:write only.
+                if not self._check(acl.allow_agent_write()):
+                    return
+                body = self._body()
+                action = str(body.get("action", ""))
+                trace_dir = str(body.get("dir", "")) or "/tmp/jax-trace"
+                try:
+                    import jax
+                    if action == "start":
+                        jax.profiler.start_trace(trace_dir)
+                        self._send(200, {"tracing": True,
+                                         "dir": trace_dir})
+                    elif action == "stop":
+                        jax.profiler.stop_trace()
+                        self._send(200, {"tracing": False,
+                                         "dir": trace_dir})
+                    else:
+                        self._error(400, "action must be start|stop")
+                except RuntimeError as e:
+                    self._error(400, str(e))
             elif parts == ["v1", "node", "identity-sign"]:
                 # client-agent path (node:write pre-gated above): mint a
                 # workload identity JWT for a task the node runs

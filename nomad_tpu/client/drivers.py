@@ -526,6 +526,80 @@ def _pid_alive(pid: int) -> bool:
         return True
 
 
+class JavaDriver(ExecDriver):
+    """Run a jar/class under the JVM with exec isolation (reference:
+    drivers/java -- argv assembly around the shared executor). Config:
+    jar_path | class, args, jvm_args."""
+
+    name = "java"
+
+    def fingerprint(self) -> Dict[str, object]:
+        import shutil as _sh
+        java = _sh.which("java")
+        return {"detected": java is not None, "healthy": java is not None,
+                "attributes": ({"driver.java.runtime": java}
+                               if java else {})}
+
+    def start_task(self, task_id: str, task: Task, env: Dict[str, str],
+                   task_dir) -> TaskHandle:
+        cfg = dict(task.config or {})
+        jvm_args = [str(a) for a in cfg.get("jvm_args", [])]
+        args = [str(a) for a in cfg.get("args", [])]
+        if cfg.get("jar_path"):
+            argv = ["java", *jvm_args, "-jar", str(cfg["jar_path"]), *args]
+        elif cfg.get("class"):
+            argv = ["java", *jvm_args, str(cfg["class"]), *args]
+        else:
+            raise DriverError("java requires config.jar_path or "
+                              "config.class")
+        shim = Task(name=task.name, driver=self.name,
+                    config={"command": argv[0], "args": argv[1:]},
+                    resources=task.resources)
+        return super().start_task(task_id, shim, env, task_dir)
+
+
+def _find_qemu():
+    import shutil as _sh
+    return _sh.which("qemu-system-x86_64") or _sh.which("qemu-kvm")
+
+
+class QemuDriver(RawExecDriver):
+    """Boot a VM image under qemu (reference: drivers/qemu). Config:
+    image_path, format (optional; qemu probes when unset), accelerator,
+    memory derived from resources, extra args via config.args."""
+
+    name = "qemu"
+
+    def fingerprint(self) -> Dict[str, object]:
+        qemu = _find_qemu()
+        return {"detected": qemu is not None, "healthy": qemu is not None,
+                "attributes": ({"driver.qemu.binary": qemu}
+                               if qemu else {})}
+
+    def start_task(self, task_id: str, task: Task, env: Dict[str, str],
+                   task_dir) -> TaskHandle:
+        qemu = _find_qemu()
+        if qemu is None:
+            raise DriverError("qemu binary not present on this host")
+        cfg = dict(task.config or {})
+        image = str(cfg.get("image_path", ""))
+        if not image:
+            raise DriverError("qemu requires config.image_path")
+        drive = f"file={image}"
+        if cfg.get("format"):
+            drive += f",format={cfg['format']}"
+        argv = [qemu, "-nographic",
+                "-m", f"{max(task.resources.memory_mb, 32)}M",
+                "-drive", drive]
+        if cfg.get("accelerator"):
+            argv += ["-accel", str(cfg["accelerator"])]
+        argv += [str(a) for a in cfg.get("args", [])]
+        shim = Task(name=task.name, driver=self.name,
+                    config={"command": argv[0], "args": argv[1:]},
+                    resources=task.resources)
+        return super().start_task(task_id, shim, env, task_dir)
+
+
 # ---------------------------------------------------------------------------
 class DriverRegistry:
     """Per-client driver instances (reference: client/pluginmanager/
@@ -535,7 +609,7 @@ class DriverRegistry:
                  external: Optional[List[List[str]]] = None):
         all_drivers = {d.name: d for d in
                        (MockDriver(), RawExecDriver(), ExecDriver(),
-                        ContainerDriver())}
+                        ContainerDriver(), JavaDriver(), QemuDriver())}
         if enabled is not None:
             all_drivers = {k: v for k, v in all_drivers.items()
                            if k in enabled}

@@ -337,3 +337,35 @@ def test_numalib_topology_scan(tmp_path):
     topo2 = numalib.scan(str(tmp_path / "missing"))
     assert topo2.node_count == 1 and topo2.core_count >= 1
     assert numalib.parse_cpulist("0-2,5, 7-8") == [0, 1, 2, 5, 7, 8]
+
+
+def test_java_qemu_driver_fingerprints(tmp_path):
+    """java/qemu drivers (reference: drivers/java, drivers/qemu): argv
+    assembly over the shared exec path; fingerprint reflects host
+    binaries honestly."""
+    import shutil as _sh
+
+    import pytest as _pytest
+
+    from nomad_tpu.client.drivers import (
+        DriverError, DriverRegistry, JavaDriver, QemuDriver)
+    from nomad_tpu.structs import Resources, Task as _Task
+
+    reg = DriverRegistry()
+    assert "java" in reg._drivers and "qemu" in reg._drivers
+    jd, qd = JavaDriver(), QemuDriver()
+    assert jd.fingerprint()["detected"] == (_sh.which("java") is not None)
+    assert qd.fingerprint()["detected"] == (
+        _sh.which("qemu-system-x86_64") is not None
+        or _sh.which("qemu-kvm") is not None)
+    # config validation fails fast regardless of binary presence
+    with _pytest.raises(DriverError):
+        jd.start_task("j1", _Task(name="j", driver="java", config={},
+                                  resources=Resources(cpu=100,
+                                                      memory_mb=64)),
+                      {}, None)
+    with _pytest.raises(DriverError):
+        qd.start_task("q1", _Task(name="q", driver="qemu", config={},
+                                  resources=Resources(cpu=100,
+                                                      memory_mb=64)),
+                      {}, None)

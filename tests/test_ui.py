@@ -97,3 +97,17 @@ def test_metrics_prometheus_format(http):
             continue
         name, _, value = line.partition(" ")
         float(value)    # parseable
+
+
+def test_pprof_endpoints(http):
+    """pprof-equivalents (reference: command/agent/pprof/): thread stacks
+    + statistical profile."""
+    _, _, body = get(http, "/v1/agent/pprof/goroutine")
+    stacks = json.loads(body)["stacks"]
+    assert any("http-api" in s["thread"] or "MainThread" in s["thread"]
+               for s in stacks)
+    assert all(s["frames"] for s in stacks)
+    _, _, body = get(http, "/v1/agent/pprof/profile?seconds=0.2&hz=50")
+    prof = json.loads(body)
+    assert prof["samples"] > 0
+    assert isinstance(prof["top"], list)
