@@ -556,6 +556,24 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return self._error(403, str(e))
                 except (OSError, ValueError) as e:
                     return self._error(400, str(e))
+            elif parts[:3] == ["v1", "client", "allocation"] and \
+                    len(parts) == 5 and parts[4] == "stats":
+                # live task resource usage (reference: client
+                # Allocations.Stats via server->client forwarding)
+                from ..acl import CAP_READ_JOB
+                client, alloc = self._client_for_alloc(parts[3])
+                if alloc is None:
+                    return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(
+                        alloc.namespace, CAP_READ_JOB)):
+                    return
+                if client is None:
+                    return self._error(
+                        501, "alloc's node is not served by this agent")
+                try:
+                    return self._send(200, client.alloc_stats(parts[3]))
+                except KeyError as e:
+                    return self._error(404, str(e))
             elif parts[:3] == ["v1", "client", "fs"] and len(parts) == 6 \
                     and parts[3] == "logs":
                 from ..acl import CAP_READ_LOGS

@@ -29,13 +29,15 @@ class AllocRunner:
     def __init__(self, alloc: Allocation, drivers: DriverRegistry,
                  data_dir: str, node=None,
                  on_update: Optional[Callable[["AllocRunner"], None]] = None,
-                 identity_signer=None, secrets_fetcher=None):
+                 identity_signer=None, secrets_fetcher=None,
+                 device_manager=None):
         self.alloc = alloc
         self.drivers = drivers
         self.node = node
         self.on_update = on_update
         self.identity_signer = identity_signer
         self.secrets_fetcher = secrets_fetcher
+        self.device_manager = device_manager
         self.alloc_dir = AllocDir(data_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.client_status = ALLOC_CLIENT_PENDING
@@ -85,7 +87,8 @@ class AllocRunner:
                 restart_policy=tg.restart_policy,
                 on_state_change=lambda _tr: self._on_task_change(),
                 identity_signer=self.identity_signer,
-                secrets_fetcher=self.secrets_fetcher)
+                secrets_fetcher=self.secrets_fetcher,
+                device_manager=self.device_manager)
             self.task_runners[task.name] = tr
             return tr
 
@@ -191,7 +194,8 @@ class AllocRunner:
                 restart_policy=tg.restart_policy,
                 on_state_change=lambda _tr: self._on_task_change(),
                 identity_signer=self.identity_signer,
-                secrets_fetcher=self.secrets_fetcher)
+                secrets_fetcher=self.secrets_fetcher,
+                device_manager=self.device_manager)
             self.task_runners[task.name] = tr
             if tr.restore(st, handles.get(task.name)):
                 any_live = True

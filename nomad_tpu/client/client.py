@@ -193,7 +193,8 @@ class Client:
                 alloc, self.drivers, self.data_dir, node=self.node,
                 on_update=self._on_runner_update,
                 identity_signer=self.identity_signer,
-                secrets_fetcher=self.secrets_fetcher)
+                secrets_fetcher=self.secrets_fetcher,
+                device_manager=self.device_manager)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             states = {name: st for name, (st, _h) in tasks.items()}
@@ -292,6 +293,31 @@ class Client:
         with open(self._safe_path(alloc_id, path), "rb") as f:
             f.seek(max(0, offset))
             return f.read(max(0, min(limit, 1 << 24)))
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        """Live per-task resource usage (reference: client
+        allocations.Stats endpoint): cgroup stats for isolated tasks,
+        /proc RSS for plain ones."""
+        with self._runner_lock:
+            runner = self.runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc {alloc_id} not running here")
+        # the runner thread may still be inserting task runners; retry
+        # the snapshot instead of racing the dict iteration
+        items = []
+        for _ in range(5):
+            try:
+                items = list(runner.task_runners.items())
+                break
+            except RuntimeError:
+                continue
+        tasks = {}
+        for name, tr in items:
+            tasks[name] = tr.stats()
+        total_mem = sum(t.get("memory_bytes", 0) for t in tasks.values())
+        total_cpu = sum(t.get("cpu_usec", 0) for t in tasks.values())
+        return {"alloc_id": alloc_id, "tasks": tasks,
+                "memory_bytes": total_mem, "cpu_usec": total_cpu}
 
     def fs_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
                 offset: int = 0, limit: int = 1 << 20) -> bytes:
@@ -395,7 +421,8 @@ class Client:
                 a, self.drivers, self.data_dir, node=self.node,
                 on_update=self._on_runner_update,
                 identity_signer=self.identity_signer,
-                secrets_fetcher=self.secrets_fetcher)
+                secrets_fetcher=self.secrets_fetcher,
+                device_manager=self.device_manager)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             self.state_db.put_alloc(alloc_id, a.modify_index)

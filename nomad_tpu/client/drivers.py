@@ -342,10 +342,12 @@ class ExecDriver(RawExecDriver):
             raise DriverError("exec requires config.command")
         args = [interpolate(str(a), None, None, env)
                 for a in cfg.get("args", [])]
-        # the shared alloc dir lives outside the task dir -> bind it in
+        # the shared alloc dir lives outside the task dir -> bind it in,
+        # plus any volume mounts the hooks resolved onto the task dir
         from .executor import DEFAULT_CHROOT_BINDS
         binds = list(DEFAULT_CHROOT_BINDS)
         binds.append(f"{task_dir.alloc.shared_dir}:/alloc")
+        binds.extend(getattr(task_dir, "extra_binds", []) or [])
         return self._start_isolated(
             task_id, [command] + args, env, task_dir,
             root=task_dir.dir, workdir="/local",
@@ -480,6 +482,7 @@ class ContainerDriver(ExecDriver):
                             (task_dir.secrets_dir, "/secrets"),
                             (task_dir.alloc.shared_dir, "/alloc")):
             binds.append(f"{sub}:{target}")
+        binds.extend(getattr(task_dir, "extra_binds", []) or [])
         return self._start_isolated(
             task_id, [command] + args, env, task_dir,
             root=rootfs, workdir="/",

@@ -89,10 +89,12 @@ def build_launcher(root: str, argv: List[str], env: Dict[str, str],
     lines.append("mount --make-rprivate / 2>/dev/null || true")
     for bind in binds:
         # "src" mounts read-only at root+src; "src:target" mounts
-        # read-write at root+target (sandbox dirs like /local, /alloc)
+        # read-write at root+target (sandbox dirs like /local, /alloc);
+        # "src:target:ro" mounts read-only at root+target (volumes)
         if ":" in bind:
-            src, _, target = bind.partition(":")
-            writable = True
+            src, _, rest = bind.partition(":")
+            target, _, flag = rest.partition(":")
+            writable = flag != "ro"
         else:
             src, target, writable = bind, bind, False
         if not os.path.exists(src):

@@ -221,10 +221,138 @@ class IdentityHook(TaskHook):
             fh.write(token)
 
 
+class DispatchPayloadHook(TaskHook):
+    """Write a dispatched (parameterized) job's payload into local/
+    (reference: taskrunner/dispatch_hook.go)."""
+    name = "dispatch_payload"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        job = runner.alloc.job
+        payload = getattr(job, "payload", b"") if job is not None else b""
+        if not payload:
+            return
+        if isinstance(payload, str):
+            payload = payload.encode()
+        path = os.path.join(runner.task_dir.local_dir, "dispatch_payload")
+        with open(path, "wb") as fh:
+            fh.write(payload)
+
+
+class VolumeHook(TaskHook):
+    """Mount the task's volume_mount blocks: resolve each named TG volume
+    to the node's host-volume path; isolated drivers get real binds (via
+    task_dir.extra_binds, honoring read_only), non-isolated drivers get
+    symlinks under the task dir (reference: allocrunner volume hooks +
+    taskrunner volume mounts)."""
+    name = "volumes"
+
+    @staticmethod
+    def _driver_isolates(runner: "TaskRunner") -> bool:
+        """True when the driver will chroot+bind (extra_binds honored)."""
+        if getattr(runner.driver, "name", "") not in ("exec", "container"):
+            return False
+        from .executor import probe_caps
+        return probe_caps().namespaces
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        mounts = runner.task.volume_mounts or []
+        if not mounts:
+            return
+        job = runner.alloc.job
+        tg = (job.lookup_task_group(runner.alloc.task_group)
+              if job is not None else None)
+        node = runner.node
+        isolated = self._driver_isolates(runner)
+        binds = []
+        for m in mounts:
+            vol_name = str(m.get("volume", ""))
+            dest = str(m.get("destination", "")) or f"/{vol_name}"
+            read_only = bool(m.get("read_only", False))
+            vreq = (tg.volumes or {}).get(vol_name) if tg is not None \
+                else None
+            if vreq is None:
+                raise DriverError(
+                    f"task mounts unknown volume {vol_name!r}")
+            # per_alloc volumes resolve to their indexed source -- the
+            # same rule the scheduler applied (structs VolumeRequest
+            # .source_for, feasible.py:346)
+            source = vreq.source_for(runner.alloc.name)
+            cfg = (node.host_volumes.get(source)
+                   if node is not None else None)
+            if cfg is None or not cfg.path:
+                raise DriverError(
+                    f"node is missing host volume {source!r}")
+            read_only = read_only or vreq.read_only or cfg.read_only
+            if not dest.startswith("/"):
+                dest = "/" + dest
+            # destination must stay inside the sandbox: a job spec must
+            # never direct writes at arbitrary host paths
+            link = os.path.normpath(
+                os.path.join(runner.task_dir.dir, dest.lstrip("/")))
+            root = os.path.normpath(runner.task_dir.dir)
+            if not link.startswith(root + os.sep):
+                raise DriverError(
+                    f"volume destination {dest!r} escapes the sandbox")
+            if isolated:
+                # real binds honoring read_only; NO symlink -- it would
+                # sit at the bind target and break the chroot mount
+                binds.append(f"{cfg.path}:{dest}"
+                             + (":ro" if read_only else ""))
+                continue
+            # non-isolated drivers can't mount; a symlink cannot enforce
+            # read-only, so refuse rather than silently grant writes
+            if read_only:
+                raise DriverError(
+                    f"read-only volume {vol_name!r} requires an "
+                    "isolating driver (exec/container)")
+            if not os.path.lexists(link):
+                os.makedirs(os.path.dirname(link), exist_ok=True)
+                os.symlink(cfg.path, link)
+        if binds:
+            runner.task_dir.extra_binds = binds
+
+
+class DevicesHook(TaskHook):
+    """Reserve the task's allocated device instances with their owning
+    device plugin and inject the reservation env (reference:
+    taskrunner/device_hook.go + plugins/device Reserve)."""
+    name = "devices"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        dm = runner.device_manager
+        if dm is None:
+            return
+        alloc_res = runner.alloc.allocated_resources
+        tr = (alloc_res.tasks.get(runner.task.name)
+              if alloc_res is not None else None)
+        if tr is None:
+            return
+        for dev in tr.devices:
+            group = None
+            for g in (runner.node.node_resources.devices
+                      if runner.node is not None else []):
+                if (g.vendor, g.type, g.name) == (dev.vendor, dev.type,
+                                                  dev.name):
+                    group = g
+                    break
+            if group is None:
+                continue
+            try:
+                res = dm.reserve(group, list(dev.device_ids))
+            except Exception as e:  # noqa: BLE001 -- plugin failures
+                # must fail the TASK through the normal hook path, not
+                # kill the runner thread (run() catches DriverError only)
+                raise DriverError(f"device reservation failed: {e}") from e
+            for k, v in (res.get("envs") or {}).items():
+                runner.env[str(k)] = str(v)
+
+
 # identity runs BEFORE templates: nomad_var resolution needs the token
-# (reference ordering: taskrunner identity_hook precedes template)
-DEFAULT_HOOKS = (ValidateHook, TaskDirHook, EnvHook, LogmonHook,
-                 ArtifactHook, IdentityHook, TemplateHook)
+# (reference ordering: taskrunner identity_hook precedes template);
+# volumes/devices before env consumers, dispatch payload with artifacts
+DEFAULT_HOOKS = (ValidateHook, TaskDirHook, EnvHook, VolumeHook,
+                 DevicesHook, LogmonHook, ArtifactHook,
+                 DispatchPayloadHook, IdentityHook, TemplateHook)
 
 
 class TaskRunner:
@@ -234,7 +362,7 @@ class TaskRunner:
                  alloc_dir: AllocDir, node=None,
                  restart_policy: Optional[RestartPolicy] = None,
                  on_state_change=None, identity_signer=None,
-                 secrets_fetcher=None):
+                 secrets_fetcher=None, device_manager=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -244,6 +372,7 @@ class TaskRunner:
         self.on_state_change = on_state_change
         self.identity_signer = identity_signer
         self.secrets_fetcher = secrets_fetcher
+        self.device_manager = device_manager
         self.identity_token: Optional[str] = None
         self.task_dir: Optional[TaskDir] = None
         self.env: Dict[str, str] = {}
@@ -260,6 +389,28 @@ class TaskRunner:
             target=self.run, daemon=True,
             name=f"task-{self.alloc.id[:8]}-{self.task.name}")
         self._thread.start()
+
+    def stats(self) -> dict:
+        """Live resource usage (reference: taskrunner stats_hook.go +
+        driver TaskStats): cgroup numbers when the driver has one, else
+        /proc/<pid> RSS."""
+        out = {"state": self.state.state}
+        cg = getattr(self.driver, "task_cgroup", None)
+        handle = self.handle
+        if cg is not None and handle is not None:
+            cgroup = cg(handle.task_id)
+            if cgroup is not None:
+                out.update(cgroup.stats())
+                return out
+        if handle is not None and handle.pid:
+            try:
+                with open(f"/proc/{handle.pid}/statm") as fh:
+                    pages = int(fh.read().split()[1])
+                import os as _os
+                out["memory_bytes"] = pages * _os.sysconf("SC_PAGE_SIZE")
+            except (OSError, ValueError, IndexError):
+                pass
+        return out
 
     def kill(self, timeout: float = 10.0) -> None:
         self._kill.set()

@@ -223,6 +223,9 @@ class Task:
     log_config: LogConfig = field(default_factory=LogConfig)
     artifacts: List[dict] = field(default_factory=list)
     templates: List[dict] = field(default_factory=list)
+    # volume_mount blocks (reference: structs.VolumeMount):
+    # {"volume": <tg volume name>, "destination": path, "read_only": bool}
+    volume_mounts: List[dict] = field(default_factory=list)
     vault: Optional[dict] = None
     # workload identity requirement (reference: structs.WorkloadIdentity);
     # injected by admission for secret-consuming tasks

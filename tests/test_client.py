@@ -369,3 +369,89 @@ def test_java_qemu_driver_fingerprints(tmp_path):
                                   resources=Resources(cpu=100,
                                                       memory_mb=64)),
                       {}, None)
+
+
+def test_volume_hook_mounts_host_volume(tmp_path):
+    """volume_mount resolves a TG host volume onto the task sandbox
+    (reference: allocrunner volume hooks; VERDICT AllocRunner partial)."""
+    from nomad_tpu.structs import (
+        ClientHostVolumeConfig, VolumeRequest)
+
+    host_vol = tmp_path / "shared-data"
+    host_vol.mkdir()
+    (host_vol / "seed.txt").write_text("from-host-volume")
+
+    from nomad_tpu.server import Server
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    from nomad_tpu.client import Client, LocalServerConn
+    node = mock.node()
+    node.host_volumes["shared"] = ClientHostVolumeConfig(
+        name="shared", path=str(host_vol))
+    client = Client(LocalServerConn(server), str(tmp_path / "data"),
+                    node=node, name="vol-client")
+    client.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                server.state.node_by_id(client.node.id) is None:
+            time.sleep(0.05)
+        job = mock.job(id="vol-job")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {"data": VolumeRequest(name="data", type="host",
+                                            source="shared")}
+        tg.tasks[0].driver = "raw_exec"
+        tg.tasks[0].volume_mounts = [
+            {"volume": "data", "destination": "/data"}]
+        tg.tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c", "cp ../data/seed.txt $NOMAD_TASK_DIR/copied"]}
+        server.register_job(job)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            allocs = server.state.allocs_by_job("default", "vol-job")
+            if allocs and allocs[0].client_status == "complete":
+                break
+            time.sleep(0.05)
+        allocs = server.state.allocs_by_job("default", "vol-job")
+        assert allocs and allocs[0].client_status == "complete", \
+            [a.task_states for a in allocs]
+        copied = (tmp_path / "data" / allocs[0].id / "web" / "local"
+                  / "copied")
+        assert copied.read_text() == "from-host-volume"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_dispatch_payload_written_to_task(tmp_path, dev_server):
+    """Parameterized dispatch payload lands in local/dispatch_payload
+    (reference: taskrunner/dispatch_hook.go)."""
+    from nomad_tpu.structs import ParameterizedJobConfig
+
+    client = Client(LocalServerConn(dev_server), str(tmp_path),
+                    name="dispatch-client")
+    client.start()
+    assert _wait(lambda: dev_server.state.node_by_id(client.node.id)
+                 is not None)
+    base = mock.batch_job(count=1)
+    base.id = "payload-job"
+    base.parameterized = ParameterizedJobConfig(payload="required")
+    base.task_groups[0].tasks[0].driver = "raw_exec"
+    base.task_groups[0].tasks[0].config = {
+        "command": "/bin/sh",
+        "args": ["-c", "cp $NOMAD_TASK_DIR/dispatch_payload "
+                       "$NOMAD_TASK_DIR/seen"]}
+    dev_server.register_job(base)
+    child, _ev = dev_server.dispatch_job("default", "payload-job",
+                                         payload=b"hello-payload")
+    assert _wait(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in dev_server.state.allocs_by_job("default", child.id)),
+        timeout=15.0)
+    alloc = dev_server.state.allocs_by_job("default", child.id)[0]
+    seen = (tmp_path / alloc.id / base.task_groups[0].tasks[0].name
+            / "local" / "seen")
+    assert seen.read_bytes() == b"hello-payload"
+    client.shutdown()

@@ -317,3 +317,148 @@ def test_exec_alloc_dir_shared_between_tasks(tmp_path):
     assert result is not None and result.exit_code == 0, result
     assert open(os.path.join(td.alloc.shared_dir,
                              "handoff")).read().strip() == "shared"
+
+
+@needs_isolation
+def test_exec_volume_bind_mounted_readonly(tmp_path):
+    """Isolated exec tasks see host volumes as real binds honoring
+    read_only (the VolumeHook -> task_dir.extra_binds path)."""
+    host_vol = tmp_path / "hostdata"
+    host_vol.mkdir()
+    (host_vol / "cfg.txt").write_text("volume-content")
+    td = make_task_dir(tmp_path)
+    td.extra_binds = [f"{host_vol}:/data:ro"]
+    drv = ExecDriver()
+    task = exec_task("/bin/sh",
+                     ["-c", "cat /data/cfg.txt > /local/got; "
+                            "(touch /data/w 2>/dev/null && echo RW "
+                            "|| echo RO) >> /local/got"])
+    handle = drv.start_task("iso-vol-0001", task, {}, td)
+    result = drv.wait_task(handle, timeout=15.0)
+    assert result is not None and result.exit_code == 0, result
+    got = open(os.path.join(td.local_dir, "got")).read()
+    assert "volume-content" in got
+    assert "RO" in got and "RW" not in got
+
+
+@needs_isolation
+def test_task_stats_from_cgroup(tmp_path):
+    """TaskRunner.stats(): live memory/cpu numbers from the task cgroup
+    (reference: stats_hook.go)."""
+    if not CAPS.cgroups:
+        pytest.skip("requires writable cgroups")
+    import time as _time
+
+    from nomad_tpu.client.task_runner import TaskRunner
+    from nomad_tpu.structs import RestartPolicy
+
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c", "sleep 20"], cpu=100, memory_mb=64)
+    handle = drv.start_task("iso-stats-01", task, {}, td)
+    try:
+        runner = TaskRunner.__new__(TaskRunner)
+        runner.driver = drv
+        runner.handle = handle
+        from nomad_tpu.client.task_runner import TaskState
+        runner.state = TaskState(state="running")
+        deadline = _time.time() + 10
+        stats = {}
+        while _time.time() < deadline:
+            stats = runner.stats()
+            if stats.get("memory_bytes", 0) > 0:
+                break
+            _time.sleep(0.1)
+        assert stats.get("memory_bytes", 0) > 0, stats
+    finally:
+        drv.stop_task(handle, kill_timeout=2.0)
+        drv.wait_task(handle, timeout=5.0)
+
+
+@needs_isolation
+def test_exec_volume_mount_through_full_pipeline(tmp_path):
+    """volume_mount on an exec task through server+client: the HOOK must
+    produce a working bind inside the chroot (regression: a symlink at
+    the bind target used to break the mount)."""
+    import time as _time
+
+    from nomad_tpu.client import Client, LocalServerConn
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs import ClientHostVolumeConfig, VolumeRequest
+
+    host_vol = tmp_path / "hostvol"
+    host_vol.mkdir()
+    (host_vol / "seed.txt").write_text("pipeline-volume")
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    node = mock.node()
+    node.host_volumes["shared"] = ClientHostVolumeConfig(
+        name="shared", path=str(host_vol), read_only=True)
+    client = Client(LocalServerConn(server), str(tmp_path / "data"),
+                    node=node, name="iso-vol-client")
+    client.start()
+    try:
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                server.state.node_by_id(client.node.id) is None:
+            _time.sleep(0.05)
+        job = mock.job(id="iso-vol-job")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {"data": VolumeRequest(name="data", type="host",
+                                            source="shared",
+                                            read_only=True)}
+        tg.tasks[0].driver = "exec"
+        tg.tasks[0].volume_mounts = [
+            {"volume": "data", "destination": "/data",
+             "read_only": True}]
+        tg.tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c", "cat /data/seed.txt > /local/got; "
+                           "(touch /data/w 2>/dev/null && echo RW "
+                           "|| echo RO) >> /local/got"]}
+        server.register_job(job)
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            allocs = server.state.allocs_by_job("default", "iso-vol-job")
+            if allocs and allocs[0].client_status == "complete":
+                break
+            _time.sleep(0.05)
+        allocs = server.state.allocs_by_job("default", "iso-vol-job")
+        assert allocs and allocs[0].client_status == "complete", \
+            [a.task_states for a in allocs]
+        got = (tmp_path / "data" / allocs[0].id / "web" / "local" / "got")
+        text = got.read_text()
+        assert "pipeline-volume" in text
+        assert "RO" in text and "RW" not in text
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_volume_destination_escape_rejected(tmp_path):
+    """A volume destination with .. must fail the task, never write
+    outside the sandbox."""
+    from nomad_tpu.client.allocdir import AllocDir
+    from nomad_tpu.client.drivers import DriverError, MockDriver
+    from nomad_tpu.client.task_runner import TaskRunner, VolumeHook
+    from nomad_tpu.structs import (
+        ClientHostVolumeConfig, Resources, Task, VolumeRequest)
+
+    node = mock.node()
+    node.host_volumes["shared"] = ClientHostVolumeConfig(
+        name="shared", path=str(tmp_path / "vol"))
+    (tmp_path / "vol").mkdir()
+    job = mock.job(id="escape-job")
+    tg = job.task_groups[0]
+    tg.volumes = {"data": VolumeRequest(name="data", source="shared")}
+    tg.tasks[0].volume_mounts = [
+        {"volume": "data", "destination": "../../../../etc/escape"}]
+    alloc = mock.alloc_for(job, node)
+    ad = AllocDir(str(tmp_path), alloc.id)
+    ad.build()
+    runner = TaskRunner(alloc, tg.tasks[0], MockDriver(), ad, node=node)
+    runner.task_dir = ad.new_task_dir(tg.tasks[0].name)
+    runner.task_dir.build()
+    with pytest.raises(DriverError, match="escapes the sandbox"):
+        VolumeHook().prestart(runner)
