@@ -1132,8 +1132,10 @@ class ApiHandler(BaseHTTPRequestHandler):
                     scheduler_algorithm=body.get("scheduler_algorithm",
                                                  "binpack"),
                     memory_oversubscription_enabled=body.get(
-                        "memory_oversubscription_enabled", False))
-                self.nomad.state.set_scheduler_config(cfg)
+                        "memory_oversubscription_enabled", False),
+                    pause_eval_broker=bool(body.get("pause_eval_broker",
+                                                    False)))
+                self.nomad.apply_scheduler_config(cfg)
                 self._send(200, {"updated": True})
             elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
                     parts[3] == "drain":

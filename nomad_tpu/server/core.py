@@ -269,6 +269,20 @@ class Server:
             return None
         return token
 
+    def apply_scheduler_config(self, cfg) -> None:
+        """Store + enact runtime scheduler configuration: the
+        pause_eval_broker knob stops dequeues on the live broker
+        (reference: SchedulerSetConfigurationRequest + the leader's
+        broker enable/disable, operator_endpoint.go)."""
+        self.state.set_scheduler_config(cfg)
+        if self._leader_active.is_set():
+            was = self.broker.enabled
+            self.broker.set_enabled(not cfg.pause_eval_broker)
+            if not was and not cfg.pause_eval_broker:
+                # resume: re-seed from state like a fresh leader
+                # (reference: leader.go:403 restoreEvals)
+                self._restore_evals()
+
     def resolve_token(self, secret_id: Optional[str]):
         """-> (ACL, token). With ACLs disabled every request is management;
         with ACLs enabled a missing/unknown secret is anonymous deny-all

@@ -487,3 +487,23 @@ def test_drain_force_deadline_migrates_everything(cluster):
     wait_until(lambda: len([a for a in running_allocs(server, job)
                             if a.node_id != victim.node.id]) == 3,
                timeout=15.0, msg="force-drained")
+
+
+def test_eval_broker_pause_resume(cluster):
+    """Operator pause/resume of the eval broker via scheduler config
+    (reference: SchedulerConfiguration.PauseEvalBroker)."""
+    from nomad_tpu.structs import SchedulerConfiguration
+
+    server, clients = cluster
+    server.apply_scheduler_config(
+        SchedulerConfiguration(pause_eval_broker=True))
+    job = mock.job(id="paused-job")
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].config = {}
+    server.register_job(job)
+    time.sleep(0.6)
+    assert not running_allocs(server, job), "scheduled while paused"
+    server.apply_scheduler_config(
+        SchedulerConfiguration(pause_eval_broker=False))
+    wait_until(lambda: len(running_allocs(server, job)) == 1,
+               msg="resumed scheduling")
