@@ -23,7 +23,8 @@ from ..raft import (
 from ..raft.fsm import encode_command
 from ..state import StateStore
 from ..structs import (
-    Allocation, DrainStrategy, Evaluation, Job, Node, codec,
+    Allocation, DrainStrategy, Evaluation, Job, Node,
+    SchedulerConfiguration, codec,
 )
 from .core import Server
 
@@ -191,6 +192,7 @@ _FORWARD_SPECS: Dict[str, Tuple[List[Any], Any]] = {
     "heartbeat": ([str], float),
     "drain_node": ([str, Optional[DrainStrategy]], type(None)),
     "update_allocs_from_client": ([List[Allocation]], type(None)),
+    "apply_scheduler_config": ([SchedulerConfiguration], type(None)),
 }
 
 
@@ -332,6 +334,10 @@ class ClusterServer(Server):
 
     def update_allocs_from_client(self, allocs):
         return self._leader_call("update_allocs_from_client", (allocs,))
+
+    def apply_scheduler_config(self, cfg):
+        # the pause side effect must run on the LEADER's live broker
+        return self._leader_call("apply_scheduler_config", (cfg,))
 
 
 # ---------------------------------------------------------------------------

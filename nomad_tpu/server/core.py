@@ -166,7 +166,12 @@ class Server:
         with self._leader_lock:
             if self._leader_active.is_set():
                 return
-            self.broker.set_enabled(True)
+            # a failover must not un-pause a broker the operator paused:
+            # the flag lives in replicated state (reference: leader.go
+            # gating broker enable on SchedulerConfig.PauseEvalBroker)
+            paused = bool(getattr(self.state.scheduler_config(),
+                                  "pause_eval_broker", False))
+            self.broker.set_enabled(not paused)
             self.blocked_evals.set_enabled(True)
             # (reference: leader.go initializeKeyring -- first leader mints
             # the root encryption key)
@@ -206,12 +211,18 @@ class Server:
                 self._heartbeat_deadlines.clear()
             self._periodic_last.clear()
 
-    def _restore_evals(self) -> None:
+    def _restore_evals(self, reblock: bool = True) -> None:
         """Re-populate broker/blocked-evals from replicated state
-        (reference: leader.go:403 restoreEvals)."""
+        (reference: leader.go:403 restoreEvals). With reblock=False,
+        state-BLOCKED evals enqueue for re-evaluation instead (they
+        re-block if capacity still lacks) -- used on broker resume where
+        capacity events during the pause may have been dropped."""
         for ev in self.state.evals():
             if ev.status == EVAL_STATUS_BLOCKED:
-                self.blocked_evals.block(ev)
+                if reblock:
+                    self.blocked_evals.block(ev)
+                else:
+                    self.broker.enqueue(ev)
             elif ev.should_enqueue():
                 self.broker.enqueue(ev)
 
@@ -273,15 +284,22 @@ class Server:
         """Store + enact runtime scheduler configuration: the
         pause_eval_broker knob stops dequeues on the live broker
         (reference: SchedulerSetConfigurationRequest + the leader's
-        broker enable/disable, operator_endpoint.go)."""
+        broker enable/disable, operator_endpoint.go). Serialized with
+        leadership transitions -- every broker enable/disable takes
+        _leader_lock."""
         self.state.set_scheduler_config(cfg)
-        if self._leader_active.is_set():
+        with self._leader_lock:
+            if not self._leader_active.is_set():
+                return
             was = self.broker.enabled
             self.broker.set_enabled(not cfg.pause_eval_broker)
             if not was and not cfg.pause_eval_broker:
-                # resume: re-seed from state like a fresh leader
+                # resume: re-seed from state like a fresh leader, and
+                # ENQUEUE evals that blocked before/while paused -- a
+                # capacity event during the pause dropped its wakeup at
+                # the disabled broker, so they must re-evaluate
                 # (reference: leader.go:403 restoreEvals)
-                self._restore_evals()
+                self._restore_evals(reblock=False)
 
     def resolve_token(self, secret_id: Optional[str]):
         """-> (ACL, token). With ACLs disabled every request is management;
