@@ -435,6 +435,17 @@ class HttpServerConn:
             raise
         return reply.get("items")
 
+    def csi_volume(self, namespace: str, vol_id: str):
+        from ..structs.csi import CSIVolume
+        try:
+            raw = self.api.get(f"/v1/volume/csi/{vol_id}",
+                               namespace=namespace)
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return codec.decode(CSIVolume, raw)
+
     def register_services(self, regs) -> None:
         self.api.post("/v1/node/services-register",
                       {"services": [codec.encode(r) for r in regs]})

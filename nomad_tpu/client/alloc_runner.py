@@ -30,7 +30,8 @@ class AllocRunner:
                  data_dir: str, node=None,
                  on_update: Optional[Callable[["AllocRunner"], None]] = None,
                  identity_signer=None, secrets_fetcher=None,
-                 device_manager=None):
+                 device_manager=None, csi_manager=None,
+                 csi_volume_info=None):
         self.alloc = alloc
         self.drivers = drivers
         self.node = node
@@ -38,6 +39,11 @@ class AllocRunner:
         self.identity_signer = identity_signer
         self.secrets_fetcher = secrets_fetcher
         self.device_manager = device_manager
+        self.csi_manager = csi_manager
+        self.csi_volume_info = csi_volume_info
+        self.csi_paths: Dict[str, str] = {}
+        self._csi_attached: List[tuple] = []
+        self._restored = False
         self.alloc_dir = AllocDir(data_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.client_status = ALLOC_CLIENT_PENDING
@@ -70,6 +76,14 @@ class AllocRunner:
             self._set_status(ALLOC_CLIENT_FAILED, "task group not found")
             self._done.set()
             return
+        try:
+            self._attach_csi_volumes(tg)
+        except Exception as e:  # noqa: BLE001 -- plugin/volume failures
+            self._set_status(ALLOC_CLIENT_FAILED, f"csi: {e}")
+            self._detach_csi_volumes()
+            self._done.set()
+            self._notify()
+            return
 
         prestart = [t for t in tg.tasks if t.lifecycle
                     and t.lifecycle.get("hook") == "prestart"
@@ -88,7 +102,8 @@ class AllocRunner:
                 on_state_change=lambda _tr: self._on_task_change(),
                 identity_signer=self.identity_signer,
                 secrets_fetcher=self.secrets_fetcher,
-                device_manager=self.device_manager)
+                device_manager=self.device_manager,
+                csi_paths=self.csi_paths)
             self.task_runners[task.name] = tr
             return tr
 
@@ -101,12 +116,14 @@ class AllocRunner:
             if tr.state.failed:
                 self._set_status(ALLOC_CLIENT_FAILED,
                                  f"prestart task {task.name} failed")
+                self._detach_csi_volumes(tg_hint=tg)
                 self._done.set()
                 self._notify()
                 return
         if self._kill.is_set():
             # stopped/destroyed during prestart: don't launch main tasks
             self._finalize_status(stopped=True)
+            self._detach_csi_volumes(tg_hint=tg)
             self._done.set()
             self._notify()
             return
@@ -149,6 +166,7 @@ class AllocRunner:
                 tr.start()
                 tr.wait()
         self._finalize_status()
+        self._detach_csi_volumes()
         self._done.set()
         self._notify()
 
@@ -159,6 +177,10 @@ class AllocRunner:
         for tr in self.task_runners.values():
             tr.kill()
         self._done.wait(timeout)
+        # restored allocs never re-enter run(): destroy is their detach
+        # point (paths are filesystem-deterministic, so this works even
+        # when the attach happened before an agent restart)
+        self._detach_csi_volumes(tg_hint=None)
         self.alloc_dir.destroy()
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -176,6 +198,7 @@ class AllocRunner:
     # -- restore (reference: alloc_runner.go:455 Restore) --------------
     def restore(self, task_states: Dict[str, TaskState],
                 handles: Dict[str, object]) -> bool:
+        self._restored = True
         """Re-attach task runners to live tasks. Returns True if any task
         was recovered running."""
         self.alloc_dir.build()
@@ -195,7 +218,8 @@ class AllocRunner:
                 on_state_change=lambda _tr: self._on_task_change(),
                 identity_signer=self.identity_signer,
                 secrets_fetcher=self.secrets_fetcher,
-                device_manager=self.device_manager)
+                device_manager=self.device_manager,
+                csi_paths=self.csi_paths)
             self.task_runners[task.name] = tr
             if tr.restore(st, handles.get(task.name)):
                 any_live = True
@@ -213,6 +237,68 @@ class AllocRunner:
             self._notify()
         return any_live
 
+    # -- CSI volumes (reference: allocrunner/csi_hook.go: attach ONCE
+    #    per alloc before tasks start, detach after they all stop) -----
+    def _attach_csi_volumes(self, tg) -> None:
+        if self.csi_manager is None:
+            return
+        referenced = {str(m.get("volume", ""))
+                      for t in tg.tasks for m in (t.volume_mounts or [])}
+        for name, vreq in (tg.volumes or {}).items():
+            if vreq.type != "csi" or name not in referenced:
+                continue
+            if self.csi_volume_info is None:
+                raise RuntimeError("no CSI volume lookup available")
+            source = vreq.source_for(self.alloc.name)
+            vol = self.csi_volume_info(self.alloc.namespace, source)
+            if vol is None:
+                raise RuntimeError(f"unknown CSI volume {source!r}")
+            path = self.csi_manager.publish(
+                vol.plugin_id, vol.id, self.alloc.id,
+                self.alloc.node_id, vreq.read_only)
+            self.csi_paths[name] = path
+            self._csi_attached.append((vol.plugin_id, vol.id))
+
+    def _detach_csi_volumes(self, tg_hint=None) -> None:
+        """Best-effort by construction: detach runs on terminal paths
+        (run end, watch-restored end, destroy) where a raise would leave
+        a zombie alloc or kill the client's watch thread."""
+        if self.csi_manager is None:
+            return
+        attached = self._csi_attached
+        if not attached and self._restored:
+            # restored alloc: the attach happened before an agent
+            # restart; re-derive REFERENCED csi volumes from the job
+            # spec (paths are filesystem-deterministic in the manager).
+            # Allocs that already detached in run() have _restored
+            # False and skip this entirely.
+            tg = tg_hint or (self.alloc.job.lookup_task_group(
+                self.alloc.task_group) if self.alloc.job else None)
+            if tg is not None and self.csi_volume_info is not None:
+                referenced = {str(m.get("volume", ""))
+                              for t in tg.tasks
+                              for m in (t.volume_mounts or [])}
+                for name, vreq in (tg.volumes or {}).items():
+                    if vreq.type != "csi" or name not in referenced:
+                        continue
+                    try:
+                        vol = self.csi_volume_info(
+                            self.alloc.namespace,
+                            vreq.source_for(self.alloc.name))
+                    except Exception:  # noqa: BLE001 -- server away
+                        vol = None
+                    if vol is not None:
+                        attached.append((vol.plugin_id, vol.id))
+        for plugin_id, vol_id in attached:
+            try:
+                self.csi_manager.unpublish(plugin_id, vol_id,
+                                           self.alloc.id,
+                                           self.alloc.node_id)
+            except Exception:  # noqa: BLE001 -- best-effort detach
+                pass
+        self._csi_attached = []
+        self.csi_paths = {}
+
     def _watch_restored(self) -> None:
         while not self._kill.is_set():
             if all(tr.state.state == TASK_STATE_DEAD
@@ -220,6 +306,7 @@ class AllocRunner:
                 break
             time.sleep(0.05)
         self._finalize_status()
+        self._detach_csi_volumes()
         self._done.set()
         self._notify()
 

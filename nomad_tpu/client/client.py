@@ -61,6 +61,10 @@ class ServerConn:
         (reference analog: DeriveVaultToken -> native Variables)."""
         raise NotImplementedError
 
+    def csi_volume(self, namespace: str, vol_id: str):
+        """-> CSIVolume or None (volume hook attach path)."""
+        raise NotImplementedError
+
 
 class LocalServerConn(ServerConn):
     """In-process server (dev agent topology)."""
@@ -95,6 +99,9 @@ class LocalServerConn(ServerConn):
     def workload_variable(self, jwt: str, path: str):
         return self.server.workload_variable(jwt, path)
 
+    def csi_volume(self, namespace: str, vol_id: str):
+        return self.server.state.csi_volume_by_id(namespace, vol_id)
+
 
 MAX_TERMINAL_RUNNERS = 50     # client GC watermark (reference: client/gc.go)
 
@@ -106,7 +113,7 @@ class Client:
                  node: Optional[Node] = None, name: str = "",
                  drivers: Optional[DriverRegistry] = None,
                  probe_jax: bool = False, identity_signer=None,
-                 device_plugins=None):
+                 device_plugins=None, csi_plugins=None):
         self.conn = conn
         self.data_dir = data_dir
         self.drivers = drivers or DriverRegistry()
@@ -115,6 +122,13 @@ class Client:
         if device_plugins:
             from ..plugins.device import DeviceManager
             self.device_manager = DeviceManager(device_plugins)
+        # CSI plugins: per-plugin-id subprocesses; the node advertises
+        # healthy node plugins for scheduler feasibility
+        # (reference: client/pluginmanager/csimanager)
+        self.csi_manager = None
+        if csi_plugins:
+            from ..plugins.csi import CSIManager
+            self.csi_manager = CSIManager(data_dir, csi_plugins)
         self.state_db = StateDB(data_dir)
         if identity_signer is None:
             def identity_signer(claims, _c=conn):
@@ -132,6 +146,16 @@ class Client:
         if self.device_manager is not None:
             self.node.node_resources.devices.extend(
                 self.device_manager.all_devices())
+        if self.csi_manager is not None:
+            for pid in self.csi_manager.plugin_ids():
+                # health comes from the plugin's own probe, not blind
+                # optimism: an unready plugin must not attract placements
+                try:
+                    ready = bool(self.csi_manager.plugins[pid]
+                                 .probe().get("ready", False))
+                except Exception:  # noqa: BLE001 -- plugin failure
+                    ready = False
+                self.node.csi_node_plugins[pid] = {"healthy": ready}
         self.node.compute_class()
         # restore node identity across restarts
         prev = self.state_db.node_id()
@@ -172,6 +196,8 @@ class Client:
         # plugin subprocesses must not outlive the client
         if self.device_manager is not None:
             self.device_manager.shutdown()
+        if self.csi_manager is not None:
+            self.csi_manager.shutdown()
         self.drivers.shutdown()
 
     # -- fault injection (parity with SimClient for tests) -------------
@@ -194,7 +220,9 @@ class Client:
                 on_update=self._on_runner_update,
                 identity_signer=self.identity_signer,
                 secrets_fetcher=self.secrets_fetcher,
-                device_manager=self.device_manager)
+                device_manager=self.device_manager,
+                csi_manager=self.csi_manager,
+                csi_volume_info=self.conn.csi_volume)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             states = {name: st for name, (st, _h) in tasks.items()}
@@ -422,7 +450,9 @@ class Client:
                 on_update=self._on_runner_update,
                 identity_signer=self.identity_signer,
                 secrets_fetcher=self.secrets_fetcher,
-                device_manager=self.device_manager)
+                device_manager=self.device_manager,
+                csi_manager=self.csi_manager,
+                csi_volume_info=self.conn.csi_volume)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             self.state_db.put_alloc(alloc_id, a.modify_index)

@@ -277,12 +277,23 @@ class VolumeHook(TaskHook):
             # same rule the scheduler applied (structs VolumeRequest
             # .source_for, feasible.py:346)
             source = vreq.source_for(runner.alloc.name)
-            cfg = (node.host_volumes.get(source)
-                   if node is not None else None)
-            if cfg is None or not cfg.path:
-                raise DriverError(
-                    f"node is missing host volume {source!r}")
-            read_only = read_only or vreq.read_only or cfg.read_only
+            if vreq.type == "csi":
+                # attached ONCE per alloc by the AllocRunner (reference:
+                # allocrunner/csi_hook.go altitude) -- the task hook only
+                # consumes the already-published host path
+                host_path = (runner.csi_paths or {}).get(vol_name)
+                if not host_path:
+                    raise DriverError(
+                        f"CSI volume {vol_name!r} is not attached")
+                read_only = read_only or vreq.read_only
+            else:
+                cfg = (node.host_volumes.get(source)
+                       if node is not None else None)
+                if cfg is None or not cfg.path:
+                    raise DriverError(
+                        f"node is missing host volume {source!r}")
+                read_only = read_only or vreq.read_only or cfg.read_only
+                host_path = cfg.path
             if not dest.startswith("/"):
                 dest = "/" + dest
             # destination must stay inside the sandbox: a job spec must
@@ -296,7 +307,7 @@ class VolumeHook(TaskHook):
             if isolated:
                 # real binds honoring read_only; NO symlink -- it would
                 # sit at the bind target and break the chroot mount
-                binds.append(f"{cfg.path}:{dest}"
+                binds.append(f"{host_path}:{dest}"
                              + (":ro" if read_only else ""))
                 continue
             # non-isolated drivers can't mount; a symlink cannot enforce
@@ -307,10 +318,9 @@ class VolumeHook(TaskHook):
                     "isolating driver (exec/container)")
             if not os.path.lexists(link):
                 os.makedirs(os.path.dirname(link), exist_ok=True)
-                os.symlink(cfg.path, link)
+                os.symlink(host_path, link)
         if binds:
             runner.task_dir.extra_binds = binds
-
 
 class DevicesHook(TaskHook):
     """Reserve the task's allocated device instances with their owning
@@ -362,7 +372,8 @@ class TaskRunner:
                  alloc_dir: AllocDir, node=None,
                  restart_policy: Optional[RestartPolicy] = None,
                  on_state_change=None, identity_signer=None,
-                 secrets_fetcher=None, device_manager=None):
+                 secrets_fetcher=None, device_manager=None,
+                 csi_paths=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -373,6 +384,8 @@ class TaskRunner:
         self.identity_signer = identity_signer
         self.secrets_fetcher = secrets_fetcher
         self.device_manager = device_manager
+        # alloc-level CSI attachments: volume name -> host path
+        self.csi_paths = csi_paths or {}
         self.identity_token: Optional[str] = None
         self.task_dir: Optional[TaskDir] = None
         self.env: Dict[str, str] = {}

@@ -1,0 +1,180 @@
+"""CSI plugins: controller + node services over the subprocess boundary
+(reference: /root/reference/plugins/csi -- the CSI gRPC client for
+controller/node services; here the same RPC surface over plugins/base
+JSON-RPC, spec-shaped: ControllerPublishVolume, NodeStageVolume,
+NodePublishVolume and their inverses).
+
+`CSIManager` is the client-agent side (reference: client/pluginmanager/
+csimanager): it owns one plugin subprocess per plugin_id, stages volumes
+under the client's data dir, and hands the task hooks a host path to
+bind into the sandbox."""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from .base import PluginClient, PluginError
+
+
+class CSIPluginClient:
+    """One CSI plugin subprocess exposing controller+node services."""
+
+    def __init__(self, argv: List[str]):
+        self.argv = list(argv)
+        self._lock = threading.Lock()
+        self._client = PluginClient(argv, "csi")
+        self.name = self._client.name or "csi"
+
+    def _rpc(self, method: str, **params):
+        with self._lock:
+            if not self._client.alive():
+                self._client.kill()
+                self._client = PluginClient(self.argv, "csi")
+        return self._client.call(method, **params)
+
+    def probe(self) -> dict:
+        return self._rpc("probe") or {}
+
+    def controller_publish(self, volume_id: str, node_id: str,
+                           readonly: bool = False) -> dict:
+        """-> publish context (reference: ControllerPublishVolume)."""
+        return self._rpc("controller_publish", volume_id=volume_id,
+                         node_id=node_id, readonly=readonly) or {}
+
+    def controller_unpublish(self, volume_id: str, node_id: str) -> None:
+        self._rpc("controller_unpublish", volume_id=volume_id,
+                  node_id=node_id)
+
+    def node_stage(self, volume_id: str, staging_path: str,
+                   publish_context: dict) -> None:
+        self._rpc("node_stage", volume_id=volume_id,
+                  staging_path=staging_path,
+                  publish_context=publish_context)
+
+    def node_publish(self, volume_id: str, staging_path: str,
+                     target_path: str, readonly: bool) -> str:
+        """-> the host path the volume is available at."""
+        res = self._rpc("node_publish", volume_id=volume_id,
+                        staging_path=staging_path,
+                        target_path=target_path, readonly=readonly) or {}
+        return str(res.get("path", target_path))
+
+    def node_unpublish(self, volume_id: str, target_path: str) -> None:
+        self._rpc("node_unpublish", volume_id=volume_id,
+                  target_path=target_path)
+
+    def node_unstage(self, volume_id: str, staging_path: str) -> None:
+        self._rpc("node_unstage", volume_id=volume_id,
+                  staging_path=staging_path)
+
+    def shutdown(self) -> None:
+        self._client.kill()
+
+
+class CSIManager:
+    """Client-side CSI volume lifecycle (reference:
+    client/pluginmanager/csimanager volume manager): stage-once,
+    publish-per-alloc under <data_dir>/csi/."""
+
+    def __init__(self, data_dir: str,
+                 plugins: Optional[Dict[str, List[str]]] = None):
+        self.base = os.path.join(data_dir, "csi")
+        self.plugins: Dict[str, CSIPluginClient] = {}
+        # one lock PER PLUGIN: a hung plugin must not stall other
+        # plugins' volumes; publish/unpublish state is derived from the
+        # filesystem layout (deterministic paths) so it survives
+        # client-agent restarts
+        self._locks: Dict[str, threading.Lock] = {}
+        for plugin_id, argv in (plugins or {}).items():
+            try:
+                self.plugins[plugin_id] = CSIPluginClient(argv)
+                self._locks[plugin_id] = threading.Lock()
+            except PluginError as e:
+                import sys
+                print(f"[nomad-tpu] csi plugin {plugin_id!r} failed: {e}",
+                      file=sys.stderr)
+
+    def plugin_ids(self) -> List[str]:
+        return sorted(self.plugins)
+
+    def _staging_path(self, volume_id: str) -> str:
+        return os.path.join(self.base, "staging",
+                            os.path.basename(volume_id) or "vol")
+
+    def _target_path(self, volume_id: str, alloc_id: str) -> str:
+        return os.path.join(self.base, "per-alloc", alloc_id,
+                            os.path.basename(volume_id) or "vol")
+
+    def _other_publishes(self, volume_id: str, alloc_id: str) -> bool:
+        """Any OTHER alloc still has this volume published (fs truth)."""
+        import glob
+        name = os.path.basename(volume_id) or "vol"
+        for p in glob.glob(os.path.join(self.base, "per-alloc", "*",
+                                        name)):
+            if os.path.basename(os.path.dirname(p)) != alloc_id:
+                return True
+        return False
+
+    def publish(self, plugin_id: str, volume_id: str, alloc_id: str,
+                node_id: str, readonly: bool) -> str:
+        """Full attach flow for one alloc: controller publish ->
+        node stage (once per volume) -> node publish. Returns the host
+        path to bind into the task sandbox."""
+        plugin = self.plugins.get(plugin_id)
+        if plugin is None:
+            raise PluginError(f"no csi plugin {plugin_id!r} on this node")
+        with self._locks[plugin_id]:
+            ctx = plugin.controller_publish(volume_id, node_id,
+                                            readonly=readonly)
+            staging = self._staging_path(volume_id)
+            # stage-once keyed on a marker written only AFTER a
+            # successful node_stage: a failed stage or completed unstage
+            # must re-stage, never silently publish from an unstaged dir
+            ok_marker = staging + ".ok"
+            if not os.path.exists(ok_marker):
+                os.makedirs(staging, exist_ok=True)
+                plugin.node_stage(volume_id, staging, ctx)
+                with open(ok_marker, "w") as fh:
+                    fh.write(volume_id)
+            target = self._target_path(volume_id, alloc_id)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            return plugin.node_publish(volume_id, staging, target,
+                                       readonly)
+
+    def unpublish(self, plugin_id: str, volume_id: str, alloc_id: str,
+                  node_id: str) -> None:
+        plugin = self.plugins.get(plugin_id)
+        if plugin is None:
+            return
+        with self._locks[plugin_id]:
+            target = self._target_path(volume_id, alloc_id)
+            try:
+                plugin.node_unpublish(volume_id, target)
+            except PluginError:
+                pass
+            try:
+                os.rmdir(os.path.dirname(target))
+            except OSError:
+                pass
+            if not self._other_publishes(volume_id, alloc_id):
+                staging = self._staging_path(volume_id)
+                try:
+                    plugin.node_unstage(volume_id, staging)
+                except PluginError:
+                    pass
+                for leftover in (staging + ".ok",):
+                    try:
+                        os.unlink(leftover)
+                    except OSError:
+                        pass
+                import shutil
+                shutil.rmtree(staging, ignore_errors=True)
+                try:
+                    plugin.controller_unpublish(volume_id, node_id)
+                except PluginError:
+                    pass
+
+    def shutdown(self) -> None:
+        for p in self.plugins.values():
+            p.shutdown()

@@ -1,0 +1,85 @@
+"""Example CSI plugin: the canonical hostpath driver (reference analog:
+kubernetes-csi/csi-driver-host-path behind plugins/csi). Volumes are
+directories under CSI_HOSTPATH_DIR; node_publish symlinks the staged
+volume dir at the target path.
+
+Run: CSI_HOSTPATH_DIR=/srv/vols python -m \
+        nomad_tpu.plugins.examples.hostpath_csi_plugin
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import serve
+
+BASE = os.environ.get("CSI_HOSTPATH_DIR", "/tmp/csi-hostpath")
+
+
+def _vol_dir(volume_id: str) -> str:
+    safe = os.path.basename(volume_id) or "vol"
+    return os.path.join(BASE, safe)
+
+
+def probe():
+    return {"ready": True, "name": "hostpath", "base": BASE}
+
+
+def controller_publish(volume_id, node_id, readonly=False):
+    os.makedirs(_vol_dir(volume_id), exist_ok=True)
+    return {"backing_dir": _vol_dir(volume_id)}
+
+
+def controller_unpublish(volume_id, node_id):
+    return True
+
+
+def node_stage(volume_id, staging_path, publish_context):
+    src = publish_context.get("backing_dir") or _vol_dir(volume_id)
+    os.makedirs(src, exist_ok=True)
+    marker = os.path.join(staging_path, ".staged")
+    os.makedirs(staging_path, exist_ok=True)
+    with open(marker, "w") as fh:
+        fh.write(src)
+    return True
+
+
+def node_publish(volume_id, staging_path, target_path, readonly):
+    src = _vol_dir(volume_id)
+    marker = os.path.join(staging_path, ".staged")
+    if os.path.exists(marker):
+        with open(marker) as fh:
+            src = fh.read().strip() or src
+    if os.path.islink(target_path) or os.path.exists(target_path):
+        return {"path": target_path}
+    os.makedirs(os.path.dirname(target_path), exist_ok=True)
+    os.symlink(src, target_path)
+    return {"path": target_path}
+
+
+def node_unpublish(volume_id, target_path):
+    if os.path.islink(target_path):
+        os.unlink(target_path)
+    return True
+
+
+def node_unstage(volume_id, staging_path):
+    marker = os.path.join(staging_path, ".staged")
+    if os.path.exists(marker):
+        os.unlink(marker)
+    return True
+
+
+def main() -> None:
+    serve({
+        "probe": probe,
+        "controller_publish": controller_publish,
+        "controller_unpublish": controller_unpublish,
+        "node_stage": node_stage,
+        "node_publish": node_publish,
+        "node_unpublish": node_unpublish,
+        "node_unstage": node_unstage,
+    }, plugin_type="csi", name="hostpath")
+
+
+if __name__ == "__main__":
+    main()

@@ -274,3 +274,121 @@ def test_volume_register_bad_capacity_is_400(server):
         assert err.value.status == 404
     finally:
         http.shutdown()
+
+
+def test_csi_plugin_end_to_end(tmp_path):
+    """Full CSI attach flow through a real plugin subprocess (reference:
+    plugins/csi controller/node services + csimanager; VERDICT
+    plugins/csi partial): register volume -> job claims it -> hostpath
+    plugin stages/publishes -> task writes through the mount -> detach
+    on stop."""
+    import os
+    import sys
+    import time as _time
+
+    from nomad_tpu import mock
+    from nomad_tpu.client import Client, LocalServerConn
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs import VolumeRequest
+    from nomad_tpu.structs.csi import CSIVolume
+
+    backing = tmp_path / "csi-backing"
+    backing.mkdir()
+    plugin_argv = [sys.executable, "-m",
+                   "nomad_tpu.plugins.examples.hostpath_csi_plugin"]
+    os.environ["CSI_HOSTPATH_DIR"] = str(backing)
+    try:
+        server = Server(num_workers=1, heartbeat_ttl=30.0)
+        server.start()
+        server.register_csi_volume(CSIVolume(
+            id="vol-e2e", namespace="default", name="vol-e2e",
+            plugin_id="hostpath"))
+        client = Client(LocalServerConn(server), str(tmp_path / "data"),
+                        name="csi-client",
+                        csi_plugins={"hostpath": plugin_argv})
+        client.start()
+        try:
+            deadline = _time.time() + 10
+            while _time.time() < deadline and \
+                    server.state.node_by_id(client.node.id) is None:
+                _time.sleep(0.05)
+            assert "hostpath" in server.state.node_by_id(
+                client.node.id).csi_node_plugins
+            job = mock.job(id="csi-e2e-job")
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.volumes = {"data": VolumeRequest(
+                name="data", type="csi", source="vol-e2e")}
+            tg.tasks[0].driver = "raw_exec"
+            tg.tasks[0].volume_mounts = [
+                {"volume": "data", "destination": "/voldata"}]
+            tg.tasks[0].config = {
+                "command": "/bin/sh",
+                "args": ["-c", "echo persisted > ../voldata/out.txt"]}
+            server.register_job(job)
+            deadline = _time.time() + 15
+            while _time.time() < deadline:
+                allocs = server.state.allocs_by_job("default",
+                                                    "csi-e2e-job")
+                if allocs and allocs[0].client_status == "complete":
+                    break
+                _time.sleep(0.05)
+            allocs = server.state.allocs_by_job("default", "csi-e2e-job")
+            assert allocs and allocs[0].client_status == "complete", \
+                [a.task_states for a in allocs]
+            # the write landed in the plugin's backing volume dir
+            assert (backing / "vol-e2e" / "out.txt").read_text().strip() \
+                == "persisted"
+            # claim lifecycle: recorded at plan apply, RELEASED by the
+            # volume watcher once the alloc is terminal (either state is
+            # a valid observation for a fast task; it must end released)
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                vol = server.state.csi_volume_by_id("default", "vol-e2e")
+                if not vol.write_claims:
+                    break
+                _time.sleep(0.05)
+            assert not vol.write_claims
+            assert vol.modify_index > vol.create_index
+        finally:
+            client.shutdown()
+            server.shutdown()
+    finally:
+        os.environ.pop("CSI_HOSTPATH_DIR", None)
+
+
+def test_csi_detach_on_alloc_stop_and_shared_staging(tmp_path):
+    """Alloc-level detach semantics: node_unpublish on stop, and the
+    staging/controller teardown only when no other alloc still uses the
+    volume (review findings: task-level detach pulled volumes out from
+    under siblings)."""
+    import sys
+
+    from nomad_tpu.plugins.csi import CSIManager
+
+    backing = tmp_path / "backing"
+    backing.mkdir()
+    plugin_argv = [sys.executable, "-m",
+                   "nomad_tpu.plugins.examples.hostpath_csi_plugin"]
+    import os as _os
+    _os.environ["CSI_HOSTPATH_DIR"] = str(backing)
+    try:
+        mgr = CSIManager(str(tmp_path / "client"),
+                         {"hostpath": plugin_argv})
+        p1 = mgr.publish("hostpath", "vol-1", "alloc-a", "node-1", False)
+        p2 = mgr.publish("hostpath", "vol-1", "alloc-b", "node-1", False)
+        assert _os.path.exists(p1) and _os.path.exists(p2)
+        staging = mgr._staging_path("vol-1")
+        assert _os.path.exists(_os.path.join(staging, ".staged"))
+        # alloc-a detaches: its publish goes away, staging SURVIVES
+        mgr.unpublish("hostpath", "vol-1", "alloc-a", "node-1")
+        assert not _os.path.lexists(p1)
+        assert _os.path.lexists(p2)
+        assert _os.path.exists(_os.path.join(staging, ".staged"))
+        # last alloc detaches: staging torn down too
+        mgr.unpublish("hostpath", "vol-1", "alloc-b", "node-1")
+        assert not _os.path.lexists(p2)
+        assert not _os.path.exists(_os.path.join(staging, ".staged"))
+        mgr.shutdown()
+    finally:
+        _os.environ.pop("CSI_HOSTPATH_DIR", None)
