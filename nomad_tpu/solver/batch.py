@@ -85,7 +85,8 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
         groups.setdefault(lane.fuse_key(), []).append(i)
 
     for key, idxs in groups.items():
-        dtype_name, spread_alg = key[-2], key[-1]
+        dtype_name = lanes[idxs[0]].dtype_name
+        spread_alg = lanes[idxs[0]].spread_alg
         A = 1 if lanes[idxs[0]].ptab is not None else 0
         e_real = len(idxs)
         e_pad = _e_bucket(e_real)
@@ -129,7 +130,8 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
                 for k in lane0.pinit._fields])
 
         out = _dispatch(const, init, batch, spread_alg, dtype_name,
-                        use_mesh, ptab=ptab, pinit=pinit)
+                        use_mesh, ptab=ptab, pinit=pinit,
+                        wave=lanes[idxs[0]].wavefront_ok())
         if A > 0:
             chosen, scores, n_yielded, evict_rows = out
         else:
@@ -146,10 +148,13 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
 
 
 def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
-              use_mesh: bool, ptab=None, pinit=None):
+              use_mesh: bool, ptab=None, pinit=None, wave: bool = False):
     """One solve_eval_batch[_preempt] call; shards over an (evals, nodes)
     mesh when multiple devices are attached and the shapes divide the
-    mesh (non-preempt path only; preemption tables stay single-device)."""
+    mesh (non-preempt path only; preemption tables stay single-device).
+    ``wave`` (homogeneous by fuse_key) routes the group through the
+    wavefront kernel -- its per-step work is O(B), so it skips mesh
+    sharding (nothing N-heavy to shard)."""
     import jax
     import jax.numpy as jnp
 
@@ -159,6 +164,10 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         return solve_lane_fused(const, init, batch, ptab, pinit,
                                 spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True)
+    if wave:
+        return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
+                                dtype_name=dtype_name, batched=True,
+                                wave=True)
 
     E = const.cpu_cap.shape[0]
     N = const.cpu_cap.shape[1]

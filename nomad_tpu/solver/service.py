@@ -50,7 +50,7 @@ class PackedLane:
 
     __slots__ = ("service", "tg", "places", "nodes", "order", "const",
                  "init", "batch", "dtype_name", "spread_alg", "ptab",
-                 "pinit", "cand_allocs")
+                 "pinit", "cand_allocs", "_wave")
 
     def __init__(self, service, tg, places, nodes, order, const, init,
                  batch, dtype_name, spread_alg, ptab=None, pinit=None,
@@ -70,6 +70,44 @@ class PackedLane:
         self.ptab = ptab
         self.pinit = pinit
         self.cand_allocs = cand_allocs
+        self._wave = None
+
+    def wavefront_ok(self) -> bool:
+        """Can this lane route through the O(B)-per-step wavefront kernel
+        (binpack._solve_wavefront_impl)? Requires uniform asks over the
+        active prefix and none of the node-coupling carries (spreads,
+        distinct_property, devices, cores, penalties, preemption)."""
+        if self._wave is not None:
+            return self._wave
+        self._wave = self._wavefront_check()
+        return self._wave
+
+    def _wavefront_check(self) -> bool:
+        import os
+        from .binpack import MAX_SKIP, WAVE_B
+        if os.environ.get("NOMAD_TPU_WAVEFRONT", "1") == "0":
+            return False
+        if self.ptab is not None:
+            return False
+        c = self.const
+        if (c.spread_vidx.shape[0] or c.dp_vidx.shape[0]
+                or c.dev_aff.shape[0] or c.mhz_per_core.shape[0]):
+            return False
+        b = self.batch
+        act = np.asarray(b.active)
+        n_act = int(act.sum())
+        if n_act == 0 or not act[:n_act].all():      # active must be prefix
+            return False
+        for arr in (b.ask_cpu, b.ask_mem, b.ask_disk, b.n_dyn_ports,
+                    b.has_static, b.limit, b.count):
+            v = np.asarray(arr)[:n_act]
+            if not (v == v[0]).all():
+                return False
+        if not (np.asarray(b.penalty_idx)[:n_act] == -1).all():
+            return False
+        if int(np.asarray(b.limit)[0]) + MAX_SKIP > WAVE_B:
+            return False
+        return True
 
     def fuse_key(self) -> tuple:
         """Lanes with equal keys can fuse into one vmapped dispatch: every
@@ -85,7 +123,8 @@ class PackedLane:
                 self.const.dev_aff.shape[:2],         # (R, Gd)
                 self.ptab.cpu.shape[1] if self.ptab is not None else 0,
                 self.pinit.counts.shape[0] if self.pinit is not None else 0,
-                self.dtype_name, self.spread_alg)
+                self.dtype_name, self.spread_alg,
+                self.wavefront_ok())
 
 
 def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
@@ -132,7 +171,8 @@ def dispatch_lane(lane: PackedLane):
 
     return solve_lane_fused(
         lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
-        spread_alg=lane.spread_alg, dtype_name=lane.dtype_name)
+        spread_alg=lane.spread_alg, dtype_name=lane.dtype_name,
+        wave=lane.wavefront_ok())
 
 
 class _DeviceShim:

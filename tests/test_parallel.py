@@ -63,10 +63,12 @@ def test_sharded_parity_at_padded_scale():
                                np.asarray(sharded[1]), rtol=0, atol=0)
 
 
-def test_batch_worker_mesh_branch_end_to_end():
+def test_batch_worker_mesh_branch_end_to_end(monkeypatch):
     """BatchWorker(use_mesh=True) over the virtual mesh: the fused batch
     must dispatch through solver/batch.py's mesh branch (asserted via the
-    mesh_dispatches counter) and place every alloc correctly."""
+    mesh_dispatches counter) and place every alloc correctly. Wavefront
+    routing is pinned off -- eligible lanes would otherwise take the O(B)
+    kernel, which deliberately skips mesh sharding (nothing N-heavy)."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     import time as _time
@@ -76,6 +78,7 @@ def test_batch_worker_mesh_branch_end_to_end():
     from nomad_tpu.server.telemetry import metrics
     from nomad_tpu.structs import SchedulerConfiguration
 
+    monkeypatch.setenv("NOMAD_TPU_WAVEFRONT", "0")
     metrics.reset()
     server = Server(num_workers=4, heartbeat_ttl=30.0, eval_batching=True,
                     batch_width=4)

@@ -1,0 +1,204 @@
+"""Wavefront kernel vs dense scan kernel: identical outputs on eligible
+lanes (binpack._solve_wavefront_impl vs _solve_placements_impl).
+
+The wavefront kernel is the production fast path for uniform-ask lanes
+(solver/service.py PackedLane.wavefront_ok); the dense scan is the
+oracle-parity-proven reference. Fuzzes worlds over the coupling-free
+feature set: static/dynamic ports, distinct_hosts (tg and job level),
+affinities off (limit stays log2), exhaustion, low-score skips.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu.solver.binpack import (
+    NodeConst, NodeState, PlacementBatch,
+    solve_placements, solve_wavefront, _solve_wavefront_impl,
+)
+
+
+def _world(rng, n, p, *, ask=(500, 256, 300), n_dyn=0, has_static=False,
+           distinct=False, job_level=False, limit=4, count=None,
+           low_score=False, seed_usage=True, affinity=False):
+    dtype = np.float64
+    cpu_cap = np.array([rng.choice([2000, 4000, 8000]) for _ in range(n)],
+                       dtype=dtype)
+    mem_cap = np.array([rng.choice([4096, 8192, 16384]) for _ in range(n)],
+                       dtype=dtype)
+    disk_cap = np.full(n, 90 * 1024, dtype=dtype)
+    used_cpu = np.zeros(n, dtype=dtype)
+    used_mem = np.zeros(n, dtype=dtype)
+    used_disk = np.zeros(n, dtype=dtype)
+    placed = np.zeros(n, dtype=np.int32)
+    placed_job = np.zeros(n, dtype=np.int32)
+    if seed_usage:
+        for i in range(n):
+            k = rng.randint(0, 3)
+            used_cpu[i] = k * rng.choice([250, 500, 1000])
+            used_mem[i] = k * rng.choice([256, 512, 1024])
+            used_disk[i] = k * 150
+    if low_score:
+        # give some nodes existing same-job+tg allocs so the anti-affinity
+        # term drives final scores <= 0 (exercises the skip rule)
+        for i in range(0, n, 3):
+            placed[i] = rng.randint(1, 4)
+            placed_job[i] = placed[i] + rng.randint(0, 2)
+    feasible = np.array([rng.random() > 0.15 for _ in range(n)])
+    aff = np.zeros(n, dtype=dtype)
+    if affinity:
+        # sparse normalized affinity boosts/penalties, incl. exact zeros
+        # (aff_present must key off != 0, not the has_affinity flag)
+        for i in range(n):
+            if rng.random() < 0.5:
+                aff[i] = rng.choice([-1.0, -0.5, 0.25, 0.5, 1.0])
+    const = NodeConst(
+        cpu_cap=cpu_cap, mem_cap=mem_cap, disk_cap=disk_cap,
+        feasible=feasible,
+        affinity=aff,
+        has_affinity=np.asarray(bool(affinity)),
+        distinct_hosts=np.asarray(distinct),
+        distinct_job_level=np.asarray(job_level),
+        spread_vidx=np.zeros((0, n), dtype=np.int32),
+        spread_desired=np.zeros((0, 1), dtype=dtype),
+        spread_has_targets=np.zeros(0, dtype=bool),
+        spread_weights=np.zeros(0, dtype=dtype),
+        spread_sum_weights=np.asarray(0.0, dtype=dtype),
+        n_spreads=np.asarray(0, dtype=np.int32))
+    init = NodeState(
+        used_cpu=used_cpu, used_mem=used_mem, used_disk=used_disk,
+        placed=placed, placed_job=placed_job,
+        static_free=np.array([rng.random() > 0.3 for _ in range(n)])
+        if has_static else np.ones(n, dtype=bool),
+        dyn_avail=np.array([rng.randint(0, 40) for _ in range(n)],
+                           dtype=np.int32),
+        spread_counts=np.zeros((0, 1), dtype=np.int32))
+    count = count if count is not None else p
+    batch = PlacementBatch(
+        ask_cpu=np.full(p, float(ask[0]), dtype=dtype),
+        ask_mem=np.full(p, float(ask[1]), dtype=dtype),
+        ask_disk=np.full(p, float(ask[2]), dtype=dtype),
+        n_dyn_ports=np.full(p, n_dyn, dtype=np.int32),
+        has_static=np.full(p, has_static, dtype=bool),
+        limit=np.full(p, limit, dtype=np.int32),
+        count=np.full(p, count, dtype=np.int32),
+        penalty_idx=np.full(p, -1, dtype=np.int32),
+        active=np.ones(p, dtype=bool))
+    return const, init, batch
+
+
+def _compare(const, init, batch, spread_alg=False):
+    chosen_d, scores_d, ny_d, _ = solve_placements(
+        const, init, batch, spread_alg=spread_alg, dtype_name="float64")
+    chosen_w, scores_w, ny_w = solve_wavefront(
+        const, init, batch, spread_alg=spread_alg, dtype_name="float64")
+    chosen_d, scores_d, ny_d = (np.asarray(chosen_d), np.asarray(scores_d),
+                                np.asarray(ny_d))
+    chosen_w, scores_w, ny_w = (np.asarray(chosen_w), np.asarray(scores_w),
+                                np.asarray(ny_w))
+    np.testing.assert_array_equal(chosen_w, chosen_d)
+    np.testing.assert_array_equal(ny_w, ny_d)
+    sel = chosen_d >= 0
+    np.testing.assert_allclose(scores_w[sel], scores_d[sel], rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_plain_binpack_parity(seed):
+    rng = random.Random(seed)
+    const, init, batch = _world(rng, n=40, p=30, limit=6)
+    _compare(const, init, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_spread_algorithm_parity(seed):
+    rng = random.Random(100 + seed)
+    const, init, batch = _world(rng, n=40, p=30, limit=6)
+    _compare(const, init, batch, spread_alg=True)
+
+
+def test_exhaustion_runs_dry():
+    rng = random.Random(7)
+    # tiny fleet, big asks: placements outrun capacity -> trailing -1s
+    const, init, batch = _world(rng, n=6, p=40, ask=(1500, 2048, 300),
+                                limit=3)
+    chosen_w, _, _ = solve_wavefront(
+        const, init, batch, dtype_name="float64")
+    assert (np.asarray(chosen_w) == -1).any()
+    _compare(const, init, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_distinct_hosts_parity(seed):
+    rng = random.Random(200 + seed)
+    const, init, batch = _world(rng, n=50, p=35, distinct=True,
+                                job_level=bool(seed % 2), limit=6)
+    _compare(const, init, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ports_parity(seed):
+    rng = random.Random(300 + seed)
+    const, init, batch = _world(rng, n=40, p=30, n_dyn=7,
+                                has_static=True, limit=5)
+    _compare(const, init, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_affinity_parity(seed):
+    """Affinity lanes are production-ineligible today (their limit is
+    >= 100 > WAVE_B - MAX_SKIP), but the kernel carries the scoring term
+    (slot column 6 + the aff_present nscores component) -- keep it honest
+    against the dense oracle at kernel level."""
+    rng = random.Random(600 + seed)
+    const, init, batch = _world(rng, n=40, p=30, limit=6, affinity=True)
+    _compare(const, init, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_low_score_skip_parity(seed):
+    """Anti-affinity on seeded same-job allocs pushes finals <= 0,
+    exercising the LimitIterator skip rule and its fallback."""
+    rng = random.Random(400 + seed)
+    const, init, batch = _world(rng, n=30, p=40, low_score=True,
+                                count=1, limit=4)
+    _compare(const, init, batch)
+
+
+def test_padded_inactive_tail():
+    """Batched fusion pads the placement axis with inert rows; the active
+    prefix must match the dense kernel (tails are sliced off by callers)."""
+    rng = random.Random(11)
+    const, init, batch = _world(rng, n=40, p=32, limit=6)
+    act = np.ones(32, dtype=bool)
+    act[20:] = False
+    batch = batch._replace(active=act)
+    chosen_d, scores_d, ny_d, _ = solve_placements(
+        const, init, batch, dtype_name="float64")
+    chosen_w, scores_w, ny_w = solve_wavefront(
+        const, init, batch, dtype_name="float64")
+    np.testing.assert_array_equal(np.asarray(chosen_w)[:20],
+                                  np.asarray(chosen_d)[:20])
+    np.testing.assert_array_equal(np.asarray(ny_w)[:20],
+                                  np.asarray(ny_d)[:20])
+    assert (np.asarray(chosen_w)[20:] == -1).all()
+
+
+def test_batched_vmap_matches_single():
+    import jax
+    rng = random.Random(21)
+    lanes = [_world(random.Random(500 + k), n=24, p=16, limit=5)
+             for k in range(4)]
+    const = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[0] for l in lanes])
+    init = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                  *[l[1] for l in lanes])
+    batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[2] for l in lanes])
+    import functools
+    inner = functools.partial(_solve_wavefront_impl, dtype_name="float64")
+    chosen_b, scores_b, ny_b = jax.vmap(inner)(const, init, batch)
+    for k, (c, i, b) in enumerate(lanes):
+        c1, s1, y1 = solve_wavefront(c, i, b, dtype_name="float64")
+        np.testing.assert_array_equal(np.asarray(chosen_b)[k],
+                                      np.asarray(c1))
+        np.testing.assert_array_equal(np.asarray(ny_b)[k], np.asarray(y1))
