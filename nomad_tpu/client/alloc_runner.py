@@ -198,9 +198,9 @@ class AllocRunner:
     # -- restore (reference: alloc_runner.go:455 Restore) --------------
     def restore(self, task_states: Dict[str, TaskState],
                 handles: Dict[str, object]) -> bool:
-        self._restored = True
         """Re-attach task runners to live tasks. Returns True if any task
         was recovered running."""
+        self._restored = True
         self.alloc_dir.build()
         tg = (self.alloc.job.lookup_task_group(self.alloc.task_group)
               if self.alloc.job else None)
@@ -298,6 +298,10 @@ class AllocRunner:
                 pass
         self._csi_attached = []
         self.csi_paths = {}
+        # once detached, never re-derive: destroy() after a restored
+        # alloc's watch-thread detach must not issue a second round of
+        # unpublish/unstage RPCs
+        self._restored = False
 
     def _watch_restored(self) -> None:
         while not self._kill.is_set():

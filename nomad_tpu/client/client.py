@@ -146,16 +146,7 @@ class Client:
         if self.device_manager is not None:
             self.node.node_resources.devices.extend(
                 self.device_manager.all_devices())
-        if self.csi_manager is not None:
-            for pid in self.csi_manager.plugin_ids():
-                # health comes from the plugin's own probe, not blind
-                # optimism: an unready plugin must not attract placements
-                try:
-                    ready = bool(self.csi_manager.plugins[pid]
-                                 .probe().get("ready", False))
-                except Exception:  # noqa: BLE001 -- plugin failure
-                    ready = False
-                self.node.csi_node_plugins[pid] = {"healthy": ready}
+        self._probe_csi_health()
         self.node.compute_class()
         # restore node identity across restarts
         prev = self.state_db.node_id()
@@ -230,6 +221,29 @@ class Client:
             runner.restore(states, handles)
 
     # -- heartbeats (reference: registerAndHeartbeat :1734) ------------
+    def _probe_csi_health(self) -> bool:
+        """Probe every CSI plugin's own readiness into
+        node.csi_node_plugins; returns True when any health flag changed.
+        Health comes from the plugin's probe, not blind optimism: an
+        unready plugin must not attract placements -- and a plugin that
+        becomes ready later must not leave the node ineligible forever,
+        so the heartbeat loop re-probes (reference: csimanager's
+        periodic fingerprint loop)."""
+        if self.csi_manager is None:
+            return False
+        changed = False
+        for pid in self.csi_manager.plugin_ids():
+            try:
+                ready = bool(self.csi_manager.plugins[pid]
+                             .probe().get("ready", False))
+            except Exception:  # noqa: BLE001 -- plugin failure
+                ready = False
+            prev = self.node.csi_node_plugins.get(pid, {}).get("healthy")
+            if prev != ready:
+                changed = True
+            self.node.csi_node_plugins[pid] = {"healthy": ready}
+        return changed
+
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             interval = max(self.heartbeat_ttl / 3.0, 0.05)
@@ -238,6 +252,10 @@ class Client:
             if self._frozen.is_set():
                 continue
             try:
+                if self._probe_csi_health():
+                    # changed plugin health must reach the scheduler's
+                    # feasibility view
+                    self.conn.register_node(self.node)
                 ttl = self.conn.heartbeat(self.node.id)
                 if ttl:
                     self.heartbeat_ttl = ttl

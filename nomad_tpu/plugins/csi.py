@@ -98,18 +98,29 @@ class CSIManager:
     def plugin_ids(self) -> List[str]:
         return sorted(self.plugins)
 
-    def _staging_path(self, volume_id: str) -> str:
+    @staticmethod
+    def _vol_key(plugin_id: str, volume_id: str) -> str:
+        """Deterministic filesystem-safe name for (plugin, volume):
+        distinct volumes must never share staging/publish paths (ids may
+        contain '/', glob metacharacters, or collide on basename across
+        plugins), and detach re-derives these paths after agent restarts."""
+        from urllib.parse import quote
+        return quote(f"{plugin_id}--{volume_id}", safe="") or "vol"
+
+    def _staging_path(self, plugin_id: str, volume_id: str) -> str:
         return os.path.join(self.base, "staging",
-                            os.path.basename(volume_id) or "vol")
+                            self._vol_key(plugin_id, volume_id))
 
-    def _target_path(self, volume_id: str, alloc_id: str) -> str:
+    def _target_path(self, plugin_id: str, volume_id: str,
+                     alloc_id: str) -> str:
         return os.path.join(self.base, "per-alloc", alloc_id,
-                            os.path.basename(volume_id) or "vol")
+                            self._vol_key(plugin_id, volume_id))
 
-    def _other_publishes(self, volume_id: str, alloc_id: str) -> bool:
+    def _other_publishes(self, plugin_id: str, volume_id: str,
+                         alloc_id: str) -> bool:
         """Any OTHER alloc still has this volume published (fs truth)."""
         import glob
-        name = os.path.basename(volume_id) or "vol"
+        name = glob.escape(self._vol_key(plugin_id, volume_id))
         for p in glob.glob(os.path.join(self.base, "per-alloc", "*",
                                         name)):
             if os.path.basename(os.path.dirname(p)) != alloc_id:
@@ -127,7 +138,7 @@ class CSIManager:
         with self._locks[plugin_id]:
             ctx = plugin.controller_publish(volume_id, node_id,
                                             readonly=readonly)
-            staging = self._staging_path(volume_id)
+            staging = self._staging_path(plugin_id, volume_id)
             # stage-once keyed on a marker written only AFTER a
             # successful node_stage: a failed stage or completed unstage
             # must re-stage, never silently publish from an unstaged dir
@@ -137,7 +148,7 @@ class CSIManager:
                 plugin.node_stage(volume_id, staging, ctx)
                 with open(ok_marker, "w") as fh:
                     fh.write(volume_id)
-            target = self._target_path(volume_id, alloc_id)
+            target = self._target_path(plugin_id, volume_id, alloc_id)
             os.makedirs(os.path.dirname(target), exist_ok=True)
             return plugin.node_publish(volume_id, staging, target,
                                        readonly)
@@ -148,7 +159,7 @@ class CSIManager:
         if plugin is None:
             return
         with self._locks[plugin_id]:
-            target = self._target_path(volume_id, alloc_id)
+            target = self._target_path(plugin_id, volume_id, alloc_id)
             try:
                 plugin.node_unpublish(volume_id, target)
             except PluginError:
@@ -157,8 +168,8 @@ class CSIManager:
                 os.rmdir(os.path.dirname(target))
             except OSError:
                 pass
-            if not self._other_publishes(volume_id, alloc_id):
-                staging = self._staging_path(volume_id)
+            if not self._other_publishes(plugin_id, volume_id, alloc_id):
+                staging = self._staging_path(plugin_id, volume_id)
                 try:
                     plugin.node_unstage(volume_id, staging)
                 except PluginError:

@@ -378,7 +378,7 @@ def test_csi_detach_on_alloc_stop_and_shared_staging(tmp_path):
         p1 = mgr.publish("hostpath", "vol-1", "alloc-a", "node-1", False)
         p2 = mgr.publish("hostpath", "vol-1", "alloc-b", "node-1", False)
         assert _os.path.exists(p1) and _os.path.exists(p2)
-        staging = mgr._staging_path("vol-1")
+        staging = mgr._staging_path("hostpath", "vol-1")
         assert _os.path.exists(_os.path.join(staging, ".staged"))
         # alloc-a detaches: its publish goes away, staging SURVIVES
         mgr.unpublish("hostpath", "vol-1", "alloc-a", "node-1")
