@@ -169,10 +169,13 @@ class Client:
     def start(self) -> None:
         self.restore()
         self.conn.register_node(self.node)
-        for fn, label in ((self._heartbeat_loop, "heartbeat"),
-                          (self._watch_allocations, "alloc-watch"),
-                          (self._health_loop, "health"),
-                          (self._heartbeatstop_loop, "heartbeatstop")):
+        loops = [(self._heartbeat_loop, "heartbeat"),
+                 (self._watch_allocations, "alloc-watch"),
+                 (self._health_loop, "health"),
+                 (self._heartbeatstop_loop, "heartbeatstop")]
+        if self.csi_manager is not None:
+            loops.append((self._csi_fingerprint_loop, "csi-fingerprint"))
+        for fn, label in loops:
             t = threading.Thread(target=fn, daemon=True,
                                  name=f"client-{label}-{self.node.name}")
             t.start()
@@ -244,10 +247,13 @@ class Client:
             self.node.csi_node_plugins[pid] = {"healthy": ready}
         return changed
 
-    def _heartbeat_loop(self) -> None:
+    def _csi_fingerprint_loop(self) -> None:
+        """Periodic plugin health re-probe on its OWN thread (reference:
+        csimanager's fingerprint loop): plugin RPCs are blocking pipe
+        calls, and a wedged plugin subprocess must never stall the
+        heartbeat thread into a server-side node-down sweep."""
         while not self._shutdown.is_set():
-            interval = max(self.heartbeat_ttl / 3.0, 0.05)
-            if self._shutdown.wait(interval):
+            if self._shutdown.wait(5.0):
                 return
             if self._frozen.is_set():
                 continue
@@ -256,6 +262,17 @@ class Client:
                     # changed plugin health must reach the scheduler's
                     # feasibility view
                     self.conn.register_node(self.node)
+            except Exception:  # noqa: BLE001 -- server unreachable
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            interval = max(self.heartbeat_ttl / 3.0, 0.05)
+            if self._shutdown.wait(interval):
+                return
+            if self._frozen.is_set():
+                continue
+            try:
                 ttl = self.conn.heartbeat(self.node.id)
                 if ttl:
                     self.heartbeat_ttl = ttl

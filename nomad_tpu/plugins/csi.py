@@ -103,9 +103,11 @@ class CSIManager:
         """Deterministic filesystem-safe name for (plugin, volume):
         distinct volumes must never share staging/publish paths (ids may
         contain '/', glob metacharacters, or collide on basename across
-        plugins), and detach re-derives these paths after agent restarts."""
+        plugins), and detach re-derives these paths after agent restarts.
+        Components are quoted SEPARATELY and joined with '@' -- quote()
+        escapes '@' inside components, so the join is unambiguous."""
         from urllib.parse import quote
-        return quote(f"{plugin_id}--{volume_id}", safe="") or "vol"
+        return quote(plugin_id, safe="") + "@" + quote(volume_id, safe="")
 
     def _staging_path(self, plugin_id: str, volume_id: str) -> str:
         return os.path.join(self.base, "staging",

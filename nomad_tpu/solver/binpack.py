@@ -831,8 +831,7 @@ def _fuse_trees(trees):
 
 
 def _make_fused_fn(metas, treedef, group_keys, spread_alg: bool,
-                   dtype_name: str, preempt: bool, batched: bool,
-                   wave: bool = False):
+                   dtype_name: str, preempt: bool, batched: bool):
     gpos = {k: i for i, k in enumerate(group_keys)}
 
     def rebuild(buffers):
@@ -861,21 +860,6 @@ def _make_fused_fn(metas, treedef, group_keys, spread_alg: bool,
             return out, evict_rows
         return fn
 
-    if wave:
-        inner_w = functools.partial(_solve_wavefront_impl,
-                                    spread_alg=spread_alg,
-                                    dtype_name=dtype_name)
-        if batched:
-            inner_w = jax.vmap(inner_w)
-
-        @jax.jit
-        def fn_w(*buffers):
-            const, init, batch = rebuild(buffers)
-            chosen, scores, n_yielded = inner_w(const, init, batch)
-            return jnp.stack([chosen.astype(scores.dtype), scores,
-                              n_yielded.astype(scores.dtype)])
-        return fn_w
-
     inner = functools.partial(_solve_placements_impl, spread_alg=spread_alg,
                               dtype_name=dtype_name)
     if batched:
@@ -896,19 +880,22 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
     """Solve with minimal transfers: returns host-side numpy
     (chosen int64, scores, n_yielded int64[, evict_rows]). When ``batched``
     every leaf carries a leading eval axis and outputs do too. ``wave``
-    routes through the O(B)-per-step wavefront kernel (caller must have
-    checked eligibility). Stacking chosen/n_yielded through the score dtype
+    routes through the wavefront path (caller must have checked
+    eligibility): host-side O(N) precompute + compact-table device scan
+    (solve_lane_wave). Stacking chosen/n_yielded through the score dtype
     is exact: node indexes and yield counts are < 2^24."""
+    if wave and ptab is None:
+        return solve_lane_wave(const, init, batch, spread_alg=spread_alg,
+                               dtype_name=dtype_name, batched=batched)
     trees = ((const, init, batch) if ptab is None
              else (const, init, batch, ptab, pinit))
     stacked, metas, treedef, group_keys = _fuse_trees(trees)
     sig = (metas, treedef, group_keys, spread_alg, dtype_name,
-           ptab is not None, batched, wave)
+           ptab is not None, batched)
     fn = _FUSED_CACHE.get(sig)
     if fn is None:
         fn = _make_fused_fn(metas, treedef, group_keys, spread_alg,
-                            dtype_name, ptab is not None, batched,
-                            wave=wave)
+                            dtype_name, ptab is not None, batched)
         _FUSED_CACHE[sig] = fn
     buffers = jax.device_put(stacked)
     out = fn(*buffers)
@@ -1139,6 +1126,234 @@ def _solve_wavefront_impl(const: NodeConst, init: NodeState,
 solve_wavefront = functools.partial(
     jax.jit, static_argnames=("spread_alg", "dtype_name"))(
         _solve_wavefront_impl)
+
+
+# -- compact wavefront: host-side O(N) precompute, device-side scan --------
+#
+# The wavefront scan only ever reads the first C = P + B fit-order rows, so
+# the O(N) precompute (capacity fold + fit-order compress + row gather) runs
+# on the HOST in numpy and only the compact (C, 8) table crosses the
+# host->device boundary: ~65KB/lane instead of ~0.5MB of N-sized tables.
+# Over a tunneled TPU the transfer dominated the whole dispatch; on local
+# hardware it still cuts per-dispatch HBM traffic E-fold in fused batches.
+# The float predicates here MUST mirror _solve_wavefront_impl / the dense
+# kernel op-for-op (IEEE ops agree between numpy and XLA) so placements
+# stay bit-identical.
+
+def wavefront_compact_host(const, init, batch, dtype_name: str):
+    """Numpy precompute for ONE lane: returns (compact (C, 8), scal_f (3,),
+    scal_i (2,)). Columns: c, used_cpu, used_mem, cpu_cap, mem_cap,
+    placed, affinity, pos(sentinel -1)."""
+    dt = np.dtype(dtype_name)
+    P = int(np.asarray(batch.ask_cpu).shape[0])
+    B = WAVE_B
+    N = int(np.asarray(const.cpu_cap).shape[0])
+    ask_cpu = np.asarray(batch.ask_cpu, dtype=dt)[0]
+    ask_mem = np.asarray(batch.ask_mem, dtype=dt)[0]
+    ask_disk = np.asarray(batch.ask_disk, dtype=dt)[0]
+    n_dyn = int(np.asarray(batch.n_dyn_ports)[0])
+    has_static = bool(np.asarray(batch.has_static)[0])
+    count = np.asarray(batch.count, dtype=dt)[0]
+    L = int(np.asarray(batch.limit)[0])
+    n_active = int(np.asarray(batch.active).sum())
+
+    BIG = np.int64(2 ** 30)
+    cpu_cap = np.asarray(const.cpu_cap, dtype=dt)
+    mem_cap = np.asarray(const.mem_cap, dtype=dt)
+    disk_cap = np.asarray(const.disk_cap, dtype=dt)
+    used_cpu = np.asarray(init.used_cpu, dtype=dt)
+    used_mem = np.asarray(init.used_mem, dtype=dt)
+    used_disk = np.asarray(init.used_disk, dtype=dt)
+
+    def cap_dim(used0, cap, ask):
+        with np.errstate(divide="ignore", invalid="ignore",
+                         over="ignore"):
+            q = np.floor((cap - used0) / np.maximum(ask, dt.type(1e-9)))
+        q = np.where(np.isfinite(q), q, 0).astype(np.int64)
+
+        def fits(m):
+            return used0 + m.astype(dt) * ask <= cap
+
+        q = np.where(fits(q), q, q - 1)
+        q = np.where(fits(q), q, q - 1)
+        q = np.maximum(q, 0)
+        q = np.where(fits(q + 1), q + 1, q)
+        q = np.where(fits(q + 1), q + 1, q)
+        q = np.where(fits(q), q, 0)
+        return np.where(ask > 0, q, BIG)
+
+    c = np.minimum(cap_dim(used_cpu, cpu_cap, ask_cpu),
+                   cap_dim(used_mem, mem_cap, ask_mem))
+    c = np.minimum(c, cap_dim(used_disk, disk_cap, ask_disk))
+    if n_dyn > 0:
+        c = np.minimum(c, np.asarray(init.dyn_avail, dtype=np.int64)
+                       // n_dyn)
+    if has_static:
+        c = np.minimum(c, np.where(np.asarray(init.static_free), 1, 0))
+    if bool(np.asarray(const.distinct_hosts)):
+        distinct0 = (np.asarray(init.placed_job)
+                     if bool(np.asarray(const.distinct_job_level))
+                     else np.asarray(init.placed))
+        c = np.minimum(c, np.where(distinct0 > 0, 0, 1))
+    c = np.where(np.asarray(const.feasible), c, 0)
+    c = np.clip(c, 0, P)
+
+    aff = (np.asarray(const.affinity, dtype=dt)
+           if bool(np.asarray(const.has_affinity))
+           else np.zeros(N, dtype=dt))
+
+    fit_pos = np.nonzero(c > 0)[0][:P + B]
+    C = P + B
+    compact = np.zeros((C, 8), dtype=dt)
+    compact[:, 7] = -1.0
+    k = fit_pos.shape[0]
+    compact[:k, 0] = c[fit_pos]
+    compact[:k, 1] = used_cpu[fit_pos]
+    compact[:k, 2] = used_mem[fit_pos]
+    compact[:k, 3] = cpu_cap[fit_pos]
+    compact[:k, 4] = mem_cap[fit_pos]
+    compact[:k, 5] = np.asarray(init.placed)[fit_pos].astype(dt)
+    compact[:k, 6] = aff[fit_pos]
+    compact[:k, 7] = fit_pos.astype(dt)
+    scal_f = np.array([ask_cpu, ask_mem, count], dtype=dt)
+    scal_i = np.array([L, n_active], dtype=np.int32)
+    return compact, scal_f, scal_i
+
+
+def _solve_wave_compact_impl(compact, scal_f, scal_i,
+                             spread_alg: bool = False,
+                             dtype_name: str = "float32"):
+    """Device-side scan over a host-precomputed compact table; identical
+    outputs to _solve_wavefront_impl (P = C - WAVE_B)."""
+    dtype = jnp.dtype(dtype_name)
+    C = compact.shape[0]
+    B = WAVE_B
+    P = C - B
+    ask_cpu = scal_f[0]
+    ask_mem = scal_f[1]
+    count = scal_f[2]
+    L = scal_i[0]
+    n_active = scal_i[1]
+
+    slot0 = compact[:B]
+    j0 = jnp.zeros(B, dtype=jnp.int32)
+    cursor0 = jnp.int32(B)
+    arangeB = jnp.arange(B, dtype=jnp.int32)
+    arangeC = jnp.arange(C, dtype=jnp.int32)
+    neg_inf = jnp.array(-jnp.inf, dtype=dtype)
+    big = jnp.iinfo(jnp.int32).max
+
+    def step(carry, i):
+        j, slot, cursor = carry
+        cs = slot[:, 0]
+        fit = j.astype(dtype) < cs            # sentinel rows: c = 0
+        jp1 = (j + 1).astype(dtype)
+        new_cpu = slot[:, 1] + jp1 * ask_cpu
+        new_mem = slot[:, 2] + jp1 * ask_mem
+        free_cpu = 1.0 - new_cpu / jnp.maximum(slot[:, 3], 1e-9)
+        free_mem = 1.0 - new_mem / jnp.maximum(slot[:, 4], 1e-9)
+        binpack = _binpack_score(free_cpu, free_mem, spread_alg)
+        coll = slot[:, 5] + j.astype(dtype)
+        anti = jnp.where(
+            coll > 0, -(coll + 1.0) / jnp.maximum(count, 1.0), 0.0)
+        affs = slot[:, 6]
+        nscores = (1.0 + (coll > 0).astype(dtype)
+                   + (affs != 0.0).astype(dtype))
+        final = (binpack + (anti + affs)) / nscores
+
+        low = fit & (final <= SKIP_THRESHOLD)
+        skip_rank = jnp.cumsum(low.astype(jnp.int32))
+        skipped = low & (skip_rank <= MAX_SKIP)
+        counted = fit & ~skipped
+        cpos = jnp.cumsum(counted.astype(jnp.int32))
+        total_counted = cpos[-1]
+        window = counted & (cpos <= L)
+        deficit = jnp.maximum(0, L - jnp.minimum(total_counted, L))
+        srank = jnp.cumsum(skipped.astype(jnp.int32))
+        fallback = skipped & (srank <= deficit)
+        yielded = window | fallback
+        order = jnp.where(window, cpos, L + srank)
+        eff = jnp.where(yielded, final, neg_inf)
+        best = jnp.max(eff)
+        is_best = yielded & (eff == best)
+        border = jnp.min(jnp.where(is_best, order, big))
+        w = jnp.argmax(is_best & (order == border))
+        any_yield = jnp.any(yielded)
+        do = (i < n_active) & any_yield
+        oh_w = arangeB == w
+        chosen = jnp.where(
+            do,
+            jnp.sum(jnp.where(oh_w, slot[:, 7], 0.0)).astype(jnp.int32),
+            -1)
+        score_out = jnp.where(any_yield, best, neg_inf)
+        ny = jnp.sum(yielded.astype(jnp.int32))
+
+        do_i = do.astype(jnp.int32)
+        j2 = j + oh_w.astype(jnp.int32) * do_i
+        jw = jnp.sum(jnp.where(oh_w, j2, 0), dtype=jnp.int32)
+        csw = jnp.sum(jnp.where(oh_w, cs, 0.0))
+        sat = do & (jw.astype(dtype) >= csw)
+        oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
+        entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0), axis=0)
+        take_next = arangeB >= w
+        is_last = arangeB == B - 1
+        j_sh = jnp.where(is_last, 0,
+                         jnp.where(take_next, jnp.roll(j2, -1), j2))
+        slot_sh = jnp.where(
+            is_last[:, None], entry_row[None, :],
+            jnp.where(take_next[:, None], jnp.roll(slot, -1, axis=0), slot))
+        j3 = jnp.where(sat, j_sh, j2)
+        slot2 = jnp.where(sat, slot_sh, slot)
+        cursor2 = cursor + sat.astype(jnp.int32)
+        return (j3, slot2, cursor2), (chosen, score_out, ny)
+
+    _, (chosen, scores, n_yielded) = jax.lax.scan(
+        step, (j0, slot0, cursor0), jnp.arange(P, dtype=jnp.int32),
+        unroll=8)
+    return chosen, scores, n_yielded
+
+
+_WAVE_COMPACT_FNS: dict = {}
+
+
+def solve_lane_wave(const, init, batch, *, spread_alg: bool,
+                    dtype_name: str, batched: bool = False):
+    """Wavefront solve with host precompute + compact transfer; returns
+    host numpy (chosen int64, scores, n_yielded int64), shaped like
+    solve_lane_fused's non-preempt outputs."""
+    if batched:
+        E = np.asarray(batch.ask_cpu).shape[0]
+        lanes = [wavefront_compact_host(
+            jax.tree_util.tree_map(lambda a, e=e: a[e], const),
+            jax.tree_util.tree_map(lambda a, e=e: a[e], init),
+            jax.tree_util.tree_map(lambda a, e=e: a[e], batch),
+            dtype_name) for e in range(E)]
+        compact = np.stack([l[0] for l in lanes])
+        scal_f = np.stack([l[1] for l in lanes])
+        scal_i = np.stack([l[2] for l in lanes])
+    else:
+        compact, scal_f, scal_i = wavefront_compact_host(
+            const, init, batch, dtype_name)
+
+    key = (compact.shape, spread_alg, dtype_name, batched)
+    fn = _WAVE_COMPACT_FNS.get(key)
+    if fn is None:
+        inner = functools.partial(_solve_wave_compact_impl,
+                                  spread_alg=spread_alg,
+                                  dtype_name=dtype_name)
+        if batched:
+            inner = jax.vmap(inner)
+
+        @jax.jit
+        def fn(cm, sf, si):
+            chosen, scores, ny = inner(cm, sf, si)
+            return jnp.stack([chosen.astype(scores.dtype), scores,
+                              ny.astype(scores.dtype)])
+        _WAVE_COMPACT_FNS[key] = fn
+    cm, sf, si = jax.device_put((compact, scal_f, scal_i))
+    combined = jax.device_get(fn(cm, sf, si))
+    return (combined[0].astype(np.int64), combined[1],
+            combined[2].astype(np.int64))
 
 
 def make_node_const(matrix, feasible: np.ndarray, affinity,

@@ -248,6 +248,19 @@ class StateStore:
             existing = self._nodes.get(node.id)
             if existing is not None:
                 node.create_index = existing.create_index
+                # re-registration must not clear operator-set drain or
+                # eligibility state (reference: state_store.go UpsertNode
+                # retains drain_strategy / scheduling_eligibility from the
+                # existing node): clients re-register at runtime (server
+                # restart recovery, fingerprint changes) with no knowledge
+                # of server-side drains; eligibility only changes through
+                # the drain/eligibility endpoints
+                if node.drain_strategy is None:
+                    if existing.drain_strategy is not None:
+                        node.drain_strategy = existing.drain_strategy
+                    if existing.scheduling_eligibility:
+                        node.scheduling_eligibility = \
+                            existing.scheduling_eligibility
             else:
                 node.create_index = self._index + 1
             node.modify_index = self._index + 1

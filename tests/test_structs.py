@@ -146,3 +146,28 @@ def test_alloc_index():
     j = mock.job()
     a = mock.alloc_for(j, n, 7)
     assert a.index() == 7
+
+
+def test_node_reregistration_preserves_drain_state():
+    """A client re-register (runtime fingerprint change, server restart
+    recovery) must not clear operator-set drain/eligibility -- the client's
+    node copy never carries them (reference: state_store.go UpsertNode)."""
+    import copy
+
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import DrainStrategy
+
+    state = StateStore()
+    node = mock.node()
+    state.upsert_node(copy.deepcopy(node))
+    state.update_node_drain(node.id, DrainStrategy(deadline_s=60.0))
+    drained = state.node_by_id(node.id)
+    assert drained.drain_strategy is not None
+    assert drained.scheduling_eligibility == "ineligible"
+
+    # client-side copy: fresh fingerprint, no drain knowledge
+    state.upsert_node(copy.deepcopy(node))
+    after = state.node_by_id(node.id)
+    assert after.drain_strategy is not None
+    assert after.scheduling_eligibility == "ineligible"

@@ -183,6 +183,50 @@ def test_padded_inactive_tail():
     assert (np.asarray(chosen_w)[20:] == -1).all()
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_compact_path_matches_kernels(seed):
+    """The production wave route (host precompute + compact-table scan,
+    solve_lane_fused(wave=True)) must equal both the in-kernel wavefront
+    and the dense oracle kernel."""
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    rng = random.Random(700 + seed)
+    const, init, batch = _world(rng, n=40, p=30, limit=6,
+                                n_dyn=5 if seed % 2 else 0,
+                                distinct=bool(seed == 3),
+                                low_score=bool(seed == 2),
+                                count=1 if seed == 2 else None)
+    chosen_c, scores_c, ny_c = solve_lane_fused(
+        const, init, batch, spread_alg=False, dtype_name="float64",
+        wave=True)
+    chosen_d, scores_d, ny_d, _ = solve_placements(
+        const, init, batch, dtype_name="float64")
+    np.testing.assert_array_equal(chosen_c, np.asarray(chosen_d))
+    np.testing.assert_array_equal(ny_c, np.asarray(ny_d))
+    sel = chosen_c >= 0
+    np.testing.assert_allclose(scores_c[sel], np.asarray(scores_d)[sel],
+                               rtol=1e-12)
+
+
+def test_compact_path_batched():
+    import jax
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    lanes = [_world(random.Random(800 + k), n=24, p=16, limit=5)
+             for k in range(4)]
+    const = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[0] for l in lanes])
+    init = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                  *[l[1] for l in lanes])
+    batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[2] for l in lanes])
+    chosen_b, scores_b, ny_b = solve_lane_fused(
+        const, init, batch, spread_alg=False, dtype_name="float64",
+        batched=True, wave=True)
+    for k, (c, i, b) in enumerate(lanes):
+        c1, s1, y1 = solve_wavefront(c, i, b, dtype_name="float64")
+        np.testing.assert_array_equal(chosen_b[k], np.asarray(c1))
+        np.testing.assert_array_equal(ny_b[k], np.asarray(y1))
+
+
 def test_batched_vmap_matches_single():
     import jax
     rng = random.Random(21)
