@@ -86,6 +86,8 @@ def main(argv=None) -> int:
             scheduler_algorithm=SCHED_ALG_TPU_BINPACK))
     server.start()
 
+    scheme = ("https" if tls_cfg is not None and tls_cfg.enable_http
+              else "http")
     clients = []
     if args.real_clients:
         import os
@@ -95,7 +97,8 @@ def main(argv=None) -> int:
         for i in range(args.nodes):
             c = Client(LocalServerConn(server),
                        os.path.join(base, f"client{i}"),
-                       name=f"dev-client-{i}")
+                       name=f"dev-client-{i}",
+                       api_addr=f"{scheme}://127.0.0.1:{args.port}")
             c.start()
             clients.append(c)
     else:
@@ -108,8 +111,6 @@ def main(argv=None) -> int:
                       clients=clients if args.real_clients else None,
                       tls=tls_cfg)
     http.start()
-    scheme = "https" if tls_cfg is not None and tls_cfg.enable_http \
-        else "http"
     print(f"==> nomad-tpu dev agent: {scheme}://127.0.0.1:{http.port} "
           f"({args.nodes} simulated nodes, "
           f"algorithm={server.state.scheduler_config().scheduler_algorithm})")

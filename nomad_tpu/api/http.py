@@ -15,9 +15,9 @@ from urllib.parse import parse_qs, urlparse
 
 from ..structs import (
     Constraint, EphemeralDisk, Job, NetworkResource, Port, ReschedulePolicy,
-    Resources, RestartPolicy, SchedulerConfiguration, Spread, SpreadTarget,
-    Task, TaskGroup, UpdateStrategy, Affinity, ParameterizedJobConfig,
-    PeriodicConfig,
+    Resources, RestartPolicy, SchedulerConfiguration, Service, Spread,
+    SpreadTarget, Task, TaskGroup, UpdateStrategy, Affinity,
+    ParameterizedJobConfig, PeriodicConfig,
 )
 
 
@@ -110,7 +110,8 @@ def job_from_json(data: dict) -> Job:
                              for c in t_src.get("constraints", [])],
                 affinities=[build(Affinity, a)
                             for a in t_src.get("affinities", [])],
-                services=[]))
+                services=[build(Service, s)
+                          for s in t_src.get("services", [])]))
         networks = [
             build(NetworkResource, n,
                   reserved_ports=[build(Port, p)
@@ -120,6 +121,8 @@ def job_from_json(data: dict) -> Job:
             for n in tg_src.get("networks", [])]
         tg = build(
             TaskGroup, tg_src, tasks=tasks, networks=networks,
+            services=[build(Service, s)
+                      for s in tg_src.get("services", [])],
             constraints=[build(Constraint, c)
                          for c in tg_src.get("constraints", [])],
             affinities=[build(Affinity, a)

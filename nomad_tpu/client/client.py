@@ -113,7 +113,8 @@ class Client:
                  node: Optional[Node] = None, name: str = "",
                  drivers: Optional[DriverRegistry] = None,
                  probe_jax: bool = False, identity_signer=None,
-                 device_plugins=None, csi_plugins=None):
+                 device_plugins=None, csi_plugins=None,
+                 api_addr: str = ""):
         self.conn = conn
         self.data_dir = data_dir
         self.drivers = drivers or DriverRegistry()
@@ -137,6 +138,10 @@ class Client:
         self.secrets_fetcher = conn.workload_variable
         fm = FingerprintManager(data_dir=data_dir, probe_jax=probe_jax)
         self.node = fm.fingerprint_node(node=node, name=name)
+        if api_addr:
+            # lets workloads reach the HTTP API via ${attr.nomad.api_addr}
+            # (the connect sidecar's catalog resolution needs it)
+            self.node.attributes["nomad.api_addr"] = api_addr
         # driver fingerprints -> node.drivers (reference: drivermanager)
         from ..structs import DriverInfo
         for dname, fp in self.drivers.fingerprints().items():

@@ -1,0 +1,186 @@
+"""Sidecar mesh proxy: the data plane of the connect integration.
+
+Reference analog: the Envoy sidecar Nomad launches for Consul Connect
+(nomad/job_endpoint_hook_connect.go injects the task; Envoy proxies
+traffic). Here the proxy is a self-contained stdlib TCP forwarder the
+ConnectHook injects as a raw_exec sidecar task:
+
+  - INBOUND: listens on the alloc's public ``connect-proxy-<svc>`` port
+    and forwards to the fronted service's local port. Other allocs'
+    upstreams dial THIS listener, never the service directly.
+  - OUTBOUND (upstreams): one listener per upstream on
+    127.0.0.1:<local_bind_port>; each accepted connection resolves the
+    destination's sidecar (``<dest>-sidecar-proxy`` in the native service
+    catalog via /v1/service/..., falling back to the service itself) and
+    pumps bytes both ways.
+
+Config comes from the task environment (set by the admission hook with
+``${...}`` interpolation resolved by taskenv):
+  NOMAD_CONNECT_HTTP_ADDR    server API base, e.g. http://127.0.0.1:4646
+  NOMAD_CONNECT_PUBLIC_PORT  inbound listener port (0/unset = no inbound)
+  NOMAD_CONNECT_LOCAL_PORT   fronted service's local port
+  NOMAD_CONNECT_UPSTREAMS    JSON [{"destination_name", "local_bind_port"}]
+  NOMAD_NAMESPACE            catalog namespace for resolution
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+RESOLVE_TTL_S = 2.0
+
+
+class _Resolver:
+    """Catalog lookups with a tiny TTL cache (one HTTP round per
+    destination per TTL, not per connection)."""
+
+    def __init__(self, base: str, namespace: str):
+        self.base = base.rstrip("/")
+        self.namespace = namespace
+        self._cache = {}
+        self._lock = threading.Lock()
+
+    def _ssl_context(self):
+        if not self.base.startswith("https"):
+            return None
+        import ssl
+        ca = os.environ.get("NOMAD_CONNECT_CA_FILE", "")
+        if ca:
+            return ssl.create_default_context(cafile=ca)
+        # dev agents use self-signed certs; catalog lookups carry no
+        # secrets, so fall back to unverified rather than a dead mesh
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def endpoints(self, service: str):
+        now = time.time()
+        with self._lock:
+            hit = self._cache.get(service)
+            if hit and now - hit[0] < RESOLVE_TTL_S:
+                return hit[1]
+        regs = []
+        for name in (f"{service}-sidecar-proxy", service):
+            try:
+                url = (f"{self.base}/v1/service/{name}"
+                       f"?namespace={self.namespace}")
+                with urllib.request.urlopen(
+                        url, timeout=2.0,
+                        context=self._ssl_context()) as resp:
+                    regs = json.loads(resp.read() or b"[]")
+            except Exception:  # noqa: BLE001 -- server flap: keep trying
+                regs = []
+            regs = [r for r in regs if r.get("port")]
+            if regs:
+                break
+        eps = [(r.get("address") or "127.0.0.1", int(r["port"]))
+               for r in regs]
+        with self._lock:
+            self._cache[service] = (now, eps)
+        return eps
+
+
+def _pump(a: socket.socket, b: socket.socket) -> None:
+    """One direction; EOF half-closes the destination so the reverse
+    direction keeps flowing (request/response over half-close works)."""
+    try:
+        while True:
+            data = a.recv(65536)
+            if not data:
+                break
+            b.sendall(data)
+        b.shutdown(socket.SHUT_WR)
+    except OSError:
+        pass
+
+
+def _handle(conn: socket.socket, remote: socket.socket) -> None:
+    fwd = threading.Thread(target=_pump, args=(conn, remote), daemon=True)
+    rev = threading.Thread(target=_pump, args=(remote, conn), daemon=True)
+    fwd.start()
+    rev.start()
+    fwd.join()
+    rev.join()
+    for s in (conn, remote):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _serve(listen_host: str, listen_port: int, dial) -> None:
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((listen_host, listen_port))
+    srv.listen(64)
+    while True:
+        conn, _ = srv.accept()
+        try:
+            remote = dial()
+        except OSError:
+            conn.close()
+            continue
+        threading.Thread(target=_handle, args=(conn, remote),
+                         daemon=True).start()
+
+
+def main() -> int:
+    base = os.environ.get("NOMAD_CONNECT_HTTP_ADDR", "")
+    namespace = os.environ.get("NOMAD_NAMESPACE", "default")
+    public_port = int(os.environ.get("NOMAD_CONNECT_PUBLIC_PORT", "0")
+                      or 0)
+    local_port = int(os.environ.get("NOMAD_CONNECT_LOCAL_PORT", "0") or 0)
+    upstreams = json.loads(
+        os.environ.get("NOMAD_CONNECT_UPSTREAMS", "[]") or "[]")
+    resolver = _Resolver(base, namespace)
+    threads = []
+
+    if public_port and local_port:
+        def dial_local():
+            return socket.create_connection(("127.0.0.1", local_port),
+                                            timeout=5.0)
+        t = threading.Thread(target=_serve,
+                             args=("0.0.0.0", public_port, dial_local),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    for up in upstreams:
+        dest = str(up.get("destination_name", ""))
+        bind = int(up.get("local_bind_port", 0) or 0)
+        if not dest or not bind:
+            continue
+
+        def dial_dest(dest=dest):
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                for host, port in resolver.endpoints(dest):
+                    try:
+                        return socket.create_connection((host, port),
+                                                        timeout=3.0)
+                    except OSError:
+                        continue
+                time.sleep(0.2)
+            raise OSError(f"no healthy endpoint for {dest!r}")
+
+        t = threading.Thread(target=_serve,
+                             args=("127.0.0.1", bind, dial_dest),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    if not threads:
+        print("connect-proxy: nothing to do", file=sys.stderr)
+        return 1
+    while True:          # sidecar lifetime == task lifetime (kill stops us)
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,10 @@ def _port_by_label(alloc: Allocation, label: str) -> int:
     res = alloc.allocated_resources
     networks = []
     if res is not None:
+        # group network ports land in shared.ports (AllocatedPortMapping)
+        for pm in res.shared.ports or []:
+            if pm.label == label:
+                return pm.value
         networks.extend(res.shared.networks or [])
         for tr in res.tasks.values():
             networks.extend(tr.networks or [])

@@ -92,6 +92,19 @@ class EnvHook(TaskHook):
     def prestart(self, runner: "TaskRunner") -> None:
         runner.env = build_env(runner.alloc, runner.task, runner.node,
                                runner.task_dir)
+        if runner.task.kind.startswith("connect-proxy:"):
+            # The sidecar data plane ships with the framework: resolve the
+            # interpreter and module path on THIS client, not whatever the
+            # admission-time server had (server and client may run from
+            # different installs/venvs/hosts).
+            import sys as _sys
+
+            import nomad_tpu as _pkg
+            runner.env["PYTHONPATH"] = os.path.dirname(
+                os.path.dirname(os.path.abspath(_pkg.__file__)))
+            cfg = dict(runner.task.config or {})
+            cfg["command"] = _sys.executable
+            runner.task.config = cfg
 
 
 class ArtifactHook(TaskHook):

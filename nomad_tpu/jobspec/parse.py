@@ -331,12 +331,29 @@ def _network(b: Block) -> NetworkResource:
 
 def _service(b: Block) -> Service:
     a = b.attrs()
+    connect = None
+    cb = b.first("connect")
+    if cb is not None:
+        sb = cb.first("sidecar_service")
+        if sb is not None:
+            proxy = {}
+            pb = sb.first("proxy")
+            if pb is not None:
+                proxy["upstreams"] = [{
+                    "destination_name":
+                        str(u.attrs().get("destination_name", "")),
+                    "local_bind_port":
+                        int(u.attrs().get("local_bind_port", 0)),
+                } for u in pb.blocks("upstreams")]
+            connect = {"sidecar_service": {"proxy": proxy} if proxy
+                       else {}}
     return Service(
         name=str(a.get("name", b.label(0))),
         port_label=str(a.get("port", "")),
         provider=str(a.get("provider", "consul")),
         tags=[str(t) for t in a.get("tags", [])],
-        checks=[c.attrs() for c in b.blocks("check")])
+        checks=[c.attrs() for c in b.blocks("check")],
+        connect=connect)
 
 
 def _resources(b: Block) -> Resources:

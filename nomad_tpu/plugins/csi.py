@@ -109,24 +109,48 @@ class CSIManager:
         from urllib.parse import quote
         return quote(plugin_id, safe="") + "@" + quote(volume_id, safe="")
 
+    def _legacy_keys(self, plugin_id: str, volume_id: str):
+        """Names older agents may have staged/published under (detach
+        re-derives paths from the filesystem across restarts, so teardown
+        must find state written by previous key schemes)."""
+        from urllib.parse import quote
+        return (quote(f"{plugin_id}--{volume_id}", safe=""),
+                os.path.basename(volume_id) or "vol")
+
     def _staging_path(self, plugin_id: str, volume_id: str) -> str:
-        return os.path.join(self.base, "staging",
-                            self._vol_key(plugin_id, volume_id))
+        current = os.path.join(self.base, "staging",
+                               self._vol_key(plugin_id, volume_id))
+        if not os.path.exists(current + ".ok"):
+            for legacy in self._legacy_keys(plugin_id, volume_id):
+                old = os.path.join(self.base, "staging", legacy)
+                if os.path.exists(old + ".ok"):
+                    return old
+        return current
 
     def _target_path(self, plugin_id: str, volume_id: str,
                      alloc_id: str) -> str:
-        return os.path.join(self.base, "per-alloc", alloc_id,
-                            self._vol_key(plugin_id, volume_id))
+        current = os.path.join(self.base, "per-alloc", alloc_id,
+                               self._vol_key(plugin_id, volume_id))
+        if not os.path.lexists(current):
+            for legacy in self._legacy_keys(plugin_id, volume_id):
+                old = os.path.join(self.base, "per-alloc", alloc_id,
+                                   legacy)
+                if os.path.lexists(old):
+                    return old
+        return current
 
     def _other_publishes(self, plugin_id: str, volume_id: str,
                          alloc_id: str) -> bool:
-        """Any OTHER alloc still has this volume published (fs truth)."""
+        """Any OTHER alloc still has this volume published (fs truth,
+        current or legacy key schemes)."""
         import glob
-        name = glob.escape(self._vol_key(plugin_id, volume_id))
-        for p in glob.glob(os.path.join(self.base, "per-alloc", "*",
-                                        name)):
-            if os.path.basename(os.path.dirname(p)) != alloc_id:
-                return True
+        names = (self._vol_key(plugin_id, volume_id),
+                 *self._legacy_keys(plugin_id, volume_id))
+        for name in names:
+            for p in glob.glob(os.path.join(self.base, "per-alloc", "*",
+                                            glob.escape(name))):
+                if os.path.basename(os.path.dirname(p)) != alloc_id:
+                    return True
         return False
 
     def publish(self, plugin_id: str, volume_id: str, alloc_id: str,

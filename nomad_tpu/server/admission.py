@@ -120,8 +120,129 @@ class WorkloadVarScopeHook(AdmissionHook):
         return []
 
 
+class ConnectHook(AdmissionHook):
+    """Service-mesh admission (reference: job_endpoint_hook_connect.go):
+    every group service with a ``connect.sidecar_service`` block gets
+
+      - a dynamic group-network port ``connect-proxy-<svc>`` (the public
+        mesh listener other allocs dial),
+      - an injected ``raw_exec`` sidecar task running the stdlib data
+        plane (client/connect_proxy.py -- the Envoy analog), configured
+        purely through taskenv interpolation, and
+      - a ``<svc>-sidecar-proxy`` catalog registration so upstream
+        resolution targets the destination's proxy, not the service.
+
+    Mutation is idempotent by name: resubmitting an already-admitted job
+    injects nothing twice."""
+
+    name = "connect"
+
+    @staticmethod
+    def _sidecar_block(svc):
+        """The sidecar_service dict, or None. Tolerates dict-shaped
+        services (defensive; job_from_json builds Service objects) and
+        rejects malformed connect values with the 400-mapped error."""
+        connect = (svc.get("connect") if isinstance(svc, dict)
+                   else svc.connect)
+        if connect is None:
+            return None
+        if not isinstance(connect, dict):
+            raise ValueError("service connect block must be a map")
+        sc = connect.get("sidecar_service")
+        if sc is not None and not isinstance(sc, dict):
+            raise ValueError("connect.sidecar_service must be a map")
+        return sc
+
+    def mutate(self, job: Job) -> Tuple[Job, List[str]]:
+        import json as _json
+        import sys as _sys
+
+        from ..structs import NetworkResource, Port, Resources, Service, \
+            Task
+        for tg in job.task_groups:
+            for svc in list(tg.services):
+                if isinstance(svc, dict):
+                    continue          # defensive: untyped service payload
+                sc = self._sidecar_block(svc)
+                if sc is None:
+                    continue
+                proxy_task = f"connect-proxy-{svc.name}"
+                port_label = proxy_task
+                if not tg.networks:
+                    tg.networks = [NetworkResource()]
+                net = tg.networks[0]
+                if not any(p.label == port_label
+                           for p in net.dynamic_ports):
+                    net.dynamic_ports.append(Port(label=port_label))
+                if not any(t.name == proxy_task for t in tg.tasks):
+                    upstreams = (((sc or {}).get("proxy") or {})
+                                 .get("upstreams")) or []
+                    env_label = port_label.upper().replace("-", "_")
+                    # command/PYTHONPATH are placeholders: the client's
+                    # EnvHook re-resolves both against ITS install (the
+                    # admitting server may run elsewhere)
+                    env = {
+                        "NOMAD_CONNECT_HTTP_ADDR":
+                            "${attr.nomad.api_addr}",
+                        "NOMAD_CONNECT_PUBLIC_PORT":
+                            f"${{NOMAD_PORT_{env_label}}}",
+                        "NOMAD_CONNECT_UPSTREAMS": _json.dumps(upstreams),
+                    }
+                    if svc.port_label:
+                        svc_label = svc.port_label.upper().replace("-", "_")
+                        env["NOMAD_CONNECT_LOCAL_PORT"] = \
+                            f"${{NOMAD_PORT_{svc_label}}}"
+                    tg.tasks.append(Task(
+                        name=proxy_task, driver="raw_exec",
+                        config={"command": _sys.executable,
+                                "args": ["-m",
+                                         "nomad_tpu.client.connect_proxy"]},
+                        env=env,
+                        resources=Resources(cpu=50, memory_mb=64),
+                        lifecycle={"hook": "prestart", "sidecar": True},
+                        kind=f"connect-proxy:{svc.name}"))
+                sp_name = f"{svc.name}-sidecar-proxy"
+                if not any(s.name == sp_name for s in tg.services):
+                    tg.services.append(Service(
+                        name=sp_name, port_label=port_label,
+                        provider="nomad", tags=["connect-proxy"]))
+        return job, []
+
+    def validate(self, job: Job, server) -> List[str]:
+        for tg in job.task_groups:
+            binds = set()
+            for svc in tg.services:
+                sc = self._sidecar_block(svc)
+                if sc is None:
+                    continue
+                sname = (svc.get("name", "") if isinstance(svc, dict)
+                         else svc.name)
+                ups = (((sc or {}).get("proxy") or {})
+                       .get("upstreams")) or []
+                for up in ups:
+                    dest = str(up.get("destination_name", ""))
+                    if not dest:
+                        raise ValueError(
+                            f"service {sname!r}: connect upstream "
+                            "missing destination_name")
+                    try:
+                        bind = int(up.get("local_bind_port", 0))
+                    except (TypeError, ValueError):
+                        bind = 0
+                    if bind <= 0:
+                        raise ValueError(
+                            f"service {sname!r}: upstream {dest!r} "
+                            "needs a positive local_bind_port")
+                    if bind in binds:
+                        raise ValueError(
+                            f"group {tg.name!r}: duplicate connect "
+                            f"local_bind_port {bind}")
+                    binds.add(bind)
+        return []
+
+
 DEFAULT_ADMISSION_HOOKS = (ImplicitIdentityHook, VaultHook,
-                           WorkloadVarScopeHook)
+                           WorkloadVarScopeHook, ConnectHook)
 
 
 class AdmissionPipeline:

@@ -174,6 +174,12 @@ class Service:
     provider: str = "consul"
     tags: List[str] = field(default_factory=list)
     checks: List[dict] = field(default_factory=list)
+    # service mesh (reference: structs.ConsulConnect at structs/services.go):
+    # {"sidecar_service": {"proxy": {"upstreams": [
+    #     {"destination_name": ..., "local_bind_port": ...}]}}}
+    # Admission injects the sidecar proxy task + its public port
+    # (server/admission.py ConnectHook).
+    connect: Optional[dict] = None
 
 
 @dataclass

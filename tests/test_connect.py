@@ -1,0 +1,245 @@
+"""Service mesh (connect) integration: admission injection + the sidecar
+data plane end-to-end (reference analogs: nomad/job_endpoint_hook_connect.go
+for the injection, the Envoy sidecar for the proxy hops).
+
+The e2e test runs a REAL topology on localhost: an echo service fronted by
+its sidecar's public mesh port, a downstream group whose sidecar exposes
+the upstream on a local bind port, and traffic flowing
+client -> downstream sidecar -> upstream sidecar -> echo task.
+"""
+import socket
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import Service
+
+
+def wait(cond, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# -- admission-level tests -------------------------------------------------
+
+def connect_job(job_id="api", upstreams=None, port_label="http"):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = 1
+    sc = {"proxy": {"upstreams": upstreams}} if upstreams else {}
+    tg.services = [Service(name=job_id, provider="nomad",
+                           port_label=port_label,
+                           connect={"sidecar_service": sc})]
+    return job
+
+
+def test_connect_hook_injects_sidecar():
+    from nomad_tpu.server.admission import ConnectHook
+    job = connect_job(upstreams=[
+        {"destination_name": "db", "local_bind_port": 9191}])
+    tg = job.task_groups[0]
+    n_tasks = len(tg.tasks)
+    ConnectHook().mutate(job)
+    assert len(tg.tasks) == n_tasks + 1
+    proxy = tg.lookup_task("connect-proxy-api")
+    assert proxy is not None
+    assert proxy.lifecycle == {"hook": "prestart", "sidecar": True}
+    assert "db" in proxy.env["NOMAD_CONNECT_UPSTREAMS"]
+    assert any(p.label == "connect-proxy-api"
+               for p in tg.networks[0].dynamic_ports)
+    assert any(s.name == "api-sidecar-proxy" for s in tg.services)
+    # idempotent on resubmission
+    ConnectHook().mutate(job)
+    assert len(tg.tasks) == n_tasks + 1
+    assert sum(1 for s in tg.services
+               if s.name == "api-sidecar-proxy") == 1
+
+
+def test_connect_hook_validation_rejects_bad_upstreams():
+    from nomad_tpu.server.admission import ConnectHook
+    hook = ConnectHook()
+    bad = connect_job(upstreams=[{"local_bind_port": 9191}])
+    with pytest.raises(ValueError, match="destination_name"):
+        hook.validate(bad, None)
+    dup = connect_job(upstreams=[
+        {"destination_name": "a", "local_bind_port": 9191},
+        {"destination_name": "b", "local_bind_port": 9191}])
+    with pytest.raises(ValueError, match="duplicate"):
+        hook.validate(dup, None)
+
+
+def test_register_job_admits_connect():
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        job = connect_job(job_id="meshed")
+        server.register_job(job)
+        stored = server.state.job_by_id("default", "meshed")
+        assert stored.task_groups[0].lookup_task(
+            "connect-proxy-meshed") is not None
+    finally:
+        server.shutdown()
+
+
+def test_connect_reachable_from_hcl():
+    """The jobspec surface must be able to express connect (reference:
+    jobspec2 service->connect->sidecar_service->proxy->upstreams)."""
+    from nomad_tpu.jobspec import parse
+    job = parse("""
+job "mesh" {
+  group "web" {
+    service {
+      name     = "web"
+      provider = "nomad"
+      connect {
+        sidecar_service {
+          proxy {
+            upstreams {
+              destination_name = "api"
+              local_bind_port  = 9191
+            }
+          }
+        }
+      }
+    }
+    task "t" { driver = "mock" }
+  }
+}
+""")
+    svc = job.task_groups[0].services[0]
+    assert svc.connect == {"sidecar_service": {"proxy": {"upstreams": [
+        {"destination_name": "api", "local_bind_port": 9191}]}}}
+
+
+def test_connect_reachable_from_json_api():
+    """JSON-submitted jobs build typed Service objects (group AND task
+    level), so ConnectHook sees .connect instead of crashing on dicts."""
+    from nomad_tpu.api.http import job_from_json
+    job = job_from_json({
+        "id": "jsonmesh", "name": "jsonmesh",
+        "task_groups": [{
+            "name": "web", "count": 1,
+            "services": [{"name": "web", "provider": "nomad",
+                          "connect": {"sidecar_service": {}}}],
+            "tasks": [{"name": "t", "driver": "mock",
+                       "services": [{"name": "t-svc",
+                                     "provider": "nomad"}]}],
+        }]})
+    from nomad_tpu.structs import Service
+    assert isinstance(job.task_groups[0].services[0], Service)
+    assert isinstance(job.task_groups[0].tasks[0].services[0], Service)
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        server.register_job(job)
+        stored = server.state.job_by_id("default", "jsonmesh")
+        assert stored.task_groups[0].lookup_task(
+            "connect-proxy-web") is not None
+    finally:
+        server.shutdown()
+
+
+def test_malformed_connect_rejected():
+    from nomad_tpu.server.admission import ConnectHook
+    job = connect_job(job_id="bad")
+    job.task_groups[0].services[0].connect = "bogus"
+    with pytest.raises(ValueError, match="must be a map"):
+        ConnectHook().mutate(job)
+
+
+# -- the data plane, end to end -------------------------------------------
+
+ECHO_SRC = (
+    "import os,socket\n"
+    "s=socket.socket();s.setsockopt(socket.SOL_SOCKET,"
+    "socket.SO_REUSEADDR,1)\n"
+    "s.bind((\"127.0.0.1\",int(os.environ[\"NOMAD_PORT_HTTP\"])))\n"
+    "s.listen(8)\n"
+    "while True:\n"
+    "    c,_=s.accept()\n"
+    "    d=c.recv(4096)\n"
+    "    c.sendall(b\"echo:\"+d)\n"
+    "    c.close()\n"
+)
+
+
+@pytest.mark.slow
+def test_mesh_traffic_end_to_end(tmp_path):
+    import sys
+
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.client.client import Client, LocalServerConn
+    from nomad_tpu.structs import Task, Resources
+
+    server = Server(num_workers=2, heartbeat_ttl=2.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    client = Client(LocalServerConn(server), str(tmp_path / "c0"),
+                    name="mesh-node",
+                    api_addr=f"http://127.0.0.1:{http.port}")
+    client.start()
+    try:
+        # upstream job: echo server behind its sidecar's public port
+        api = connect_job(job_id="echoapi", port_label="http")
+        tg = api.task_groups[0]
+        from nomad_tpu.structs import NetworkResource, Port
+        tg.networks = [NetworkResource(
+            dynamic_ports=[Port(label="http")])]
+        tg.tasks = [Task(
+            name="echo", driver="raw_exec",
+            config={"command": sys.executable, "args": ["-c", ECHO_SRC]},
+            resources=Resources(cpu=50, memory_mb=64))]
+        server.register_job(api)
+
+        # downstream job: upstream bound at a local port via its sidecar
+        bind_port = 28391
+        web = mock.job(id="webfront")
+        wtg = web.task_groups[0]
+        wtg.count = 1
+        wtg.services = [Service(
+            name="webfront", provider="nomad",
+            connect={"sidecar_service": {"proxy": {"upstreams": [
+                {"destination_name": "echoapi",
+                 "local_bind_port": bind_port}]}}})]
+        wtg.tasks = [Task(
+            name="idle", driver="raw_exec",
+            config={"command": "/bin/sh", "args": ["-c", "sleep 60"]},
+            resources=Resources(cpu=50, memory_mb=64))]
+        server.register_job(web)
+
+        def service_up(name):
+            return any(r.port for r in server.state.service_registrations(
+                None) if r.service_name == name)
+
+        wait(lambda: service_up("echoapi-sidecar-proxy"),
+             msg="upstream sidecar registered")
+
+        def roundtrip():
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", bind_port), timeout=2.0) as s:
+                    s.sendall(b"ping")
+                    s.shutdown(socket.SHUT_WR)
+                    return s.recv(4096)
+            except OSError:
+                return b""
+
+        deadline = time.time() + 20
+        got = b""
+        while time.time() < deadline:
+            got = roundtrip()
+            if got == b"echo:ping":
+                break
+            time.sleep(0.3)
+        assert got == b"echo:ping", got
+    finally:
+        client.shutdown()
+        http.shutdown()
+        server.shutdown()
