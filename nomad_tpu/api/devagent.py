@@ -26,6 +26,12 @@ def main(argv=None) -> int:
     parser.add_argument("--join", action="append", default=[],
                         metavar="REGION=ADDR",
                         help="federate with another region's agent")
+    parser.add_argument("--wan", action="store_true",
+                        help="start the WAN gossip pool (regions then "
+                             "discover each other via --wan-join)")
+    parser.add_argument("--wan-join", action="append", default=[],
+                        metavar="HOST:PORT",
+                        help="join an existing WAN gossip member")
     parser.add_argument("--real-clients", action="store_true",
                         help="run full client agents with allocdirs "
                              "(enables /v1/client/fs endpoints)")
@@ -88,6 +94,10 @@ def main(argv=None) -> int:
 
     scheme = ("https" if tls_cfg is not None and tls_cfg.enable_http
               else "http")
+    # HTTP first: with --port 0 the bound port is only known afterwards,
+    # and real clients advertise it to workloads (attr.nomad.api_addr)
+    http = HttpServer(server, port=args.port, tls=tls_cfg)
+    http.start()
     clients = []
     if args.real_clients:
         import os
@@ -98,19 +108,24 @@ def main(argv=None) -> int:
             c = Client(LocalServerConn(server),
                        os.path.join(base, f"client{i}"),
                        name=f"dev-client-{i}",
-                       api_addr=f"{scheme}://127.0.0.1:{args.port}")
+                       api_addr=f"{scheme}://127.0.0.1:{http.port}")
             c.start()
             clients.append(c)
+            http.add_client(c)
     else:
         for _ in range(args.nodes):
             c = SimClient(server, mock.node(datacenter=args.datacenter))
             c.start()
             clients.append(c)
-
-    http = HttpServer(server, port=args.port,
-                      clients=clients if args.real_clients else None,
-                      tls=tls_cfg)
-    http.start()
+    if args.wan or args.wan_join:
+        wan = server.enable_wan(f"{scheme}://127.0.0.1:{http.port}",
+                                name=args.region)
+        for spec in args.wan_join:
+            host, _, port = spec.rpartition(":")
+            if not port.isdigit():
+                parser.error(f"--wan-join needs HOST:PORT, got {spec!r}")
+            server.wan_join((host or "127.0.0.1", int(port)))
+        print(f"==> WAN gossip: {wan.addr[0]}:{wan.addr[1]}")
     print(f"==> nomad-tpu dev agent: {scheme}://127.0.0.1:{http.port} "
           f"({args.nodes} simulated nodes, "
           f"algorithm={server.state.scheduler_config().scheduler_algorithm})")

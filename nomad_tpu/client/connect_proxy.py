@@ -100,7 +100,14 @@ def _pump(a: socket.socket, b: socket.socket) -> None:
         pass
 
 
-def _handle(conn: socket.socket, remote: socket.socket) -> None:
+def _handle(conn: socket.socket, dial) -> None:
+    """Dial happens HERE, per connection thread: a slow/flapping
+    destination must not head-of-line block the accept loop."""
+    try:
+        remote = dial()
+    except OSError:
+        conn.close()
+        return
     fwd = threading.Thread(target=_pump, args=(conn, remote), daemon=True)
     rev = threading.Thread(target=_pump, args=(remote, conn), daemon=True)
     fwd.start()
@@ -121,12 +128,7 @@ def _serve(listen_host: str, listen_port: int, dial) -> None:
     srv.listen(64)
     while True:
         conn, _ = srv.accept()
-        try:
-            remote = dial()
-        except OSError:
-            conn.close()
-            continue
-        threading.Thread(target=_handle, args=(conn, remote),
+        threading.Thread(target=_handle, args=(conn, dial),
                          daemon=True).start()
 
 

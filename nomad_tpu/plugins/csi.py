@@ -112,10 +112,11 @@ class CSIManager:
     def _legacy_keys(self, plugin_id: str, volume_id: str):
         """Names older agents may have staged/published under (detach
         re-derives paths from the filesystem across restarts, so teardown
-        must find state written by previous key schemes)."""
+        must find state written by previous key schemes). The bare
+        basename scheme is deliberately NOT here: it collides across
+        plugins/volumes, which is exactly what the keying fixes."""
         from urllib.parse import quote
-        return (quote(f"{plugin_id}--{volume_id}", safe=""),
-                os.path.basename(volume_id) or "vol")
+        return (quote(f"{plugin_id}--{volume_id}", safe=""),)
 
     def _staging_path(self, plugin_id: str, volume_id: str) -> str:
         current = os.path.join(self.base, "staging",
@@ -123,8 +124,15 @@ class CSIManager:
         if not os.path.exists(current + ".ok"):
             for legacy in self._legacy_keys(plugin_id, volume_id):
                 old = os.path.join(self.base, "staging", legacy)
-                if os.path.exists(old + ".ok"):
-                    return old
+                marker = old + ".ok"
+                try:
+                    # the marker records the staged volume id: only trust
+                    # a legacy dir that proves it holds THIS volume
+                    with open(marker) as fh:
+                        if fh.read() == volume_id:
+                            return old
+                except OSError:
+                    continue
         return current
 
     def _target_path(self, plugin_id: str, volume_id: str,

@@ -220,6 +220,10 @@ class ConnectHook(AdmissionHook):
                 ups = (((sc or {}).get("proxy") or {})
                        .get("upstreams")) or []
                 for up in ups:
+                    if not isinstance(up, dict):
+                        raise ValueError(
+                            f"service {sname!r}: connect upstreams must "
+                            "be maps")
                     dest = str(up.get("destination_name", ""))
                     if not dest:
                         raise ValueError(

@@ -94,6 +94,7 @@ class Server:
         # regions discover each other via WAN serf there, via explicit
         # join here)
         self.federation: Dict[str, str] = {}
+        self.wan = None                     # WAN gossip pool (enable_wan)
         self._acl_replication_thread: Optional[threading.Thread] = None
         self.state = state if state is not None else StateStore()
         self.acl_enabled = acl_enabled
@@ -261,6 +262,9 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        if getattr(self, "wan", None) is not None:
+            self.wan.shutdown()
+            self.wan = None
         for w in self.workers:
             w.stop()
         self.broker.set_enabled(False)
@@ -1139,6 +1143,27 @@ class Server:
             return
         self.federation[region] = address.rstrip("/")
         self.publish_event("RegionJoined", {"name": region})
+
+    def leave_federation(self, region: str) -> None:
+        if self.federation.pop(region, None) is not None:
+            self.publish_event("RegionLeft", {"name": region})
+
+    def enable_wan(self, http_addr: str, name: str = "",
+                   port: int = 0):
+        """Start the WAN gossip pool (reference: server.go setupSerf WAN):
+        regions then discover each other via wan_join instead of explicit
+        join_federation pairs. Returns the WanGossip (its .addr is the
+        join target for other regions)."""
+        from .wan import WanGossip
+        self.wan = WanGossip(self, http_addr, name=name or None,
+                             port=port)
+        self.wan.start()
+        return self.wan
+
+    def wan_join(self, addr) -> int:
+        if self.wan is None:
+            raise RuntimeError("WAN gossip not enabled (enable_wan first)")
+        return self.wan.join(addr)
 
     def regions(self) -> List[str]:
         return sorted([self.region] + list(self.federation))

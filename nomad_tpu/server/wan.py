@@ -1,0 +1,67 @@
+"""WAN gossip: cross-region server discovery feeding the federation table.
+
+Reference: the second serf pool every Nomad server joins
+(nomad/server.go setupSerf with the WAN config; nomad/serf.go
+nodeJoin/nodeFailed -> peersFromMembers keeps the per-region forwarding
+table current). Here the same serf-lite Membership used for LAN gossip
+(raft/membership.py) runs on its OWN transport with region/http tags;
+member events translate directly into Server.join_federation /
+leave_federation, so regions discover each other by joining ANY WAN
+member instead of configuring every pair by hand.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..raft.membership import Membership
+from ..raft.transport import TcpTransport
+
+
+class WanGossip:
+    """One server's WAN pool membership."""
+
+    def __init__(self, server, http_addr: str, name: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self.http_addr = http_addr.rstrip("/")
+        self.transport = TcpTransport(host=host, port=port)
+        # serf WAN member names are "<node>.<region>" in the reference
+        member = f"{name or 'server'}.{server.region}"
+        self.serf = Membership(
+            member, self.transport,
+            tags={"region": server.region, "http_addr": self.http_addr,
+                  "role": "server"},
+            gossip_interval=0.3, probe_interval=0.5,
+            suspicion_timeout=3.0)
+        self.serf.on_event(self._on_event)
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self.transport.addr
+
+    def start(self) -> None:
+        self.transport.start()
+        self.serf.start()
+
+    def join(self, addr: Tuple[str, int]) -> int:
+        """Join any existing WAN member; the push-pull merge fires join
+        events for every region already in the pool."""
+        return self.serf.join(tuple(addr))
+
+    def shutdown(self) -> None:
+        self.serf.leave()
+        self.transport.shutdown()
+
+    # ------------------------------------------------------------------
+    def _on_event(self, event: str, member) -> None:
+        region = member.tags.get("region", "")
+        http_addr = (member.tags.get("http_addr", "") or "").rstrip("/")
+        if not region or region == self.server.region:
+            return
+        if event == "join" and http_addr:
+            self.server.join_federation(region, http_addr)
+        elif event in ("failed", "left"):
+            # only drop the table entry if it still points at THIS member
+            # (another server of the same region may have replaced it)
+            if self.server.forward_address(region) == http_addr:
+                self.server.leave_federation(region)

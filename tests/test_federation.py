@@ -185,3 +185,55 @@ def test_fs_log_frames_numeric_order(tmp_path):
     finally:
         client.shutdown()
         server.shutdown()
+
+
+def test_wan_gossip_discovers_regions():
+    """WAN serf pool (reference: server.go setupSerf WAN + serf.go
+    peersFromMembers): three regions each join ONE seed and the full
+    forwarding mesh forms; a leaving region drops out everywhere."""
+    setups = []
+    try:
+        for name in ("alpha", "beta", "gamma"):
+            s = Server(num_workers=0, heartbeat_ttl=5.0, region=name)
+            s.start()
+            h = HttpServer(s, port=0)
+            h.start()
+            s.enable_wan(f"http://127.0.0.1:{h.port}", name=name)
+            setups.append((s, h))
+        seed = setups[0][0].wan.addr
+        for s, _ in setups[1:]:
+            s.wan_join(seed)
+
+        def mesh_complete():
+            return all(sorted(s.regions()) ==
+                       ["alpha", "beta", "gamma"] for s, _ in setups)
+
+        deadline = time.time() + 10
+        while time.time() < deadline and not mesh_complete():
+            time.sleep(0.05)
+        assert mesh_complete(), [s.regions() for s, _ in setups]
+        # forwarding table points at the right HTTP agents
+        alpha = setups[0][0]
+        assert alpha.forward_address("beta") == \
+            f"http://127.0.0.1:{setups[1][1].port}"
+
+        # cross-region read over the WAN-discovered route
+        setups[1][0].register_job(mock.job(id="beta-job"))
+        beta_view = ApiClient(f"http://127.0.0.1:{setups[0][1].port}",
+                              region="beta")
+        assert [j["id"] for j in beta_view.jobs()] == ["beta-job"]
+
+        # graceful leave removes gamma from the other tables
+        gamma_s, gamma_h = setups.pop()
+        gamma_h.shutdown()
+        gamma_s.shutdown()
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                "gamma" in s.regions() for s, _ in setups):
+            time.sleep(0.05)
+        assert all("gamma" not in s.regions() for s, _ in setups), \
+            [s.regions() for s, _ in setups]
+    finally:
+        for s, h in setups:
+            h.shutdown()
+            s.shutdown()
