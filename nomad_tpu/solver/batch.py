@@ -90,8 +90,10 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
         A = 1 if lanes[idxs[0]].ptab is not None else 0
         e_real = len(idxs)
         e_pad = _e_bucket(e_real)
-        p_pad = _e_bucket(max(
-            lanes[i].batch.ask_cpu.shape[0] for i in idxs))
+        # floor of 32: many lane sizes share one compiled variant (an
+        # inert padded step costs ~us; a fresh XLA compile costs seconds)
+        p_pad = max(32, _e_bucket(max(
+            lanes[i].batch.ask_cpu.shape[0] for i in idxs)))
         metrics.sample_ms("nomad.solver.batch_lanes", float(e_real))
         padded = {i: _pad_placement_axis(lanes[i].batch, p_pad)
                   for i in idxs}

@@ -937,6 +937,27 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
 
 WAVE_B = 32
 
+# Placement-axis padding for wavefront dispatch shapes: pow2 with a floor,
+# so production lanes of many sizes land on FEW compiled variants (inert
+# padded steps cost ~a microsecond each; an extra XLA compile costs
+# seconds).
+WAVE_P_BUCKETS_MIN = 32
+
+
+def _wave_p_bucket(p: int) -> int:
+    b = WAVE_P_BUCKETS_MIN
+    while b < p:
+        b *= 2
+    return b
+
+
+def _wave_unroll() -> int:
+    """Scan unroll: 8 on TPU (amortizes per-step loop overhead), 1
+    elsewhere (unrolling multiplies the compiled body; CPU/virtual-mesh
+    runs are compile-time-bound, not step-overhead-bound)."""
+    import jax as _jax
+    return 8 if _jax.default_backend() == "tpu" else 1
+
 
 def _slotmat_cols(c, init: NodeState, const: NodeConst, aff_node, dtype):
     """(N, 7) per-node row: [c, used_cpu0, used_mem0, cpu_cap, mem_cap,
@@ -1119,7 +1140,7 @@ def _solve_wavefront_impl(const: NodeConst, init: NodeState,
 
     _, (chosen, scores, n_yielded) = jax.lax.scan(
         step, (pos0, j0, slot0, cursor0),
-        jnp.arange(P, dtype=jnp.int32), unroll=8)
+        jnp.arange(P, dtype=jnp.int32), unroll=_wave_unroll())
     return chosen.astype(jnp.int32), scores, n_yielded
 
 
@@ -1140,12 +1161,16 @@ solve_wavefront = functools.partial(
 # kernel op-for-op (IEEE ops agree between numpy and XLA) so placements
 # stay bit-identical.
 
-def wavefront_compact_host(const, init, batch, dtype_name: str):
+def wavefront_compact_host(const, init, batch, dtype_name: str,
+                           p_pad: Optional[int] = None):
     """Numpy precompute for ONE lane: returns (compact (C, 8), scal_f (3,),
     scal_i (2,)). Columns: c, used_cpu, used_mem, cpu_cap, mem_cap,
-    placed, affinity, pos(sentinel -1)."""
+    placed, affinity, pos(sentinel -1). ``p_pad`` grows the output axis
+    (C = p_pad + B) so many lane sizes share one compiled variant; the
+    padded steps are inert (beyond n_active) and callers slice outputs."""
     dt = np.dtype(dtype_name)
     P = int(np.asarray(batch.ask_cpu).shape[0])
+    P_out = max(P, p_pad or 0)
     B = WAVE_B
     N = int(np.asarray(const.cpu_cap).shape[0])
     ask_cpu = np.asarray(batch.ask_cpu, dtype=dt)[0]
@@ -1202,8 +1227,8 @@ def wavefront_compact_host(const, init, batch, dtype_name: str):
            if bool(np.asarray(const.has_affinity))
            else np.zeros(N, dtype=dt))
 
-    fit_pos = np.nonzero(c > 0)[0][:P + B]
-    C = P + B
+    fit_pos = np.nonzero(c > 0)[0][:P_out + B]
+    C = P_out + B
     compact = np.zeros((C, 8), dtype=dt)
     compact[:, 7] = -1.0
     k = fit_pos.shape[0]
@@ -1309,7 +1334,7 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i,
 
     _, (chosen, scores, n_yielded) = jax.lax.scan(
         step, (j0, slot0, cursor0), jnp.arange(P, dtype=jnp.int32),
-        unroll=8)
+        unroll=_wave_unroll())
     return chosen, scores, n_yielded
 
 
@@ -1323,17 +1348,21 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
     solve_lane_fused's non-preempt outputs."""
     if batched:
         E = np.asarray(batch.ask_cpu).shape[0]
+        P = int(np.asarray(batch.ask_cpu).shape[1])
+        p_pad = _wave_p_bucket(P)
         lanes = [wavefront_compact_host(
             jax.tree_util.tree_map(lambda a, e=e: a[e], const),
             jax.tree_util.tree_map(lambda a, e=e: a[e], init),
             jax.tree_util.tree_map(lambda a, e=e: a[e], batch),
-            dtype_name) for e in range(E)]
+            dtype_name, p_pad=p_pad) for e in range(E)]
         compact = np.stack([l[0] for l in lanes])
         scal_f = np.stack([l[1] for l in lanes])
         scal_i = np.stack([l[2] for l in lanes])
     else:
+        P = int(np.asarray(batch.ask_cpu).shape[0])
+        p_pad = _wave_p_bucket(P)
         compact, scal_f, scal_i = wavefront_compact_host(
-            const, init, batch, dtype_name)
+            const, init, batch, dtype_name, p_pad=p_pad)
 
     key = (compact.shape, spread_alg, dtype_name, batched)
     fn = _WAVE_COMPACT_FNS.get(key)
@@ -1352,6 +1381,8 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
         _WAVE_COMPACT_FNS[key] = fn
     cm, sf, si = jax.device_put((compact, scal_f, scal_i))
     combined = jax.device_get(fn(cm, sf, si))
+    # slice padded placement steps back off (outputs are [..., :p_pad])
+    combined = combined[..., :P]
     return (combined[0].astype(np.int64), combined[1],
             combined[2].astype(np.int64))
 
