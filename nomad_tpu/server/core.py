@@ -490,6 +490,18 @@ class Server:
                 f"namespace {job.namespace!r} does not allow node pool "
                 f"{job.node_pool!r}")
         for tg in job.task_groups:
+            # network validation (reference: structs/job.go
+            # TaskGroup.Validate -- "Only one network resource may be
+            # specified"; task-level networks are the deprecated pre-0.12
+            # surface the scheduler no longer honors)
+            if len(tg.networks) > 1:
+                raise ValueError(
+                    f"group {tg.name}: only one network block is allowed")
+            for task in tg.tasks:
+                if task.resources is not None and task.resources.networks:
+                    raise ValueError(
+                        f"task {task.name}: task-level network blocks are "
+                        "not supported; use the group network block")
             sc = tg.scaling
             if sc is None:
                 continue

@@ -130,15 +130,18 @@ class PackedLane:
 def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
     """Does the dense path model everything this TG asks for? The
     remaining carve-outs (host iterator fallback):
-      - per-task networks (multi-NetworkIndex asks)
-      - multiple TG networks
       - preemption combined with ports, devices or cores (network/device
         preemption are subset searches, preemption.go:273,475; core
         release needs id-level accounting)
-      - 0%-spread targets (stateful lowest-boost scoring is host-only)
+      - 0%-spread targets (the host's lowest-boost scoring depends on the
+        scanned-prefix order, which couples window membership to scores)
     Devices, distinct_property AND reserved cores are modeled densely
     (cores: count-exact fit + node-dependent effective cpu, with core ids
     replayed deterministically at materialize -- VERDICT r2 next #7).
+    Per-task networks and multi-network TGs are REJECTED at job
+    validation (server/core.py _validate_job, mirroring
+    structs/job.go TaskGroup.Validate) -- the defensive gates below only
+    matter for harness-constructed jobs that bypass registration.
     """
     has_devices = False
     has_cores = False

@@ -171,3 +171,30 @@ def test_node_reregistration_preserves_drain_state():
     after = state.node_by_id(node.id)
     assert after.drain_strategy is not None
     assert after.scheduling_eligibility == "ineligible"
+
+
+def test_job_validation_rejects_bad_networks():
+    """Reference structs/job.go TaskGroup.Validate: one network block per
+    group; task-level networks are the deprecated pre-0.12 surface."""
+    import pytest
+
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs import NetworkResource
+
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        multi = mock.job(id="multi-net")
+        multi.task_groups[0].networks = [NetworkResource(),
+                                         NetworkResource()]
+        with pytest.raises(ValueError, match="one network block"):
+            server.register_job(multi)
+
+        tasknet = mock.job(id="task-net")
+        tasknet.task_groups[0].tasks[0].resources.networks = [
+            NetworkResource()]
+        with pytest.raises(ValueError, match="task-level network"):
+            server.register_job(tasknet)
+    finally:
+        server.shutdown()
