@@ -151,9 +151,14 @@ class SystemScheduler:
 
         for tg in self.job.task_groups:
             placed = 0
+            # Pass 1: updates and destructive stops, collecting the nodes
+            # that need a fresh placement. Stops land in the plan BEFORE
+            # the dense solve packs usage, so the freed capacity is seen
+            # (coupling is within-node only; the host's interleaved order
+            # is equivalent because placements go to distinct nodes).
+            to_place: List[Node] = []
             for node in nodes:
-                key = (node.id, tg.name)
-                current = by_node_tg.get(key)
+                current = by_node_tg.get((node.id, tg.name))
                 if current is not None:
                     if current.job_version == self.job.version:
                         continue  # ignore: up to date
@@ -168,14 +173,46 @@ class SystemScheduler:
                         updated.job_version = self.job.version
                         self.plan.append_alloc(updated)
                         continue
-                self.stack.set_nodes([node])
-                option = self.stack.select(tg, SelectOptions(
-                    alloc_name=f"{self.job.id}.{tg.name}[0]"))
+                to_place.append(node)
+
+            # Pass 2: dense TPU solve (one vectorized fit+score over every
+            # node -- the system form has no sequential dependence at all)
+            # with per-node host fallback when ineligible.
+            dense = self._dense_system(tg, to_place)
+            for i, node in enumerate(to_place):
+                alloc_metrics = None
+                if dense is not None:
+                    sp = dense[i]
+                    if sp.node is None or sp.task_resources is None:
+                        option = None
+                    else:
+                        option = sp
+                        # dense selects never touch ctx.metrics: record
+                        # the same evaluation trail the host path leaves
+                        # (1 candidate node, normalized score)
+                        self.ctx.reset()
+                        alloc_metrics = self.ctx.metrics.copy()
+                        alloc_metrics.nodes_evaluated = 1
+                        alloc_metrics.score_node(
+                            sp.node.id, "normalized-score", sp.score)
+                else:
+                    self.stack.set_nodes([node])
+                    option = self.stack.select(tg, SelectOptions(
+                        alloc_name=f"{self.job.id}.{tg.name}[0]"))
                 if option is None:
                     if tg.name in self.failed_tg_allocs:
                         self.failed_tg_allocs[tg.name].coalesced_failures += 1
                     else:
-                        self.failed_tg_allocs[tg.name] = self.ctx.metrics.copy()
+                        if dense is not None:
+                            self.ctx.reset()
+                            m = self.ctx.metrics.copy()
+                            m.nodes_evaluated = 1
+                            m.exhausted_node(node.id, node.computed_class,
+                                             "resources exhausted")
+                            self.failed_tg_allocs[tg.name] = m
+                        else:
+                            self.failed_tg_allocs[tg.name] = \
+                                self.ctx.metrics.copy()
                     continue
                 resources = AllocatedResources(
                     tasks=dict(option.task_resources),
@@ -197,7 +234,8 @@ class SystemScheduler:
                     allocated_resources=resources,
                     desired_status=ALLOC_DESIRED_RUN,
                     client_status="pending",
-                    metrics=self.ctx.metrics.copy(),
+                    metrics=(alloc_metrics if alloc_metrics is not None
+                             else self.ctx.metrics.copy()),
                 )
                 if option.preempted_allocs:
                     for p in option.preempted_allocs:
@@ -205,3 +243,37 @@ class SystemScheduler:
                 self.plan.append_alloc(alloc)
                 placed += 1
             self.queued_allocs[tg.name] = 0
+
+    def _dense_system(self, tg, to_place: List[Node]):
+        """TpuPlacement list aligned with to_place when the tpu algorithm
+        is selected and the TG is dense-eligible, else None (host path).
+        Gated out: distinct_property (its counts couple nodes through the
+        plan) and device asks (allocation replay is generic-path only)."""
+        if not to_place:
+            return None
+        if not hasattr(self.state, "scheduler_config"):
+            return None
+        cfg = self.state.scheduler_config()
+        if cfg is None or not cfg.uses_tpu():
+            return None
+        from ..solver.service import TpuPlacementService, tg_solver_eligible
+        from ..structs import CONSTRAINT_DISTINCT_PROPERTY, \
+            SCHED_ALG_TPU_SPREAD
+        if not tg_solver_eligible(tg, self.job):
+            return None
+        if any(t.resources.devices for t in tg.tasks):
+            return None
+        if any(c.operand == CONSTRAINT_DISTINCT_PROPERTY
+               for c in list(self.job.constraints) + list(tg.constraints)):
+            return None
+        service = TpuPlacementService(
+            self.ctx, self.job, batch_mode=self.sysbatch,
+            spread_alg=cfg.scheduler_algorithm == SCHED_ALG_TPU_SPREAD)
+        solved = service.solve_system(tg, to_place)
+        if solved is None:
+            return None
+        from ..server.telemetry import metrics as _tm
+        for sp in solved:
+            if sp.node is not None:
+                _tm.incr("nomad.scheduler.placements_tpu")
+        return solved

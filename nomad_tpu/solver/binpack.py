@@ -1149,6 +1149,51 @@ solve_wavefront = functools.partial(
         _solve_wavefront_impl)
 
 
+def _solve_system_impl(const: NodeConst, init: NodeState,
+                       batch: PlacementBatch, spread_alg: bool = False,
+                       dtype_name: str = "float32"):
+    """System-job dense solve: one INDEPENDENT fit+score per node, all at
+    once (reference: scheduler_system.go runs one Stack.Select per node
+    with that node as the only candidate). SystemStack has no limit
+    window, no distinct-hosts iterator and no affinity/spread/
+    anti-affinity scoring (stack.go:201 SystemStack chain), so the score
+    is the normalized binpack fitness alone. Returns (fit (N,) bool,
+    score (N,)) in shuffled order."""
+    dtype = jnp.dtype(dtype_name)
+    ask_cpu = batch.ask_cpu[0]
+    ask_mem = batch.ask_mem[0]
+    ask_disk = batch.ask_disk[0]
+    n_dyn = batch.n_dyn_ports[0]
+    has_static = batch.has_static[0]
+    has_cores = const.mhz_per_core.shape[0] > 0
+    if has_cores:
+        ask_cores = batch.ask_cores[0]
+        eff_cpu = ask_cpu + ask_cores.astype(dtype) * const.mhz_per_core
+    else:
+        eff_cpu = ask_cpu
+    new_cpu = init.used_cpu + eff_cpu
+    new_mem = init.used_mem + ask_mem
+    new_disk = init.used_disk + ask_disk
+    feas = (const.feasible
+            & (init.dyn_avail >= n_dyn)
+            & (init.static_free | ~has_static))
+    if has_cores:
+        feas &= init.cores_free >= ask_cores
+    fit = (feas
+           & (new_cpu <= const.cpu_cap)
+           & (new_mem <= const.mem_cap)
+           & (new_disk <= const.disk_cap))
+    free_cpu = 1.0 - new_cpu / jnp.maximum(const.cpu_cap, 1e-9)
+    free_mem = 1.0 - new_mem / jnp.maximum(const.mem_cap, 1e-9)
+    score = _binpack_score(free_cpu, free_mem, spread_alg)
+    return fit, score
+
+
+solve_system = functools.partial(
+    jax.jit, static_argnames=("spread_alg", "dtype_name"))(
+        _solve_system_impl)
+
+
 # -- compact wavefront: host-side O(N) precompute, device-side scan --------
 #
 # The wavefront scan only ever reads the first C = P + B fit-order rows, so

@@ -218,6 +218,42 @@ class TpuPlacementService:
             return None
         return self.materialize(lane, *dispatch_lane(lane))
 
+    def solve_system(self, tg, nodes) -> Optional[List[TpuPlacement]]:
+        """Dense system-job solve: one independent fit+score per node
+        (scheduler_system.go semantics -- no window, no distinct-hosts,
+        binpack score only). Returns one TpuPlacement per input node
+        (node=None where infeasible), or None when ineligible."""
+        from ..scheduler.reconcile import AllocPlaceResult
+        from .binpack import solve_system as _solve
+
+        if not nodes:
+            return []
+        places = [AllocPlaceResult(name=f"{self.job.id}.{tg.name}[0]",
+                                   task_group=tg) for _ in nodes]
+        lane = self.pack(tg, places, nodes)
+        if lane is None:
+            return None
+        # the kernel reads only row 0 of the uniform ask arrays: slice the
+        # placement axis to 1 so the compiled shape depends on the padded
+        # node axis alone (not on how many nodes need placing this eval)
+        import jax as _jax
+        batch1 = _jax.tree_util.tree_map(
+            lambda a: a[:1], lane.batch)
+        fit, score = _solve(lane.const, lane.init, batch1,
+                            spread_alg=self.spread_alg,
+                            dtype_name=lane.dtype_name)
+        fit = np.asarray(fit)
+        score = np.asarray(score)
+        # lane.order is the length-n shuffled order (real nodes only);
+        # padding positions can never be fit (matrix.valid False)
+        n = len(nodes)
+        inv = np.empty(n, dtype=np.int64)
+        inv[np.asarray(lane.order, dtype=np.int64)] = np.arange(n)
+        chosen = np.where(fit[inv], inv, -1).astype(np.int64)
+        scores = score[inv].astype(np.float64)
+        return self.materialize(lane, chosen, scores,
+                                np.ones(n, dtype=np.int64))
+
     def pack(self, tg, places, nodes, penalty_nodes_per_place=None
              ) -> Optional[PackedLane]:
         """Marshal one TG's placements into a PackedLane (numpy-backed, no
