@@ -179,13 +179,13 @@ class SystemScheduler:
             # node -- the system form has no sequential dependence at all)
             # with per-node host fallback when ineligible.
             dense = self._dense_system(tg, to_place)
+            preempt = self._preemption_enabled()
             for i, node in enumerate(to_place):
                 alloc_metrics = None
+                option = None
                 if dense is not None:
                     sp = dense[i]
-                    if sp.node is None or sp.task_resources is None:
-                        option = None
-                    else:
+                    if sp.node is not None and sp.task_resources is not None:
                         option = sp
                         # dense selects never touch ctx.metrics: record
                         # the same evaluation trail the host path leaves
@@ -195,15 +195,27 @@ class SystemScheduler:
                         alloc_metrics.nodes_evaluated = 1
                         alloc_metrics.score_node(
                             sp.node.id, "normalized-score", sp.score)
+                    elif preempt:
+                        # full node + preemption enabled: the eviction
+                        # search is host-only -- retry just this node
+                        # through the stack with evict on (reference:
+                        # system jobs preempt by default,
+                        # PreemptionConfig.SystemSchedulerEnabled)
+                        self.stack.set_nodes([node])
+                        option = self.stack.select(tg, SelectOptions(
+                            alloc_name=f"{self.job.id}.{tg.name}[0]",
+                            preempt=True))
                 else:
                     self.stack.set_nodes([node])
                     option = self.stack.select(tg, SelectOptions(
-                        alloc_name=f"{self.job.id}.{tg.name}[0]"))
+                        alloc_name=f"{self.job.id}.{tg.name}[0]",
+                        preempt=preempt))
                 if option is None:
                     if tg.name in self.failed_tg_allocs:
                         self.failed_tg_allocs[tg.name].coalesced_failures += 1
                     else:
-                        if dense is not None:
+                        if dense is not None and not preempt:
+                            # no host select ran: synthesize the trail
                             self.ctx.reset()
                             m = self.ctx.metrics.copy()
                             m.nodes_evaluated = 1
@@ -243,6 +255,16 @@ class SystemScheduler:
                 self.plan.append_alloc(alloc)
                 placed += 1
             self.queued_allocs[tg.name] = 0
+
+    def _preemption_enabled(self) -> bool:
+        """(reference: PreemptionConfig -- system on by default,
+        sysbatch off by default)"""
+        cfg = (self.state.scheduler_config()
+               if hasattr(self.state, "scheduler_config") else None)
+        if cfg is None:
+            return False
+        return cfg.preemption_config.is_enabled(
+            JOB_TYPE_SYSBATCH if self.sysbatch else JOB_TYPE_SYSTEM)
 
     def _dense_system(self, tg, to_place: List[Node]):
         """TpuPlacement list aligned with to_place when the tpu algorithm

@@ -215,3 +215,38 @@ def test_preemption_end_to_end():
     finally:
         client.stop()
         server.shutdown()
+
+
+@pytest.mark.parametrize("alg", ["binpack", "tpu-binpack"])
+def test_system_job_preempts_lower_priority(alg):
+    """System jobs evict lower-priority allocs on full nodes (reference:
+    PreemptionConfig.SystemSchedulerEnabled, on by default). On the tpu
+    algorithm the dense pass handles fitting nodes and the host eviction
+    search retries only the full ones."""
+    h = Harness()
+    h.state.set_scheduler_config(SchedulerConfiguration(
+        scheduler_algorithm=alg,
+        preemption_config=PreemptionConfig(system_scheduler_enabled=True)))
+    free_node = mock.node()
+    full_node = mock.node()
+    for n in (free_node, full_node):
+        n.node_resources.cpu.cpu_shares = 4000
+        n.node_resources.memory.memory_mb = 8192
+        n.compute_class()
+        h.state.upsert_node(n)
+    victims = fill_node(h, full_node, cpu_each=1800, count=2, priority=20)
+
+    job = mock.system_job(priority=90)
+    job.task_groups[0].tasks[0].resources.cpu = 3000
+    job.task_groups[0].tasks[0].resources.memory_mb = 1024
+    h.state.upsert_job(job)
+    err = h.process("system", make_eval(job))
+    assert err is None
+    plan = h.plans[0]
+    placed_nodes = {a.node_id for allocs in plan.node_allocation.values()
+                    for a in allocs}
+    assert placed_nodes == {free_node.id, full_node.id}
+    evicted = [a.id for allocs in plan.node_preemptions.values()
+               for a in allocs]
+    assert evicted, "expected evictions on the full node"
+    assert set(evicted) <= {v.id for v in victims}

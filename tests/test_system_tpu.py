@@ -28,8 +28,13 @@ def _world(alg, seed, n_nodes=30, ports=False):
     rng = random.Random(seed)
     mock._counter = itertools.count()
     h = Harness()
-    h.state.set_scheduler_config(
-        SchedulerConfiguration(scheduler_algorithm=alg))
+    from nomad_tpu.structs import PreemptionConfig
+    # preemption off: the dense path must carry 100% of the placements
+    # (system preemption coverage lives in test_preemption.py)
+    h.state.set_scheduler_config(SchedulerConfiguration(
+        scheduler_algorithm=alg,
+        preemption_config=PreemptionConfig(
+            system_scheduler_enabled=False)))
     nodes = []
     for i in range(n_nodes):
         node = mock.node()
