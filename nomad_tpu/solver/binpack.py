@@ -1062,7 +1062,8 @@ def _solve_wavefront_impl(const: NodeConst, init: NodeState,
     neg_inf = jnp.array(-jnp.inf, dtype=dtype)
     big = jnp.iinfo(jnp.int32).max
 
-    def step(carry, i):
+    def step(carry, xs):
+        i, pen_i = xs
         pos, j, slot, cursor = carry
         cs = slot[:, 0]
         fit = (pos < N) & (j.astype(dtype) < cs)
@@ -1076,10 +1077,15 @@ def _solve_wavefront_impl(const: NodeConst, init: NodeState,
         anti = jnp.where(
             coll > 0, -(coll + 1.0) / jnp.maximum(count.astype(dtype), 1.0),
             0.0)
+        # per-placement reschedule penalty: the previous alloc's node
+        # scores -1 for THIS placement only (rank.go penalty iterator)
+        is_pen = (pen_i >= 0) & (pos == pen_i)
+        resched = jnp.where(is_pen, -1.0, 0.0)
         affs = slot[:, 6]
         aff_present = affs != 0.0
-        nscores = 1.0 + (coll > 0).astype(dtype) + aff_present.astype(dtype)
-        other = anti + affs
+        nscores = (1.0 + (coll > 0).astype(dtype)
+                   + is_pen.astype(dtype) + aff_present.astype(dtype))
+        other = (anti + resched) + affs
         final = (binpack + other) / nscores
 
         low = fit & (final <= SKIP_THRESHOLD)
@@ -1140,7 +1146,8 @@ def _solve_wavefront_impl(const: NodeConst, init: NodeState,
 
     _, (chosen, scores, n_yielded) = jax.lax.scan(
         step, (pos0, j0, slot0, cursor0),
-        jnp.arange(P, dtype=jnp.int32), unroll=_wave_unroll())
+        (jnp.arange(P, dtype=jnp.int32),
+         batch.penalty_idx.astype(jnp.int32)), unroll=_wave_unroll())
     return chosen.astype(jnp.int32), scores, n_yielded
 
 
@@ -1287,10 +1294,12 @@ def wavefront_compact_host(const, init, batch, dtype_name: str,
     compact[:k, 7] = fit_pos.astype(dt)
     scal_f = np.array([ask_cpu, ask_mem, count], dtype=dt)
     scal_i = np.array([L, n_active], dtype=np.int32)
-    return compact, scal_f, scal_i
+    pen = np.full(P_out, -1, dtype=np.int32)
+    pen[:P] = np.asarray(batch.penalty_idx, dtype=np.int32)
+    return compact, scal_f, scal_i, pen
 
 
-def _solve_wave_compact_impl(compact, scal_f, scal_i,
+def _solve_wave_compact_impl(compact, scal_f, scal_i, pen,
                              spread_alg: bool = False,
                              dtype_name: str = "float32"):
     """Device-side scan over a host-precomputed compact table; identical
@@ -1313,7 +1322,8 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i,
     neg_inf = jnp.array(-jnp.inf, dtype=dtype)
     big = jnp.iinfo(jnp.int32).max
 
-    def step(carry, i):
+    def step(carry, xs):
+        i, pen_i = xs
         j, slot, cursor = carry
         cs = slot[:, 0]
         fit = j.astype(dtype) < cs            # sentinel rows: c = 0
@@ -1326,10 +1336,14 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i,
         coll = slot[:, 5] + j.astype(dtype)
         anti = jnp.where(
             coll > 0, -(coll + 1.0) / jnp.maximum(count, 1.0), 0.0)
+        # per-placement reschedule penalty via the pos column (exact int
+        # floats), matching the dense kernel's is_penalty term
+        is_pen = (pen_i >= 0) & (slot[:, 7] == pen_i.astype(dtype))
+        resched = jnp.where(is_pen, -1.0, 0.0)
         affs = slot[:, 6]
         nscores = (1.0 + (coll > 0).astype(dtype)
-                   + (affs != 0.0).astype(dtype))
-        final = (binpack + (anti + affs)) / nscores
+                   + is_pen.astype(dtype) + (affs != 0.0).astype(dtype))
+        final = (binpack + ((anti + resched) + affs)) / nscores
 
         low = fit & (final <= SKIP_THRESHOLD)
         skip_rank = jnp.cumsum(low.astype(jnp.int32))
@@ -1378,7 +1392,8 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i,
         return (j3, slot2, cursor2), (chosen, score_out, ny)
 
     _, (chosen, scores, n_yielded) = jax.lax.scan(
-        step, (j0, slot0, cursor0), jnp.arange(P, dtype=jnp.int32),
+        step, (j0, slot0, cursor0),
+        (jnp.arange(P, dtype=jnp.int32), pen.astype(jnp.int32)),
         unroll=_wave_unroll())
     return chosen, scores, n_yielded
 
@@ -1403,10 +1418,11 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
         compact = np.stack([l[0] for l in lanes])
         scal_f = np.stack([l[1] for l in lanes])
         scal_i = np.stack([l[2] for l in lanes])
+        pen = np.stack([l[3] for l in lanes])
     else:
         P = int(np.asarray(batch.ask_cpu).shape[0])
         p_pad = _wave_p_bucket(P)
-        compact, scal_f, scal_i = wavefront_compact_host(
+        compact, scal_f, scal_i, pen = wavefront_compact_host(
             const, init, batch, dtype_name, p_pad=p_pad)
 
     key = (compact.shape, spread_alg, dtype_name, batched)
@@ -1419,13 +1435,13 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
             inner = jax.vmap(inner)
 
         @jax.jit
-        def fn(cm, sf, si):
-            chosen, scores, ny = inner(cm, sf, si)
+        def fn(cm, sf, si, pn):
+            chosen, scores, ny = inner(cm, sf, si, pn)
             return jnp.stack([chosen.astype(scores.dtype), scores,
                               ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
-    cm, sf, si = jax.device_put((compact, scal_f, scal_i))
-    combined = jax.device_get(fn(cm, sf, si))
+    cm, sf, si, pn = jax.device_put((compact, scal_f, scal_i, pen))
+    combined = jax.device_get(fn(cm, sf, si, pn))
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]
     return (combined[0].astype(np.int64), combined[1],

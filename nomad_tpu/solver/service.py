@@ -76,7 +76,8 @@ class PackedLane:
         """Can this lane route through the O(B)-per-step wavefront kernel
         (binpack._solve_wavefront_impl)? Requires uniform asks over the
         active prefix and none of the node-coupling carries (spreads,
-        distinct_property, devices, cores, penalties, preemption)."""
+        distinct_property, devices, cores, preemption). Reschedule
+        penalties are modeled (per-step penalty node in the scan)."""
         if self._wave is not None:
             return self._wave
         self._wave = self._wavefront_check()
@@ -103,8 +104,6 @@ class PackedLane:
             v = np.asarray(arr)[:n_act]
             if not (v == v[0]).all():
                 return False
-        if not (np.asarray(b.penalty_idx)[:n_act] == -1).all():
-            return False
         if int(np.asarray(b.limit)[0]) + MAX_SKIP > WAVE_B:
             return False
         return True

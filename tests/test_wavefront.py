@@ -246,3 +246,34 @@ def test_batched_vmap_matches_single():
         np.testing.assert_array_equal(np.asarray(chosen_b)[k],
                                       np.asarray(c1))
         np.testing.assert_array_equal(np.asarray(ny_b)[k], np.asarray(y1))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_penalty_parity(seed):
+    """Reschedule penalties are per-placement scoring of one node; the
+    wavefront carries them as scan xs and must match the dense kernel."""
+    rng = random.Random(900 + seed)
+    const, init, batch = _world(rng, n=40, p=30, limit=6)
+    pen = np.full(30, -1, dtype=np.int32)
+    for pi in range(0, 30, 3):
+        pen[pi] = rng.randrange(40)
+    batch = batch._replace(penalty_idx=pen)
+    _compare(const, init, batch)
+
+
+def test_penalty_compact_path():
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    rng = random.Random(950)
+    const, init, batch = _world(rng, n=40, p=30, limit=6)
+    pen = np.full(30, -1, dtype=np.int32)
+    pen[::2] = [rng.randrange(40) for _ in range(15)]
+    batch = batch._replace(penalty_idx=pen)
+    chosen_c, scores_c, ny_c = solve_lane_fused(
+        const, init, batch, spread_alg=False, dtype_name="float64",
+        wave=True)
+    chosen_d, scores_d, ny_d, _ = solve_placements(
+        const, init, batch, dtype_name="float64")
+    np.testing.assert_array_equal(chosen_c, np.asarray(chosen_d))
+    sel = chosen_c >= 0
+    np.testing.assert_allclose(scores_c[sel], np.asarray(scores_d)[sel],
+                               rtol=1e-12)
