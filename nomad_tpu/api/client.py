@@ -380,6 +380,63 @@ class ApiClient:
         return self.get("/v1/event/stream", index=index, poll="true")
 
 
+class FailoverServerConn:
+    """Servers manager: one ServerConn over MANY server addresses with
+    rotate-on-failure (reference: client/servers/manager.go -- the client
+    keeps a ring of known servers, retries the next one when an RPC
+    fails, and sticks with whichever worked). Wraps one HttpServerConn
+    per address; any method failing with a transport-level error rotates
+    through the remaining ring before giving up."""
+
+    # errors that mean "this server is unreachable/unhealthy", not "the
+    # request is bad": rotate instead of failing the caller
+    def __init__(self, addresses, timeout: float = 10.0, token: str = ""):
+        if not addresses:
+            raise ValueError("at least one server address required")
+        self._conns = [HttpServerConn(a, timeout=timeout, token=token)
+                       for a in addresses]
+        self._cur = 0
+        import threading
+        self._lock = threading.Lock()
+
+    def _rotate_call(self, method: str, *args, **kwargs):
+        import urllib.error
+        with self._lock:
+            start = self._cur
+            n = len(self._conns)
+        last_err: Exception = RuntimeError("no servers")
+        for k in range(n):
+            idx = (start + k) % n
+            conn = self._conns[idx]
+            try:
+                out = getattr(conn, method)(*args, **kwargs)
+            except (ConnectionError, OSError, urllib.error.URLError) as e:
+                last_err = e
+                continue
+            except ApiError as e:
+                if e.status >= 500:
+                    last_err = e
+                    continue
+                raise
+            if k:
+                with self._lock:
+                    self._cur = idx
+            return out
+        raise last_err
+
+    def __getattr__(self, name: str):
+        # delegate every ServerConn method through the rotation wrapper
+        if name.startswith("_"):
+            raise AttributeError(name)
+        probe = getattr(self._conns[0], name)
+        if not callable(probe):
+            return probe
+
+        def call(*args, **kwargs):
+            return self._rotate_call(name, *args, **kwargs)
+        return call
+
+
 class HttpServerConn:
     """Client-agent transport over the HTTP API (the remote deployment
     shape; reference: client->server msgpack RPC, nomad/client_rpc.go).

@@ -1523,3 +1523,7 @@ class HttpServer:
 
     def shutdown(self) -> None:
         self.httpd.shutdown()
+        # close the listener too: without this the port stays bound and
+        # new connections queue in the backlog forever instead of being
+        # refused (clients' failover depends on a fast refusal)
+        self.httpd.server_close()

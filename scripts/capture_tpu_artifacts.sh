@@ -1,0 +1,15 @@
+#!/bin/bash
+# One-shot TPU artifact capture for the round: headline bench + tier
+# shapes. Run when the chip is reachable (check: scripts/probe_tpu.sh or
+# /tmp/tpu_probe.log). Each run gates on placement parity.
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date +%H%M%S)
+echo "== default bench =="
+python bench.py 2>bench_${ts}.err | tee BENCH_local.json || exit 1
+for tier in 3 4 5; do
+  echo "== tier $tier =="
+  BENCH_TIER=$tier python bench.py 2>tier${tier}_${ts}.err \
+    | tee BENCH_r03_tier${tier}.json || exit 1
+done
+echo "done; artifacts: BENCH_local.json BENCH_r03_tier{3,4,5}.json"

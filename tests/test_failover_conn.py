@@ -1,0 +1,103 @@
+"""Client servers-manager: FailoverServerConn rotates across server
+agents on transport failure (reference: client/servers/manager.go), so a
+client agent survives losing the server it was talking to.
+"""
+import time
+
+import pytest
+
+from nomad_tpu.api.client import ApiError, FailoverServerConn
+from nomad_tpu.api.http import HttpServer
+from nomad_tpu.server.cluster import make_cluster, wait_for_leader
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_failover_conn_survives_server_loss(tmp_path):
+    from nomad_tpu.client.client import Client
+
+    servers = make_cluster(3, num_workers=1)
+    https = [HttpServer(s, port=0) for s in servers]
+    for h in https:
+        h.start()
+    client = None
+    try:
+        wait_for_leader(servers)
+        conn = FailoverServerConn(
+            [f"http://127.0.0.1:{h.port}" for h in https])
+        client = Client(conn, str(tmp_path / "c0"), name="failover-node")
+        client.heartbeat_ttl = 0.5
+        client.start()
+        node_id = client.node.id
+        leader = wait_for_leader(servers)
+        assert _wait(lambda: leader.state.node_by_id(node_id) is not None)
+
+        # kill the HTTP agent the conn is currently using
+        current = conn._cur
+        https[current].shutdown()
+        # heartbeats keep landing via another server: the node must NOT
+        # go down even after several TTL windows
+        time.sleep(2.5)
+        leader = wait_for_leader(servers)
+        node = leader.state.node_by_id(node_id)
+        assert node is not None and node.status == "ready", (
+            node.status if node else None)
+        assert conn._cur != current
+    finally:
+        if client is not None:
+            client.shutdown()
+        for h in https:
+            try:
+                h.shutdown()
+            except Exception:  # noqa: BLE001 -- one already closed
+                pass
+        for s in servers:
+            s.shutdown()
+
+
+def test_failover_rotation_semantics():
+    """Transport errors and 5xx rotate; 4xx pass straight through; all
+    servers dead raises the last transport error."""
+    conn = FailoverServerConn(["http://unused"])
+
+    class Dead:
+        def ping(self):
+            raise ConnectionError("down")
+
+    class Err500:
+        def ping(self):
+            raise ApiError(503, "leader loss")
+
+    class Bad:
+        def ping(self):
+            raise ApiError(400, "bad request")
+
+    class Ok:
+        def ping(self):
+            return "pong"
+
+    conn._conns = [Dead(), Ok()]
+    conn._cur = 0
+    assert conn._rotate_call("ping") == "pong"
+    assert conn._cur == 1          # sticks with the working server
+
+    conn._conns = [Err500(), Ok()]
+    conn._cur = 0
+    assert conn._rotate_call("ping") == "pong"
+
+    conn._conns = [Bad(), Ok()]
+    conn._cur = 0
+    with pytest.raises(ApiError):
+        conn._rotate_call("ping")
+
+    conn._conns = [Dead(), Dead()]
+    conn._cur = 0
+    with pytest.raises(ConnectionError):
+        conn._rotate_call("ping")
