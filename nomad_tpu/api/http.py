@@ -726,6 +726,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                 else:
                     lid, addr = raft.leader()
                     self._send(200, f"{addr[0]}:{addr[1]}" if addr else lid)
+            elif parts == ["v1", "operator", "raft", "configuration"]:
+                # (reference: operator_endpoint.go RaftGetConfiguration)
+                raft = getattr(self.nomad, "raft", None)
+                if raft is None:
+                    self._send(200, {"servers": []})
+                else:
+                    lid, _ = raft.leader()
+                    self._send(200, {"servers": [
+                        {"id": name, "address": f"{a[0]}:{a[1]}",
+                         "leader": name == lid, "voter": True}
+                        for name, a in raft.configuration()]})
             elif parts == ["v1", "agent", "members"]:
                 serf = getattr(self.nomad, "serf", None)
                 if serf is None:
@@ -1093,6 +1104,20 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except (TypeError, ValueError) as e:
                     return self._error(400, str(e))
                 self._send(200, {"registered": True})
+            elif parts == ["v1", "operator", "raft", "remove-peer"]:
+                # (reference: operator_endpoint.go RaftRemovePeer via
+                # `nomad operator raft remove-peer`); forwards to the
+                # leader on clustered followers like every other write
+                name = str(self._body().get("id", ""))
+                if not name:
+                    return self._error(400, "id required")
+                try:
+                    self.nomad.remove_raft_peer(name)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                except Exception as e:  # noqa: BLE001 -- not leader etc.
+                    return self._error(500, str(e))
+                self._send(200, {"removed": name})
             elif parts == ["v1", "regions", "join"]:
                 # federation join (operator; pre-gated operator_write)
                 body = self._body()

@@ -327,6 +327,9 @@ class Snapshot:
     last_index: int = 0
     last_term: int = 0
     state: Any = None        # FSM-opaque JSON-able blob
+    # cluster configuration as of last_index (single-server membership
+    # changes; None on snapshots from before the feature)
+    peers: Any = None        # {name: [host, port]} | None
 
 
 class SnapshotStore:
@@ -345,7 +348,8 @@ class SnapshotStore:
                     with open(path, encoding="utf-8") as fh:
                         rec = json.load(fh)
                     self._latest = Snapshot(rec["last_index"],
-                                            rec["last_term"], rec["state"])
+                                            rec["last_term"], rec["state"],
+                                            rec.get("peers"))
                 except (json.JSONDecodeError, KeyError, OSError):
                     pass
 
@@ -358,7 +362,8 @@ class SnapshotStore:
                 with open(tmp, "w", encoding="utf-8") as fh:
                     json.dump({"last_index": snap.last_index,
                                "last_term": snap.last_term,
-                               "state": snap.state}, fh,
+                               "state": snap.state,
+                               "peers": snap.peers}, fh,
                               separators=(",", ":"))
                 os.replace(tmp, path)
 

@@ -54,10 +54,16 @@ class RaftNode:
                  data_dir: Optional[str] = None,
                  heartbeat_interval: float = 0.05,
                  election_timeout: float = 0.25,
-                 snapshot_threshold: int = 8192):
+                 snapshot_threshold: int = 8192,
+                 joining: bool = False):
         self.name = name
         self.transport = transport
         self.peers = dict(peers)
+        # a joining server must NOT campaign before it hears from the
+        # cluster's leader: self-elections on a 1-node bootstrap inflate
+        # its term, and that term would leak back through append replies
+        # and depose the real leader the moment it starts replicating
+        self._joining = joining
         self.fsm = fsm
         self.log = log if log is not None else InMemLogStore()
         self.data_dir = data_dir
@@ -78,6 +84,9 @@ class RaftNode:
                            if data_dir else None)
         self._load_meta()
 
+        # membership baseline for config-entry replay (truncations and
+        # restarts re-derive peers from baseline + log)
+        self._base_peers: Dict[str, Tuple[str, int]] = dict(peers)
         snap = self.snapshots.latest()
         self._snap_last_index = snap.last_index if snap else 0
         self._snap_last_term = snap.last_term if snap else 0
@@ -85,6 +94,16 @@ class RaftNode:
             self.fsm.restore(snap.state)
             self.commit_index = snap.last_index
             self.last_applied = snap.last_index
+            if snap.peers:
+                self._base_peers = {k: tuple(v)
+                                    for k, v in snap.peers.items()}
+                self.peers = dict(self._base_peers)
+        # replay config entries the log holds past the snapshot point
+        for idx in range(self.log.first_index() or 1,
+                         self.log.last_index() + 1):
+            e = self.log.get(idx)
+            if e is not None and e.type == "config":
+                self._apply_config_change(self.peers, e.data)
 
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
@@ -158,12 +177,103 @@ class RaftNode:
             raise pend.error
         return pend.result
 
+    # -- membership changes (single-server at a time) -------------------
+    @staticmethod
+    def _apply_config_change(peers: Dict[str, Tuple[str, int]],
+                             change: dict) -> None:
+        if change.get("op") == "add":
+            peers[change["name"]] = tuple(change["addr"])
+        elif change.get("op") == "remove":
+            peers.pop(change["name"], None)
+
+    def add_voter(self, name: str, addr: Tuple[str, int],
+                  timeout: float = 10.0) -> None:
+        """Grow the cluster by one voter (reference: raft AddVoter via
+        `nomad server join` + autopilot). Single change at a time."""
+        self._config_change({"op": "add", "name": name,
+                             "addr": list(addr)}, timeout)
+
+    def remove_server(self, name: str, timeout: float = 10.0) -> None:
+        """Shrink the cluster by one server (reference: raft
+        RemoveServer via `nomad operator raft remove-peer` / autopilot
+        dead-server cleanup)."""
+        if name == self.name:
+            raise ValueError("leader cannot remove itself")
+        self._config_change({"op": "remove", "name": name}, timeout)
+
+    def _config_change(self, change: dict, timeout: float) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id or "",
+                                     self.peers.get(self.leader_id or ""))
+            # one membership change at a time (raft single-server rule):
+            # an uncommitted config entry must finish first
+            for idx in range(self.commit_index + 1,
+                             self.log.last_index() + 1):
+                e = self.log.get(idx)
+                if e is not None and e.type == "config":
+                    raise RuntimeError("membership change already in "
+                                       "flight")
+            entry = LogEntry(index=self.log.last_index() + 1,
+                             term=self.current_term, type="config",
+                             data=change)
+            self.log.append(entry)
+            # config takes effect as soon as it is APPENDED (standard
+            # single-server-change semantics): quorum math and
+            # replication immediately use the new set
+            self._apply_config_change(self.peers, change)
+            if change["op"] == "add" and change["name"] != self.name:
+                peer = change["name"]
+                self._next_index[peer] = self.log.last_index() + 1
+                self._match_index[peer] = 0
+                self._spawn_replicator_locked(peer, tuple(change["addr"]),
+                                              self.current_term)
+            self._match_self()
+            pend = _Pending(self.current_term)
+            self._pending[entry.index] = pend
+        self._wake_replicators()
+        self._maybe_advance_commit()
+        if not pend.event.wait(timeout):
+            with self._lock:
+                self._pending.pop(entry.index, None)
+            raise TimeoutError("membership change timed out")
+        if pend.error is not None:
+            raise pend.error
+
+    def _spawn_replicator_locked(self, peer: str, addr,
+                                 term: int) -> None:
+        ev = self._repl_events.setdefault(peer, threading.Event())
+        ev.set()
+        t = threading.Thread(target=self._replicate_loop,
+                             args=(peer, addr, term),
+                             daemon=True,
+                             name=f"raft-repl-{self.name}->{peer}")
+        t.start()
+        self._repl_threads.append(t)
+
+    def _rebuild_peers_locked(self) -> None:
+        """Re-derive peers from the baseline + surviving log entries
+        (a follower truncation may have dropped an uncommitted config)."""
+        peers = dict(self._base_peers)
+        for idx in range(self.log.first_index() or 1,
+                         self.log.last_index() + 1):
+            e = self.log.get(idx)
+            if e is not None and e.type == "config":
+                self._apply_config_change(peers, e.data)
+        self.peers = peers
+
     def barrier(self, timeout: float = 10.0) -> int:
         """Commit a noop; after it applies, local reads reflect every write
         committed before the call (linearizable read point)."""
         self.apply(None, timeout=timeout, entry_type="barrier")
         with self._lock:
             return self.last_applied
+
+    def configuration(self) -> List[Tuple[str, Tuple[str, int]]]:
+        """Copied peer list for observers (the live dict mutates under
+        membership changes)."""
+        with self._lock:
+            return sorted(self.peers.items())
 
     def stats(self) -> dict:
         with self._lock:
@@ -266,7 +376,7 @@ class RaftNode:
     def _ticker(self) -> None:
         while not self._shutdown.wait(self.heartbeat_interval / 2):
             with self._lock:
-                if self.state == LEADER:
+                if self.state == LEADER or self._joining:
                     continue
                 expired = time.monotonic() >= self._election_deadline
             if expired:
@@ -335,12 +445,7 @@ class RaftNode:
         for peer, addr in self.peers.items():
             if peer == self.name:
                 continue
-            t = threading.Thread(target=self._replicate_loop,
-                                 args=(peer, addr, self.current_term),
-                                 daemon=True,
-                                 name=f"raft-repl-{self.name}->{peer}")
-            t.start()
-            self._repl_threads.append(t)
+            self._spawn_replicator_locked(peer, addr, self.current_term)
         # Commit a noop from the new term so earlier-term entries commit
         # (Raft safety: only current-term entries commit by counting).
         noop = LogEntry(index=self.log.last_index() + 1,
@@ -358,6 +463,10 @@ class RaftNode:
             ev.clear()
             with self._lock:
                 if self.state != LEADER or self.current_term != term:
+                    return
+                if peer not in self.peers:      # removed from the config
+                    self._next_index.pop(peer, None)
+                    self._match_index.pop(peer, None)
                     return
             try:
                 self._replicate_once(peer, addr, term)
@@ -389,6 +498,7 @@ class RaftNode:
                 "type": "install_snapshot", "term": term,
                 "leader": self.name, "last_index": snap.last_index,
                 "last_term": snap.last_term, "state": snap.state,
+                "peers": snap.peers,
             }, timeout=10.0)
             with self._lock:
                 if reply.get("term", 0) > self.current_term:
@@ -500,16 +610,39 @@ class RaftNode:
                     return
                 term = self._term_at(last) or self.current_term
             blob = self.fsm.snapshot()
+            with self._lock:
+                # peers AS OF the snapshot point, NOT current: an
+                # uncommitted config entry past `last` is applied-on-
+                # append in self.peers but may still be truncated away --
+                # baking it into the baseline would make it permanent
+                peers_at = dict(self._base_peers)
+                for idx in range(self.log.first_index() or 1, last + 1):
+                    e = self.log.get(idx)
+                    if e is not None and e.type == "config":
+                        self._apply_config_change(peers_at, e.data)
+                peers_wire = {k: list(v) for k, v in peers_at.items()}
             self.snapshots.save(Snapshot(last_index=last, last_term=term,
-                                         state=blob))
+                                         state=blob, peers=peers_wire))
             with self._lock:
                 self._snap_last_index = last
                 self._snap_last_term = term
+                # compaction drops replayable config entries: re-baseline
+                self._base_peers = peers_at
                 self.log.compact_to(last)
 
     # -- RPC handlers (follower side) ----------------------------------
     def _handle_request_vote(self, msg: dict) -> dict:
         with self._lock:
+            # a server outside the current configuration (removed, or not
+            # yet added) must not disrupt the cluster: deny WITHOUT
+            # adopting its term (hashicorp/raft's non-voter guard). Only
+            # enforced when this node has LEARNED a multi-member config --
+            # a fresh joiner still on its {self} bootstrap must keep
+            # granting votes or a post-add leader loss can deadlock the
+            # election (quorum includes the joiner, which knows nobody).
+            if len(self.peers) > 1 and \
+                    msg.get("candidate") not in self.peers:
+                return {"term": self.current_term, "granted": False}
             term = msg["term"]
             if term < self.current_term:
                 return {"term": self.current_term, "granted": False}
@@ -534,6 +667,7 @@ class RaftNode:
             if term > self.current_term or self.state != FOLLOWER:
                 self._become_follower(term, msg["leader"])
             self.leader_id = msg["leader"]
+            self._joining = False           # heard the cluster: full member
             self._election_deadline = self._rand_deadline()
 
             prev_index = msg["prev_log_index"]
@@ -549,6 +683,8 @@ class RaftNode:
                     if existing.term == e["term"]:
                         continue
                     self.log.truncate_after(e["index"] - 1)
+                    # a dropped uncommitted config entry must un-apply
+                    self._rebuild_peers_locked()
                 if self.log.first_index() == 0 and e["index"] > 1 and \
                         self.log.last_index() + 1 != e["index"]:
                     # empty log after snapshot restore: entries continue
@@ -556,6 +692,8 @@ class RaftNode:
                     self.log.reset(e["index"])
                 self.log.append(LogEntry(index=e["index"], term=e["term"],
                                          type=e["type"], data=e["data"]))
+                if e["type"] == "config":
+                    self._apply_config_change(self.peers, e["data"])
             if msg["leader_commit"] > self.commit_index:
                 self.commit_index = min(msg["leader_commit"],
                                         self.log.last_index())
@@ -576,9 +714,14 @@ class RaftNode:
             with self._lock:
                 self.snapshots.save(Snapshot(last_index=msg["last_index"],
                                              last_term=msg["last_term"],
-                                             state=msg["state"]))
+                                             state=msg["state"],
+                                             peers=msg.get("peers")))
                 self._snap_last_index = msg["last_index"]
                 self._snap_last_term = msg["last_term"]
+                if msg.get("peers"):
+                    self._base_peers = {k: tuple(v) for k, v
+                                        in msg["peers"].items()}
+                    self.peers = dict(self._base_peers)
                 self.log.reset(msg["last_index"] + 1)
                 self.commit_index = max(self.commit_index, msg["last_index"])
                 self.last_applied = max(self.last_applied, msg["last_index"])

@@ -193,6 +193,7 @@ _FORWARD_SPECS: Dict[str, Tuple[List[Any], Any]] = {
     "drain_node": ([str, Optional[DrainStrategy]], type(None)),
     "update_allocs_from_client": ([List[Allocation]], type(None)),
     "apply_scheduler_config": ([SchedulerConfiguration], type(None)),
+    "remove_raft_peer": ([str], type(None)),
 }
 
 
@@ -206,7 +207,8 @@ class ClusterServer(Server):
                  data_dir: Optional[str] = None, num_workers: int = 2,
                  heartbeat_ttl: float = 10.0,
                  election_timeout: float = 0.25,
-                 acl_enabled: bool = False, tls=None):
+                 acl_enabled: bool = False, tls=None,
+                 joining: bool = False):
         self.name = name
         # mutual TLS on raft RPC when the agent config asks for it
         # (reference: nomad/rpc.go:31)
@@ -222,7 +224,8 @@ class ClusterServer(Server):
         self.raft = RaftNode(
             name, self.transport,
             peers or {name: self.transport.addr}, self.fsm, log=log,
-            data_dir=data_dir, election_timeout=election_timeout)
+            data_dir=data_dir, election_timeout=election_timeout,
+            joining=joining)
         super().__init__(num_workers=num_workers,
                          heartbeat_ttl=heartbeat_ttl,
                          state=RaftBackedStateStore(self.raft, self.store),
@@ -231,6 +234,12 @@ class ClusterServer(Server):
                                tags={"role": "server", "raft": "true"})
         self.raft.on_leadership(self._on_leadership)
         self.transport.register("server_rpc", self._handle_server_rpc)
+        # autopilot (reference: nomad/autopilot.go + serf.go nodeJoin):
+        # the leader adds gossiping servers as raft voters and cleans up
+        # dead ones after a stabilization window
+        self.autopilot = True
+        self.autopilot_stabilization_s = 1.0
+        self.serf.on_event(self._on_serf_event)
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -238,11 +247,81 @@ class ClusterServer(Server):
         self.serf.start()
         self.raft.start()
         self._start_background()
+        t = threading.Thread(target=self._autopilot_loop, daemon=True,
+                             name=f"autopilot-{self.name}")
+        t.start()
 
     def join(self, addr: Tuple[str, int]) -> int:
         """Gossip-join an existing cluster member (reference: serf Join via
         `nomad server join`)."""
         return self.serf.join(addr)
+
+    # -- autopilot ------------------------------------------------------
+    def _on_serf_event(self, event: str, member) -> None:
+        if not self.autopilot or member.tags.get("role") != "server":
+            return
+        if member.name == self.name:
+            return
+        if event == "join":
+            threading.Thread(target=self._autopilot_add,
+                             args=(member.name, tuple(member.addr)),
+                             daemon=True,
+                             name=f"autopilot-add-{member.name}").start()
+        elif event in ("failed", "left"):
+            threading.Thread(target=self._autopilot_remove,
+                             args=(member.name, event), daemon=True,
+                             name=f"autopilot-rm-{member.name}").start()
+
+    def _autopilot_loop(self) -> None:
+        """Periodic reconcile (reference: autopilot's promoter loop):
+        event-driven adds can be lost to races (two joins -> one change
+        in flight at a time) or leadership churn, so the leader re-checks
+        every second that each alive gossiping server is a voter."""
+        while not self._shutdown.wait(1.0):
+            if not self.autopilot or not self.raft.is_leader():
+                continue
+            for m in self.serf.alive_members():
+                if (m.tags.get("role") == "server"
+                        and m.name != self.name
+                        and m.name not in self.raft.peers):
+                    self._autopilot_add(m.name, tuple(m.addr))
+
+    def _autopilot_add(self, name: str, addr: Tuple[str, int]) -> None:
+        """Leader promotes a newly-gossiping server to raft voter
+        (reference: serf.go nodeJoin -> addRaftPeer)."""
+        if not self.raft.is_leader() or name in self.raft.peers:
+            return
+        try:
+            self.raft.add_voter(name, addr)
+        except Exception:  # noqa: BLE001 -- change in flight / lost lead
+            pass
+
+    def _autopilot_remove(self, name: str, event: str) -> None:
+        """Dead-server cleanup: after a stabilization window, a still-
+        failed server is removed from the raft configuration IF the
+        remaining members hold quorum (reference: autopilot
+        CleanupDeadServers)."""
+        if not self.raft.is_leader() or name not in self.raft.peers:
+            return
+        if event == "failed":
+            time.sleep(self.autopilot_stabilization_s)
+            still_bad = any(
+                m.name == name and m.status in ("failed", "left")
+                for m in self.serf.members())
+            if not still_bad:
+                return
+        if not self.raft.is_leader() or name not in self.raft.peers:
+            return
+        alive = {m.name for m in self.serf.alive_members()}
+        remaining = [p for p in self.raft.peers if p != name]
+        quorum = len(remaining) // 2 + 1
+        if len([p for p in remaining if p in alive or p == self.name]) \
+                < quorum:
+            return                  # removing would break quorum
+        try:
+            self.raft.remove_server(name)
+        except Exception:  # noqa: BLE001 -- change in flight / lost lead
+            pass
 
     def shutdown(self) -> None:
         super().shutdown()
@@ -322,6 +401,9 @@ class ClusterServer(Server):
 
     def register_node(self, node: Node):
         return self._leader_call("register_node", (node,))
+
+    def remove_raft_peer(self, name: str):
+        return self._leader_call("remove_raft_peer", (name,))
 
     def update_node_status(self, node_id: str, status: str):
         return self._leader_call("update_node_status", (node_id, status))

@@ -1156,6 +1156,15 @@ class Server:
         self.federation[region] = address.rstrip("/")
         self.publish_event("RegionJoined", {"name": region})
 
+    def remove_raft_peer(self, name: str) -> None:
+        """(reference: operator_endpoint.go RaftRemovePeer). Real logic
+        lives here so the cluster forwarding layer can invoke it on the
+        leader; plain dev servers have no raft to operate on."""
+        raft = getattr(self, "raft", None)
+        if raft is None:
+            raise ValueError("not a raft server")
+        raft.remove_server(name)
+
     def leave_federation(self, region: str) -> None:
         if self.federation.pop(region, None) is not None:
             self.publish_event("RegionLeft", {"name": region})

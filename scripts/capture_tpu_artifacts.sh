@@ -2,7 +2,7 @@
 # One-shot TPU artifact capture for the round: headline bench + tier
 # shapes. Run when the chip is reachable (check: scripts/probe_tpu.sh or
 # /tmp/tpu_probe.log). Each run gates on placement parity.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 ts=$(date +%H%M%S)
 echo "== default bench =="

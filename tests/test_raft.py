@@ -459,3 +459,68 @@ def test_wal_survives_kill9_mid_append(tmp_path):
         assert e is not None and e.data["n"] == i
     store.append(LogEntry(index=n + 1, term=2, type="command", data={}))
     store.close()
+
+
+def test_add_voter_grows_cluster_live():
+    """A new server gossip-joins; autopilot promotes it to raft voter and
+    it replicates existing state (reference: serf.go nodeJoin ->
+    addRaftPeer + raft AddVoter)."""
+    from nomad_tpu import mock
+    from nomad_tpu.raft.transport import TcpTransport
+    from nomad_tpu.server.cluster import ClusterServer
+
+    servers = make_cluster(3, num_workers=1)
+    new = None
+    try:
+        leader = wait_for_leader(servers)
+        leader.register_job(mock.job(id="pre-join-job"))
+
+        t = TcpTransport()
+        new = ClusterServer("server-3", peers={"server-3": t.addr},
+                            transport=t, num_workers=1, joining=True)
+        new.start()
+        new.join(servers[0].transport.addr)
+
+        assert _wait(lambda: "server-3" in wait_for_leader(servers)
+                     .raft.peers, timeout=10.0)
+        # replicated state reaches the joiner
+        assert _wait(lambda: new.store.job_by_id(
+            "default", "pre-join-job") is not None, timeout=10.0)
+        # and it participates: commits still flow
+        leader = wait_for_leader(servers)
+        leader.register_job(mock.job(id="post-join-job"))
+        assert _wait(lambda: new.store.job_by_id(
+            "default", "post-join-job") is not None, timeout=10.0)
+        assert len(leader.raft.peers) == 4
+    finally:
+        if new is not None:
+            new.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def test_autopilot_removes_dead_server():
+    """Hard-killing a follower shrinks the raft config after the serf
+    failure detector + stabilization window (reference: autopilot
+    CleanupDeadServers), and the cluster keeps committing."""
+    from nomad_tpu import mock
+
+    servers = make_cluster(3, num_workers=1)
+    try:
+        leader = wait_for_leader(servers)
+        victim = next(s for s in servers if s is not leader)
+        victim.shutdown()               # no graceful leave
+
+        assert _wait(lambda: victim.name not in
+                     wait_for_leader(servers).raft.peers, timeout=15.0)
+        leader = wait_for_leader(servers)
+        assert len(leader.raft.peers) == 2
+        # quorum of the NEW config: writes commit with 2/2
+        leader.register_job(mock.job(id="after-cleanup-job"))
+        follower = next(s for s in servers
+                        if s is not leader and s is not victim)
+        assert _wait(lambda: follower.store.job_by_id(
+            "default", "after-cleanup-job") is not None, timeout=10.0)
+    finally:
+        for s in servers:
+            s.shutdown()
