@@ -359,6 +359,21 @@ def cmd_operator_keyring(args) -> int:
     return 0
 
 
+def cmd_operator_raft(args) -> int:
+    """(reference: command/operator_raft_*.go)"""
+    api = _client(args)
+    if args.sub2 == "remove-peer":
+        api.post("/v1/operator/raft/remove-peer", {"id": args.id})
+        print(f"Removed raft peer {args.id}")
+        return 0
+    cfg = api.get("/v1/operator/raft/configuration")
+    print(_fmt_table(
+        [[s["id"], s["address"], "leader" if s["leader"] else "follower",
+          "true" if s["voter"] else "false"] for s in cfg["servers"]],
+        ["ID", "Address", "State", "Voter"]))
+    return 0
+
+
 def cmd_acl_bootstrap(args) -> int:
     out = _client(args).post("/v1/acl/bootstrap")
     print(f"Accessor ID = {out['accessor_id']}\n"
@@ -632,6 +647,11 @@ def build_parser() -> argparse.ArgumentParser:
                                                   required=True)
     okr.add_parser("list").set_defaults(fn=cmd_operator_keyring)
     okr.add_parser("rotate").set_defaults(fn=cmd_operator_keyring)
+    orf = op.add_parser("raft").add_subparsers(dest="sub2", required=True)
+    orf.add_parser("list-peers").set_defaults(fn=cmd_operator_raft)
+    orp = orf.add_parser("remove-peer")
+    orp.add_argument("id")
+    orp.set_defaults(fn=cmd_operator_raft)
 
     srv = sub.add_parser("server").add_subparsers(dest="sub",
                                                   required=True)
