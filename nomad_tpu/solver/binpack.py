@@ -29,6 +29,14 @@ MAX_SKIP = 3               # select.go maxSkip
 SKIP_THRESHOLD = 0.0       # select.go skipScoreThreshold
 BINPACK_MAX = 18.0
 
+
+def _dense_unroll() -> int:
+    """Dense-scan unroll: 4 on TPU (amortizes per-step loop overhead in
+    the O(N)-per-step kernels), 1 elsewhere (the body is large; unrolling
+    multiplies compile time on CPU test/virtual-mesh runs)."""
+    import jax as _jax
+    return 4 if _jax.default_backend() == "tpu" else 1
+
 _EMPTY_I2 = np.zeros((0, 0), dtype=np.int32)
 _EMPTY_I1 = np.zeros(0, dtype=np.int32)
 _EMPTY_B1 = np.zeros(0, dtype=bool)
@@ -655,7 +663,7 @@ def _solve_placements_impl(const: NodeConst, init: NodeState,
         step, init,
         (batch.ask_cpu, batch.ask_mem, batch.ask_disk, batch.n_dyn_ports,
          batch.has_static, batch.limit, batch.count, batch.penalty_idx,
-         batch.active, ask_cores_xs))
+         batch.active, ask_cores_xs), unroll=_dense_unroll())
     return chosen, scores, n_yielded, final_state
 
 
@@ -758,7 +766,8 @@ def _solve_placements_preempt_impl(const: NodeConst, init: NodeState,
             step, (init, pinit),
             (batch.ask_cpu, batch.ask_mem, batch.ask_disk,
              batch.n_dyn_ports, batch.has_static, batch.limit, batch.count,
-             batch.penalty_idx, batch.active, ask_cores_xs))
+             batch.penalty_idx, batch.active, ask_cores_xs),
+            unroll=_dense_unroll())
     return chosen, scores, n_yielded, evict_rows, final_state
 
 
