@@ -339,17 +339,21 @@ def _fused_compute_only(lanes, repeats=3):
 
     if not all(lane.wavefront_ok() for lane in lanes):
         return None
+    if lanes[0].const.spread_vidx.shape[0]:
+        return None             # spread lanes carry extra tables
+    B = lanes[0].wavefront_B()
     p_pad = _wave_p_bucket(max(
         lane.batch.ask_cpu.shape[0] for lane in lanes))
     packs = [wavefront_compact_host(l.const, l.init, l.batch,
-                                    l.dtype_name, p_pad=p_pad)
+                                    l.dtype_name, p_pad=p_pad, B=B)
              for l in lanes]
     compact = np.stack([p[0] for p in packs])
     scal_f = np.stack([p[1] for p in packs])
     scal_i = np.stack([p[2] for p in packs])
     pen = np.stack([p[3] for p in packs])
     inner = jax.vmap(functools.partial(
-        _solve_wave_compact_impl, spread_alg=lanes[0].spread_alg,
+        _solve_wave_compact_impl, sp=None, B=B,
+        spread_alg=lanes[0].spread_alg,
         dtype_name=lanes[0].dtype_name))
     fn = jax.jit(inner)
     dev = jax.device_put((compact, scal_f, scal_i, pen))

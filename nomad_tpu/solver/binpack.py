@@ -945,6 +945,22 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
 # limit + MAX_SKIP <= WAVE_B.
 
 WAVE_B = 32
+# wide-window variant for spread/affinity lanes (the host stack forces
+# limit = max(count, 100) when either is present, stack.go:176-185)
+WAVE_B_WIDE = 128
+
+
+class _WaveSpread(NamedTuple):
+    """Spread tables the compact wavefront carries: per-spread value
+    counts (the ONLY cross-placement coupling spreads add) plus the
+    static scoring tables."""
+
+    counts: jnp.ndarray       # (S, V) int32
+    desired: jnp.ndarray      # (S, V)
+    has_targets: jnp.ndarray  # (S,) bool
+    weights: jnp.ndarray      # (S,)
+    sum_weights: jnp.ndarray  # ()
+
 
 # Placement-axis padding for wavefront dispatch shapes: pow2 with a floor,
 # so production lanes of many sizes land on FEW compiled variants (inert
@@ -1222,17 +1238,30 @@ solve_system = functools.partial(
 # kernel op-for-op (IEEE ops agree between numpy and XLA) so placements
 # stay bit-identical.
 
+def wavefront_buffer_size(limit: int) -> Optional[int]:
+    """Static slot-buffer size for a lane's scan window: small for log2
+    windows, wide for the limit>=100 spread/affinity windows; None when
+    the window outgrows every variant (dense kernel territory)."""
+    if limit + MAX_SKIP <= WAVE_B:
+        return WAVE_B
+    if limit + MAX_SKIP <= WAVE_B_WIDE:
+        return WAVE_B_WIDE
+    return None
+
+
 def wavefront_compact_host(const, init, batch, dtype_name: str,
-                           p_pad: Optional[int] = None):
-    """Numpy precompute for ONE lane: returns (compact (C, 8), scal_f (3,),
-    scal_i (2,)). Columns: c, used_cpu, used_mem, cpu_cap, mem_cap,
-    placed, affinity, pos(sentinel -1). ``p_pad`` grows the output axis
-    (C = p_pad + B) so many lane sizes share one compiled variant; the
-    padded steps are inert (beyond n_active) and callers slice outputs."""
+                           p_pad: Optional[int] = None,
+                           B: int = WAVE_B):
+    """Numpy precompute for ONE lane: returns (compact (C, 8+S),
+    scal_f (3,), scal_i (2,), pen (P,), spread tables). Columns: c,
+    used_cpu, used_mem, cpu_cap, mem_cap, placed, affinity,
+    pos(sentinel -1), then one spread value-index column per spread.
+    ``p_pad`` grows the output axis (C = p_pad + B) so many lane sizes
+    share one compiled variant; the padded steps are inert (beyond
+    n_active) and callers slice outputs."""
     dt = np.dtype(dtype_name)
     P = int(np.asarray(batch.ask_cpu).shape[0])
     P_out = max(P, p_pad or 0)
-    B = WAVE_B
     N = int(np.asarray(const.cpu_cap).shape[0])
     ask_cpu = np.asarray(batch.ask_cpu, dtype=dt)[0]
     ask_mem = np.asarray(batch.ask_mem, dtype=dt)[0]
@@ -1288,10 +1317,13 @@ def wavefront_compact_host(const, init, batch, dtype_name: str,
            if bool(np.asarray(const.has_affinity))
            else np.zeros(N, dtype=dt))
 
+    S = int(np.asarray(const.spread_vidx).shape[0])
     fit_pos = np.nonzero(c > 0)[0][:P_out + B]
     C = P_out + B
-    compact = np.zeros((C, 8), dtype=dt)
+    compact = np.zeros((C, 8 + S), dtype=dt)
     compact[:, 7] = -1.0
+    if S:
+        compact[:, 8:] = -1.0           # missing spread attr sentinel
     k = fit_pos.shape[0]
     compact[:k, 0] = c[fit_pos]
     compact[:k, 1] = used_cpu[fit_pos]
@@ -1301,22 +1333,35 @@ def wavefront_compact_host(const, init, batch, dtype_name: str,
     compact[:k, 5] = np.asarray(init.placed)[fit_pos].astype(dt)
     compact[:k, 6] = aff[fit_pos]
     compact[:k, 7] = fit_pos.astype(dt)
+    if S:
+        compact[:k, 8:] = np.asarray(
+            const.spread_vidx)[:, fit_pos].T.astype(dt)
     scal_f = np.array([ask_cpu, ask_mem, count], dtype=dt)
     scal_i = np.array([L, n_active], dtype=np.int32)
     pen = np.full(P_out, -1, dtype=np.int32)
     pen[:P] = np.asarray(batch.penalty_idx, dtype=np.int32)
-    return compact, scal_f, scal_i, pen
+    sp = _WaveSpread(
+        counts=np.asarray(init.spread_counts, dtype=np.int32),
+        desired=np.asarray(const.spread_desired, dtype=dt),
+        has_targets=np.asarray(const.spread_has_targets, dtype=bool),
+        weights=np.asarray(const.spread_weights, dtype=dt),
+        sum_weights=np.asarray(const.spread_sum_weights, dtype=dt))
+    return compact, scal_f, scal_i, pen, sp
 
 
-def _solve_wave_compact_impl(compact, scal_f, scal_i, pen,
+def _solve_wave_compact_impl(compact, scal_f, scal_i, pen, sp=None,
                              spread_alg: bool = False,
-                             dtype_name: str = "float32"):
+                             dtype_name: str = "float32",
+                             B: int = WAVE_B):
     """Device-side scan over a host-precomputed compact table; identical
-    outputs to _solve_wavefront_impl (P = C - WAVE_B)."""
+    outputs to the dense kernel on eligible lanes (P = C - B). ``sp``
+    carries spread tables when the lane has spreads (the wide-window
+    variant; spreads couple placements only through per-value counts,
+    which ride the carry)."""
     dtype = jnp.dtype(dtype_name)
     C = compact.shape[0]
-    B = WAVE_B
     P = C - B
+    S = sp.counts.shape[0] if sp is not None else 0
     ask_cpu = scal_f[0]
     ask_mem = scal_f[1]
     count = scal_f[2]
@@ -1330,10 +1375,58 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen,
     arangeC = jnp.arange(C, dtype=jnp.int32)
     neg_inf = jnp.array(-jnp.inf, dtype=dtype)
     big = jnp.iinfo(jnp.int32).max
+    if S:
+        V = sp.counts.shape[1]
+        arangeV = jnp.arange(V, dtype=jnp.int32)
+        weight_fracs = sp.weights / jnp.maximum(sp.sum_weights, 1e-9)
+
+    def _spread_boosts(slot, counts):
+        """(S, B) per-slot spread boost, mirroring _spread_score op for
+        op; slot value indexes live in columns 8.. as exact int floats.
+        Gathers go through one-hot matmuls (V is small; batched gathers
+        under vmap hit TPU slow paths)."""
+        def one_spread(vidx_f, desired, has_targets, weight_frac, cnts):
+            missing = vidx_f < 0
+            safe = jnp.maximum(vidx_f, 0.0).astype(jnp.int32)
+            oh_v = arangeV[None, :] == safe[:, None]          # (B, V)
+            current_i = jnp.sum(jnp.where(oh_v, cnts[None, :], 0),
+                                axis=1)
+            used = current_i + 1
+            des = jnp.sum(jnp.where(oh_v, desired[None, :], 0.0), axis=1)
+            no_target = des < 0.0
+            boost_t = jnp.where(
+                no_target, -1.0,
+                jnp.where(des == 0.0, -1.0,
+                          (des - used.astype(dtype))
+                          / jnp.maximum(des, 1e-9) * weight_frac))
+            present = cnts > 0
+            any_present = jnp.any(present)
+            big_i = jnp.iinfo(jnp.int32).max
+            min_c = jnp.min(jnp.where(present, cnts, big_i))
+            max_c = jnp.max(jnp.where(present, cnts, 0))
+            min_f = min_c.astype(dtype)
+            max_f = max_c.astype(dtype)
+            cur_f = current_i.astype(dtype)
+            even = jnp.where(
+                current_i != min_c,
+                jnp.where(min_c == 0, -1.0,
+                          (min_f - cur_f) / jnp.maximum(min_f, 1e-9)),
+                jnp.where(min_c == max_c, -1.0,
+                          (max_f - min_f) / jnp.maximum(min_f, 1e-9)))
+            boost_e = jnp.where(any_present, even, 0.0)
+            per_node = jnp.where(has_targets, boost_t, boost_e)
+            return jnp.where(missing, -1.0, per_node).astype(dtype)
+
+        return jax.vmap(one_spread)(
+            jnp.moveaxis(slot[:, 8:], 1, 0), sp.desired, sp.has_targets,
+            weight_fracs, counts)
 
     def step(carry, xs):
         i, pen_i = xs
-        j, slot, cursor = carry
+        if S:
+            j, slot, cursor, counts = carry
+        else:
+            j, slot, cursor = carry
         cs = slot[:, 0]
         fit = j.astype(dtype) < cs            # sentinel rows: c = 0
         jp1 = (j + 1).astype(dtype)
@@ -1350,9 +1443,16 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen,
         is_pen = (pen_i >= 0) & (slot[:, 7] == pen_i.astype(dtype))
         resched = jnp.where(is_pen, -1.0, 0.0)
         affs = slot[:, 6]
+        if S:
+            spread_total = jnp.sum(_spread_boosts(slot, counts), axis=0)
+        else:
+            spread_total = jnp.zeros(B, dtype=dtype)
+        spread_present = spread_total != 0.0
         nscores = (1.0 + (coll > 0).astype(dtype)
-                   + is_pen.astype(dtype) + (affs != 0.0).astype(dtype))
-        final = (binpack + ((anti + resched) + affs)) / nscores
+                   + is_pen.astype(dtype) + (affs != 0.0).astype(dtype)
+                   + spread_present.astype(dtype))
+        final = (binpack
+                 + (((anti + resched) + affs) + spread_total)) / nscores
 
         low = fit & (final <= SKIP_THRESHOLD)
         skip_rank = jnp.cumsum(low.astype(jnp.int32))
@@ -1398,10 +1498,22 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen,
         j3 = jnp.where(sat, j_sh, j2)
         slot2 = jnp.where(sat, slot_sh, slot)
         cursor2 = cursor + sat.astype(jnp.int32)
+        if S:
+            # winner's value index per spread -> bump its count
+            vw = jnp.sum(jnp.where(oh_w[:, None], slot[:, 8:], 0.0),
+                         axis=0)                              # (S,)
+            safe_vw = jnp.maximum(vw, 0.0).astype(jnp.int32)
+            upd = ((arangeV[None, :] == safe_vw[:, None])
+                   & (vw >= 0)[:, None] & do)
+            counts2 = counts + upd.astype(jnp.int32)
+            return ((j3, slot2, cursor2, counts2),
+                    (chosen, score_out, ny))
         return (j3, slot2, cursor2), (chosen, score_out, ny)
 
+    carry0 = ((j0, slot0, cursor0, sp.counts.astype(jnp.int32)) if S
+              else (j0, slot0, cursor0))
     _, (chosen, scores, n_yielded) = jax.lax.scan(
-        step, (j0, slot0, cursor0),
+        step, carry0,
         (jnp.arange(P, dtype=jnp.int32), pen.astype(jnp.int32)),
         unroll=_wave_unroll())
     return chosen, scores, n_yielded
@@ -1414,43 +1526,71 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
                     dtype_name: str, batched: bool = False):
     """Wavefront solve with host precompute + compact transfer; returns
     host numpy (chosen int64, scores, n_yielded int64), shaped like
-    solve_lane_fused's non-preempt outputs."""
+    solve_lane_fused's non-preempt outputs. The slot-buffer width B is
+    picked from the lane's limit (WAVE_B for log2 windows, WAVE_B_WIDE
+    for spread/affinity windows); callers guarantee it fits."""
     if batched:
         E = np.asarray(batch.ask_cpu).shape[0]
         P = int(np.asarray(batch.ask_cpu).shape[1])
+        L = int(np.asarray(batch.limit)[0][0])
+        B = wavefront_buffer_size(L) or WAVE_B_WIDE
         p_pad = _wave_p_bucket(P)
         lanes = [wavefront_compact_host(
             jax.tree_util.tree_map(lambda a, e=e: a[e], const),
             jax.tree_util.tree_map(lambda a, e=e: a[e], init),
             jax.tree_util.tree_map(lambda a, e=e: a[e], batch),
-            dtype_name, p_pad=p_pad) for e in range(E)]
+            dtype_name, p_pad=p_pad, B=B) for e in range(E)]
         compact = np.stack([l[0] for l in lanes])
         scal_f = np.stack([l[1] for l in lanes])
         scal_i = np.stack([l[2] for l in lanes])
         pen = np.stack([l[3] for l in lanes])
+        sp = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[l[4] for l in lanes])
     else:
         P = int(np.asarray(batch.ask_cpu).shape[0])
+        L = int(np.asarray(batch.limit)[0])
+        B = wavefront_buffer_size(L) or WAVE_B_WIDE
         p_pad = _wave_p_bucket(P)
-        compact, scal_f, scal_i, pen = wavefront_compact_host(
-            const, init, batch, dtype_name, p_pad=p_pad)
+        compact, scal_f, scal_i, pen, sp = wavefront_compact_host(
+            const, init, batch, dtype_name, p_pad=p_pad, B=B)
 
-    key = (compact.shape, spread_alg, dtype_name, batched)
+    has_spreads = sp.counts.shape[-2] > 0 if sp.counts.ndim >= 2 else False
+    key = (compact.shape, sp.counts.shape, spread_alg, dtype_name,
+           batched, B)
     fn = _WAVE_COMPACT_FNS.get(key)
     if fn is None:
-        inner = functools.partial(_solve_wave_compact_impl,
-                                  spread_alg=spread_alg,
-                                  dtype_name=dtype_name)
-        if batched:
-            inner = jax.vmap(inner)
+        if has_spreads:
+            inner = functools.partial(_solve_wave_compact_impl,
+                                      spread_alg=spread_alg,
+                                      dtype_name=dtype_name, B=B)
+            if batched:
+                inner = jax.vmap(inner)
 
-        @jax.jit
-        def fn(cm, sf, si, pn):
-            chosen, scores, ny = inner(cm, sf, si, pn)
-            return jnp.stack([chosen.astype(scores.dtype), scores,
-                              ny.astype(scores.dtype)])
+            @jax.jit
+            def fn(cm, sf, si, pn, spx):
+                chosen, scores, ny = inner(cm, sf, si, pn, spx)
+                return jnp.stack([chosen.astype(scores.dtype), scores,
+                                  ny.astype(scores.dtype)])
+        else:
+            inner = functools.partial(_solve_wave_compact_impl, sp=None,
+                                      spread_alg=spread_alg,
+                                      dtype_name=dtype_name, B=B)
+            if batched:
+                inner = jax.vmap(inner)
+
+            @jax.jit
+            def fn(cm, sf, si, pn):
+                chosen, scores, ny = inner(cm, sf, si, pn)
+                return jnp.stack([chosen.astype(scores.dtype), scores,
+                                  ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
-    cm, sf, si, pn = jax.device_put((compact, scal_f, scal_i, pen))
-    combined = jax.device_get(fn(cm, sf, si, pn))
+    if has_spreads:
+        cm, sf, si, pn, spd = jax.device_put(
+            (compact, scal_f, scal_i, pen, sp))
+        combined = jax.device_get(fn(cm, sf, si, pn, spd))
+    else:
+        cm, sf, si, pn = jax.device_put((compact, scal_f, scal_i, pen))
+        combined = jax.device_get(fn(cm, sf, si, pn))
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]
     return (combined[0].astype(np.int64), combined[1],

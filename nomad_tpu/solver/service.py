@@ -85,14 +85,14 @@ class PackedLane:
 
     def _wavefront_check(self) -> bool:
         import os
-        from .binpack import MAX_SKIP, WAVE_B
+        from .binpack import wavefront_buffer_size
         if os.environ.get("NOMAD_TPU_WAVEFRONT", "1") == "0":
             return False
         if self.ptab is not None:
             return False
         c = self.const
-        if (c.spread_vidx.shape[0] or c.dp_vidx.shape[0]
-                or c.dev_aff.shape[0] or c.mhz_per_core.shape[0]):
+        if (c.dp_vidx.shape[0] or c.dev_aff.shape[0]
+                or c.mhz_per_core.shape[0]):
             return False
         b = self.batch
         act = np.asarray(b.active)
@@ -104,9 +104,16 @@ class PackedLane:
             v = np.asarray(arr)[:n_act]
             if not (v == v[0]).all():
                 return False
-        if int(np.asarray(b.limit)[0]) + MAX_SKIP > WAVE_B:
-            return False
-        return True
+        return wavefront_buffer_size(
+            int(np.asarray(b.limit)[0])) is not None
+
+    def wavefront_B(self):
+        """Static slot-buffer width for fusion grouping (lanes with
+        different widths compile to different programs)."""
+        from .binpack import wavefront_buffer_size
+        if not self.wavefront_ok():
+            return None
+        return wavefront_buffer_size(int(np.asarray(self.batch.limit)[0]))
 
     def fuse_key(self) -> tuple:
         """Lanes with equal keys can fuse into one vmapped dispatch: every
@@ -123,7 +130,7 @@ class PackedLane:
                 self.ptab.cpu.shape[1] if self.ptab is not None else 0,
                 self.pinit.counts.shape[0] if self.pinit is not None else 0,
                 self.dtype_name, self.spread_alg,
-                self.wavefront_ok())
+                self.wavefront_B())
 
 
 def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:

@@ -20,7 +20,8 @@ from nomad_tpu.solver.binpack import (
 
 def _world(rng, n, p, *, ask=(500, 256, 300), n_dyn=0, has_static=False,
            distinct=False, job_level=False, limit=4, count=None,
-           low_score=False, seed_usage=True, affinity=False):
+           low_score=False, seed_usage=True, affinity=False,
+           spreads=0, spread_values=4, spread_targets=False):
     dtype = np.float64
     cpu_cap = np.array([rng.choice([2000, 4000, 8000]) for _ in range(n)],
                        dtype=dtype)
@@ -52,6 +53,28 @@ def _world(rng, n, p, *, ask=(500, 256, 300), n_dyn=0, has_static=False,
         for i in range(n):
             if rng.random() < 0.5:
                 aff[i] = rng.choice([-1.0, -0.5, 0.25, 0.5, 1.0])
+    S, V = spreads, spread_values
+    if S:
+        vidx = np.array([[rng.randrange(-1, V) for _ in range(n)]
+                         for _ in range(S)], dtype=np.int32)
+        if spread_targets:
+            desired = np.array(
+                [[rng.choice([-1.0, float(rng.randint(1, p))])
+                  for _ in range(V)] for _ in range(S)], dtype=dtype)
+            has_t = np.ones(S, dtype=bool)
+        else:
+            desired = np.full((S, V), -1.0, dtype=dtype)
+            has_t = np.zeros(S, dtype=bool)
+        weights = np.array([rng.choice([25.0, 50.0, 100.0])
+                            for _ in range(S)], dtype=dtype)
+        counts0 = np.array([[rng.randint(0, 3) for _ in range(V)]
+                            for _ in range(S)], dtype=np.int32)
+    else:
+        vidx = np.zeros((0, n), dtype=np.int32)
+        desired = np.zeros((0, 1), dtype=dtype)
+        has_t = np.zeros(0, dtype=bool)
+        weights = np.zeros(0, dtype=dtype)
+        counts0 = np.zeros((0, 1), dtype=np.int32)
     const = NodeConst(
         cpu_cap=cpu_cap, mem_cap=mem_cap, disk_cap=disk_cap,
         feasible=feasible,
@@ -59,12 +82,12 @@ def _world(rng, n, p, *, ask=(500, 256, 300), n_dyn=0, has_static=False,
         has_affinity=np.asarray(bool(affinity)),
         distinct_hosts=np.asarray(distinct),
         distinct_job_level=np.asarray(job_level),
-        spread_vidx=np.zeros((0, n), dtype=np.int32),
-        spread_desired=np.zeros((0, 1), dtype=dtype),
-        spread_has_targets=np.zeros(0, dtype=bool),
-        spread_weights=np.zeros(0, dtype=dtype),
-        spread_sum_weights=np.asarray(0.0, dtype=dtype),
-        n_spreads=np.asarray(0, dtype=np.int32))
+        spread_vidx=vidx,
+        spread_desired=desired,
+        spread_has_targets=has_t,
+        spread_weights=weights,
+        spread_sum_weights=np.asarray(float(weights.sum()), dtype=dtype),
+        n_spreads=np.asarray(S, dtype=np.int32))
     init = NodeState(
         used_cpu=used_cpu, used_mem=used_mem, used_disk=used_disk,
         placed=placed, placed_job=placed_job,
@@ -72,7 +95,7 @@ def _world(rng, n, p, *, ask=(500, 256, 300), n_dyn=0, has_static=False,
         if has_static else np.ones(n, dtype=bool),
         dyn_avail=np.array([rng.randint(0, 40) for _ in range(n)],
                            dtype=np.int32),
-        spread_counts=np.zeros((0, 1), dtype=np.int32))
+        spread_counts=counts0)
     count = count if count is not None else p
     batch = PlacementBatch(
         ask_cpu=np.full(p, float(ask[0]), dtype=dtype),
@@ -225,6 +248,67 @@ def test_compact_path_batched():
         c1, s1, y1 = solve_wavefront(c, i, b, dtype_name="float64")
         np.testing.assert_array_equal(chosen_b[k], np.asarray(c1))
         np.testing.assert_array_equal(ny_b[k], np.asarray(y1))
+
+
+def _compare_compact(const, init, batch, spread_alg=False):
+    """Production wave route (host precompute + compact scan) vs the
+    dense oracle kernel, incl. the wide-window spread/affinity variant."""
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    chosen_c, scores_c, ny_c = solve_lane_fused(
+        const, init, batch, spread_alg=spread_alg, dtype_name="float64",
+        wave=True)
+    chosen_d, scores_d, ny_d, _ = solve_placements(
+        const, init, batch, spread_alg=spread_alg, dtype_name="float64")
+    np.testing.assert_array_equal(chosen_c, np.asarray(chosen_d))
+    np.testing.assert_array_equal(ny_c, np.asarray(ny_d))
+    sel = chosen_c >= 0
+    np.testing.assert_allclose(scores_c[sel], np.asarray(scores_d)[sel],
+                               rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_spread_even_parity(seed):
+    """Even-spread (no targets) lanes ride the wide-window wavefront;
+    counts couple placements through the carry."""
+    rng = random.Random(1000 + seed)
+    const, init, batch = _world(rng, n=60, p=40, limit=100, spreads=2,
+                                spread_values=4)
+    _compare_compact(const, init, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_spread_target_parity(seed):
+    rng = random.Random(1100 + seed)
+    const, init, batch = _world(rng, n=60, p=40, limit=100, spreads=2,
+                                spread_values=5, spread_targets=True)
+    _compare_compact(const, init, batch)
+
+
+def test_spread_with_affinity_and_ports_parity():
+    rng = random.Random(1200)
+    const, init, batch = _world(rng, n=50, p=30, limit=100, spreads=1,
+                                spread_values=4, affinity=True, n_dyn=5)
+    _compare_compact(const, init, batch)
+
+
+def test_spread_wavefront_batched():
+    import jax
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    lanes = [_world(random.Random(1300 + k), n=40, p=16, limit=100,
+                    spreads=2, spread_values=4) for k in range(3)]
+    const = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[0] for l in lanes])
+    init = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                  *[l[1] for l in lanes])
+    batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[2] for l in lanes])
+    chosen_b, scores_b, ny_b = solve_lane_fused(
+        const, init, batch, spread_alg=False, dtype_name="float64",
+        batched=True, wave=True)
+    for k, (c, i, b) in enumerate(lanes):
+        cd, sd, yd, _ = solve_placements(c, i, b, dtype_name="float64")
+        np.testing.assert_array_equal(chosen_b[k], np.asarray(cd))
+        np.testing.assert_array_equal(ny_b[k], np.asarray(yd))
 
 
 def test_batched_vmap_matches_single():
