@@ -940,9 +940,13 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
 # test_parity_scale) because eligible lanes route here in production.
 #
 # Eligibility (checked host-side, service.PackedLane.wavefront_ok): no
-# spreads / distinct_property / devices / cores / penalties / preemption
-# (their carries couple nodes), uniform asks over the active prefix, and
-# limit + MAX_SKIP <= WAVE_B.
+# distinct_property / devices / cores / preemption, uniform asks over the
+# active prefix, and limit + MAX_SKIP within a buffer variant (WAVE_B for
+# log2 windows, WAVE_B_WIDE for spread/affinity windows). Spreads ride the
+# compact kernel's carry as (S, V) counts; reschedule penalties ride the
+# scan xs. The in-kernel variant below (_solve_wavefront_impl) stays
+# S == 0-only and is the test reference; production routes through
+# solve_lane_wave (host precompute + compact (C, 8+S) table).
 
 WAVE_B = 32
 # wide-window variant for spread/affinity lanes (the host stack forces
@@ -1533,7 +1537,11 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
         E = np.asarray(batch.ask_cpu).shape[0]
         P = int(np.asarray(batch.ask_cpu).shape[1])
         L = int(np.asarray(batch.limit)[0][0])
-        B = wavefront_buffer_size(L) or WAVE_B_WIDE
+        B = wavefront_buffer_size(L)
+        if B is None:
+            raise ValueError(f"lane limit {L} exceeds every wavefront "
+                             "buffer width (caller must gate on "
+                             "wavefront_ok)")
         p_pad = _wave_p_bucket(P)
         lanes = [wavefront_compact_host(
             jax.tree_util.tree_map(lambda a, e=e: a[e], const),
@@ -1549,48 +1557,36 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
     else:
         P = int(np.asarray(batch.ask_cpu).shape[0])
         L = int(np.asarray(batch.limit)[0])
-        B = wavefront_buffer_size(L) or WAVE_B_WIDE
+        B = wavefront_buffer_size(L)
+        if B is None:
+            raise ValueError(f"lane limit {L} exceeds every wavefront "
+                             "buffer width (caller must gate on "
+                             "wavefront_ok)")
         p_pad = _wave_p_bucket(P)
         compact, scal_f, scal_i, pen, sp = wavefront_compact_host(
             const, init, batch, dtype_name, p_pad=p_pad, B=B)
 
-    has_spreads = sp.counts.shape[-2] > 0 if sp.counts.ndim >= 2 else False
+    # zero-size spread tables flow through uniformly: the kernel skips
+    # spread work statically when S == 0
     key = (compact.shape, sp.counts.shape, spread_alg, dtype_name,
            batched, B)
     fn = _WAVE_COMPACT_FNS.get(key)
     if fn is None:
-        if has_spreads:
-            inner = functools.partial(_solve_wave_compact_impl,
-                                      spread_alg=spread_alg,
-                                      dtype_name=dtype_name, B=B)
-            if batched:
-                inner = jax.vmap(inner)
+        inner = functools.partial(_solve_wave_compact_impl,
+                                  spread_alg=spread_alg,
+                                  dtype_name=dtype_name, B=B)
+        if batched:
+            inner = jax.vmap(inner)
 
-            @jax.jit
-            def fn(cm, sf, si, pn, spx):
-                chosen, scores, ny = inner(cm, sf, si, pn, spx)
-                return jnp.stack([chosen.astype(scores.dtype), scores,
-                                  ny.astype(scores.dtype)])
-        else:
-            inner = functools.partial(_solve_wave_compact_impl, sp=None,
-                                      spread_alg=spread_alg,
-                                      dtype_name=dtype_name, B=B)
-            if batched:
-                inner = jax.vmap(inner)
-
-            @jax.jit
-            def fn(cm, sf, si, pn):
-                chosen, scores, ny = inner(cm, sf, si, pn)
-                return jnp.stack([chosen.astype(scores.dtype), scores,
-                                  ny.astype(scores.dtype)])
+        @jax.jit
+        def fn(cm, sf, si, pn, spx):
+            chosen, scores, ny = inner(cm, sf, si, pn, spx)
+            return jnp.stack([chosen.astype(scores.dtype), scores,
+                              ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
-    if has_spreads:
-        cm, sf, si, pn, spd = jax.device_put(
-            (compact, scal_f, scal_i, pen, sp))
-        combined = jax.device_get(fn(cm, sf, si, pn, spd))
-    else:
-        cm, sf, si, pn = jax.device_put((compact, scal_f, scal_i, pen))
-        combined = jax.device_get(fn(cm, sf, si, pn))
+    cm, sf, si, pn, spd = jax.device_put(
+        (compact, scal_f, scal_i, pen, sp))
+    combined = jax.device_get(fn(cm, sf, si, pn, spd))
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]
     return (combined[0].astype(np.int64), combined[1],

@@ -73,11 +73,13 @@ class PackedLane:
         self._wave = None
 
     def wavefront_ok(self) -> bool:
-        """Can this lane route through the O(B)-per-step wavefront kernel
-        (binpack._solve_wavefront_impl)? Requires uniform asks over the
-        active prefix and none of the node-coupling carries (spreads,
-        distinct_property, devices, cores, preemption). Reschedule
-        penalties are modeled (per-step penalty node in the scan)."""
+        """Can this lane route through the O(B)-per-step wavefront path
+        (binpack.solve_lane_wave -- host precompute + compact scan)?
+        Requires uniform asks over the active prefix, a window that fits
+        a buffer variant (limit+skips <= WAVE_B or WAVE_B_WIDE), and none
+        of distinct_property/devices/cores/preemption. Spreads,
+        affinities and reschedule penalties ARE modeled (spread counts
+        ride the carry; penalties ride the scan xs)."""
         if self._wave is not None:
             return self._wave
         self._wave = self._wavefront_check()
