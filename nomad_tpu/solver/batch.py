@@ -167,9 +167,11 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
                                 spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True)
     if wave:
+        metrics.incr("nomad.solver.wavefront_dispatches")
         return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True,
                                 wave=True)
+    metrics.incr("nomad.solver.dense_dispatches")
 
     E = const.cpu_cap.shape[0]
     N = const.cpu_cap.shape[1]

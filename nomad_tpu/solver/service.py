@@ -180,10 +180,14 @@ def dispatch_lane(lane: PackedLane):
     more than the entire compiled scan."""
     from .binpack import solve_lane_fused
 
+    wave = lane.wavefront_ok()
+    from ..server.telemetry import metrics as _tm
+    _tm.incr("nomad.solver.wavefront_dispatches" if wave
+             else "nomad.solver.dense_dispatches")
     return solve_lane_fused(
         lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
         spread_alg=lane.spread_alg, dtype_name=lane.dtype_name,
-        wave=lane.wavefront_ok())
+        wave=wave)
 
 
 class _DeviceShim:
