@@ -1584,8 +1584,23 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
             return jnp.stack([chosen.astype(scores.dtype), scores,
                               ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
-    cm, sf, si, pn, spd = jax.device_put(
-        (compact, scal_f, scal_i, pen, sp))
+    sharding = None
+    if batched and jax.device_count() > 1 \
+            and compact.shape[0] % jax.device_count() == 0:
+        # the fused eval axis is embarrassingly data-parallel: shard the
+        # lanes across chips (no collectives needed -- each chip runs its
+        # lanes' scans independently; outputs gather on fetch)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(np.asarray(jax.devices()), ("evals",))
+        sharding = NamedSharding(mesh, PartitionSpec("evals"))
+    if sharding is not None:
+        put = lambda a: jax.device_put(a, sharding)  # noqa: E731
+        cm, sf, si, pn = (put(compact), put(scal_f), put(scal_i),
+                          put(pen))
+        spd = jax.tree_util.tree_map(put, sp)
+    else:
+        cm, sf, si, pn, spd = jax.device_put(
+            (compact, scal_f, scal_i, pen, sp))
     combined = jax.device_get(fn(cm, sf, si, pn, spd))
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]

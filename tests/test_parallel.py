@@ -132,3 +132,31 @@ def test_eval_batch_independence():
     # eval 1 must not overflow node 0: its used_cpu was nearly full
     final_used = np.asarray(state.used_cpu)
     assert final_used[1, 0] <= 4000.0
+
+
+def test_wavefront_batched_shards_over_eval_axis():
+    """The fused wavefront dispatch data-parallels lanes across devices
+    (no collectives -- each chip scans its lanes); sharded results must
+    equal the per-lane solo solves."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import random
+
+    import tests.test_wavefront as tw
+    from nomad_tpu.solver.binpack import solve_lane_fused, solve_wavefront
+
+    lanes = [tw._world(random.Random(1400 + k), n=48, p=16, limit=5)
+             for k in range(8)]
+    const = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[0] for l in lanes])
+    init = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                  *[l[1] for l in lanes])
+    batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[2] for l in lanes])
+    chosen_b, scores_b, ny_b = solve_lane_fused(
+        const, init, batch, spread_alg=False, dtype_name="float64",
+        batched=True, wave=True)
+    for k, (c, i, b) in enumerate(lanes):
+        c1, s1, y1 = solve_wavefront(c, i, b, dtype_name="float64")
+        np.testing.assert_array_equal(chosen_b[k], np.asarray(c1))
+        np.testing.assert_array_equal(ny_b[k], np.asarray(y1))
