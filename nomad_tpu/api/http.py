@@ -449,6 +449,39 @@ class ApiHandler(BaseHTTPRequestHandler):
                     parts[3] == "evaluations":
                 self._send(200, state.evals_by_job(ns, parts[2]), index)
             elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
+                    parts[3] == "summary":
+                # (reference: structs.JobSummary, maintained by the state
+                # store; equivalent here computed on read from allocs +
+                # the latest eval's queued counts)
+                job = state.job_by_id(ns, parts[2])
+                if job is None:
+                    return self._error(404, "job not found")
+                summary = {tg.name: {
+                    "queued": 0, "starting": 0, "running": 0,
+                    "complete": 0, "failed": 0, "lost": 0, "unknown": 0,
+                } for tg in job.task_groups}
+                for a in state.allocs_by_job(ns, parts[2]):
+                    row = summary.get(a.task_group)
+                    if row is None:
+                        continue
+                    cs = a.client_status or "pending"
+                    key = {"pending": "starting", "running": "running",
+                           "complete": "complete", "failed": "failed",
+                           "lost": "lost", "unknown": "unknown"}.get(
+                               cs, "unknown")
+                    if a.server_terminal_status() and key in (
+                            "starting", "running"):
+                        continue
+                    row[key] += 1
+                evs = sorted(state.evals_by_job(ns, parts[2]),
+                             key=lambda e: e.modify_index, reverse=True)
+                if evs and evs[0].queued_allocations:
+                    for tg_name, n_q in evs[0].queued_allocations.items():
+                        if tg_name in summary:
+                            summary[tg_name]["queued"] = int(n_q)
+                self._send(200, {"job_id": parts[2], "namespace": ns,
+                                 "summary": summary}, index)
+            elif parts[:2] == ["v1", "job"] and len(parts) == 4 and \
                     parts[3] == "deployment":
                 self._send(200, state.latest_deployment_by_job(ns, parts[2]),
                            index)

@@ -409,3 +409,33 @@ def test_hcl2_function_with_runtime_ref_passes_through():
                 '}')
     assert job.meta["v"] == "${upper(NOMAD_ALLOC_ID)}"
     assert job.meta["ok"] == "ABC"
+
+
+def test_job_summary_endpoint():
+    """(reference: structs.JobSummary via /v1/job/:id/summary)"""
+    import time as _time
+
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        from nomad_tpu.client import SimClient
+        client = SimClient(server, mock.node())
+        client.start()
+        job = mock.job(id="sum-job")
+        job.task_groups[0].count = 3
+        server.register_job(job)
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        deadline = _time.time() + 10
+        summary = {}
+        while _time.time() < deadline:
+            summary = api.get("/v1/job/sum-job/summary")["summary"]
+            if summary.get("web", {}).get("running", 0) == 3:
+                break
+            _time.sleep(0.05)
+        assert summary["web"]["running"] == 3, summary
+        assert api.get("/v1/job/sum-job/summary")["job_id"] == "sum-job"
+    finally:
+        http.shutdown()
+        server.shutdown()
