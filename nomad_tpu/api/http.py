@@ -1151,6 +1151,30 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except Exception as e:  # noqa: BLE001 -- not leader etc.
                     return self._error(500, str(e))
                 self._send(200, {"removed": name})
+            elif parts[:2] == ["v1", "allocation"] and len(parts) == 4 \
+                    and parts[3] == "stop":
+                # (reference: alloc_endpoint.go Stop)
+                from ..acl import CAP_ALLOC_LIFECYCLE
+                alloc = self.nomad.state.alloc_by_id(parts[2])
+                if alloc is None:
+                    return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(
+                        alloc.namespace, CAP_ALLOC_LIFECYCLE)):
+                    return
+                eval_id = self.nomad.stop_alloc(parts[2])
+                self._send(200, {"eval_id": eval_id})
+            elif parts[:2] == ["v1", "job"] and len(parts) == 5 and \
+                    parts[3] == "periodic" and parts[4] == "force":
+                # (reference: periodic_endpoint.go Force)
+                from ..acl import CAP_SUBMIT_JOB
+                if not self._check(acl.allow_namespace_op(
+                        ns, CAP_SUBMIT_JOB)):
+                    return
+                try:
+                    child = self.nomad.periodic_force(ns, parts[2])
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"dispatched_job_id": child})
             elif parts == ["v1", "regions", "join"]:
                 # federation join (operator; pre-gated operator_write)
                 body = self._body()

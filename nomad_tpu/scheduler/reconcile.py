@@ -388,6 +388,11 @@ class AllocReconciler:
                         and a.node_id not in self.tainted):
                     # node is back -> reconnect path
                     reconnecting.append(a)
+                elif a.desired_transition.should_migrate():
+                    # operator-requested move on a HEALTHY node
+                    # (reference: alloc stop -> DesiredTransition.Migrate;
+                    # filterByTainted migrates these regardless of taint)
+                    migrate.append(a)
                 else:
                     untainted.append(a)
 

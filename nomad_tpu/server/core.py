@@ -1449,6 +1449,42 @@ class Server:
         child.periodic = None
         self.register_job(child)
 
+    def periodic_force(self, namespace: str, job_id: str) -> str:
+        """Launch a periodic job's child NOW (reference:
+        periodic_endpoint.go Force -> PeriodicDispatch.ForceRun).
+        Returns the child job id."""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        if not job.is_periodic():
+            raise ValueError(f"job {job_id!r} is not periodic")
+        now = time.time()
+        self._dispatch_periodic(job, now)
+        return f"{job.id}/periodic-{int(now)}"
+
+    def stop_alloc(self, alloc_id: str) -> Optional[str]:
+        """Stop ONE allocation and let the scheduler replace it
+        (reference: alloc_endpoint.go Stop -> DesiredTransition.Migrate +
+        eval). Returns the created eval id, or None for unknown allocs."""
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None
+        from ..structs import DesiredTransition
+        updated = alloc.copy_skip_job()
+        updated.job = alloc.job
+        updated.desired_transition = DesiredTransition(migrate=True)
+        self.state.upsert_allocs([updated])
+        ev = Evaluation(
+            id=generate_uuid(), namespace=alloc.namespace,
+            job_id=alloc.job_id, priority=alloc.job.priority
+            if alloc.job else 50,
+            type=alloc.job.type if alloc.job else "service",
+            triggered_by="alloc-stop", status=EVAL_STATUS_PENDING)
+        self.state.upsert_evals([ev])
+        self.broker.enqueue(ev)
+        self.publish_event("AllocStopRequested", {"alloc_id": alloc_id})
+        return ev.id
+
     def _run_deployment_watcher(self) -> None:
         """Drives rolling updates: watches alloc health within active
         deployments, advances/fails/completes them, and emits evals so the

@@ -507,3 +507,38 @@ def test_eval_broker_pause_resume(cluster):
         SchedulerConfiguration(pause_eval_broker=False))
     wait_until(lambda: len(running_allocs(server, job)) == 1,
                msg="resumed scheduling")
+
+
+def test_alloc_stop_replaces_allocation(cluster):
+    """(reference: alloc_endpoint.go Stop -> DesiredTransition.Migrate +
+    eval; the reconciler migrates should-migrate allocs on HEALTHY
+    nodes): the stopped alloc is replaced and the job stays at count."""
+    server, _clients = cluster
+    job = mock.job(id="alloc-stop-job")
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 2,
+               msg="initial allocs")
+    victim = running_allocs(server, job)[0]
+    eval_id = server.stop_alloc(victim.id)
+    assert eval_id
+
+    def replaced():
+        allocs = running_allocs(server, job)
+        return (len(allocs) == 2
+                and victim.id not in {a.id for a in allocs})
+    wait_until(replaced, msg="replacement alloc")
+    stopped = server.state.alloc_by_id(victim.id)
+    assert stopped.desired_status == "stop"
+
+
+def test_periodic_force_launches_child(cluster):
+    from nomad_tpu.structs import PeriodicConfig
+    server, _clients = cluster
+    job = mock.job(id="pf-job")
+    job.periodic = PeriodicConfig(spec="0 0 1 1 *", enabled=True)
+    server.register_job(job)
+    child_id = server.periodic_force("default", "pf-job")
+    assert child_id.startswith("pf-job/periodic-")
+    child = server.state.job_by_id("default", child_id)
+    assert child is not None and child.parent_id == "pf-job"
