@@ -229,6 +229,13 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_stop(args) -> int:
+    """(reference: command/alloc_stop.go)"""
+    out = _client(args).post(f"/v1/allocation/{args.id}/stop")
+    print(f"Stop requested; follow-up eval {out.get('eval_id')}")
+    return 0
+
+
 def cmd_alloc_fs(args) -> int:
     api = _client(args)
     path = args.path or "/"
@@ -611,6 +618,9 @@ def build_parser() -> argparse.ArgumentParser:
     als = al.add_parser("status")
     als.add_argument("id")
     als.set_defaults(fn=cmd_alloc_status)
+    alst = al.add_parser("stop")
+    alst.add_argument("id")
+    alst.set_defaults(fn=cmd_alloc_stop)
     alfs = al.add_parser("fs")
     alfs.add_argument("id")
     alfs.add_argument("path", nargs="?", default="/")
