@@ -45,6 +45,15 @@ class ClientConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """(reference: the telemetry{} agent block,
+    command/agent/command.go:1164 sink wiring)"""
+
+    statsd_address: str = ""
+    interval_s: float = 1.0
+
+
+@dataclass
 class AgentConfig:
     region: str = "global"
     datacenter: str = "dc1"
@@ -52,6 +61,7 @@ class AgentConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     client: ClientConfig = field(default_factory=ClientConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
 
 def _apply(obj, attrs: Dict[str, Any], mapping: Dict[str, str]) -> None:
@@ -92,6 +102,13 @@ def parse_agent_config(src: str) -> AgentConfig:
             "enabled": "enabled", "simulated_nodes": "simulated_nodes",
             "real_clients": "real_clients", "data_dir": "data_dir"})
         cfg.client.simulated_nodes = int(cfg.client.simulated_nodes)
+
+    tel = root.first("telemetry")
+    if tel is not None:
+        a = tel.attrs()
+        _apply(cfg.telemetry, a, {"statsd_address": "statsd_address",
+                                  "interval": "interval_s"})
+        cfg.telemetry.interval_s = float(cfg.telemetry.interval_s)
 
     tls = root.first("tls")
     if tls is not None:

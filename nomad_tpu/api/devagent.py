@@ -117,6 +117,13 @@ def main(argv=None) -> int:
             c = SimClient(server, mock.node(datacenter=args.datacenter))
             c.start()
             clients.append(c)
+    statsd = None
+    if file_cfg is not None and file_cfg.telemetry.statsd_address:
+        from ..server.telemetry import StatsdSink, metrics as _metrics
+        statsd = StatsdSink(file_cfg.telemetry.statsd_address, _metrics,
+                            interval_s=file_cfg.telemetry.interval_s)
+        statsd.start()
+        print(f"==> statsd sink: {file_cfg.telemetry.statsd_address}")
     if args.wan or args.wan_join:
         wan = server.enable_wan(f"{scheme}://127.0.0.1:{http.port}",
                                 name=args.region)
@@ -137,6 +144,8 @@ def main(argv=None) -> int:
         while not stop:
             time.sleep(0.2)
     finally:
+        if statsd is not None:
+            statsd.shutdown()
         http.shutdown()
         for c in clients:
             (c.stop if hasattr(c, "stop") else c.shutdown)()

@@ -114,5 +114,56 @@ class _Timer:
         return False
 
 
+class StatsdSink:
+    """Periodic UDP statsd flush of the registry (reference: go-metrics
+    statsd sink wired by telemetry{statsd_address=...} in the agent
+    config, command/agent/command.go:1164-1253). Counters emit deltas as
+    ``<name>:<delta>|c``; sample series emit their window mean as
+    ``<name>:<mean_ms>|ms``."""
+
+    def __init__(self, address: str, registry: "Telemetry",
+                 interval_s: float = 1.0):
+        import socket
+        import threading
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._registry = registry
+        self._interval = interval_s
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._last_counts: dict = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="statsd-sink")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.flush()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.flush()
+
+    def flush(self) -> None:
+        snap = self._registry.snapshot()
+        lines = []
+        for name, total in snap.get("counters", {}).items():
+            delta = total - self._last_counts.get(name, 0)
+            if delta:
+                lines.append(f"{name}:{delta}|c")
+            self._last_counts[name] = total
+        for name, s in snap.get("samples", {}).items():
+            if s.get("count"):
+                lines.append(f"{name}:{s.get('mean_ms', 0.0):.3f}|ms")
+        if not lines:
+            return
+        try:
+            self._sock.sendto("\n".join(lines).encode(), self._addr)
+        except OSError:
+            pass                  # sink loss must never hurt the server
+
+
 # Process-global registry, like go-metrics' global sink fanout.
 metrics = Telemetry()

@@ -73,3 +73,45 @@ def test_scheduler_series_emitted_end_to_end():
         c.stop()
     finally:
         server.shutdown()
+
+
+def test_statsd_sink_emits_deltas():
+    """(reference: go-metrics statsd sink via the telemetry{} agent
+    block): counters flush as deltas, samples as window means, over UDP."""
+    import socket
+
+    from nomad_tpu.server.telemetry import StatsdSink, Telemetry
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(3.0)
+    port = recv.getsockname()[1]
+
+    reg = Telemetry()
+    sink = StatsdSink(f"127.0.0.1:{port}", reg, interval_s=60.0)
+    reg.incr("nomad.test.counter", 3)
+    reg.sample_ms("nomad.test.latency", 12.5)
+    sink.flush()
+    data = recv.recv(65536).decode()
+    assert "nomad.test.counter:3|c" in data
+    assert "nomad.test.latency:12.500|ms" in data
+
+    # second flush: only NEW counter increments emit
+    reg.incr("nomad.test.counter", 2)
+    sink.flush()
+    data = recv.recv(65536).decode()
+    assert "nomad.test.counter:2|c" in data
+    sink.shutdown()
+    recv.close()
+
+
+def test_agent_config_telemetry_block(tmp_path):
+    from nomad_tpu.api.config import parse_agent_config
+    cfg = parse_agent_config('''
+telemetry {
+  statsd_address = "127.0.0.1:8125"
+  interval       = 2.5
+}
+''')
+    assert cfg.telemetry.statsd_address == "127.0.0.1:8125"
+    assert cfg.telemetry.interval_s == 2.5
