@@ -770,6 +770,29 @@ class ApiHandler(BaseHTTPRequestHandler):
                         {"id": name, "address": f"{a[0]}:{a[1]}",
                          "leader": name == lid, "voter": True}
                         for name, a in raft.configuration()]})
+            elif parts == ["v1", "agent", "self"]:
+                # (reference: agent_endpoint.go AgentSelfRequest)
+                cfg = self.nomad.state.scheduler_config()
+                raft = getattr(self.nomad, "raft", None)
+                self._send(200, {
+                    "config": {
+                        "region": self.nomad.region,
+                        "version": "nomad-tpu",
+                        "server": {"enabled": True,
+                                   "raft": raft is not None},
+                        "scheduler_algorithm":
+                            cfg.scheduler_algorithm if cfg else "",
+                    },
+                    "stats": {
+                        "nomad": {
+                            "leader": str(raft.is_leader()).lower()
+                            if raft is not None else "true",
+                        },
+                    },
+                    "member": {"name": getattr(self.nomad, "name",
+                                               "local"),
+                               "status": "alive"},
+                })
             elif parts == ["v1", "agent", "members"]:
                 serf = getattr(self.nomad, "serf", None)
                 if serf is None:

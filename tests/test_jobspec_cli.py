@@ -439,3 +439,18 @@ def test_job_summary_endpoint():
     finally:
         http.shutdown()
         server.shutdown()
+
+
+def test_agent_self_endpoint():
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        info = api.get("/v1/agent/self")
+        assert info["config"]["region"] == "global"
+        assert info["member"]["status"] == "alive"
+    finally:
+        http.shutdown()
+        server.shutdown()
