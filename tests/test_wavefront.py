@@ -361,3 +361,43 @@ def test_penalty_compact_path():
     sel = chosen_c >= 0
     np.testing.assert_allclose(scores_c[sel], np.asarray(scores_d)[sel],
                                rtol=1e-12)
+
+
+def test_random_config_sweep():
+    """Randomized cross-product of every wavefront-modeled feature
+    (ports, distinct, penalties, affinities, spreads, both windows, both
+    algorithms) vs the dense oracle kernel."""
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    for trial in range(25):
+        rng = random.Random(50000 + trial)
+        n = rng.choice([8, 25, 40, 80])
+        p = rng.choice([5, 20, 45])
+        kw = dict(
+            n_dyn=rng.choice([0, 0, 3, 9]),
+            has_static=rng.random() < 0.3,
+            distinct=rng.random() < 0.25,
+            job_level=rng.random() < 0.5,
+            low_score=rng.random() < 0.3,
+            count=rng.choice([1, 3, p]),
+            affinity=rng.random() < 0.4,
+            limit=rng.choice([2, 4, 9, 100]),
+            spreads=rng.choice([0, 0, 1, 2]),
+            spread_values=rng.choice([2, 4, 7]),
+            spread_targets=rng.random() < 0.5,
+            ask=(rng.choice([100, 500, 1500]),
+                 rng.choice([128, 512, 2048]), 300),
+        )
+        const, init, batch = _world(rng, n, p, **kw)
+        if rng.random() < 0.4:
+            pen = np.full(p, -1, dtype=np.int32)
+            for pi in range(0, p, 2):
+                if rng.random() < 0.5:
+                    pen[pi] = rng.randrange(n)
+            batch = batch._replace(penalty_idx=pen)
+        spread_alg = rng.random() < 0.3
+        cw = solve_lane_fused(const, init, batch, spread_alg=spread_alg,
+                              dtype_name="float64", wave=True)
+        cd = solve_placements(const, init, batch, spread_alg=spread_alg,
+                              dtype_name="float64")
+        assert (cw[0] == np.asarray(cd[0])).all(), (trial, kw)
+        assert (cw[2] == np.asarray(cd[2])).all(), (trial, kw)
