@@ -80,10 +80,16 @@ async function viewJobs() {
 }
 
 async function viewJob(id) {
-  const [job, allocs, evals] = await Promise.all([
+  const [job, allocs, evals, summaryResp] = await Promise.all([
     api(`/v1/job/${id}`),
     api(`/v1/job/${id}/allocations`).catch(() => []),
     api(`/v1/job/${id}/evaluations`).catch(() => []),
+    api(`/v1/job/${id}/summary`).catch(() => null),
+  ]);
+  const summary = summaryResp?.summary || {};
+  const sumRows = Object.entries(summary).map(([tg, s]) => [
+    esc(tg), esc(s.queued), esc(s.starting), esc(s.running),
+    esc(s.complete), esc(s.failed), esc(s.lost),
   ]);
   const tgRows = (job.task_groups || []).map((tg) => [
     esc(tg.name), esc(tg.count),
@@ -100,8 +106,11 @@ async function viewJob(id) {
     shortId(e.id), badge(e.status), esc(e.triggered_by), esc(e.type),
   ]);
   return h(`<h1>${esc(job.id)} ${badge(job.status)}</h1>
-    <p class="muted">${esc(job.type)} · priority ${esc(job.priority)} · v${esc(job.version)}</p>
-    <h2>Task groups</h2>` +
+    <p class="muted">${esc(job.type)} · priority ${esc(job.priority)} · v${esc(job.version)}</p>` +
+    (sumRows.length ? `<h2>Summary</h2>` +
+      table(["Group", "Queued", "Starting", "Running", "Complete",
+             "Failed", "Lost"], sumRows) : "") +
+    `<h2>Task groups</h2>` +
     table(["Name", "Count", "Tasks", "CPU", "Mem MB"], tgRows) +
     `<h2>Allocations (${allocs.length})</h2>` +
     table(["ID", "Group", "Client", "Desired", "Node", "Updated"], alRows) +

@@ -168,10 +168,9 @@ def test_ports_parity(seed):
 
 @pytest.mark.parametrize("seed", range(4))
 def test_affinity_parity(seed):
-    """Affinity lanes are production-ineligible today (their limit is
-    >= 100 > WAVE_B - MAX_SKIP), but the kernel carries the scoring term
-    (slot column 6 + the aff_present nscores component) -- keep it honest
-    against the dense oracle at kernel level."""
+    """Affinity scoring at kernel level (production affinity lanes ride
+    the wide-window compact variant; the in-kernel B=32 wavefront keeps
+    the same term -- slot column 6 + the aff_present nscores share)."""
     rng = random.Random(600 + seed)
     const, init, batch = _world(rng, n=40, p=30, limit=6, affinity=True)
     _compare(const, init, batch)
