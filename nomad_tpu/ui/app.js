@@ -89,7 +89,7 @@ async function viewJob(id) {
   const summary = summaryResp?.summary || {};
   const sumRows = Object.entries(summary).map(([tg, s]) => [
     esc(tg), esc(s.queued), esc(s.starting), esc(s.running),
-    esc(s.complete), esc(s.failed), esc(s.lost),
+    esc(s.complete), esc(s.failed), esc(s.lost), esc(s.unknown),
   ]);
   const tgRows = (job.task_groups || []).map((tg) => [
     esc(tg.name), esc(tg.count),
@@ -109,7 +109,7 @@ async function viewJob(id) {
     <p class="muted">${esc(job.type)} · priority ${esc(job.priority)} · v${esc(job.version)}</p>` +
     (sumRows.length ? `<h2>Summary</h2>` +
       table(["Group", "Queued", "Starting", "Running", "Complete",
-             "Failed", "Lost"], sumRows) : "") +
+             "Failed", "Lost", "Unknown"], sumRows) : "") +
     `<h2>Task groups</h2>` +
     table(["Name", "Count", "Tasks", "CPU", "Mem MB"], tgRows) +
     `<h2>Allocations (${allocs.length})</h2>` +
