@@ -242,13 +242,22 @@ class ApiHandler(BaseHTTPRequestHandler):
         return True
 
     def _client_for_alloc(self, alloc_id: str):
-        """-> (client, alloc) serving the alloc's fs, or (None, alloc)."""
+        """-> (client, alloc) serving the alloc's fs, or (None, alloc).
+        Falls back to the node's advertised client-agent listener
+        (reference: server->client RPC forwarding, nomad/client_rpc.go)
+        when the alloc's node is not served in-process."""
         alloc = self.nomad.state.alloc_by_id(alloc_id)
         if alloc is None:
             return None, None
         for c in getattr(self.server, "local_clients", []):
             if c.node.id == alloc.node_id:
                 return c, alloc
+        node = self.nomad.state.node_by_id(alloc.node_id)
+        addr = (node.attributes or {}).get("nomad.client_http", "") \
+            if node is not None else ""
+        if addr:
+            from ..client.http import RemoteClientProxy
+            return RemoteClientProxy(addr), alloc
         return None, alloc
 
     # ------------------------------------------------------------------
@@ -646,6 +655,18 @@ class ApiHandler(BaseHTTPRequestHandler):
                 for c in getattr(self.server, "local_clients", []):
                     if not node_id or c.node.id == node_id:
                         return self._send(200, c.client_stats())
+                if node_id:
+                    node = self.nomad.state.node_by_id(node_id)
+                    addr = (node.attributes or {}).get(
+                        "nomad.client_http", "") if node else ""
+                    if addr:
+                        from ..client.http import RemoteClientProxy
+                        try:
+                            return self._send(
+                                200,
+                                RemoteClientProxy(addr).client_stats())
+                        except OSError as e:
+                            return self._error(502, str(e))
                 return self._error(
                     501, "no matching client served by this agent")
             elif parts == ["v1", "services"]:

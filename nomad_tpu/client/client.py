@@ -114,7 +114,7 @@ class Client:
                  drivers: Optional[DriverRegistry] = None,
                  probe_jax: bool = False, identity_signer=None,
                  device_plugins=None, csi_plugins=None,
-                 api_addr: str = ""):
+                 api_addr: str = "", serve_http: bool = False):
         self.conn = conn
         self.data_dir = data_dir
         self.drivers = drivers or DriverRegistry()
@@ -142,6 +142,14 @@ class Client:
             # lets workloads reach the HTTP API via ${attr.nomad.api_addr}
             # (the connect sidecar's catalog resolution needs it)
             self.node.attributes["nomad.api_addr"] = api_addr
+        # server->client forwarding channel (reference: client/rpc.go):
+        # the node advertises its own listener so ANY server agent can
+        # proxy fs/logs/stats for allocs it does not host in-process
+        self.http = None
+        if serve_http:
+            from .http import ClientHttpServer
+            self.http = ClientHttpServer(self)
+            self.node.attributes["nomad.client_http"] = self.http.address
         # driver fingerprints -> node.drivers (reference: drivermanager)
         from ..structs import DriverInfo
         for dname, fp in self.drivers.fingerprints().items():
@@ -173,6 +181,8 @@ class Client:
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
         self.restore()
+        if self.http is not None:
+            self.http.start()
         self.conn.register_node(self.node)
         loops = [(self._heartbeat_loop, "heartbeat"),
                  (self._watch_allocations, "alloc-watch"),
@@ -188,6 +198,8 @@ class Client:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        if self.http is not None:
+            self.http.shutdown()
         with self._runner_lock:
             runners = list(self.runners.values())
         for r in runners:

@@ -1,0 +1,172 @@
+"""Client-agent HTTP listener: the server->client forwarding channel.
+
+Reference: every Nomad client serves Agent/Alloc/FS/ClientStats RPCs that
+servers reach over the persistent yamux session (client/rpc.go,
+nomad/client_rpc.go streaming passthrough). The HTTP-native analog here:
+a real client agent listens on its own port, the node advertises the
+address as the ``nomad.client_http`` attribute, and any server agent
+proxies /v1/client/* requests for allocs it does not host locally
+(api/http.py RemoteClientProxy). Ops mirror the in-process surface:
+fs_list / fs_stat / fs_read / fs_logs / alloc_stats / client_stats.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):     # noqa: D102 -- quiet
+        pass
+
+    def _send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_bytes(self, data: bytes) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:       # noqa: N802 -- stdlib contract
+        client = self.server.nomad_client
+        parsed = urlparse(self.path)
+        q = parse_qs(parsed.query)
+        parts = [p for p in parsed.path.split("/") if p]
+        try:
+            if parts[:1] == ["fs"] and len(parts) == 3:
+                op, alloc_id = parts[1], parts[2]
+                path = q.get("path", ["/"])[0]
+                if op == "ls":
+                    return self._send_json(
+                        200, client.fs_list(alloc_id, path))
+                if op == "stat":
+                    return self._send_json(
+                        200, client.fs_stat(alloc_id, path))
+                if op == "cat":
+                    offset = int(q.get("offset", ["0"])[0])
+                    limit = int(q.get("limit", [str(1 << 20)])[0])
+                    return self._send_bytes(
+                        client.fs_read(alloc_id, path, offset, limit))
+                return self._send_json(404, {"error": f"unknown op {op}"})
+            if parts[:1] == ["logs"] and len(parts) == 2:
+                data = client.fs_logs(
+                    parts[1], q.get("task", [""])[0],
+                    q.get("type", ["stdout"])[0],
+                    int(q.get("offset", ["0"])[0]),
+                    int(q.get("limit", [str(1 << 20)])[0]))
+                return self._send_bytes(data)
+            if parts[:1] == ["stats"] and len(parts) == 1:
+                return self._send_json(200, client.client_stats())
+            if parts[:1] == ["alloc-stats"] and len(parts) == 2:
+                return self._send_json(200, client.alloc_stats(parts[1]))
+            self._send_json(404, {"error": "unknown path"})
+        except KeyError as e:
+            self._send_json(404, {"error": str(e)})
+        except PermissionError as e:
+            self._send_json(403, {"error": str(e)})
+        except (OSError, ValueError) as e:
+            self._send_json(400, {"error": str(e)})
+
+
+class ClientHttpServer:
+    """Tiny per-client listener; start() returns after binding, and the
+    bound address is what the node advertises."""
+
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.nomad_client = client
+        self.port = self.httpd.server_address[1]
+        self.address = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="client-http")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class RemoteClientProxy:
+    """Server-side adapter speaking ClientHttpServer's surface with the
+    method names the /v1/client handlers call on in-process clients."""
+
+    def __init__(self, address: str, timeout: float = 5.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    @staticmethod
+    def _translate(e):
+        """Remote status -> the exception class the server handlers map
+        back to the same status (404 KeyError, 403 PermissionError)."""
+        try:
+            detail = json.loads(e.read()).get("error", str(e))
+        except Exception:  # noqa: BLE001
+            detail = str(e)
+        if e.code == 404:
+            return KeyError(detail)
+        if e.code == 403:
+            return PermissionError(detail)
+        return ValueError(detail)
+
+    def _get_json(self, path: str):
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(self.address + path,
+                                        timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            raise self._translate(e) from e
+
+    def _get_bytes(self, path: str) -> bytes:
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(self.address + path,
+                                        timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise self._translate(e) from e
+
+    def fs_list(self, alloc_id: str, path: str = "/"):
+        from urllib.parse import quote
+        return self._get_json(f"/fs/ls/{alloc_id}?path={quote(path)}")
+
+    def fs_stat(self, alloc_id: str, path: str = "/"):
+        from urllib.parse import quote
+        return self._get_json(f"/fs/stat/{alloc_id}?path={quote(path)}")
+
+    def fs_read(self, alloc_id: str, path: str, offset: int = 0,
+                limit: int = 1 << 20) -> bytes:
+        from urllib.parse import quote
+        return self._get_bytes(
+            f"/fs/cat/{alloc_id}?path={quote(path)}"
+            f"&offset={offset}&limit={limit}")
+
+    def fs_logs(self, alloc_id: str, task: str, kind: str = "stdout",
+                offset: int = 0, limit: int = 1 << 20) -> bytes:
+        from urllib.parse import quote
+        return self._get_bytes(
+            f"/logs/{alloc_id}?task={quote(task)}&type={quote(kind)}"
+            f"&offset={offset}&limit={limit}")
+
+    def client_stats(self):
+        return self._get_json("/stats")
+
+    def alloc_stats(self, alloc_id: str):
+        return self._get_json(f"/alloc-stats/{alloc_id}")

@@ -172,3 +172,51 @@ def test_host_uptime_is_real():
     from nomad_tpu.client.hoststats import HostStatsCollector
     up = HostStatsCollector._host_uptime()
     assert up > 1.0     # the host has been up longer than this test
+
+
+def test_remote_client_forwarding(tmp_path):
+    """A server agent that does NOT host the client in-process proxies
+    fs/logs/stats through the node's advertised client listener
+    (reference: server->client RPC forwarding, nomad/client_rpc.go)."""
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.client.client import Client, LocalServerConn
+
+    server = Server(num_workers=1, heartbeat_ttl=5.0)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path),
+                    name="remote-fs-node", serve_http=True)
+    client.start()
+    # NOTE: no clients= -- this agent has no in-process client
+    http = HttpServer(server, port=0)
+    http.start()
+    api = ApiClient(f"http://127.0.0.1:{http.port}")
+    try:
+        node = server.state.node_by_id(client.node.id)
+        assert node.attributes.get("nomad.client_http", "").startswith(
+            "http://")
+        job = run_logged_job(server, job_id="remote-logged",
+                             stdout="remote hello\n")
+        alloc = wait_running(server, "remote-logged")
+        # fs listing + log read, proxied over the client listener
+        entries = api.request("GET", f"/v1/client/fs/ls/{alloc.id}",
+                              params={"path": "/"})
+        assert any(e["name"] == "alloc" for e in entries)
+        task_name = job.task_groups[0].tasks[0].name
+        deadline = time.time() + 10
+        data = b""
+        while time.time() < deadline:
+            data = api.request_raw(
+                "GET", f"/v1/client/fs/logs/{alloc.id}/{task_name}"
+                "?type=stdout")
+            if b"remote hello" in data:
+                break
+            time.sleep(0.1)
+        assert b"remote hello" in data
+        stats = api.get("/v1/client/stats",
+                        node_id=client.node.id)
+        assert stats
+    finally:
+        http.shutdown()
+        client.shutdown()
+        server.shutdown()
