@@ -108,7 +108,8 @@ def main(argv=None) -> int:
             c = Client(LocalServerConn(server),
                        os.path.join(base, f"client{i}"),
                        name=f"dev-client-{i}",
-                       api_addr=f"{scheme}://127.0.0.1:{http.port}")
+                       api_addr=f"{scheme}://127.0.0.1:{http.port}",
+                       serve_http=True)
             c.start()
             clients.append(c)
             http.add_client(c)
