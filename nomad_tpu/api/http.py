@@ -1195,6 +1195,34 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except Exception as e:  # noqa: BLE001 -- not leader etc.
                     return self._error(500, str(e))
                 self._send(200, {"removed": name})
+            elif parts[:3] == ["v1", "client", "allocation"] and \
+                    len(parts) == 5 and parts[4] == "exec":
+                # one-shot exec in a task's context (reference:
+                # `nomad alloc exec`, non-interactive form)
+                from ..acl import CAP_ALLOC_EXEC
+                client, alloc = self._client_for_alloc(parts[3])
+                if alloc is None:
+                    return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(
+                        alloc.namespace, CAP_ALLOC_EXEC)):
+                    return
+                if client is None:
+                    return self._error(
+                        501, "alloc's node is not served by this agent")
+                body = self._body()
+                cmd = body.get("cmd") or []
+                if not isinstance(cmd, list) or not cmd:
+                    return self._error(400, "cmd must be a non-empty list")
+                try:
+                    out = client.alloc_exec(
+                        parts[3], str(body.get("task", "")),
+                        [str(c) for c in cmd],
+                        timeout=float(body.get("timeout", 10.0)))
+                except KeyError as e:
+                    return self._error(404, str(e))
+                except Exception as e:  # noqa: BLE001 -- driver errors
+                    return self._error(400, str(e))
+                self._send(200, out)
             elif parts[:2] == ["v1", "allocation"] and len(parts) == 4 \
                     and parts[3] == "stop":
                 # (reference: alloc_endpoint.go Stop)

@@ -236,6 +236,16 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_alloc_exec(args) -> int:
+    """(reference: command/alloc_exec.go, non-interactive form)"""
+    out = _client(args).post(
+        f"/v1/client/allocation/{args.id}/exec",
+        {"task": args.task, "cmd": args.cmd})
+    sys.stdout.write(out.get("stdout", ""))
+    sys.stderr.write(out.get("stderr", ""))
+    return int(out.get("exit_code", 0))
+
+
 def cmd_alloc_fs(args) -> int:
     api = _client(args)
     path = args.path or "/"
@@ -621,6 +631,11 @@ def build_parser() -> argparse.ArgumentParser:
     alst = al.add_parser("stop")
     alst.add_argument("id")
     alst.set_defaults(fn=cmd_alloc_stop)
+    alex = al.add_parser("exec")
+    alex.add_argument("-task", required=True)
+    alex.add_argument("id")
+    alex.add_argument("cmd", nargs="+")
+    alex.set_defaults(fn=cmd_alloc_exec)
     alfs = al.add_parser("fs")
     alfs.add_argument("id")
     alfs.add_argument("path", nargs="?", default="/")

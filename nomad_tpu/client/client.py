@@ -399,6 +399,23 @@ class Client:
         return {"alloc_id": alloc_id, "tasks": tasks,
                 "memory_bytes": total_mem, "cpu_usec": total_cpu}
 
+    def alloc_exec(self, alloc_id: str, task: str,
+                   cmd: List[str], timeout: float = 10.0) -> dict:
+        """One-shot command inside a live task's context (reference:
+        `nomad alloc exec` / plugins/drivers ExecTask -- scoped to the
+        non-interactive form: captured stdout/stderr + exit code)."""
+        with self._runner_lock:
+            runner = self.runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc {alloc_id} not running here")
+        tr = runner.task_runners.get(task)
+        if tr is None:
+            raise KeyError(f"task {task!r} not found in alloc")
+        if tr.handle is None or tr.driver is None:
+            raise KeyError(f"task {task!r} has no live handle")
+        return tr.driver.exec_task(tr.handle, tr.env, tr.task_dir, cmd,
+                                   timeout=timeout)
+
     def fs_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
                 offset: int = 0, limit: int = 1 << 20) -> bytes:
         """Rotated log frames for a task, sliced WITHOUT loading the full

@@ -103,6 +103,28 @@ class Driver:
         """Re-attach after agent restart; False if unrecoverable."""
         return False
 
+    def exec_task(self, handle: TaskHandle, env: Dict[str, str],
+                  task_dir, cmd: List[str],
+                  timeout: float = 10.0) -> Dict[str, object]:
+        """One-shot command in the task's context (reference:
+        plugins/drivers ExecTask; the interactive streaming form is
+        `nomad alloc exec`). Base semantics: run in the task dir with
+        the task env -- isolated drivers override to enter the task's
+        namespaces."""
+        import subprocess
+        cwd = getattr(task_dir, "local_dir", None) if task_dir else None
+        try:
+            proc = subprocess.run(
+                cmd, cwd=cwd, env=dict(env), capture_output=True,
+                timeout=timeout)
+        except FileNotFoundError as e:
+            raise DriverError(str(e)) from e
+        except subprocess.TimeoutExpired as e:
+            raise DriverError(f"exec timed out after {timeout}s") from e
+        return {"stdout": proc.stdout.decode("utf-8", "replace"),
+                "stderr": proc.stderr.decode("utf-8", "replace"),
+                "exit_code": proc.returncode}
+
 
 # ---------------------------------------------------------------------------
 class _MockInstance:
@@ -381,6 +403,62 @@ class ExecDriver(RawExecDriver):
                 state["cgroup_paths"] = list(cgroup.paths)
         return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid,
                           started_at=time.time(), driver_state=state)
+
+    def exec_task(self, handle: TaskHandle, env: Dict[str, str],
+                  task_dir, cmd: List[str],
+                  timeout: float = 10.0) -> Dict[str, object]:
+        """Enter the live task's namespaces + chroot via nsenter when the
+        task runs isolated (reference: executor Exec entering the
+        container); degrades to the base in-task-dir semantics
+        otherwise."""
+        if not handle.driver_state.get("isolated") or handle.pid <= 0:
+            return super().exec_task(handle, env, task_dir, cmd,
+                                     timeout=timeout)
+        import subprocess
+
+        def payload_pid(pid: int) -> int:
+            # handle.pid is the LAUNCHER; the chrooted payload is its
+            # descendant -- descend the (single-child) chain to the
+            # process that actually lives in the sandbox namespaces
+            for _ in range(6):
+                try:
+                    with open(f"/proc/{pid}/task/{pid}/children") as fh:
+                        kids = fh.read().split()
+                except OSError:
+                    break
+                if not kids:
+                    break
+                pid = int(kids[0])
+            return pid
+
+        def sandboxed(pid: int) -> bool:
+            try:
+                host = os.stat("/")
+                root = os.stat(f"/proc/{pid}/root/.")
+                return (root.st_dev, root.st_ino) != (host.st_dev,
+                                                      host.st_ino)
+            except OSError:
+                return False
+
+        # the launcher chroots the payload asynchronously after start:
+        # wait briefly for a descendant whose root is the sandbox
+        target = payload_pid(handle.pid)
+        deadline = time.time() + 5.0
+        while not sandboxed(target) and time.time() < deadline:
+            time.sleep(0.05)
+            target = payload_pid(handle.pid)
+        full = ["nsenter", "-t", str(target), "-m", "-p", "-r", "-w",
+                "--"] + list(cmd)
+        try:
+            proc = subprocess.run(full, env=dict(env),
+                                  capture_output=True, timeout=timeout)
+        except FileNotFoundError as e:
+            raise DriverError(str(e)) from e
+        except subprocess.TimeoutExpired as e:
+            raise DriverError(f"exec timed out after {timeout}s") from e
+        return {"stdout": proc.stdout.decode("utf-8", "replace"),
+                "stderr": proc.stderr.decode("utf-8", "replace"),
+                "exit_code": proc.returncode}
 
     def wait_task(self, handle: TaskHandle,
                   timeout: Optional[float] = None) -> Optional[ExitResult]:

@@ -79,6 +79,28 @@ class _Handler(BaseHTTPRequestHandler):
         except (OSError, ValueError) as e:
             self._send_json(400, {"error": str(e)})
 
+    def do_POST(self) -> None:      # noqa: N802 -- stdlib contract
+        client = self.server.nomad_client
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            return self._send_json(400, {"error": "bad json"})
+        try:
+            if parts[:1] == ["exec"] and len(parts) == 2:
+                out = client.alloc_exec(
+                    parts[1], str(body.get("task", "")),
+                    [str(c) for c in (body.get("cmd") or [])],
+                    timeout=float(body.get("timeout", 10.0)))
+                return self._send_json(200, out)
+            self._send_json(404, {"error": "unknown path"})
+        except KeyError as e:
+            self._send_json(404, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 -- driver errors
+            self._send_json(400, {"error": str(e)})
+
 
 class ClientHttpServer:
     """Tiny per-client listener; start() returns after binding, and the
@@ -170,3 +192,20 @@ class RemoteClientProxy:
 
     def alloc_stats(self, alloc_id: str):
         return self._get_json(f"/alloc-stats/{alloc_id}")
+
+    def alloc_exec(self, alloc_id: str, task: str, cmd,
+                   timeout: float = 10.0):
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.address}/exec/{alloc_id}",
+            data=json.dumps({"task": task, "cmd": cmd,
+                             "timeout": timeout}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=max(self.timeout, timeout + 2)) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            raise self._translate(e) from e

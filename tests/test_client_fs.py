@@ -220,3 +220,60 @@ def test_remote_client_forwarding(tmp_path):
         http.shutdown()
         client.shutdown()
         server.shutdown()
+
+
+def test_alloc_exec_in_task_context(env):
+    """Non-interactive alloc exec (reference: `nomad alloc exec` /
+    ExecTask): command runs with the task's env in its task dir, both
+    in-process and through the remote forwarding path."""
+    server, client, api = env
+    job = mock.job(id="exec-job")
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "sleep 30"]}
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    alloc = wait_running(server, "exec-job")
+    out = api.post(f"/v1/client/allocation/{alloc.id}/exec",
+                   {"task": task.name,
+                    "cmd": ["/bin/sh", "-c",
+                            "echo alloc=$NOMAD_ALLOC_ID; pwd"]})
+    assert out["exit_code"] == 0, out
+    assert f"alloc={alloc.id}" in out["stdout"]
+    assert "local" in out["stdout"]    # cwd = the task dir
+
+    # unknown task -> 404
+    from nomad_tpu.api.client import ApiError
+    with pytest.raises(ApiError):
+        api.post(f"/v1/client/allocation/{alloc.id}/exec",
+                 {"task": "nope", "cmd": ["true"]})
+
+
+def test_alloc_exec_remote_forwarding(tmp_path):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.client.client import Client, LocalServerConn
+
+    server = Server(num_workers=1, heartbeat_ttl=5.0)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path),
+                    name="exec-remote-node", serve_http=True)
+    client.start()
+    http = HttpServer(server, port=0)   # no in-process client
+    http.start()
+    api = ApiClient(f"http://127.0.0.1:{http.port}")
+    try:
+        job = mock.job(id="exec-remote")
+        task = job.task_groups[0].tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh", "args": ["-c", "sleep 30"]}
+        job.task_groups[0].count = 1
+        server.register_job(job)
+        alloc = wait_running(server, "exec-remote")
+        out = api.post(f"/v1/client/allocation/{alloc.id}/exec",
+                       {"task": task.name, "cmd": ["echo", "proxied"]})
+        assert out["exit_code"] == 0 and "proxied" in out["stdout"]
+    finally:
+        http.shutdown()
+        client.shutdown()
+        server.shutdown()

@@ -462,3 +462,26 @@ def test_volume_destination_escape_rejected(tmp_path):
     runner.task_dir.build()
     with pytest.raises(DriverError, match="escapes the sandbox"):
         VolumeHook().prestart(runner)
+
+
+@needs_isolation
+def test_exec_task_enters_namespaces(tmp_path):
+    """exec_task on an isolated task runs INSIDE its mount namespace +
+    chroot (reference: executor Exec entering the container): the command
+    must see the sandbox root, not the host filesystem."""
+    td = make_task_dir(tmp_path)
+    drv = ExecDriver()
+    task = exec_task("/bin/sh", ["-c", "sleep 20"])
+    handle = drv.start_task("iso-exec-0001", task,
+                            {"NOMAD_TASK_NAME": "t1"}, td)
+    try:
+        assert handle.driver_state.get("isolated")
+        out = drv.exec_task(handle, {"NOMAD_TASK_NAME": "t1"}, td,
+                            ["/bin/sh", "-c",
+                             "ls /root/repo >/dev/null 2>&1 "
+                             "&& echo VISIBLE || echo ISOLATED; ls /"])
+        assert out["exit_code"] == 0, out
+        assert "ISOLATED" in out["stdout"], out
+        assert "local" in out["stdout"]       # sandbox root layout
+    finally:
+        drv.stop_task(handle, kill_timeout=1.0)
