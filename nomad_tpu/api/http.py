@@ -1214,10 +1214,16 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if not isinstance(cmd, list) or not cmd:
                     return self._error(400, "cmd must be a non-empty list")
                 try:
+                    exec_timeout = float(body.get("timeout", 10.0))
+                except (TypeError, ValueError):
+                    return self._error(400, "timeout must be a number")
+                if not (0 < exec_timeout <= 300):
+                    return self._error(
+                        400, "timeout must be in (0, 300] seconds")
+                try:
                     out = client.alloc_exec(
                         parts[3], str(body.get("task", "")),
-                        [str(c) for c in cmd],
-                        timeout=float(body.get("timeout", 10.0)))
+                        [str(c) for c in cmd], timeout=exec_timeout)
                 except KeyError as e:
                     return self._error(404, str(e))
                 except Exception as e:  # noqa: BLE001 -- driver errors

@@ -238,9 +238,11 @@ def cmd_alloc_stop(args) -> int:
 
 def cmd_alloc_exec(args) -> int:
     """(reference: command/alloc_exec.go, non-interactive form)"""
-    out = _client(args).post(
-        f"/v1/client/allocation/{args.id}/exec",
-        {"task": args.task, "cmd": args.cmd})
+    out = _client(args).request(
+        "POST", f"/v1/client/allocation/{args.id}/exec",
+        body={"task": args.task, "cmd": args.cmd,
+              "timeout": args.timeout},
+        timeout=args.timeout + 10.0)    # pad past every server-side leg
     sys.stdout.write(out.get("stdout", ""))
     sys.stderr.write(out.get("stderr", ""))
     return int(out.get("exit_code", 0))
@@ -633,6 +635,7 @@ def build_parser() -> argparse.ArgumentParser:
     alst.set_defaults(fn=cmd_alloc_stop)
     alex = al.add_parser("exec")
     alex.add_argument("-task", required=True)
+    alex.add_argument("-timeout", type=float, default=10.0)
     alex.add_argument("id")
     alex.add_argument("cmd", nargs="+")
     alex.set_defaults(fn=cmd_alloc_exec)

@@ -74,6 +74,21 @@ class DriverError(Exception):
     pass
 
 
+def _run_captured(argv: List[str], env: Dict[str, str],
+                  cwd: Optional[str], timeout: float) -> Dict[str, object]:
+    """Shared one-shot exec: captured output + DriverError translation."""
+    try:
+        proc = subprocess.run(argv, cwd=cwd, env=dict(env),
+                              capture_output=True, timeout=timeout)
+    except FileNotFoundError as e:
+        raise DriverError(str(e)) from e
+    except subprocess.TimeoutExpired as e:
+        raise DriverError(f"exec timed out after {timeout}s") from e
+    return {"stdout": proc.stdout.decode("utf-8", "replace"),
+            "stderr": proc.stderr.decode("utf-8", "replace"),
+            "exit_code": proc.returncode}
+
+
 class Driver:
     """(reference: plugins/drivers/driver.go DriverPlugin)"""
 
@@ -111,19 +126,8 @@ class Driver:
         `nomad alloc exec`). Base semantics: run in the task dir with
         the task env -- isolated drivers override to enter the task's
         namespaces."""
-        import subprocess
         cwd = getattr(task_dir, "local_dir", None) if task_dir else None
-        try:
-            proc = subprocess.run(
-                cmd, cwd=cwd, env=dict(env), capture_output=True,
-                timeout=timeout)
-        except FileNotFoundError as e:
-            raise DriverError(str(e)) from e
-        except subprocess.TimeoutExpired as e:
-            raise DriverError(f"exec timed out after {timeout}s") from e
-        return {"stdout": proc.stdout.decode("utf-8", "replace"),
-                "stderr": proc.stderr.decode("utf-8", "replace"),
-                "exit_code": proc.returncode}
+        return _run_captured(list(cmd), env, cwd, timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -414,22 +418,6 @@ class ExecDriver(RawExecDriver):
         if not handle.driver_state.get("isolated") or handle.pid <= 0:
             return super().exec_task(handle, env, task_dir, cmd,
                                      timeout=timeout)
-        import subprocess
-
-        def payload_pid(pid: int) -> int:
-            # handle.pid is the LAUNCHER; the chrooted payload is its
-            # descendant -- descend the (single-child) chain to the
-            # process that actually lives in the sandbox namespaces
-            for _ in range(6):
-                try:
-                    with open(f"/proc/{pid}/task/{pid}/children") as fh:
-                        kids = fh.read().split()
-                except OSError:
-                    break
-                if not kids:
-                    break
-                pid = int(kids[0])
-            return pid
 
         def sandboxed(pid: int) -> bool:
             try:
@@ -440,25 +428,44 @@ class ExecDriver(RawExecDriver):
             except OSError:
                 return False
 
+        def payload_pid(pid: int) -> Optional[int]:
+            # handle.pid is the LAUNCHER; descend the child chain and
+            # stop at the FIRST process whose root is the sandbox
+            # (deeper descendants may be short-lived grandchildren)
+            for _ in range(6):
+                if sandboxed(pid):
+                    return pid
+                try:
+                    with open(f"/proc/{pid}/task/{pid}/children") as fh:
+                        kids = fh.read().split()
+                except OSError:
+                    return None
+                if not kids:
+                    return None
+                pid = int(kids[0])
+            return None
+
         # the launcher chroots the payload asynchronously after start:
-        # wait briefly for a descendant whose root is the sandbox
+        # wait briefly for a sandboxed descendant, and NEVER run against
+        # an unsandboxed target (that would execute on the host root)
         target = payload_pid(handle.pid)
         deadline = time.time() + 5.0
-        while not sandboxed(target) and time.time() < deadline:
+        while target is None and time.time() < deadline:
             time.sleep(0.05)
             target = payload_pid(handle.pid)
-        full = ["nsenter", "-t", str(target), "-m", "-p", "-r", "-w",
-                "--"] + list(cmd)
-        try:
-            proc = subprocess.run(full, env=dict(env),
-                                  capture_output=True, timeout=timeout)
-        except FileNotFoundError as e:
-            raise DriverError(str(e)) from e
-        except subprocess.TimeoutExpired as e:
-            raise DriverError(f"exec timed out after {timeout}s") from e
-        return {"stdout": proc.stdout.decode("utf-8", "replace"),
-                "stderr": proc.stderr.decode("utf-8", "replace"),
-                "exit_code": proc.returncode}
+        if target is None:
+            raise DriverError("task sandbox not available for exec")
+        # sandbox paths, like _start_isolated rewrites for the payload
+        env = dict(env)
+        env.update({"NOMAD_TASK_DIR": "/local",
+                    "NOMAD_ALLOC_DIR": "/alloc",
+                    "NOMAD_SECRETS_DIR": "/secrets"})
+        # in-sandbox `timeout` kills the command itself: subprocess.run's
+        # timeout only kills nsenter, orphaning the forked child inside
+        # the task's pid namespace
+        full = (["nsenter", "-t", str(target), "-m", "-p", "-r", "-w",
+                 "--", "timeout", f"{timeout:.1f}"] + list(cmd))
+        return _run_captured(full, env, None, timeout + 2.0)
 
     def wait_task(self, handle: TaskHandle,
                   timeout: Optional[float] = None) -> Optional[ExitResult]:
