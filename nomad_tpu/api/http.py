@@ -780,6 +780,28 @@ class ApiHandler(BaseHTTPRequestHandler):
                 else:
                     lid, addr = raft.leader()
                     self._send(200, f"{addr[0]}:{addr[1]}" if addr else lid)
+            elif parts == ["v1", "operator", "autopilot", "health"]:
+                # (reference: operator_autopilot.go ServerHealth)
+                raft = getattr(self.nomad, "raft", None)
+                serf = getattr(self.nomad, "serf", None)
+                if raft is None:
+                    return self._send(200, {"healthy": True,
+                                            "servers": []})
+                alive = ({m.name: m.status for m in serf.members()}
+                         if serf is not None else {})
+                lid, _ = raft.leader()
+                servers = [{
+                    "id": name, "address": f"{a[0]}:{a[1]}",
+                    "leader": name == lid, "voter": True,
+                    "serf_status": alive.get(name, "unknown"),
+                    "healthy": alive.get(name, "alive") == "alive",
+                } for name, a in raft.configuration()]
+                self._send(200, {
+                    "healthy": all(s["healthy"] for s in servers),
+                    "failure_tolerance":
+                        max(0, sum(1 for s in servers if s["healthy"])
+                            - (len(servers) // 2 + 1)),
+                    "servers": servers})
             elif parts == ["v1", "operator", "raft", "configuration"]:
                 # (reference: operator_endpoint.go RaftGetConfiguration)
                 raft = getattr(self.nomad, "raft", None)

@@ -524,3 +524,21 @@ def test_autopilot_removes_dead_server():
     finally:
         for s in servers:
             s.shutdown()
+
+
+def test_autopilot_health_endpoint(cluster):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+
+    leader = wait_for_leader(cluster)
+    http = HttpServer(leader, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        health = api.get("/v1/operator/autopilot/health")
+        assert health["healthy"] is True
+        assert len(health["servers"]) == 3
+        assert sum(1 for s in health["servers"] if s["leader"]) == 1
+        assert health["failure_tolerance"] == 1
+    finally:
+        http.shutdown()
