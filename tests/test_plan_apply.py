@@ -194,7 +194,14 @@ def test_pipeline_reverifies_after_commit_failure():
 
         t1 = threading.Thread(target=submit_first)
         t1.start()
-        time.sleep(0.03)
+        # wait until plan 1's commit is actually IN FLIGHT (a fixed sleep
+        # races on loaded single-core CI): the overlay only exists while
+        # the slow commit runs
+        deadline = time.time() + 5
+        while time.time() < deadline and not any(
+                n == "commit-start" for n, _ in store.events):
+            time.sleep(0.005)
+        assert any(n == "commit-start" for n, _ in store.events)
         # second plan claims the SAME port: against the overlay it would
         # be rejected, but plan 1's commit fails -> re-verified clean ->
         # must commit
