@@ -1263,6 +1263,15 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return
                 eval_id = self.nomad.stop_alloc(parts[2])
                 self._send(200, {"eval_id": eval_id})
+            elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
+                    parts[3] == "purge":
+                # (reference: node_endpoint.go Deregister via
+                # `nomad node purge`); node:write pre-gated above
+                try:
+                    self.nomad.deregister_node(parts[2])
+                except ValueError as e:
+                    return self._error(404, str(e))
+                self._send(200, {"purged": parts[2]})
             elif parts[:2] == ["v1", "job"] and len(parts) == 5 and \
                     parts[3] == "periodic" and parts[4] == "force":
                 # (reference: periodic_endpoint.go Force)

@@ -270,6 +270,13 @@ def cmd_alloc_logs(args) -> int:
     return 0
 
 
+def cmd_node_purge(args) -> int:
+    """(reference: command/node_purge.go)"""
+    _client(args).post(f"/v1/node/{args.id}/purge")
+    print(f"Purged node {args.id}")
+    return 0
+
+
 def cmd_node_stats(args) -> int:
     stats = _client(args).client_stats(args.id)
     print(json.dumps(stats, indent=2))
@@ -611,6 +618,9 @@ def build_parser() -> argparse.ArgumentParser:
     nst = node.add_parser("stats")
     nst.add_argument("id", nargs="?", default="")
     nst.set_defaults(fn=cmd_node_stats)
+    npg = node.add_parser("purge")
+    npg.add_argument("id")
+    npg.set_defaults(fn=cmd_node_purge)
     nd = node.add_parser("drain")
     nd.add_argument("id")
     g = nd.add_mutually_exclusive_group(required=True)

@@ -793,6 +793,17 @@ class Server:
         self.blocked_evals.unblock(node.computed_class)
         self.publish_event("NodeRegistered", {"node_id": node.id})
 
+    def deregister_node(self, node_id: str) -> None:
+        """Purge a node from state (reference: node_endpoint.go:
+        Node.Deregister): the node goes down first so its allocs
+        reschedule, then the record is removed."""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise ValueError(f"unknown node {node_id!r}")
+        self.update_node_status(node_id, NODE_STATUS_DOWN)
+        self.state.delete_node(node_id)
+        self.publish_event("NodeDeregistered", {"node_id": node_id})
+
     def update_node_status(self, node_id: str, status: str) -> None:
         """(reference: node_endpoint.go:541 UpdateStatus)"""
         node = self.state.node_by_id(node_id)

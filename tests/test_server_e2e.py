@@ -542,3 +542,23 @@ def test_periodic_force_launches_child(cluster):
     assert child_id.startswith("pf-job/periodic-")
     child = server.state.job_by_id("default", child_id)
     assert child is not None and child.parent_id == "pf-job"
+
+
+def test_node_purge_reschedules_allocs(cluster):
+    """(reference: node_endpoint.go Deregister): purging a node removes
+    it from state and its allocs reschedule elsewhere."""
+    server, clients = cluster
+    job = mock.job(id="purge-move-job")
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 2,
+               msg="initial allocs")
+    victim_node = running_allocs(server, job)[0].node_id
+    server.deregister_node(victim_node)
+    assert server.state.node_by_id(victim_node) is None
+
+    def moved():
+        allocs = running_allocs(server, job)
+        return (len(allocs) == 2
+                and all(a.node_id != victim_node for a in allocs))
+    wait_until(moved, msg="allocs moved off the purged node")
