@@ -236,6 +236,15 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_alloc_restart(args) -> int:
+    """(reference: command/alloc_restart.go)"""
+    out = _client(args).post(
+        f"/v1/client/allocation/{args.id}/restart",
+        {"task": args.task or ""})
+    print(f"Restarted: {', '.join(out.get('restarted', []))}")
+    return 0
+
+
 def cmd_alloc_exec(args) -> int:
     """(reference: command/alloc_exec.go, non-interactive form)"""
     out = _client(args).request(
@@ -643,6 +652,10 @@ def build_parser() -> argparse.ArgumentParser:
     alst = al.add_parser("stop")
     alst.add_argument("id")
     alst.set_defaults(fn=cmd_alloc_stop)
+    alrs = al.add_parser("restart")
+    alrs.add_argument("-task", default="")
+    alrs.add_argument("id")
+    alrs.set_defaults(fn=cmd_alloc_restart)
     alex = al.add_parser("exec")
     alex.add_argument("-task", required=True)
     alex.add_argument("-timeout", type=float, default=10.0)

@@ -399,6 +399,24 @@ class Client:
         return {"alloc_id": alloc_id, "tasks": tasks,
                 "memory_bytes": total_mem, "cpu_usec": total_cpu}
 
+    def alloc_restart(self, alloc_id: str, task: str = "") -> dict:
+        """In-place restart of a live alloc's task(s) (reference:
+        alloc_endpoint.go Restart via server->client forwarding)."""
+        with self._runner_lock:
+            runner = self.runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc {alloc_id} not running here")
+        targets = ([task] if task
+                   else list(runner.task_runners.keys()))
+        restarted = []
+        for name in targets:
+            tr = runner.task_runners.get(name)
+            if tr is None:
+                raise KeyError(f"task {name!r} not found in alloc")
+            tr.restart()
+            restarted.append(name)
+        return {"restarted": restarted}
+
     def alloc_exec(self, alloc_id: str, task: str,
                    cmd: List[str], timeout: float = 10.0) -> dict:
         """One-shot command inside a live task's context (reference:

@@ -95,6 +95,10 @@ class _Handler(BaseHTTPRequestHandler):
                     [str(c) for c in (body.get("cmd") or [])],
                     timeout=float(body.get("timeout", 10.0)))
                 return self._send_json(200, out)
+            if parts[:1] == ["restart"] and len(parts) == 2:
+                out = client.alloc_restart(
+                    parts[1], str(body.get("task", "")))
+                return self._send_json(200, out)
             self._send_json(404, {"error": "unknown path"})
         except KeyError as e:
             self._send_json(404, {"error": str(e)})
@@ -192,6 +196,20 @@ class RemoteClientProxy:
 
     def alloc_stats(self, alloc_id: str):
         return self._get_json(f"/alloc-stats/{alloc_id}")
+
+    def alloc_restart(self, alloc_id: str, task: str = ""):
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.address}/restart/{alloc_id}",
+            data=json.dumps({"task": task}).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            raise self._translate(e) from e
 
     def alloc_exec(self, alloc_id: str, task: str, cmd,
                    timeout: float = 10.0):

@@ -406,6 +406,7 @@ class TaskRunner:
         self.handle: Optional[TaskHandle] = None
         self.hooks = [cls() for cls in DEFAULT_HOOKS]
         self._kill = threading.Event()
+        self._restart_requested = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -448,6 +449,19 @@ class TaskRunner:
                 pass
         self._done.wait(timeout)
 
+    def restart(self) -> None:
+        """Operator-requested in-place restart (reference:
+        alloc_endpoint.go Restart -> client restart): stop the process
+        and let the run loop start it again regardless of exit code,
+        without consuming restart-policy attempts."""
+        self._restart_requested.set()
+        if self.handle is not None:
+            try:
+                self.driver.stop_task(self.handle,
+                                      self.task.kill_timeout_s)
+            except DriverError:
+                pass
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
 
@@ -466,6 +480,13 @@ class TaskRunner:
             if self._kill.is_set():
                 self._mark_dead(failed=False, desc="task killed")
                 break
+            if self._restart_requested.is_set():
+                self._restart_requested.clear()
+                self.state.restarts += 1
+                self.state.last_restart = time.time()
+                self._event("Restarting", "user requested restart")
+                self._notify()
+                continue
             failed = exit_result is None or not exit_result.successful()
             if not failed:
                 self._mark_dead(failed=False, desc="task completed")

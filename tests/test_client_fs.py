@@ -277,3 +277,36 @@ def test_alloc_exec_remote_forwarding(tmp_path):
         http.shutdown()
         client.shutdown()
         server.shutdown()
+
+
+def test_alloc_restart_in_place(env):
+    """(reference: alloc restart): the task restarts with a NEW process
+    without rescheduling -- same alloc id, restarts counter bumps."""
+    server, client, api = env
+    job = mock.job(id="restart-job")
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "sleep 30"]}
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    alloc = wait_running(server, "restart-job")
+    runner = client.runners[alloc.id]
+    tr = runner.task_runners[task.name]
+    pid_before = tr.handle.pid
+    out = api.post(f"/v1/client/allocation/{alloc.id}/restart", {})
+    assert task.name in out["restarted"]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if (tr.state.restarts == 1 and tr.handle is not None
+                and tr.handle.pid != pid_before
+                and tr.state.state == "running"):
+            break
+        time.sleep(0.05)
+    assert tr.state.restarts == 1
+    assert tr.handle.pid != pid_before
+    assert tr.state.state == "running"
+    # still the SAME allocation (no reschedule)
+    allocs = [a for a in server.state.allocs_by_job("default",
+                                                    "restart-job")
+              if a.desired_status == "run"]
+    assert [a.id for a in allocs] == [alloc.id]
