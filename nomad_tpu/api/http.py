@@ -1235,6 +1235,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                         parts[3], str(self._body().get("task", "")))
                 except KeyError as e:
                     return self._error(404, str(e))
+                except Exception as e:  # noqa: BLE001 -- forwarding loss
+                    return self._error(400, str(e))
                 self._send(200, out)
             elif parts[:3] == ["v1", "client", "allocation"] and \
                     len(parts) == 5 and parts[4] == "exec":

@@ -406,8 +406,18 @@ class Client:
             runner = self.runners.get(alloc_id)
         if runner is None:
             raise KeyError(f"alloc {alloc_id} not running here")
-        targets = ([task] if task
-                   else list(runner.task_runners.keys()))
+        if task:
+            targets = [task]
+        else:
+            # the runner thread may still be inserting task runners
+            # (same race alloc_stats guards against)
+            targets = []
+            for _ in range(5):
+                try:
+                    targets = list(runner.task_runners.keys())
+                    break
+                except RuntimeError:
+                    continue
         restarted = []
         for name in targets:
             tr = runner.task_runners.get(name)

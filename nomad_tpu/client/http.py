@@ -197,33 +197,27 @@ class RemoteClientProxy:
     def alloc_stats(self, alloc_id: str):
         return self._get_json(f"/alloc-stats/{alloc_id}")
 
-    def alloc_restart(self, alloc_id: str, task: str = ""):
+    def _post_json(self, path: str, payload: dict,
+                   timeout: Optional[float] = None):
         import urllib.error
         import urllib.request
         req = urllib.request.Request(
-            f"{self.address}/restart/{alloc_id}",
-            data=json.dumps({"task": task}).encode(), method="POST",
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as e:
-            raise self._translate(e) from e
-
-    def alloc_exec(self, alloc_id: str, task: str, cmd,
-                   timeout: float = 10.0):
-        import urllib.error
-        import urllib.request
-        req = urllib.request.Request(
-            f"{self.address}/exec/{alloc_id}",
-            data=json.dumps({"task": task, "cmd": cmd,
-                             "timeout": timeout}).encode(),
+            self.address + path, data=json.dumps(payload).encode(),
             method="POST",
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(
-                    req, timeout=max(self.timeout, timeout + 2)) as resp:
+                    req, timeout=timeout or self.timeout) as resp:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
             raise self._translate(e) from e
+
+    def alloc_restart(self, alloc_id: str, task: str = ""):
+        return self._post_json(f"/restart/{alloc_id}", {"task": task})
+
+    def alloc_exec(self, alloc_id: str, task: str, cmd,
+                   timeout: float = 10.0):
+        return self._post_json(
+            f"/exec/{alloc_id}",
+            {"task": task, "cmd": cmd, "timeout": timeout},
+            timeout=max(self.timeout, timeout + 2))

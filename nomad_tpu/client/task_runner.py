@@ -453,14 +453,18 @@ class TaskRunner:
         """Operator-requested in-place restart (reference:
         alloc_endpoint.go Restart -> client restart): stop the process
         and let the run loop start it again regardless of exit code,
-        without consuming restart-policy attempts."""
+        without consuming restart-policy attempts. Only valid against a
+        RUNNING task -- setting the flag while the loop is in prestart
+        or a backoff wait would leak into the NEXT exit and convert a
+        later successful completion into a spurious restart."""
+        if self._done.is_set() or self.state.state != TASK_STATE_RUNNING \
+                or self.handle is None:
+            raise KeyError(f"task {self.task.name!r} is not running")
         self._restart_requested.set()
-        if self.handle is not None:
-            try:
-                self.driver.stop_task(self.handle,
-                                      self.task.kill_timeout_s)
-            except DriverError:
-                pass
+        try:
+            self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+        except DriverError:
+            pass
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
