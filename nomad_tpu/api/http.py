@@ -1218,6 +1218,29 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return self._error(500, str(e))
                 self._send(200, {"removed": name})
             elif parts[:3] == ["v1", "client", "allocation"] and \
+                    len(parts) == 5 and parts[4] == "signal":
+                # (reference: alloc_endpoint.go Signal)
+                from ..acl import CAP_ALLOC_LIFECYCLE
+                client, alloc = self._client_for_alloc(parts[3])
+                if alloc is None:
+                    return self._error(404, "alloc not found")
+                if not self._check(acl.allow_namespace_op(
+                        alloc.namespace, CAP_ALLOC_LIFECYCLE)):
+                    return
+                if client is None:
+                    return self._error(
+                        501, "alloc's node is not served by this agent")
+                body = self._body()
+                try:
+                    out = client.alloc_signal(
+                        parts[3], str(body.get("task", "")),
+                        str(body.get("signal", "SIGUSR1")))
+                except KeyError as e:
+                    return self._error(404, str(e))
+                except Exception as e:  # noqa: BLE001 -- driver errors
+                    return self._error(400, str(e))
+                self._send(200, out)
+            elif parts[:3] == ["v1", "client", "allocation"] and \
                     len(parts) == 5 and parts[4] == "restart":
                 # (reference: alloc_endpoint.go Restart)
                 from ..acl import CAP_ALLOC_LIFECYCLE

@@ -236,6 +236,15 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_alloc_signal(args) -> int:
+    """(reference: command/alloc_signal.go)"""
+    out = _client(args).post(
+        f"/v1/client/allocation/{args.id}/signal",
+        {"task": args.task, "signal": args.signal})
+    print(f"Signalled {out.get('signalled')} with {out.get('signal')}")
+    return 0
+
+
 def cmd_alloc_restart(args) -> int:
     """(reference: command/alloc_restart.go)"""
     out = _client(args).post(
@@ -652,6 +661,11 @@ def build_parser() -> argparse.ArgumentParser:
     alst = al.add_parser("stop")
     alst.add_argument("id")
     alst.set_defaults(fn=cmd_alloc_stop)
+    alsg = al.add_parser("signal")
+    alsg.add_argument("-task", required=True)
+    alsg.add_argument("-s", dest="signal", default="SIGUSR1")
+    alsg.add_argument("id")
+    alsg.set_defaults(fn=cmd_alloc_signal)
     alrs = al.add_parser("restart")
     alrs.add_argument("-task", default="")
     alrs.add_argument("id")

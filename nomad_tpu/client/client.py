@@ -427,6 +427,22 @@ class Client:
             restarted.append(name)
         return {"restarted": restarted}
 
+    def alloc_signal(self, alloc_id: str, task: str,
+                     sig: str = "SIGUSR1") -> dict:
+        """Deliver a signal to a live task (reference: alloc_endpoint.go
+        Signal via server->client forwarding)."""
+        with self._runner_lock:
+            runner = self.runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc {alloc_id} not running here")
+        tr = runner.task_runners.get(task)
+        if tr is None:
+            raise KeyError(f"task {task!r} not found in alloc")
+        if tr.handle is None or tr.driver is None:
+            raise KeyError(f"task {task!r} has no live handle")
+        tr.driver.signal_task(tr.handle, sig)
+        return {"signalled": task, "signal": sig}
+
     def alloc_exec(self, alloc_id: str, task: str,
                    cmd: List[str], timeout: float = 10.0) -> dict:
         """One-shot command inside a live task's context (reference:

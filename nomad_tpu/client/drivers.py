@@ -129,6 +129,22 @@ class Driver:
         cwd = getattr(task_dir, "local_dir", None) if task_dir else None
         return _run_captured(list(cmd), env, cwd, timeout)
 
+    def signal_task(self, handle: TaskHandle, sig: str) -> None:
+        """Deliver a signal to the task's process (reference:
+        plugins/drivers SignalTask). Process-backed drivers signal the
+        handle pid; others raise."""
+        if handle.pid <= 0:
+            raise DriverError(
+                f"driver {self.name!r} does not support signals")
+        signum = getattr(signal, sig if sig.startswith("SIG")
+                         else f"SIG{sig}", None)
+        if signum is None:
+            raise DriverError(f"unknown signal {sig!r}")
+        try:
+            os.kill(handle.pid, int(signum))
+        except ProcessLookupError as e:
+            raise DriverError("task process is gone") from e
+
 
 # ---------------------------------------------------------------------------
 class _MockInstance:

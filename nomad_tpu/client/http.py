@@ -99,6 +99,11 @@ class _Handler(BaseHTTPRequestHandler):
                 out = client.alloc_restart(
                     parts[1], str(body.get("task", "")))
                 return self._send_json(200, out)
+            if parts[:1] == ["signal"] and len(parts) == 2:
+                out = client.alloc_signal(
+                    parts[1], str(body.get("task", "")),
+                    str(body.get("signal", "SIGUSR1")))
+                return self._send_json(200, out)
             self._send_json(404, {"error": "unknown path"})
         except KeyError as e:
             self._send_json(404, {"error": str(e)})
@@ -214,6 +219,11 @@ class RemoteClientProxy:
 
     def alloc_restart(self, alloc_id: str, task: str = ""):
         return self._post_json(f"/restart/{alloc_id}", {"task": task})
+
+    def alloc_signal(self, alloc_id: str, task: str,
+                     sig: str = "SIGUSR1"):
+        return self._post_json(f"/signal/{alloc_id}",
+                               {"task": task, "signal": sig})
 
     def alloc_exec(self, alloc_id: str, task: str, cmd,
                    timeout: float = 10.0):

@@ -310,3 +310,37 @@ def test_alloc_restart_in_place(env):
                                                     "restart-job")
               if a.desired_status == "run"]
     assert [a.id for a in allocs] == [alloc.id]
+
+
+def test_alloc_signal(env):
+    """(reference: alloc signal): a trapped signal reaches the task's
+    process."""
+    server, client, api = env
+    job = mock.job(id="signal-job")
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c",
+                            "trap 'echo GOT-USR1' USR1; "
+                            "while true; do sleep 0.2; done"]}
+    job.task_groups[0].count = 1
+    server.register_job(job)
+    alloc = wait_running(server, "signal-job")
+    out = api.post(f"/v1/client/allocation/{alloc.id}/signal",
+                   {"task": task.name, "signal": "SIGUSR1"})
+    assert out["signal"] == "SIGUSR1"
+    deadline = time.time() + 10
+    logged = b""
+    while time.time() < deadline:
+        logged = api.request_raw(
+            "GET", f"/v1/client/fs/logs/{alloc.id}/{task.name}"
+            "?type=stdout")
+        if b"GOT-USR1" in logged:
+            break
+        time.sleep(0.1)
+    assert b"GOT-USR1" in logged
+    # bad signal name -> 400
+    from nomad_tpu.api.client import ApiError
+    with pytest.raises(ApiError):
+        api.post(f"/v1/client/allocation/{alloc.id}/signal",
+                 {"task": task.name, "signal": "SIGNOPE"})
