@@ -773,6 +773,13 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, dec, index)
             elif parts == ["v1", "regions"]:
                 self._send(200, self.nomad.regions())
+            elif parts == ["v1", "status", "peers"]:
+                raft = getattr(self.nomad, "raft", None)
+                if raft is None:
+                    self._send(200, [])
+                else:
+                    self._send(200, [f"{a[0]}:{a[1]}"
+                                     for _, a in raft.configuration()])
             elif parts == ["v1", "status", "leader"]:
                 raft = getattr(self.nomad, "raft", None)
                 if raft is None:

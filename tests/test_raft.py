@@ -542,3 +542,19 @@ def test_autopilot_health_endpoint(cluster):
         assert health["failure_tolerance"] == 1
     finally:
         http.shutdown()
+
+
+def test_status_peers_endpoint(cluster):
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+
+    leader = wait_for_leader(cluster)
+    http = HttpServer(leader, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        peers = api.get("/v1/status/peers")
+        assert len(peers) == 3
+        assert all(":" in p for p in peers)
+    finally:
+        http.shutdown()
