@@ -1061,6 +1061,37 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, {"node_id": node.id,
                                  "heartbeat_ttl":
                                      self.nomad.heartbeat_ttl})
+            elif parts[:3] == ["v1", "deployment", "pause"] and \
+                    len(parts) == 4:
+                # (reference: deployment_endpoint.go Pause)
+                from ..acl import CAP_SUBMIT_JOB
+                d = self.nomad.state.deployment_by_id(parts[3])
+                if d is None:
+                    return self._error(404, "unknown deployment")
+                if not self._check(acl.allow_namespace_op(
+                        d.namespace, CAP_SUBMIT_JOB)):
+                    return
+                try:
+                    self.nomad.pause_deployment(
+                        parts[3], bool(self._body().get("pause", True)))
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"paused": True})
+            elif parts[:3] == ["v1", "deployment", "fail"] and \
+                    len(parts) == 4:
+                # (reference: deployment_endpoint.go Fail)
+                from ..acl import CAP_SUBMIT_JOB
+                d = self.nomad.state.deployment_by_id(parts[3])
+                if d is None:
+                    return self._error(404, "unknown deployment")
+                if not self._check(acl.allow_namespace_op(
+                        d.namespace, CAP_SUBMIT_JOB)):
+                    return
+                try:
+                    self.nomad.fail_deployment(parts[3])
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"failed": True})
             elif parts[:3] == ["v1", "deployment", "promote"] and \
                     len(parts) == 4:
                 # (reference: deployment_endpoint.go Promote)

@@ -20,7 +20,8 @@ from ..structs import (
     Allocation, Deployment, DeploymentStatusUpdate, Evaluation, Job, Node,
     Plan, PlanResult, ScalingEvent, generate_uuid,
     ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_RUN,
-    ALLOC_DESIRED_STOP, DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STOP, DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED, DEPLOYMENT_STATUS_RUNNING,
     DEPLOYMENT_STATUS_SUCCESSFUL, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
     EVAL_STATUS_PENDING, JOB_STATUS_DEAD, JOB_STATUS_RUNNING,
     JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
@@ -1508,6 +1509,47 @@ class Server:
                 if not d.active() or d.status != DEPLOYMENT_STATUS_RUNNING:
                     continue
                 self._watch_deployment(d)
+
+    def pause_deployment(self, deployment_id: str, pause: bool) -> None:
+        """Pause/resume a rollout (reference: deployment_endpoint.go
+        Pause -> deploymentwatcher PauseDeployment); the watcher only
+        advances RUNNING deployments."""
+        import copy
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise ValueError(f"unknown deployment {deployment_id!r}")
+        if pause and d.status != DEPLOYMENT_STATUS_RUNNING:
+            raise ValueError(f"deployment is {d.status}, not running")
+        if not pause and d.status != DEPLOYMENT_STATUS_PAUSED:
+            raise ValueError(f"deployment is {d.status}, not paused")
+        nd = copy.deepcopy(d)
+        nd.status = (DEPLOYMENT_STATUS_PAUSED if pause
+                     else DEPLOYMENT_STATUS_RUNNING)
+        nd.status_description = ("Deployment is paused" if pause
+                                 else "Deployment is running")
+        self.state.upsert_deployment_cas(nd, d.modify_index)
+        self.publish_event("DeploymentPaused" if pause
+                           else "DeploymentResumed",
+                           {"deployment_id": deployment_id})
+
+    def fail_deployment(self, deployment_id: str) -> None:
+        """Operator-failed rollout (reference: deployment_endpoint.go
+        Fail): marks failed and auto-reverts groups that ask for it,
+        exactly like the watcher's unhealthy path."""
+        import copy
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise ValueError(f"unknown deployment {deployment_id!r}")
+        if not d.active():
+            raise ValueError(f"deployment is already {d.status}")
+        nd = copy.deepcopy(d)
+        nd.status = DEPLOYMENT_STATUS_FAILED
+        nd.status_description = "Deployment marked as failed by operator"
+        if self.state.upsert_deployment_cas(nd, d.modify_index):
+            if any(st.auto_revert for st in nd.task_groups.values()):
+                self._revert_job(nd)
+        self.publish_event("DeploymentFailed",
+                           {"deployment_id": deployment_id})
 
     def promote_deployment(self, deployment_id: str,
                            groups: Optional[List[str]] = None) -> None:

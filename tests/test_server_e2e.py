@@ -562,3 +562,43 @@ def test_node_purge_reschedules_allocs(cluster):
         return (len(allocs) == 2
                 and all(a.node_id != victim_node for a in allocs))
     wait_until(moved, msg="allocs moved off the purged node")
+
+
+def test_deployment_pause_and_fail_operations(cluster):
+    """(reference: deployment_endpoint.go Pause/Fail): pause freezes a
+    running rollout, resume restarts it, operator-fail marks it failed
+    and auto-reverts when the group asks for it."""
+    from nomad_tpu.structs import (
+        DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_PAUSED,
+        DEPLOYMENT_STATUS_RUNNING)
+    server, clients = cluster
+    job = mock.job(id="pause-deploy-job")
+    job.task_groups[0].count = 2
+    job.task_groups[0].update.max_parallel = 1
+    job.task_groups[0].update.min_healthy_time_s = 0.2
+    server.register_job(job)
+    wait_until(lambda: len(running_allocs(server, job)) == 2,
+               msg="v0 running")
+
+    job2 = mock.job(id="pause-deploy-job")
+    job2.task_groups[0].count = 2
+    job2.task_groups[0].update.max_parallel = 1
+    job2.task_groups[0].update.min_healthy_time_s = 0.2
+    job2.task_groups[0].tasks[0].resources.cpu = 150   # destructive
+    server.register_job(job2)
+    wait_until(lambda: server.state.latest_deployment_by_job(
+        "default", "pause-deploy-job") is not None, msg="deployment")
+    d = server.state.latest_deployment_by_job("default",
+                                              "pause-deploy-job")
+    server.pause_deployment(d.id, True)
+    d = server.state.deployment_by_id(d.id)
+    assert d.status == DEPLOYMENT_STATUS_PAUSED
+    server.pause_deployment(d.id, False)
+    d = server.state.deployment_by_id(d.id)
+    assert d.status == DEPLOYMENT_STATUS_RUNNING
+
+    server.fail_deployment(d.id)
+    d = server.state.deployment_by_id(d.id)
+    assert d.status == DEPLOYMENT_STATUS_FAILED
+    with pytest.raises(ValueError):
+        server.fail_deployment(d.id)    # already terminal
