@@ -314,6 +314,24 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_deployment_op(args) -> int:
+    """(reference: command/deployment_{promote,pause,resume,fail}.go)"""
+    api = _client(args)
+    if args.sub == "promote":
+        api.post(f"/v1/deployment/promote/{args.id}")
+        print(f"Promoted deployment {args.id}")
+    elif args.sub == "pause":
+        api.post(f"/v1/deployment/pause/{args.id}", {"pause": True})
+        print(f"Paused deployment {args.id}")
+    elif args.sub == "resume":
+        api.post(f"/v1/deployment/pause/{args.id}", {"pause": False})
+        print(f"Resumed deployment {args.id}")
+    else:
+        api.post(f"/v1/deployment/fail/{args.id}")
+        print(f"Failed deployment {args.id}")
+    return 0
+
+
 def cmd_deployment(args) -> int:
     api = _client(args)
     deps = api.deployments()
@@ -690,8 +708,15 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("id", nargs="?", default="")
     ev.set_defaults(fn=cmd_eval)
 
-    dep = sub.add_parser("deployment", help="deployment list")
+    dep = sub.add_parser("deployment", help="deployment commands")
+    depsub = dep.add_subparsers(dest="sub")
     dep.set_defaults(fn=cmd_deployment)
+    for op_name in ("promote", "pause", "resume", "fail"):
+        dop = depsub.add_parser(op_name)
+        dop.add_argument("id")
+        dop.set_defaults(fn=cmd_deployment_op)
+    depls = depsub.add_parser("list")
+    depls.set_defaults(fn=cmd_deployment)
 
     op = sub.add_parser("operator").add_subparsers(dest="sub",
                                                    required=True)
