@@ -318,8 +318,11 @@ def cmd_deployment_op(args) -> int:
     """(reference: command/deployment_{promote,pause,resume,fail}.go)"""
     api = _client(args)
     if args.sub == "promote":
-        api.post(f"/v1/deployment/promote/{args.id}")
-        print(f"Promoted deployment {args.id}")
+        body = {"groups": args.group} if args.group else None
+        api.post(f"/v1/deployment/promote/{args.id}", body)
+        print(f"Promoted deployment {args.id}"
+              + (f" (groups: {', '.join(args.group)})" if args.group
+                 else ""))
     elif args.sub == "pause":
         api.post(f"/v1/deployment/pause/{args.id}", {"pause": True})
         print(f"Paused deployment {args.id}")
@@ -713,6 +716,9 @@ def build_parser() -> argparse.ArgumentParser:
     dep.set_defaults(fn=cmd_deployment)
     for op_name in ("promote", "pause", "resume", "fail"):
         dop = depsub.add_parser(op_name)
+        if op_name == "promote":
+            # (reference: command/deployment_promote.go -group)
+            dop.add_argument("-group", action="append", default=[])
         dop.add_argument("id")
         dop.set_defaults(fn=cmd_deployment_op)
     depls = depsub.add_parser("list")
