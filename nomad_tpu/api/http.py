@@ -535,6 +535,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                                                           CAP_READ_JOB)):
                     return
                 self._send(200, ev, index)
+            elif parts[:2] == ["v1", "evaluation"] and len(parts) == 4 \
+                    and parts[3] == "allocations":
+                # (reference: eval_endpoint.go Allocations)
+                ev = state.eval_by_id(parts[2])
+                if ev is None:
+                    return self._error(404, "eval not found")
+                if not self._check(acl.allow_namespace_op(ev.namespace,
+                                                          CAP_READ_JOB)):
+                    return
+                self._send(200, [a for a in state.allocs()
+                                 if a.eval_id == parts[2]], index)
             elif parts[:2] == ["v1", "allocations"]:
                 self._send(200, [a for a in state.allocs()
                                  if acl.allow_namespace_op(

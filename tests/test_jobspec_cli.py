@@ -454,3 +454,35 @@ def test_agent_self_endpoint():
     finally:
         http.shutdown()
         server.shutdown()
+
+
+def test_evaluation_allocations_endpoint():
+    import time as _time
+
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        from nomad_tpu.client import SimClient
+        client = SimClient(server, mock.node())
+        client.start()
+        job = mock.job(id="ev-allocs-job")
+        job.task_groups[0].count = 2
+        server.register_job(job)
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        deadline = _time.time() + 10
+        allocs = []
+        while _time.time() < deadline:
+            evs = api.get("/v1/job/ev-allocs-job/evaluations")
+            if evs:
+                allocs = api.get(
+                    f"/v1/evaluation/{evs[0]['id']}/allocations")
+                if len(allocs) == 2:
+                    break
+            _time.sleep(0.05)
+        assert len(allocs) == 2
+        assert all(a["eval_id"] == evs[0]["id"] for a in allocs)
+    finally:
+        http.shutdown()
+        server.shutdown()
