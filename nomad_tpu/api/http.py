@@ -1357,6 +1357,15 @@ class ApiHandler(BaseHTTPRequestHandler):
                 eval_id = self.nomad.stop_alloc(parts[2])
                 self._send(200, {"eval_id": eval_id})
             elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
+                    parts[3] == "evaluate":
+                # (reference: node_endpoint.go Evaluate -- force evals
+                # for every job with allocs on the node)
+                node = self.nomad.state.node_by_id(parts[2])
+                if node is None:
+                    return self._error(404, "node not found")
+                self.nomad._create_node_evals(parts[2])
+                self._send(200, {"evaluated": parts[2]})
+            elif parts[:2] == ["v1", "node"] and len(parts) == 4 and \
                     parts[3] == "purge":
                 # (reference: node_endpoint.go Deregister via
                 # `nomad node purge`); node:write pre-gated above
