@@ -201,8 +201,31 @@ async function viewAlloc(id) {
       <tr><td>Eval</td><td class="mono">${esc(a.eval_id || "")}</td></tr>
     </table>
     <h2>Tasks</h2>` + table(["Task", "State", "Failed", "Recent events"], tasks) +
-    (scores.length ? `<h2>Placement scores</h2>` + table(["Node/score", "Value"], scores) : ""));
+    (scores.length ? `<h2>Placement scores</h2>` + table(["Node/score", "Value"], scores) : "") +
+    `<h2>Actions</h2><p>
+      <button onclick="allocAction('${esc(a.id)}', 'restart')">Restart</button>
+      <button onclick="allocAction('${esc(a.id)}', 'stop')">Stop &amp; reschedule</button>
+      <span id="action-result" class="muted"></span></p>`);
 }
+
+// alloc lifecycle buttons (restart = client path, stop = server path)
+window.allocAction = async function (id, action) {
+  const out = document.getElementById("action-result");
+  out.textContent = "…";
+  const url = action === "stop"
+    ? `/v1/allocation/${id}/stop`
+    : `/v1/client/allocation/${id}/restart`;
+  try {
+    const r = await fetch(url, {method: "POST",
+                               headers: {"Content-Type": "application/json"},
+                               body: "{}"});
+    const body = await r.json();
+    out.textContent = r.ok ? `${action} ok ${JSON.stringify(body)}`
+                           : `error: ${body.error || r.status}`;
+  } catch (e) {
+    out.textContent = `error: ${e}`;
+  }
+};
 
 async function viewEvals() {
   const evals = await api("/v1/evaluations");
