@@ -357,7 +357,12 @@ class GenericScheduler:
         if not hasattr(self.state, "scheduler_config"):
             return False
         cfg = self.state.scheduler_config()
-        return cfg is not None and cfg.uses_tpu()
+        if cfg is None or not cfg.uses_tpu():
+            return False
+        # a wedged accelerator runtime must not strand worker threads:
+        # degrade to the host oracle (solver/guard.py)
+        from ..solver.guard import backend_available
+        return backend_available()
 
     def _compute_placements_tpu(self, places: List[AllocPlaceResult]
                                 ) -> List[AllocPlaceResult]:

@@ -1,0 +1,63 @@
+"""Accelerator backend guard: never let a wedged runtime stall scheduling.
+
+A broken accelerator transport (observed live: the axon TPU tunnel left
+with a stale device claim) can hang PJRT client init FOREVER -- not fail,
+hang. A scheduler worker that walks into ``jax.device_count()`` then never
+returns, evals pin at pending, and the cluster silently stops placing.
+The reference never has this failure mode (its hot loop is host code);
+the TPU-native design must degrade to the host oracle instead.
+
+``backend_available()`` probes backend init ONCE per process in a daemon
+thread with a hard deadline. A timed-out probe pins the answer False for
+the process lifetime: the leaked init thread can never be cancelled, and
+any later jax call would hang its caller the same way. All dense-path
+entry points consult it before touching jax.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_STATE = {"checked": False, "ok": False}
+_LOCK = threading.Lock()
+
+
+def backend_available(timeout_s: float = 0.0) -> bool:
+    with _LOCK:
+        if _STATE["checked"]:
+            return _STATE["ok"]
+        timeout = timeout_s or float(
+            os.environ.get("NOMAD_TPU_BACKEND_TIMEOUT", "30"))
+        done = threading.Event()
+        result = {"n": 0}
+
+        def probe() -> None:
+            try:
+                import jax
+                result["n"] = jax.device_count()
+            except Exception:  # noqa: BLE001 -- any failure = no backend
+                result["n"] = 0
+            finally:
+                done.set()
+
+        t = threading.Thread(target=probe, daemon=True,
+                             name="solver-backend-probe")
+        t.start()
+        ok = done.wait(timeout) and result["n"] > 0
+        _STATE["checked"] = True
+        _STATE["ok"] = ok
+        if not ok:
+            from ..server.telemetry import metrics
+            metrics.incr("nomad.solver.backend_unavailable")
+            import sys
+            print("[nomad-tpu] accelerator backend unavailable "
+                  f"(init did not complete in {timeout:.0f}s); "
+                  "scheduling falls back to the host oracle",
+                  file=sys.stderr)
+        return ok
+
+
+def _reset_for_tests() -> None:
+    with _LOCK:
+        _STATE["checked"] = False
+        _STATE["ok"] = False
