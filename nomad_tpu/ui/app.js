@@ -230,11 +230,38 @@ window.allocAction = async function (id, action) {
 async function viewEvals() {
   const evals = await api("/v1/evaluations");
   const rows = evals.map((e) => [
-    shortId(e.id), esc(e.job_id), badge(e.status), esc(e.type),
+    idLink("evaluation", e.id, `${shortId(e.id)}`),
+    esc(e.job_id), badge(e.status), esc(e.type),
     esc(e.triggered_by), esc(e.priority),
   ]);
   return h(`<h1>Evaluations</h1>` +
     table(["ID", "Job", "Status", "Type", "Triggered by", "Priority"], rows));
+}
+
+async function viewEval(id) {
+  const [e, allocs] = await Promise.all([
+    api(`/v1/evaluation/${id}`),
+    api(`/v1/evaluation/${id}/allocations`).catch(() => []),
+  ]);
+  const alRows = allocs.map((a) => [
+    `${idLink("allocation", a.id, `${shortId(a.id)}`)}`,
+    esc(a.task_group), badge(a.client_status), badge(a.desired_status),
+    `${idLink("node", a.node_id, `${shortId(a.node_id)}`)}`,
+  ]);
+  const failed = Object.entries(e.failed_tg_allocs || {}).map(
+    ([tg, m]) => [esc(tg), esc(m.nodes_evaluated ?? ""),
+                  esc(JSON.stringify(m.constraint_filtered || m.dimension_exhausted || {}).slice(0, 80))]);
+  return h(`<h1>Evaluation ${shortId(e.id)} ${badge(e.status)}</h1>
+    <table class="kv">
+      <tr><td>Job</td><td>${idLink("job", e.job_id, esc(e.job_id))}</td></tr>
+      <tr><td>Type</td><td>${esc(e.type)}</td></tr>
+      <tr><td>Triggered by</td><td>${esc(e.triggered_by)}</td></tr>
+      <tr><td>Description</td><td>${esc(e.status_description || "")}</td></tr>
+    </table>` +
+    (failed.length ? `<h2>Failed placements</h2>` +
+      table(["Group", "Nodes evaluated", "Filtered/exhausted"], failed) : "") +
+    `<h2>Allocations (${allocs.length})</h2>` +
+    table(["ID", "Group", "Client", "Desired", "Node"], alRows));
 }
 
 async function viewDeployments() {
@@ -336,6 +363,7 @@ const routes = [
   [/^#\/allocations$/, () => viewAllocs(), "allocations"],
   [/^#\/allocation\/(.+)$/, (m) => viewAlloc(m[1]), "allocations"],
   [/^#\/evaluations$/, () => viewEvals(), "evaluations"],
+  [/^#\/evaluation\/(.+)$/, (m) => viewEval(m[1]), "evaluations"],
   [/^#\/deployments$/, () => viewDeployments(), "deployments"],
   [/^#\/metrics$/, () => viewMetrics(), "metrics"],
   [/^#\/events$/, () => viewEvents(), "events"],
