@@ -38,7 +38,8 @@ class Resolver:
         # otherwise a concurrent policy write could cache a stale compile
         # under a fresh key and serve revoked capabilities indefinitely
         key = (self.state.table_index("acl_tokens"),
-               self.state.table_index("acl_policies"))
+               self.state.table_index("acl_policies"),
+               self.state.table_index("acl_roles"))
         with self._lock:
             if key != self._cache_key:
                 self._cache = {}
@@ -52,8 +53,19 @@ class Resolver:
         with self._lock:
             if key == self._cache_key and cache_id in self._cache:
                 return self._cache[cache_id], token
+        # direct policy links, plus policies reached through role links
+        # (reference: ACLToken.Roles -> ACLRole.Policies union)
+        names = list(token.policies)
+        for role_name in getattr(token, "roles", []) or []:
+            role = self.state.acl_role_by_name(role_name)
+            if role is not None:
+                names.extend(role.policies)
         policies = []
-        for name in token.policies:
+        seen = set()
+        for name in names:
+            if name in seen:
+                continue
+            seen.add(name)
             stored = self.state.acl_policy_by_name(name)
             if stored is not None:
                 policies.append(parse_policy(stored.name, stored.rules))

@@ -1564,6 +1564,17 @@ class ApiHandler(BaseHTTPRequestHandler):
             if p is None:
                 return self._error(404, "policy not found")
             self._send(200, p, index)
+        elif parts == ["v1", "acl", "roles"]:
+            if not self._check(acl.is_management()):
+                return
+            self._send(200, state.acl_roles(), index)
+        elif parts[:3] == ["v1", "acl", "role"] and len(parts) == 4:
+            if not self._check(acl.is_management()):
+                return
+            r = state.acl_role_by_name(parts[3])
+            if r is None:
+                return self._error(404, "role not found")
+            self._send(200, r, index)
         elif parts == ["v1", "acl", "tokens"]:
             if not self._check(acl.is_management()):
                 return
@@ -1619,9 +1630,26 @@ class ApiHandler(BaseHTTPRequestHandler):
                 name=body.get("name", ""),
                 type=body.get("type", "client"),
                 policies=body.get("policies", []),
+                roles=body.get("roles", []),
                 ttl_s=body.get("ttl_s"))
             state.upsert_acl_tokens([token])
             self._send(200, token)
+        elif parts[:3] == ["v1", "acl", "role"] and len(parts) == 4:
+            # (reference: acl_endpoint.go UpsertRoles, Nomad 1.4+)
+            if not self._check(acl.is_management()):
+                return
+            from ..structs import ACLRole
+            body = self._body()
+            policies = [str(p) for p in body.get("policies", [])]
+            for p in policies:
+                if state.acl_policy_by_name(p) is None:
+                    return self._error(
+                        400, f"role links unknown policy {p!r}")
+            state.upsert_acl_roles([ACLRole(
+                name=parts[3],
+                description=body.get("description", ""),
+                policies=policies)])
+            self._send(200, {"updated": True})
         else:
             self._error(404, "unknown acl path")
 

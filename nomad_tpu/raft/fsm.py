@@ -14,7 +14,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..state.store import StateStore
 from ..structs import (
-    ACLPolicy, ACLToken, Allocation, CSIVolume, Deployment, DrainStrategy,
+    ACLPolicy, ACLRole, ACLToken, Allocation, CSIVolume, Deployment,
+    DrainStrategy,
     Evaluation, Job, Namespace, Node, NodePool, PlanResult, RootKey,
     ScalingEvent, ScalingPolicy, SchedulerConfiguration,
     ServiceRegistration, VariableEncrypted,
@@ -60,6 +61,8 @@ WRITE_METHODS: Dict[str, List[Any]] = {
     "upsert_plan_results": [PlanResult, Optional[List[Evaluation]]],
     "upsert_acl_policies": [List[ACLPolicy]],
     "delete_acl_policies": [List[str]],
+    "upsert_acl_roles": [List[ACLRole]],
+    "delete_acl_roles": [List[str]],
     "upsert_acl_tokens": [List[ACLToken]],
     "delete_acl_tokens": [List[str]],
     "bootstrap_acl_token": [ACLToken],
@@ -121,6 +124,8 @@ def dump_state(store: StateStore) -> dict:
             "scheduler_config": codec.encode(store._scheduler_config),
             "acl_policies": [codec.encode(p)
                              for p in store._acl_policies.values()],
+            "acl_roles": [codec.encode(r)
+                          for r in store._acl_roles.values()],
             "acl_tokens": [codec.encode(t)
                            for t in store._acl_tokens.values()],
             "acl_bootstrapped": store._acl_bootstrapped,
@@ -156,6 +161,8 @@ def restore_state(store: StateStore, blob: dict) -> None:
                     for p in blob.get("acl_policies", [])]
     acl_tokens = [codec.decode(ACLToken, t)
                   for t in blob.get("acl_tokens", [])]
+    acl_roles = [codec.decode(ACLRole, r)
+                 for r in blob.get("acl_roles", [])]
     root_keys = [codec.decode(RootKey, k)
                  for k in blob.get("root_keys", [])]
     variables = [codec.decode(VariableEncrypted, v)
@@ -190,6 +197,7 @@ def restore_state(store: StateStore, blob: dict) -> None:
         store._variables = {(v.meta.namespace, v.meta.path): v
                             for v in variables}
         store._acl_policies = {p.name: p for p in acl_policies}
+        store._acl_roles = {r.name: r for r in acl_roles}
         store._acl_tokens = {t.accessor_id: t for t in acl_tokens}
         store._acl_tokens_by_secret = {t.secret_id: t.accessor_id
                                        for t in acl_tokens}

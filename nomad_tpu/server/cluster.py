@@ -160,6 +160,12 @@ class RaftBackedStateStore:
     def upsert_acl_tokens(self, tokens):
         return self._propose("upsert_acl_tokens", tokens)
 
+    def upsert_acl_roles(self, roles):
+        return self._propose("upsert_acl_roles", roles)
+
+    def delete_acl_roles(self, names):
+        return self._propose("delete_acl_roles", names)
+
     def delete_acl_tokens(self, accessor_ids):
         return self._propose("delete_acl_tokens", accessor_ids)
 

@@ -26,7 +26,8 @@ from ..structs import (
 
 TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
           "scheduler_config", "job_versions", "acl_policies", "acl_tokens",
-          "root_keys", "variables", "scaling_policies", "scaling_events",
+          "acl_roles", "root_keys", "variables", "scaling_policies",
+          "scaling_events",
           "namespaces", "csi_volumes", "csi_plugins", "services")
 
 
@@ -175,6 +176,7 @@ class StateStore:
         self._scheduler_config = SchedulerConfiguration()
         # ACL tables (reference: state_store.go ACLPolicy/ACLToken regions)
         self._acl_policies: Dict[str, "ACLPolicy"] = {}
+        self._acl_roles: Dict[str, "ACLRole"] = {}
         self._acl_tokens: Dict[str, "ACLToken"] = {}          # by accessor
         self._acl_tokens_by_secret: Dict[str, str] = {}       # secret->accessor
         self._acl_bootstrapped = False
@@ -958,6 +960,30 @@ class StateStore:
             for name in names:
                 self._acl_policies.pop(name, None)
             return self._bump("acl_policies")
+
+    def upsert_acl_roles(self, roles: List["ACLRole"]) -> int:
+        with self._lock:
+            for r in roles:
+                existing = self._acl_roles.get(r.name)
+                r.create_index = (existing.create_index if existing
+                                  else self._index + 1)
+                r.modify_index = self._index + 1
+                self._acl_roles[r.name] = r
+            return self._bump("acl_roles")
+
+    def delete_acl_roles(self, names: List[str]) -> int:
+        with self._lock:
+            for name in names:
+                self._acl_roles.pop(name, None)
+            return self._bump("acl_roles")
+
+    def acl_role_by_name(self, name: str) -> Optional["ACLRole"]:
+        with self._lock:
+            return self._acl_roles.get(name)
+
+    def acl_roles(self) -> List["ACLRole"]:
+        with self._lock:
+            return list(self._acl_roles.values())
 
     def acl_policy_by_name(self, name: str) -> Optional[ACLPolicy]:
         with self._lock:

@@ -69,7 +69,7 @@ from .config import (  # noqa: F401
     SCHED_ALG_TPU_SPREAD,
 )
 from .acl import (  # noqa: F401
-    ACLPolicy, ACLToken,
+    ACLPolicy, ACLRole, ACLToken,
     ACL_TOKEN_TYPE_CLIENT, ACL_TOKEN_TYPE_MANAGEMENT,
     ANONYMOUS_TOKEN_ACCESSOR,
 )

@@ -28,6 +28,17 @@ class ACLPolicy:
 
 
 @dataclass
+class ACLRole:
+    """Named bundle of policies tokens can link to (reference:
+    structs.ACLRole, Nomad 1.4+)."""
+    name: str = ""
+    description: str = ""
+    policies: List[str] = field(default_factory=list)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
 class ACLToken:
     """(reference: structs.ACLToken)"""
     accessor_id: str = ""
@@ -35,6 +46,9 @@ class ACLToken:
     name: str = ""
     type: str = ACL_TOKEN_TYPE_CLIENT
     policies: List[str] = field(default_factory=list)
+    # role links by name; resolution unions the roles' policies with the
+    # directly-attached ones (reference: ACLToken.Roles)
+    roles: List[str] = field(default_factory=list)
     global_token: bool = False
     create_time: float = 0.0
     expiration_time: Optional[float] = None
@@ -44,12 +58,14 @@ class ACLToken:
     @staticmethod
     def new(name: str = "", type: str = ACL_TOKEN_TYPE_CLIENT,
             policies: Optional[List[str]] = None,
-            ttl_s: Optional[float] = None) -> "ACLToken":
+            ttl_s: Optional[float] = None,
+            roles: Optional[List[str]] = None) -> "ACLToken":
         now = time.time()
         return ACLToken(
             accessor_id=str(uuid.uuid4()),
             secret_id=str(uuid.UUID(bytes=secrets.token_bytes(16))),
             name=name, type=type, policies=list(policies or []),
+            roles=list(roles or []),
             create_time=now,
             expiration_time=(now + ttl_s) if ttl_s is not None else None)
 

@@ -251,3 +251,46 @@ namespace "default" {
 }''')])
     assert not acl2.allow_variable_op("default", "drop/x", "read")
     assert not acl2.allow_variable_op("default", "drop/x", "write")
+
+
+def test_acl_roles_resolve_to_policies(acl_server):
+    """(reference: structs.ACLRole, Nomad 1.4+): a token linked only to
+    a ROLE inherits the role's policies; editing the role changes the
+    token's effective capabilities (cache invalidation)."""
+    server, port = acl_server
+    code, boot = _req(port, "/v1/acl/bootstrap", method="POST")
+    assert code == 200
+    mgmt = boot["secret_id"]
+    code, _ = _req(port, "/v1/acl/policy/readonly", method="POST",
+                   body={"rules": READONLY}, token=mgmt)
+    assert code == 200
+    # role linking an unknown policy is rejected
+    code, _ = _req(port, "/v1/acl/role/oops", method="POST",
+                   body={"policies": ["nope"]}, token=mgmt)
+    assert code == 400
+    code, _ = _req(port, "/v1/acl/role/readers", method="POST",
+                   body={"policies": ["readonly"],
+                         "description": "read-only crew"}, token=mgmt)
+    assert code == 200
+    code, roles = _req(port, "/v1/acl/roles", token=mgmt)
+    assert code == 200 and roles[0]["name"] == "readers"
+
+    code, tok = _req(port, "/v1/acl/token", method="POST",
+                     body={"name": "via-role", "roles": ["readers"]},
+                     token=mgmt)
+    assert code == 200 and tok["roles"] == ["readers"]
+    secret = tok["secret_id"]
+    # role-granted read works; writes stay denied
+    code, _ = _req(port, "/v1/jobs", token=secret)
+    assert code == 200
+    code, _ = _req(port, "/v1/jobs", method="POST",
+                   body={"job": {"id": "nope", "task_groups": []}},
+                   token=secret)
+    assert code == 403
+    # dropping the policy from the role revokes access (cache keyed on
+    # the roles table index)
+    code, _ = _req(port, "/v1/acl/role/readers", method="POST",
+                   body={"policies": []}, token=mgmt)
+    assert code == 200
+    code, _ = _req(port, "/v1/jobs", token=secret)
+    assert code == 403
