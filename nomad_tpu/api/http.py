@@ -1478,6 +1478,11 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return
                 self.nomad.state.delete_acl_policies([parts[3]])
                 self._send(200, {"deleted": True})
+            elif parts[:3] == ["v1", "acl", "role"] and len(parts) == 4:
+                if not self._check(acl.is_management()):
+                    return
+                self.nomad.state.delete_acl_roles([parts[3]])
+                self._send(200, {"deleted": True})
             elif parts[:3] == ["v1", "acl", "token"] and len(parts) == 4:
                 if not self._check(acl.is_management()):
                     return
