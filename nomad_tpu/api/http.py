@@ -241,6 +241,21 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._error(502, f"region {region!r} unreachable: {e}")
         return True
 
+    def _client_for_csi_plugin(self, plugin_id: str):
+        """A client serving this controller plugin: in-process first,
+        then any node advertising it healthy + a client listener."""
+        for c in getattr(self.server, "local_clients", []):
+            mgr = getattr(c, "csi_manager", None)
+            if mgr is not None and plugin_id in mgr.plugins:
+                return c
+        for node in self.nomad.state.nodes():
+            health = (node.csi_node_plugins or {}).get(plugin_id, {})
+            addr = (node.attributes or {}).get("nomad.client_http", "")
+            if health.get("healthy") and addr:
+                from ..client.http import RemoteClientProxy
+                return RemoteClientProxy(addr)
+        return None
+
     def _client_for_alloc(self, alloc_id: str):
         """-> (client, alloc) serving the alloc's fs, or (None, alloc).
         Falls back to the node's advertised client-agent listener
@@ -1225,6 +1240,65 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except ValueError as e:
                     return self._error(400, str(e))
                 self._send(200, {"updated": True})
+            elif parts[:3] == ["v1", "volume", "csi"] and \
+                    len(parts) == 5 and parts[4] == "create":
+                # dynamic provisioning (reference: csi_endpoint.go Create
+                # -> controller CreateVolume on a plugin-running client)
+                from ..acl import CAP_CSI_WRITE_VOLUME
+                if not self._check(acl.allow_namespace_op(
+                        ns, CAP_CSI_WRITE_VOLUME)):
+                    return
+                from ..structs import CSIVolume
+                body = self._body()
+                plugin_id = str(body.get("plugin_id", ""))
+                if not plugin_id:
+                    return self._error(400, "plugin_id required")
+                client = self._client_for_csi_plugin(plugin_id)
+                if client is None:
+                    return self._error(
+                        400, f"no healthy client runs plugin "
+                             f"{plugin_id!r}")
+                try:
+                    created = client.csi_create_volume(
+                        plugin_id, parts[3],
+                        body.get("parameters") or {})
+                except KeyError as e:
+                    return self._error(404, str(e))
+                except Exception as e:  # noqa: BLE001 -- plugin errors
+                    return self._error(400, str(e))
+                vol = CSIVolume(
+                    id=parts[3], namespace=ns,
+                    name=body.get("name", parts[3]),
+                    external_id=str(created.get("volume_id", parts[3])),
+                    plugin_id=plugin_id,
+                    access_mode=body.get("access_mode",
+                                         "single-node-writer"),
+                    attachment_mode=body.get("attachment_mode",
+                                             "file-system"),
+                    parameters=body.get("parameters") or {})
+                self.nomad.register_csi_volume(vol)
+                self._send(200, {"created": True, "volume": created})
+            elif parts[:3] == ["v1", "volume", "csi"] and \
+                    len(parts) == 5 and parts[4] == "delete":
+                # (reference: csi_endpoint.go Delete -> DeleteVolume)
+                from ..acl import CAP_CSI_WRITE_VOLUME
+                if not self._check(acl.allow_namespace_op(
+                        ns, CAP_CSI_WRITE_VOLUME)):
+                    return
+                v = self.nomad.state.csi_volume_by_id(ns, parts[3])
+                if v is None:
+                    return self._error(404, "volume not found")
+                client = self._client_for_csi_plugin(v.plugin_id)
+                if client is not None:
+                    try:
+                        client.csi_delete_volume(v.plugin_id, parts[3])
+                    except Exception as e:  # noqa: BLE001
+                        return self._error(400, str(e))
+                try:
+                    self.nomad.deregister_csi_volume(ns, parts[3], False)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                self._send(200, {"deleted": True})
             elif parts[:3] == ["v1", "volume", "csi"] and len(parts) == 4:
                 from ..acl import CAP_CSI_WRITE_VOLUME
                 if not self._check(acl.allow_namespace_op(

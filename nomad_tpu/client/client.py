@@ -427,6 +427,26 @@ class Client:
             restarted.append(name)
         return {"restarted": restarted}
 
+    def csi_create_volume(self, plugin_id: str, volume_id: str,
+                          parameters=None) -> dict:
+        """Dynamic provisioning through the controller plugin this node
+        runs (reference: csi CreateVolume via a controller-capable
+        client)."""
+        if self.csi_manager is None:
+            raise KeyError("no csi plugins on this node")
+        plugin = self.csi_manager.plugins.get(plugin_id)
+        if plugin is None:
+            raise KeyError(f"no csi plugin {plugin_id!r} on this node")
+        return plugin.create_volume(volume_id, parameters or {})
+
+    def csi_delete_volume(self, plugin_id: str, volume_id: str) -> None:
+        if self.csi_manager is None:
+            raise KeyError("no csi plugins on this node")
+        plugin = self.csi_manager.plugins.get(plugin_id)
+        if plugin is None:
+            raise KeyError(f"no csi plugin {plugin_id!r} on this node")
+        plugin.delete_volume(volume_id)
+
     def alloc_signal(self, alloc_id: str, task: str,
                      sig: str = "SIGUSR1") -> dict:
         """Deliver a signal to a live task (reference: alloc_endpoint.go

@@ -104,6 +104,15 @@ class _Handler(BaseHTTPRequestHandler):
                     parts[1], str(body.get("task", "")),
                     str(body.get("signal", "SIGUSR1")))
                 return self._send_json(200, out)
+            if parts[:1] == ["csi-create"] and len(parts) == 2:
+                out = client.csi_create_volume(
+                    str(body.get("plugin_id", "")), parts[1],
+                    body.get("parameters") or {})
+                return self._send_json(200, out)
+            if parts[:1] == ["csi-delete"] and len(parts) == 2:
+                client.csi_delete_volume(
+                    str(body.get("plugin_id", "")), parts[1])
+                return self._send_json(200, {"deleted": True})
             self._send_json(404, {"error": "unknown path"})
         except KeyError as e:
             self._send_json(404, {"error": str(e)})
@@ -231,3 +240,13 @@ class RemoteClientProxy:
             f"/exec/{alloc_id}",
             {"task": task, "cmd": cmd, "timeout": timeout},
             timeout=max(self.timeout, timeout + 2))
+
+    def csi_create_volume(self, plugin_id: str, volume_id: str,
+                          parameters=None):
+        return self._post_json(f"/csi-create/{volume_id}",
+                               {"plugin_id": plugin_id,
+                                "parameters": parameters or {}})
+
+    def csi_delete_volume(self, plugin_id: str, volume_id: str):
+        self._post_json(f"/csi-delete/{volume_id}",
+                        {"plugin_id": plugin_id})

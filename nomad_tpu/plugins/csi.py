@@ -36,6 +36,17 @@ class CSIPluginClient:
     def probe(self) -> dict:
         return self._rpc("probe") or {}
 
+    def create_volume(self, volume_id: str,
+                      parameters: Optional[dict] = None) -> dict:
+        """(reference: csi.proto CreateVolume via the controller
+        service)"""
+        return self._rpc("create_volume", volume_id=volume_id,
+                         parameters=parameters or {}) or {}
+
+    def delete_volume(self, volume_id: str) -> None:
+        """(reference: csi.proto DeleteVolume)"""
+        self._rpc("delete_volume", volume_id=volume_id)
+
     def controller_publish(self, volume_id: str, node_id: str,
                            readonly: bool = False) -> dict:
         """-> publish context (reference: ControllerPublishVolume)."""

@@ -24,6 +24,22 @@ def probe():
     return {"ready": True, "name": "hostpath", "base": BASE}
 
 
+def create_volume(volume_id, parameters=None):
+    """(reference: csi.proto CreateVolume)"""
+    os.makedirs(_vol_dir(volume_id), exist_ok=True)
+    marker = os.path.join(_vol_dir(volume_id), ".created")
+    with open(marker, "w") as fh:
+        fh.write(volume_id)
+    return {"volume_id": volume_id, "backing_dir": _vol_dir(volume_id)}
+
+
+def delete_volume(volume_id):
+    """(reference: csi.proto DeleteVolume)"""
+    import shutil
+    shutil.rmtree(_vol_dir(volume_id), ignore_errors=True)
+    return True
+
+
 def controller_publish(volume_id, node_id, readonly=False):
     os.makedirs(_vol_dir(volume_id), exist_ok=True)
     return {"backing_dir": _vol_dir(volume_id)}
@@ -72,6 +88,8 @@ def node_unstage(volume_id, staging_path):
 def main() -> None:
     serve({
         "probe": probe,
+        "create_volume": create_volume,
+        "delete_volume": delete_volume,
         "controller_publish": controller_publish,
         "controller_unpublish": controller_unpublish,
         "node_stage": node_stage,

@@ -392,3 +392,54 @@ def test_csi_detach_on_alloc_stop_and_shared_staging(tmp_path):
         mgr.shutdown()
     finally:
         _os.environ.pop("CSI_HOSTPATH_DIR", None)
+
+
+def test_dynamic_volume_create_delete(tmp_path):
+    """Dynamic provisioning (reference: csi_endpoint.go Create/Delete ->
+    controller CreateVolume/DeleteVolume on a plugin-running client):
+    create provisions through the plugin AND registers the volume;
+    delete tears both down."""
+    import sys as _sys
+
+    from nomad_tpu.api.client import ApiClient, ApiError
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.client.client import Client, LocalServerConn
+
+    backing = tmp_path / "backing"
+    backing.mkdir()
+    import os as _os
+    _os.environ["CSI_HOSTPATH_DIR"] = str(backing)
+    plugin_argv = [_sys.executable, "-m",
+                   "nomad_tpu.plugins.examples.hostpath_csi_plugin"]
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    client = Client(LocalServerConn(server), str(tmp_path / "client"),
+                    name="csi-create-node",
+                    csi_plugins={"hostpath": plugin_argv})
+    client.start()
+    http = HttpServer(server, port=0, clients=[client])
+    http.start()
+    api = ApiClient(f"http://127.0.0.1:{http.port}")
+    try:
+        out = api.post("/v1/volume/csi/dynvol/create",
+                       {"plugin_id": "hostpath", "name": "dynamic"})
+        assert out["created"] is True
+        assert (backing / "dynvol" / ".created").exists()
+        vol = server.state.csi_volume_by_id("default", "dynvol")
+        assert vol is not None and vol.plugin_id == "hostpath"
+
+        # unknown plugin -> 400
+        import pytest as _pytest
+        with _pytest.raises(ApiError):
+            api.post("/v1/volume/csi/bad/create",
+                     {"plugin_id": "no-such-plugin"})
+
+        out = api.post("/v1/volume/csi/dynvol/delete", {})
+        assert out["deleted"] is True
+        assert not (backing / "dynvol").exists()
+        assert server.state.csi_volume_by_id("default", "dynvol") is None
+    finally:
+        _os.environ.pop("CSI_HOSTPATH_DIR", None)
+        http.shutdown()
+        client.shutdown()
+        server.shutdown()
