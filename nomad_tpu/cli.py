@@ -556,6 +556,18 @@ def cmd_volume(args) -> int:
                                 **{k: v for k, v in body.items()
                                    if k not in ("id", "plugin_id")})
         print(f"Volume {body['id']!r} registered")
+    elif args.sub2 == "create":
+        # (reference: command/volume_create.go -- dynamic provisioning)
+        body = {"plugin_id": args.plugin}
+        if args.file:
+            with open(args.file) as f:
+                body.update(json.load(f))
+        out = api.post(f"/v1/volume/csi/{args.id}/create", body)
+        print(f"Volume {args.id!r} created via "
+              f"{body.get('plugin_id', '')!r}: {out.get('volume', {})}")
+    elif args.sub2 == "delete":
+        api.post(f"/v1/volume/csi/{args.id}/delete", {})
+        print(f"Volume {args.id!r} deleted")
     elif args.sub2 == "deregister":
         api.deregister_csi_volume(args.id, force=args.force)
         print(f"Volume {args.id!r} deregistered")
@@ -849,6 +861,14 @@ def build_parser() -> argparse.ArgumentParser:
     vdereg.add_argument("id")
     vdereg.add_argument("-force", action="store_true")
     vdereg.set_defaults(fn=cmd_volume)
+    vcr = vol.add_parser("create")
+    vcr.add_argument("-plugin", required=True)
+    vcr.add_argument("-file", default="")
+    vcr.add_argument("id")
+    vcr.set_defaults(fn=cmd_volume)
+    vdel = vol.add_parser("delete")
+    vdel.add_argument("id")
+    vdel.set_defaults(fn=cmd_volume)
 
     plg = sub.add_parser("plugin").add_subparsers(dest="sub2",
                                                   required=True)
