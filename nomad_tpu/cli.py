@@ -558,10 +558,17 @@ def cmd_volume(args) -> int:
         print(f"Volume {body['id']!r} registered")
     elif args.sub2 == "create":
         # (reference: command/volume_create.go -- dynamic provisioning)
-        body = {"plugin_id": args.plugin}
+        body = {}
         if args.file:
             with open(args.file) as f:
-                body.update(json.load(f))
+                loaded = json.load(f)
+            if not isinstance(loaded, dict):
+                print("Error: -file must contain a JSON object",
+                      file=sys.stderr)
+                return 1
+            body.update(loaded)
+        # the explicit flag always wins over a reused spec file
+        body["plugin_id"] = args.plugin
         out = api.post(f"/v1/volume/csi/{args.id}/create", body)
         print(f"Volume {args.id!r} created via "
               f"{body.get('plugin_id', '')!r}: {out.get('volume', {})}")
