@@ -274,6 +274,18 @@ async function viewDeployments() {
     table(["ID", "Job", "Status", "Description"], rows));
 }
 
+async function viewVolumes() {
+  const vols = await api("/v1/volumes");
+  const rows = vols.map((v) => [
+    esc(v.id), esc(v.namespace), esc(v.plugin_id), esc(v.access_mode),
+    esc(String(v.schedulable)),
+    `${esc(v.read_claims)}r / ${esc(v.write_claims)}w`,
+  ]);
+  return h(`<h1>Volumes</h1>` +
+    table(["ID", "Namespace", "Plugin", "Access", "Schedulable",
+           "Claims"], rows));
+}
+
 async function viewMetrics() {
   const m = await api("/v1/metrics");
   const counters = m.counters || {};
@@ -365,6 +377,7 @@ const routes = [
   [/^#\/evaluations$/, () => viewEvals(), "evaluations"],
   [/^#\/evaluation\/(.+)$/, (m) => viewEval(m[1]), "evaluations"],
   [/^#\/deployments$/, () => viewDeployments(), "deployments"],
+  [/^#\/volumes$/, () => viewVolumes(), "volumes"],
   [/^#\/metrics$/, () => viewMetrics(), "metrics"],
   [/^#\/events$/, () => viewEvents(), "events"],
 ];
