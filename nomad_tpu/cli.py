@@ -461,10 +461,32 @@ def cmd_acl_token_create(args) -> int:
     out = _client(args).post(
         "/v1/acl/token",
         body={"name": args.name or "", "type": args.type,
-              "policies": args.policy or []})
+              "policies": args.policy or [],
+              "roles": args.role or []})
     print(f"Accessor ID = {out['accessor_id']}\n"
           f"Secret ID   = {out['secret_id']}\n"
-          f"Policies    = {out['policies']}")
+          f"Policies    = {out['policies']}\n"
+          f"Roles       = {out.get('roles', [])}")
+    return 0
+
+
+def cmd_acl_role(args) -> int:
+    """(reference: command/acl_role_*.go)"""
+    api = _client(args)
+    if args.sub2 == "apply":
+        api.post(f"/v1/acl/role/{args.name}",
+                 {"policies": args.policy or [],
+                  "description": args.description or ""})
+        print(f"Applied role {args.name}")
+    elif args.sub2 == "delete":
+        api.request("DELETE", f"/v1/acl/role/{args.name}")
+        print(f"Deleted role {args.name}")
+    else:
+        roles = api.get("/v1/acl/roles")
+        print(_fmt_table(
+            [[r["name"], ", ".join(r["policies"]),
+              r.get("description", "")] for r in roles],
+            ["Name", "Policies", "Description"]))
     return 0
 
 
@@ -814,7 +836,19 @@ def build_parser() -> argparse.ArgumentParser:
     atc.add_argument("-type", default="client",
                      choices=["client", "management"])
     atc.add_argument("-policy", action="append")
+    atc.add_argument("-role", action="append")
     atc.set_defaults(fn=cmd_acl_token_create)
+    arole = aclp.add_parser("role").add_subparsers(dest="sub2",
+                                                   required=True)
+    ara = arole.add_parser("apply")
+    ara.add_argument("name")
+    ara.add_argument("-policy", action="append")
+    ara.add_argument("-description", default="")
+    ara.set_defaults(fn=cmd_acl_role)
+    arole.add_parser("list").set_defaults(fn=cmd_acl_role)
+    ard = arole.add_parser("delete")
+    ard.add_argument("name")
+    ard.set_defaults(fn=cmd_acl_role)
 
     mt = sub.add_parser("metrics")
     mt.set_defaults(fn=cmd_metrics)
