@@ -1,17 +1,28 @@
-"""The TPU solver core: the bin-packing inner loop as dense JAX.
+"""The TPU solver core: the scheduler's inner loop as a kernel family.
 
 This is the north star (BASELINE.json): the per-candidate work of
 BinPackIterator.Next (reference: scheduler/rank.go:205) -- fit check,
 BestFit-v3 scoring, anti-affinity/penalty/affinity/spread scoring, and the
 LimitIterator/MaxScoreIterator selection semantics (select.go, stack.go:82)
--- computed for EVERY node at once as vectorized XLA ops, with the
-within-eval sequential dependence (earlier placements consume resources,
-context.go:176 ProposedAllocs) carried through a lax.scan.
+-- with the within-eval sequential dependence (earlier placements consume
+resources, context.go:176 ProposedAllocs) carried through a lax.scan.
+Three kernels share those semantics, picked by lane shape:
+
+  - **wavefront** (solve_lane_wave; the production fast path): uniform-ask
+    lanes admit a closed-form per-node placement capacity, so the scan
+    carries only a B-slot buffer of the front-of-order fit nodes -- O(B)
+    per step, a compact (P+B, 8+S) table as the only transfer, spread
+    counts in the carry, penalties in the scan xs.
+  - **dense** (solve_placements[_preempt]): every node rescored per step;
+    handles the node-coupling features the wavefront gates out
+    (distinct_property, devices, cores, dense preemption search).
+  - **system** (solve_system): one INDEPENDENT fit+score per node, no
+    window at all (scheduler_system.go semantics).
 
 Selection parity: the reference scans a shuffled, log2-limited window with
 up-to-3 low-score skips and picks the max score (first-seen wins ties).
-The dense emulation reproduces that exactly from per-node (feasible, score)
-arrays laid out in shuffled order -- see _select_window.
+Every kernel reproduces that exactly (see _select_window and the
+wavefront's in-buffer emulation); the oracle suites gate all of them.
 
 All arrays are in SHUFFLED ORDER (nomad_tpu/scheduler/util.py
 shuffled_order); callers map chosen indexes back to node ids.
