@@ -458,8 +458,11 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return
             index = self._blocking(url.query, tables)
             if parts[:2] == ["v1", "jobs"] and len(parts) == 2:
+                # ?prefix= filtering like every reference list endpoint
+                prefix = q.get("prefix", [""])[0]
                 self._send(200, [self._job_stub(j) for j in state.jobs()
-                                 if acl.allow_namespace_op(
+                                 if j.id.startswith(prefix)
+                                 and acl.allow_namespace_op(
                                      j.namespace, CAP_LIST_JOBS)], index)
             elif parts[:2] == ["v1", "job"] and len(parts) == 3:
                 job = state.job_by_id(ns, parts[2])
