@@ -542,8 +542,10 @@ class ApiHandler(BaseHTTPRequestHandler):
                     return
                 self._send(200, pol, index)
             elif parts[:2] == ["v1", "evaluations"]:
+                prefix = q.get("prefix", [""])[0]
                 self._send(200, [e for e in state.evals()
-                                 if acl.allow_namespace_op(
+                                 if e.id.startswith(prefix)
+                                 and acl.allow_namespace_op(
                                      e.namespace, CAP_READ_JOB)], index)
             elif parts[:2] == ["v1", "evaluation"] and len(parts) == 3:
                 ev = state.eval_by_id(parts[2])
@@ -565,6 +567,13 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._send(200, [a for a in state.allocs()
                                  if a.eval_id == parts[2]], index)
             elif parts[:2] == ["v1", "allocations"]:
+                prefix = q.get("prefix", [""])[0]
+                if prefix:
+                    return self._send(
+                        200, [a for a in state.allocs()
+                              if a.id.startswith(prefix)
+                              and acl.allow_namespace_op(
+                                  a.namespace, CAP_READ_JOB)], index)
                 self._send(200, [a for a in state.allocs()
                                  if acl.allow_namespace_op(
                                      a.namespace, CAP_READ_JOB)], index)
