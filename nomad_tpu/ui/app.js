@@ -165,10 +165,33 @@ async function viewNode(id) {
     </div>
     <h2>Allocations</h2>` +
     table(["ID", "Job", "Group", "Client", "Desired"], alRows) +
-    `<h2>Attributes</h2><table class="kv">` +
+    `<h2>Actions</h2><p>
+      <button onclick="nodeAction('${esc(id)}', 'drain')">Drain</button>
+      <button onclick="nodeAction('${esc(id)}', 'eligibility',
+        '${node.scheduling_eligibility === "ineligible" ? "eligible" : "ineligible"}')">
+        ${node.scheduling_eligibility === "ineligible" ? "Mark eligible" : "Mark ineligible"}</button>
+      <span id="action-result" class="muted"></span></p>
+    <h2>Attributes</h2><table class="kv">` +
     attrs.map(([k, v]) => `<tr><td>${k}</td><td>${v}</td></tr>`).join("") +
     `</table>`);
 }
+
+window.nodeAction = async function (id, action, arg) {
+  const out = document.getElementById("action-result");
+  out.textContent = "…";
+  const [url, body] = action === "drain"
+    ? [`/v1/node/${id}/drain`, {drain_spec: {deadline_s: 3600}}]
+    : [`/v1/node/${id}/eligibility`, {eligibility: arg}];
+  try {
+    const r = await fetch(url, {method: "POST",
+                               headers: {"Content-Type": "application/json"},
+                               body: JSON.stringify(body)});
+    const resp = await r.json();
+    out.textContent = r.ok ? `${action} ok` : `error: ${resp.error || r.status}`;
+  } catch (e) {
+    out.textContent = `error: ${e}`;
+  }
+};
 
 async function viewAllocs() {
   const allocs = await api("/v1/allocations");
