@@ -166,8 +166,8 @@ async function viewNode(id) {
     <h2>Allocations</h2>` +
     table(["ID", "Job", "Group", "Client", "Desired"], alRows) +
     `<h2>Actions</h2><p>
-      <button onclick="nodeAction('${esc(id)}', 'drain')">Drain</button>
-      <button onclick="nodeAction('${esc(id)}', 'eligibility',
+      <button onclick="nodeAction('${encodeURIComponent(id)}', 'drain')">Drain</button>
+      <button onclick="nodeAction('${encodeURIComponent(id)}', 'eligibility',
         '${node.scheduling_eligibility === "ineligible" ? "eligible" : "ineligible"}')">
         ${node.scheduling_eligibility === "ineligible" ? "Mark eligible" : "Mark ineligible"}</button>
       <span id="action-result" class="muted"></span></p>
@@ -176,21 +176,34 @@ async function viewNode(id) {
     `</table>`);
 }
 
-window.nodeAction = async function (id, action, arg) {
-  const out = document.getElementById("action-result");
-  out.textContent = "…";
-  const [url, body] = action === "drain"
-    ? [`/v1/node/${id}/drain`, {drain_spec: {deadline_s: 3600}}]
-    : [`/v1/node/${id}/eligibility`, {eligibility: arg}];
+// Shared POST-and-report for action buttons. The result span is
+// re-resolved on every write (the 5s auto-refresh can re-render and
+// detach a cached element mid-flight); success re-renders so button
+// labels/state don't go stale. Callers pass URL-ENCODED ids.
+async function postAction(label, url, body) {
+  const say = (msg) => {
+    const out = document.getElementById("action-result");
+    if (out) out.textContent = msg;
+  };
+  say("…");
   try {
     const r = await fetch(url, {method: "POST",
                                headers: {"Content-Type": "application/json"},
-                               body: JSON.stringify(body)});
+                               body: JSON.stringify(body || {})});
     const resp = await r.json();
-    out.textContent = r.ok ? `${action} ok` : `error: ${resp.error || r.status}`;
+    if (r.ok) { say(`${label} ok`); render(); }
+    else say(`error: ${resp.error || r.status}`);
   } catch (e) {
-    out.textContent = `error: ${e}`;
+    say(`error: ${e}`);
   }
+}
+
+window.nodeAction = function (id, action, arg) {
+  return action === "drain"
+    ? postAction("drain", `/v1/node/${id}/drain`,
+                 {drain_spec: {deadline_s: 3600}})
+    : postAction("eligibility", `/v1/node/${id}/eligibility`,
+                 {eligibility: arg});
 };
 
 async function viewAllocs() {
@@ -226,28 +239,16 @@ async function viewAlloc(id) {
     <h2>Tasks</h2>` + table(["Task", "State", "Failed", "Recent events"], tasks) +
     (scores.length ? `<h2>Placement scores</h2>` + table(["Node/score", "Value"], scores) : "") +
     `<h2>Actions</h2><p>
-      <button onclick="allocAction('${esc(a.id)}', 'restart')">Restart</button>
-      <button onclick="allocAction('${esc(a.id)}', 'stop')">Stop &amp; reschedule</button>
+      <button onclick="allocAction('${encodeURIComponent(a.id)}', 'restart')">Restart</button>
+      <button onclick="allocAction('${encodeURIComponent(a.id)}', 'stop')">Stop &amp; reschedule</button>
       <span id="action-result" class="muted"></span></p>`);
 }
 
 // alloc lifecycle buttons (restart = client path, stop = server path)
-window.allocAction = async function (id, action) {
-  const out = document.getElementById("action-result");
-  out.textContent = "…";
-  const url = action === "stop"
+window.allocAction = function (id, action) {
+  return postAction(action, action === "stop"
     ? `/v1/allocation/${id}/stop`
-    : `/v1/client/allocation/${id}/restart`;
-  try {
-    const r = await fetch(url, {method: "POST",
-                               headers: {"Content-Type": "application/json"},
-                               body: "{}"});
-    const body = await r.json();
-    out.textContent = r.ok ? `${action} ok ${JSON.stringify(body)}`
-                           : `error: ${body.error || r.status}`;
-  } catch (e) {
-    out.textContent = `error: ${e}`;
-  }
+    : `/v1/client/allocation/${id}/restart`, {});
 };
 
 async function viewEvals() {
