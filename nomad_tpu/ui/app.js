@@ -7,8 +7,15 @@ const $main = document.getElementById("main");
 let refreshTimer = null;
 let eventAbort = null;
 
+// ACL token (reference: the UI's token page): kept in sessionStorage,
+// attached to every request as X-Nomad-Token
+function authHeaders() {
+  const tok = sessionStorage.getItem("nomad_token") || "";
+  return tok ? {"X-Nomad-Token": tok} : {};
+}
+
 function api(path) {
-  return fetch(path).then((r) => {
+  return fetch(path, {headers: authHeaders()}).then((r) => {
     if (!r.ok) throw new Error(path + " -> " + r.status);
     return r.json();
   });
@@ -188,7 +195,8 @@ async function postAction(label, url, body) {
   say("…");
   try {
     const r = await fetch(url, {method: "POST",
-                               headers: {"Content-Type": "application/json"},
+                               headers: {"Content-Type": "application/json",
+                                         ...authHeaders()},
                                body: JSON.stringify(body || {})});
     const resp = await r.json();
     if (r.ok) { say(`${label} ok`); render(); }
@@ -357,7 +365,9 @@ async function attachEventStream() {
   const state = document.getElementById("evt-state");
   if (!list) return;
   try {
-    const resp = await fetch("/v1/event/stream", {signal: eventAbort.signal});
+    const resp = await fetch("/v1/event/stream",
+                             {signal: eventAbort.signal,
+                              headers: authHeaders()});
     state.textContent = "live";
     const reader = resp.body.getReader();
     const dec = new TextDecoder();
@@ -432,6 +442,15 @@ async function render() {
     return;
   }
   location.hash = "#/jobs";
+}
+
+const $tok = document.getElementById("acl-token");
+if ($tok) {
+  $tok.value = sessionStorage.getItem("nomad_token") || "";
+  $tok.addEventListener("change", () => {
+    sessionStorage.setItem("nomad_token", $tok.value.trim());
+    render();
+  });
 }
 
 window.addEventListener("hashchange", render);
