@@ -77,12 +77,17 @@ async function clusterStat() {
 /* ----- views ----- */
 
 async function viewJobs() {
-  const jobs = await api("/v1/jobs");
+  const prefix = sessionStorage.getItem("jobs_prefix") || "";
+  const jobs = await api("/v1/jobs"
+    + (prefix ? `?prefix=${encodeURIComponent(prefix)}` : ""));
   const rows = jobs.map((j) => [
     idLink("job", j.id, esc(j.id)),
     esc(j.type), badge(j.status), esc(j.priority), esc(j.version ?? ""),
   ]);
-  return h(`<h1>Jobs</h1>` +
+  return h(`<h1>Jobs</h1>
+    <p><input id="jobs-prefix" placeholder="filter by id prefix"
+       value="${esc(prefix)}"
+       onchange="sessionStorage.setItem('jobs_prefix', this.value.trim()); render();"></p>` +
     table(["ID", "Type", "Status", "Priority", "Version"], rows));
 }
 
