@@ -1,0 +1,11 @@
+#!/bin/bash
+# Quick TPU reachability probe (subprocess + hard timeout; a wedged axon
+# tunnel HANGS jax init rather than failing). Exit 0 = chip reachable.
+timeout "${1:-90}" python -u -c "
+import os
+os.environ.pop('JAX_PLATFORMS', None)
+import jax
+devs = jax.devices()
+assert devs and devs[0].platform != 'cpu', devs
+print('TPU OK:', devs)
+"
