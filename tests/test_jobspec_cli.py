@@ -486,3 +486,24 @@ def test_evaluation_allocations_endpoint():
     finally:
         http.shutdown()
         server.shutdown()
+
+
+def test_list_prefix_filters():
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        for jid in ("web-a", "web-b", "db-a"):
+            server.register_job(mock.job(id=jid))
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        assert {j["id"] for j in api.get("/v1/jobs", prefix="web-")} == \
+            {"web-a", "web-b"}
+        assert len(api.get("/v1/jobs")) == 3
+        evs = api.get("/v1/evaluations")
+        some = evs[0]["id"]
+        got = api.get("/v1/evaluations", prefix=some[:8])
+        assert all(e["id"].startswith(some[:8]) for e in got) and got
+    finally:
+        http.shutdown()
+        server.shutdown()
