@@ -226,8 +226,9 @@ class Planner:
 
         def commit(item=item, result=result):
             try:
-                index = self.state.upsert_plan_results(result,
-                                                       item.eval_updates)
+                with metrics.measure("nomad.plan.commit"):
+                    index = self.state.upsert_plan_results(
+                        result, item.eval_updates)
             except BaseException as e:  # noqa: BLE001 -- waiter must wake
                 item.resolve(error=e)
                 raise

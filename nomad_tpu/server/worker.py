@@ -159,7 +159,8 @@ class BatchWorker(threading.Thread):
         if not batch:
             return
         metrics.sample_ms("nomad.worker.batch_width", float(len(batch)))
-        barrier = SolveBarrier(len(batch), use_mesh=self.use_mesh)
+        barrier = SolveBarrier(len(batch), use_mesh=self.use_mesh,
+                               e_pad_hint=self.width)
         hook = make_solve_hook(barrier)
         threads = [
             threading.Thread(

@@ -24,7 +24,9 @@ concurrency, SURVEY.md section 2.6.1).
 """
 from __future__ import annotations
 
+import sys
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -74,11 +76,19 @@ def _pad_placement_axis(batch, p_pad: int):
                    else grow(batch.ask_cores)))
 
 
-def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
+def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
+                   e_pad_hint: int = 0
                    ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Group lanes by static-shape signature (placement axes pad to a
     common bucket), solve each group as ONE batched dispatch, return
-    per-lane (chosen, scores, n_yielded) in input order."""
+    per-lane (chosen, scores, n_yielded) in input order.
+
+    ``e_pad_hint`` (the barrier width) pins the eval axis of WAVEFRONT
+    groups to one bucket regardless of how many lanes actually arrived:
+    retry batches come in arbitrary sizes, and every fresh E bucket is a
+    fresh XLA program (seconds of compile stalling the whole batch) while
+    an inert wave lane costs only O(B*P) padded compute. Dense groups
+    keep the tight bucket -- their padding costs O(N*P) per lane."""
     results: List = [None] * len(lanes)
     groups: Dict[tuple, List[int]] = {}
     for i, lane in enumerate(lanes):
@@ -90,6 +100,8 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
         A = 1 if lanes[idxs[0]].ptab is not None else 0
         e_real = len(idxs)
         e_pad = _e_bucket(e_real)
+        if e_pad_hint and lanes[idxs[0]].wavefront_ok():
+            e_pad = max(e_pad, _e_bucket(min(e_pad_hint, E_BUCKETS[-1])))
         # floor of 32: many lane sizes share one compiled variant (an
         # inert padded step costs ~us; a fresh XLA compile costs seconds)
         p_pad = max(32, _e_bucket(max(
@@ -131,9 +143,20 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True
                 stack(lambda i, k=k: getattr(lanes[i].pinit, k))
                 for k in lane0.pinit._fields])
 
+        t0 = time.perf_counter()
         out = _dispatch(const, init, batch, spread_alg, dtype_name,
                         use_mesh, ptab=ptab, pinit=pinit,
                         wave=lanes[idxs[0]].wavefront_ok())
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        metrics.sample_ms("nomad.solver.dispatch", dt_ms)
+        if dt_ms > 1000.0:
+            # a >1s dispatch on these shapes is an XLA compile, not compute;
+            # record which variant so warm-path stalls are attributable
+            metrics.incr("nomad.solver.dispatch_slow")
+            print(f"[nomad-tpu] slow dispatch {dt_ms:.0f}ms "
+                  f"(E={e_pad} P={p_pad} wave={lanes[idxs[0]].wavefront_ok()}"
+                  f" A={A}) -- likely fresh XLA compile",
+                  file=sys.stderr)
         if A > 0:
             chosen, scores, n_yielded, evict_rows = out
         else:
@@ -207,13 +230,18 @@ class SolveBarrier:
     dispatch for everyone and wakes them (baton-passing, no extra
     dispatcher thread)."""
 
-    def __init__(self, participants: int, use_mesh: bool = True):
+    def __init__(self, participants: int, use_mesh: bool = True,
+                 e_pad_hint: int = 0):
         self._cv = threading.Condition()
         self._participants = participants
         self._finished = 0
         self._waiting: List[Tuple[PackedLane, dict]] = []
         self._use_mesh = use_mesh
         self._generation = 0
+        # pin wave groups' eval axis to the worker's CONFIGURED width, not
+        # the momentary batch size: dequeue sizes vary per iteration and
+        # every fresh E bucket is a fresh XLA program
+        self._e_pad_hint = e_pad_hint or participants
 
     def done(self) -> None:
         """Thread finished its eval (no more solves coming)."""
@@ -254,7 +282,8 @@ class SolveBarrier:
         self._generation += 1
         lanes = [lane for lane, _ in batch]
         try:
-            results = fuse_and_solve(lanes, use_mesh=self._use_mesh)
+            results = fuse_and_solve(lanes, use_mesh=self._use_mesh,
+                                     e_pad_hint=self._e_pad_hint)
             for (lane, cell), res in zip(batch, results):
                 cell["result"] = res
         except Exception as e:  # noqa: BLE001 -- waiters must not strand

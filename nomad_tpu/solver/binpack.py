@@ -810,6 +810,8 @@ def solve_eval_batch(const: NodeConst, init: NodeState, batch: PlacementBatch,
     The eval axis is the data-parallel axis for multi-chip sharding; the
     node axis shards as the model axis (see parallel/mesh.py).
     """
+    from .cache import enable_compile_cache
+    enable_compile_cache()
     import functools as _ft
     inner = _ft.partial(solve_placements, spread_alg=spread_alg,
                         dtype_name=dtype_name)
@@ -904,6 +906,8 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
     eligibility): host-side O(N) precompute + compact-table device scan
     (solve_lane_wave). Stacking chosen/n_yielded through the score dtype
     is exact: node indexes and yield counts are < 2^24."""
+    from .cache import enable_compile_cache
+    enable_compile_cache()
     if wave and ptab is None:
         return solve_lane_wave(const, init, batch, spread_alg=spread_alg,
                                dtype_name=dtype_name, batched=batched)
@@ -1554,11 +1558,27 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
                              "buffer width (caller must gate on "
                              "wavefront_ok)")
         p_pad = _wave_p_bucket(P)
-        lanes = [wavefront_compact_host(
-            jax.tree_util.tree_map(lambda a, e=e: a[e], const),
-            jax.tree_util.tree_map(lambda a, e=e: a[e], init),
-            jax.tree_util.tree_map(lambda a, e=e: a[e], batch),
-            dtype_name, p_pad=p_pad, B=B) for e in range(E)]
+        # Inert padding lanes (active all-False, replicas of lane 0 from
+        # the fuse path's E-bucket pinning) place nothing; one precompute
+        # serves them all instead of E-e_real redundant O(N) host folds.
+        active_rows = np.asarray(batch.active).any(axis=1)
+
+        def pack_one(e):
+            return wavefront_compact_host(
+                jax.tree_util.tree_map(lambda a: a[e], const),
+                jax.tree_util.tree_map(lambda a: a[e], init),
+                jax.tree_util.tree_map(lambda a: a[e], batch),
+                dtype_name, p_pad=p_pad, B=B)
+
+        inert_pack = None
+        lanes = []
+        for e in range(E):
+            if not active_rows[e]:
+                if inert_pack is None:
+                    inert_pack = pack_one(e)
+                lanes.append(inert_pack)
+            else:
+                lanes.append(pack_one(e))
         compact = np.stack([l[0] for l in lanes])
         scal_f = np.stack([l[1] for l in lanes])
         scal_i = np.stack([l[2] for l in lanes])

@@ -177,7 +177,7 @@ def test_solve_barrier_dispatch_exception_fans_out():
             return ("boom",)
 
     orig = batch_mod.fuse_and_solve
-    batch_mod.fuse_and_solve = lambda lanes, use_mesh=True: (
+    batch_mod.fuse_and_solve = lambda lanes, use_mesh=True, **kw: (
         (_ for _ in ()).throw(RuntimeError("device exploded")))
     try:
         barrier = SolveBarrier(participants=3)
@@ -218,7 +218,7 @@ def test_solve_barrier_straggler_timeout_dispatches_without_it():
 
     dispatched = []
     orig_fuse = batch_mod.fuse_and_solve
-    batch_mod.fuse_and_solve = lambda lanes, use_mesh=True: (
+    batch_mod.fuse_and_solve = lambda lanes, use_mesh=True, **kw: (
         dispatched.append([ln.tag for ln in lanes])
         or [("ok", ln.tag) for ln in lanes])
     orig_timeout = batch_mod.BARRIER_TIMEOUT_S

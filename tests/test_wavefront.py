@@ -249,6 +249,36 @@ def test_compact_path_batched():
         np.testing.assert_array_equal(ny_b[k], np.asarray(y1))
 
 
+def test_compact_path_batched_inert_padding_lanes():
+    """The fuse path pins the eval axis to the barrier-width bucket, so
+    production batched wave dispatches routinely carry inert padding
+    lanes (replicas of lane 0 with active all-False). Real lanes must
+    still solve exactly and padding lanes must place nothing."""
+    import jax
+    from nomad_tpu.solver.binpack import solve_lane_fused
+    real = [_world(random.Random(900 + k), n=24, p=16, limit=5)
+            for k in range(3)]
+    # pad to E=8 with replicas of lane 0, active=False (what
+    # batch.fuse_and_solve's stack() + active[e_real:]=False produces)
+    pad_c, pad_i, pad_b = real[0]
+    pad_b = pad_b._replace(active=np.zeros_like(np.asarray(pad_b.active)))
+    lanes = real + [(pad_c, pad_i, pad_b)] * 5
+    const = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[0] for l in lanes])
+    init = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                  *[l[1] for l in lanes])
+    batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                   *[l[2] for l in lanes])
+    chosen_b, scores_b, ny_b = solve_lane_fused(
+        const, init, batch, spread_alg=False, dtype_name="float64",
+        batched=True, wave=True)
+    for k, (c, i, b) in enumerate(real):
+        c1, s1, y1 = solve_wavefront(c, i, b, dtype_name="float64")
+        np.testing.assert_array_equal(chosen_b[k], np.asarray(c1))
+        np.testing.assert_array_equal(ny_b[k], np.asarray(y1))
+    assert (chosen_b[len(real):] == -1).all()
+
+
 def _compare_compact(const, init, batch, spread_alg=False):
     """Production wave route (host precompute + compact scan) vs the
     dense oracle kernel, incl. the wide-window spread/affinity variant."""
