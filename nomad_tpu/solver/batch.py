@@ -222,6 +222,189 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
                             dtype_name=dtype_name, batched=True)
 
 
+def _cross_lane_fixpoint(lanes: List[PackedLane], results: List,
+                         ledger: Dict[str, list]) -> None:
+    """Resolve intra-batch placement conflicts BEFORE plans are submitted.
+
+    Every lane solved from the same snapshot, so concurrent evals pile
+    onto the same best-scoring nodes; the serialized applier then
+    partial-rejects the losers and each rejected eval pays a full
+    scheduler retry round trip (broker -> worker -> solve -> applier).
+    The reference has the same race between its parallel workers
+    (plan_apply.go:96 partial commits + generic_sched.go:330 retries);
+    here the barrier already holds EVERY in-flight result, so it can
+    settle the conflicts locally: walk lanes in plan-priority order,
+    charge each placement against a shared per-node capacity ledger, and
+    re-solve only the overflowing placements of wave-eligible lanes
+    against the accumulated usage (one extra small cached-program
+    dispatch per conflicted lane). The outcome matches what the
+    applier+retry loop would have produced from this snapshot -- minus
+    the control-plane round trips. The applier's authoritative re-check
+    (plan_apply.py _evaluate_plan) still runs unchanged on every plan.
+
+    Lanes that the wave kernel can't re-solve (preemption tables, static
+    ports, devices/cores/distinct_property) only consume ledger capacity;
+    their conflicts keep the applier/retry path. The ledger is keyed by
+    node id and persists across a batch's barrier generations (multi-TG
+    evals rendezvous once per TG) so later generations see earlier ones'
+    usage. Results are edited in place.
+
+    Disable with NOMAD_TPU_BATCH_FIXPOINT=0.
+    """
+    import os
+    if os.environ.get("NOMAD_TPU_BATCH_FIXPOINT", "1") == "0":
+        return
+    if len(lanes) < 2 and not ledger:
+        return
+
+    order_idx = sorted(
+        range(len(lanes)),
+        key=lambda i: (-lanes[i].service.ctx.plan.priority, i))
+
+    def charge(lane, free, pi):
+        """Try to charge placement pi to the ledger entry ``free``;
+        returns True and subtracts when it fits."""
+        b = lane.batch
+        need = (float(b.ask_cpu[pi]), float(b.ask_mem[pi]),
+                float(b.ask_disk[pi]), int(b.n_dyn_ports[pi]))
+        if (free[0] >= need[0] and free[1] >= need[1]
+                and free[2] >= need[2] and free[3] >= need[3]):
+            free[0] -= need[0]
+            free[1] -= need[1]
+            free[2] -= need[2]
+            free[3] -= need[3]
+            return True
+        return False
+
+    def entry(lane, pos, nid):
+        f = ledger.get(nid)
+        if f is None:
+            c, s = lane.const, lane.init
+            f = [float(c.cpu_cap[pos]) - float(s.used_cpu[pos]),
+                 float(c.mem_cap[pos]) - float(s.used_mem[pos]),
+                 float(c.disk_cap[pos]) - float(s.used_disk[pos]),
+                 int(s.dyn_avail[pos])]
+            ledger[nid] = f
+        return f
+
+    for i in order_idx:
+        lane, res = lanes[i], results[i]
+        if res is None:
+            continue
+        chosen = res[0]
+        active = np.asarray(lane.batch.active)
+        plan = lane.service.ctx.plan
+        # Consumer-only lanes are never re-solved: preemption tables and
+        # static ports need the applier's exact checks, and a plan
+        # carrying stops/preemptions has a usage view the shared ledger
+        # can't represent (its init excludes capacity that frees only if
+        # ITS plan commits -- re-solving against the ledger would strand
+        # that capacity and spuriously fail placements the applier would
+        # have accepted).
+        resolvable = (lane.ptab is None and lane.wavefront_ok()
+                      and not bool(np.asarray(lane.batch.has_static)[:1]
+                                   .any())
+                      and not plan.node_update
+                      and not plan.node_preemptions)
+        order = np.asarray(lane.order)
+        conflicted: List[int] = []
+        accepted_own: List[int] = []
+        for pi in range(chosen.shape[0]):
+            pos = int(chosen[pi])
+            if pos < 0 or pos >= order.shape[0] or not active[pi]:
+                continue
+            nid = lane.nodes[order[pos]].id
+            if charge(lane, entry(lane, pos, nid), pi):
+                accepted_own.append(pos)
+            elif resolvable:
+                conflicted.append(pi)
+            # else: leave the placement for the applier to adjudicate;
+            # its capacity was NOT charged (the applier will reject it)
+        if not conflicted:
+            continue
+        metrics.incr("nomad.solver.fixpoint_conflicts", len(conflicted))
+        metrics.incr("nomad.solver.fixpoint_dispatches")
+        results[i] = _resolve_lane_conflicts(
+            lane, res, conflicted, accepted_own, ledger, entry, charge)
+
+
+def _resolve_lane_conflicts(lane, res, conflicted, accepted_own,
+                            ledger, entry, charge):
+    """Re-solve ``conflicted`` placements of one wave lane against the
+    ledger's accumulated usage; returns the merged result tuple (the
+    fused dispatch's arrays are read-only device-buffer views, so the
+    merge copies instead of mutating)."""
+    from .binpack import solve_lane_fused
+
+    import jax
+
+    chosen = np.array(res[0], copy=True)
+    scores = np.array(res[1], copy=True)
+    n_yielded = np.array(res[2], copy=True)
+    const, init = lane.const, lane.init
+    order = np.asarray(lane.order)
+    n = order.shape[0]
+    pos_of = {lane.nodes[order[p]].id: p for p in range(n)}
+
+    used_cpu = np.array(init.used_cpu, copy=True)
+    used_mem = np.array(init.used_mem, copy=True)
+    used_disk = np.array(init.used_disk, copy=True)
+    dyn_avail = np.array(init.dyn_avail, copy=True)
+    for nid, f in ledger.items():
+        p = pos_of.get(nid)
+        if p is None:
+            continue
+        # re-derive this lane's view of the node from the joint ledger
+        # (caps are identical across lanes -- raw node resources minus
+        # reserved -- so cap - free is the joint used)
+        used_cpu[p] = float(const.cpu_cap[p]) - f[0]
+        used_mem[p] = float(const.mem_cap[p]) - f[1]
+        used_disk[p] = float(const.disk_cap[p]) - f[2]
+        dyn_avail[p] = f[3]
+    placed = np.array(init.placed, copy=True)
+    placed_job = np.array(init.placed_job, copy=True)
+    spread_counts = np.array(init.spread_counts, copy=True)
+    S = spread_counts.shape[0] if spread_counts.ndim else 0
+    for pos in accepted_own:
+        placed[pos] += 1
+        placed_job[pos] += 1
+        for s in range(S):
+            v = int(const.spread_vidx[s, pos])
+            if v >= 0:
+                spread_counts[s, v] += 1
+    new_init = init._replace(
+        used_cpu=used_cpu, used_mem=used_mem, used_disk=used_disk,
+        dyn_avail=dyn_avail, placed=placed, placed_job=placed_job,
+        spread_counts=spread_counts)
+
+    idx = np.asarray(conflicted, dtype=np.int64)
+    sub_batch = jax.tree_util.tree_map(
+        lambda a: np.asarray(a)[idx]
+        if np.asarray(a).shape[:1] == (chosen.shape[0],) else a,
+        lane.batch)
+    c2, s2, y2 = solve_lane_fused(
+        const, new_init, sub_batch, spread_alg=lane.spread_alg,
+        dtype_name=lane.dtype_name, wave=True)
+    # Merge ONLY successful re-solves. A -1 re-solve means the ledger saw
+    # no capacity -- but the ledger can be pessimistic (a consumer-only
+    # lane's charge whose plan later gets rejected is never refunded), so
+    # keep the ORIGINAL choice and let the authoritative applier decide:
+    # a phantom conflict then commits fine, a real one costs one retry
+    # round trip (exactly the pre-fixpoint behavior).
+    for k, pi in enumerate(conflicted):
+        pos = int(c2[k])
+        if pos < 0:
+            continue
+        chosen[pi] = pos
+        scores[pi] = s2[k]
+        n_yielded[pi] = y2[k]
+        # charge the fresh choice (solved against the ledger's usage, so
+        # it fits; charging records it for later lanes)
+        nid = lane.nodes[order[pos]].id
+        charge(lane, entry(lane, pos, nid), pi)
+    return (chosen, scores, n_yielded)
+
+
 class SolveBarrier:
     """Rendezvous point for one batch of eval threads.
 
@@ -242,6 +425,9 @@ class SolveBarrier:
         # the momentary batch size: dequeue sizes vary per iteration and
         # every fresh E bucket is a fresh XLA program
         self._e_pad_hint = e_pad_hint or participants
+        # shared per-node capacity ledger for the cross-lane conflict
+        # fixpoint; persists across this batch's barrier generations
+        self._ledger: Dict[str, list] = {}
 
     def done(self) -> None:
         """Thread finished its eval (no more solves coming)."""
@@ -284,6 +470,7 @@ class SolveBarrier:
         try:
             results = fuse_and_solve(lanes, use_mesh=self._use_mesh,
                                      e_pad_hint=self._e_pad_hint)
+            _cross_lane_fixpoint(lanes, results, self._ledger)
             for (lane, cell), res in zip(batch, results):
                 cell["result"] = res
         except Exception as e:  # noqa: BLE001 -- waiters must not strand

@@ -164,6 +164,60 @@ def test_multi_tg_eval_sequences_within_batch():
         server.shutdown()
 
 
+def test_cross_lane_fixpoint_avoids_applier_retry():
+    """Two evals in one batch whose best-fit choices collide on the same
+    node, with spare capacity elsewhere: the barrier's conflict fixpoint
+    must settle the loser onto the spare node BEFORE plan submission, so
+    the applier commits both plans with zero rejections (no retry round
+    trips through the broker)."""
+    metrics.reset()
+    # one TIGHT node (fits exactly one 500cpu/256mb mock alloc; best-fit
+    # scores it highest for BOTH evals regardless of shuffle order) plus
+    # one roomy spare: the fused batch must collide on the tight node
+    server, nodes = make_server(n_nodes=1, width=4, cpu=600, mem=400)
+    spare = mock.node()
+    spare.id = "batch-node-spare"
+    spare.node_resources.cpu.cpu_shares = 4000
+    spare.node_resources.memory.memory_mb = 8192
+    spare.compute_class()
+    server.register_node(spare)
+    try:
+        from nomad_tpu.structs import Evaluation, generate_uuid
+
+        j1 = mock.job(id="fixpoint-a")
+        j1.task_groups[0].count = 1
+        j2 = mock.job(id="fixpoint-b")
+        j2.task_groups[0].count = 1
+        # enqueue both evals ATOMICALLY (one broker lock acquisition) so a
+        # polling batch worker cannot dequeue one before the other exists
+        # -- register_job enqueues each eval separately, which makes the
+        # same-batch rendezvous (the thing under test) timing-dependent
+        evs = []
+        for j in (j1, j2):
+            server.state.upsert_job(j)
+            ev = Evaluation(id=generate_uuid(), namespace=j.namespace,
+                            priority=j.priority, type=j.type,
+                            triggered_by="job-register", job_id=j.id,
+                            status="pending")
+            evs.append(ev)
+        server.state.upsert_evals(evs)
+        server.broker.enqueue_all(evs)
+        wait_until(lambda: len(committed_allocs(server, j1)) == 1
+                   and len(committed_allocs(server, j2)) == 1,
+                   msg="both jobs placed")
+        a1 = committed_allocs(server, j1)[0]
+        a2 = committed_allocs(server, j2)[0]
+        assert a1.node_id != a2.node_id
+        # the point of the fixpoint: the applier never saw a conflict
+        assert server.planner.plans_rejected == 0
+        snap = metrics.snapshot()
+        assert snap["counters"].get(
+            "nomad.solver.fixpoint_conflicts", 0) >= 1, \
+            sorted(snap["counters"])
+    finally:
+        server.shutdown()
+
+
 def test_solve_barrier_dispatch_exception_fans_out():
     """A dispatch failure must re-raise in EVERY blocked participant
     (VERDICT r2 weak #5), so each eval nacks independently."""
@@ -216,6 +270,8 @@ def test_solve_barrier_straggler_timeout_dispatches_without_it():
         def fuse_key(self):
             return ("t",)
 
+    import os
+
     dispatched = []
     orig_fuse = batch_mod.fuse_and_solve
     batch_mod.fuse_and_solve = lambda lanes, use_mesh=True, **kw: (
@@ -223,6 +279,7 @@ def test_solve_barrier_straggler_timeout_dispatches_without_it():
         or [("ok", ln.tag) for ln in lanes])
     orig_timeout = batch_mod.BARRIER_TIMEOUT_S
     batch_mod.BARRIER_TIMEOUT_S = 0.3
+    os.environ["NOMAD_TPU_BATCH_FIXPOINT"] = "0"    # fake lanes/results
     try:
         # 3 participants; only 2 ever arrive -- the third is a straggler
         barrier = SolveBarrier(participants=3)
@@ -245,3 +302,4 @@ def test_solve_barrier_straggler_timeout_dispatches_without_it():
     finally:
         batch_mod.fuse_and_solve = orig_fuse
         batch_mod.BARRIER_TIMEOUT_S = orig_timeout
+        os.environ.pop("NOMAD_TPU_BATCH_FIXPOINT", None)
