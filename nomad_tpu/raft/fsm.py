@@ -215,9 +215,9 @@ def restore_state(store: StateStore, blob: dict) -> None:
         store._allocs_by_node = {}
         store._allocs_by_job = {}
         for a in allocs:
-            store._allocs_by_node.setdefault(a.node_id, []).append(a.id)
+            store._allocs_by_node.setdefault(a.node_id, {})[a.id] = None
             store._allocs_by_job.setdefault(
-                (a.namespace, a.job_id), []).append(a.id)
+                (a.namespace, a.job_id), {})[a.id] = None
         # re-link alloc.job to the stored job (codec duplicates the object)
         for a in allocs:
             stored = store._jobs.get((a.namespace, a.job_id))

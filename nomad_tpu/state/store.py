@@ -56,8 +56,8 @@ class StateSnapshot:
             # plan applier re-verifies every plan against latest state
             self.alloc_table = store.alloc_table
             self._store = store
-            self._allocs_by_node = {k: list(v) for k, v in store._allocs_by_node.items()}
-            self._allocs_by_job = {k: list(v) for k, v in store._allocs_by_job.items()}
+            self._allocs_by_node = {k: dict(v) for k, v in store._allocs_by_node.items()}
+            self._allocs_by_job = {k: dict(v) for k, v in store._allocs_by_job.items()}
             self._csi_volumes = dict(store._csi_volumes)
             self._csi_plugins = dict(store._csi_plugins)
 
@@ -199,9 +199,12 @@ class StateStore:
         # native service catalog (reference: state_store.go
         # service_registration region), keyed by registration id
         self._services: Dict[str, "ServiceRegistration"] = {}
-        # secondary indexes
-        self._allocs_by_node: Dict[str, List[str]] = {}
-        self._allocs_by_job: Dict[Tuple[str, str], List[str]] = {}
+        # secondary indexes: insertion-ordered id sets (dict keys). Plain
+        # lists made _insert_allocs_locked O(K^2) in a job's alloc count
+        # (a membership scan per insert) -- 70ms of a 2000-alloc plan
+        # commit was this scan.
+        self._allocs_by_node: Dict[str, Dict[str, None]] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], Dict[str, None]] = {}
         # watch support
         self._watch_cond = threading.Condition(self._lock)
         # tensor-resident alloc table (fed to the TPU solver's native
@@ -271,14 +274,21 @@ class StateStore:
             self._nodes[node.id] = node
             self.alloc_table.register_node(node)
             idx = self._bump("nodes")
-            self._recompute_csi_plugins_locked()
+            # the recompute walks every node; skip it when this write
+            # cannot change plugin state (no CSI fingerprints on the new
+            # node and none aggregated fleet-wide) -- otherwise a 10K-node
+            # registration burst is O(N^2)
+            if node.csi_node_plugins or self._csi_plugins:
+                self._recompute_csi_plugins_locked()
             return idx
 
     def delete_node(self, node_id: str) -> int:
         with self._lock:
-            self._nodes.pop(node_id, None)
+            node = self._nodes.pop(node_id, None)
             idx = self._bump("nodes")
-            self._recompute_csi_plugins_locked()
+            if (node is not None and node.csi_node_plugins) \
+                    or self._csi_plugins:
+                self._recompute_csi_plugins_locked()
             return idx
 
     def update_node_status(self, node_id: str, status: str,
@@ -294,7 +304,8 @@ class StateStore:
             node.modify_index = self._index + 1
             self._nodes[node_id] = node
             idx = self._bump("nodes")
-            self._recompute_csi_plugins_locked()
+            if node.csi_node_plugins or self._csi_plugins:
+                self._recompute_csi_plugins_locked()
             return idx
 
     def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
@@ -308,7 +319,8 @@ class StateStore:
             node.modify_index = self._index + 1
             self._nodes[node_id] = node
             idx = self._bump("nodes")
-            self._recompute_csi_plugins_locked()
+            if node.csi_node_plugins or self._csi_plugins:
+                self._recompute_csi_plugins_locked()
             return idx
 
     def update_node_drain(self, node_id: str, drain_strategy,
@@ -328,7 +340,8 @@ class StateStore:
             node.modify_index = self._index + 1
             self._nodes[node_id] = node
             idx = self._bump("nodes")
-            self._recompute_csi_plugins_locked()
+            if node.csi_node_plugins or self._csi_plugins:
+                self._recompute_csi_plugins_locked()
             return idx
 
     # -- jobs ----------------------------------------------------------------
@@ -535,13 +548,9 @@ class StateStore:
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job
             self._allocs[alloc.id] = alloc
-            self._allocs_by_node.setdefault(alloc.node_id, [])
-            if alloc.id not in self._allocs_by_node[alloc.node_id]:
-                self._allocs_by_node[alloc.node_id].append(alloc.id)
+            self._allocs_by_node.setdefault(alloc.node_id, {})[alloc.id] = None
             jk = (alloc.namespace, alloc.job_id)
-            self._allocs_by_job.setdefault(jk, [])
-            if alloc.id not in self._allocs_by_job[jk]:
-                self._allocs_by_job[jk].append(alloc.id)
+            self._allocs_by_job.setdefault(jk, {})[alloc.id] = None
             self.alloc_table.upsert(alloc)
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
@@ -592,11 +601,11 @@ class StateStore:
                 a = self._allocs.pop(aid, None)
                 if a is not None:
                     ids = self._allocs_by_node.get(a.node_id)
-                    if ids and aid in ids:
-                        ids.remove(aid)
+                    if ids is not None:
+                        ids.pop(aid, None)
                     jids = self._allocs_by_job.get((a.namespace, a.job_id))
-                    if jids and aid in jids:
-                        jids.remove(aid)
+                    if jids is not None:
+                        jids.pop(aid, None)
                 self.alloc_table.remove(aid)
             return self._bump("allocs")
 
