@@ -393,7 +393,8 @@ def _fused_compute_only(lanes, repeats=3):
     from nomad_tpu.solver.binpack import (
         _solve_wave_compact_impl, _wave_p_bucket, wavefront_compact_host)
 
-    if not all(lane.wavefront_ok() for lane in lanes):
+    if not all(lane.ptab is None and lane.wavefront_ok()
+               for lane in lanes):
         return None
     if lanes[0].const.spread_vidx.shape[0]:
         return None             # spread lanes carry extra tables

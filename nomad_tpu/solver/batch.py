@@ -186,9 +186,12 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
     from .binpack import solve_eval_batch, solve_lane_fused
 
     if ptab is not None:
+        if wave:
+            metrics.incr("nomad.solver.wavefront_preempt_dispatches")
         return solve_lane_fused(const, init, batch, ptab, pinit,
                                 spread_alg=spread_alg,
-                                dtype_name=dtype_name, batched=True)
+                                dtype_name=dtype_name, batched=True,
+                                wave=wave)
     if wave:
         metrics.incr("nomad.solver.wavefront_dispatches")
         return solve_lane_fused(const, init, batch, spread_alg=spread_alg,

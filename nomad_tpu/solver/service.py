@@ -91,7 +91,29 @@ class PackedLane:
         if os.environ.get("NOMAD_TPU_WAVEFRONT", "1") == "0":
             return False
         if self.ptab is not None:
-            return False
+            # windowed preemption (solve_lane_wave_preempt): spreads stay
+            # dense (the preempt slot kernel carries no spread columns);
+            # networks/devices/cores are already excluded for preempt
+            # lanes by tg_solver_eligible(preempt=True)
+            if os.environ.get("NOMAD_TPU_WAVEFRONT_PREEMPT", "1") == "0":
+                return False
+            if self.const.spread_vidx.shape[0]:
+                return False
+            # max_parallel penalties couple the greedy's pick ORDER to the
+            # evolving per-group eviction counts; the picked set feeds
+            # fit2, so a node's option status would no longer be static
+            # outside the window -- the invariant the windowed design
+            # rests on. Those lanes stay dense.
+            if bool(np.any(np.asarray(self.ptab.maxp)[
+                    np.asarray(self.ptab.valid)] > 0)):
+                return False
+            # the deferred zombie occupies one slot for a step: the
+            # window must still fit beside it
+            from .binpack import MAX_SKIP
+            lim = int(np.asarray(self.batch.limit)[0])
+            b = wavefront_buffer_size(lim)
+            if b is None or lim + MAX_SKIP + 1 > b:
+                return False
         c = self.const
         if (c.dp_vidx.shape[0] or c.dev_aff.shape[0]
                 or c.mhz_per_core.shape[0]):
@@ -182,8 +204,12 @@ def dispatch_lane(lane: PackedLane):
 
     wave = lane.wavefront_ok()
     from ..server.telemetry import metrics as _tm
-    _tm.incr("nomad.solver.wavefront_dispatches" if wave
-             else "nomad.solver.dense_dispatches")
+    if lane.ptab is not None:
+        _tm.incr("nomad.solver.wavefront_preempt_dispatches" if wave
+                 else "nomad.solver.dense_dispatches")
+    else:
+        _tm.incr("nomad.solver.wavefront_dispatches" if wave
+                 else "nomad.solver.dense_dispatches")
     return solve_lane_fused(
         lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
         spread_alg=lane.spread_alg, dtype_name=lane.dtype_name,
