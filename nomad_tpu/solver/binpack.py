@@ -2011,6 +2011,11 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
     returns host numpy (chosen int64, scores, n_yielded int64,
     evict_rows (P, A) bool), shaped like solve_lane_fused's preempt
     outputs. Callers gate on wavefront_preempt_ok."""
+    S_dim = np.asarray(const.spread_vidx).shape[1 if batched else 0]
+    if S_dim:
+        raise ValueError(
+            "wave-preempt kernel carries no spread columns; spread lanes "
+            "must stay dense (callers gate on wavefront_ok)")
     if batched:
         E = np.asarray(batch.ask_cpu).shape[0]
         P = int(np.asarray(batch.ask_cpu).shape[1])

@@ -1,0 +1,27 @@
+"""The driver's multichip dryrun, exercised in CI on the virtual
+8-device CPU mesh (conftest sets xla_force_host_platform_device_count).
+
+The dryrun itself asserts bit-parity of the sharded dense solve, the
+batched wavefront, the windowed preemption kernel, and the fuse
+coordinator's mesh route against single-device/dense references
+(VERDICT r3 next-step 4); CI runs it at reduced-but-nontrivial shapes so
+a sharding regression fails the suite, while the driver's invocation
+(python __graft_entry__.py) runs the full 32 x 128 x 10240."""
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.mark.skipif(jax.device_count() < 2,
+                    reason="needs the virtual multi-device mesh")
+def test_dryrun_multichip_parity(monkeypatch):
+    monkeypatch.setenv("MULTICHIP_EVALS", "8")
+    monkeypatch.setenv("MULTICHIP_PLACE", "32")
+    monkeypatch.setenv("MULTICHIP_NODES", "1024")
+    import __graft_entry__ as graft
+    graft.dryrun_multichip(jax.device_count())
