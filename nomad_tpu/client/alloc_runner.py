@@ -31,7 +31,7 @@ class AllocRunner:
                  on_update: Optional[Callable[["AllocRunner"], None]] = None,
                  identity_signer=None, secrets_fetcher=None,
                  device_manager=None, csi_manager=None,
-                 csi_volume_info=None):
+                 csi_volume_info=None, network_manager=None):
         self.alloc = alloc
         self.drivers = drivers
         self.node = node
@@ -41,6 +41,9 @@ class AllocRunner:
         self.device_manager = device_manager
         self.csi_manager = csi_manager
         self.csi_volume_info = csi_volume_info
+        self.network_manager = network_manager
+        self._network = None
+        self.alloc_network = None
         self.csi_paths: Dict[str, str] = {}
         self._csi_attached: List[tuple] = []
         self._restored = False
@@ -84,6 +87,15 @@ class AllocRunner:
             self._done.set()
             self._notify()
             return
+        try:
+            self._setup_network(tg)     # network hook (bridge mode)
+        except Exception as e:  # noqa: BLE001 -- netns/veth failures
+            self._set_status(ALLOC_CLIENT_FAILED, f"network: {e}")
+            self._detach_csi_volumes(tg_hint=tg)
+            self._teardown_network()
+            self._done.set()
+            self._notify()
+            return
 
         prestart = [t for t in tg.tasks if t.lifecycle
                     and t.lifecycle.get("hook") == "prestart"
@@ -117,6 +129,7 @@ class AllocRunner:
                 self._set_status(ALLOC_CLIENT_FAILED,
                                  f"prestart task {task.name} failed")
                 self._detach_csi_volumes(tg_hint=tg)
+                self._teardown_network()
                 self._done.set()
                 self._notify()
                 return
@@ -124,6 +137,7 @@ class AllocRunner:
             # stopped/destroyed during prestart: don't launch main tasks
             self._finalize_status(stopped=True)
             self._detach_csi_volumes(tg_hint=tg)
+            self._teardown_network()
             self._done.set()
             self._notify()
             return
@@ -167,6 +181,7 @@ class AllocRunner:
                 tr.wait()
         self._finalize_status()
         self._detach_csi_volumes()
+        self._teardown_network()
         self._done.set()
         self._notify()
 
@@ -181,6 +196,7 @@ class AllocRunner:
         # point (paths are filesystem-deterministic, so this works even
         # when the attach happened before an agent restart)
         self._detach_csi_volumes(tg_hint=None)
+        self._teardown_network()
         self.alloc_dir.destroy()
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -206,6 +222,13 @@ class AllocRunner:
               if self.alloc.job else None)
         if tg is None:
             return False
+        try:
+            # re-adopt the bridge netns (manager.create adopts an
+            # existing namespace) so mapped ports come back up and the
+            # terminal teardown can actually delete it
+            self._setup_network(tg)
+        except Exception:   # noqa: BLE001 -- degraded restore beats none
+            pass
         any_live = False
         for task in tg.tasks:
             st = task_states.get(task.name)
@@ -236,6 +259,44 @@ class AllocRunner:
             self._done.set()
             self._notify()
         return any_live
+
+    # -- bridge networking (reference: allocrunner/network_hook.go +
+    #    networking_bridge_linux.go; redesign: client/netns.py) ---------
+    def _setup_network(self, tg) -> None:
+        """Create the alloc's network namespace when the group asks for
+        bridge mode and this host supports it; tasks then launch inside
+        it (drivers read alloc_dir.netns). Without support the alloc
+        falls back to host networking, matching the dev-agent contract.
+        """
+        if self.network_manager is None or not tg.networks:
+            return
+        mode = getattr(tg.networks[0], "mode", "host") or "host"
+        if mode != "bridge" and not mode.startswith("cni/"):
+            return
+        # network_manager is a FACTORY (Client._get_network_manager):
+        # the capability probe only runs for bridge-mode groups
+        manager = (self.network_manager() if callable(self.network_manager)
+                   else self.network_manager)
+        if manager is None:
+            return
+        self._network = manager
+        ports = (self.alloc.allocated_resources.shared.ports
+                 if self.alloc.allocated_resources is not None else [])
+        net = manager.create(self.alloc.id, ports)
+        self.alloc_network = net
+        # drivers + taskenv read these off the shared alloc dir
+        self.alloc_dir.netns = net.netns
+        self.alloc_dir.alloc_ip = net.ip
+        self.alloc_dir.gateway_ip = net.gateway
+
+    def _teardown_network(self) -> None:
+        if self.alloc_network is None or self._network is None:
+            return
+        try:
+            self._network.destroy(self.alloc.id)
+        except Exception:   # noqa: BLE001 -- best-effort teardown
+            pass
+        self.alloc_network = None
 
     # -- CSI volumes (reference: allocrunner/csi_hook.go: attach ONCE
     #    per alloc before tasks start, detach after they all stop) -----
@@ -311,6 +372,7 @@ class AllocRunner:
             time.sleep(0.05)
         self._finalize_status()
         self._detach_csi_volumes()
+        self._teardown_network()
         self._done.set()
         self._notify()
 

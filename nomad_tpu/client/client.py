@@ -117,6 +117,11 @@ class Client:
                  api_addr: str = "", serve_http: bool = False):
         self.conn = conn
         self.data_dir = data_dir
+        # bridge networking (client/netns.py): the AllocRunner invokes
+        # this factory only for bridge-mode groups, so host-network-only
+        # clients never pay the netns capability probe
+        self._network_manager = None
+        self._network_lock = threading.Lock()
         self.drivers = drivers or DriverRegistry()
         # device plugins feed node devices (reference: devicemanager)
         self.device_manager = None
@@ -196,6 +201,14 @@ class Client:
             t.start()
             self._threads.append(t)
 
+    def _get_network_manager(self):
+        from .netns import bridge_caps, shared_manager
+        with self._network_lock:
+            if self._network_manager is None and bridge_caps():
+                # process-global: the bridge subnet is host-global state
+                self._network_manager = shared_manager()
+            return self._network_manager
+
     def shutdown(self) -> None:
         self._shutdown.set()
         if self.http is not None:
@@ -207,6 +220,14 @@ class Client:
         # plugin subprocesses must not outlive the client
         if self.device_manager is not None:
             self.device_manager.shutdown()
+        if self._network_manager is not None:
+            with self._runner_lock:
+                ids = list(self.runners)
+            for alloc_id in ids:
+                try:
+                    self._network_manager.destroy(alloc_id)
+                except Exception:   # noqa: BLE001 -- best-effort
+                    pass
         if self.csi_manager is not None:
             self.csi_manager.shutdown()
         self.drivers.shutdown()
@@ -233,7 +254,8 @@ class Client:
                 secrets_fetcher=self.secrets_fetcher,
                 device_manager=self.device_manager,
                 csi_manager=self.csi_manager,
-                csi_volume_info=self.conn.csi_volume)
+                csi_volume_info=self.conn.csi_volume,
+                network_manager=self._get_network_manager)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             states = {name: st for name, (st, _h) in tasks.items()}
@@ -585,7 +607,8 @@ class Client:
                 secrets_fetcher=self.secrets_fetcher,
                 device_manager=self.device_manager,
                 csi_manager=self.csi_manager,
-                csi_volume_info=self.conn.csi_volume)
+                csi_volume_info=self.conn.csi_volume,
+                network_manager=self._get_network_manager)
             with self._runner_lock:
                 self.runners[alloc_id] = runner
             self.state_db.put_alloc(alloc_id, a.modify_index)

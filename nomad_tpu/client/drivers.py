@@ -278,11 +278,19 @@ class RawExecDriver(Driver):
             raise DriverError("raw_exec requires config.command")
         args = [interpolate(str(a), None, None, env)
                 for a in cfg.get("args", [])]
+        argv = [command] + args
+        # bridge-mode allocs: enter the alloc's network namespace
+        # (reference: the CNI netns the docker/exec drivers join;
+        # redesign: client/netns.py)
+        netns = (getattr(task_dir.alloc, "netns", None)
+                 if task_dir is not None else None)
+        if netns:
+            argv = ["ip", "netns", "exec", netns] + argv
         stdout = open(task_dir.stdout_path(), "ab") if task_dir else None
         stderr = open(task_dir.stderr_path(), "ab") if task_dir else None
         try:
             proc = subprocess.Popen(
-                [command] + args,
+                argv,
                 env={**os.environ, **env},
                 cwd=task_dir.local_dir if task_dir else None,
                 stdout=stdout or subprocess.DEVNULL,
@@ -411,7 +419,8 @@ class ExecDriver(RawExecDriver):
                 stdout_path=task_dir.stdout_path(),
                 stderr_path=task_dir.stderr_path(),
                 cpu_shares=cpu_shares, memory_mb=memory_mb,
-                binds=binds, workdir=workdir)
+                binds=binds, workdir=workdir,
+                netns=getattr(task_dir.alloc, "netns", None))
         except OSError as e:
             raise DriverError(f"failed to start isolated task: {e}") from e
         state: Dict[str, object] = {"isolated": True}

@@ -135,7 +135,8 @@ def launch_isolated(task_id: str, argv: List[str], env: Dict[str, str],
                     cpu_shares: int = 0, memory_mb: int = 0,
                     binds: Optional[List[str]] = None,
                     workdir: str = "/local",
-                    cgroup_root: Optional[str] = None):
+                    cgroup_root: Optional[str] = None,
+                    netns: Optional[str] = None):
     """Start the payload under namespaces+chroot+cgroups. Returns
     (Popen of the unshare supervisor, Cgroup or None). The Popen's pid is
     the reattach handle; killing its process group kills the namespace
@@ -155,9 +156,14 @@ def launch_isolated(task_id: str, argv: List[str], env: Dict[str, str],
     stdout = open(stdout_path, "ab") if stdout_path else subprocess.DEVNULL
     stderr = open(stderr_path, "ab") if stderr_path else subprocess.DEVNULL
     try:
+        argv = ["unshare", "--mount", "--pid", "--fork", "--kill-child",
+                "/bin/sh", launcher]
+        if netns:
+            # join the alloc's bridge network namespace first; the
+            # mount/PID namespaces are still fresh per task
+            argv = ["ip", "netns", "exec", netns] + argv
         proc = subprocess.Popen(
-            ["unshare", "--mount", "--pid", "--fork", "--kill-child",
-             "/bin/sh", launcher],
+            argv,
             stdout=stdout, stderr=stderr, start_new_session=True,
             env={"PATH": "/usr/sbin:/usr/bin:/sbin:/bin"})
     except OSError:

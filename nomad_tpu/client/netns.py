@@ -1,0 +1,341 @@
+"""Bridge networking data plane: per-alloc network namespaces.
+
+Reference analog: client/allocrunner/networking_bridge_linux.go:1 (the
+``nomad`` bridge + veth pair per alloc + CNI-installed iptables port
+maps) and networking_cni.go:1. The redesign here keeps the same shape --
+one shared Linux bridge, one netns per bridge-mode allocation, a veth
+pair joining them -- but maps ports through supervised USERSPACE
+forwarders instead of iptables DNAT rules: this image (and many minimal
+hosts) has no iptables/nft, the repo already runs its service mesh
+through stdlib TCP relays (client/connect_proxy.py), and a crashed
+forwarder is visible/restartable where orphaned DNAT rules silently
+blackhole. The trade is a copy per byte on mapped ports; intra-bridge
+traffic (alloc->alloc via the bridge) stays in-kernel.
+
+Degrades cleanly like the executor: ``bridge_caps()`` probes root + the
+iproute2 binary + a live netns round trip once per process; without
+support, bridge-mode allocs fall back to host networking (the same
+contract the scheduler's feasibility check allows for dev agents).
+"""
+from __future__ import annotations
+
+import ipaddress
+import os
+import shutil
+import socket
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+DEFAULT_BRIDGE = "nomadtpu0"
+# same default subnet as the reference's bridge config
+# (networking_bridge_linux.go defaultNomadAllocSubnet)
+DEFAULT_SUBNET = "172.26.64.0/20"
+
+_caps_lock = threading.Lock()
+_caps: Optional[bool] = None
+
+
+def bridge_caps() -> bool:
+    """True when this host can create bridges + network namespaces
+    (cached). Requires root and iproute2."""
+    global _caps
+    with _caps_lock:
+        if _caps is not None:
+            return _caps
+        ok = False
+        if os.geteuid() == 0 and shutil.which("ip"):
+            probe = "nomadtpu-caps-probe"
+            try:
+                rc = subprocess.run(["ip", "netns", "add", probe],
+                                    capture_output=True, timeout=10
+                                    ).returncode
+                if rc == 0:
+                    subprocess.run(["ip", "netns", "del", probe],
+                                   capture_output=True, timeout=10)
+                    ok = True
+            except (subprocess.SubprocessError, OSError):
+                ok = False
+        _caps = ok
+        return ok
+
+
+def _reset_caps_for_tests() -> None:
+    global _caps
+    with _caps_lock:
+        _caps = None
+
+
+def _ip(*args: str, netns: Optional[str] = None) -> None:
+    cmd = ["ip"]
+    if netns:
+        cmd += ["-n", netns]
+    cmd += list(args)
+    res = subprocess.run(cmd, capture_output=True, timeout=15)
+    if res.returncode != 0:
+        raise OSError(
+            f"{' '.join(cmd)!r} failed: {res.stderr.decode().strip()}")
+
+
+class PortForwarder:
+    """One mapped port: accepts on the HOST address and pumps bytes to
+    the alloc's in-namespace ip:port (the userspace stand-in for the
+    reference's CNI portmap DNAT rule)."""
+
+    def __init__(self, host_ip: str, host_port: int,
+                 dest_ip: str, dest_port: int):
+        self.dest = (dest_ip, dest_port)
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((host_ip or "0.0.0.0", host_port))
+        self.listener.listen(64)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True,
+            name=f"portmap-{host_port}->{dest_port}")
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            out = socket.create_connection(self.dest, timeout=10)
+        except OSError:
+            conn.close()
+            return
+
+        def pump(a, b):
+            try:
+                while True:
+                    data = a.recv(65536)
+                    if not data:
+                        break
+                    b.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (a, b):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        threading.Thread(target=pump, args=(conn, out), daemon=True).start()
+        threading.Thread(target=pump, args=(out, conn), daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # shutdown BEFORE close: a blocked accept() holds the socket's
+        # io refcount, so close() alone defers the real fd close and the
+        # LISTEN socket (and its port) would leak until process exit
+        try:
+            self.listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+@dataclass
+class AllocNetwork:
+    alloc_id: str
+    netns: str
+    ip: str
+    gateway: str
+    forwarders: List[PortForwarder] = field(default_factory=list)
+
+
+_shared_manager: Optional["BridgeNetworkManager"] = None
+_shared_lock = threading.Lock()
+
+
+def shared_manager() -> "BridgeNetworkManager":
+    """Process-global manager: the bridge and its subnet are host-global
+    resources, so per-Client managers would hand out duplicate alloc IPs
+    (multi-client test topologies share one bridge). Cross-PROCESS
+    agents on one host still race the subnet; netns adoption (create()
+    on an existing namespace) covers the restart case."""
+    global _shared_manager
+    with _shared_lock:
+        if _shared_manager is None:
+            _shared_manager = BridgeNetworkManager()
+        return _shared_manager
+
+
+class BridgeNetworkManager:
+    """Owns the shared bridge and the per-alloc namespaces
+    (reference: networking_bridge_linux.go bridgeNetworkConfigurator)."""
+
+    def __init__(self, bridge: str = DEFAULT_BRIDGE,
+                 subnet: str = DEFAULT_SUBNET):
+        self.bridge = bridge
+        self.net = ipaddress.ip_network(subnet)
+        hosts = self.net.hosts()
+        self.gateway = str(next(hosts))
+        self._bridge_up = False
+        self._lock = threading.Lock()
+        self._by_alloc: Dict[str, AllocNetwork] = {}
+        self._used_ips = {self.gateway}
+
+    # ------------------------------------------------------------------
+    def ensure_bridge(self) -> None:
+        with self._lock:
+            if self._bridge_up:
+                return
+            if not os.path.isdir(f"/sys/class/net/{self.bridge}"):
+                _ip("link", "add", self.bridge, "type", "bridge")
+            prefix = self.net.prefixlen
+            try:
+                _ip("addr", "add", f"{self.gateway}/{prefix}",
+                    "dev", self.bridge)
+            except OSError as e:
+                # idempotent re-ensure: the bridge (and its address)
+                # survives agent restarts; iproute2 wording varies
+                msg = str(e)
+                if ("File exists" not in msg
+                        and "already assigned" not in msg.lower()):
+                    raise
+            _ip("link", "set", self.bridge, "up")
+            self._bridge_up = True
+
+    def _next_ip(self) -> str:
+        for host in self.net.hosts():
+            ip = str(host)
+            if ip not in self._used_ips:
+                self._used_ips.add(ip)
+                return ip
+        raise OSError(f"bridge subnet {self.net} exhausted")
+
+    # ------------------------------------------------------------------
+    def _adopt_ip(self, ns: str, veth_ns: str) -> Optional[str]:
+        """The address a pre-existing namespace (a prior agent run's, for
+        the restore path) already holds on its veth, if any."""
+        try:
+            res = subprocess.run(
+                ["ip", "-n", ns, "-4", "-o", "addr", "show", veth_ns],
+                capture_output=True, timeout=15)
+        except (subprocess.SubprocessError, OSError):
+            return None
+        for tok in res.stdout.decode().split():
+            if "/" in tok:
+                ip = tok.split("/")[0]
+                try:
+                    if ipaddress.ip_address(ip) in self.net:
+                        return ip
+                except ValueError:
+                    continue
+        return None
+
+    def create(self, alloc_id: str, port_mappings=()) -> AllocNetwork:
+        """netns + veth pair + address + routes + port forwarders
+        (reference: the CNI bridge plugin chain the reference invokes).
+        An already-existing namespace for this alloc (agent restart) is
+        ADOPTED: its address is re-read and the forwarders rebuilt."""
+        self.ensure_bridge()
+        short = alloc_id[:8]
+        ns = f"nt-{short}"
+        veth_host = f"vh-{short}"
+        veth_ns = f"vn-{short}"
+        with self._lock:
+            existing = self._by_alloc.get(alloc_id)
+        if existing is not None:
+            return existing
+        ip = None
+        created_ns = False
+        if os.path.exists(f"/run/netns/{ns}"):
+            ip = self._adopt_ip(ns, veth_ns)
+            if ip is not None:
+                with self._lock:
+                    self._used_ips.add(ip)
+        if ip is None:
+            try:
+                _ip("netns", "add", ns)
+                created_ns = True
+                _ip("link", "add", veth_host, "type", "veth",
+                    "peer", "name", veth_ns)
+                _ip("link", "set", veth_ns, "netns", ns)
+                _ip("link", "set", veth_host, "master", self.bridge)
+                _ip("link", "set", veth_host, "up")
+                with self._lock:
+                    ip = self._next_ip()
+                prefix = self.net.prefixlen
+                _ip("addr", "add", f"{ip}/{prefix}", "dev", veth_ns,
+                    netns=ns)
+                _ip("link", "set", "lo", "up", netns=ns)
+                _ip("link", "set", veth_ns, "up", netns=ns)
+                _ip("route", "add", "default", "via", self.gateway,
+                    netns=ns)
+            except OSError:
+                # only unwind resources THIS call created: deleting a
+                # pre-existing nt-<short> (stale run or id-prefix
+                # collision) would rip the namespace out from under a
+                # live allocation
+                if created_ns:
+                    self._teardown(ns, ip)
+                elif ip is not None:
+                    with self._lock:
+                        self._used_ips.discard(ip)
+                raise
+        net = AllocNetwork(alloc_id=alloc_id, netns=ns, ip=ip,
+                           gateway=self.gateway)
+        for pm in port_mappings:
+            host_port = int(getattr(pm, "value", 0) or 0)
+            to = int(getattr(pm, "to", 0) or 0) or host_port
+            if host_port <= 0:
+                continue
+            try:
+                # listen on ALL host interfaces (the CNI portmap plugin's
+                # default): the advertised host_ip is the node's fingerprint
+                # address, but loopback clients on the node itself must
+                # reach mapped ports too
+                net.forwarders.append(PortForwarder(
+                    "0.0.0.0", host_port, ip, to))
+            except OSError:
+                for f in net.forwarders:
+                    f.stop()
+                self._teardown(ns, ip)
+                raise
+        with self._lock:
+            self._by_alloc[alloc_id] = net
+        return net
+
+    def destroy(self, alloc_id: str) -> None:
+        with self._lock:
+            net = self._by_alloc.pop(alloc_id, None)
+        if net is None:
+            return
+        for f in net.forwarders:
+            f.stop()
+        self._teardown(net.netns, net.ip)
+
+    def _teardown(self, ns: str, ip: Optional[str]) -> None:
+        try:
+            # deleting the netns destroys the veth pair with it
+            subprocess.run(["ip", "netns", "del", ns],
+                           capture_output=True, timeout=15)
+        except (subprocess.SubprocessError, OSError):
+            pass
+        if ip is not None:
+            with self._lock:
+                self._used_ips.discard(ip)
+
+    def get(self, alloc_id: str) -> Optional[AllocNetwork]:
+        with self._lock:
+            return self._by_alloc.get(alloc_id)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            ids = list(self._by_alloc)
+        for alloc_id in ids:
+            self.destroy(alloc_id)

@@ -33,6 +33,13 @@ def build_env(alloc: Allocation, task: Task, node: Optional[Node],
         env["NOMAD_ALLOC_DIR"] = task_dir.alloc.shared_dir
         env["NOMAD_TASK_DIR"] = task_dir.local_dir
         env["NOMAD_SECRETS_DIR"] = task_dir.secrets_dir
+        # bridge-mode allocs (client/netns.py): the task sees its netns
+        # address and the bridge gateway (the route back to the host)
+        alloc_ip = getattr(task_dir.alloc, "alloc_ip", None)
+        if alloc_ip:
+            env["NOMAD_ALLOC_IP"] = alloc_ip
+            env["NOMAD_HOST_GATEWAY"] = getattr(
+                task_dir.alloc, "gateway_ip", "")
     if task.resources is not None:
         env["NOMAD_CPU_LIMIT"] = str(task.resources.cpu)
         env["NOMAD_MEMORY_LIMIT"] = str(task.resources.memory_mb)
@@ -47,15 +54,33 @@ def build_env(alloc: Allocation, task: Task, node: Optional[Node],
                 env[f"NOMAD_IP_{label}"] = net.ip
                 env[f"NOMAD_ADDR_{label}"] = f"{net.ip}:{p.value}"
     if alloc.allocated_resources is not None:
+        alloc_ip = env.get("NOMAD_ALLOC_IP", "")
         for pm in alloc.allocated_resources.shared.ports:
             label = pm.label.upper().replace("-", "_")
-            env[f"NOMAD_PORT_{label}"] = str(pm.value)
             env[f"NOMAD_HOST_PORT_{label}"] = str(pm.value)
-            env[f"NOMAD_IP_{label}"] = pm.host_ip
-            env[f"NOMAD_ADDR_{label}"] = f"{pm.host_ip}:{pm.value}"
+            if alloc_ip:
+                # bridge mode (reference: env.go setPortMapEnvs): the
+                # task binds the MAPPED port inside its namespace; the
+                # host port lives on the forwarder
+                to = pm.to or pm.value
+                env[f"NOMAD_PORT_{label}"] = str(to)
+                env[f"NOMAD_IP_{label}"] = alloc_ip
+                env[f"NOMAD_ADDR_{label}"] = f"{alloc_ip}:{to}"
+            else:
+                env[f"NOMAD_PORT_{label}"] = str(pm.value)
+                env[f"NOMAD_IP_{label}"] = pm.host_ip
+                env[f"NOMAD_ADDR_{label}"] = f"{pm.host_ip}:{pm.value}"
     # user-specified env wins, after interpolation
     for k, v in (task.env or {}).items():
         env[k] = interpolate(str(v), alloc, node, env)
+    # inside a netns, loopback no longer reaches the host: rewrite the
+    # connect sidecar's server address onto the bridge gateway
+    gw = env.get("NOMAD_HOST_GATEWAY", "")
+    if gw and "NOMAD_CONNECT_HTTP_ADDR" in env:
+        env["NOMAD_CONNECT_HTTP_ADDR"] = (
+            env["NOMAD_CONNECT_HTTP_ADDR"]
+            .replace("//127.0.0.1", f"//{gw}")
+            .replace("//localhost", f"//{gw}"))
     return env
 
 
