@@ -254,8 +254,12 @@ class AllocRunner:
             self._thread.start()
         else:
             # nothing recovered: the alloc terminated while we were down --
-            # the server must hear about it or it will never reschedule
+            # the server must hear about it or it will never reschedule.
+            # The network re-adopted above must come down with it or its
+            # forwarders keep the alloc's host ports bound against the
+            # replacement allocation
             self._finalize_status()
+            self._teardown_network()
             self._done.set()
             self._notify()
         return any_live

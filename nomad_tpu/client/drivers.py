@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
+import tarfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -488,8 +489,11 @@ class ExecDriver(RawExecDriver):
         # in-sandbox `timeout` kills the command itself: subprocess.run's
         # timeout only kills nsenter, orphaning the forked child inside
         # the task's pid namespace
-        full = (["nsenter", "-t", str(target), "-m", "-p", "-r", "-w",
-                 "--", "timeout", f"{timeout:.1f}"] + list(cmd))
+        # -n joins the task's network namespace too: for bridge-mode
+        # allocs an exec'd probe must see the ports the task bound
+        # inside its netns, not the host's
+        full = (["nsenter", "-t", str(target), "-m", "-p", "-n", "-r",
+                 "-w", "--", "timeout", f"{timeout:.1f}"] + list(cmd))
         return _run_captured(full, env, None, timeout + 2.0)
 
     def wait_task(self, handle: TaskHandle,
@@ -578,13 +582,22 @@ class ContainerDriver(ExecDriver):
             raise DriverError("container driver requires a task dir")
         cfg = task.config or {}
         image = str(cfg.get("image", ""))
+        if not image:
+            raise DriverError("container requires config.image")
+        rootfs, img_cfg = self._materialize_rootfs(image, task_dir)
         command = str(cfg.get("command", ""))
-        if not image or not command:
-            raise DriverError("container requires config.image and "
-                              "config.command")
-        rootfs = self._materialize_rootfs(image, task_dir)
         args = [interpolate(str(a), None, None, env)
                 for a in cfg.get("args", [])]
+        argv = img_cfg.argv(command, args)
+        if not argv:
+            raise DriverError(
+                "container has no command: set config.command or use an "
+                "image with an Entrypoint/Cmd")
+        # image env underlays the task env (docker semantics)
+        merged_env = dict(env)
+        for kv in img_cfg.env:
+            k, _, v = kv.partition("=")
+            merged_env.setdefault(k, v)
         binds = [] if not cfg.get("host_binds") \
             else [str(b) for b in cfg["host_binds"]]
         # sandbox dirs appear at the nomad-standard mount points
@@ -593,40 +606,55 @@ class ContainerDriver(ExecDriver):
                             (task_dir.alloc.shared_dir, "/alloc")):
             binds.append(f"{sub}:{target}")
         binds.extend(getattr(task_dir, "extra_binds", []) or [])
+        workdir = (str(cfg.get("work_dir", ""))
+                   or img_cfg.working_dir or "/")
         return self._start_isolated(
-            task_id, [command] + args, env, task_dir,
-            root=rootfs, workdir="/",
+            task_id, argv, merged_env, task_dir,
+            root=rootfs, workdir=workdir,
             cpu_shares=task.resources.cpu,
             memory_mb=task.resources.memory_mb, binds=binds)
 
     @staticmethod
-    def _materialize_rootfs(image: str, task_dir) -> str:
-        """Copy/unpack the image into the task sandbox so container
-        writes never mutate the shared image (reference: docker's
-        per-container layer)."""
-        import tarfile
+    def _materialize_rootfs(image: str, task_dir):
+        """Flatten the image (OCI layout / docker-archive / plain rootfs
+        dir or tar, client/oci.py) into the task sandbox so container
+        writes never mutate the shared artifact (reference: docker's
+        per-container layer). Returns (rootfs path, ImageConfig)."""
+        import json as _json
+
+        from . import oci
 
         rootfs = os.path.join(task_dir.dir, "rootfs")
+        cfg_path = os.path.join(task_dir.dir, "rootfs.config.json")
         if os.path.isdir(rootfs):
-            return rootfs           # restart: reuse the materialized fs
+            # restart: reuse the materialized fs + its recorded config
+            img_cfg = oci.ImageConfig()
+            try:
+                img_cfg = oci.ImageConfig(**_json.load(open(cfg_path)))
+            except (OSError, ValueError, TypeError):
+                pass
+            return rootfs, img_cfg
         # materialize into a scratch dir and rename into place so a crash
         # mid-copy can never leave a half-built rootfs that a restart
         # would silently trust
         partial = rootfs + ".partial"
         import shutil
         shutil.rmtree(partial, ignore_errors=True)
-        if os.path.isdir(image):
-            shutil.copytree(image, partial, symlinks=True)
-        elif os.path.isfile(image) and (
-                image.endswith(".tar") or image.endswith(".tar.gz")
-                or image.endswith(".tgz")):
-            os.makedirs(partial, exist_ok=True)
-            with tarfile.open(image) as tf:
-                tf.extractall(partial, filter="tar")
-        else:
-            raise DriverError(f"container image not found: {image}")
+        try:
+            img_cfg = oci.materialize(image, partial, task_dir.tmp_dir)
+        except oci.ImageError as e:
+            raise DriverError(str(e)) from e
+        except (OSError, ValueError, tarfile.TarError) as e:
+            # corrupt/truncated artifacts must FAIL the task, not kill
+            # the runner thread (it catches DriverError only)
+            raise DriverError(f"bad container image {image!r}: {e}") from e
+        with open(cfg_path, "w") as f:
+            _json.dump({"env": img_cfg.env,
+                        "entrypoint": img_cfg.entrypoint,
+                        "cmd": img_cfg.cmd,
+                        "working_dir": img_cfg.working_dir}, f)
         os.rename(partial, rootfs)
-        return rootfs
+        return rootfs, img_cfg
 
 
 def _pid_alive(pid: int) -> bool:

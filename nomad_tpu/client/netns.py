@@ -113,20 +113,18 @@ class PortForwarder:
             return
 
         def pump(a, b):
+            # EOF half-closes the destination so the reverse direction
+            # keeps flowing (request/response over half-close works) --
+            # same contract as connect_proxy._pump
             try:
                 while True:
                     data = a.recv(65536)
                     if not data:
                         break
                     b.sendall(data)
+                b.shutdown(socket.SHUT_WR)
             except OSError:
                 pass
-            finally:
-                for s in (a, b):
-                    try:
-                        s.shutdown(socket.SHUT_RDWR)
-                    except OSError:
-                        pass
 
         threading.Thread(target=pump, args=(conn, out), daemon=True).start()
         threading.Thread(target=pump, args=(out, conn), daemon=True).start()
@@ -187,6 +185,20 @@ class BridgeNetworkManager:
         self._lock = threading.Lock()
         self._by_alloc: Dict[str, AllocNetwork] = {}
         self._used_ips = {self.gateway}
+        # pre-existing nt-* namespaces (an earlier agent run, possibly
+        # crashed) still hold addresses on this bridge's subnet: register
+        # them so _next_ip never hands out a duplicate. The namespaces
+        # themselves are left alone -- their allocs may be adopted by
+        # restore(), and deleting another agent's netns is not ours to do
+        try:
+            for ns in os.listdir("/run/netns"):
+                if not ns.startswith("nt-"):
+                    continue
+                ip = self._adopt_ip(ns, f"vn-{ns[3:]}")
+                if ip is not None:
+                    self._used_ips.add(ip)
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------
     def ensure_bridge(self) -> None:
@@ -304,7 +316,13 @@ class BridgeNetworkManager:
             except OSError:
                 for f in net.forwarders:
                     f.stop()
-                self._teardown(ns, ip)
+                # an ADOPTED namespace (agent restart, task still live)
+                # must survive a forwarder bind failure
+                if created_ns:
+                    self._teardown(ns, ip)
+                elif ip is not None:
+                    with self._lock:
+                        self._used_ips.discard(ip)
                 raise
         with self._lock:
             self._by_alloc[alloc_id] = net

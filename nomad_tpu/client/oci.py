@@ -1,0 +1,280 @@
+"""OCI / docker image handling for the container driver.
+
+Reference analog: drivers/docker/driver.go:1 (image pull + container
+create) and docklog/docklog.go:1 (the log pipeline). The redesign keeps
+the driver daemonless: images arrive as artifacts -- an OCI image-layout
+directory (`oci-layout` + `index.json` + `blobs/`), a docker-archive tar
+(`docker save` output), or a plain rootfs dir/tar -- and are flattened
+into a per-task rootfs by applying layers in order with OCI whiteout
+semantics. The image config's Env/Entrypoint/Cmd/WorkingDir participate
+in command assembly exactly like dockerd's. Logs need no separate
+pipeline: the payload's stdout/stderr are the task's log files already
+(the reference needs docklog because dockerd owns the stream).
+
+Registry pulls are deliberately OUT by default: this environment has no
+egress, and an image fetched at task start is a supply-chain liability
+the artifact path avoids. `registry://` image references raise unless
+NOMAD_TPU_IMAGE_PULL=1 opts in, and the pull itself (OCI distribution
+v2 GET manifest/blobs) is left to the operator's artifact stanza.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import shutil
+import tarfile
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+WHITEOUT_PREFIX = ".wh."
+WHITEOUT_OPAQUE = ".wh..wh..opq"
+
+
+@dataclass
+class ImageConfig:
+    """The runtime half of an OCI image config
+    (application/vnd.oci.image.config.v1+json)."""
+
+    env: List[str] = field(default_factory=list)
+    entrypoint: List[str] = field(default_factory=list)
+    cmd: List[str] = field(default_factory=list)
+    working_dir: str = ""
+
+    def argv(self, task_command: str, task_args: List[str]) -> List[str]:
+        """Docker's command assembly: a task command REPLACES Cmd (and
+        clears Entrypoint only when the task says so via command);
+        otherwise Entrypoint + Cmd run."""
+        if task_command:
+            return [task_command] + list(task_args)
+        argv = list(self.entrypoint) + list(self.cmd)
+        if task_args:
+            # args without command: docker semantics replace Cmd
+            argv = list(self.entrypoint) + list(task_args)
+        return argv
+
+
+class ImageError(Exception):
+    pass
+
+
+def detect_format(image: str) -> str:
+    """'oci-layout' | 'docker-archive' | 'rootfs-dir' | 'rootfs-tar'
+    | 'registry'."""
+    if image.startswith("registry://") or image.startswith("docker://"):
+        return "registry"
+    if os.path.isdir(image):
+        if os.path.exists(os.path.join(image, "oci-layout")):
+            return "oci-layout"
+        return "rootfs-dir"
+    if os.path.isfile(image):
+        if _tar_has_member(image, "manifest.json"):
+            return "docker-archive"
+        if _tar_has_member(image, "oci-layout"):
+            return "oci-layout-tar"
+        return "rootfs-tar"
+    raise ImageError(f"container image not found: {image}")
+
+
+def _tar_has_member(path: str, name: str) -> bool:
+    try:
+        with tarfile.open(path) as tf:
+            try:
+                tf.getmember(name)
+                return True
+            except KeyError:
+                return False
+    except (tarfile.TarError, OSError):
+        return False
+
+
+def _safe_join(root: str, name: str) -> str:
+    """Containment check that also RESOLVES symlinks: a lower layer can
+    plant `evil -> /etc` and a later layer reference `evil/...` -- the
+    name itself stays inside the rootfs while the real path escapes, so
+    lexical normpath alone would let a tampered artifact delete or write
+    host files (whiteout markers follow the resolved path)."""
+    dest = os.path.normpath(os.path.join(root, name.lstrip("/")))
+    realroot = os.path.realpath(root)
+    if not dest.startswith(os.path.normpath(root) + os.sep) \
+            and dest != os.path.normpath(root):
+        raise ImageError(f"layer member escapes rootfs: {name!r}")
+    real_parent = os.path.realpath(os.path.dirname(dest))
+    if real_parent != realroot \
+            and not real_parent.startswith(realroot + os.sep):
+        raise ImageError(
+            f"layer member traverses a symlink out of the rootfs: "
+            f"{name!r}")
+    return dest
+
+
+def apply_layer(rootfs: str, layer_tar) -> None:
+    """Extract one layer onto rootfs with OCI whiteout handling
+    (image-spec layer.md): `.wh.<name>` deletes <name> from lower
+    layers; `.wh..wh..opq` makes the directory opaque (drops all lower
+    content)."""
+    members = layer_tar.getmembers()
+    regular = []
+    for m in members:
+        base = os.path.basename(m.name)
+        parent = os.path.dirname(m.name)
+        if base == WHITEOUT_OPAQUE:
+            target = _safe_join(rootfs, parent)
+            # the opaque TARGET itself may be a planted symlink: resolve
+            # it before emptying the directory it points at
+            realroot = os.path.realpath(rootfs)
+            real_target = os.path.realpath(target)
+            if real_target != realroot \
+                    and not real_target.startswith(realroot + os.sep):
+                raise ImageError(
+                    f"opaque whiteout traverses a symlink out of the "
+                    f"rootfs: {m.name!r}")
+            if os.path.isdir(target):
+                for entry in os.listdir(target):
+                    full = os.path.join(target, entry)
+                    (shutil.rmtree if os.path.isdir(full)
+                     and not os.path.islink(full) else os.remove)(full)
+            continue
+        if base.startswith(WHITEOUT_PREFIX):
+            victim = _safe_join(
+                rootfs, os.path.join(parent, base[len(WHITEOUT_PREFIX):]))
+            if os.path.isdir(victim) and not os.path.islink(victim):
+                shutil.rmtree(victim, ignore_errors=True)
+            elif os.path.lexists(victim):
+                os.remove(victim)
+            continue
+        regular.append(m)
+    # extract one member at a time, re-validating the resolved path
+    # right before each write: a single layer can plant a symlink and
+    # then name members THROUGH it, which a pre-pass over the whole
+    # member list cannot see (the symlink isn't on disk yet)
+    for m in regular:
+        dest = _safe_join(rootfs, m.name)
+        # type changes between layers displace the lower entry: a file
+        # over a directory removes the tree, a directory over a file
+        # removes the file
+        if os.path.lexists(dest):
+            lower_is_dir = (os.path.isdir(dest)
+                            and not os.path.islink(dest))
+            if not m.isdir() and lower_is_dir:
+                shutil.rmtree(dest, ignore_errors=True)
+            elif not m.isdir():
+                os.remove(dest)
+            elif m.isdir() and not lower_is_dir:
+                os.remove(dest)
+        layer_tar.extract(m, rootfs, filter="tar")
+
+
+def _open_layer(path: str):
+    """tarfile over a possibly-gzipped layer blob."""
+    f = open(path, "rb")
+    magic = f.read(2)
+    f.seek(0)
+    if magic == b"\x1f\x8b":
+        return tarfile.open(fileobj=gzip.GzipFile(fileobj=f))  # noqa: SIM115
+    return tarfile.open(fileobj=f)
+
+
+def _parse_config_blob(raw: bytes) -> ImageConfig:
+    doc = json.loads(raw or b"{}")
+    cfg = doc.get("config") or {}
+    return ImageConfig(
+        env=list(cfg.get("Env") or []),
+        entrypoint=list(cfg.get("Entrypoint") or []),
+        cmd=list(cfg.get("Cmd") or []),
+        working_dir=str(cfg.get("WorkingDir") or ""))
+
+
+def unpack_oci_layout(layout_dir: str, rootfs: str) -> ImageConfig:
+    """Flatten an OCI image-layout directory into rootfs."""
+    try:
+        index = json.load(open(os.path.join(layout_dir, "index.json")))
+    except (OSError, ValueError) as e:
+        raise ImageError(f"bad OCI layout: {e}") from e
+
+    def blob(digest: str) -> str:
+        algo, _, hexd = digest.partition(":")
+        path = os.path.join(layout_dir, "blobs", algo, hexd)
+        if not os.path.isfile(path):
+            raise ImageError(f"missing blob {digest}")
+        return path
+
+    manifests = index.get("manifests") or []
+    if not manifests:
+        raise ImageError("OCI index has no manifests")
+    manifest = json.load(open(blob(manifests[0]["digest"])))
+    if "manifests" in manifest:         # nested index (multi-platform)
+        manifest = json.load(open(blob(manifest["manifests"][0]["digest"])))
+    config = ImageConfig()
+    if manifest.get("config", {}).get("digest"):
+        config = _parse_config_blob(
+            open(blob(manifest["config"]["digest"]), "rb").read())
+    os.makedirs(rootfs, exist_ok=True)
+    for layer in manifest.get("layers") or []:
+        with _open_layer(blob(layer["digest"])) as tf:
+            apply_layer(rootfs, tf)
+    return config
+
+
+def unpack_docker_archive(archive: str, rootfs: str,
+                          scratch: str) -> ImageConfig:
+    """Flatten a `docker save` tar into rootfs."""
+    extract = os.path.join(scratch, "docker-archive")
+    shutil.rmtree(extract, ignore_errors=True)
+    os.makedirs(extract)
+    with tarfile.open(archive) as tf:
+        tf.extractall(extract, filter="tar")
+    try:
+        manifest = json.load(open(os.path.join(extract, "manifest.json")))
+    except (OSError, ValueError) as e:
+        raise ImageError(f"bad docker archive: {e}") from e
+    if not manifest:
+        raise ImageError("docker archive manifest is empty")
+    entry = manifest[0]
+    config = ImageConfig()
+    cfg_name = entry.get("Config")
+    if cfg_name and os.path.isfile(os.path.join(extract, cfg_name)):
+        config = _parse_config_blob(
+            open(os.path.join(extract, cfg_name), "rb").read())
+    os.makedirs(rootfs, exist_ok=True)
+    for layer_name in entry.get("Layers") or []:
+        with _open_layer(os.path.join(extract, layer_name)) as tf:
+            apply_layer(rootfs, tf)
+    shutil.rmtree(extract, ignore_errors=True)
+    return config
+
+
+def materialize(image: str, rootfs: str, scratch: str) -> ImageConfig:
+    """Flatten any supported image reference into ``rootfs`` (which must
+    not exist yet); returns the image's runtime config."""
+    fmt = detect_format(image)
+    if fmt == "registry":
+        if os.environ.get("NOMAD_TPU_IMAGE_PULL", "") != "1":
+            raise ImageError(
+                "registry pulls are disabled (set NOMAD_TPU_IMAGE_PULL=1 "
+                "and provide egress); ship the image as an OCI layout or "
+                "docker-archive artifact instead")
+        raise ImageError("registry transport not available in this build")
+    if fmt == "rootfs-dir":
+        shutil.copytree(image, rootfs, symlinks=True)
+        return ImageConfig()
+    if fmt == "rootfs-tar":
+        os.makedirs(rootfs, exist_ok=True)
+        with tarfile.open(image) as tf:
+            tf.extractall(rootfs, filter="tar")
+        return ImageConfig()
+    if fmt == "oci-layout":
+        return unpack_oci_layout(image, rootfs)
+    if fmt == "oci-layout-tar":
+        extract = os.path.join(scratch, "oci-layout")
+        shutil.rmtree(extract, ignore_errors=True)
+        os.makedirs(extract)
+        with tarfile.open(image) as tf:
+            tf.extractall(extract, filter="tar")
+        try:
+            return unpack_oci_layout(extract, rootfs)
+        finally:
+            shutil.rmtree(extract, ignore_errors=True)
+    if fmt == "docker-archive":
+        return unpack_docker_archive(image, rootfs, scratch)
+    raise ImageError(f"unsupported image format {fmt!r}")
