@@ -79,6 +79,97 @@ def encode_command(method: str, args: Tuple[Any, ...]) -> dict:
             "a": [codec.encode(a) for a in args[:len(specs)]]}
 
 
+# ---------------------------------------------------------------------------
+# Plan normalization (reference: nomad/worker.go:666-669 SubmitPlan's
+# normalized requests + plan_normalization_test.go). Plans dominate the
+# raft log under load, and a naive encoding ships FULL Allocation structs
+# -- each embedding the entire Job -- for every stop, preemption and
+# placement. The FSM only reads a diff's worth of fields from
+# stops/preemptions (see StateStore.upsert_plan_results), and every
+# placement in a plan shares its job, so the normalized form carries:
+#   - stop/preemption STUBS (id + the status fields the apply reads),
+#   - placements with the embedded job STRIPPED,
+#   - each distinct job exactly once, reattached at apply time.
+
+_STOP_STUB_FIELDS = ("id", "namespace", "job_id", "task_group", "node_id",
+                     "desired_status", "desired_description",
+                     "client_status", "followup_eval_id",
+                     "preempted_by_allocation")
+
+
+def _stub(alloc: Allocation) -> dict:
+    return {f: getattr(alloc, f) for f in _STOP_STUB_FIELDS}
+
+
+def encode_plan_results(result: PlanResult,
+                        eval_updates: Optional[List[Evaluation]]) -> dict:
+    """The normalized raft command for upsert_plan_results."""
+    jobs: Dict[str, Any] = {}
+
+    def strip(alloc: Allocation) -> dict:
+        raw = codec.encode(alloc)
+        job = alloc.job
+        if job is not None:
+            key = f"{alloc.namespace}\x00{alloc.job_id}\x00{job.version}"
+            if key not in jobs:
+                jobs[key] = codec.encode(job)
+            raw["job"] = None
+            raw["_jobkey"] = key
+        return raw
+
+    payload = {
+        "node_update": {nid: [_stub(a) for a in allocs]
+                        for nid, allocs in result.node_update.items()},
+        "node_preemptions": {
+            nid: [_stub(a) for a in allocs]
+            for nid, allocs in result.node_preemptions.items()},
+        "node_allocation": {
+            nid: [strip(a) for a in allocs]
+            for nid, allocs in result.node_allocation.items()},
+        "deployment": codec.encode(result.deployment),
+        "deployment_updates": [codec.encode(du)
+                               for du in result.deployment_updates],
+        "jobs": jobs,
+        "evals": ([codec.encode(e) for e in eval_updates]
+                  if eval_updates else None),
+    }
+    return {"m": "upsert_plan_results_norm", "a": [payload]}
+
+
+def decode_plan_results(payload: dict
+                        ) -> Tuple[PlanResult, Optional[List[Evaluation]]]:
+    from ..structs import Deployment, DeploymentStatusUpdate
+
+    jobs = {k: codec.decode(Job, v)
+            for k, v in (payload.get("jobs") or {}).items()}
+
+    def alloc_of(raw: dict) -> Allocation:
+        key = raw.pop("_jobkey", None) if isinstance(raw, dict) else None
+        a = codec.decode(Allocation, raw)
+        if key is not None:
+            a.job = jobs.get(key)
+        return a
+
+    result = PlanResult(
+        node_update={nid: [alloc_of(r) for r in raws]
+                     for nid, raws in payload["node_update"].items()},
+        node_preemptions={nid: [alloc_of(r) for r in raws]
+                          for nid, raws in
+                          payload["node_preemptions"].items()},
+        node_allocation={nid: [alloc_of(r) for r in raws]
+                         for nid, raws in
+                         payload["node_allocation"].items()},
+        deployment=codec.decode(Optional[Deployment],
+                                payload.get("deployment")),
+        deployment_updates=[
+            codec.decode(DeploymentStatusUpdate, du)
+            for du in payload.get("deployment_updates") or []],
+    )
+    evals = ([codec.decode(Evaluation, e) for e in payload["evals"]]
+             if payload.get("evals") else None)
+    return result, evals
+
+
 class StateFSM:
     """(reference: nomad/fsm.go nomadFSM)"""
 
@@ -87,6 +178,9 @@ class StateFSM:
 
     def apply(self, data: dict) -> Any:
         method = data["m"]
+        if method == "upsert_plan_results_norm":
+            result, evals = decode_plan_results(data["a"][0])
+            return self.store.upsert_plan_results(result, evals)
         specs = WRITE_METHODS.get(method)
         if specs is None:
             raise ValueError(f"unknown FSM command: {method}")

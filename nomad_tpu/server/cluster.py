@@ -149,7 +149,12 @@ class RaftBackedStateStore:
                              event)
 
     def upsert_plan_results(self, result, eval_updates=None):
-        return self._propose("upsert_plan_results", result, eval_updates)
+        # normalized plan payloads (raft/fsm.py encode_plan_results):
+        # stops/preemptions as diff stubs, placements job-stripped with
+        # each distinct job shipped once -- plans dominate the log under
+        # load and the naive form embeds the full job per alloc
+        from ..raft.fsm import encode_plan_results
+        return self._raft.apply(encode_plan_results(result, eval_updates))
 
     def upsert_acl_policies(self, policies):
         return self._propose("upsert_acl_policies", policies)
