@@ -316,12 +316,58 @@ def time_batched_path(n_nodes, e_evals, per_eval):
                 if placed >= want:
                     break
                 time.sleep(0.02)
-            return time.perf_counter() - t0, placed
+            return time.perf_counter() - t0, placed, jobs
 
-        warm_dt, warm_placed = run_round("warm")
+        def drain_round(jobs):
+            """Free a round's capacity before the next one: at headline
+            shape (32x2000x500MHz = 32M shares) one round consumes ~70% of
+            the 10K-node cluster, so a measured round after an undrained
+            warm round runs into capacity exhaustion and blocks forever
+            (that was BENCH_r04's TRUNCATED 29,328/64,000). Matching the
+            reference's semantics, capacity frees only when the CLIENT
+            acknowledges the stop (ProposedAllocs filters client-terminal
+            only, context.go:200); this bench has no client agents, so
+            acknowledge the server-side stops here the way a fleet of
+            clients would (node_endpoint.go:1322 UpdateAlloc)."""
+            for job in jobs:
+                server.deregister_job(job.namespace, job.id)
+            deadline = time.time() + 120
+            live = -1
+            while time.time() < deadline:
+                live = sum(
+                    1 for job in jobs
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                    if a.desired_status == "run")
+                if live == 0:
+                    break
+                time.sleep(0.02)
+            if live:
+                # warm-round deregister plans are still in flight; a round
+                # measured now would share the applier with them, so it
+                # must not be published as a clean number (and acking
+                # allocs the scheduler hasn't stopped yet would only
+                # muddy a post-mortem of the wedged state)
+                log(f"bench: WARNING warm-round drain incomplete "
+                    f"({live} live); measured round would be contaminated")
+                return False
+            import copy
+            acks = []
+            for job in jobs:
+                for a in server.state.allocs_by_job(job.namespace, job.id):
+                    if not a.client_terminal_status():
+                        ack = copy.copy(a)
+                        ack.client_status = "complete"
+                        acks.append(ack)
+            server.update_allocs_from_client(acks)
+            return True
+
+        warm_dt, warm_placed, warm_jobs = run_round("warm")
         log(f"bench: batched warmup (incl. compile) {warm_dt:.3f}s "
             f"({warm_placed} placed)")
-        dt, placed = run_round("run")
+        if not drain_round(warm_jobs):
+            # dt=0 sentinel: the measured round never ran (drain failed)
+            return 0.0, e_evals, 0
+        dt, placed, _ = run_round("run")
         return dt, e_evals, placed
     finally:
         server.shutdown()
@@ -590,12 +636,17 @@ def main():
         except Exception as e:  # noqa: BLE001 -- report the rest anyway
             log(f"bench: e2e pipeline ({tag}) failed: {e!r}")
             return None
+        if bdt == 0.0:
+            # drain-failure sentinel: the measured round never ran
+            log(f"bench: e2e pipeline ({tag}) DRAIN FAILED; "
+                f"dropping metric")
+            return None
         log(f"bench: e2e pipeline ({tag}) {bevals} evals x {per_eval} in "
             f"{bdt:.3f}s ({bplaced} placed, "
             f"{bplaced / bdt:.0f} placements/s)")
         if bplaced < e_evals * per_eval:
-            # run_round's deadline expired: a truncated round must not be
-            # published as a complete measurement
+            # run_round's 600s deadline expired mid-round: a truncated
+            # round must not be published as a complete measurement
             log(f"bench: e2e pipeline ({tag}) TRUNCATED "
                 f"({bplaced}/{e_evals * per_eval} placed); dropping metric")
             return None

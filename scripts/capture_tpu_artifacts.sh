@@ -4,12 +4,21 @@
 # /tmp/tpu_probe.log). Each run gates on placement parity.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
+# round tag: explicit $ROUND, else the latest round in PROGRESS.jsonl
+# (avoids a per-round hardcoded default silently mislabeling artifacts)
+r=${ROUND:-$(python -c "
+import json
+try:
+    line = open('PROGRESS.jsonl').readlines()[-1]
+    print('r%02d' % json.loads(line)['round'])
+except Exception:
+    print('rXX')")}
 ts=$(date +%H%M%S)
 echo "== default bench =="
-python bench.py 2>bench_${ts}.err | tee BENCH_local.json || exit 1
+python bench.py 2>bench_${ts}.err | tee BENCH_${r}_headline.json || exit 1
 for tier in 3 4 5; do
   echo "== tier $tier =="
   BENCH_TIER=$tier python bench.py 2>tier${tier}_${ts}.err \
-    | tee BENCH_r03_tier${tier}.json || exit 1
+    | tee BENCH_${r}_tier${tier}.json || exit 1
 done
-echo "done; artifacts: BENCH_local.json BENCH_r03_tier{3,4,5}.json"
+echo "done; artifacts: BENCH_${r}_headline.json BENCH_${r}_tier{3,4,5}.json"

@@ -1,0 +1,268 @@
+"""Plan normalization: the raft-log encoding of committed plans.
+
+Plans dominate the raft log under load; the normalized form
+(raft/fsm.py encode_plan_results) ships stop/preemption STUBS and
+job-stripped placements with each distinct job exactly once
+(reference: nomad/plan_normalization_test.go, worker.go:666
+SubmitPlan normalized requests). These tests pin the three contracts
+VERDICT r4 called out as untested:
+
+  1. roundtrip: encode -> JSON wire -> decode reproduces the plan
+     semantically (placements re-attached to their job, one shared
+     job object per version);
+  2. stop-stub contract: the FSM apply path reads ONLY fields the
+     stub carries -- a store change that starts reading a new alloc
+     field off a stub must fail here, not corrupt replicas silently;
+  3. bounded entry size: a 2000-alloc burst encodes in O(stub) bytes
+     per stop and ships the job once, not 2000 times.
+"""
+import dataclasses
+import json
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft.fsm import (
+    _STOP_STUB_FIELDS,
+    StateFSM,
+    decode_plan_results,
+    encode_plan_results,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    Deployment,
+    DeploymentStatusUpdate,
+    Evaluation,
+    PlanResult,
+    codec,
+)
+
+
+def _world(n_nodes=4, n_existing=6):
+    """Store with nodes, a job, and existing committed allocs."""
+    store = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"norm-node-{i:03d}"
+        store.upsert_node(n)
+        nodes.append(n)
+    job = mock.job(id="norm-job")
+    store.upsert_job(job)
+    existing = []
+    for k in range(n_existing):
+        a = mock.alloc_for(job, nodes[k % n_nodes], index=k)
+        existing.append(a)
+    store.upsert_allocs(existing)
+    return store, nodes, job, existing
+
+
+def _plan(nodes, job, existing, job2=None):
+    """A plan exercising every PlanResult arm: placements (two jobs),
+    stops, preemptions, deployment + updates."""
+    placements = {}
+    for k, n in enumerate(nodes):
+        a = mock.alloc_for(job, n, index=100 + k)
+        placements.setdefault(n.id, []).append(a)
+    if job2 is not None:
+        a2 = mock.alloc_for(job2, nodes[0], index=0)
+        placements[nodes[0].id].append(a2)
+
+    import copy
+    stop = copy.copy(existing[0])
+    stop.desired_status = "stop"
+    stop.desired_description = "stopped by test"
+    stop.client_status = "complete"
+    preempted = copy.copy(existing[1])
+    preempted.desired_status = "evict"
+    preempted.desired_description = "preempted by test"
+    preempted.preempted_by_allocation = placements[nodes[0].id][0].id
+
+    dep = Deployment(id="norm-dep-1", namespace=job.namespace,
+                     job_id=job.id, job_version=job.version,
+                     status="running")
+    du = DeploymentStatusUpdate(deployment_id="norm-dep-0",
+                                status="failed",
+                                status_description="superseded")
+    result = PlanResult(
+        node_update={stop.node_id: [stop]},
+        node_preemptions={preempted.node_id: [preempted]},
+        node_allocation=placements,
+        deployment=dep,
+        deployment_updates=[du],
+    )
+    evals = [Evaluation(id="norm-eval-1", namespace=job.namespace,
+                        job_id=job.id, status="blocked",
+                        triggered_by="queued-allocs")]
+    return result, evals
+
+
+def _wire(cmd):
+    """The raft log boundary: a command must survive JSON."""
+    return json.loads(json.dumps(cmd))
+
+
+def test_roundtrip_reattaches_jobs_and_preserves_stubs():
+    store, nodes, job, existing = _world()
+    job2 = mock.job(id="norm-job-2")
+    result, evals = _plan(nodes, job, existing, job2=job2)
+
+    cmd = _wire(encode_plan_results(result, evals))
+    assert cmd["m"] == "upsert_plan_results_norm"
+    got, got_evals = decode_plan_results(cmd["a"][0])
+
+    # placements: same shape, every alloc's job re-attached with equal
+    # content, and ONE shared object per distinct (ns, job, version)
+    assert set(got.node_allocation) == set(result.node_allocation)
+    seen_jobs = {}
+    for nid, allocs in result.node_allocation.items():
+        dec = got.node_allocation[nid]
+        assert [a.id for a in dec] == [a.id for a in allocs]
+        for orig, back in zip(allocs, dec):
+            assert back.job is not None
+            assert codec.encode(back.job) == codec.encode(orig.job)
+            key = (orig.namespace, orig.job_id, orig.job.version)
+            if key in seen_jobs:
+                assert back.job is seen_jobs[key], (
+                    "same job version must decode to one shared object")
+            seen_jobs[key] = back.job
+            # placement content survives (job handled above)
+            o, b = codec.encode(orig), codec.encode(back)
+            o.pop("job"), b.pop("job")
+            assert o == b
+    assert len(seen_jobs) == 2
+
+    # stubs: every stub field survives the wire for stops + preemptions
+    for src, dst in ((result.node_update, got.node_update),
+                     (result.node_preemptions, got.node_preemptions)):
+        assert set(dst) == set(src)
+        for nid, allocs in src.items():
+            for orig, back in zip(allocs, dst[nid]):
+                for f in _STOP_STUB_FIELDS:
+                    assert getattr(back, f) == getattr(orig, f), f
+
+    assert got.deployment is not None
+    assert codec.encode(got.deployment) == codec.encode(result.deployment)
+    assert [codec.encode(d) for d in got.deployment_updates] == \
+        [codec.encode(d) for d in result.deployment_updates]
+    assert [e.id for e in got_evals] == [e.id for e in evals]
+
+
+def test_apply_equivalence_direct_vs_normalized():
+    """Applying the normalized command through the FSM must leave the
+    store in the same state as the direct (leader-local) apply."""
+    store_a, nodes_a, job_a, existing_a = _world()
+    store_b = StateStore()
+    from nomad_tpu.raft.fsm import dump_state, restore_state
+    restore_state(store_b, dump_state(store_a))
+
+    result, evals = _plan(nodes_a, job_a, existing_a)
+    import copy
+    result_b, evals_b = copy.deepcopy(result), copy.deepcopy(evals)
+
+    store_a.upsert_plan_results(result, evals)
+    StateFSM(store_b).apply(_wire(encode_plan_results(result_b, evals_b)))
+
+    def norm(store):
+        out = {}
+        for a in store.allocs():
+            d = codec.encode(a)
+            # wall-clock stamps legitimately differ between the applies
+            d.pop("modify_time", None)
+            d.pop("create_time", None)
+            out[a.id] = d
+        return out
+
+    assert norm(store_a) == norm(store_b)
+    da = {d.id: (d.status, d.status_description)
+          for d in store_a.deployments()}
+    db = {d.id: (d.status, d.status_description)
+          for d in store_b.deployments()}
+    assert da == db
+    assert ({e.id: e.status for e in store_a.evals()}
+            == {e.id: e.status for e in store_b.evals()})
+
+
+class _TrackedAlloc(Allocation):
+    """Allocation that records which dataclass fields are read."""
+
+    def __getattribute__(self, name):
+        if name in _FIELD_NAMES:
+            object.__getattribute__(self, "_reads").add(name)
+        return object.__getattribute__(self, name)
+
+
+_FIELD_NAMES = {f.name for f in dataclasses.fields(Allocation)}
+
+
+def test_stop_stub_contract_apply_reads_only_stub_fields():
+    """If upsert_plan_results ever reads an alloc field off a stop or
+    preemption stub that encode_plan_results does not ship, replicas
+    would apply defaults where the leader applied data. Track every
+    field read during the apply and pin it to the stub set."""
+    store, nodes, job, existing = _world()
+    result, evals = _plan(nodes, job, existing)
+
+    tracked = []
+    for table in (result.node_update, result.node_preemptions):
+        for nid, allocs in table.items():
+            wrapped = []
+            for a in allocs:
+                t = _TrackedAlloc(**{f: getattr(a, f)
+                                     for f in _FIELD_NAMES})
+                object.__setattr__(t, "_reads", set())
+                wrapped.append(t)
+            table[nid] = wrapped
+            tracked.extend(wrapped)
+    assert tracked
+
+    store.upsert_plan_results(result, evals)
+
+    read = set()
+    for t in tracked:
+        read |= object.__getattribute__(t, "_reads")
+    extra = read - set(_STOP_STUB_FIELDS)
+    assert not extra, (
+        f"upsert_plan_results reads {sorted(extra)} off stop/preemption "
+        f"allocs, but encode_plan_results ships only "
+        f"{sorted(_STOP_STUB_FIELDS)}; add the field(s) to "
+        f"_STOP_STUB_FIELDS or stop reading them")
+
+
+def test_bounded_entry_size_2000_alloc_burst():
+    """A burst plan (2000 placements of one job, then 2000 stops) must
+    encode in bounded bytes: the job ships once, stops ship as stubs."""
+    store, nodes, job, _ = _world(n_nodes=8, n_existing=0)
+    placements = {}
+    allocs = []
+    for k in range(2000):
+        a = mock.alloc_for(job, nodes[k % len(nodes)], index=k)
+        placements.setdefault(a.node_id, []).append(a)
+        allocs.append(a)
+    result = PlanResult(node_allocation=placements)
+
+    raw = json.dumps(encode_plan_results(result, None))
+    job_bytes = len(json.dumps(codec.encode(job)))
+    naive_bytes = 2000 * len(json.dumps(codec.encode(allocs[0])))
+    # the job appears once, not per alloc: total is at most one job plus
+    # a slim per-alloc record (alloc sans job is ~1KB here)
+    per_alloc = (len(raw) - job_bytes) / 2000
+    assert len(raw) < naive_bytes / 2, (len(raw), naive_bytes)
+    assert per_alloc < 2 * len(json.dumps(
+        codec.encode(dataclasses.replace(allocs[0], job=None)))), per_alloc
+    # distinctive job content must not be duplicated per placement
+    assert raw.count('"run_for"') == 1
+
+    # stop burst: stubs only -- a few hundred bytes per stop, no job
+    store.upsert_plan_results(result, None)
+    stops = {}
+    import copy
+    for a in allocs:
+        s = copy.copy(a)
+        s.desired_status = "stop"
+        stops.setdefault(s.node_id, []).append(s)
+    stop_raw = json.dumps(encode_plan_results(
+        PlanResult(node_update=stops), None))
+    assert len(stop_raw) / 2000 < 600, len(stop_raw) / 2000
+    assert '"run_for"' not in stop_raw
