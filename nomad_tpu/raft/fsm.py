@@ -305,9 +305,14 @@ def restore_state(store: StateStore, blob: dict) -> None:
         store._node_pools = {p.name: p for p in pools}
         if sched_cfg is not None:
             store._scheduler_config = sched_cfg
-        # rebuild secondary indexes
+        # rebuild secondary indexes (and drop the snapshot cache + its
+        # incremental-copy base: both refer to the replaced dicts)
         store._allocs_by_node = {}
         store._allocs_by_job = {}
+        store._snap_cache = None
+        store._snap_prev = None
+        store._dirty_alloc_nodes.clear()
+        store._dirty_alloc_jobs.clear()
         for a in allocs:
             store._allocs_by_node.setdefault(a.node_id, {})[a.id] = None
             store._allocs_by_job.setdefault(

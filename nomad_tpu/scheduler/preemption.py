@@ -113,7 +113,10 @@ class Preemptor:
 
     # -- CPU/memory/disk path (reference: PreemptForTaskGroup) --------------
     def preempt_for_task_group(self, resource_ask) -> List[Allocation]:
-        resources_needed = resource_ask.comparable()
+        # comparable() results are cached on the ask and shared between
+        # the three calls in this method; this one is mutated (subtract
+        # below), so it must be a private copy
+        resources_needed = resource_ask.comparable().copy()
         node_remaining = self.node_remaining.copy()
         for alloc in self.current_allocs:
             node_remaining.subtract(self.alloc_details[alloc.id][1])

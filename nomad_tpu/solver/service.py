@@ -788,6 +788,25 @@ class TpuPlacementService:
                                     preempted_allocs=preempted))
         return out
 
+    @staticmethod
+    def _node_slots(table, matrix, nodes, n_pad):
+        """node -> table-slot array for this eval's node ordering, cached
+        on the (immutable, version-keyed) NodeMatrix: slots are stable for
+        a node's lifetime, and the 10K-iteration Python lookup loop ran
+        under the store lock on every lane pack (a top leaf in the
+        headline e2e profile). Only fully-resolved maps are cached, so a
+        node that registers with the table later is re-looked-up."""
+        cached = getattr(matrix, "_table_slots", None)
+        if cached is not None and cached[0] is table:
+            return cached[1]
+        slots = np.full(n_pad, -1, dtype=np.int32)
+        slots[:len(nodes)] = np.fromiter(
+            map(table.node_slot_of, (n.id for n in nodes)),
+            dtype=np.int32, count=len(nodes))
+        if len(nodes) == 0 or slots[:len(nodes)].min() >= 0:
+            matrix._table_slots = (table, slots)
+        return slots
+
     def _pack_usage_from_table(self, table, matrix, nodes, tg):
         """Fast marshalling: fold the state store's tensor-resident alloc
         table via the native kernels (nomad_tpu/native.py), then overlay
@@ -800,12 +819,10 @@ class TpuPlacementService:
         lock = store._lock if store is not None else None
 
         with_ports = bool(tg.networks)
-        slots = np.full(n_pad, -1, dtype=np.int32)
         if lock is not None:
             lock.acquire()
         try:
-            for i, node in enumerate(nodes):
-                slots[i] = table.node_slot_of(node.id)
+            slots = self._node_slots(table, matrix, nodes, n_pad)
             packed = table.pack(n_pad, slots, with_ports,
                                 port_words_seed=matrix.port_bitmap)
             placed, placed_job = table.count_placed(

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from functools import lru_cache
 from typing import Dict, Optional
 
 import numpy as np
@@ -21,7 +22,11 @@ from .. import native
 MAX_PORTS = native.MAX_PORTS_PER_ALLOC
 
 
+@lru_cache(maxsize=65536)
 def stable_hash(*parts: str) -> int:
+    # memoized: the key space is (namespace, job[, tg]) tuples -- small --
+    # and a 2000-alloc plan commit was spending a third of its time
+    # re-hashing the same job key per alloc
     h = hashlib.blake2b(digest_size=8)
     for p in parts:
         h.update(p.encode())
@@ -146,11 +151,12 @@ class AllocTable:
         node ordering. node_slots_for_pad[i] = table slot of the node at
         position i (or -1). Returns dict of arrays (position-indexed)."""
         n = self.n_rows
-        # remap table node slots -> caller positions
+        # remap table node slots -> caller positions (vectorized; the
+        # Python per-position loop ran under the store lock per lane pack)
         remap = np.full(self.n_nodes + 1, -1, dtype=np.int32)
-        for pos, slot in enumerate(node_slots_for_pad):
-            if slot >= 0:
-                remap[slot] = pos
+        valid_pad = node_slots_for_pad >= 0
+        remap[node_slots_for_pad[valid_pad]] = \
+            np.nonzero(valid_pad)[0].astype(np.int32)
         row_slots = self.node_slot[:n]
         mapped = np.where(row_slots >= 0, remap[np.maximum(row_slots, 0)], -1)
 

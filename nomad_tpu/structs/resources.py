@@ -202,13 +202,25 @@ class AllocatedResources:
 
     def comparable(self) -> "ComparableResources":
         """Flatten tasks + shared into one additive bundle
-        (reference: AllocatedResources.Comparable, structs.go)."""
+        (reference: AllocatedResources.Comparable, structs.go).
+
+        The result is cached on the instance: committed allocs' resources
+        are immutable by design (writes replace objects), and the hot
+        paths (alloc-table upsert, plan verify, usage packing) call this
+        several times per alloc. Contract: do not mutate an
+        AllocatedResources after its first comparable() call, and treat
+        the returned bundle as read-only."""
+        cached = self.__dict__.get("_cmp_cache")
+        if cached is not None:
+            return cached
         out = ComparableResources(disk_mb=self.shared.disk_mb)
         for tr in self.tasks.values():
             out.cpu_shares += tr.cpu_shares
             out.memory_mb += tr.memory_mb
             out.reserved_cores.extend(tr.reserved_cores)
         out.ports = list(self.shared.ports)
+        # plain attribute, not a dataclass field: invisible to the codec
+        self.__dict__["_cmp_cache"] = out
         return out
 
     def all_ports(self) -> List[int]:
