@@ -361,8 +361,11 @@ class GenericScheduler:
             return False
         # a wedged accelerator runtime must not strand worker threads:
         # degrade to the host oracle (solver/guard.py)
-        from ..solver.guard import backend_available
-        return backend_available()
+        from ..solver.guard import backend_available, note_host_fallback
+        if not backend_available():
+            note_host_fallback()
+            return False
+        return True
 
     def _compute_placements_tpu(self, places: List[AllocPlaceResult]
                                 ) -> List[AllocPlaceResult]:
