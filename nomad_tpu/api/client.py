@@ -52,6 +52,11 @@ class ApiClient:
                 key_file=client_key))
 
     # -- low-level -----------------------------------------------------
+    def _headers(self) -> Dict[str, str]:
+        """Auth headers for callers that open raw streams (monitor,
+        debug capture) outside request_raw."""
+        return {"X-Nomad-Token": self.token} if self.token else {}
+
     def _url(self, path: str, params: Optional[Dict[str, Any]] = None) -> str:
         params = dict(params or {})
         params.setdefault("namespace", self.namespace)

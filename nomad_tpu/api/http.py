@@ -206,10 +206,11 @@ class ApiHandler(BaseHTTPRequestHandler):
         # unbounded streams can't be relayed through the buffering
         # forwarder -- clients must connect to that region directly
         parsed = urlparse(self.path)
-        if parsed.path == "/v1/event/stream" and \
-                q.get("poll", ["false"])[0] != "true":
+        if (parsed.path == "/v1/event/stream"
+                and q.get("poll", ["false"])[0] != "true") or \
+                parsed.path == "/v1/agent/monitor":
             self._error(
-                400, f"event stream cannot be forwarded; connect to "
+                400, f"{parsed.path} cannot be forwarded; connect to "
                      f"region {region!r} at {addr} directly")
             return True
         import urllib.error
@@ -895,6 +896,14 @@ class ApiHandler(BaseHTTPRequestHandler):
                         m.to_wire() for m in serf.members()]})
             elif parts == ["v1", "agent", "health"]:
                 self._send(200, {"server": {"ok": True}})
+            elif parts == ["v1", "agent", "monitor"]:
+                # live log stream with level filter (reference:
+                # command/agent/agent_endpoint.go AgentMonitor +
+                # monitor/monitor.go). agent:read, like the reference.
+                if not self._check(acl.allow_agent_read()):
+                    return
+                self._stream_monitor(q)
+                return
             elif parts == ["v1", "agent", "pprof", "goroutine"]:
                 # thread-stack dump (reference: command/agent/pprof/ --
                 # gated on agent:write like the reference's enableDebug)
@@ -1761,6 +1770,58 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._send(200, {"updated": True})
         else:
             self._error(404, "unknown acl path")
+
+    def _stream_monitor(self, q) -> None:
+        """Chunked NDJSON log stream (reference: AgentMonitor --
+        ?log_level=trace|debug|info|warn|error, ?plain=true for raw
+        lines). Replays the recent ring first so an operator attaching
+        after an incident still sees it, then follows live; heartbeat
+        frame every 10s; client disconnect detaches the sink."""
+        from ..server.logbroker import broker
+        level = q.get("log_level", ["info"])[0]
+        plain = q.get("plain", ["false"])[0] == "true"
+        # one locked step: a record logged around attach time shows up
+        # exactly once (replay xor live), never twice
+        sink, recent = broker.attach_with_recent(min_level=level)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain" if plain
+                             else "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(payload: bytes) -> None:
+                self.wfile.write(f"{len(payload):x}\r\n".encode())
+                self.wfile.write(payload + b"\r\n")
+                self.wfile.flush()
+
+            def frame(rec: dict) -> bytes:
+                if plain:
+                    ts = time.strftime("%H:%M:%S",
+                                       time.localtime(rec["ts"]))
+                    return (f"{ts} [{rec['level'].upper():5s}] "
+                            f"{rec['name']}: {rec['msg']}\n").encode()
+                return json.dumps(rec).encode() + b"\n"
+
+            for rec in recent:
+                chunk(frame(rec))
+            last_beat = time.time()
+            while True:
+                rec = sink.next(timeout=0.5)
+                if rec is not None:
+                    chunk(frame(rec))
+                elif time.time() - last_beat >= 10.0:
+                    chunk(b"\n" if plain else b"{}\n")
+                    last_beat = time.time()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            broker.detach(sink)
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
 
     def _stream_events(self, q, since: int) -> None:
         """Chunked NDJSON event stream with topic filters (reference:

@@ -528,6 +528,108 @@ def cmd_node_pool(args) -> int:
     return 0
 
 
+def cmd_monitor(args) -> int:
+    """Stream agent logs (reference: command/monitor.go riding
+    /v1/agent/monitor). Ctrl-C detaches."""
+    import urllib.request
+    api = _client(args)
+    url = (f"{api.address}/v1/agent/monitor?plain=true"
+           f"&log_level={args.log_level}")
+    req = urllib.request.Request(url, headers=api._headers())
+    try:
+        with urllib.request.urlopen(req, context=api._ssl_ctx) as resp:
+            for raw in resp:
+                line = raw.decode(errors="replace").rstrip("\n")
+                if line:
+                    print(line, flush=True)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_operator_debug(args) -> int:
+    """Capture a debug bundle (reference: command/operator_debug.go):
+    agent/cluster/scheduler state, thread stacks, metrics, guard state,
+    recent evals/deployments, and a log capture, tarred for transport."""
+    import io
+    import json as _json
+    import tarfile
+    import threading
+    import time as _time
+    import urllib.request
+
+    api = _client(args)
+    stamp = _time.strftime("%Y%m%d-%H%M%S")
+    out_path = args.output or f"nomad-tpu-debug-{stamp}.tar.gz"
+    captures = {}
+
+    def grab(name: str, path: str) -> None:
+        try:
+            captures[name] = api.get(path)
+        except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+            captures[name] = {"capture_error": repr(e)}
+
+    # log capture rides the monitor stream for the requested duration;
+    # runs first in a thread so the state grabs land inside the window
+    log_lines: list = []
+
+    def capture_logs() -> None:
+        url = (f"{api.address}/v1/agent/monitor?plain=true"
+               f"&log_level=debug")
+        req = urllib.request.Request(url, headers=api._headers())
+        deadline = _time.time() + args.duration
+        # socket timeout must outlive the server's 10s heartbeat frame,
+        # or a quiet agent makes every capture "fail" on timeout; a
+        # timeout after the window is just a clean end of capture
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=max(args.duration, 12.0),
+                    context=api._ssl_ctx) as resp:
+                while _time.time() < deadline:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    log_lines.append(line.decode(errors="replace"))
+        except TimeoutError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            log_lines.append(f"[capture error: {e!r}]\n")
+
+    t = threading.Thread(target=capture_logs, daemon=True)
+    t.start()
+
+    grab("agent-self.json", "/v1/agent/self")
+    grab("agent-members.json", "/v1/agent/members")
+    grab("agent-health.json", "/v1/agent/health")
+    grab("threads.json", "/v1/agent/pprof/goroutine")
+    grab("metrics.json", "/v1/metrics")
+    grab("scheduler-config.json", "/v1/operator/scheduler/configuration")
+    grab("autopilot-health.json", "/v1/operator/autopilot/health")
+    grab("nodes.json", "/v1/nodes")
+    grab("jobs.json", "/v1/jobs")
+    grab("evaluations.json", "/v1/evaluations")
+    grab("deployments.json", "/v1/deployments")
+    # daemon thread: if it is still blocked waiting for a first frame
+    # from a quiet agent, take what arrived and move on
+    t.join(timeout=args.duration + 2)
+    captures["monitor.log"] = "".join(log_lines)
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, content in captures.items():
+            if isinstance(content, str):
+                blob = content.encode()
+            else:
+                blob = _json.dumps(content, indent=2,
+                                   default=str).encode()
+            info = tarfile.TarInfo(f"nomad-tpu-debug-{stamp}/{name}")
+            info.size = len(blob)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(blob))
+    print(f"Debug bundle written to {out_path} "
+          f"({len(captures)} captures, {len(log_lines)} log lines)")
+    return 0
+
+
 def cmd_operator_snapshot(args) -> int:
     api = _client(args)
     if args.sub2 == "save":
@@ -789,6 +891,14 @@ def build_parser() -> argparse.ArgumentParser:
     orp = orf.add_parser("remove-peer")
     orp.add_argument("id")
     orp.set_defaults(fn=cmd_operator_raft)
+    odbg = op.add_parser("debug")
+    odbg.add_argument("-duration", type=float, default=2.0)
+    odbg.add_argument("-output", default="")
+    odbg.set_defaults(fn=cmd_operator_debug)
+
+    mon = sub.add_parser("monitor")
+    mon.add_argument("-log-level", dest="log_level", default="info")
+    mon.set_defaults(fn=cmd_monitor)
 
     srv = sub.add_parser("server").add_subparsers(dest="sub",
                                                   required=True)

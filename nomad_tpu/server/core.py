@@ -132,6 +132,8 @@ class Server:
     def start(self) -> None:
         """Boot; the dev single-server topology is immediately the leader
         (reference: server boot + monitorLeadership leader.go:90)."""
+        from .logbroker import _StdlibBridge
+        _StdlibBridge.install()     # stdlib logging -> /v1/agent/monitor
         self._start_background()
         self.establish_leadership()
 
@@ -157,9 +159,10 @@ class Server:
                 fn()
                 return          # clean exit (shutdown)
             except Exception:
-                print(f"[nomad-tpu] {name} watcher error (restarting):",
-                      file=__import__("sys").stderr)
-                traceback.print_exc()
+                from .logbroker import log as _log
+                _log("error", "server",
+                     f"{name} watcher error (restarting): "
+                     f"{traceback.format_exc()}")
                 self._shutdown.wait(0.5)
 
     def establish_leadership(self) -> None:
@@ -173,6 +176,10 @@ class Server:
             # gating broker enable on SchedulerConfig.PauseEvalBroker)
             paused = bool(getattr(self.state.scheduler_config(),
                                   "pause_eval_broker", False))
+            from .logbroker import log as _log
+            _log("info", "server",
+                 f"cluster leadership acquired (broker "
+                 f"{'paused' if paused else 'enabled'})")
             self.broker.set_enabled(not paused)
             self.blocked_evals.set_enabled(True)
             # (reference: leader.go initializeKeyring -- first leader mints
@@ -818,6 +825,10 @@ class Server:
                 self.blocked_evals.unblock(node.computed_class)
                 self._create_node_evals(node_id)
         elif status in (NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED):
+            if old not in (NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED):
+                from .logbroker import log as _log
+                _log("warn", "heartbeat",
+                     f"node {node_id[:8]} marked {status}")
             with self._hb_lock:
                 self._heartbeat_deadlines.pop(node_id, None)
             self._create_node_evals(node_id)

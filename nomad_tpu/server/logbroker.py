@@ -1,0 +1,161 @@
+"""Process log broker: the stream behind /v1/agent/monitor.
+
+The reference streams agent logs by registering a sink on its
+hclog InterceptLogger (command/agent/monitor/monitor.go:1): each
+attached monitor gets a bounded buffer, messages that overflow it are
+counted and reported in-stream rather than blocking the logger. This
+is the same design for a Python process: a process-global broker that
+
+  - formats and writes every record to stderr (the behavior the
+    scattered print() diagnostics had before),
+  - keeps a ring of recent records (operator debug bundles capture it),
+  - fans records out to attached MonitorSinks, each with its own level
+    filter and bounded queue + dropped-count accounting,
+  - bridges the stdlib ``logging`` root logger, so library code using
+    logging is captured too.
+
+Logging must never block scheduling: offer() is non-blocking and the
+stderr write happens outside the broker lock.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+LEVELS = {"trace": 5, "debug": 10, "info": 20, "warn": 30, "error": 40}
+
+
+def _level_num(name: str) -> int:
+    return LEVELS.get(name.lower(), 20)
+
+
+class MonitorSink:
+    """One attached monitor: a bounded queue of records plus a count of
+    records dropped while the consumer lagged (reference:
+    monitor.go droppedCount)."""
+
+    def __init__(self, min_level: str, buf: int = 512):
+        self.min_level = _level_num(min_level)
+        self._q: "queue.Queue[dict]" = queue.Queue(maxsize=buf)
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def offer(self, rec: dict, level_num: int) -> None:
+        if self.closed or level_num < self.min_level:
+            return
+        try:
+            self._q.put_nowait(rec)
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+
+    def next(self, timeout: float = 0.5) -> Optional[dict]:
+        """The next record, or a drop notice, or None on timeout."""
+        with self._lock:
+            if self._dropped:
+                n, self._dropped = self._dropped, 0
+                return {"ts": time.time(), "level": "warn",
+                        "name": "monitor",
+                        "msg": f"monitor dropped {n} logs during delivery"}
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class LogBroker:
+    def __init__(self, ring: int = 512):
+        self._lock = threading.Lock()
+        self._sinks: List[MonitorSink] = []
+        self._ring: collections.deque = collections.deque(maxlen=ring)
+
+    def _deliver(self, rec: dict, echo_stderr: bool) -> None:
+        num = _level_num(rec["level"])
+        with self._lock:
+            self._ring.append(rec)
+            sinks = list(self._sinks)
+        for s in sinks:
+            s.offer(rec, num)
+        if echo_stderr:
+            ts = time.strftime("%H:%M:%S", time.localtime(rec["ts"]))
+            print(f"[nomad-tpu] {ts} [{rec['level'].upper():5s}] "
+                  f"{rec['name']}: {rec['msg']}", file=sys.stderr)
+
+    def log(self, level: str, name: str, msg: str) -> None:
+        self._deliver({"ts": time.time(), "level": level.lower(),
+                       "name": name, "msg": msg}, echo_stderr=True)
+
+    def attach(self, min_level: str = "info", buf: int = 512
+               ) -> MonitorSink:
+        sink = MonitorSink(min_level, buf)
+        with self._lock:
+            self._sinks.append(sink)
+        return sink
+
+    def attach_with_recent(self, min_level: str = "info", buf: int = 512
+                           ) -> "tuple[MonitorSink, List[dict]]":
+        """Attach a sink AND snapshot the ring in one locked step, so a
+        record logged around attach time appears exactly once -- either
+        in the replay or in the live queue, never both."""
+        lvl = _level_num(min_level)
+        sink = MonitorSink(min_level, buf)
+        with self._lock:
+            recent = [r for r in self._ring
+                      if _level_num(r["level"]) >= lvl]
+            self._sinks.append(sink)
+        return sink, recent
+
+    def detach(self, sink: MonitorSink) -> None:
+        sink.closed = True
+        with self._lock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def recent(self, n: int = 512, min_level: str = "trace") -> List[dict]:
+        lvl = _level_num(min_level)
+        with self._lock:
+            recs = list(self._ring)
+        return [r for r in recs if _level_num(r["level"]) >= lvl][-n:]
+
+
+broker = LogBroker()
+
+
+def log(level: str, name: str, msg: str) -> None:
+    broker.log(level, name, msg)
+
+
+class _StdlibBridge:
+    """Forward stdlib logging records into the broker (reference analog:
+    the InterceptLogger capturing dependencies' loggers). Installed
+    lazily; never installed twice."""
+
+    _installed = False
+
+    @classmethod
+    def install(cls) -> None:
+        if cls._installed:
+            return
+        import logging
+
+        class Handler(logging.Handler):
+            def emit(self, record: "logging.LogRecord") -> None:
+                lvl = ("error" if record.levelno >= 40 else
+                       "warn" if record.levelno >= 30 else
+                       "info" if record.levelno >= 20 else "debug")
+                # no stderr echo: stdlib logging already has its own
+                # handlers; double-printing every jax warning would spam
+                broker._deliver(
+                    {"ts": record.created, "level": lvl,
+                     "name": record.name, "msg": record.getMessage()},
+                    echo_stderr=False)
+
+        logging.getLogger().addHandler(Handler())
+        cls._installed = True

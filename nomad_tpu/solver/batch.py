@@ -24,7 +24,6 @@ concurrency, SURVEY.md section 2.6.1).
 """
 from __future__ import annotations
 
-import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -153,10 +152,11 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
             # a >1s dispatch on these shapes is an XLA compile, not compute;
             # record which variant so warm-path stalls are attributable
             metrics.incr("nomad.solver.dispatch_slow")
-            print(f"[nomad-tpu] slow dispatch {dt_ms:.0f}ms "
-                  f"(E={e_pad} P={p_pad} wave={lanes[idxs[0]].wavefront_ok()}"
-                  f" A={A}) -- likely fresh XLA compile",
-                  file=sys.stderr)
+            from ..server.logbroker import log as _log
+            _log("warn", "solver",
+                 f"slow dispatch {dt_ms:.0f}ms "
+                 f"(E={e_pad} P={p_pad} wave={lanes[idxs[0]].wavefront_ok()}"
+                 f" A={A}) -- likely fresh XLA compile")
         if A > 0:
             chosen, scores, n_yielded, evict_rows = out
         else:

@@ -78,12 +78,13 @@ def backend_available(timeout_s: float = 0.0) -> bool:
         _STATE["ok"] = ok
         _STATE["probe_timed_out"] = not done.is_set()
         if not ok:
+            from ..server.logbroker import log as _log
             from ..server.telemetry import metrics
             metrics.incr("nomad.solver.backend_unavailable")
-            print("[nomad-tpu] accelerator backend unavailable "
-                  f"(init did not complete in {timeout:.0f}s); "
-                  "scheduling falls back to the host oracle",
-                  file=sys.stderr)
+            _log("error", "solver.guard",
+                 "accelerator backend unavailable "
+                 f"(init did not complete in {timeout:.0f}s); "
+                 "scheduling falls back to the host oracle")
         return ok
 
 
@@ -104,11 +105,12 @@ def _maybe_recover_locked() -> bool:
             and result and result["n"] > 0 and not _STATE["ok"]):
         _STATE["ok"] = True
         _STATE["recovered_late"] = True
+        from ..server.logbroker import log as _log
         from ..server.telemetry import metrics
         metrics.incr("nomad.solver.backend_recovered")
-        print("[nomad-tpu] accelerator backend recovered "
-              "(late probe completion); dense scheduling re-enabled",
-              file=sys.stderr)
+        _log("warn", "solver.guard",
+             "accelerator backend recovered (late probe completion); "
+             "dense scheduling re-enabled")
         return True
     return False
 

@@ -1,0 +1,112 @@
+"""Agent monitor stream + operator debug bundle (VERDICT r4 missing #1;
+reference: command/agent/monitor/monitor.go, command/operator_debug.go)."""
+import io
+import json
+import tarfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu.api.http import HttpServer
+from nomad_tpu.server import Server
+from nomad_tpu.server.logbroker import LogBroker, broker, log
+
+
+@pytest.fixture
+def agent():
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    yield server, f"http://127.0.0.1:{http.port}"
+    http.shutdown()
+    server.shutdown()
+
+
+def test_broker_level_filter_and_drop_accounting():
+    b = LogBroker(ring=8)
+    sink = b.attach(min_level="warn", buf=2)
+    b.log("info", "t", "filtered out")
+    b.log("warn", "t", "one")
+    b.log("error", "t", "two")
+    b.log("error", "t", "overflow")        # queue full -> dropped
+    got = [sink.next(0.1) for _ in range(3)]
+    msgs = [r["msg"] for r in got if r]
+    assert "one" in msgs and "two" in msgs
+    assert "filtered out" not in msgs
+    # the drop notice is surfaced in-stream (delivered before the
+    # buffered records, reference monitor.go droppedCount behavior)
+    assert any("dropped 1 logs" in m for m in msgs), msgs
+    b.detach(sink)
+    b.log("error", "t", "after detach")
+    assert sink.next(0.1) is None
+
+    # ring keeps recent records for debug capture, level-filterable
+    assert [r["msg"] for r in b.recent(min_level="error")] == \
+        ["two", "overflow", "after detach"]
+
+
+def test_monitor_endpoint_streams_and_filters(agent):
+    server, base = agent
+    lines = []
+    done = threading.Event()
+
+    def consume():
+        req = urllib.request.Request(
+            f"{base}/v1/agent/monitor?log_level=warn")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            while not done.is_set():
+                raw = resp.readline()
+                if not raw:
+                    break
+                raw = raw.strip()
+                if raw and raw != b"{}":
+                    lines.append(json.loads(raw))
+                if any(r["msg"] == "visible" for r in lines):
+                    done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)          # let the sink attach
+    log("debug", "test", "invisible")
+    log("warn", "test", "visible")
+    assert done.wait(5), f"stream never delivered: {lines}"
+    msgs = [r["msg"] for r in lines]
+    assert "visible" in msgs and "invisible" not in msgs
+    t.join(timeout=2)
+
+
+def test_monitor_plain_mode_replays_ring(agent):
+    server, base = agent
+    log("error", "replay-test", "before attach")
+    req = urllib.request.Request(
+        f"{base}/v1/agent/monitor?plain=true&log_level=error")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        line = resp.readline().decode()
+    # the ring replay delivers pre-attach records to late operators
+    assert "replay-test" in line and "before attach" in line
+
+
+def test_operator_debug_bundle(agent, tmp_path, monkeypatch):
+    server, base = agent
+    from nomad_tpu import cli
+
+    log("warn", "bundle-test", "incident marker")
+    out = tmp_path / "bundle.tar.gz"
+    rc = cli.main(["-address", base, "operator", "debug",
+                   "-duration", "0.5", "-output", str(out)])
+    assert rc == 0 and out.exists()
+    with tarfile.open(out) as tar:
+        names = {n.split("/", 1)[1] for n in tar.getnames()}
+        assert {"agent-self.json", "threads.json", "metrics.json",
+                "nodes.json", "jobs.json", "evaluations.json",
+                "monitor.log"} <= names
+        for member in tar.getmembers():
+            if member.name.endswith("agent-self.json"):
+                self_info = json.load(tar.extractfile(member))
+                assert "solver_guard" in self_info["stats"]
+            if member.name.endswith("monitor.log"):
+                logtxt = tar.extractfile(member).read().decode()
+                assert "incident marker" in logtxt
