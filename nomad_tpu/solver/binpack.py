@@ -2075,13 +2075,32 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
             return jnp.stack([chosen.astype(scores.dtype), scores,
                               ny.astype(scores.dtype)]), ev
         _WAVE_PREEMPT_FNS[key] = fn
-    cm, cd, sf, si, pn, c0 = jax.device_put(
+    cm, cd, sf, si, pn, c0 = _put_eval_sharded(
+        batched, compact.shape[0],
         (compact, cand, scal_f, scal_i, pen, counts0))
     combined, ev = jax.device_get(fn(cm, cd, sf, si, pn, c0))
     combined = combined[..., :P]
     ev = ev[..., :P, :]
     return (combined[0].astype(np.int64), combined[1],
             combined[2].astype(np.int64), np.asarray(ev))
+
+
+def _put_eval_sharded(batched: bool, e_dim: int, trees):
+    """Device-put a tuple of (possibly nested) arrays, sharding the
+    leading eval axis across ALL attached devices when it divides the
+    device count. The fused eval axis is embarrassingly data-parallel:
+    each chip runs its lanes' scans independently (no collectives;
+    outputs gather on fetch). Shared by the wave and wave-preempt
+    dispatch paths so their sharding gates can't diverge."""
+    if not (batched and jax.device_count() > 1
+            and e_dim % jax.device_count() == 0):
+        return jax.device_put(trees)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    mesh = Mesh(np.asarray(jax.devices()), ("evals",))
+    sharding = NamedSharding(mesh, PartitionSpec("evals"))
+    return tuple(
+        jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
+        for t in trees)
 
 
 _WAVE_COMPACT_FNS: dict = {}
@@ -2161,23 +2180,8 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
             return jnp.stack([chosen.astype(scores.dtype), scores,
                               ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
-    sharding = None
-    if batched and jax.device_count() > 1 \
-            and compact.shape[0] % jax.device_count() == 0:
-        # the fused eval axis is embarrassingly data-parallel: shard the
-        # lanes across chips (no collectives needed -- each chip runs its
-        # lanes' scans independently; outputs gather on fetch)
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
-        mesh = Mesh(np.asarray(jax.devices()), ("evals",))
-        sharding = NamedSharding(mesh, PartitionSpec("evals"))
-    if sharding is not None:
-        put = lambda a: jax.device_put(a, sharding)  # noqa: E731
-        cm, sf, si, pn = (put(compact), put(scal_f), put(scal_i),
-                          put(pen))
-        spd = jax.tree_util.tree_map(put, sp)
-    else:
-        cm, sf, si, pn, spd = jax.device_put(
-            (compact, scal_f, scal_i, pen, sp))
+    cm, sf, si, pn, spd = _put_eval_sharded(
+        batched, compact.shape[0], (compact, scal_f, scal_i, pen, sp))
     combined = jax.device_get(fn(cm, sf, si, pn, spd))
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]
