@@ -21,8 +21,9 @@ from typing import Dict, List, Tuple
 
 from . import mock
 from .structs import (
-    Affinity, Constraint, NetworkResource, Port, PreemptionConfig,
-    SchedulerConfiguration, Spread, SpreadTarget,
+    Affinity, Constraint, DeviceRequest, NetworkResource,
+    NodeDeviceResource, Port, PreemptionConfig, SchedulerConfiguration,
+    Spread, SpreadTarget,
     ALLOC_CLIENT_RUNNING,
 )
 
@@ -30,9 +31,11 @@ RACK_COUNT = 25   # reference sweep uses {10,25,50,75} racks
 
 
 def make_fleet(rng: random.Random, h, n_nodes: int,
-               racks: int = RACK_COUNT) -> List:
+               racks: int = RACK_COUNT, gpus: bool = False) -> List:
     """Heterogeneous fleet: 3 machine classes, rack + datacenter spread
-    attributes (the reference bench's rack axis)."""
+    attributes (the reference bench's rack axis). ``gpus`` equips every
+    other node with an nvidia/gpu group of 2-4 instances (the BASELINE
+    tier-5 'GPU device reservations' axis)."""
     nodes = []
     for i in range(n_nodes):
         node = mock.node()
@@ -41,6 +44,12 @@ def make_fleet(rng: random.Random, h, n_nodes: int,
         node.node_resources.memory.memory_mb = (8192, 16384, 32768)[i % 3]
         node.datacenter = f"dc{i % 2 + 1}"
         node.attributes["platform.rack"] = f"rack-{i % racks:03d}"
+        if gpus and i % 2 == 0:
+            n_inst = (2, 4)[i % 4 // 2]
+            node.node_resources.devices = [NodeDeviceResource(
+                vendor="nvidia", type="gpu", name="v100",
+                instance_ids=[f"{node.id}-gpu-{k}"
+                              for k in range(n_inst)])]
         node.compute_class()
         h.state.upsert_node(node)
         nodes.append(node)
@@ -109,7 +118,7 @@ def run_tier_placements(tier: int, n_nodes: int, count: int, seed: int,
         cfg.preemption_config = PreemptionConfig(
             service_scheduler_enabled=True, batch_scheduler_enabled=True)
     h.state.set_scheduler_config(cfg)
-    nodes = make_fleet(rng, h, n_nodes)
+    nodes = make_fleet(rng, h, n_nodes, gpus=(tier == 5))
     if tier == 5:
         seed_utilization(rng, h, nodes, 0.95, priorities=(10, 20, 30, 40))
     elif tier in (3, 4):
@@ -120,6 +129,13 @@ def run_tier_placements(tier: int, n_nodes: int, count: int, seed: int,
     if tier == 5:
         job.priority = 70
         job.task_groups[0].tasks[0].resources.cpu = 1000
+        # BASELINE tier 5: "priority tiers + GPU device reservations".
+        # The GPU ask constrains placement to the equipped half of the
+        # fleet; preemption pressure stays cpu (the filler jobs hold no
+        # devices, so device availability never changes under eviction
+        # and the windowed preempt kernel stays exact)
+        job.task_groups[0].tasks[0].resources.devices = [
+            DeviceRequest(name="nvidia/gpu", count=1)]
     h.state.upsert_job(job)
     ev = mock.evaluation(job_id=job.id, type=job.type,
                          priority=job.priority)

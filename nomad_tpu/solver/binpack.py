@@ -1292,6 +1292,60 @@ def wavefront_buffer_size(limit: int) -> Optional[int]:
     return None
 
 
+# shared with service._wave_devices_ok's eligibility bound: a lane passes
+# the wave gate ONLY if the capacity replay provably terminates within
+# this many steps, so wavefront_compact_host can assert the replay
+# succeeded rather than silently skipping the device clamp
+WAVE_DEVICE_CAP_STEPS = 1024
+
+
+def _wave_device_capacity(const, init,
+                          cap_steps: int = WAVE_DEVICE_CAP_STEPS
+                          ) -> Optional[np.ndarray]:
+    """Per-node placement capacity in the DEVICE dimension for a uniform
+    lane: numpy replay of the dense kernel's per-step commit (feasible if
+    every request has a group with free >= count; the first-max-affinity
+    eligible group is drained, _commit_carry_tables). Capacity is the
+    number of placements until device-infeasible. Returns None when the
+    simulation can't bound (a request with count <= 0 would never drain).
+
+    Eligibility for the wave path additionally requires
+    dev_sum_weight == 0 (no device affinities): with zero weight the
+    dense kernel's device score component vanishes, so capacity is the
+    ONLY device effect and the wave scoring stays bit-identical.
+    """
+    R = int(np.asarray(const.dev_aff).shape[0])
+    if R == 0:
+        return None
+    dev_cnt = np.asarray(const.dev_count, dtype=np.int64)
+    if (dev_cnt <= 0).any():
+        return None
+    free = np.asarray(init.dev_free, dtype=np.int64).copy()  # (R, Gd, N)
+    aff = np.asarray(const.dev_aff, dtype=np.float64)
+    N = free.shape[2]
+    c_dev = np.zeros(N, dtype=np.int64)
+    alive = np.ones(N, dtype=bool)
+    rr = np.arange(R)
+    nn = np.arange(N)
+    for _ in range(cap_steps):
+        ok_g = free >= dev_cnt[:, None, None]            # (R, Gd, N)
+        feas = ok_g.any(axis=1).all(axis=0) & alive      # (N,)
+        if not feas.any():
+            break
+        # first-max affinity among eligible groups, exactly the dense
+        # argmax (ties -> lowest group index)
+        aff_m = np.where(ok_g, aff, -np.inf)
+        g_star = aff_m.argmax(axis=1)                    # (R, N)
+        dec = np.zeros_like(free)
+        dec[rr[:, None], g_star, nn[None, :]] = dev_cnt[:, None]
+        free -= np.where(feas[None, None, :], dec, 0)
+        c_dev += feas
+        alive = feas
+    else:
+        return None             # capacity unbounded within cap_steps
+    return c_dev
+
+
 def wavefront_compact_host(const, init, batch, dtype_name: str,
                            p_pad: Optional[int] = None,
                            B: int = WAVE_B):
@@ -1353,6 +1407,15 @@ def wavefront_compact_host(const, init, batch, dtype_name: str,
                      if bool(np.asarray(const.distinct_job_level))
                      else np.asarray(init.placed))
         c = np.minimum(c, np.where(distinct0 > 0, 0, 1))
+    if np.asarray(const.dev_aff).shape[0]:
+        c_dev = _wave_device_capacity(const, init)
+        # wavefront_ok admits device lanes only when the replay bound
+        # holds, so a None here is an eligibility bug, not a fallback
+        assert c_dev is not None, "unbounded device capacity replay"
+        # uniform device asks fold into the closed-form capacity; the
+        # score is unaffected (wavefront_ok gates on zero device
+        # affinity weight, where the dense device score component is 0)
+        c = np.minimum(c, c_dev)
     c = np.where(np.asarray(const.feasible), c, 0)
     c = np.clip(c, 0, P)
 
@@ -1591,12 +1654,15 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen, sp=None,
 # the kernel models cpu/mem/disk + distinct_hosts + affinity + penalties;
 # spreads stay dense.
 
-# slot columns for the preempt wavefront (compactP, (C, 11))
+# slot columns for the preempt wavefront (compactP, (C, _WPC_NCOLS))
 _WPC_FEAS = 0
 _WPC_UC, _WPC_UM, _WPC_UD = 1, 2, 3
 _WPC_CC, _WPC_CM, _WPC_CD = 4, 5, 6
 _WPC_PLACED, _WPC_PLACED_JOB = 7, 8
 _WPC_AFF, _WPC_POS = 9, 10
+_WPC_CDEV = 11          # device-dimension placement capacity (2^24 =
+_WPC_NCOLS = 12         # unbounded; exact in float32)
+_WPC_DEV_UNBOUNDED = float(2 ** 24)
 
 
 def _numpy_preempt_pristine(ccpu, cmem, cdisk, cprio, cmaxp, cgrp, cvalid,
@@ -1697,7 +1763,7 @@ def wavefront_preempt_compact_host(const, init, batch, ptab, pinit,
                                    B: int = WAVE_B):
     """Host precompute for ONE preempt lane: the pristine option
     predicate + refill-ordered compact node columns and candidate tables.
-    Returns (compactP (C, 11), cand dict of (C, A) arrays, scal_f (4,),
+    Returns (compactP (C, _WPC_NCOLS), cand dict of (C, A) arrays, scal_f (4,),
     scal_i (4,), pen (P,), counts0 (G,))."""
     dt = np.dtype(dtype_name)
     P = int(np.asarray(batch.ask_cpu).shape[0])
@@ -1728,7 +1794,21 @@ def wavefront_preempt_compact_host(const, init, batch, ptab, pinit,
 
     dcount0 = placed_job0 if job_level else placed0
     feas_nonres0 = feas if not distinct else (feas & (dcount0 == 0))
-    fit0 = (feas_nonres0
+    # device-dimension capacity (uniform ask, zero affinity weight --
+    # wavefront_ok gates): a node with no eligible group (or drained by
+    # earlier placements, tracked via j in the kernel) is NOT an option,
+    # not even via eviction -- eviction never frees matching devices
+    # (pack() rejects lanes whose evictable candidates hold them), so a
+    # failed device assign skips the node exactly like rank.go:443's
+    # PreemptForDevice returning nil
+    if np.asarray(const.dev_aff).shape[0]:
+        c_dev = _wave_device_capacity(const, init)
+        assert c_dev is not None, "unbounded device capacity replay"
+        dev_ok0 = c_dev >= 1
+    else:
+        c_dev = None
+        dev_ok0 = np.ones(N, dtype=bool)
+    fit0 = (feas_nonres0 & dev_ok0
             & (used_c + ask_cpu <= cpu_cap)
             & (used_m + ask_mem <= mem_cap)
             & (used_d + ask_disk <= disk_cap))
@@ -1752,11 +1832,11 @@ def wavefront_preempt_compact_host(const, init, batch, ptab, pinit,
     fit2g0 = ((used_c + ask_cpu - freed0[0] <= cpu_cap)
               & (used_m + ask_mem - freed0[1] <= mem_cap)
               & (used_d + ask_disk - freed0[2] <= disk_cap))
-    option0 = fit0 | (feas_nonres0 & ~fit0 & met0 & fit2g0)
+    option0 = fit0 | (feas_nonres0 & dev_ok0 & ~fit0 & met0 & fit2g0)
 
     fit_pos = np.nonzero(option0)[0][:P_out + B]
     C = P_out + B
-    compact = np.zeros((C, 11), dtype=dt)
+    compact = np.zeros((C, _WPC_NCOLS), dtype=dt)
     compact[:, _WPC_POS] = -1.0
     k = fit_pos.shape[0]
     compact[:k, _WPC_FEAS] = feas[fit_pos].astype(dt)
@@ -1773,6 +1853,11 @@ def wavefront_preempt_compact_host(const, init, batch, ptab, pinit,
            else np.zeros(N, dtype=dt))
     compact[:k, _WPC_AFF] = aff[fit_pos]
     compact[:k, _WPC_POS] = fit_pos.astype(dt)
+    if c_dev is not None:
+        compact[:k, _WPC_CDEV] = np.minimum(
+            c_dev[fit_pos], P_out + 1).astype(dt)
+    else:
+        compact[:, _WPC_CDEV] = dt.type(_WPC_DEV_UNBOUNDED)
 
     def take(arr, fill):
         out = np.full((C, A), fill, dtype=arr.dtype)
@@ -1842,7 +1927,11 @@ def _solve_wave_preempt_impl(compact, cand, scal_f, scal_i, pen, counts0,
         dcount = jnp.where(distinct_flag == 2,
                            slot[:, _WPC_PLACED_JOB] + jf,
                            slot[:, _WPC_PLACED] + jf)
-        feas_nonres = ((slot[:, _WPC_FEAS] > 0.5)
+        # device capacity countdown: each landed placement (j) consumed
+        # one unit; a drained node stops being an option entirely (no
+        # eviction can free matching devices -- pack() gates on that)
+        dev_ok = slot[:, _WPC_CDEV] - jf >= 1.0
+        feas_nonres = ((slot[:, _WPC_FEAS] > 0.5) & dev_ok
                        & ((distinct_flag == 0) | (dcount == 0.0)))
         fit = (feas_nonres
                & (new_c <= slot[:, _WPC_CC])

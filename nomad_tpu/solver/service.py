@@ -93,8 +93,10 @@ class PackedLane:
         if self.ptab is not None:
             # windowed preemption (solve_lane_wave_preempt): spreads stay
             # dense (the preempt slot kernel carries no spread columns);
-            # networks/devices/cores are already excluded for preempt
-            # lanes by tg_solver_eligible(preempt=True)
+            # networks/cores are excluded for preempt lanes by
+            # tg_solver_eligible(preempt=True); devices ride via the
+            # capacity-countdown column when _wave_devices_ok passes
+            # (checked in the shared section below)
             if os.environ.get("NOMAD_TPU_WAVEFRONT_PREEMPT", "1") == "0":
                 return False
             if self.const.spread_vidx.shape[0]:
@@ -115,8 +117,9 @@ class PackedLane:
             if b is None or lim + MAX_SKIP + 1 > b:
                 return False
         c = self.const
-        if (c.dp_vidx.shape[0] or c.dev_aff.shape[0]
-                or c.mhz_per_core.shape[0]):
+        if c.dp_vidx.shape[0] or c.mhz_per_core.shape[0]:
+            return False
+        if c.dev_aff.shape[0] and not self._wave_devices_ok():
             return False
         b = self.batch
         act = np.asarray(b.active)
@@ -130,6 +133,29 @@ class PackedLane:
                 return False
         return wavefront_buffer_size(
             int(np.asarray(b.limit)[0])) is not None
+
+    def _wave_devices_ok(self) -> bool:
+        """Uniform device asks ride the wavefront as a pure capacity
+        dimension (binpack._wave_device_capacity) when the dense device
+        SCORE vanishes (zero affinity weight -> the dense kernel's
+        device component is exactly 0) and the host capacity replay is
+        bounded. Candidate-held matching devices are rejected at pack
+        time (pack returns None -> host fallback), so eviction can
+        never change device availability."""
+        c = self.const
+        if float(np.asarray(c.dev_sum_weight)) != 0.0:
+            return False
+        cnt = np.asarray(c.dev_count)
+        if cnt.size == 0 or (cnt <= 0).any():
+            return False
+        free = np.asarray(self.init.dev_free)
+        if free.size == 0:
+            return False
+        # bounded replay: max per-node instances / min ask under the cap
+        from .binpack import WAVE_DEVICE_CAP_STEPS
+        per_node = np.clip(free, 0, None).sum(axis=(0, 1))
+        return (int(per_node.max(initial=0)) // int(cnt.min())
+                < WAVE_DEVICE_CAP_STEPS)
 
     def wavefront_B(self):
         """Static slot-buffer width for fusion grouping (lanes with
@@ -173,18 +199,21 @@ def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
     structs/job.go TaskGroup.Validate) -- the defensive gates below only
     matter for harness-constructed jobs that bypass registration.
     """
-    has_devices = False
     has_cores = False
     for task in tg.tasks:
         if task.resources.cores > 0:
             has_cores = True
         if task.resources.networks:
             return False
-        if task.resources.devices:
-            has_devices = True
     if len(tg.networks) > 1:
         return False
-    if preempt and (tg.networks or has_devices or has_cores):
+    if preempt and (tg.networks or has_cores):
+        # devices + preemption ARE modeled (dense feas_nonres gates
+        # device-infeasible nodes out of the eviction path exactly like
+        # rank.go:443's nil PreemptForDevice; the windowed kernel
+        # carries a capacity countdown column) -- EXCEPT when evicting
+        # a candidate would free matching instances, which pack()
+        # detects and routes to the host iterator
         return False
     spreads = list(tg.spreads) + (list(job.spreads) if job is not None else [])
     for s in spreads:
@@ -466,9 +495,46 @@ class TpuPlacementService:
         if self.preempt:
             ptab, pinit, cand_allocs = self._pack_preemption(
                 tg, nodes, order, n_pad, dtype, proposed_by_node)
+            if requests and cand_allocs is not None and \
+                    self._cands_hold_matching_devices(requests,
+                                                      cand_allocs,
+                                                      ptab):
+                # evicting such a candidate frees matching device
+                # instances (rank.go:443 PreemptForDevice territory) --
+                # neither the dense nor the windowed preempt kernel
+                # models device release; the host iterator does
+                from ..server.telemetry import metrics as _tm
+                _tm.incr("nomad.solver.device_preempt_host_fallback")
+                return None
         return PackedLane(self, tg, places, nodes, order, const, init,
                           batch, np.dtype(dtype).name, self.spread_alg,
                           ptab=ptab, pinit=pinit, cand_allocs=cand_allocs)
+
+    @staticmethod
+    def _cands_hold_matching_devices(requests, cand_allocs, ptab) -> bool:
+        """Only EVICTABLE candidates matter: rows _pack_preemption masked
+        invalid (own job, terminal, beyond the A truncation) can never be
+        evicted, so their held devices can never be freed -- scanning
+        them would force host fallback for the common grow-an-existing-
+        GPU-job case, where the job's own running allocs hold devices."""
+        names = [r.name for r in requests]
+        # evictable = valid row AND priority-eligible (the kernel's
+        # eligible mask; preemption.go:678 delta >= 10 floor) -- the
+        # host's PreemptForDevice filters candidates identically, so a
+        # device held by an ineligible alloc is equally stuck there
+        valid = (np.asarray(ptab.valid)
+                 & (int(np.asarray(ptab.job_prio))
+                    - np.asarray(ptab.prio) >= 10))
+        A = valid.shape[1]
+        for pos, cands in enumerate(cand_allocs):
+            for a_i, a in enumerate(cands[:A]):
+                if not valid[pos, a_i]:
+                    continue
+                for tr in a.allocated_resources.tasks.values():
+                    for d in tr.devices:
+                        if any(d.matches_request(n) for n in names):
+                            return True
+        return False
 
     def _pack_distinct_property(self, tg, nodes, order, n_pad):
         """distinct_property tables (feasible.go:661, propertyset.go):

@@ -92,6 +92,21 @@ class NodeDiskResources:
     disk_mb: int = 0
 
 
+def _device_matches_request(dev, req_name: str) -> bool:
+    """Shared device-name matching for node groups AND allocated
+    holdings: <type>, <vendor>/<type>, or <vendor>/<type>/<name>
+    (reference: structs.NodeDeviceResource.ID matching)."""
+    parts = req_name.split("/")
+    if len(parts) == 1:
+        return parts[0] == dev.type
+    if len(parts) == 2:
+        return parts[0] == dev.vendor and parts[1] == dev.type
+    if len(parts) == 3:
+        return (parts[0] == dev.vendor and parts[1] == dev.type
+                and parts[2] == dev.name)
+    return False
+
+
 @dataclass
 class NodeDeviceResource:
     """One device group on a node (reference: structs.NodeDeviceResource)."""
@@ -106,16 +121,7 @@ class NodeDeviceResource:
         return f"{self.vendor}/{self.type}/{self.name}"
 
     def matches_request(self, req_name: str) -> bool:
-        """Match by <type>, <vendor>/<type>, or <vendor>/<type>/<name>."""
-        parts = req_name.split("/")
-        if len(parts) == 1:
-            return parts[0] == self.type
-        if len(parts) == 2:
-            return parts[0] == self.vendor and parts[1] == self.type
-        if len(parts) == 3:
-            return (parts[0] == self.vendor and parts[1] == self.type
-                    and parts[2] == self.name)
-        return False
+        return _device_matches_request(self, req_name)
 
 
 @dataclass
@@ -184,6 +190,9 @@ class AllocatedDeviceResource:
 
     def id_string(self) -> str:
         return f"{self.vendor}/{self.type}/{self.name}"
+
+    def matches_request(self, req_name: str) -> bool:
+        return _device_matches_request(self, req_name)
 
 
 @dataclass
