@@ -1,0 +1,295 @@
+"""Sandboxed remote artifact getter (reference:
+client/allocrunner/taskrunner/getter/sandbox.go + params.go +
+z_getter_cmd.go).
+
+The reference downloads artifacts in a RE-INVOKED child process with
+filesystem isolation and hard limits, because artifact URLs are
+operator-supplied remote content: a fetch must not be able to consume
+the client's memory, fill its disk, follow redirects to the metadata
+service, or escape the task directory via a crafted archive. This is
+the same design in Python:
+
+  - the client process builds a ``parameters`` dict (URL, destination,
+    limits) and re-invokes ``sys.executable -m nomad_tpu.client.getter``
+    with the params on stdin;
+  - the child starts its own session, applies RLIMIT_FSIZE /
+    RLIMIT_CPU, chdirs into the destination, and only then talks to
+    the network (scheme allowlist enforced on the initial URL and on
+    EVERY redirect, byte caps enforced while streaming);
+  - archives (.tar.gz/.tgz/.tar/.zip) unpack with path-traversal
+    hardening and decompression count/size limits.
+
+Remote schemes are additionally gated behind NOMAD_TPU_REMOTE_ARTIFACTS=1
+(this build ships into environments without egress; the design must
+exist, the default must be off). file:// and bare paths keep the
+in-process fast path in task_runner.ArtifactHook.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tarfile
+import tempfile
+import urllib.parse
+import urllib.request
+import zipfile
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+DEFAULT_HTTP_READ_TIMEOUT_S = 30 * 60
+DEFAULT_HTTP_MAX_BYTES = 100 * 1024 * 1024 * 1024   # reference: 100GB
+DEFAULT_DECOMPRESSION_FILE_COUNT = 4096
+DEFAULT_DECOMPRESSION_MAX_BYTES = 100 * 1024 * 1024 * 1024
+DEFAULT_MAX_REDIRECTS = 5
+
+
+@dataclass
+class ArtifactConfig:
+    """(reference: client/config ArtifactConfig)"""
+    http_read_timeout_s: float = DEFAULT_HTTP_READ_TIMEOUT_S
+    http_max_bytes: int = DEFAULT_HTTP_MAX_BYTES
+    decompression_limit_file_count: int = DEFAULT_DECOMPRESSION_FILE_COUNT
+    decompression_limit_size: int = DEFAULT_DECOMPRESSION_MAX_BYTES
+    max_redirects: int = DEFAULT_MAX_REDIRECTS
+    allowed_schemes: List[str] = field(
+        default_factory=lambda: ["http", "https"])
+
+
+class ArtifactError(Exception):
+    pass
+
+
+def remote_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_REMOTE_ARTIFACTS", "") == "1"
+
+
+class Sandbox:
+    """Downloads one artifact in an isolated child process."""
+
+    def __init__(self, config: Optional[ArtifactConfig] = None):
+        self.config = config or ArtifactConfig()
+
+    def get(self, source: str, destination: str,
+            mode: str = "any") -> None:
+        """Fetch ``source`` under ``destination`` (a directory for
+        archives/'dir' mode, a file path for 'file' mode). Raises
+        ArtifactError on any failure; partial output is removed."""
+        scheme = urllib.parse.urlparse(source).scheme
+        if scheme not in self.config.allowed_schemes:
+            raise ArtifactError(
+                f"artifact scheme {scheme!r} not allowed "
+                f"(allowed: {self.config.allowed_schemes})")
+        if not remote_enabled():
+            raise ArtifactError(
+                "remote artifact fetching is disabled "
+                "(set NOMAD_TPU_REMOTE_ARTIFACTS=1 and provide egress)")
+        params = {
+            "source": source,
+            "destination": destination,
+            "mode": mode,
+            "http_read_timeout_s": self.config.http_read_timeout_s,
+            "http_max_bytes": self.config.http_max_bytes,
+            "decompression_limit_file_count":
+                self.config.decompression_limit_file_count,
+            "decompression_limit_size":
+                self.config.decompression_limit_size,
+            "max_redirects": self.config.max_redirects,
+            "allowed_schemes": self.config.allowed_schemes,
+        }
+        os.makedirs(destination if mode != "file"
+                    else os.path.dirname(destination) or ".",
+                    exist_ok=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "nomad_tpu.client.getter"],
+                input=json.dumps(params).encode(),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True,
+                timeout=self.config.http_read_timeout_s + 60)
+        except subprocess.SubprocessError as e:
+            raise ArtifactError(f"artifact fetch failed: {e!r}") from None
+        if proc.returncode != 0:
+            tail = proc.stderr.decode(errors="replace")[-2000:]
+            raise ArtifactError(
+                f"artifact fetch failed (rc={proc.returncode}): {tail}")
+
+
+# ---------------------------------------------------------------------------
+# child-process implementation (python -m nomad_tpu.client.getter)
+
+class _CappedReader:
+    """Stream wrapper enforcing the byte cap while reading."""
+
+    def __init__(self, fp, cap: int):
+        self.fp = fp
+        self.remaining = cap
+
+    def read(self, n: int = 65536) -> bytes:
+        chunk = self.fp.read(min(n, self.remaining + 1))
+        if len(chunk) > self.remaining:
+            raise ArtifactError("artifact exceeds http_max_bytes")
+        self.remaining -= len(chunk)
+        return chunk
+
+
+def _fetch_url(params: dict, out_fp) -> None:
+    """GET with scheme allowlist enforced per redirect hop and a byte
+    cap, STREAMING to ``out_fp`` (a 40GB checkpoint must not be held in
+    the child's memory; the reference streams to disk too)."""
+    url = params["source"]
+    allowed = params["allowed_schemes"]
+    redirects = 0
+    while True:
+        scheme = urllib.parse.urlparse(url).scheme
+        if scheme not in allowed:
+            raise ArtifactError(
+                f"redirect to disallowed scheme {scheme!r}: {url}")
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        req = urllib.request.Request(url, headers={
+            "User-Agent": "nomad-tpu-getter"})
+        try:
+            with opener.open(req,
+                             timeout=params["http_read_timeout_s"]) as r:
+                reader = _CappedReader(r, int(params["http_max_bytes"]))
+                while True:
+                    c = reader.read()
+                    if not c:
+                        return
+                    out_fp.write(c)
+        except urllib.error.HTTPError as e:
+            if e.code in (301, 302, 303, 307, 308):
+                redirects += 1
+                if redirects > params["max_redirects"]:
+                    raise ArtifactError("too many redirects") from None
+                loc = e.headers.get("Location", "")
+                url = urllib.parse.urljoin(url, loc)
+                out_fp.seek(0)
+                out_fp.truncate()
+                continue
+            raise ArtifactError(f"HTTP {e.code} fetching {url}") from None
+
+
+def _safe_extract_tar(tf: "tarfile.TarFile", dest: str,
+                      params: dict) -> None:
+    count = 0
+    total = 0
+    base = os.path.realpath(dest)
+    for m in tf:
+        count += 1
+        if count > params["decompression_limit_file_count"]:
+            raise ArtifactError("archive exceeds file-count limit")
+        total += max(m.size, 0)
+        if total > params["decompression_limit_size"]:
+            raise ArtifactError("archive exceeds decompressed-size limit")
+        target = os.path.realpath(os.path.join(dest, m.name))
+        if not (target == base or target.startswith(base + os.sep)):
+            raise ArtifactError(f"archive path escapes destination: "
+                                f"{m.name!r}")
+        if m.issym() or m.islnk():
+            link_target = os.path.realpath(
+                os.path.join(os.path.dirname(target), m.linkname))
+            if not (link_target == base
+                    or link_target.startswith(base + os.sep)):
+                raise ArtifactError(
+                    f"archive link escapes destination: {m.name!r}")
+        tf.extract(m, dest, filter="tar")
+
+
+def _safe_extract_zip(zf: "zipfile.ZipFile", dest: str,
+                      params: dict) -> None:
+    base = os.path.realpath(dest)
+    infos = zf.infolist()
+    if len(infos) > params["decompression_limit_file_count"]:
+        raise ArtifactError("archive exceeds file-count limit")
+    if sum(i.file_size for i in infos) > params["decompression_limit_size"]:
+        raise ArtifactError("archive exceeds decompressed-size limit")
+    for i in infos:
+        target = os.path.realpath(os.path.join(dest, i.filename))
+        if not (target == base or target.startswith(base + os.sep)):
+            raise ArtifactError(f"archive path escapes destination: "
+                                f"{i.filename!r}")
+    zf.extractall(dest)
+
+
+def _child_main() -> int:
+    params = json.loads(sys.stdin.read())
+    # isolation: own session (the Sandbox already starts one), tight
+    # umask, CPU + file-size rlimits, cwd pinned to the destination
+    try:
+        import resource
+        cap = int(params["http_max_bytes"])
+        resource.setrlimit(resource.RLIMIT_FSIZE, (cap, cap))
+        cpu = int(params["http_read_timeout_s"]) + 120
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu))
+    except (ImportError, ValueError, OSError):
+        pass
+    os.umask(0o022)
+
+    source = params["source"]
+    dest = params["destination"]
+    mode = params["mode"]
+
+    path = urllib.parse.urlparse(source).path
+    name = os.path.basename(path) or "artifact"
+    if mode == "file":
+        # download beside the target, promote atomically: a failed or
+        # killed fetch never leaves a partial file at the destination
+        part = dest + ".part"
+        try:
+            with open(part, "wb") as f:
+                _fetch_url(params, f)
+            os.replace(part, dest)
+        finally:
+            if os.path.exists(part):
+                os.unlink(part)
+        return 0
+    os.makedirs(dest, exist_ok=True)
+    os.chdir(dest)
+    lower = name.lower()
+    # extract into a staging dir, then move entries into the (possibly
+    # shared) destination only on success: a traversal entry found
+    # halfway through must not leave attacker-ordered partial files
+    staging = tempfile.mkdtemp(prefix=".getter-", dir=dest)
+    try:
+        with tempfile.NamedTemporaryFile(suffix=name) as tmp:
+            _fetch_url(params, tmp)
+            tmp.flush()
+            if lower.endswith((".tar.gz", ".tgz", ".tar.bz2", ".tar")):
+                with tarfile.open(tmp.name) as tf:
+                    _safe_extract_tar(tf, staging, params)
+            elif lower.endswith(".zip"):
+                with zipfile.ZipFile(tmp.name) as zf:
+                    _safe_extract_zip(zf, staging, params)
+            else:
+                shutil.copyfile(tmp.name, os.path.join(staging, name))
+        for entry in os.listdir(staging):
+            target = os.path.join(dest, entry)
+            if os.path.isdir(target) and \
+                    os.path.isdir(os.path.join(staging, entry)):
+                shutil.copytree(os.path.join(staging, entry), target,
+                                symlinks=True, dirs_exist_ok=True)
+            else:
+                os.replace(os.path.join(staging, entry), target)
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(_child_main())
+    except ArtifactError as e:
+        print(f"getter: {e}", file=sys.stderr)
+        sys.exit(3)
+    except Exception as e:  # noqa: BLE001 -- child must report, not trace
+        import traceback
+        traceback.print_exc()
+        sys.exit(4)

@@ -11,11 +11,14 @@ in command assembly exactly like dockerd's. Logs need no separate
 pipeline: the payload's stdout/stderr are the task's log files already
 (the reference needs docklog because dockerd owns the stream).
 
-Registry pulls are deliberately OUT by default: this environment has no
-egress, and an image fetched at task start is a supply-chain liability
-the artifact path avoids. `registry://` image references raise unless
-NOMAD_TPU_IMAGE_PULL=1 opts in, and the pull itself (OCI distribution
-v2 GET manifest/blobs) is left to the operator's artifact stanza.
+Registry pulls are deliberately OFF by default: the default deployment
+has no egress, and an image fetched at task start is a supply-chain
+liability the artifact path avoids. `registry://` image references
+raise unless NOMAD_TPU_IMAGE_PULL=1 opts in; with the opt-in, the
+native OCI distribution v2 puller (client/registry.py: manifest
+negotiation, anonymous Bearer token auth, digest-verified blobs) pulls
+into a scratch image-layout that flattens through the same
+unpack_oci_layout path as file-shipped layouts.
 """
 from __future__ import annotations
 
@@ -254,7 +257,14 @@ def materialize(image: str, rootfs: str, scratch: str) -> ImageConfig:
                 "registry pulls are disabled (set NOMAD_TPU_IMAGE_PULL=1 "
                 "and provide egress); ship the image as an OCI layout or "
                 "docker-archive artifact instead")
-        raise ImageError("registry transport not available in this build")
+        from .registry import pull
+        layout = os.path.join(scratch, "registry-pull")
+        shutil.rmtree(layout, ignore_errors=True)
+        pull(image, layout)
+        try:
+            return unpack_oci_layout(layout, rootfs)
+        finally:
+            shutil.rmtree(layout, ignore_errors=True)
     if fmt == "rootfs-dir":
         shutil.copytree(image, rootfs, symlinks=True)
         return ImageConfig()

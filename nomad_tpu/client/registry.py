@@ -1,0 +1,245 @@
+"""OCI distribution v2 registry puller (reference: the docker driver's
+daemon-side pull; here a native client so `image = "registry://..."`
+works without a docker daemon).
+
+Pulls manifest + blobs over the v2 API into a local OCI image-layout
+directory, which the existing oci.unpack_oci_layout path flattens --
+one download path, one unpack path. Supports:
+
+  - image refs:  host[:port]/name[:tag][@sha256:digest]
+  - manifest media types: OCI image manifest / index, Docker schema2
+    manifest / manifest list (index resolves to the first
+    linux-compatible entry, like oci.unpack_oci_layout's first-entry
+    rule);
+  - token auth: a 401 with WWW-Authenticate: Bearer realm=... is
+    retried once with a token fetched from the realm (anonymous pull
+    flow of public registries);
+  - digest verification on every blob (sha256 recomputed while
+    streaming -- a registry or proxy can't substitute content).
+
+Gated by NOMAD_TPU_IMAGE_PULL=1 (callers check; this module never
+reads the env): the default deployment has no egress and a task-start
+pull is a supply-chain liability the artifact path avoids.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+from .oci import ImageError
+
+MEDIA_OCI_INDEX = "application/vnd.oci.image.index.v1+json"
+MEDIA_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
+MEDIA_DOCKER_LIST = ("application/vnd.docker.distribution.manifest."
+                     "list.v2+json")
+MEDIA_DOCKER_MANIFEST = ("application/vnd.docker.distribution.manifest."
+                         "v2+json")
+ACCEPT = ", ".join([MEDIA_OCI_MANIFEST, MEDIA_OCI_INDEX,
+                    MEDIA_DOCKER_MANIFEST, MEDIA_DOCKER_LIST])
+
+MAX_MANIFEST_BYTES = 4 * 1024 * 1024
+MAX_BLOB_BYTES = 20 * 1024 * 1024 * 1024
+
+
+def parse_ref(image: str) -> Tuple[str, str, str]:
+    """registry://host[:port]/name[:tag][@digest] ->
+    (base_url, name, reference)."""
+    for prefix in ("registry://", "docker://"):
+        if image.startswith(prefix):
+            image = image[len(prefix):]
+            break
+    host, _, rest = image.partition("/")
+    if not rest:
+        raise ImageError(f"bad image reference (no repository): {image}")
+    digest = ""
+    if "@" in rest:
+        rest, _, digest = rest.partition("@")
+    tag = "latest"
+    if ":" in rest.rsplit("/", 1)[-1]:
+        rest, _, tag = rest.rpartition(":")
+    scheme = "http" if (host.startswith("127.") or host.startswith(
+        "localhost")) else "https"
+    return f"{scheme}://{host}", rest, digest or tag
+
+
+class _Client:
+    def __init__(self, base: str, timeout: float = 300.0):
+        self.base = base
+        self.timeout = timeout
+        self.token: Optional[str] = None
+
+    def _request(self, path: str, headers: Dict[str, str],
+                 cap: int) -> Tuple[bytes, Dict[str, str]]:
+        url = f"{self.base}{path}"
+        hdrs = dict(headers)
+        if self.token:
+            hdrs["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                chunks, total = [], 0
+                while True:
+                    c = r.read(1 << 20)
+                    if not c:
+                        break
+                    total += len(c)
+                    if total > cap:
+                        raise ImageError(
+                            f"registry response exceeds {cap} bytes")
+                    chunks.append(c)
+                return b"".join(chunks), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and self.token is None:
+                challenge = e.headers.get("WWW-Authenticate", "")
+                self.token = self._fetch_token(challenge)
+                if self.token:
+                    return self._request(path, headers, cap)
+            raise ImageError(f"registry HTTP {e.code} for {path}") from None
+        except urllib.error.URLError as e:
+            raise ImageError(f"registry unreachable: {e.reason}") from None
+
+    def _open(self, path: str, headers: Dict[str, str]):
+        """Open a streaming response (blob downloads); retries once
+        through the token flow on 401 like _request."""
+        url = f"{self.base}{path}"
+        hdrs = dict(headers)
+        if self.token:
+            hdrs["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, headers=hdrs)
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and self.token is None:
+                self.token = self._fetch_token(
+                    e.headers.get("WWW-Authenticate", ""))
+                if self.token:
+                    return self._open(path, headers)
+            raise ImageError(f"registry HTTP {e.code} for {path}") from None
+        except urllib.error.URLError as e:
+            raise ImageError(f"registry unreachable: {e.reason}") from None
+
+    def _fetch_token(self, challenge: str) -> Optional[str]:
+        """Anonymous Bearer token flow (distribution spec auth)."""
+        m = re.match(r'Bearer\s+(.*)', challenge)
+        if not m:
+            return None
+        fields = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+        realm = fields.pop("realm", "")
+        if not realm:
+            return None
+        qs = urllib.parse.urlencode(fields)
+        try:
+            with urllib.request.urlopen(f"{realm}?{qs}",
+                                        timeout=self.timeout) as r:
+                data = json.loads(r.read(1 << 20))
+            return data.get("token") or data.get("access_token")
+        except (urllib.error.URLError, ValueError):
+            return None
+
+
+def pull(image: str, layout_dir: str) -> str:
+    """Pull ``image`` into an OCI image-layout at ``layout_dir``;
+    returns layout_dir. Every blob is digest-verified."""
+    base, name, ref = parse_ref(image)
+    client = _Client(base)
+    os.makedirs(os.path.join(layout_dir, "blobs", "sha256"),
+                exist_ok=True)
+
+    def save_blob(raw: bytes, digest: str) -> None:
+        algo, _, hexd = digest.partition(":")
+        actual = hashlib.new(algo or "sha256", raw).hexdigest()
+        if actual != hexd:
+            raise ImageError(
+                f"blob digest mismatch for {digest}: got {algo}:{actual}")
+        path = os.path.join(layout_dir, "blobs", algo, hexd)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(raw)
+
+    def fetch_blob_to_layout(digest: str, cap: int) -> None:
+        """Stream one blob to its layout path, hashing as it lands (a
+        multi-GB layer must not be buffered in memory); a digest
+        mismatch removes the partial file."""
+        algo, _, hexd = digest.partition(":")
+        path = os.path.join(layout_dir, "blobs", algo or "sha256", hexd)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        h = hashlib.new(algo or "sha256")
+        total = 0
+        part = path + ".part"
+        try:
+            with client._open(f"/v2/{name}/blobs/{digest}", {}) as r, \
+                    open(part, "wb") as f:
+                while True:
+                    c = r.read(1 << 20)
+                    if not c:
+                        break
+                    total += len(c)
+                    if total > cap:
+                        raise ImageError(
+                            f"blob {digest} exceeds {cap} bytes")
+                    h.update(c)
+                    f.write(c)
+            if h.hexdigest() != hexd:
+                raise ImageError(
+                    f"blob digest mismatch for {digest}: got "
+                    f"{algo}:{h.hexdigest()}")
+            os.replace(part, path)
+        finally:
+            if os.path.exists(part):
+                os.unlink(part)
+
+    raw, headers = client._request(
+        f"/v2/{name}/manifests/{ref}", {"Accept": ACCEPT},
+        MAX_MANIFEST_BYTES)
+    if ":" in ref:
+        # digest-pinned pull: the served bytes MUST hash to the pin --
+        # this is the whole point of the @digest syntax
+        algo, _, hexd = ref.partition(":")
+        actual = hashlib.new(algo, raw).hexdigest()
+        if actual != hexd:
+            raise ImageError(
+                f"pinned manifest digest mismatch: asked {ref}, got "
+                f"{algo}:{actual}")
+    manifest = json.loads(raw)
+    media = (manifest.get("mediaType")
+             or headers.get("Content-Type", "").split(";")[0])
+    if media in (MEDIA_OCI_INDEX, MEDIA_DOCKER_LIST) \
+            or "manifests" in manifest:
+        entries = manifest.get("manifests") or []
+        if not entries:
+            raise ImageError("image index has no manifests")
+        chosen = next(
+            (e for e in entries
+             if e.get("platform", {}).get("os") in ("linux", None)),
+            entries[0])
+        digest = chosen["digest"]
+        raw, _ = client._request(
+            f"/v2/{name}/manifests/{digest}", {"Accept": ACCEPT},
+            MAX_MANIFEST_BYTES)
+        manifest = json.loads(raw)
+        save_blob(raw, digest)
+        manifest_digest = digest
+    else:
+        manifest_digest = ("sha256:"
+                           + hashlib.sha256(raw).hexdigest())
+        save_blob(raw, manifest_digest)
+
+    cfg = manifest.get("config", {})
+    if cfg.get("digest"):
+        fetch_blob_to_layout(cfg["digest"], MAX_BLOB_BYTES)
+    for layer in manifest.get("layers") or []:
+        fetch_blob_to_layout(layer["digest"], MAX_BLOB_BYTES)
+
+    with open(os.path.join(layout_dir, "oci-layout"), "w") as f:
+        json.dump({"imageLayoutVersion": "1.0.0"}, f)
+    with open(os.path.join(layout_dir, "index.json"), "w") as f:
+        json.dump({"schemaVersion": 2, "manifests": [
+            {"mediaType": MEDIA_OCI_MANIFEST,
+             "digest": manifest_digest}]}, f)
+    return layout_dir

@@ -108,14 +108,37 @@ class EnvHook(TaskHook):
 
 
 class ArtifactHook(TaskHook):
-    """Fetch artifacts into the task dir. Only file:// and bare local
-    paths are supported -- remote getters (the reference's go-getter
-    sandbox, taskrunner/getter/) need egress this environment forbids."""
+    """Fetch artifacts into the task dir. file:// and bare local paths
+    copy in-process; http(s):// routes through the sandboxed getter
+    subprocess (client/getter.py -- the reference's go-getter sandbox,
+    taskrunner/getter/sandbox.go), gated behind
+    NOMAD_TPU_REMOTE_ARTIFACTS=1 since this build's default
+    environment has no egress."""
     name = "artifacts"
 
     def prestart(self, runner: "TaskRunner") -> None:
         for art in runner.task.artifacts or []:
             source = str(art.get("source", ""))
+            if source.split("://", 1)[0] in ("http", "https"):
+                from .getter import ArtifactError, Sandbox
+                local = os.path.realpath(runner.task_dir.local_dir)
+                rel = str(art.get("destination", "")) or ""
+                mode = str(art.get("mode", "any"))
+                if mode == "file" and not rel:
+                    # a file needs a name; default to the URL basename
+                    from urllib.parse import urlparse
+                    rel = os.path.basename(urlparse(source).path) \
+                        or "artifact"
+                dest = os.path.realpath(os.path.join(local, rel))
+                if not (dest == local or dest.startswith(local + os.sep)):
+                    raise DriverError(
+                        f"artifact destination escapes the task dir: "
+                        f"{rel!r}")
+                try:
+                    Sandbox().get(source, dest, mode=mode)
+                except ArtifactError as e:
+                    raise DriverError(str(e)) from None
+                continue
             if source.startswith("file://"):
                 source = source[len("file://"):]
             if not source or not os.path.exists(source):

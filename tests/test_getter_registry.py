@@ -1,0 +1,330 @@
+"""Sandboxed remote artifact getter + native OCI registry puller
+(VERDICT r4 missing #3 / next-step 10; reference:
+client/allocrunner/taskrunner/getter/sandbox.go and the docker
+driver's pull path). Everything runs against in-process HTTP servers
+-- no egress needed to prove the designs."""
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nomad_tpu.client.getter import ArtifactConfig, ArtifactError, Sandbox
+from nomad_tpu.client.oci import ImageError, materialize
+from nomad_tpu.client.registry import parse_ref, pull
+
+
+@pytest.fixture
+def remote_on(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_REMOTE_ARTIFACTS", "1")
+
+
+def _serve(routes):
+    """Tiny HTTP server: routes = {path: (status, headers, body)}."""
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            entry = routes.get(self.path.split("?")[0])
+            if entry is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            status, headers, body = entry
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+def _targz(files):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def test_remote_disabled_by_default(tmp_path):
+    with pytest.raises(ArtifactError, match="disabled"):
+        Sandbox().get("http://127.0.0.1:1/x", str(tmp_path / "d"))
+
+
+def test_fetch_file_and_archive(remote_on, tmp_path):
+    tar = _targz({"a/b.txt": b"hello", "c.txt": b"world"})
+    srv, base = _serve({
+        "/plain.bin": (200, {}, b"payload"),
+        "/bundle.tar.gz": (200, {}, tar),
+    })
+    try:
+        out = tmp_path / "f" / "plain.bin"
+        Sandbox().get(f"{base}/plain.bin", str(out), mode="file")
+        assert out.read_bytes() == b"payload"
+
+        d = tmp_path / "d"
+        Sandbox().get(f"{base}/bundle.tar.gz", str(d))
+        assert (d / "a" / "b.txt").read_bytes() == b"hello"
+        assert (d / "c.txt").read_bytes() == b"world"
+    finally:
+        srv.shutdown()
+
+
+def test_size_cap_and_redirect_policy(remote_on, tmp_path):
+    srv, base = _serve({
+        "/big.bin": (200, {}, b"x" * 4096),
+        "/hop": (302, {"Location": "/hop"}, b""),
+        "/to-file-scheme": (302, {"Location": "file:///etc/passwd"}, b""),
+    })
+    try:
+        cfg = ArtifactConfig(http_max_bytes=1024)
+        with pytest.raises(ArtifactError, match="max_bytes|failed"):
+            Sandbox(cfg).get(f"{base}/big.bin",
+                             str(tmp_path / "a"), mode="file")
+        with pytest.raises(ArtifactError, match="redirect|failed"):
+            Sandbox().get(f"{base}/hop", str(tmp_path / "b"), mode="file")
+        with pytest.raises(ArtifactError, match="scheme|failed"):
+            Sandbox().get(f"{base}/to-file-scheme",
+                          str(tmp_path / "c"), mode="file")
+    finally:
+        srv.shutdown()
+
+
+def test_archive_traversal_and_limits(remote_on, tmp_path):
+    evil = _targz({"../../escape.txt": b"evil"})
+    many = _targz({f"f{i}": b"x" for i in range(40)})
+    srv, base = _serve({
+        "/evil.tar.gz": (200, {}, evil),
+        "/many.tar.gz": (200, {}, many),
+    })
+    try:
+        with pytest.raises(ArtifactError, match="escape|failed"):
+            Sandbox().get(f"{base}/evil.tar.gz", str(tmp_path / "e"))
+        assert not (tmp_path.parent / "escape.txt").exists()
+        cfg = ArtifactConfig(decompression_limit_file_count=10)
+        with pytest.raises(ArtifactError, match="count|failed"):
+            Sandbox(cfg).get(f"{base}/many.tar.gz", str(tmp_path / "m"))
+    finally:
+        srv.shutdown()
+
+
+def test_zip_archive(remote_on, tmp_path):
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("z/inner.txt", "zipped")
+    srv, base = _serve({"/a.zip": (200, {}, buf.getvalue())})
+    try:
+        d = tmp_path / "z"
+        Sandbox().get(f"{base}/a.zip", str(d))
+        assert (d / "z" / "inner.txt").read_text() == "zipped"
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# registry puller
+
+def _digest(raw: bytes) -> str:
+    return "sha256:" + hashlib.sha256(raw).hexdigest()
+
+
+def _fake_registry(token_auth=False):
+    """An OCI distribution v2 registry serving one single-layer image
+    (manifest list -> manifest -> config + layer)."""
+    layer_tar = io.BytesIO()
+    with tarfile.open(fileobj=layer_tar, mode="w") as tf:
+        info = tarfile.TarInfo("hello.txt")
+        data = b"from the registry"
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    layer = gzip.compress(layer_tar.getvalue())
+    config = json.dumps({"config": {"Entrypoint": ["/hello"]}}).encode()
+    manifest = json.dumps({
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "config": {"digest": _digest(config), "size": len(config)},
+        "layers": [{"digest": _digest(layer), "size": len(layer)}],
+    }).encode()
+    index = json.dumps({
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.index.v1+json",
+        "manifests": [{"digest": _digest(manifest),
+                       "platform": {"os": "linux"}}],
+    }).encode()
+
+    state = {"authed": not token_auth}
+    routes = {}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/token":
+                state["authed"] = True
+                body = json.dumps({"token": "anon-tok"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if token_auth and \
+                    self.headers.get("Authorization") != "Bearer anon-tok":
+                self.send_response(401)
+                self.send_header(
+                    "WWW-Authenticate",
+                    f'Bearer realm="http://127.0.0.1:{srv.server_port}'
+                    f'/token",service="reg"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = None
+            ctype = "application/octet-stream"
+            if path == "/v2/library/hello/manifests/1.0":
+                body, ctype = index, \
+                    "application/vnd.oci.image.index.v1+json"
+            elif path == f"/v2/library/hello/manifests/{_digest(manifest)}":
+                body, ctype = manifest, \
+                    "application/vnd.oci.image.manifest.v1+json"
+            elif path == f"/v2/library/hello/blobs/{_digest(config)}":
+                body = config
+            elif path == f"/v2/library/hello/blobs/{_digest(layer)}":
+                body = layer
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_parse_ref():
+    assert parse_ref("registry://127.0.0.1:5000/library/hello:1.0") == \
+        ("http://127.0.0.1:5000", "library/hello", "1.0")
+    assert parse_ref("docker://reg.example.com/app") == \
+        ("https://reg.example.com", "app", "latest")
+    base, name, ref = parse_ref(
+        "registry://localhost:5000/a/b@sha256:abcd")
+    assert ref == "sha256:abcd"
+
+
+@pytest.mark.parametrize("token_auth", [False, True])
+def test_registry_pull_to_layout_and_materialize(tmp_path, monkeypatch,
+                                                 token_auth):
+    srv = _fake_registry(token_auth=token_auth)
+    try:
+        image = (f"registry://127.0.0.1:{srv.server_port}"
+                 f"/library/hello:1.0")
+        layout = tmp_path / "layout"
+        pull(image, str(layout))
+        assert (layout / "oci-layout").exists()
+        assert (layout / "index.json").exists()
+
+        # the gate: disabled by default
+        rootfs = tmp_path / "rootfs"
+        monkeypatch.delenv("NOMAD_TPU_IMAGE_PULL", raising=False)
+        with pytest.raises(ImageError, match="disabled"):
+            materialize(image, str(rootfs), str(tmp_path / "scratch"))
+
+        # opt-in: full pull -> layout -> flatten path
+        monkeypatch.setenv("NOMAD_TPU_IMAGE_PULL", "1")
+        cfg = materialize(image, str(rootfs), str(tmp_path / "scratch"))
+        assert (rootfs / "hello.txt").read_bytes() == b"from the registry"
+        assert cfg.entrypoint == ["/hello"]
+    finally:
+        srv.shutdown()
+
+
+def test_registry_pull_verifies_digest_pin(tmp_path):
+    """@sha256:... pins must be verified against the served manifest
+    bytes -- a registry serving different content for the pinned path
+    must be rejected."""
+    srv = _fake_registry()
+    try:
+        wrong = "sha256:" + "0" * 64
+        image = (f"registry://127.0.0.1:{srv.server_port}"
+                 f"/library/hello@{wrong}")
+        import nomad_tpu.client.registry as reg
+        orig = reg._Client._request
+
+        def serve_anything(self, path, headers, cap):
+            # registry answers the pinned path with the 1.0 index
+            return orig(self, path.replace(wrong, "1.0"), headers, cap)
+
+        reg._Client._request = serve_anything
+        try:
+            with pytest.raises(ImageError, match="pinned manifest"):
+                pull(image, str(tmp_path / "layout"))
+        finally:
+            reg._Client._request = orig
+    finally:
+        srv.shutdown()
+
+
+def test_registry_pull_rejects_corrupt_blob(tmp_path):
+    srv = _fake_registry()
+    try:
+        # corrupt: point the puller at a manifest whose digest is right
+        # but serve a WRONG layer body by patching the route table --
+        # simplest equivalent: ask for a repo path that returns the
+        # config blob where the layer digest is expected
+        image = (f"registry://127.0.0.1:{srv.server_port}"
+                 f"/library/hello:1.0")
+        layout = tmp_path / "layout"
+        import nomad_tpu.client.registry as reg
+
+        orig = reg._Client._open
+
+        class Tampered:
+            def __init__(self, r):
+                self.r = r
+                self.done = False
+
+            def read(self, n=-1):
+                c = self.r.read(n)
+                if not c and not self.done:
+                    self.done = True
+                    return b"tamper"
+                return c
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                self.r.close()
+
+        def tampered(self, path, headers):
+            r = orig(self, path, headers)
+            return Tampered(r) if "/blobs/" in path else r
+
+        reg._Client._open = tampered
+        try:
+            with pytest.raises(ImageError, match="digest mismatch"):
+                pull(image, str(layout))
+        finally:
+            reg._Client._open = orig
+    finally:
+        srv.shutdown()
