@@ -194,8 +194,14 @@ def _safe_extract_tar(tf: "tarfile.TarFile", dest: str,
             raise ArtifactError(f"archive path escapes destination: "
                                 f"{m.name!r}")
         if m.issym() or m.islnk():
+            # symlinks resolve relative to the LINK's directory;
+            # hardlinks resolve relative to the EXTRACTION ROOT (that is
+            # what tarfile.makelink does) -- checking the wrong base
+            # would approve nested hardlinks whose ../ chains land
+            # outside the sandbox
+            link_base = os.path.dirname(target) if m.issym() else dest
             link_target = os.path.realpath(
-                os.path.join(os.path.dirname(target), m.linkname))
+                os.path.join(link_base, m.linkname))
             if not (link_target == base
                     or link_target.startswith(base + os.sep)):
                 raise ArtifactError(

@@ -63,8 +63,14 @@ def parse_ref(image: str) -> Tuple[str, str, str]:
     tag = "latest"
     if ":" in rest.rsplit("/", 1)[-1]:
         rest, _, tag = rest.rpartition(":")
-    scheme = "http" if (host.startswith("127.") or host.startswith(
-        "localhost")) else "https"
+    # plain http ONLY for genuine loopback -- a hostname merely
+    # STARTING with "localhost"/"127." (localhost.attacker.com) must
+    # not downgrade the transport and leak pulls/tokens in cleartext
+    hostname = host.rsplit(":", 1)[0] if not host.startswith("[") \
+        else host[1:].split("]")[0]
+    is_loopback = (hostname == "localhost" or hostname == "::1"
+                   or re.fullmatch(r"127(\.\d{1,3}){3}", hostname))
+    scheme = "http" if is_loopback else "https"
     return f"{scheme}://{host}", rest, digest or tag
 
 
@@ -76,33 +82,20 @@ class _Client:
 
     def _request(self, path: str, headers: Dict[str, str],
                  cap: int) -> Tuple[bytes, Dict[str, str]]:
-        url = f"{self.base}{path}"
-        hdrs = dict(headers)
-        if self.token:
-            hdrs["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(url, headers=hdrs)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                chunks, total = [], 0
-                while True:
-                    c = r.read(1 << 20)
-                    if not c:
-                        break
-                    total += len(c)
-                    if total > cap:
-                        raise ImageError(
-                            f"registry response exceeds {cap} bytes")
-                    chunks.append(c)
-                return b"".join(chunks), dict(r.headers)
-        except urllib.error.HTTPError as e:
-            if e.code == 401 and self.token is None:
-                challenge = e.headers.get("WWW-Authenticate", "")
-                self.token = self._fetch_token(challenge)
-                if self.token:
-                    return self._request(path, headers, cap)
-            raise ImageError(f"registry HTTP {e.code} for {path}") from None
-        except urllib.error.URLError as e:
-            raise ImageError(f"registry unreachable: {e.reason}") from None
+        """Buffered GET with a byte cap; auth/error handling lives in
+        _open (one copy of the 401 Bearer retry flow)."""
+        with self._open(path, headers) as r:
+            chunks, total = [], 0
+            while True:
+                c = r.read(1 << 20)
+                if not c:
+                    break
+                total += len(c)
+                if total > cap:
+                    raise ImageError(
+                        f"registry response exceeds {cap} bytes")
+                chunks.append(c)
+            return b"".join(chunks), dict(r.headers)
 
     def _open(self, path: str, headers: Dict[str, str]):
         """Open a streaming response (blob downloads); retries once

@@ -117,7 +117,8 @@ async function viewJob(id) {
   const evRows = evals.map((e) => [
     shortId(e.id), badge(e.status), esc(e.triggered_by), esc(e.type),
   ]);
-  return h(`<h1>${esc(job.id)} ${badge(job.status)}</h1>
+  return h(`<h1>${esc(job.id)} ${badge(job.status)}
+    <a class="btn" href="#/job/${encodeURIComponent(job.id)}/versions">versions</a></h1>
     <p class="muted">${esc(job.type)} · priority ${esc(job.priority)} · v${esc(job.version)}</p>` +
     (sumRows.length ? `<h2>Summary</h2>` +
       table(["Group", "Queued", "Starting", "Running", "Complete",
@@ -254,6 +255,7 @@ async function viewAlloc(id) {
     `<h2>Actions</h2><p>
       <button onclick="allocAction('${encodeURIComponent(a.id)}', 'restart')">Restart</button>
       <button onclick="allocAction('${encodeURIComponent(a.id)}', 'stop')">Stop &amp; reschedule</button>
+      <a class="btn" href="#/allocation/${encodeURIComponent(a.id)}/exec">Exec</a>
       <span id="action-result" class="muted"></span></p>`);
 }
 
@@ -354,6 +356,238 @@ async function viewMetrics() {
     `<h2>Counters</h2>` + table(["Counter", "Value"], counterRows));
 }
 
+/* ----- topology (reference: ui/app/components/topo-viz) ----- */
+
+async function viewTopology() {
+  const [nodes, allocs] = await Promise.all([
+    api("/v1/nodes"), api("/v1/allocations"),
+  ]);
+  const byNode = {};
+  for (const a of allocs) {
+    if (a.desired_status !== "run") continue;
+    (byNode[a.node_id] ||= []).push(a);
+  }
+  // group by datacenter; each node is a cell sized/colored by alloc
+  // density so hotspots and empty racks read at a glance
+  const dcs = {};
+  for (const n of nodes) (dcs[n.datacenter] ||= []).push(n);
+  let out = `<h1>Topology <span class="muted">${nodes.length} nodes ·
+    ${allocs.filter((a) => a.desired_status === "run").length} running allocs</span></h1>`;
+  for (const [dc, dcNodes] of Object.entries(dcs).sort()) {
+    const cells = dcNodes.map((n) => {
+      const na = byNode[n.id] || [];
+      const cap = n.node_resources?.cpu?.cpu_shares || 1;
+      const used = na.reduce((s, a) => {
+        const tasks = a.allocated_resources?.tasks || {};
+        return s + Object.values(tasks).reduce(
+          (t, tr) => t + (tr.cpu_shares || 0), 0);
+      }, 0);
+      const pct = Math.min(100, Math.round((100 * used) / cap));
+      const cls = n.status !== "ready" ? "down"
+        : pct >= 85 ? "hot" : pct >= 50 ? "warm" : "";
+      return `<a class="topo-cell ${cls}" href="#/node/${encodeURIComponent(n.id)}"
+        title="${esc(n.name)} · ${na.length} allocs · ${pct}% cpu"
+        style="--fill:${pct}%"><i></i></a>`;
+    }).join("");
+    out += `<h2>${esc(dc)} <span class="muted">${dcNodes.length} nodes</span></h2>
+      <div class="topo-grid">${cells}</div>`;
+  }
+  out += `<p class="muted">cell fill = cpu allocated; amber &ge; 50%,
+    red &ge; 85%, grey = node down. Click a cell for node detail.</p>`;
+  return h(out);
+}
+
+/* ----- exec terminal (reference: ui exec-socket-xterm-adapter; the
+   backend exec is one-shot, so this is a command console, each RUN a
+   fresh /v1/client/allocation/<id>/exec round trip) ----- */
+
+function viewExec(allocId) {
+  setTimeout(async () => {
+    const inp = document.getElementById("exec-cmd");
+    if (inp) inp.focus();
+    try {
+      const a = await api(`/v1/allocation/${encodeURIComponent(allocId)}`);
+      const sel = document.getElementById("exec-task");
+      if (sel && a.task_states) {
+        sel.innerHTML = Object.keys(a.task_states).map(
+          (t) => `<option>${esc(t)}</option>`).join("");
+      }
+    } catch { /* task selector stays empty; server picks default */ }
+  }, 0);
+  return h(`<h1>Exec <span class="mono">${shortId(allocId)}</span></h1>
+    <div class="term" id="term-out"><div class="muted">one-shot exec:
+      each command runs fresh in the task's context (no pty state
+      carries over)</div></div>
+    <form class="term-input"
+      onsubmit="return runExec('${encodeURIComponent(allocId)}')">
+      <span class="mono accent">$</span>
+      <input type="text" id="exec-cmd" class="mono" autocomplete="off"
+             placeholder="command…">
+      <select id="exec-task" class="mono"></select>
+    </form>`);
+}
+
+window.runExec = function (allocIdEnc) {
+  const allocId = decodeURIComponent(allocIdEnc);
+  const inp = document.getElementById("exec-cmd");
+  const out = document.getElementById("term-out");
+  const cmd = (inp.value || "").trim();
+  if (!cmd) return false;
+  inp.value = "";
+  const taskSel = document.getElementById("exec-task");
+  const task = taskSel?.value || "";
+  const echo = document.createElement("div");
+  echo.innerHTML = `<span class="accent mono">$ ${esc(cmd)}</span>`;
+  out.appendChild(echo);
+  fetch(`/v1/client/allocation/${encodeURIComponent(allocId)}/exec`, {
+    method: "POST",
+    headers: {...authHeaders(), "Content-Type": "application/json"},
+    body: JSON.stringify({cmd: ["/bin/sh", "-c", cmd], task}),
+  }).then(async (r) => {
+    const body = await r.json().catch(() => ({}));
+    const div = document.createElement("div");
+    if (!r.ok) {
+      div.innerHTML = `<span class="badge error">HTTP ${r.status}</span>
+        <pre class="log">${esc(JSON.stringify(body))}</pre>`;
+    } else {
+      div.innerHTML = `<pre class="log">${esc(body.stdout || "")}${
+        body.stderr ? "\n[stderr]\n" + esc(body.stderr) : ""}</pre>
+        <span class="muted">exit ${esc(body.exit_code ?? "?")}</span>`;
+    }
+    out.appendChild(div);
+    out.scrollTop = out.scrollHeight;
+  }).catch((e) => {
+    const div = document.createElement("div");
+    div.innerHTML = `<span class="badge error">${esc(e.message)}</span>`;
+    out.appendChild(div);
+  });
+  return false;
+};
+
+/* ----- job versions + diff (reference: ui job-version models) ----- */
+
+function flatten(obj, prefix, out) {
+  if (obj === null || typeof obj !== "object") {
+    out[prefix] = JSON.stringify(obj);
+    return out;
+  }
+  const entries = Array.isArray(obj)
+    ? obj.map((v, i) => [i, v]) : Object.entries(obj);
+  if (!entries.length) out[prefix] = Array.isArray(obj) ? "[]" : "{}";
+  for (const [k, v] of entries) {
+    flatten(v, prefix ? `${prefix}.${k}` : String(k), out);
+  }
+  return out;
+}
+
+async function viewJobVersions(id) {
+  const reply = await api(`/v1/job/${encodeURIComponent(id)}/versions`);
+  const versions = reply.versions || reply || [];
+  const pick = (sessionStorage.getItem(`diff_${id}`) || "").split("|");
+  const idEnc = encodeURIComponent(id);   // inline-handler safe
+  const rows = versions.map((v) => [
+    `<label><input type="radio" name="va" value="${v.version}"
+       ${String(v.version) === pick[0] ? "checked" : ""}
+       onchange="pickDiff('${idEnc}', 0, this.value)"></label>`,
+    `<label><input type="radio" name="vb" value="${v.version}"
+       ${String(v.version) === pick[1] ? "checked" : ""}
+       onchange="pickDiff('${idEnc}', 1, this.value)"></label>`,
+    esc(v.version), String(v.stable), badge(v.status || ""),
+  ]);
+  let diffHtml = "";
+  if (pick[0] && pick[1] && pick[0] !== pick[1]) {
+    const a = versions.find((v) => String(v.version) === pick[0]);
+    const b = versions.find((v) => String(v.version) === pick[1]);
+    if (a && b) {
+      const fa = flatten(a, "", {});
+      const fb = flatten(b, "", {});
+      const keys = [...new Set([...Object.keys(fa), ...Object.keys(fb)])]
+        .sort().filter((k) => fa[k] !== fb[k])
+        .filter((k) => !/^(version|modify_index|create_index|job_modify_index|submit_time)/.test(k));
+      const drows = keys.map((k) => [
+        `<span class="mono">${esc(k)}</span>`,
+        `<span class="diff-del mono">${esc(fa[k] ?? "—")}</span>`,
+        `<span class="diff-add mono">${esc(fb[k] ?? "—")}</span>`,
+      ]);
+      diffHtml = `<h2>Diff v${esc(pick[0])} → v${esc(pick[1])}
+        <span class="muted">${keys.length} changed fields</span></h2>` +
+        (keys.length ? table(["Field", `v${esc(pick[0])}`,
+                              `v${esc(pick[1])}`], drows)
+          : `<p class="muted">no differences outside indexes</p>`);
+    }
+  }
+  return h(`<h1>${idLink("job", id, esc(id))} versions</h1>` +
+    table(["A", "B", "Version", "Stable", "Status"], rows) + diffHtml);
+}
+
+window.pickDiff = function (idEnc, side, val) {
+  const id = decodeURIComponent(idEnc);
+  const cur = (sessionStorage.getItem(`diff_${id}`) || "|").split("|");
+  cur[side] = val;
+  sessionStorage.setItem(`diff_${id}`, cur.join("|"));
+  render();
+};
+
+/* ----- live agent monitor (rides /v1/agent/monitor) ----- */
+
+function viewMonitor() {
+  setTimeout(attachMonitorStream, 0);
+  return h(`<h1>Agent monitor <span class="muted" id="mon-state">connecting…</span></h1>
+    <div class="controls">
+      <select id="mon-level" onchange="attachMonitorStream()">
+        <option value="debug">debug</option>
+        <option value="info" selected>info</option>
+        <option value="warn">warn</option>
+        <option value="error">error</option>
+      </select>
+    </div>
+    <div id="mon-list" class="term"></div>`);
+}
+
+async function attachMonitorStream() {
+  if (eventAbort) eventAbort.abort();
+  eventAbort = new AbortController();
+  const list = document.getElementById("mon-list");
+  const state = document.getElementById("mon-state");
+  const level = document.getElementById("mon-level")?.value || "info";
+  if (!list) return;
+  list.innerHTML = "";
+  try {
+    const resp = await fetch(`/v1/agent/monitor?log_level=${level}`,
+                             {signal: eventAbort.signal,
+                              headers: authHeaders()});
+    if (!resp.ok) {
+      state.textContent = `error (HTTP ${resp.status})`;
+      return;
+    }
+    state.textContent = "live";
+    const reader = resp.body.getReader();
+    const dec = new TextDecoder();
+    let buf = "";
+    for (;;) {
+      const {value, done} = await reader.read();
+      if (done) break;
+      buf += dec.decode(value, {stream: true});
+      const lines = buf.split("\n");
+      buf = lines.pop();
+      for (const line of lines) {
+        if (!line.trim() || line.trim() === "{}") continue;
+        let rec;
+        try { rec = JSON.parse(line); } catch { continue; }
+        const div = document.createElement("div");
+        div.innerHTML = `<span class="muted">${when(rec.ts)}</span>
+          <span class="badge ${esc(rec.level)}">${esc(rec.level)}</span>
+          <span class="mono">${esc(rec.name)}: ${esc(rec.msg)}</span>`;
+        list.appendChild(div);
+        while (list.children.length > 500) list.removeChild(list.firstChild);
+        list.scrollTop = list.scrollHeight;
+      }
+    }
+  } catch (e) {
+    if (state) state.textContent = "disconnected";
+  }
+}
+
 function viewEvents() {
   // live stream: render shell now, then attach the NDJSON reader
   setTimeout(attachEventStream, 0);
@@ -408,10 +642,15 @@ async function attachEventStream() {
 
 const routes = [
   [/^#\/jobs$/, () => viewJobs(), "jobs"],
+  [/^#\/job\/([^/]+)\/versions$/, (m) => viewJobVersions(
+    decodeURIComponent(m[1])), "jobs"],
   [/^#\/job\/(.+)$/, (m) => viewJob(m[1]), "jobs"],
   [/^#\/nodes$/, () => viewNodes(), "nodes"],
   [/^#\/node\/(.+)$/, (m) => viewNode(m[1]), "nodes"],
+  [/^#\/topology$/, () => viewTopology(), "topology"],
   [/^#\/allocations$/, () => viewAllocs(), "allocations"],
+  [/^#\/allocation\/([^/]+)\/exec$/, (m) => viewExec(
+    decodeURIComponent(m[1])), "allocations"],
   [/^#\/allocation\/(.+)$/, (m) => viewAlloc(m[1]), "allocations"],
   [/^#\/evaluations$/, () => viewEvals(), "evaluations"],
   [/^#\/evaluation\/(.+)$/, (m) => viewEval(m[1]), "evaluations"],
@@ -419,6 +658,7 @@ const routes = [
   [/^#\/volumes$/, () => viewVolumes(), "volumes"],
   [/^#\/metrics$/, () => viewMetrics(), "metrics"],
   [/^#\/events$/, () => viewEvents(), "events"],
+  [/^#\/monitor$/, () => viewMonitor(), "monitor"],
 ];
 
 let renderEpoch = 0;
@@ -462,5 +702,8 @@ window.addEventListener("hashchange", render);
 render();
 // light auto-refresh for list views (the event stream page is live)
 refreshTimer = setInterval(() => {
-  if (!location.hash.startsWith("#/events")) render();
+  const live = ["#/events", "#/monitor"];
+  const stateful = /#\/allocation\/[^/]+\/exec/;
+  if (!live.some((p) => location.hash.startsWith(p))
+      && !stateful.test(location.hash)) render();
 }, 5000);

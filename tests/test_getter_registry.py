@@ -328,3 +328,23 @@ def test_registry_pull_rejects_corrupt_blob(tmp_path):
             reg._Client._open = orig
     finally:
         srv.shutdown()
+
+
+def test_archive_hardlink_escape_rejected(remote_on, tmp_path):
+    """Hardlinks resolve relative to the EXTRACTION ROOT in tarfile; a
+    nested member's ../-chain must be judged against that base."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        d = tarfile.TarInfo("a/b/c")
+        d.type = tarfile.DIRTYPE
+        tf.addfile(d)
+        lnk = tarfile.TarInfo("a/b/c/hl")
+        lnk.type = tarfile.LNKTYPE
+        lnk.linkname = "../../../../../outside-file"
+        tf.addfile(lnk)
+    srv, base = _serve({"/hl.tar.gz": (200, {}, buf.getvalue())})
+    try:
+        with pytest.raises(ArtifactError, match="escape|failed"):
+            Sandbox().get(f"{base}/hl.tar.gz", str(tmp_path / "h"))
+    finally:
+        srv.shutdown()
