@@ -172,6 +172,16 @@ FUNCTIONS: Dict[str, Any] = {
     "range": lambda *a: list(range(*(int(x) for x in a))),
 }
 
+# type-constructor expressions, valid ONLY inside variable blocks
+# (variable { type = list(string) }); evaluating them in the general
+# expression language would silently turn list()/map() calls elsewhere
+# into literal strings instead of a clear unknown-function error
+TYPE_FUNCTIONS: Dict[str, Any] = {
+    "list": lambda t="": f"list({t})",
+    "set": lambda t="": f"set({t})",
+    "map": lambda t="": f"map({t})",
+}
+
 
 class Parser:
     def __init__(self, tokens: List[Token],
@@ -179,6 +189,9 @@ class Parser:
         self.tokens = tokens
         self.i = 0
         self.variables = variables if variables is not None else {}
+        # enclosing-block stack: type constructors (list/set/map) only
+        # evaluate inside `variable` blocks
+        self._block_stack: List[str] = []
 
     def peek(self) -> Token:
         return self.tokens[self.i]
@@ -225,7 +238,11 @@ class Parser:
         if not (t.kind == "punct" and t.value == "{"):
             raise HclError(f"expected '{{' after {name.value}", t.line)
         self.next()
-        body = self.parse_body()
+        self._block_stack.append(name.value)
+        try:
+            body = self.parse_body()
+        finally:
+            self._block_stack.pop()
         close = self.next()
         if not (close.kind == "punct" and close.value == "}"):
             raise HclError("expected '}'", close.line)
@@ -304,6 +321,9 @@ class Parser:
             if self.peek().kind == "punct" and self.peek().value == ",":
                 self.next()
         fn = FUNCTIONS.get(name)
+        if fn is None and name in TYPE_FUNCTIONS \
+                and "variable" in self._block_stack:
+            fn = TYPE_FUNCTIONS[name]
         if fn is None:
             raise HclError(f"unknown function {name!r}", line)
         try:
@@ -377,6 +397,7 @@ def parse_hcl(src: str, variables: Optional[Dict[str, Any]] = None
     tokens = tokenize(src)
     # first pass without variables to harvest variable/locals defaults
     defaults: Dict[str, Any] = {}
+    declared: Dict[str, Dict[str, Any]] = {}
     probe = Parser(tokens, variables=_Everything())
     try:
         items = probe.parse_body(root=True)
@@ -386,10 +407,24 @@ def parse_hcl(src: str, variables: Optional[Dict[str, Any]] = None
         for it in items:
             if isinstance(it, Block) and it.type == "variable" and it.labels:
                 attrs = it.attrs()
+                declared[it.labels[0]] = attrs
                 if "default" in attrs:
                     defaults[it.labels[0]] = attrs["default"]
     merged = dict(defaults)
     merged.update(variables or {})
+    # declared-variable contract (reference: jobspec2 ParseWithConfig --
+    # unset required variables fail UPFRONT with their names, and
+    # provided values coerce to the declared type or error)
+    missing = [n for n in declared
+               if n not in merged]
+    if missing:
+        raise HclError(
+            "missing required variable(s): " + ", ".join(sorted(missing)),
+            0)
+    for n, attrs in declared.items():
+        want = str(attrs.get("type", "") or "")
+        if n in merged and want:
+            merged[n] = _coerce_var(n, merged[n], want)
     if items is not None and any(
             isinstance(it, Block) and it.type == "locals" for it in items):
         # locals may reference variables: re-evaluate them with the real
@@ -402,6 +437,38 @@ def parse_hcl(src: str, variables: Optional[Dict[str, Any]] = None
     parser = Parser(tokens, variables=merged)
     root = Block(type="root", body=parser.parse_body(root=True))
     return root
+
+
+def _coerce_var(name: str, value: Any, want: str) -> Any:
+    """Coerce a provided variable value to its declared type (CLI/-var
+    values arrive as strings; reference: hcl2 convert.Convert against
+    the declared cty type)."""
+    try:
+        if want == "number":
+            if isinstance(value, (int, float)):
+                return value
+            s = str(value)
+            return float(s) if "." in s else int(s)
+        if want == "bool":
+            if isinstance(value, bool):
+                return value
+            s = str(value).lower()
+            if s in ("true", "1"):
+                return True
+            if s in ("false", "0"):
+                return False
+            raise ValueError(s)
+        if want == "string":
+            return value if isinstance(value, str) else str(value)
+        if want.startswith("list"):
+            if isinstance(value, list):
+                return value
+            return [p.strip() for p in str(value).split(",") if p.strip()]
+    except (ValueError, TypeError):
+        raise HclError(
+            f"variable {name!r}: value {value!r} does not match "
+            f"declared type {want}", 0) from None
+    return value        # unknown/complex type expressions: pass through
 
 
 class _Fallback(dict):

@@ -285,3 +285,134 @@ def test_port_forwarder_relay_and_stop():
             if time.time() > deadline:
                 raise
             time.sleep(0.2)
+
+
+def test_kernel_portmap_probe_negative(monkeypatch):
+    """No nft binary -> kernel path unavailable, relay path used."""
+    from nomad_tpu.client import netns
+    monkeypatch.setenv("PATH", "/nonexistent")
+    netns._reset_caps_for_tests()
+    assert netns.kernel_portmap_available() is False
+    netns._reset_caps_for_tests()
+
+
+def test_nft_portmap_programs_and_removes(monkeypatch, tmp_path):
+    """With a working nft, the manager programs per-alloc DNAT chains
+    (tcp+udp, prerouting + output hooks) and tears them down by chain
+    delete -- verified against a recording stub binary."""
+    import os
+    from nomad_tpu.client import netns
+
+    log = tmp_path / "nft.log"
+    stub = tmp_path / "bin" / "nft"
+    stub.parent.mkdir()
+    stub.write_text(f"#!/bin/sh\necho \"$@\" >> {log}\nexit 0\n")
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{stub.parent}:{os.environ['PATH']}")
+    netns._reset_caps_for_tests()
+    try:
+        assert netns.kernel_portmap_available() is True
+
+        pmap = netns.NftPortMap("abcd1234", "172.26.64.0/20")
+        pmap.install([(8080, "172.26.64.5", 80),
+                      (9090, "172.26.64.5", 9090)])
+        lines = log.read_text().splitlines()
+        assert any("add table ip nomad_tpu_portmap" in l for l in lines)
+        assert any("add chain ip nomad_tpu_portmap nt_abcd1234_pre" in l
+                   and "prerouting" in l for l in lines)
+        assert any("add chain ip nomad_tpu_portmap nt_abcd1234_post" in l
+                   and "postrouting" in l for l in lines)
+        for proto in ("tcp", "udp"):
+            # DNAT only for traffic ADDRESSED TO the node (a bare dport
+            # match would hijack unrelated forwarded/outbound flows)
+            assert any("fib daddr type local "
+                       f"{proto} dport 8080 dnat to 172.26.64.5:80" in l
+                       for l in lines), (proto, lines)
+            # hairpin masquerade for bridge-sourced flows
+            assert any(f"ip saddr 172.26.64.0/20 ip daddr 172.26.64.5 "
+                       f"{proto} dport 80 masquerade" in l
+                       for l in lines), (proto, lines)
+        assert pmap.installed
+
+        log.write_text("")
+        pmap.remove()
+        lines = log.read_text().splitlines()
+        for chain in ("nt_abcd1234_pre", "nt_abcd1234_post"):
+            assert any(f"flush chain ip nomad_tpu_portmap {chain}" in l
+                       for l in lines)
+            assert any(f"delete chain ip nomad_tpu_portmap {chain}" in l
+                       for l in lines)
+        assert not pmap.installed
+
+        # reinstalling (agent restart adoption) programs fresh chains
+        # after removing the old ones -- no duplicate rules
+        log.write_text("")
+        pmap.install([(8080, "172.26.64.5", 80)])
+        lines = log.read_text().splitlines()
+        del_idx = next(i for i, l in enumerate(lines)
+                       if "delete chain" in l and "nt_abcd1234_pre" in l)
+        add_idx = next(i for i, l in enumerate(lines)
+                       if "add rule" in l)
+        assert del_idx < add_idx
+    finally:
+        netns._reset_caps_for_tests()
+
+
+def test_nft_install_failure_unwinds_and_falls_back(monkeypatch, tmp_path):
+    """A failing rule add removes partial chains; create() would then
+    take the userspace relay path (nft=None)."""
+    from nomad_tpu.client import netns
+
+    log = tmp_path / "nft.log"
+    stub = tmp_path / "bin" / "nft"
+    stub.parent.mkdir()
+    # fail on the first 'add rule', succeed otherwise
+    stub.write_text(
+        f"#!/bin/sh\necho \"$@\" >> {log}\n"
+        "case \"$1 $2\" in 'add rule') exit 1;; esac\nexit 0\n")
+    stub.chmod(0o755)
+    import os
+    monkeypatch.setenv("PATH", f"{stub.parent}:{os.environ['PATH']}")
+    netns._reset_caps_for_tests()
+    try:
+        pmap = netns.NftPortMap("beef0001", "172.26.64.0/20")
+        with pytest.raises(OSError):
+            pmap.install([(8080, "172.26.64.9", 80)])
+        assert not pmap.installed
+        lines = log.read_text().splitlines()
+        assert any("delete chain ip nomad_tpu_portmap nt_beef0001_pre"
+                   in l for l in lines)
+    finally:
+        netns._reset_caps_for_tests()
+
+
+def test_reap_stale_chains(monkeypatch, tmp_path):
+    """Chains left by a dead agent are reaped at manager start (a stale
+    DNAT rule would blackhole traffic to a freed IP)."""
+    import os
+    from nomad_tpu.client import netns
+
+    log = tmp_path / "nft.log"
+    stub = tmp_path / "bin" / "nft"
+    stub.parent.mkdir()
+    stub.write_text(
+        f"#!/bin/sh\necho \"$@\" >> {log}\n"
+        "case \"$1\" in list)\n"
+        "  echo 'table ip nomad_tpu_portmap {'\n"
+        "  echo '  chain nt_dead0001_pre {'\n"
+        "  echo '  }'\n"
+        "  echo '  chain nt_dead0001_post {'\n"
+        "  echo '  }'\n"
+        "  echo '}'\n"
+        ";; esac\nexit 0\n")
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{stub.parent}:{os.environ['PATH']}")
+    netns._reset_caps_for_tests()
+    try:
+        netns.reap_stale_chains()
+        lines = log.read_text().splitlines()
+        for chain in ("nt_dead0001_pre", "nt_dead0001_post"):
+            assert any(f"delete chain ip nomad_tpu_portmap {chain}" in l
+                       for l in lines), lines
+    finally:
+        netns._reset_caps_for_tests()

@@ -507,3 +507,44 @@ def test_list_prefix_filters():
     finally:
         http.shutdown()
         server.shutdown()
+
+
+def test_hcl_variable_types_and_required():
+    """Variable blocks: declared types coerce -var string values, unset
+    required variables fail upfront with their names (reference:
+    jobspec2/parse.go ParseWithConfig + types.variables.go)."""
+    from nomad_tpu.jobspec.hcl import HclError
+    from nomad_tpu.jobspec.parse import parse
+
+    src = """
+variable "count" {
+  type    = number
+  default = 2
+}
+variable "image" {
+  type = string
+}
+variable "dcs" {
+  type    = list(string)
+  default = ["dc1"]
+}
+job "t" {
+  datacenters = var.dcs
+  group "g" {
+    count = var.count
+    task "w" {
+      driver = "mock"
+      config { image = var.image }
+    }
+  }
+}
+"""
+    job = parse(src, {"image": "app:v1", "count": "7", "dcs": "dc1,dc2"})
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.task_groups[0].count == 7
+    assert job.task_groups[0].tasks[0].config["image"] == "app:v1"
+
+    with pytest.raises(HclError, match="missing required.*image"):
+        parse(src, {})
+    with pytest.raises(HclError, match="does not match declared type"):
+        parse(src, {"image": "x", "count": "notnum"})
