@@ -72,15 +72,18 @@ def test_netns_isolation_and_portmap():
              "http.server.SimpleHTTPRequestHandler).serve_forever()"],
             cwd="/tmp", stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL)
-        deadline = time.time() + 10
+        # generous deadline: under full-suite CPU load the in-netns
+        # python http.server can take >10s to come up (observed flaky)
+        deadline = time.time() + 45
         out = b""
         while time.time() < deadline:
             try:
                 out = http_get("127.0.0.1", host_port)
-                if out:
+                if b"HTTP/1.0 200" in out:
                     break
             except OSError:
-                time.sleep(0.2)
+                pass
+            time.sleep(0.3)
         assert b"HTTP/1.0 200" in out, out        # via the port map
 
         # from B's namespace over the bridge (the mapped-ports path a
