@@ -440,11 +440,14 @@ class GenericScheduler:
     def _append_solved_alloc(self, sp, deployment_id: str) -> None:
         place = sp.place
         tg = place.task_group
-        resources = AllocatedResources(
-            tasks=sp.task_resources,
-            shared=sp.alloc_resources
-            if sp.alloc_resources is not None
-            else AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb))
+        resources = getattr(sp, "resources_prebuilt", None)
+        if resources is None:
+            resources = AllocatedResources(
+                tasks=sp.task_resources,
+                shared=sp.alloc_resources
+                if sp.alloc_resources is not None
+                else AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb))
         metrics = self.ctx.metrics.copy()
         metrics.nodes_evaluated = sp.n_yielded
         metrics.score_node(sp.node.id, "normalized-score", sp.score)

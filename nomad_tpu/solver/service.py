@@ -16,7 +16,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..structs import (
-    AllocatedSharedResources, AllocatedTaskResources, NetworkIndex,
+    AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
+    NetworkIndex,
     CONSTRAINT_DISTINCT_HOSTS,
 )
 from ..tensor import (
@@ -29,10 +30,12 @@ class TpuPlacement:
     """One solved placement returned to the scheduler."""
 
     __slots__ = ("place", "node", "task_resources", "alloc_resources",
-                 "score", "n_yielded", "preempted_allocs")
+                 "score", "n_yielded", "preempted_allocs",
+                 "resources_prebuilt")
 
     def __init__(self, place, node, task_resources, alloc_resources, score,
-                 n_yielded, preempted_allocs=None):
+                 n_yielded, preempted_allocs=None,
+                 resources_prebuilt=None):
         self.place = place
         self.node = node
         self.task_resources = task_resources
@@ -40,6 +43,11 @@ class TpuPlacement:
         self.score = score
         self.n_yielded = n_yielded
         self.preempted_allocs = preempted_allocs
+        # uniform simple lanes share ONE AllocatedResources across all
+        # placements (committed alloc graphs are immutable-by-replace
+        # already -- update_allocs_from_client's shallow copy shares the
+        # same object across versions today)
+        self.resources_prebuilt = resources_prebuilt
 
 
 class PackedLane:
@@ -762,6 +770,19 @@ class TpuPlacementService:
         dev_allocators: Dict[str, object] = {}
         core_used: Dict[str, set] = {}
         has_devices = any(t.resources.devices for t in tg.tasks)
+        # uniform simple lane (no ports/cores/devices): every placement
+        # gets IDENTICAL resources -- build the object graph once and
+        # share it, instead of 3 dataclass constructions per placement
+        shared_res = None
+        if (not tg.networks and not has_devices
+                and not any(t.resources.cores > 0 for t in tg.tasks)):
+            shared_res = AllocatedResources(
+                tasks={t.name: AllocatedTaskResources(
+                    cpu_shares=t.resources.cpu,
+                    memory_mb=t.resources.memory_mb)
+                    for t in tg.tasks},
+                shared=AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb))
         for pi, place in enumerate(places):
             pos = int(chosen[pi])
             if pos < 0:
@@ -776,6 +797,13 @@ class TpuPlacementService:
                     cands = lane.cand_allocs[pos]
                     preempted = [cands[ai] for ai in np.nonzero(row)[0]
                                  if ai < len(cands)]
+            if shared_res is not None:
+                out.append(TpuPlacement(
+                    place, node, shared_res.tasks, shared_res.shared,
+                    float(scores[pi]), int(n_yielded[pi]),
+                    preempted_allocs=preempted,
+                    resources_prebuilt=shared_res))
+                continue
             task_resources = {}
             dev_failed = False
             for task in tg.tasks:

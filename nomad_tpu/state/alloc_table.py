@@ -49,6 +49,14 @@ class AllocTable:
         self.mem = np.zeros(cap, dtype=np.float64)
         self.disk = np.zeros(cap, dtype=np.float64)
         self.live = np.zeros(cap, dtype=np.uint8)
+        # live by the APPLIER's filter (terminal_status: desired
+        # stop/evict OR client-terminal), vs `live` which is the
+        # scheduler's filter (client-terminal only, ProposedAllocs)
+        self.live_strict = np.zeros(cap, dtype=np.uint8)
+        # any ports/networks/reserved-cores/devices on the alloc: nodes
+        # carrying such rows need the full python fit walk in the plan
+        # applier (the native kernel models cpu/mem/disk only)
+        self.special = np.zeros(cap, dtype=np.uint8)
         self.job_hash = np.zeros(cap, dtype=np.uint64)
         self.jobtg_hash = np.zeros(cap, dtype=np.uint64)
         self.ports = np.full((cap, MAX_PORTS), -1, dtype=np.int32)
@@ -83,6 +91,7 @@ class AllocTable:
     def _grow(self) -> None:
         self._cap *= 2
         for name in ("node_slot", "cpu", "mem", "disk", "live",
+                     "live_strict", "special",
                      "job_hash", "jobtg_hash"):
             arr = getattr(self, name)
             setattr(self, name, np.resize(arr, self._cap))
@@ -107,6 +116,9 @@ class AllocTable:
         self.mem[row] = cr.memory_mb
         self.disk[row] = cr.disk_mb
         self.live[row] = 0 if alloc.client_terminal_status() else 1
+        self.live_strict[row] = 0 if alloc.terminal_status() else 1
+        self.special[row] = \
+            1 if alloc.allocated_resources.has_special_dimensions() else 0
         self.job_hash[row] = stable_hash(alloc.namespace, alloc.job_id)
         self.jobtg_hash[row] = stable_hash(alloc.namespace, alloc.job_id,
                                            alloc.task_group)
@@ -140,6 +152,8 @@ class AllocTable:
             self.rows_with_ports -= 1
         self._overflow_rows.discard(row)
         self.live[row] = 0
+        self.live_strict[row] = 0
+        self.special[row] = 0
         self.node_slot[row] = -1
         self.ports[row, :] = -1
         self._free.append(row)
@@ -180,6 +194,36 @@ class AllocTable:
         return {"used_cpu": used_cpu, "used_mem": used_mem,
                 "used_disk": used_disk, "dyn_used": dyn_used,
                 "port_words": port_words, "row_slots": mapped}
+
+    def fold_verify(self, node_ids):
+        """Per-node (used_cpu, used_mem, used_disk, special_any, found)
+        under the APPLIER's liveness filter (live_strict: excludes
+        server-terminal too, matching AllocsByNodeTerminal(false) in
+        plan_apply.go) for the plan verifier's native pre-pass. Caller
+        must hold the owning store's lock (a half-committed plan would
+        tear the fold). ``found[k]`` False = node unknown to the table
+        (no allocs ever) -- usage is zero there."""
+        n = self.n_rows
+        npos = len(node_ids)
+        slots = np.fromiter(
+            (self._slot_of_node.get(i, -1) for i in node_ids),
+            dtype=np.int32, count=npos)
+        found = slots >= 0
+        remap = np.full(self.n_nodes + 1, -1, dtype=np.int32)
+        remap[slots[found]] = np.nonzero(found)[0].astype(np.int32)
+        rows = self.node_slot[:n]
+        mapped = np.where(rows >= 0, remap[np.maximum(rows, 0)], -1)
+        live = (self.live_strict[:n] > 0) & (mapped >= 0)
+        used_c = np.zeros(npos)
+        used_m = np.zeros(npos)
+        used_d = np.zeros(npos)
+        m = mapped[live]
+        np.add.at(used_c, m, self.cpu[:n][live])
+        np.add.at(used_m, m, self.mem[:n][live])
+        np.add.at(used_d, m, self.disk[:n][live])
+        spec_any = np.zeros(npos, dtype=bool)
+        spec_any[mapped[live & (self.special[:n] > 0)]] = True
+        return used_c, used_m, used_d, spec_any, found
 
     def count_placed(self, n_pad: int, mapped_slots: np.ndarray,
                      namespace: str, job_id: str, tg_name: str):

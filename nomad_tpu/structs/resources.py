@@ -232,6 +232,19 @@ class AllocatedResources:
         self.__dict__["_cmp_cache"] = out
         return out
 
+    def has_special_dimensions(self) -> bool:
+        """Any ports/networks/reserved-cores/devices on the allocation:
+        the dimensions the native cpu/mem/disk verify kernel cannot
+        model. Shared by the alloc table's `special` column and the plan
+        verifier's per-plan-alloc check -- they must agree or nodes
+        skip the full Python fit walk they still need."""
+        if self.shared.ports or self.shared.networks:
+            return True
+        for tr in self.tasks.values():
+            if tr.reserved_cores or tr.devices or tr.networks:
+                return True
+        return False
+
     def all_ports(self) -> List[int]:
         """Every host port this allocation holds, deduplicated, in
         first-seen order -- the single enumeration used by the port
