@@ -916,15 +916,36 @@ class TpuPlacementService:
         if lock is not None:
             lock.acquire()
         try:
-            slots = self._node_slots(table, matrix, nodes, n_pad)
-            packed = table.pack(n_pad, slots, with_ports,
-                                port_words_seed=matrix.port_bitmap)
+            # fold cache: all lanes of one barrier generation pack from
+            # the same table version against the same (version-keyed)
+            # matrix -- fold once, hand out copies (the overlay mutates
+            # usage arrays in place). Port lanes skip the cache: their
+            # port_words can be 80MB and are cheaper to refold.
+            cached = getattr(matrix, "_fold_cache", None)
+            packed = None
+            if not with_ports and cached is not None \
+                    and cached[0] is table and cached[1] == table.version:
+                packed = cached[2]
+            if packed is None:
+                slots = self._node_slots(table, matrix, nodes, n_pad)
+                packed = table.pack(n_pad, slots, with_ports,
+                                    port_words_seed=matrix.port_bitmap)
+                if not with_ports:
+                    matrix._fold_cache = (table, table.version, packed)
             placed, placed_job = table.count_placed(
                 n_pad, packed["row_slots"], self.job.namespace, self.job.id,
                 tg.name)
         finally:
             if lock is not None:
                 lock.release()
+        if not with_ports:
+            # cached arrays are shared across lanes: the overlay below
+            # mutates usage in place, so each lane works on copies
+            packed = dict(packed,
+                          used_cpu=packed["used_cpu"].copy(),
+                          used_mem=packed["used_mem"].copy(),
+                          used_disk=packed["used_disk"].copy(),
+                          dyn_used=packed["dyn_used"].copy())
 
         usage = UsageState(
             used_cpu=packed["used_cpu"], used_mem=packed["used_mem"],

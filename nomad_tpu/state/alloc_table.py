@@ -42,6 +42,9 @@ class AllocTable:
         cap = initial_capacity
         self._row_of: Dict[str, int] = {}
         self._free: list = []
+        # bumped on every mutation: packers cache fold results per
+        # version (32 lanes of one barrier generation fold identically)
+        self.version = 0
         self.n_rows = 0
         self._cap = cap
         self.node_slot = np.full(cap, -1, dtype=np.int32)
@@ -71,6 +74,7 @@ class AllocTable:
 
     # ------------------------------------------------------------------
     def register_node(self, node) -> int:
+        self.version += 1    # dyn ranges/slots feed folds too
         slot = self._slot_of_node.get(node.id)
         if slot is None:
             if self.n_nodes == self._node_cap:
@@ -100,6 +104,7 @@ class AllocTable:
         self.ports = new_ports
 
     def upsert(self, alloc) -> None:
+        self.version += 1
         row = self._row_of.get(alloc.id)
         if row is None:
             if self._free:
@@ -148,6 +153,7 @@ class AllocTable:
         row = self._row_of.pop(alloc_id, None)
         if row is None:
             return
+        self.version += 1
         if self.ports[row, 0] >= 0:
             self.rows_with_ports -= 1
         self._overflow_rows.discard(row)
