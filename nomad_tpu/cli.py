@@ -630,6 +630,34 @@ def cmd_operator_debug(args) -> int:
     return 0
 
 
+def cmd_operator_solver(args) -> int:
+    """Accelerator guard state / re-probe (rides /v1/agent/self and
+    POST /v1/operator/solver/reprobe)."""
+    api = _client(args)
+    if args.sub2 == "status":
+        st = api.get("/v1/agent/self")["stats"]["solver_guard"]
+        for k in ("checked", "ok", "probe_timed_out", "recovered_late",
+                  "host_fallback_dispatches", "backend_unavailable_total",
+                  "recovered_total"):
+            print(f"{k:28s} = {st.get(k)}")
+    elif args.sub2 == "reprobe":
+        # a first-touch reprobe legitimately blocks for the in-process
+        # probe deadline (<=30s) plus the subprocess transport probe
+        api.timeout = 150.0
+        rep = api.post("/v1/operator/solver/reprobe")
+        print(f"recovered          = {rep.get('recovered')}")
+        if rep.get("subprocess") is not None:
+            sub = rep["subprocess"]
+            print(f"transport probe    = "
+                  f"{'TIMED OUT' if sub['timed_out'] else 'ok'} "
+                  f"(devices={sub['devices']})")
+        if rep.get("tunnel_ok_process_wedged"):
+            print("verdict            = transport healthy but this "
+                  "process is wedged: restart the agent to recover")
+        print(f"guard ok           = {rep['state']['ok']}")
+    return 0
+
+
 def cmd_operator_snapshot(args) -> int:
     api = _client(args)
     if args.sub2 == "save":
@@ -895,6 +923,10 @@ def build_parser() -> argparse.ArgumentParser:
     odbg.add_argument("-duration", type=float, default=2.0)
     odbg.add_argument("-output", default="")
     odbg.set_defaults(fn=cmd_operator_debug)
+    osol = op.add_parser("solver").add_subparsers(dest="sub2",
+                                                  required=True)
+    osol.add_parser("status").set_defaults(fn=cmd_operator_solver)
+    osol.add_parser("reprobe").set_defaults(fn=cmd_operator_solver)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("-log-level", dest="log_level", default="info")

@@ -180,3 +180,35 @@ def test_guard_state_in_agent_self_and_reprobe_endpoint():
     finally:
         http.shutdown()
         server.shutdown()
+
+
+def test_cli_operator_solver_status_and_reprobe(capsys):
+    from nomad_tpu import cli
+    from nomad_tpu.api.http import HttpServer
+
+    guard._reset_for_tests()
+    guard._STATE.update(checked=True, ok=False, probe_timed_out=True)
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        base = f"http://127.0.0.1:{http.port}"
+        assert cli.main(["-address", base, "operator", "solver",
+                         "status"]) == 0
+        out = capsys.readouterr().out
+        assert "ok" in out and "= False" in out
+
+        import unittest.mock as um
+        with um.patch.object(
+                guard, "_subprocess_probe",
+                lambda timeout: {"timed_out": False, "rc": 0,
+                                 "devices": 1}):
+            assert cli.main(["-address", base, "operator", "solver",
+                             "reprobe"]) == 0
+        out = capsys.readouterr().out
+        assert "recovered" in out
+        assert "restart the agent" in out   # tunnel ok, process wedged
+    finally:
+        http.shutdown()
+        server.shutdown()
