@@ -528,6 +528,61 @@ window.pickDiff = function (idEnc, side, val) {
   render();
 };
 
+/* ----- variables browser (rides /v1/vars + /v1/var/<path>) ----- */
+
+async function viewVars() {
+  const vars = await api("/v1/vars");
+  const rows = vars.map((v) => [
+    `<a href="#/var/${encodeURIComponent(v.path)}">
+       <span class="mono">${esc(v.path)}</span></a>`,
+    esc(v.namespace), esc(v.modify_index ?? ""),
+  ]);
+  return h(`<h1>Variables</h1>` +
+    (rows.length ? table(["Path", "Namespace", "Index"], rows)
+      : `<p class="muted">no variables (or none readable with this
+         token)</p>`));
+}
+
+async function viewVar(path) {
+  const v = await api(`/v1/var/${path.split("/").map(
+    encodeURIComponent).join("/")}`);
+  const meta = v.meta || {};
+  const items = v.items || {};
+  const rows = Object.entries(items).map(([k, val]) => [
+    `<span class="mono">${esc(k)}</span>`,
+    `<span class="mono">${esc(val)}</span>`,
+  ]);
+  return h(`<h1>Variable <span class="mono">${esc(path)}</span></h1>
+    <table class="kv">
+      <tr><td>Namespace</td><td>${esc(meta.namespace)}</td></tr>
+      <tr><td>Modify index</td><td>${esc(meta.modify_index ?? "")}</td></tr>
+    </table><h2>Items (${rows.length})</h2>` +
+    table(["Key", "Value"], rows));
+}
+
+/* ----- servers (raft configuration + gossip members) ----- */
+
+async function viewServers() {
+  const [raft, members] = await Promise.all([
+    api("/v1/operator/raft/configuration").catch(() => null),
+    api("/v1/agent/members").catch(() => ({members: []})),
+  ]);
+  let out = `<h1>Servers</h1>`;
+  if (raft && raft.servers) {
+    out += `<h2>Raft peers</h2>` + table(
+      ["ID", "Address", "Leader", "Voter"],
+      raft.servers.map((s) => [
+        esc(s.id), `<span class="mono">${esc(s.address)}</span>`,
+        s.leader ? badge("ready") : "",
+        String(s.voter)]));
+  }
+  out += `<h2>Gossip members</h2>` + table(
+    ["Name", "Status"],
+    (members.members || []).map((m) => [
+      esc(m.name), badge(m.status || "?")]));
+  return h(out);
+}
+
 /* ----- live agent monitor (rides /v1/agent/monitor) ----- */
 
 function viewMonitor() {
@@ -656,6 +711,10 @@ const routes = [
   [/^#\/evaluation\/(.+)$/, (m) => viewEval(m[1]), "evaluations"],
   [/^#\/deployments$/, () => viewDeployments(), "deployments"],
   [/^#\/volumes$/, () => viewVolumes(), "volumes"],
+  [/^#\/variables$/, () => viewVars(), "variables"],
+  [/^#\/var\/(.+)$/, (m) => viewVar(decodeURIComponent(m[1])),
+   "variables"],
+  [/^#\/servers$/, () => viewServers(), "servers"],
   [/^#\/metrics$/, () => viewMetrics(), "metrics"],
   [/^#\/events$/, () => viewEvents(), "events"],
   [/^#\/monitor$/, () => viewMonitor(), "monitor"],

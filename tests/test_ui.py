@@ -139,3 +139,18 @@ def test_ui_backing_endpoints_for_new_views(http):
     status, _, body = get(http, "/v1/job/ui-job/versions")
     assert status == 200
     assert json.loads(body)["versions"]
+
+
+def test_ui_variables_and_servers_views(http):
+    """Variables browser + servers view ship and their backing
+    endpoints serve the shapes the views read."""
+    _, _, app = get(http, "/ui/app.js")
+    for view in (b"viewVars", b"viewVar", b"viewServers",
+                 b"variables$", b"servers$"):
+        assert view in app, view
+    _, _, shell = get(http, "/ui/")
+    assert b"#/variables" in shell and b"#/servers" in shell
+    status, _, body = get(http, "/v1/vars")
+    assert status == 200
+    status, _, body = get(http, "/v1/agent/members")
+    assert status == 200 and json.loads(body)["members"]
