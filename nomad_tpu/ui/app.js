@@ -531,9 +531,12 @@ window.pickDiff = function (idEnc, side, val) {
 /* ----- variables browser (rides /v1/vars + /v1/var/<path>) ----- */
 
 async function viewVars() {
-  const vars = await api("/v1/vars");
+  // namespace=* -- the page lists across namespaces (each row carries
+  // its namespace into the detail link)
+  const vars = await api("/v1/vars?namespace=*");
   const rows = vars.map((v) => [
-    `<a href="#/var/${encodeURIComponent(v.path)}">
+    `<a href="#/var/${encodeURIComponent(v.namespace)}/${
+       encodeURIComponent(v.path)}">
        <span class="mono">${esc(v.path)}</span></a>`,
     esc(v.namespace), esc(v.modify_index ?? ""),
   ]);
@@ -543,9 +546,15 @@ async function viewVars() {
          token)</p>`));
 }
 
-async function viewVar(path) {
+// location.hash decoding differs across browsers (Firefox pre-decodes);
+// a failed decode must render the error pane, not throw in the router
+function safeDecode(s) {
+  try { return decodeURIComponent(s); } catch { return s; }
+}
+
+async function viewVar(ns, path) {
   const v = await api(`/v1/var/${path.split("/").map(
-    encodeURIComponent).join("/")}`);
+    encodeURIComponent).join("/")}?namespace=${encodeURIComponent(ns)}`);
   const meta = v.meta || {};
   const items = v.items || {};
   const rows = Object.entries(items).map(([k, val]) => [
@@ -712,7 +721,8 @@ const routes = [
   [/^#\/deployments$/, () => viewDeployments(), "deployments"],
   [/^#\/volumes$/, () => viewVolumes(), "volumes"],
   [/^#\/variables$/, () => viewVars(), "variables"],
-  [/^#\/var\/(.+)$/, (m) => viewVar(decodeURIComponent(m[1])),
+  [/^#\/var\/([^/]+)\/(.+)$/, (m) => viewVar(safeDecode(m[1]),
+                                             safeDecode(m[2])),
    "variables"],
   [/^#\/servers$/, () => viewServers(), "servers"],
   [/^#\/metrics$/, () => viewMetrics(), "metrics"],
