@@ -1,0 +1,160 @@
+"""Plan-verify native fold (plan_apply._fast_check over
+AllocTable.fold_verify) and the StateStore snapshot cache: contracts
+introduced by the round-5 control-plane optimization passes."""
+import copy
+
+from nomad_tpu import mock
+from nomad_tpu.server.plan_apply import Planner, _OverlaySnapshot
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Plan, PlanResult
+
+
+def _world(n_nodes=16):
+    store = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"vf-node-{i:03d}"
+        n.compute_class()
+        store.upsert_node(n)
+        nodes.append(n)
+    job = mock.job(id="vf-job")
+    store.upsert_job(job)
+    return store, nodes, job
+
+
+def test_fold_matches_python_walk_semantics():
+    """fold_verify's used sums equal the old per-node python walk:
+    live = NOT terminal (desired stop/evict or client-terminal)."""
+    store, nodes, job = _world()
+    allocs = []
+    for k, status in enumerate(["pending", "running", "complete"]):
+        a = mock.alloc_for(job, nodes[0], index=k)
+        a.client_status = status
+        allocs.append(a)
+    stopped = mock.alloc_for(job, nodes[0], index=3)
+    stopped.desired_status = "stop"          # server-terminal
+    allocs.append(stopped)
+    store.upsert_allocs(allocs)
+
+    used_c, used_m, used_d, spec, found = \
+        store.alloc_table.fold_verify([nodes[0].id, nodes[1].id,
+                                       "unknown-node"])
+    # 2 live (pending + running); complete and desired-stop excluded
+    assert used_c[0] == 2 * 500 and used_m[0] == 2 * 256
+    assert used_c[1] == 0
+    assert found[0] and not found[2]
+    assert not spec[0]
+
+
+def test_fast_check_subtracts_each_alloc_once():
+    """An alloc named by BOTH the current plan's stops and the
+    in-flight plan's removed set must subtract once, not twice --
+    a double subtraction undercounts usage and lets an overcommitted
+    placement skip the authoritative fit check (review finding on
+    commit 44a59d3)."""
+    store, nodes, job = _world()
+    node = nodes[0]
+    cap = node.node_resources.cpu.cpu_shares          # 4000
+    # fill the node almost full: 7 x 500 = 3500 used
+    existing = [mock.alloc_for(job, node, index=k) for k in range(7)]
+    store.upsert_allocs(existing)
+    victim = existing[0]
+
+    planner = Planner(store)
+    try:
+        # in-flight plan removed the victim
+        inflight = PlanResult(node_update={node.id: [victim]})
+        overlay = _OverlaySnapshot(store.snapshot(), inflight)
+
+        # current plan ALSO stops the victim and asks 2 x 500 on top of
+        # the 3000 that remain after ONE removal -> 4000 == cap: fits
+        # exactly iff the victim is subtracted exactly once
+        plan = Plan(eval_id="vf-eval-1", priority=50, job=job)
+        stop = copy.copy(victim)
+        stop.desired_status = "stop"
+        plan.node_update[node.id] = [stop]
+        for k in range(2):
+            plan.append_alloc(mock.alloc_for(job, node, index=100 + k))
+        # pad the checked node set over the batch-setup threshold
+        node_ids = [node.id] + [n.id for n in nodes[1:9]]
+        rejects, fit = planner._fast_check(overlay, plan, node_ids)
+        assert node.id not in rejects
+        assert node.id in fit, "exact fit must be proven"
+
+        # one more 500 must overflow: double-subtraction would hide it
+        plan.append_alloc(mock.alloc_for(job, node, index=102))
+        rejects, fit = planner._fast_check(overlay, plan, node_ids)
+        assert rejects.get(node.id) == "cpu"
+    finally:
+        planner.shutdown()
+
+
+def test_fast_check_counts_inflight_until_committed():
+    """In-flight placements consume capacity until their commit lands
+    in the table; once committed they must not count twice."""
+    store, nodes, job = _world()
+    node = nodes[1]
+    planner = Planner(store)
+    try:
+        _run_inflight_scenario(planner, store, nodes, node, job)
+    finally:
+        planner.shutdown()
+
+
+def _run_inflight_scenario(planner, store, nodes, node, job):
+    inflight_alloc = mock.alloc_for(job, node, index=0)
+    inflight_alloc.allocated_resources.tasks["web"].cpu_shares = 3800
+    inflight = PlanResult(node_allocation={node.id: [inflight_alloc]})
+    overlay = _OverlaySnapshot(store.snapshot(), inflight)
+
+    plan = Plan(eval_id="vf-eval-2", priority=50, job=job)
+    plan.append_alloc(mock.alloc_for(job, node, index=1))   # 500 ask
+    node_ids = [node.id] + [n.id for n in nodes[2:10]]
+
+    # not committed yet: 3800 + 500 > 4000 -> reject
+    rejects, _ = planner._fast_check(overlay, plan, node_ids)
+    assert rejects.get(node.id) == "cpu"
+
+    # committed: the table sees it; counting the overlay copy again
+    # would still reject -- but the real usage is the same 3800
+    store.upsert_allocs([inflight_alloc])
+    rejects, _ = planner._fast_check(overlay, plan, node_ids)
+    assert rejects.get(node.id) == "cpu", "still genuinely full"
+    # shrink the committed row: now 500 + 500 fits UNLESS the stale
+    # overlay copy is double-counted. Resources are constructed fresh,
+    # never deepcopy-mutated: comparable() caches on the instance and a
+    # mutated copy would serve the stale cached bundle (the documented
+    # immutability contract production code follows)
+    from nomad_tpu.structs import (
+        AllocatedResources, AllocatedSharedResources,
+        AllocatedTaskResources)
+    smaller = copy.copy(inflight_alloc)
+    smaller.allocated_resources = AllocatedResources(
+        tasks={"web": AllocatedTaskResources(cpu_shares=500,
+                                             memory_mb=256)},
+        shared=AllocatedSharedResources(disk_mb=150))
+    store.upsert_allocs([smaller])
+    rejects, fit = planner._fast_check(overlay, plan, node_ids)
+    assert node.id not in rejects
+    assert node.id in fit
+
+
+def test_snapshot_cache_identity_and_invalidation():
+    """store.snapshot() returns ONE object per write index; any write
+    invalidates; incremental secondary-index copies stay correct
+    through inserts and deletes."""
+    store, nodes, job = _world(n_nodes=2)
+    s1 = store.snapshot()
+    assert store.snapshot() is s1
+    a = mock.alloc_for(job, nodes[0], index=0)
+    store.upsert_allocs([a])
+    s2 = store.snapshot()
+    assert s2 is not s1
+    assert [x.id for x in s2.allocs_by_node(nodes[0].id)] == [a.id]
+    assert s1.allocs_by_node(nodes[0].id) == []     # immutable view
+
+    store.delete_allocs([a.id])
+    s3 = store.snapshot()
+    assert s3.allocs_by_node(nodes[0].id) == []
+    assert [x.id for x in s2.allocs_by_node(nodes[0].id)] == [a.id]
