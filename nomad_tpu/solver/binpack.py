@@ -1022,9 +1022,24 @@ def _wave_p_bucket(p: int) -> int:
 def _wave_unroll() -> int:
     """Scan unroll: 8 on TPU (amortizes per-step loop overhead), 1
     elsewhere (unrolling multiplies the compiled body; CPU/virtual-mesh
-    runs are compile-time-bound, not step-overhead-bound)."""
+    runs are compile-time-bound, not step-overhead-bound).
+    NOMAD_TPU_WAVE_UNROLL overrides (perf experiments)."""
+    import os
+
     import jax as _jax
+    ov = os.environ.get("NOMAD_TPU_WAVE_UNROLL")
+    if ov:
+        return max(1, int(ov))
     return 8 if _jax.default_backend() == "tpu" else 1
+
+
+def _wave_gather_dynslice() -> bool:
+    """Refill-row gather strategy: one-hot masked reduce (default; safe
+    under vmap on TPU) vs dynamic_slice (NOMAD_TPU_WAVE_GATHER=dynslice;
+    perf experiments -- vmapped scalar-index slices lower to gathers,
+    which are fast or slow depending on backend/shape)."""
+    import os
+    return os.environ.get("NOMAD_TPU_WAVE_GATHER") == "dynslice"
 
 
 def _slotmat_cols(c, init: NodeState, const: NodeConst, aff_node, dtype):
@@ -1592,8 +1607,13 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen, sp=None,
         jw = jnp.sum(jnp.where(oh_w, j2, 0), dtype=jnp.int32)
         csw = jnp.sum(jnp.where(oh_w, cs, 0.0))
         sat = do & (jw.astype(dtype) >= csw)
-        oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
-        entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0), axis=0)
+        if _wave_gather_dynslice():
+            entry_row = jax.lax.dynamic_slice_in_dim(
+                compact, jnp.clip(cursor, 0, C - 1), 1, axis=0)[0]
+        else:
+            oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
+            entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0),
+                                axis=0)
         take_next = arangeB >= w
         is_last = arangeB == B - 1
         j_sh = jnp.where(is_last, 0,
@@ -2047,8 +2067,13 @@ def _solve_wave_preempt_impl(compact, cand, scal_f, scal_i, pen, counts0,
         z = jnp.maximum(pending, 0)
         oh_z = arangeB == z
         zomb = (pending >= 0) & ~jnp.any(oh_z & fit_c)
-        oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
-        entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0), axis=0)
+        if _wave_gather_dynslice():
+            entry_row = jax.lax.dynamic_slice_in_dim(
+                compact, jnp.clip(cursor, 0, C - 1), 1, axis=0)[0]
+        else:
+            oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
+            entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0),
+                                axis=0)
         entry_cd = {
             kk: jnp.sum(jnp.where(oh_c[:, None], vv,
                                   jnp.zeros((), dtype=vv.dtype)),

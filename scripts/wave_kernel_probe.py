@@ -1,0 +1,94 @@
+"""On-chip wave-kernel perf probe: times the fused compute-only program
+(the BENCH headline's `fused_compute_placements_per_sec`) across kernel
+variants (scan unroll factor, refill-gather strategy) at the headline
+shape. Run only when the chip is reachable; prints one line per variant.
+
+Usage: python scripts/wave_kernel_probe.py [E] [P] [variants...]
+  variants are "unroll:gather" pairs, e.g. 8:onehot 16:dynslice
+"""
+import functools
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+E = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+P = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+variants = sys.argv[3:] or ["8:onehot", "16:onehot", "32:onehot",
+                            "8:dynslice", "16:dynslice"]
+
+import bench  # noqa: E402  (repo root on path)
+
+t0 = time.time()
+h, job, nodes = bench.build_world()
+print(f"world built in {time.time()-t0:.1f}s", flush=True)
+
+# build E lanes exactly as time_fused_solver does
+from nomad_tpu import mock  # noqa: E402
+from nomad_tpu.scheduler.context import EvalContext  # noqa: E402
+from nomad_tpu.scheduler.reconcile import AllocPlaceResult  # noqa: E402
+from nomad_tpu.solver.service import TpuPlacementService  # noqa: E402
+from nomad_tpu.structs import Plan  # noqa: E402
+
+snap = h.state.snapshot()
+lanes = []
+for i in range(E):
+    j = mock.job(id=f"probe-{i}")
+    j.task_groups[0].count = P
+    tg = j.task_groups[0]
+    plan = Plan(eval_id=f"probe-eval-{i:016d}", priority=50, job=j)
+    ctx = EvalContext(snap, plan)
+    places = [AllocPlaceResult(name=f"{j.id}.{tg.name}[{k}]", task_group=tg)
+              for k in range(P)]
+    svc = TpuPlacementService(ctx, j, batch_mode=False, spread_alg=False)
+    lanes.append(svc.pack(tg, places, nodes))
+print(f"{E} lanes packed in {time.time()-t0:.1f}s", flush=True)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+print(f"backend: {jax.default_backend()}", flush=True)
+
+baseline_out = None
+for v in variants:
+    unroll, gather = v.split(":")
+    os.environ["NOMAD_TPU_WAVE_UNROLL"] = unroll
+    os.environ["NOMAD_TPU_WAVE_GATHER"] = gather
+    # fresh trace every variant: the env reads happen at trace time
+    from nomad_tpu.solver.binpack import (  # noqa: E402
+        _solve_wave_compact_impl, _wave_p_bucket, wavefront_compact_host)
+    B = lanes[0].wavefront_B()
+    p_pad = _wave_p_bucket(max(l.batch.ask_cpu.shape[0] for l in lanes))
+    packs = [wavefront_compact_host(l.const, l.init, l.batch, l.dtype_name,
+                                    p_pad=p_pad, B=B) for l in lanes]
+    compact = np.stack([p[0] for p in packs])
+    scal_f = np.stack([p[1] for p in packs])
+    scal_i = np.stack([p[2] for p in packs])
+    pen = np.stack([p[3] for p in packs])
+    inner = jax.vmap(functools.partial(
+        _solve_wave_compact_impl, sp=None, B=B,
+        spread_alg=lanes[0].spread_alg, dtype_name=lanes[0].dtype_name))
+    fn = jax.jit(inner)
+    dev = jax.device_put((compact, scal_f, scal_i, pen))
+    tc = time.time()
+    out = fn(*dev)
+    out[0].block_until_ready()
+    compile_s = time.time() - tc
+    times = []
+    for _ in range(5):
+        t1 = time.perf_counter()
+        out = fn(*dev)
+        out[0].block_until_ready()
+        times.append(time.perf_counter() - t1)
+    med = statistics.median(times)
+    chosen = np.asarray(out[0])
+    if baseline_out is None:
+        baseline_out = chosen
+        par = "ref"
+    else:
+        par = f"mismatch={int((chosen != baseline_out).sum())}"
+    print(f"variant unroll={unroll:>2} gather={gather:<8} "
+          f"median {med*1000:7.2f}ms  {E*P/med:10.0f} placements/s  "
+          f"compile {compile_s:5.1f}s  {par}", flush=True)
