@@ -417,7 +417,7 @@ def time_fused_solver(h, nodes, e_evals, per_eval, repeats=3):
                                       spread_alg=False)
         lane = service.pack(tg, places, nodes)
         if lane is None:
-            return None, 0, 0
+            return None, 0, 0, None
         lanes.append(lane)
 
     fused = fuse_and_solve(lanes)           # warmup (incl. compile)
@@ -436,17 +436,43 @@ def time_fused_solver(h, nodes, e_evals, per_eval, repeats=3):
     # compute-only: same fused program with device-RESIDENT inputs.
     # Separates chip capability from the host<->device link (which in
     # this environment is a tunnel ~1000x slower than local PCIe).
-    compute_dt = None
+    compute_info = None
     try:
-        compute_dt = _fused_compute_only(lanes, repeats)
+        blocking_dt, marginal_dt = _fused_compute_only(lanes, repeats)
+        compute_info = {"blocking": blocking_dt, "marginal": marginal_dt}
     except Exception as e:  # noqa: BLE001 -- report without it
         log(f"bench: fused compute-only probe failed: {e!r}")
-    return statistics.median(times), placed, mismatch, compute_dt
+    return statistics.median(times), placed, mismatch, compute_info
+
+
+def _tunnel_rtt():
+    """Round-trip latency of a trivial dispatch+fetch (median of 5).
+    Under the axon tunnel this is ~tens of ms and dominates ANY blocking
+    per-call timing; reporting it separately lets every other metric be
+    read as (RTT + real work). On local-attached hardware it is ~0."""
+    import jax
+    import numpy as np
+    fn = jax.jit(lambda x: x + 1.0)
+    x = jax.device_put(np.zeros(8, dtype=np.float32))
+    np.asarray(fn(x))
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(fn(x))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
 
 
 def _fused_compute_only(lanes, repeats=3):
-    """Median on-device time for the fused wavefront program over E
-    pre-transferred lanes (the number a non-tunneled deployment sees)."""
+    """On-device cost of the fused wavefront program over E
+    pre-transferred lanes. Returns (blocking_dt, marginal_dt):
+    blocking_dt is the classic per-call median (includes one dispatch
+    round trip -- through the axon tunnel that is ~70ms of pure
+    latency); marginal_dt chains R executions inside ONE dispatch (each
+    feeding a data-dependent no-op perturbation to the next, so XLA
+    cannot elide them) and takes (t(R) - t(1)) / (R - 1) -- the true
+    steady-state per-execution compute, what a pipelined or
+    local-attached deployment pays."""
     import functools
 
     import jax
@@ -456,9 +482,9 @@ def _fused_compute_only(lanes, repeats=3):
 
     if not all(lane.ptab is None and lane.wavefront_ok()
                for lane in lanes):
-        return None
+        return None, None       # ineligible lane shape: clean skip
     if lanes[0].const.spread_vidx.shape[0]:
-        return None             # spread lanes carry extra tables
+        return None, None       # spread lanes carry extra tables
     B = lanes[0].wavefront_B()
     p_pad = _wave_p_bucket(max(
         lane.batch.ask_cpu.shape[0] for lane in lanes))
@@ -483,7 +509,44 @@ def _fused_compute_only(lanes, repeats=3):
         out = fn(*dev)
         out[0].block_until_ready()
         times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+    blocking_dt = statistics.median(times)
+
+    # marginal: chain R kernel executions inside one dispatch, linked
+    # by a scores.sum() * 1e-12 input perturbation -- a real data
+    # dependency, so the compiler runs every execution. The perturbation
+    # can flip exact-zero columns (affinity, pos) in later iterations,
+    # so chained results are NOT parity-grade; the op graph and
+    # therefore the timing are identical, which is all this probe uses.
+    import jax.numpy as jnp
+
+    def chained(R):
+        def run(cm, sf, si, pn):
+            def once(x, _):
+                ch, sc, ny = inner(cm + x * 1e-12, sf, si, pn)
+                # finite fold: padded/unyielded steps emit -inf scores
+                s = jnp.where(jnp.isfinite(sc), sc, 0.0).sum()
+                return s, None
+            last, _ = jax.lax.scan(once, jnp.float32(0), None, length=R)
+            return last
+        return jax.jit(run)
+
+    marginal_dt = None
+    try:
+        f1, f9 = chained(1), chained(9)
+        np.asarray(f1(*dev)), np.asarray(f9(*dev))     # compile both
+        t1s, t9s = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f1(*dev))
+            t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np.asarray(f9(*dev))
+            t9s.append(time.perf_counter() - t0)
+        marginal_dt = max(
+            (statistics.median(t9s) - statistics.median(t1s)) / 8, 1e-9)
+    except Exception as e:  # noqa: BLE001 -- keep the blocking number
+        log(f"bench: chained compute probe failed: {e!r}")
+    return blocking_dt, marginal_dt
 
 
 def solve_once(h, job, nodes, n_placements):
@@ -593,6 +656,14 @@ def main():
     # --- TPU solver: warmup (compile) then repeated timed evals for p50
     warm_dt, tpu_placed = solve_once(h, job, nodes, N_PLACEMENTS)
     log(f"bench: solver warmup (incl. compile) {warm_dt:.3f}s")
+    rtt = None
+    try:
+        rtt = _tunnel_rtt()
+        log(f"bench: dispatch round-trip (trivial program) "
+            f"{rtt * 1e3:.1f}ms -- every blocking per-call timing below "
+            f"includes this as pure host<->device latency")
+    except Exception as e:  # noqa: BLE001 -- diagnostic only
+        log(f"bench: rtt probe failed: {e!r}")
     times = []
     for r in range(N_REPEATS):
         dt, rep_placed = solve_once(h, job, nodes, N_PLACEMENTS)
@@ -632,9 +703,16 @@ def main():
                     f"{N_PLACEMENTS} in {fdt:.3f}s ({fplaced} placed, "
                     f"{fplaced / fdt:.0f} placements/s, "
                     f"fused_mismatch={fmis})")
-                if fcompute:
-                    log(f"bench: fused compute-only {fcompute * 1e3:.1f}ms "
-                        f"({fplaced / fcompute:.0f} placements/s on-chip)")
+                if fcompute and fcompute.get("blocking"):
+                    log(f"bench: fused compute-only "
+                        f"{fcompute['blocking'] * 1e3:.1f}ms blocking "
+                        f"({fplaced / fcompute['blocking']:.0f} "
+                        f"placements/s incl. 1 dispatch RTT)")
+                if fcompute and fcompute.get("marginal"):
+                    log(f"bench: fused compute MARGINAL "
+                        f"{fcompute['marginal'] * 1e3:.2f}ms/exec "
+                        f"({fplaced / fcompute['marginal']:.0f} "
+                        f"placements/s steady-state on-chip)")
         except Exception as e:  # noqa: BLE001 -- report the rest anyway
             log(f"bench: fused solver failed: {e!r}")
 
@@ -678,14 +756,16 @@ def main():
         batched_full = run_batched("headline shape", e_evals, N_PLACEMENTS)
 
     _emit(platform, p50, mismatch, oracle_dt, native_dt, batched,
-          n_placed=n_tpu_ok, fused=fused, batched_full=batched_full)
+          n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
+          rtt=rtt)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
 
 
 def _emit(platform, p50, mismatch, oracle_total, native_total=None,
-          batched=None, n_placed=0, fused=None, batched_full=None):
+          batched=None, n_placed=0, fused=None, batched_full=None,
+          rtt=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -706,6 +786,8 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
         "platform": platform,
         "parity_mismatch": mismatch,
     }
+    if rtt is not None:
+        out["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
     if native_total is not None:
         vs_native = (per_place_native / per_place_tpu) if per_place_tpu \
             else 0.0
@@ -732,13 +814,29 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
             out["fused_vs_native_host"] = round(
                 per_place_native / (fdt / fplaced), 4)
             out["vs_baseline"] = out["fused_vs_native_host"]
-        if fcompute:
-            out["fused_compute_ms"] = round(fcompute * 1e3, 3)
+        blocking = fcompute.get("blocking") if fcompute else None
+        marginal = fcompute.get("marginal") if fcompute else None
+        if blocking:
+            out["fused_compute_ms"] = round(blocking * 1e3, 3)
             out["fused_compute_placements_per_sec"] = round(
-                fplaced / fcompute, 2)
+                fplaced / blocking, 2)
             if per_place_native is not None:
                 out["fused_compute_vs_native_host"] = round(
-                    per_place_native / (fcompute / fplaced), 4)
+                    per_place_native / (blocking / fplaced), 4)
+        if marginal:
+            # steady-state on-chip rate (chained in-dispatch repeats):
+            # the dispatch round trip -- rtt_ms, ~70ms through this
+            # environment's axon tunnel, ~0 locally attached --
+            # amortizes away under pipelining, so THIS is the chip's
+            # real throughput and the number a production deployment
+            # (local PCIe/ICI attach) sees; the blocking metrics above
+            # keep the tunnel cost visible rather than hiding it.
+            out["fused_compute_marginal_ms"] = round(marginal * 1e3, 3)
+            out["fused_compute_marginal_placements_per_sec"] = round(
+                fplaced / marginal, 2)
+            if per_place_native is not None:
+                out["fused_compute_marginal_vs_native_host"] = round(
+                    per_place_native / (marginal / fplaced), 4)
     if batched is not None:
         bdt, bevals, bplaced = batched
         out["batched_evals_per_sec"] = round(bevals / bdt, 2)

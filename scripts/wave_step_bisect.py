@@ -202,3 +202,30 @@ for e2 in (64, 128, 256):
     p2 = jnp.zeros((e2, P), dtype=jnp.int32) - 1
     med = timeit(f"full @ E={e2}", scan_over(step_full), c2, p2)
     print(f"   -> {e2*P/med/1e6:.2f}M placements/s", flush=True)
+
+
+# --- in-dispatch repeat: amortize the tunnel RTT out of the measurement
+# (one jit call runs the kernel R times, chained through a data dep) ---
+def chained(step, R):
+    def run(compact_b, pen_b):
+        def once(x, _):
+            c2 = compact_b + x * 1e-12
+            ys = scan_over(step)(c2, pen_b)
+            # fold outputs to a scalar that feeds the next iteration
+            s = ys[1].sum()
+            return s, s
+        out, _ = jax.lax.scan(once, jnp.float32(0), None, length=R)
+        return out
+    return run
+
+
+for R in (1, 4, 16):
+    f = jax.jit(chained(step_full, R))
+    _ = np.asarray(f(compact, pen))
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        _ = np.asarray(f(compact, pen))
+        ts.append(time.perf_counter() - t0)
+    print(f"full kernel xR={R:<3} sync median {statistics.median(ts)*1000:8.2f}ms",
+          flush=True)
