@@ -478,7 +478,8 @@ def _fused_compute_only(lanes, repeats=3):
     import jax
     import numpy as np
     from nomad_tpu.solver.binpack import (
-        _solve_wave_compact_impl, _wave_p_bucket, wavefront_compact_host)
+        _solve_wave_block_impl, _solve_wave_compact_impl,
+        _wave_block_enabled, _wave_p_bucket, wavefront_compact_host)
 
     if not all(lane.ptab is None and lane.wavefront_ok()
                for lane in lanes):
@@ -495,9 +496,14 @@ def _fused_compute_only(lanes, repeats=3):
     scal_f = np.stack([p[1] for p in packs])
     scal_i = np.stack([p[2] for p in packs])
     pen = np.stack([p[3] for p in packs])
+    # mirror the production kernel choice (solve_lane_wave's gate): the
+    # run-block kernel on penalty-free no-spread lanes, else the
+    # per-placement compact scan
+    use_block = _wave_block_enabled() and bool((pen < 0).all())
+    impl = (_solve_wave_block_impl if use_block
+            else functools.partial(_solve_wave_compact_impl, sp=None))
     inner = jax.vmap(functools.partial(
-        _solve_wave_compact_impl, sp=None, B=B,
-        spread_alg=lanes[0].spread_alg,
+        impl, B=B, spread_alg=lanes[0].spread_alg,
         dtype_name=lanes[0].dtype_name))
     fn = jax.jit(inner)
     dev = jax.device_put((compact, scal_f, scal_i, pen))

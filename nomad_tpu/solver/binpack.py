@@ -1042,6 +1042,35 @@ def _wave_gather_dynslice() -> bool:
     return os.environ.get("NOMAD_TPU_WAVE_GATHER") == "dynslice"
 
 
+def _wave_refill_shift(compact, cursor, w, j2, slot, gate, arangeB,
+                       arangeC):
+    """Shared winner shift/refill for the compact and run-block wave
+    kernels: shift slots above ``w`` left, append the ``cursor`` row of
+    ``compact``, advance the cursor -- all gated on ``gate``. The two
+    kernels' bit-parity contract depends on this being ONE
+    implementation (tests/test_wave_block.py)."""
+    C = compact.shape[0]
+    B = arangeB.shape[0]
+    if _wave_gather_dynslice():
+        entry_row = jax.lax.dynamic_slice_in_dim(
+            compact, jnp.clip(cursor, 0, C - 1), 1, axis=0)[0]
+    else:
+        oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
+        entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0),
+                            axis=0)
+    take_next = arangeB >= w
+    is_last = arangeB == B - 1
+    j_sh = jnp.where(is_last, 0,
+                     jnp.where(take_next, jnp.roll(j2, -1), j2))
+    slot_sh = jnp.where(
+        is_last[:, None], entry_row[None, :],
+        jnp.where(take_next[:, None], jnp.roll(slot, -1, axis=0), slot))
+    j3 = jnp.where(gate, j_sh, j2)
+    slot2 = jnp.where(gate, slot_sh, slot)
+    cursor2 = cursor + gate.astype(jnp.int32)
+    return j3, slot2, cursor2
+
+
 def _slotmat_cols(c, init: NodeState, const: NodeConst, aff_node, dtype):
     """(N, 7) per-node row: [c, used_cpu0, used_mem0, cpu_cap, mem_cap,
     placed0, affinity]. c/placed are < 2^24 so the float cast is exact."""
@@ -1607,23 +1636,8 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen, sp=None,
         jw = jnp.sum(jnp.where(oh_w, j2, 0), dtype=jnp.int32)
         csw = jnp.sum(jnp.where(oh_w, cs, 0.0))
         sat = do & (jw.astype(dtype) >= csw)
-        if _wave_gather_dynslice():
-            entry_row = jax.lax.dynamic_slice_in_dim(
-                compact, jnp.clip(cursor, 0, C - 1), 1, axis=0)[0]
-        else:
-            oh_c = arangeC == jnp.clip(cursor, 0, C - 1)
-            entry_row = jnp.sum(jnp.where(oh_c[:, None], compact, 0.0),
-                                axis=0)
-        take_next = arangeB >= w
-        is_last = arangeB == B - 1
-        j_sh = jnp.where(is_last, 0,
-                         jnp.where(take_next, jnp.roll(j2, -1), j2))
-        slot_sh = jnp.where(
-            is_last[:, None], entry_row[None, :],
-            jnp.where(take_next[:, None], jnp.roll(slot, -1, axis=0), slot))
-        j3 = jnp.where(sat, j_sh, j2)
-        slot2 = jnp.where(sat, slot_sh, slot)
-        cursor2 = cursor + sat.astype(jnp.int32)
+        j3, slot2, cursor2 = _wave_refill_shift(
+            compact, cursor, w, j2, slot, sat, arangeB, arangeC)
         if S:
             # winner's value index per spread -> bump its count
             vw = jnp.sum(jnp.where(oh_w[:, None], slot[:, 8:], 0.0),
@@ -1643,6 +1657,294 @@ def _solve_wave_compact_impl(compact, scal_f, scal_i, pen, sp=None,
         (jnp.arange(P, dtype=jnp.int32), pen.astype(jnp.int32)),
         unroll=_wave_unroll())
     return chosen, scores, n_yielded
+
+
+# ---------------------------------------------------------------------------
+# Run-block wavefront: the compact kernel's semantics in ~P/7 chain
+# steps instead of P.
+#
+# On-chip profiling (scripts/wave_step_bisect.py) showed the per-step
+# cost of the compact scan is dependency-chain LATENCY -- a handful of
+# sequentially dependent vector ops -- not arithmetic width; the chip
+# pays it P times because the scan commits one placement per step. The
+# shortcut is the FROZEN-OPPONENT structure of the greedy select
+# (rank.go:205 BinPackIterator + select.go MaxScoreIterator): scores
+# couple placements only through the winner's own per-node count j, so
+# while one slot keeps winning, every other slot's head score is
+# frozen. One chain step can therefore commit a winner's whole RUN:
+# pick the argmax head (first-seen-in-order tie rule), then compute in
+# closed form how many consecutive picks q it takes before
+#   - its stream value loses to the frozen runner-up head (strictly
+#     below, or tied with a runner-up of earlier window order),
+#   - it saturates its closed-form capacity c (committed, then the
+#     classic shift/refill runs and the block ends -- refills change
+#     window composition),
+#   - its value crosses the skip threshold in either direction (the
+#     low/skip sets, select.go maxSkip, are recomputed at the next
+#     block start), or
+#   - the eval's n_active placements are exhausted,
+# and emit all q picks (scores are the winner's precomputed stream
+# values) in one dynamic-update-slice. BestFit streams mostly RISE with
+# usage (fuller nodes score higher), so winners run until saturation
+# and runs are long: the headline lane shape (10K nodes, 2000
+# placements) has 272 winner runs averaging 7.4 picks
+# (scripts/wave_event_stats.py). No assumption on stream shape is
+# needed -- a run ends exactly when the per-step argmax would change.
+#
+# Equivalence argument (induction on committed picks): at a block start
+# the head state (fit/low/skip/window/fallback/order/deficit) is
+# recomputed exactly as the per-placement kernel's step does, so the
+# argmax-with-tie-rule winner is the classic step's winner. While the
+# winner runs, opponents' heads and every selection set are unchanged
+# (fit changes only at the winner's saturation, low/skip sets only at
+# threshold crossings -- both end the block), so the q-th pick of the
+# run faces the same frozen comparison the classic kernel would
+# compute, and the run-length conditions stop precisely at the first
+# pick where the classic winner would differ. Outputs are
+# bit-identical: emitted scores are the same elementwise expressions
+# (broadcast over (B, K) instead of (B,)), and n_yielded is frozen
+# between events by the same argument.
+#
+# Eligibility (enforced by solve_lane_wave): no spread tables (S == 0;
+# spread boosts couple scores across slots through shared value
+# counts) and no active reschedule penalties (penalties couple the
+# score to the absolute placement index).
+
+WAVE_K = 32            # run-block width: max picks committed per step
+WAVE_INNER = 64        # run decisions per outer buffer-commit round
+
+
+def _wave_block_enabled() -> bool:
+    """Run-block dispatch gate: on by default everywhere (the CPU test
+    suite then parity-gates it continuously); NOMAD_TPU_WAVE_BLOCK=0
+    falls back to the per-placement compact scan."""
+    import os
+    return os.environ.get("NOMAD_TPU_WAVE_BLOCK", "1") != "0"
+
+
+def _solve_wave_block_impl(compact, scal_f, scal_i, pen,
+                           spread_alg: bool = False,
+                           dtype_name: str = "float32",
+                           B: int = WAVE_B, K: int = WAVE_K,
+                           INNER: int = WAVE_INNER):
+    """Run-block wavefront solve over a host-precomputed compact table;
+    bit-identical outputs to _solve_wave_compact_impl on eligible lanes
+    (see block comment above). ``pen`` is accepted for call-signature
+    parity and must be penalty-free (callers gate)."""
+    del pen                     # gated: no active reschedule penalties
+    dtype = jnp.dtype(dtype_name)
+    C = compact.shape[0]
+    P = C - B
+    ask_cpu = scal_f[0]
+    ask_mem = scal_f[1]
+    count = scal_f[2]
+    L = scal_i[0]
+    n_active = scal_i[1]
+    arangeB = jnp.arange(B, dtype=jnp.int32)
+    arangeK = jnp.arange(K, dtype=jnp.int32)
+    arangeC = jnp.arange(C, dtype=jnp.int32)
+    arangePK = jnp.arange(P + K, dtype=jnp.int32)
+    neg_inf = jnp.array(-jnp.inf, dtype=dtype)
+    big = jnp.iinfo(jnp.int32).max
+
+    def head_state(j, slot):
+        """The classic step's per-slot head computation at the current
+        (j, slot) -- (B,)-wide only; the winner's forward stream is
+        rebuilt from scalars in block_step. All expressions mirror
+        _solve_wave_compact_impl op for op so scores are bit-identical.
+        The three selection cumsums collapse to one stacked cumsum via
+        cumsum(skipped) == min(cumsum(low), MAX_SKIP) (the skip budget
+        takes exactly the first MAX_SKIP lows) and cumsum(counted) ==
+        cumsum(fit) - cumsum(skipped) (skipped is a subset of fit)."""
+        cs = slot[:, 0]
+        fit0 = j.astype(dtype) < cs
+        jp1 = (j + 1).astype(dtype)
+        new_cpu = slot[:, 1] + jp1 * ask_cpu
+        new_mem = slot[:, 2] + jp1 * ask_mem
+        free_cpu = 1.0 - new_cpu / jnp.maximum(slot[:, 3], 1e-9)
+        free_mem = 1.0 - new_mem / jnp.maximum(slot[:, 4], 1e-9)
+        binpack = _binpack_score(free_cpu, free_mem, spread_alg)
+        coll = slot[:, 5] + j.astype(dtype)
+        anti = jnp.where(
+            coll > 0, -(coll + 1.0) / jnp.maximum(count, 1.0), 0.0)
+        affs = slot[:, 6]
+        nsc = (1.0 + (coll > 0).astype(dtype)
+               + (affs != 0.0).astype(dtype))
+        f0 = (binpack + (anti + affs)) / nsc
+        low = fit0 & (f0 <= SKIP_THRESHOLD)
+        cs2 = jnp.cumsum(
+            jnp.stack([low, fit0]).astype(jnp.int32), axis=1)
+        skip_rank = cs2[0]
+        srank = jnp.minimum(skip_rank, MAX_SKIP)
+        skipped = low & (skip_rank <= MAX_SKIP)
+        cpos = cs2[1] - srank
+        counted = fit0 & ~skipped
+        window = counted & (cpos <= L)
+        deficit = jnp.maximum(0, L - jnp.minimum(cpos[-1], L))
+        fallback = skipped & (srank <= deficit)
+        yielded = window | fallback
+        order = jnp.where(window, cpos, L + srank)
+        ny = jnp.sum(yielded.astype(jnp.int32), dtype=jnp.int32)
+        any_yield = jnp.any(yielded)
+        return f0, low, yielded, order, ny, any_yield
+
+    def block_step(carry, _):
+        """One greedy run decision over the SMALL solver state. Emitted
+        records (winner pos, run length, start offset, ny, the winner's
+        K score values) are lax.scan ys -- kept OUT of the carry so the
+        vmapped loop's per-iteration masking touches only ~B*9 floats,
+        not the (P+K,) output buffers."""
+        j, slot, cursor, p, done = carry
+        f0, low, yielded, order, ny, any_yield = head_state(j, slot)
+
+        # classic winner: max head, ties to the earliest window order
+        effH = jnp.where(yielded, f0, neg_inf)
+        best = jnp.max(effH)
+        w = jnp.argmin(jnp.where(effH == best, order, big))
+        oh_w = arangeB == w
+
+        # winner scalars in ONE masked reduce (all integer-valued
+        # columns are < 2^24: exact in the score dtype)
+        svals = jnp.sum(jnp.where(
+            oh_w[:, None],
+            jnp.concatenate(
+                [slot[:, :8],
+                 jnp.stack([j.astype(dtype), order.astype(dtype),
+                            low.astype(dtype)], axis=1)], axis=1),
+            0.0), axis=0)
+        cs_w, ucpu_w, umem_w = svals[0], svals[1], svals[2]
+        ccap_w, mcap_w, placed_w = svals[3], svals[4], svals[5]
+        aff_w, pos_w = svals[6], svals[7]
+        j_wf, order_wf, low_wf = svals[8], svals[9], svals[10]
+        low_w = low_wf != 0.0
+        eff_o = jnp.where(oh_w, neg_inf, effH)
+        rub = jnp.max(eff_o)
+        rub_ord = jnp.min(jnp.where(eff_o == rub, order, big))
+
+        # winner's forward stream from scalars: vals[q] = score of its
+        # (j_w + q + 1)-th placement, the same elementwise expressions
+        # as head_state broadcast over q (exact-int float arithmetic)
+        jq = j_wf + arangeK.astype(dtype)
+        validw = jq < cs_w
+        jp1q = jq + 1.0
+        fcq = 1.0 - (ucpu_w + jp1q * ask_cpu) / jnp.maximum(ccap_w, 1e-9)
+        fmq = 1.0 - (umem_w + jp1q * ask_mem) / jnp.maximum(mcap_w, 1e-9)
+        bpq = _binpack_score(fcq, fmq, spread_alg)
+        collq = placed_w + jq
+        antiq = jnp.where(
+            collq > 0, -(collq + 1.0) / jnp.maximum(count, 1.0), 0.0)
+        nscq = (1.0 + (collq > 0).astype(dtype)
+                + jnp.where(aff_w != 0.0, 1.0, 0.0))
+        vals = (bpq + (antiq + aff_w)) / nscq
+
+        # run length: picks until the winner loses, transitions through
+        # the skip threshold, runs out of capacity, or exhausts the eval
+        q = arangeK
+        win_q = ((vals > rub)
+                 | ((vals == rub) & (order_wf < rub_ord.astype(dtype)))
+                 | (q == 0))
+        cross = jnp.where(low_w, vals > SKIP_THRESHOLD,
+                          vals <= SKIP_THRESHOLD) & (q > 0)
+        stop_q = (~validw) | (~win_q) | cross | (q >= n_active - p)
+        tlim = jnp.min(jnp.where(stop_q, q, K))
+        # saturation: the q_sat-th pick fills the slot (j_w + q_sat + 1
+        # == c_w); commit it, then shift/refill. c/j < 2^24: exact
+        # floats.
+        q_sat = (cs_w - 1.0 - j_wf).astype(jnp.int32)
+        has_sat = (q_sat < K) & (q_sat < tlim)
+        t = jnp.where(has_sat, q_sat + 1, tlim)
+        # t >= 1 whenever active: q=0 is valid (the winner is yielded,
+        # hence fit), wins by construction, and cannot be a threshold
+        # crossing
+        active = any_yield & ~done & (p < n_active)
+        t = jnp.where(active, t, 0)
+        has_sat = has_sat & active
+
+        j2 = j + oh_w.astype(jnp.int32) * t
+
+        # classic shift/refill, gated on the saturation event
+        j3, slot2, cursor2 = _wave_refill_shift(
+            compact, cursor, w, j2, slot, has_sat, arangeB, arangeC)
+        done2 = done | ~any_yield
+        # invalid stream positions store 0.0 (not -inf): the outer
+        # expansion reads them through a one-hot matmul, and
+        # 0 * -inf would poison the row sums with NaN; positions
+        # beyond the run length are never selected anyway
+        rec = (pos_w, t, p, ny, jnp.where(validw, vals, 0.0))
+        return (j3, slot2, cursor2, p + t, done2), rec
+
+    def outer_body(carry):
+        """INNER run decisions via lax.scan (small carry), then ONE
+        vectorized expansion of the records into the output buffers --
+        the buffers ride only this outer loop, whose trip count is
+        ~P / (INNER * mean-run) instead of the block count."""
+        j, slot, cursor, p, done, ch_buf, sc_buf, ny_buf = carry
+        p_begin = p
+        (j2, slot2, cursor2, p2, done2), recs = jax.lax.scan(
+            block_step, (j, slot, cursor, p, done), None, length=INNER)
+        pos_r, t_r, p0_r, ny_r, vals_r = recs
+
+        # expansion: position s belongs to the LAST block whose start
+        # offset is <= s (starts are non-decreasing; finished-lane
+        # records have t=0 and start=p2 > s for any committed s). All
+        # record lookups go through one-hot MATMULS, not gathers --
+        # batched gathers hit TPU slow paths, one (P+K, INNER) matmul
+        # rides the MXU. Record scalars are exact small ints in the
+        # score dtype.
+        s = arangePK
+        leq = (p0_r[None, :] <= s[:, None])            # (P+K, INNER)
+        nxt = jnp.concatenate(
+            [leq[:, 1:], jnp.zeros((P + K, 1), dtype=bool)], axis=1)
+        blk_oh = (leq & ~nxt).astype(dtype)            # one-hot of blk
+        recmat = jnp.stack(
+            [pos_r, t_r.astype(dtype), p0_r.astype(dtype),
+             ny_r.astype(dtype)], axis=1)              # (INNER, 4)
+        # HIGHEST precision: TPU matmuls default to bf16 passes,
+        # which would round the exact-int node positions; with one-hot
+        # rows (single nonzero term) full-f32 passes are exact
+        rs = jnp.matmul(blk_oh, recmat,
+                        precision=jax.lax.Precision.HIGHEST)
+
+        q_s = s.astype(dtype) - rs[:, 2]
+        covered = ((s >= p_begin) & (s < p2)
+                   & (q_s >= 0) & (q_s < rs[:, 1]))
+        rowvals = jnp.matmul(blk_oh, vals_r,
+                             precision=jax.lax.Precision.HIGHEST)
+        q_oh = (arangeK[None, :].astype(dtype)
+                == jnp.clip(q_s, 0, K - 1)[:, None])
+        sc_s = jnp.sum(jnp.where(q_oh, rowvals, 0.0), axis=1)
+        ch_buf = jnp.where(covered, rs[:, 0].astype(jnp.int32), ch_buf)
+        sc_buf = jnp.where(covered, sc_s, sc_buf)
+        ny_buf = jnp.where(covered, rs[:, 3].astype(jnp.int32), ny_buf)
+        return (j2, slot2, cursor2, p2, done2, ch_buf, sc_buf, ny_buf)
+
+    slot0 = compact[:B]
+    j0 = jnp.zeros(B, dtype=jnp.int32)
+    carry0 = (j0, slot0, jnp.int32(B), jnp.int32(0),
+              jnp.array(False),
+              jnp.full(P + K, -1, dtype=jnp.int32),
+              jnp.full(P + K, -jnp.inf, dtype=dtype),
+              jnp.zeros(P + K, dtype=jnp.int32))
+
+    def cond(carry):
+        _, _, _, p, done, _, _, _ = carry
+        return (p < n_active) & ~done
+
+    (j_f, slot_f, _, p_end, _, ch_buf, sc_buf,
+     ny_buf) = jax.lax.while_loop(cond, outer_body, carry0)
+
+    # beyond-active / stuck tail: the classic scan keeps emitting
+    # (chosen=-1, best-head score, n_yielded) from its frozen state for
+    # every remaining step; broadcast the same from the final state
+    f0_f, _, yielded_f, _, ny_f, any_yield_f = head_state(j_f, slot_f)
+    effH_f = jnp.where(yielded_f, f0_f, neg_inf)
+    best_f = jnp.max(effH_f)
+    fill_mask = arangePK >= p_end
+    sc_fill = jnp.where(any_yield_f, best_f, neg_inf)
+    ch_buf = jnp.where(fill_mask, -1, ch_buf)
+    sc_buf = jnp.where(fill_mask, sc_fill, sc_buf)
+    ny_buf = jnp.where(fill_mask, ny_f, ny_buf)
+    return ch_buf[:P], sc_buf[:P], ny_buf[:P]
 
 
 # ---------------------------------------------------------------------------
@@ -2277,22 +2579,37 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
             const, init, batch, dtype_name, p_pad=p_pad, B=B)
 
     # zero-size spread tables flow through uniformly: the kernel skips
-    # spread work statically when S == 0
+    # spread work statically when S == 0. Lanes with no spreads and no
+    # active reschedule penalties take the block-merge kernel (one chain
+    # step per window event, ~10x fewer sequential steps -- see the
+    # block comment at _solve_wave_block_impl); others take the
+    # per-placement compact scan.
+    use_block = (_wave_block_enabled()
+                 and sp.counts.shape[-2] == 0
+                 and bool((np.asarray(pen) < 0).all()))
     key = (compact.shape, sp.counts.shape, spread_alg, dtype_name,
-           batched, B)
+           batched, B, use_block)
     fn = _WAVE_COMPACT_FNS.get(key)
     if fn is None:
-        inner = functools.partial(_solve_wave_compact_impl,
-                                  spread_alg=spread_alg,
+        impl = (_solve_wave_block_impl if use_block
+                else _solve_wave_compact_impl)
+        inner = functools.partial(impl, spread_alg=spread_alg,
                                   dtype_name=dtype_name, B=B)
         if batched:
             inner = jax.vmap(inner)
 
-        @jax.jit
-        def fn(cm, sf, si, pn, spx):
-            chosen, scores, ny = inner(cm, sf, si, pn, spx)
-            return jnp.stack([chosen.astype(scores.dtype), scores,
-                              ny.astype(scores.dtype)])
+        if use_block:
+            @jax.jit
+            def fn(cm, sf, si, pn, spx):
+                chosen, scores, ny = inner(cm, sf, si, pn)
+                return jnp.stack([chosen.astype(scores.dtype), scores,
+                                  ny.astype(scores.dtype)])
+        else:
+            @jax.jit
+            def fn(cm, sf, si, pn, spx):
+                chosen, scores, ny = inner(cm, sf, si, pn, spx)
+                return jnp.stack([chosen.astype(scores.dtype), scores,
+                                  ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
     cm, sf, si, pn, spd = _put_eval_sharded(
         batched, compact.shape[0], (compact, scal_f, scal_i, pen, sp))
