@@ -1582,7 +1582,8 @@ class Server:
             raise ValueError(f"unknown deployment {deployment_id!r}")
         if d.status != DEPLOYMENT_STATUS_RUNNING:
             raise ValueError("deployment is not running")
-        allocs = [a for a in self.state.allocs()
+        allocs = [a for a in self.state.allocs_by_job(
+                      d.namespace, d.job_id)
                   if a.deployment_id == d.id]
         nd = copy.deepcopy(d)
         targets = groups or list(nd.task_groups)
@@ -1620,7 +1621,8 @@ class Server:
 
     def _watch_deployment(self, d: Deployment) -> None:
         import copy
-        allocs = [a for a in self.state.allocs()
+        allocs = [a for a in self.state.allocs_by_job(
+                      d.namespace, d.job_id)
                   if a.deployment_id == d.id]
         changed = False
         nd = copy.deepcopy(d)
