@@ -621,6 +621,10 @@ def main_tier(platform: str, tier: int):
 
     n_nodes = N_NODES
     count = N_PLACEMENTS
+    if tier == 1:
+        # BASELINE tier 1 is a fixed dev-cluster shape: 3-TG service
+        # job on 5 nodes (the TG counts come from the job itself)
+        n_nodes, count = 5, 3
     t0 = time.time()
     host, host_ev = run_tier_placements(tier, n_nodes, count, seed=1,
                                         alg="binpack", with_evictions=True)
@@ -638,6 +642,23 @@ def main_tier(platform: str, tier: int):
     keys = set(host) | set(tpu)
     mismatch = sum(1 for k in keys if host.get(k) != tpu.get(k))
     mismatch += sum(1 for k in keys if host_ev.get(k) != tpu_ev.get(k))
+    if tier == 2:
+        # BASELINE tier 2 is "binpack vs spread": gate the worst-fit
+        # scheduler-algorithm pair too
+        host_s, host_s_ev = run_tier_placements(
+            tier, n_nodes, count, seed=2, alg="spread",
+            with_evictions=True)
+        tpu_s, tpu_s_ev = run_tier_placements(
+            tier, n_nodes, count, seed=2, alg="tpu-spread",
+            with_evictions=True)
+        keys_s = set(host_s) | set(tpu_s)
+        sp_mism = sum(1 for k in keys_s
+                      if host_s.get(k) != tpu_s.get(k))
+        sp_mism += sum(1 for k in keys_s
+                       if host_s_ev.get(k) != tpu_s_ev.get(k))
+        log(f"bench[tier2]: spread-algorithm variant "
+            f"{len(tpu_s)} placements, parity_mismatch={sp_mism}")
+        mismatch += sp_mism
     placements_per_sec = len(tpu) / tpu_dt if tpu_dt else 0.0
     out = {
         "metric": f"tier{tier}_eval_placements_per_sec",

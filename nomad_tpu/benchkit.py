@@ -1,4 +1,4 @@
-"""Tier-shaped benchmark/parity worlds (BASELINE.md config tiers 2-5).
+"""Tier-shaped benchmark/parity worlds (BASELINE.md config tiers 1-5).
 
 Shared by tests/test_parity_scale.py (CI scale, CPU) and bench.py (full
 scale, TPU): the same generators build the same world shapes at any size,
@@ -6,6 +6,7 @@ so the parity CI gates exactly what the bench measures
 (reference sweep analog: scheduler/benchmarks/benchmarks_test.go:36-79).
 
 Tiers (BASELINE.md "Targets"):
+  1: 3-TG service job (web/api/worker) on a 5-node dev cluster
   2: batch allocs over uniform nodes, binpack vs spread algorithm
   3: C1M-replay shape -- cpu+mem+dynamic-port asks, node-class mix,
      kernel/class constraints
@@ -84,6 +85,25 @@ def tier_job(tier: int, rng: random.Random, count: int):
     task = tg.tasks[0]
     task.resources.cpu = rng.choice([250, 500, 1000])
     task.resources.memory_mb = rng.choice([256, 512, 1024])
+
+    if tier == 1:
+        # BASELINE tier 1: 3-TG service job on a 5-node dev cluster --
+        # the smallest end-to-end shape (web + api + worker, distinct
+        # asks, one TG with dynamic ports)
+        import copy as _copy
+        tg.name = "web"
+        tg.count = max(1, min(count, 3))
+        tg.networks = [NetworkResource(dynamic_ports=[Port(label="http")])]
+        for name, cnt, cpu, mem in (("api", 2, 500, 512),
+                                    ("worker", 1, 1000, 1024)):
+            tg2 = _copy.deepcopy(job.task_groups[0])
+            tg2.name = name
+            tg2.count = cnt
+            tg2.networks = []
+            tg2.tasks[0].resources.cpu = cpu
+            tg2.tasks[0].resources.memory_mb = mem
+            job.task_groups.append(tg2)
+        return job
 
     if tier == 3:
         # C1M shape: ports + constraints (cpu+mem+port per BASELINE tier 3)

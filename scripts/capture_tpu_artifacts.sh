@@ -16,17 +16,21 @@ except Exception:
 ts=$(date +%H%M%S)
 echo "== default bench =="
 python bench.py 2>bench_${ts}.err | tee BENCH_${r}_headline.json || exit 1
-for tier in 3 4 5; do
+for tier in 1 2 3 4 5; do
   echo "== tier $tier =="
   # tier 5's HOST-oracle side (preemption search in python) is ~30min
   # at the full 10K/2000 shape; a recovered-tunnel window is precious,
   # so the preemption tier runs at a reduced-but-honest shape (the
-  # parity gate and placements/s metric are shape-normalized)
+  # parity gate and placements/s metric are shape-normalized).
+  # Tiers 1/2 are the BASELINE dev-cluster and batch shapes (5 nodes /
+  # 3-TG service; 100 nodes / 1K batch, binpack+spread pair).
   extra=""
   if [ "$tier" = 5 ]; then
     extra="BENCH_NODES=4000 BENCH_PLACEMENTS=800"
+  elif [ "$tier" = 2 ]; then
+    extra="BENCH_NODES=100 BENCH_PLACEMENTS=1000"
   fi
   env $extra BENCH_TIER=$tier python bench.py 2>tier${tier}_${ts}.err \
     | tee BENCH_${r}_tier${tier}.json || exit 1
 done
-echo "done; artifacts: BENCH_${r}_headline.json BENCH_${r}_tier{3,4,5}.json"
+echo "done; artifacts: BENCH_${r}_headline.json BENCH_${r}_tier{1..5}.json"

@@ -1,4 +1,4 @@
-"""BASELINE tier 2-5 parity at scale (VERDICT r1 weak #2: round-1 parity
+"""BASELINE tier 1-5 parity at scale (VERDICT r1 weak #2: round-1 parity
 was toy-scale only). CI runs the tier shapes at hundreds of nodes on the
 CPU backend; bench.py reuses the same nomad_tpu/benchkit generators at
 full 5K-10K scale on TPU, so what CI gates is what the bench measures."""
@@ -12,6 +12,16 @@ from nomad_tpu.benchkit import run_tier_parity
 # caches and spread tables; small enough for the CPU backend.
 SCALE = int(os.environ.get("PARITY_SCALE_NODES", "600"))
 COUNT = int(os.environ.get("PARITY_SCALE_COUNT", "250"))
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_tier1_dev_cluster_three_tg(seed):
+    """BASELINE tier 1: 3-TG service job (web/api/worker, one TG with
+    dynamic ports) on a 5-node dev cluster -- the smallest end-to-end
+    shape, 6 placements across heterogeneous asks."""
+    host, tpu = run_tier_parity(1, 5, 3, seed)
+    assert len(host) == 6
+    assert tpu == host
 
 
 @pytest.mark.parametrize("seed", range(2))
@@ -55,20 +65,24 @@ def test_tier5_preemption_heavy():
 
 def test_tier_shapes_stay_on_dense_path():
     """VERDICT r2 weak #4: nothing asserted the TPU placement ratio on
-    tier-shaped workloads. Every tier 2-5 shape must place through the
-    dense solver (placements_tpu), not silent host fallbacks."""
+    tier-shaped workloads. Every tier 1-5 shape must place through the
+    TPU solver (placements_tpu), not silent host fallbacks."""
     from nomad_tpu.benchkit import run_tier_placements
     from nomad_tpu.server.telemetry import metrics
 
-    for tier in (2, 3, 4, 5):
+    # tier 1 places 6 (the 3-TG dev job defines its own counts)
+    for tier, n_nodes, count, expect in ((1, 5, 3, 6), (2, 200, 80, 80),
+                                         (3, 200, 80, 80),
+                                         (4, 200, 80, 80),
+                                         (5, 200, 80, 80)):
         metrics.reset()
-        placed = run_tier_placements(tier, 200, 80, seed=900 + tier,
-                                     alg="tpu-binpack")
-        assert len(placed) == 80, f"tier {tier}: {len(placed)} placed"
+        placed = run_tier_placements(tier, n_nodes, count,
+                                     seed=900 + tier, alg="tpu-binpack")
+        assert len(placed) == expect, f"tier {tier}: {len(placed)} placed"
         snap = metrics.snapshot()["counters"]
         tpu = snap.get("nomad.scheduler.placements_tpu", 0)
         fallback = snap.get("nomad.scheduler.placements_host_fallback", 0)
-        assert tpu == 80 and fallback == 0, (
+        assert tpu == expect and fallback == 0, (
             f"tier {tier}: tpu={tpu} host_fallback={fallback}")
 
 
