@@ -47,6 +47,12 @@ _PROBE = {"done": None, "result": None}    # threading.Event / dict
 
 
 def backend_available(timeout_s: float = 0.0) -> bool:
+    # lock-free fast path for the steady healthy state: both flags are
+    # only ever flipped under _LOCK, dict reads are atomic in CPython,
+    # and a stale read here is benign (one extra locked check). The
+    # degraded path still takes the lock for _maybe_recover_locked.
+    if _STATE["checked"] and _STATE["ok"]:
+        return True
     with _LOCK:
         if _STATE["checked"]:
             if not _STATE["ok"]:
