@@ -49,7 +49,8 @@ def make_case(rng, C, B):
     return compact, scal_f
 
 
-for (C_P, B, K, L) in ((40, 8, 4, 5), (160, 32, 32, 14), (96, 32, 8, 3)):
+for (C_P, B, K, L) in ((40, 8, 4, 5), (160, 32, 32, 14),
+                       (96, 32, 8, 3), (360, 128, 32, 100)):
     P = C_P - B
     classic = jax.jit(partial(_solve_wave_compact_impl, sp=None,
                               spread_alg=False, dtype_name="float32",

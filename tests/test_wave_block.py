@@ -45,7 +45,8 @@ def _make_case(rng, C, B):
 
 @pytest.mark.parametrize("spread_alg", [False, True])
 @pytest.mark.parametrize("C,B,K,L", [(40, 8, 4, 5), (160, 32, 32, 14),
-                                     (96, 32, 8, 3)])
+                                     (96, 32, 8, 3),
+                                     (360, 128, 32, 100)])
 def test_block_matches_classic_fuzz(C, B, K, L, spread_alg):
     """spread_alg=True is the worst-fit scoring mode (falling score
     streams: runs end by losing to the runner-up instead of by
