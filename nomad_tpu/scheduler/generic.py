@@ -155,12 +155,19 @@ class GenericScheduler:
                 self.stack.set_scheduler_configuration(
                     self.state.scheduler_config())
             self.stack.set_job(self.job)
-            nodes = self.state.ready_nodes_in_pool(self.job.node_pool)
-            # datacenter filter (reference: readyNodesInDCsAndPool)
-            dcs = set(self.job.datacenters)
-            if "*" not in dcs:
-                nodes = [n for n in nodes if n.datacenter in dcs]
-            self.base_nodes = list(nodes)   # pre-shuffle order, for the solver
+            # datacenter filter (reference: readyNodesInDCsAndPool),
+            # memoized on the snapshot so a barrier generation's evals
+            # share one ready list (and its pack key) instead of each
+            # paying the O(N) scan; treat the shared list as read-only
+            get_dcs = getattr(self.state, "ready_nodes_in_pool_dcs", None)
+            dcs = frozenset(self.job.datacenters)
+            if get_dcs is not None:
+                nodes = get_dcs(self.job.node_pool, dcs)
+            else:
+                nodes = self.state.ready_nodes_in_pool(self.job.node_pool)
+                if "*" not in dcs:
+                    nodes = [n for n in nodes if n.datacenter in dcs]
+            self.base_nodes = nodes         # pre-shuffle order, for the solver
             self.stack.set_nodes(nodes)
             self.ctx.metrics.nodes_in_pool = len(nodes)
 

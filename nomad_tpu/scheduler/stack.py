@@ -62,6 +62,7 @@ class GenericStack:
         self.job_version: Optional[int] = None
 
         self.source = StaticIterator(ctx, [])
+        self._pending_shuffle = None
         self.job_constraint = ConstraintChecker(ctx, [])
         self.tg_drivers = DriverChecker(ctx, set())
         self.tg_constraint = ConstraintChecker(ctx, [])
@@ -91,20 +92,32 @@ class GenericStack:
         self.max_score = MaxScoreIterator(ctx, self.limit)
 
     def set_nodes(self, base_nodes: List[Node]) -> None:
-        """Shuffle + set candidate nodes + apply the log2 scan limit
-        (reference: stack.go:75-95 GenericStack.SetNodes)."""
-        idx = self.ctx.state.latest_index()
-        nodes = list(base_nodes)
-        shuffle_nodes(self.ctx.plan, idx, nodes)
-        self.source.set_nodes(nodes)
+        """Set candidate nodes + apply the log2 scan limit (reference:
+        stack.go:75-95 GenericStack.SetNodes). The Fisher-Yates shuffle
+        is DEFERRED to the first select(): it is an O(N)-python pass
+        over the whole fleet, and the TPU placement path consults the
+        stack only when a lane falls back to the host iterators -- the
+        shuffle seed (plan eval id + the state index captured HERE)
+        makes deferral invisible to semantics."""
+        self._pending_shuffle = (list(base_nodes),
+                                 self.ctx.state.latest_index())
 
         limit = 2
-        n = len(nodes)
+        n = len(base_nodes)
         if not self.batch and n > 0:
             log_limit = int(math.ceil(math.log2(n))) if n > 1 else 1
             if log_limit > limit:
                 limit = log_limit
         self.limit.set_limit(limit)
+
+    def _materialize_nodes(self) -> None:
+        pending = self._pending_shuffle
+        if pending is None:
+            return
+        self._pending_shuffle = None
+        nodes, idx = pending
+        shuffle_nodes(self.ctx.plan, idx, nodes)
+        self.source.set_nodes(nodes)
 
     def set_job(self, job: Job) -> None:
         if self.job_version is not None and self.job_version == job.version:
@@ -127,6 +140,7 @@ class GenericStack:
                options: Optional[SelectOptions] = None) -> Optional[RankedNode]:
         """(reference: stack.go:128 GenericStack.Select)"""
         options = options or SelectOptions()
+        self._materialize_nodes()
 
         if options.preferred_nodes:
             original = self.source.nodes

@@ -345,8 +345,10 @@ class TpuPlacementService:
         n = len(nodes)
         state_index = self.ctx.state.latest_index()
         from ..tensor.pack import pack_nodes_cached
+        key_fn = getattr(self.ctx.state, "nodes_pack_key", None)
         matrix = pack_nodes_cached(
-            nodes, getattr(self.ctx.state, "node_table_index", None))
+            nodes, getattr(self.ctx.state, "node_table_index", None),
+            key_hint=key_fn(nodes) if key_fn is not None else None)
         n_pad = matrix.n_pad
 
         # Same permutation the host stack applies in set_nodes
@@ -385,7 +387,8 @@ class TpuPlacementService:
                                self.job.namespace, nodes)
 
         feasible = pack_feasibility(self.ctx, None, tg, nodes, n_pad,
-                                    alloc_name=places[0].name)
+                                    alloc_name=places[0].name,
+                                    matrix=matrix)
 
         affinities = (list(self.job.affinities) + list(tg.affinities)
                       + [a for t in tg.tasks for a in t.affinities])

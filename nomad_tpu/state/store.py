@@ -102,15 +102,58 @@ class StateSnapshot:
         return list(self._nodes.values())
 
     def ready_nodes_in_pool(self, pool: str = "all") -> List[Node]:
-        """(reference: state_store.go ReadyNodesInDC / node pool filtering)"""
-        out = []
-        for n in self._nodes.values():
-            if not n.ready():
-                continue
-            if pool not in ("", "all") and n.node_pool != pool:
-                continue
-            out.append(n)
-        return out
+        """(reference: state_store.go ReadyNodesInDC / node pool
+        filtering). Memoized per snapshot: the O(N) ready scan ran once
+        per EVAL (a measured ~8ms/eval fixed cost at 10K nodes) while
+        every eval of a barrier generation shares one snapshot. The
+        memo also keeps the node-id tuple so pack_nodes_cached can key
+        its matrix cache without rebuilding it per eval
+        (nodes_pack_key)."""
+        return self._ready_memoized(("pool", pool))[0]
+
+    def _ready_memoized(self, key):
+        memo = self.__dict__.setdefault("_ready_memo", {})
+        ent = memo.get(key)
+        if ent is None:
+            kind = key[0]
+            if kind == "pool":
+                pool = key[1]
+                out = []
+                for n in self._nodes.values():
+                    if not n.ready():
+                        continue
+                    if pool not in ("", "all") and n.node_pool != pool:
+                        continue
+                    out.append(n)
+            else:                       # ("dcs", pool, frozenset(dcs))
+                base = self._ready_memoized(("pool", key[1]))[0]
+                dcs = key[2]
+                out = (base if "*" in dcs else
+                       [n for n in base if n.datacenter in dcs])
+            ent = memo.setdefault(key, (out, tuple(n.id for n in out)))
+            # id-keyed reverse map for nodes_pack_key: a single atomic
+            # dict read (concurrent evals insert into the memo while
+            # others look up; iterating it would race). The memo keeps
+            # the list alive, so its id stays valid for this snapshot.
+            self.__dict__.setdefault("_ready_by_id", {})[id(ent[0])] = \
+                ent[1]
+        return ent
+
+    def ready_nodes_in_pool_dcs(self, pool: str, dcs: frozenset
+                                ) -> List[Node]:
+        """ready_nodes_in_pool + the job's datacenter filter
+        (reference: readyNodesInDCsAndPool), memoized per snapshot so
+        concurrent evals of one barrier generation share one list."""
+        return self._ready_memoized(("dcs", pool, dcs))[0]
+
+    def nodes_pack_key(self, nodes) -> object:
+        """The cached node-id tuple for a list this snapshot's ready
+        memo handed out (identity match), else None -- lets
+        pack_nodes_cached skip the per-eval O(N) id-tuple rebuild."""
+        by_id = self.__dict__.get("_ready_by_id")
+        if by_id:
+            return by_id.get(id(nodes))
+        return None
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
         return self._jobs.get((namespace, job_id))
@@ -1194,6 +1237,12 @@ class StateStore:
 
     def ready_nodes_in_pool(self, pool: str = "all"):
         return self.snapshot().ready_nodes_in_pool(pool)
+
+    def ready_nodes_in_pool_dcs(self, pool: str, dcs: frozenset):
+        return self.snapshot().ready_nodes_in_pool_dcs(pool, dcs)
+
+    def nodes_pack_key(self, nodes):
+        return self.snapshot().nodes_pack_key(nodes)
 
     def job_by_id(self, namespace, job_id):
         with self._lock:

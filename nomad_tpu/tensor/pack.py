@@ -47,6 +47,11 @@ class NodeMatrix:
     port_bitmap: Optional[np.ndarray]
     dyn_free: np.ndarray       # (n_pad,) int32 free ports in dynamic range
     valid: np.ndarray          # (n_pad,) bool -- real node vs padding
+    # computed-class coding for vectorized feasibility: codes (n_pad,)
+    # int32 (-1 = padding or class never computed), class_reps[i] = the
+    # node index representing code i
+    class_codes: Optional[np.ndarray] = None
+    class_reps: Optional[List[int]] = None
 
 
 def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
@@ -60,8 +65,19 @@ def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
     dyn_free = np.zeros(n_pad, dtype=np.int32)
     valid = np.zeros(n_pad, dtype=bool)
     ids = []
+    codes = np.full(n_pad, -1, dtype=np.int32)
+    code_of: Dict[str, int] = {}
+    reps: List[int] = []
     for i, node in enumerate(nodes):
         ids.append(node.id)
+        cls = node.computed_class
+        if cls:
+            code = code_of.get(cls)
+            if code is None:
+                code = len(reps)
+                code_of[cls] = code
+                reps.append(i)
+            codes[i] = code
         nr, rr = node.node_resources, node.reserved_resources
         cpu[i] = nr.cpu.cpu_shares - rr.cpu_shares
         mem[i] = nr.memory.memory_mb - rr.memory_mb
@@ -78,7 +94,8 @@ def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
         valid[i] = True
     return NodeMatrix(n_real=n, n_pad=n_pad, node_ids=ids, cpu_cap=cpu,
                       mem_cap=mem, disk_cap=disk, port_bitmap=ports,
-                      dyn_free=dyn_free, valid=valid)
+                      dyn_free=dyn_free, valid=valid, class_codes=codes,
+                      class_reps=reps)
 
 
 # pack_nodes is ~20ms at 10K nodes but its inputs only change when the
@@ -93,13 +110,18 @@ _NODE_MATRIX_CACHE_MAX = 8
 _NODE_MATRIX_LOCK = _threading.Lock()
 
 
-def pack_nodes_cached(nodes, node_table_index: Optional[int]) -> NodeMatrix:
+def pack_nodes_cached(nodes, node_table_index: Optional[int],
+                      key_hint=None) -> NodeMatrix:
     """pack_nodes memoized by node-table version. Callers must treat the
     result as immutable (service.py copies the port bitmap before
-    seeding)."""
+    seeding). ``key_hint`` is the node-id tuple when the caller already
+    holds it (the snapshot ready-list memo) -- rebuilding it per eval
+    was an O(N) python pass of its own."""
     if node_table_index is None:
         return pack_nodes(nodes)
-    key = (node_table_index, tuple(n.id for n in nodes))
+    key = (node_table_index,
+           key_hint if key_hint is not None
+           else tuple(n.id for n in nodes))
     with _NODE_MATRIX_LOCK:
         hit = _NODE_MATRIX_CACHE.get(key)
     if hit is not None:
@@ -183,7 +205,7 @@ def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
 
 
 def pack_feasibility(ctx, stack_like, tg, nodes, n_pad: int,
-                     alloc_name: str = "") -> np.ndarray:
+                     alloc_name: str = "", matrix=None) -> np.ndarray:
     """Evaluate the boolean feasibility pipeline per node, memoized by
     computed class exactly like FeasibilityWrapper (feasible.go:1126).
 
@@ -209,21 +231,47 @@ def pack_feasibility(ctx, stack_like, tg, nodes, n_pad: int,
         net_check.set_network(tg.networks[0])
 
     out = np.zeros(n_pad, dtype=bool)
-    class_cache: Dict[str, bool] = {}
     escaped = any("unique." in (c.l_target + c.r_target)
                   for c in (job.constraints if job else []) + constraints)
+
+    def class_verdict(node):
+        return (job_check.feasible(node) and drv_check.feasible(node)
+                and tg_check.feasible(node)
+                and dev_check.feasible(node)
+                and net_check.feasible(node))
+
+    # vectorized path: with class-coded nodes and no escaped ("unique.")
+    # constraints, evaluate the class-level checkers once per DISTINCT
+    # class and broadcast through the code array -- the per-node python
+    # loop was a measured ~10ms/eval fixed cost at 10K nodes. Host
+    # volumes are per-node state and keep a (volume-lanes-only) loop.
+    codes = matrix.class_codes if matrix is not None else None
+    if (not escaped and codes is not None
+            and matrix.n_real == len(nodes)
+            and matrix.class_reps is not None
+            and (codes[:len(nodes)] >= 0).all()):
+        verdicts = np.fromiter(
+            (class_verdict(nodes[rep]) for rep in matrix.class_reps),
+            dtype=bool, count=len(matrix.class_reps))
+        n = len(nodes)
+        out[:n] = verdicts[codes[:n]] if len(verdicts) else False
+        if vol_check.volumes:
+            for i, node in enumerate(nodes):
+                if out[i]:
+                    out[i] = vol_check.feasible(node)
+        return out
+
+    class_cache: Dict[str, bool] = {}
+    check_vols = bool(vol_check.volumes)
     for i, node in enumerate(nodes):
         cls = node.computed_class
         if not escaped and cls in class_cache:
             class_ok = class_cache[cls]
         else:
-            class_ok = (job_check.feasible(node) and drv_check.feasible(node)
-                        and tg_check.feasible(node)
-                        and dev_check.feasible(node)
-                        and net_check.feasible(node))
+            class_ok = class_verdict(node)
             if not escaped and cls:
                 class_cache[cls] = class_ok
-        out[i] = class_ok and vol_check.feasible(node)
+        out[i] = class_ok and (not check_vols or vol_check.feasible(node))
     return out
 
 
