@@ -91,10 +91,7 @@ def encode_command(method: str, args: Tuple[Any, ...]) -> dict:
 #   - placements with the embedded job STRIPPED,
 #   - each distinct job exactly once, reattached at apply time.
 
-_STOP_STUB_FIELDS = ("id", "namespace", "job_id", "task_group", "node_id",
-                     "desired_status", "desired_description",
-                     "client_status", "followup_eval_id",
-                     "preempted_by_allocation")
+from ..structs.alloc import PLAN_STOP_STUB_FIELDS as _STOP_STUB_FIELDS
 
 
 def _stub(alloc: Allocation) -> dict:
