@@ -1193,6 +1193,15 @@ class StateStore:
                 import time as _time
                 alloc.modify_time = _time.time()
                 self._allocs[alloc.id] = alloc
+                # refresh the tensor row: the alloc just became
+                # server-terminal, and the verify fast path's
+                # live_strict column mirrors the applier's
+                # AllocsByNodeTerminal(false) filter -- a stale 1 here
+                # overcounts usage on this node until the client acks,
+                # which can fast-reject plans the authoritative python
+                # check would accept (tests/test_verify_fold.py pins
+                # this)
+                self.alloc_table.upsert(alloc)
 
             self._insert_allocs_locked(placements)
             for alloc in placements:

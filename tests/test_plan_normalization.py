@@ -266,3 +266,39 @@ def test_bounded_entry_size_2000_alloc_burst():
         PlanResult(node_update=stops), None))
     assert len(stop_raw) / 2000 < 600, len(stop_raw) / 2000
     assert '"run_for"' not in stop_raw
+
+
+def test_restore_keeps_rows_for_server_terminal_client_running():
+    """A server-terminal (plan-stopped) but client-running alloc still
+    consumes node capacity in the scheduler's live filter until the
+    client acks; the FSM snapshot-restore table rebuild must keep its
+    row (live=1, live_strict=0) exactly like the incremental path, or
+    solver usage tensors diverge across a restart."""
+    from nomad_tpu import mock
+    from nomad_tpu.raft import fsm as fsm_mod
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import Plan, PlanResult
+
+    store = StateStore()
+    n = mock.node()
+    n.id = "n-restore-live"
+    n.compute_class()
+    store.upsert_node(n)
+    j = mock.job(id="restore-live-job")
+    store.upsert_job(j)
+    a = mock.alloc_for(j, n)
+    a.client_status = "running"
+    store.upsert_allocs([a])
+    plan = Plan(eval_id="e" * 36, priority=50, job=j)
+    plan.append_stopped_alloc(a, "drain")
+    store.upsert_plan_results(
+        PlanResult(node_update=plan.node_update, node_allocation={},
+                   node_preemptions={}), [])
+
+    snap = fsm_mod.dump_state(store)
+    restored = StateStore()
+    fsm_mod.restore_state(restored, snap)
+    row = restored.alloc_table._row_of.get(a.id)
+    assert row is not None, "restore dropped the row"
+    assert int(restored.alloc_table.live[row]) == 1
+    assert int(restored.alloc_table.live_strict[row]) == 0

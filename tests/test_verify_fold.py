@@ -158,3 +158,39 @@ def test_snapshot_cache_identity_and_invalidation():
     s3 = store.snapshot()
     assert s3.allocs_by_node(nodes[0].id) == []
     assert [x.id for x in s2.allocs_by_node(nodes[0].id)] == [a.id]
+
+
+def test_plan_committed_stop_refreshes_table_liveness():
+    """A plan-committed stop makes the stored alloc server-terminal;
+    the alloc table's live_strict column (the applier filter,
+    AllocsByNodeTerminal(false) in plan_apply.go) must flip with it --
+    a stale row overcounts the node's usage in the native verify
+    fast-path until the client acks the stop, which can fast-reject
+    plans the authoritative python check would accept."""
+    from nomad_tpu import mock
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import Plan, PlanResult
+
+    store = StateStore()
+    n = mock.node()
+    n.id = "n-stop-live"
+    n.compute_class()
+    store.upsert_node(n)
+    j = mock.job(id="stop-live-job")
+    store.upsert_job(j)
+    a = mock.alloc_for(j, n)
+    a.client_status = "running"
+    store.upsert_allocs([a])
+    row = store.alloc_table._row_of[a.id]
+    assert int(store.alloc_table.live_strict[row]) == 1
+
+    plan = Plan(eval_id="e" * 36, priority=50, job=j)
+    plan.append_stopped_alloc(a, "node drain")
+    store.upsert_plan_results(
+        PlanResult(node_update=plan.node_update, node_allocation={},
+                   node_preemptions={}), [])
+    assert store._allocs[a.id].terminal_status()
+    assert int(store.alloc_table.live_strict[row]) == 0
+    # capacity-facing liveness (client-terminal filter) is unchanged
+    # until the client acks, matching scheduler semantics
+    assert int(store.alloc_table.live[row]) == 1
