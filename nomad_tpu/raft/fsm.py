@@ -339,15 +339,14 @@ def restore_state(store: StateStore, blob: dict) -> None:
         table = AllocTable()
         for n in nodes:
             table.register_node(n)
-        for a in allocs:
-            # skip only CLIENT-terminal allocs (their rows would carry
-            # live=0 AND live_strict=0 -- dead weight). Server-terminal
-            # but client-running allocs must keep a row: they still
-            # consume capacity in the scheduler's live filter until the
-            # client acks, and dropping them made solver usage tensors
-            # diverge across a snapshot restore
-            # (tests/test_plan_normalization.py pins this).
-            if not a.client_terminal_status():
-                table.upsert(a)
+        # skip only CLIENT-terminal allocs (their rows would carry
+        # live=0 AND live_strict=0 -- dead weight). Server-terminal
+        # but client-running allocs must keep a row: they still
+        # consume capacity in the scheduler's live filter until the
+        # client acks, and dropping them made solver usage tensors
+        # diverge across a snapshot restore
+        # (tests/test_plan_normalization.py pins this).
+        table.upsert_many(
+            [a for a in allocs if not a.client_terminal_status()])
         store.alloc_table = table
         store._watch_cond.notify_all()

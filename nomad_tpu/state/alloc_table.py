@@ -145,6 +145,89 @@ class AllocTable:
         elif had_ports and not has_ports:
             self.rows_with_ports -= 1
 
+    def upsert_many(self, allocs) -> None:
+        """Batch upsert: the per-alloc path pays ~15 scalar numpy writes
+        each (~10us/alloc -- ~20ms per 2000-alloc plan commit under the
+        store lock); batching turns the columns into one vectorized
+        assignment apiece. Falls back to the scalar path when a batch
+        repeats an alloc id (fancy-index write order would be
+        unspecified) -- plans never do, but correctness must not depend
+        on it."""
+        if len(allocs) < 8:
+            for a in allocs:
+                self.upsert(a)
+            return
+        ids = [a.id for a in allocs]
+        if len(set(ids)) != len(ids):
+            for a in allocs:
+                self.upsert(a)
+            return
+        # derive EVERYTHING before the first state mutation: a raising
+        # alloc mid-batch must not leave reserved-but-unwritten rows
+        # (stale resized data would fold phantom usage)
+        crs = [a.allocated_resources.comparable() for a in allocs]
+        all_ports = [a.allocated_resources.all_ports() for a in allocs]
+        live = [0 if a.client_terminal_status() else 1 for a in allocs]
+        live_strict = [0 if a.terminal_status() else 1 for a in allocs]
+        special = [
+            1 if a.allocated_resources.has_special_dimensions() else 0
+            for a in allocs]
+        job_hash = [stable_hash(a.namespace, a.job_id) for a in allocs]
+        jobtg_hash = [stable_hash(a.namespace, a.job_id, a.task_group)
+                      for a in allocs]
+        self.version += 1
+        n_new = sum(1 for i in ids if i not in self._row_of)
+        while self.n_rows + n_new - len(self._free) > self._cap:
+            self._grow()
+        rows = np.empty(len(allocs), dtype=np.int64)
+        for k, a in enumerate(allocs):
+            row = self._row_of.get(a.id)
+            if row is None:
+                if self._free:
+                    row = self._free.pop()
+                else:
+                    row = self.n_rows
+                    self.n_rows += 1
+                self._row_of[a.id] = row
+            rows[k] = row
+        slot_of = self._slot_of_node
+        self.node_slot[rows] = [slot_of.get(a.node_id, -1)
+                                for a in allocs]
+        self.cpu[rows] = [cr.cpu_shares for cr in crs]
+        self.mem[rows] = [cr.memory_mb for cr in crs]
+        self.disk[rows] = [cr.disk_mb for cr in crs]
+        self.live[rows] = live
+        self.live_strict[rows] = live_strict
+        self.special[rows] = special
+        self.job_hash[rows] = job_hash
+        self.jobtg_hash[rows] = jobtg_hash
+        # ports: reused rows (freed or replaced) may hold stale port
+        # values -- the scalar path resets every upserted row, so the
+        # batch must too (vectorized), BEFORE which the accounting
+        # baseline is captured
+        had_ports_arr = self.ports[rows, 0] >= 0
+        self.ports[rows, :] = -1
+        if not any(all_ports) and not self._overflow_rows:
+            # no new ports, nothing overflowed: rows that had ports
+            # simply lose them
+            self.rows_with_ports -= int(had_ports_arr.sum())
+        else:
+            for k, ports in enumerate(all_ports):
+                row = int(rows[k])
+                had_overflow = row in self._overflow_rows
+                for pi, value in enumerate(ports[:MAX_PORTS]):
+                    self.ports[row, pi] = value
+                if len(ports) > MAX_PORTS:
+                    self._overflow_rows.add(row)
+                elif had_overflow:
+                    self._overflow_rows.discard(row)
+                has_ports = bool(ports)
+                had = bool(had_ports_arr[k])
+                if has_ports and not had:
+                    self.rows_with_ports += 1
+                elif had and not has_ports:
+                    self.rows_with_ports -= 1
+
     @property
     def has_port_overflow(self) -> bool:
         return bool(self._overflow_rows)

@@ -637,7 +637,7 @@ class StateStore:
             jk = (alloc.namespace, alloc.job_id)
             self._allocs_by_job.setdefault(jk, {})[alloc.id] = None
             self._dirty_alloc_jobs.add(jk)
-            self.alloc_table.upsert(alloc)
+        self.alloc_table.upsert_many(allocs)
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
         """Client-side status updates (reference: Node.UpdateAlloc

@@ -194,3 +194,64 @@ def test_plan_committed_stop_refreshes_table_liveness():
     # capacity-facing liveness (client-terminal filter) is unchanged
     # until the client acks, matching scheduler semantics
     assert int(store.alloc_table.live[row]) == 1
+
+
+def test_upsert_many_matches_scalar_upsert():
+    """The batched table insert must leave IDENTICAL table state to the
+    scalar path: columns, port rows (including stale-port reset on row
+    reuse), overflow and rows_with_ports accounting."""
+    import numpy as np
+    from nomad_tpu import mock
+    from nomad_tpu.state.alloc_table import AllocTable
+    from nomad_tpu.structs import Port
+
+    def build(batch):
+        def world():
+            t = AllocTable()
+            n = mock.node()
+            n.id = "n-um"
+            t.register_node(n)
+            return t, n
+        t, n = world()
+        j = mock.job(id="um-job")
+        allocs = []
+        for k in range(40):
+            a = mock.alloc_for(j, n)
+            a.id = f"um-{k:04d}"
+            if k % 5 == 0:
+                a.client_status = "complete"
+            if k % 7 == 0:
+                res = a.allocated_resources.tasks["web"].networks
+                if res:
+                    res[0].reserved_ports = [Port(label="x", value=2000 + k)]
+            allocs.append(a)
+        if batch:
+            t.upsert_many(allocs)
+            # remove a ported row, reuse it without ports (stale reset)
+            t.remove("um-0007")
+            b = mock.alloc_for(j, n)
+            b.id = "um-reuse"
+            t.upsert_many([b])              # small batch -> scalar path
+            t.upsert_many(allocs[:10])      # re-upsert overlap
+        else:
+            for a in allocs:
+                t.upsert(a)
+            t.remove("um-0007")
+            b = mock.alloc_for(j, n)
+            b.id = "um-reuse"
+            t.upsert(b)
+            for a in allocs[:10]:
+                t.upsert(a)
+        return t
+
+    ts, tb = build(False), build(True)
+    assert ts._row_of == tb._row_of
+    for col in ("node_slot", "cpu", "mem", "disk", "live", "live_strict",
+                "special", "job_hash", "jobtg_hash"):
+        rows = sorted(ts._row_of.values())
+        a, b = getattr(ts, col)[rows], getattr(tb, col)[rows]
+        assert (a == b).all(), col
+    rows = sorted(ts._row_of.values())
+    assert (ts.ports[rows] == tb.ports[rows]).all()
+    assert ts.rows_with_ports == tb.rows_with_ports
+    assert ts._overflow_rows == tb._overflow_rows
