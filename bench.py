@@ -467,7 +467,7 @@ def _tunnel_rtt():
 
 def _fused_compute_only(lanes, repeats=3):
     """On-device cost of the fused wavefront program over E
-    pre-transferred lanes. Returns (blocking_dt, marginal_dt):
+    pre-transferred lanes.
     Returns (blocking_dt, marginal_dt, pipelined_dt): blocking_dt is
     the classic per-call median (includes one dispatch round trip --
     through the axon tunnel that is ~70ms of pure latency);

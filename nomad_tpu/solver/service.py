@@ -1006,19 +1006,27 @@ class TpuPlacementService:
                         if lo <= p <= hi:
                             usage.dyn_used[pos] -= 1
 
-        # Subtract against the STORED alloc (what the table counted) -- the
-        # plan's stop copies may carry overridden client statuses.
+        # Subtract against the STORED alloc (what the table counted) --
+        # plan stop entries are narrow stubs (structs/alloc.py
+        # _plan_stub) and may carry overridden client statuses. A
+        # missing stored alloc is SKIPPED, matching the reference's
+        # ProposedAllocs identity-set semantics (context.go:176:
+        # existing-from-snapshot minus stops by id): an alloc absent
+        # from state was never folded into usage, so subtracting its
+        # footprint would double-free.
         seen_ids = set()
         for allocs in plan.node_update.values():
             for a in allocs:
                 stored = self.ctx.state.alloc_by_id(a.id)
-                adjust(stored if stored is not None else a, -1)
+                if stored is not None:
+                    adjust(stored, -1)
                 seen_ids.add(a.id)
         for allocs in plan.node_preemptions.values():
             for a in allocs:
                 if a.id not in seen_ids:
                     stored = self.ctx.state.alloc_by_id(a.id)
-                    adjust(stored if stored is not None else a, -1)
+                    if stored is not None:
+                        adjust(stored, -1)
                     seen_ids.add(a.id)
         for allocs in plan.node_allocation.values():
             for a in allocs:

@@ -1177,6 +1177,8 @@ class StateStore:
 
             # Stops/preemptions update desired status on existing allocs
             import copy as _copy
+            import time as _time
+            merged = []
             for stop in stops:
                 existing = self._allocs.get(stop.id)
                 if existing is None:
@@ -1190,18 +1192,17 @@ class StateStore:
                 if stop.followup_eval_id:
                     alloc.followup_eval_id = stop.followup_eval_id
                 alloc.modify_index = self._index + 1
-                import time as _time
                 alloc.modify_time = _time.time()
                 self._allocs[alloc.id] = alloc
-                # refresh the tensor row: the alloc just became
-                # server-terminal, and the verify fast path's
-                # live_strict column mirrors the applier's
-                # AllocsByNodeTerminal(false) filter -- a stale 1 here
-                # overcounts usage on this node until the client acks,
-                # which can fast-reject plans the authoritative python
-                # check would accept (tests/test_verify_fold.py pins
-                # this)
-                self.alloc_table.upsert(alloc)
+                merged.append(alloc)
+            # refresh the tensor rows (batched): the allocs just became
+            # server-terminal, and the verify fast path's live_strict
+            # column mirrors the applier's AllocsByNodeTerminal(false)
+            # filter -- a stale 1 here overcounts usage on this node
+            # until the client acks, which can fast-reject plans the
+            # authoritative python check would accept
+            # (tests/test_verify_fold.py pins this)
+            self.alloc_table.upsert_many(merged)
 
             self._insert_allocs_locked(placements)
             for alloc in placements:
