@@ -255,3 +255,78 @@ def test_upsert_many_matches_scalar_upsert():
     assert (ts.ports[rows] == tb.ports[rows]).all()
     assert ts.rows_with_ports == tb.rows_with_ports
     assert ts._overflow_rows == tb._overflow_rows
+
+
+def test_fast_check_agrees_with_authoritative_check_fuzz():
+    """Differential contract for the native verify fast path: for any
+    plan, fast_reject must only name nodes the authoritative python
+    check also rejects, and fast_fit must only prove nodes it also
+    accepts -- under churn (prior allocs, plan-committed stops awaiting
+    client acks, mixed placements). Today's round fixed a staleness bug
+    exactly on this boundary; this fuzz pins both directions."""
+    import random
+
+    for seed in range(6):
+        rng = random.Random(seed * 131 + 7)
+        store = StateStore()
+        nodes = []
+        for i in range(24):
+            n = mock.node()
+            n.id = f"fz-n{i:03d}"
+            n.node_resources.cpu.cpu_shares = rng.choice([1000, 2000, 4000])
+            n.node_resources.memory.memory_mb = rng.choice([2048, 4096])
+            n.compute_class()
+            store.upsert_node(n)
+            nodes.append(n)
+        jobs = []
+        for k in range(4):
+            j = mock.job(id=f"fz-j{k}")
+            j.task_groups[0].tasks[0].resources.cpu = rng.choice(
+                [250, 500, 900])
+            store.upsert_job(j)
+            jobs.append(j)
+        # prior allocs filling nodes unevenly
+        prior = []
+        for _ in range(40):
+            j = rng.choice(jobs)
+            a = mock.alloc_for(j, rng.choice(nodes))
+            a.client_status = "running"
+            prior.append(a)
+        store.upsert_allocs(prior)
+        # stop a few via the plan-commit path (server-terminal, unacked)
+        stop_plan = Plan(eval_id="f" * 36, priority=50, job=jobs[0])
+        for a in rng.sample(prior, 8):
+            stop_plan.append_stopped_alloc(a, "churn")
+        store.upsert_plan_results(
+            PlanResult(node_update=stop_plan.node_update,
+                       node_allocation={}, node_preemptions={}), [])
+
+        # a new plan placing several allocs per node
+        planner = Planner(store)
+        try:
+            plan = Plan(eval_id="a" * 36, priority=50, job=jobs[1])
+            for _ in range(30):
+                a = mock.alloc_for(jobs[1], rng.choice(nodes))
+                plan.append_alloc(a)
+            snapshot = store.snapshot()
+            node_ids = sorted(plan.node_allocation)
+            fast_reject, fast_fit = planner._fast_check(
+                snapshot, plan, node_ids)
+            # vacuity guard: every seed must exercise BOTH directions,
+            # or a fast-path bail-out (n<8, exotic snapshot) would turn
+            # this into a silent no-op
+            assert fast_reject and fast_fit, (
+                f"seed {seed}: fast path vacuous "
+                f"(reject={len(fast_reject)} fit={len(fast_fit)})")
+            for nid in node_ids:
+                ok, reason = planner._evaluate_node_plan(
+                    snapshot, plan, nid)
+                if nid in fast_reject:
+                    assert not ok, (
+                        f"seed {seed}: fast_reject {nid} "
+                        f"({fast_reject[nid]}) but python accepts")
+                if nid in fast_fit:
+                    assert ok, (f"seed {seed}: fast_fit proved {nid} "
+                                f"but python rejects: {reason}")
+        finally:
+            planner.shutdown()
