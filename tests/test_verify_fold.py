@@ -421,3 +421,37 @@ def test_usage_pack_table_fold_matches_python_fold_fuzz():
             assert (a == b).all(), (
                 f"seed {seed}: init.{fieldname} diverges at "
                 f"{np.nonzero(np.asarray(a != b))[0][:5]}")
+
+
+def test_snapshot_ready_memo_concurrent_evals():
+    """Concurrent schedulers share one snapshot (the server's snapshot
+    cache): parallel ready_nodes_in_pool_dcs lookups with DIFFERENT
+    (pool, dcs) keys insert into the memo while other threads read
+    nodes_pack_key -- the id-keyed reverse map must make that safe (a
+    naive memo iteration raced: RuntimeError dict changed size)."""
+    import threading
+
+    store, nodes, job = _world(n_nodes=64)[:3]
+    for i, n in enumerate(nodes):
+        n.datacenter = f"dc{i % 8 + 1}"
+    snap = store.snapshot()
+    errs = []
+
+    def worker(k):
+        try:
+            for i in range(200):
+                dcs = frozenset({f"dc{(k + i) % 8 + 1}",
+                                 f"dc{(k * 3 + i) % 8 + 1}"})
+                lst = snap.ready_nodes_in_pool_dcs("all", dcs)
+                key = snap.nodes_pack_key(lst)
+                assert key is not None and len(key) == len(lst)
+        except Exception as e:  # noqa: BLE001 -- collected for assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:2]
