@@ -9,7 +9,6 @@ with tiny job counts drive scores negative to engage the skip/fallback
 machinery and both threshold-crossing directions), then asserts the two
 kernels' (chosen, scores, n_yielded) are identical elementwise.
 scripts/wave_block_fuzz.py is the wider standalone version."""
-import os
 from functools import partial
 
 import numpy as np
