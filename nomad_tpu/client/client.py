@@ -392,7 +392,12 @@ class Client:
 
     def fs_read(self, alloc_id: str, path: str, offset: int = 0,
                 limit: int = 1 << 20) -> bytes:
+        """A NEGATIVE offset tails the file (last |offset| bytes)."""
+        import os as _os
         with open(self._safe_path(alloc_id, path), "rb") as f:
+            if offset < 0:
+                size = _os.fstat(f.fileno()).st_size
+                offset = max(0, size + offset)
             f.seek(max(0, offset))
             return f.read(max(0, min(limit, 1 << 24)))
 
@@ -506,7 +511,9 @@ class Client:
                 offset: int = 0, limit: int = 1 << 20) -> bytes:
         """Rotated log frames for a task, sliced WITHOUT loading the full
         history (reference: fs_endpoint.go logs path:
-        alloc/logs/<task>.<type>.<index>)."""
+        alloc/logs/<task>.<type>.<index>). A NEGATIVE offset tails: the
+        last |offset| bytes of the concatenated frames (the reference's
+        origin="end" semantics), clamped by limit."""
         import os
         if log_type not in ("stdout", "stderr"):
             raise ValueError(f"invalid log type {log_type!r}")
@@ -524,6 +531,10 @@ class Client:
             (f for f in os.listdir(log_dir)
              if f.startswith(f"{task}.{log_type}.")),
             key=frame_idx)
+        if offset < 0:
+            total = sum(os.path.getsize(os.path.join(log_dir, f))
+                        for f in frames)
+            offset = max(0, total + offset)
         out = []
         pos, want = 0, max(0, limit)
         skip = max(0, offset)

@@ -237,6 +237,8 @@ async function viewAlloc(id) {
   const tasks = Object.entries(a.task_states || {}).map(([name, st]) => [
     esc(name), badge(st.state), esc(st.failed ? "yes" : "no"),
     (st.events || []).slice(-3).map((e) => esc(e.type)).join(" → "),
+    `<a href="#/allocation/${encodeURIComponent(a.id)}/logs/` +
+    `${encodeURIComponent(name)}/stdout">logs</a>`,
   ]);
   const metrics = a.metrics || {};
   const scores = Object.entries(metrics.scores || {}).slice(0, 12).map(
@@ -250,12 +252,14 @@ async function viewAlloc(id) {
       <tr><td>Desired</td><td>${badge(a.desired_status)}</td></tr>
       <tr><td>Eval</td><td class="mono">${esc(a.eval_id || "")}</td></tr>
     </table>
-    <h2>Tasks</h2>` + table(["Task", "State", "Failed", "Recent events"], tasks) +
+    <h2>Tasks</h2>` + table(["Task", "State", "Failed", "Recent events",
+                             "Logs"], tasks) +
     (scores.length ? `<h2>Placement scores</h2>` + table(["Node/score", "Value"], scores) : "") +
     `<h2>Actions</h2><p>
       <button onclick="allocAction('${encodeURIComponent(a.id)}', 'restart')">Restart</button>
       <button onclick="allocAction('${encodeURIComponent(a.id)}', 'stop')">Stop &amp; reschedule</button>
       <a class="btn" href="#/allocation/${encodeURIComponent(a.id)}/exec">Exec</a>
+      <a class="btn" href="#/allocation/${encodeURIComponent(a.id)}/fs/">Files</a>
       <span id="action-result" class="muted"></span></p>`);
 }
 
@@ -702,6 +706,95 @@ async function attachEventStream() {
   }
 }
 
+/* ----- alloc file browser + task logs (reference: ui alloc fs browser
+   over /v1/client/fs; logs over /v1/client/fs/logs) ----- */
+
+async function viewFs(allocId, path) {
+  path = path || "/";
+  const base = `#/allocation/${encodeURIComponent(allocId)}/fs`;
+  let listing;
+  try {
+    listing = await api(`/v1/client/fs/ls/${encodeURIComponent(allocId)}` +
+                        `?path=${encodeURIComponent(path)}`);
+  } catch (e) {
+    return h(`<h1>Files <span class="mono">${shortId(allocId)}</span></h1>
+      <p><span class="badge error">${esc(String(e.message || e))}</span></p>
+      <p class="muted">file browsing needs the alloc's node served by a
+      real client agent (dev agent: --real-clients)</p>`);
+  }
+  const crumbs = [`<a href="${base}/">/</a>`];
+  let acc = "";
+  for (const part of path.split("/").filter(Boolean)) {
+    acc += "/" + part;
+    crumbs.push(`<a href="${base}${encodeURIComponent(acc)}">` +
+                `${esc(part)}</a>`);
+  }
+  const rows = (listing || []).map((f) => {
+    const child = (path === "/" ? "" : path) + "/" + f.name;
+    const href = f.is_dir
+      ? `${base}${encodeURIComponent(child)}`
+      : `${base}-cat${encodeURIComponent(child)}`;
+    return [
+      `<a href="${href}" class="mono">${esc(f.name)}${f.is_dir ? "/" : ""}</a>`,
+      f.is_dir ? "" : esc(String(f.size)),
+      f.mod_time ? esc(new Date(f.mod_time * 1000).toISOString()
+          .replace("T", " ").slice(0, 19)) : "",
+    ];
+  });
+  return h(`<h1>Files <span class="mono">${shortId(allocId)}</span></h1>
+    <p class="mono">${crumbs.join(" ")}</p>` +
+    (rows.length ? table(["Name", "Size", "Modified"], rows)
+                 : `<p class="muted">empty directory</p>`) +
+    `<p><a class="btn" href="#/allocation/${encodeURIComponent(allocId)}">
+       Back to allocation</a></p>`);
+}
+
+const FS_CHUNK = 1 << 20;      // server default read window
+
+// raw-text fetch for fs/log bodies: (body html, truncated?) -- a full
+// FS_CHUNK read means there may be more beyond the window
+async function fetchTextPane(url, emptyMsg) {
+  const r = await fetch(url, {headers: authHeaders()});
+  const text = await r.text();
+  if (!r.ok) {
+    return [`<p><span class="badge error">HTTP ${r.status}: ` +
+            `${esc(text)}</span></p>`, false];
+  }
+  return [`<pre class="term">${esc(text || emptyMsg)}</pre>`,
+          text.length >= FS_CHUNK];
+}
+
+async function viewFsCat(allocId, path) {
+  const [body, truncated] = await fetchTextPane(
+    `/v1/client/fs/cat/${encodeURIComponent(allocId)}` +
+    `?path=${encodeURIComponent(path)}`, "(empty file)");
+  const dir = path.split("/").slice(0, -1).join("/") || "/";
+  return h(`<h1>${esc(path)}</h1>` +
+    (truncated ? `<p class="muted">showing the first 1 MiB only
+       (file continues)</p>` : "") + body +
+    `<p><a class="btn" href="#/allocation/${encodeURIComponent(allocId)}` +
+    `/fs${encodeURIComponent(dir)}">Back to ${esc(dir)}</a></p>`);
+}
+
+async function viewLogs(allocId, task, logType) {
+  logType = logType === "stderr" ? "stderr" : "stdout";
+  const other = logType === "stderr" ? "stdout" : "stderr";
+  // negative offset = tail (origin="end"): the operator wants the most
+  // RECENT output, not the oldest 1 MiB
+  const [body, truncated] = await fetchTextPane(
+    `/v1/client/fs/logs/${encodeURIComponent(allocId)}/` +
+    `${encodeURIComponent(task)}?type=${logType}&offset=-${FS_CHUNK}`,
+    `(no ${logType} output yet)`);
+  return h(`<h1>${esc(task)} ${logType}
+      <span class="mono">${shortId(allocId)}</span></h1>
+    <p><a class="btn" href="#/allocation/${encodeURIComponent(allocId)}` +
+    `/logs/${encodeURIComponent(task)}/${other}">View ${other}</a>
+    <a class="btn" href="#/allocation/${encodeURIComponent(allocId)}">` +
+    `Back to allocation</a></p>` +
+    (truncated ? `<p class="muted">showing the most recent 1 MiB</p>`
+               : "") + body);
+}
+
 /* ----- router ----- */
 
 const routes = [
@@ -715,6 +808,13 @@ const routes = [
   [/^#\/allocations$/, () => viewAllocs(), "allocations"],
   [/^#\/allocation\/([^/]+)\/exec$/, (m) => viewExec(
     decodeURIComponent(m[1])), "allocations"],
+  [/^#\/allocation\/([^/]+)\/fs-cat(.*)$/, (m) => viewFsCat(
+    decodeURIComponent(m[1]), safeDecode(m[2] || "/")), "allocations"],
+  [/^#\/allocation\/([^/]+)\/fs(.*)$/, (m) => viewFs(
+    decodeURIComponent(m[1]), safeDecode(m[2] || "/")), "allocations"],
+  [/^#\/allocation\/([^/]+)\/logs\/([^/]+)\/?([a-z]*)$/, (m) => viewLogs(
+    decodeURIComponent(m[1]), decodeURIComponent(m[2]), m[3]),
+   "allocations"],
   [/^#\/allocation\/(.+)$/, (m) => viewAlloc(m[1]), "allocations"],
   [/^#\/evaluations$/, () => viewEvals(), "evaluations"],
   [/^#\/evaluation\/(.+)$/, (m) => viewEval(m[1]), "evaluations"],

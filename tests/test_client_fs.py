@@ -344,3 +344,41 @@ def test_alloc_signal(env):
     with pytest.raises(ApiError):
         api.post(f"/v1/client/allocation/{alloc.id}/signal",
                  {"task": task.name, "signal": "SIGNOPE"})
+
+
+def test_fs_logs_negative_offset_tails(env):
+    """offset < 0 returns the LAST |offset| bytes of the concatenated
+    rotated frames (the reference's origin="end") -- what the UI log
+    viewer fetches so the operator sees recent output, not the oldest
+    window."""
+    server, client, api = env
+    run_logged_job(server, stdout="0123456789")
+    alloc = wait_running(server, "logged")
+    task_name = alloc.job.task_groups[0].tasks[0].name
+    import os
+    log_dir = client._safe_path(alloc.id, "alloc/logs")
+    with open(os.path.join(log_dir, f"{task_name}.stdout.1"), "wb") as f:
+        f.write(b"ABCDEFGHIJ")
+    # tail spanning both frames
+    assert client.fs_logs(alloc.id, task_name, offset=-12) == \
+        b"89ABCDEFGHIJ"
+    # tail larger than the total = everything
+    assert client.fs_logs(alloc.id, task_name, offset=-999) == \
+        b"0123456789ABCDEFGHIJ"
+    # tail clamped by limit
+    assert client.fs_logs(alloc.id, task_name, offset=-12, limit=4) == \
+        b"89AB"
+
+
+def test_fs_read_negative_offset_tails(env):
+    server, client, api = env
+    run_logged_job(server, job_id="tailjob", stdout="x")
+    alloc = wait_running(server, "tailjob")
+    import os
+    p = client._safe_path(alloc.id, "alloc/tailme.txt")
+    with open(p, "wb") as f:
+        f.write(b"0123456789")
+    assert client.fs_read(alloc.id, "alloc/tailme.txt", offset=-4) == \
+        b"6789"
+    assert client.fs_read(alloc.id, "alloc/tailme.txt", offset=-99) == \
+        b"0123456789"
