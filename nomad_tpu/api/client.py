@@ -234,9 +234,12 @@ class ApiClient:
         return self.request_raw("GET", url[len(self.address):])
 
     def alloc_logs(self, alloc_id: str, task: str,
-                   log_type: str = "stdout", offset: int = 0) -> bytes:
-        url = self._url(f"/v1/client/fs/logs/{alloc_id}/{task}",
-                        {"type": log_type, "offset": str(offset)})
+                   log_type: str = "stdout", offset: int = 0,
+                   limit: Optional[int] = None) -> bytes:
+        params = {"type": log_type, "offset": str(offset)}
+        if limit is not None:
+            params["limit"] = str(limit)
+        url = self._url(f"/v1/client/fs/logs/{alloc_id}/{task}", params)
         return self.request_raw("GET", url[len(self.address):])
 
     def client_stats(self, node_id: str = "") -> dict:

@@ -282,8 +282,20 @@ def cmd_alloc_fs(args) -> int:
 
 
 def cmd_alloc_logs(args) -> int:
+    # -tail N rides the fs tail semantics (negative offset = last N
+    # bytes across rotated frames, reference origin="end"); the read
+    # limit must widen with N or fs_logs' 1 MiB default would return a
+    # middle slice for large tails
+    if args.tail < 0:
+        print("-tail must be a positive byte count", file=sys.stderr)
+        return 1
+    offset = -args.tail if args.tail else 0
+    kwargs = {"offset": offset}
+    if args.tail:
+        kwargs["limit"] = args.tail
     data = _client(args).alloc_logs(
-        args.id, args.task, "stderr" if args.stderr else "stdout")
+        args.id, args.task, "stderr" if args.stderr else "stdout",
+        **kwargs)
     sys.stdout.buffer.write(data)
     return 0
 
@@ -876,6 +888,8 @@ def build_parser() -> argparse.ArgumentParser:
     allog.add_argument("id")
     allog.add_argument("task")
     allog.add_argument("-stderr", action="store_true")
+    allog.add_argument("-tail", type=int, default=0, metavar="BYTES",
+                       help="show only the last BYTES of output")
     allog.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval", help="eval commands")
