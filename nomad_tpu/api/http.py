@@ -208,7 +208,9 @@ class ApiHandler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         if (parsed.path == "/v1/event/stream"
                 and q.get("poll", ["false"])[0] != "true") or \
-                parsed.path == "/v1/agent/monitor":
+                parsed.path == "/v1/agent/monitor" or \
+                (parsed.path.startswith("/v1/client/fs/logs/")
+                 and q.get("follow", ["false"])[0] == "true"):
             self._error(
                 400, f"{parsed.path} cannot be forwarded; connect to "
                      f"region {region!r} at {addr} directly")
@@ -671,10 +673,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                 if client is None:
                     return self._error(
                         501, "alloc's node is not served by this agent")
+                log_type = q.get("type", ["stdout"])[0]
+                if q.get("follow", ["false"])[0] == "true":
+                    try:
+                        offset = int(q.get("offset", ["0"])[0])
+                    except ValueError:
+                        return self._error(400, "offset must be numeric")
+                    return self._stream_log_follow(
+                        client, alloc_id, task, log_type, offset)
                 try:
                     data = client.fs_logs(
-                        alloc_id, task,
-                        q.get("type", ["stdout"])[0],
+                        alloc_id, task, log_type,
                         int(q.get("offset", ["0"])[0]),
                         int(q.get("limit", [str(1 << 20)])[0]))
                 except KeyError as e:
@@ -1771,6 +1780,67 @@ class ApiHandler(BaseHTTPRequestHandler):
         else:
             self._error(404, "unknown acl path")
 
+    def _write_chunk(self, payload: bytes) -> None:
+        """One HTTP/1.1 chunked-transfer frame (shared by the monitor,
+        event, and log-follow streams)."""
+        self.wfile.write(f"{len(payload):x}\r\n".encode())
+        self.wfile.write(payload + b"\r\n")
+        self.wfile.flush()
+
+    def _stream_log_follow(self, client, alloc_id: str, task: str,
+                           log_type: str, offset: int) -> None:
+        """Chunked raw-byte log follow (reference: fs_endpoint.go logs
+        with follow=true): emits the requested window, then polls the
+        rotated frames for growth. Raw bytes -- no heartbeat frames
+        (they would corrupt the content); the stream ends when the
+        alloc reaches a terminal state and the tail is drained, or the
+        reader disconnects."""
+        try:
+            total0 = client.fs_logs_total(alloc_id, task, log_type)
+        except KeyError as e:
+            return self._error(404, str(e))
+        except (OSError, ValueError, PermissionError) as e:
+            return self._error(400, str(e))
+        cursor = max(0, total0 + offset) if offset < 0 else \
+            min(max(0, offset), total0)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            chunk = self._write_chunk
+
+            idle_terminal = 0
+            while True:
+                try:
+                    data = client.fs_logs(alloc_id, task, log_type,
+                                          offset=cursor, limit=1 << 20)
+                except (KeyError, ValueError):
+                    # alloc GC'd / runner torn down mid-stream: end the
+                    # chunked body cleanly -- raising here would let
+                    # do_GET write a 500 header block INTO the stream
+                    break
+                if data:
+                    chunk(data)
+                    cursor += len(data)
+                    idle_terminal = 0
+                    continue
+                alloc = self.nomad.state.alloc_by_id(alloc_id)
+                if alloc is None or alloc.terminal_status():
+                    # one extra idle pass so a final write between the
+                    # read and the state check still drains
+                    idle_terminal += 1
+                    if idle_terminal >= 2:
+                        break
+                time.sleep(0.5)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
+
     def _stream_monitor(self, q) -> None:
         """Chunked NDJSON log stream (reference: AgentMonitor --
         ?log_level=trace|debug|info|warn|error, ?plain=true for raw
@@ -1791,10 +1861,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
-            def chunk(payload: bytes) -> None:
-                self.wfile.write(f"{len(payload):x}\r\n".encode())
-                self.wfile.write(payload + b"\r\n")
-                self.wfile.flush()
+            chunk = self._write_chunk
 
             def frame(rec: dict) -> bytes:
                 if plain:
@@ -1838,10 +1905,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
-            def chunk(payload: bytes) -> None:
-                self.wfile.write(f"{len(payload):x}\r\n".encode())
-                self.wfile.write(payload + b"\r\n")
-                self.wfile.flush()
+            chunk = self._write_chunk
 
             last_beat = time.time()
             while True:

@@ -289,13 +289,36 @@ def cmd_alloc_logs(args) -> int:
     if args.tail < 0:
         print("-tail must be a positive byte count", file=sys.stderr)
         return 1
+    api = _client(args)
+    log_type = "stderr" if args.stderr else "stdout"
     offset = -args.tail if args.tail else 0
+    if args.f:
+        # follow: chunked stream, printed as it arrives (reference:
+        # alloc logs -f); urllib decodes the chunked framing
+        import urllib.request
+        url = api._url(f"/v1/client/fs/logs/{args.id}/{args.task}",
+                       {"type": log_type, "offset": str(offset),
+                        "follow": "true"})
+        req = urllib.request.Request(url, headers=api._headers())
+        try:
+            with urllib.request.urlopen(req,
+                                        context=api._ssl_ctx) as resp:
+                while True:
+                    # read1: return WHATEVER is available (read(n)
+                    # would block until n bytes buffer -- a tail must
+                    # print lines as they arrive)
+                    block = resp.read1(8192)
+                    if not block:
+                        break
+                    sys.stdout.buffer.write(block)
+                    sys.stdout.buffer.flush()
+        except KeyboardInterrupt:
+            pass
+        return 0
     kwargs = {"offset": offset}
     if args.tail:
         kwargs["limit"] = args.tail
-    data = _client(args).alloc_logs(
-        args.id, args.task, "stderr" if args.stderr else "stdout",
-        **kwargs)
+    data = api.alloc_logs(args.id, args.task, log_type, **kwargs)
     sys.stdout.buffer.write(data)
     return 0
 
@@ -890,6 +913,9 @@ def build_parser() -> argparse.ArgumentParser:
     allog.add_argument("-stderr", action="store_true")
     allog.add_argument("-tail", type=int, default=0, metavar="BYTES",
                        help="show only the last BYTES of output")
+    allog.add_argument("-f", action="store_true",
+                       help="follow: stream new output until the alloc "
+                            "stops (combine with -tail)")
     allog.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval", help="eval commands")

@@ -390,6 +390,18 @@ class Client:
                 "is_dir": os.path.isdir(full),
                 "size": st.st_size, "mod_time": st.st_mtime}
 
+    def fs_logs_total(self, alloc_id: str, task: str,
+                      log_type: str = "stdout") -> int:
+        """Total bytes across a task's rotated log frames -- the
+        follow stream's cursor base."""
+        import os
+        if log_type not in ("stdout", "stderr"):
+            raise ValueError(f"invalid log type {log_type!r}")
+        log_dir = self._safe_path(alloc_id, "alloc/logs")
+        return sum(os.path.getsize(os.path.join(log_dir, f))
+                   for f in os.listdir(log_dir)
+                   if f.startswith(f"{task}.{log_type}."))
+
     def fs_read(self, alloc_id: str, path: str, offset: int = 0,
                 limit: int = 1 << 20) -> bytes:
         """A NEGATIVE offset tails the file (last |offset| bytes)."""

@@ -60,6 +60,11 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send_bytes(
                         client.fs_read(alloc_id, path, offset, limit))
                 return self._send_json(404, {"error": f"unknown op {op}"})
+            if parts[:1] == ["logs-total"] and len(parts) == 2:
+                total = client.fs_logs_total(
+                    parts[1], q.get("task", [""])[0],
+                    q.get("type", ["stdout"])[0])
+                return self._send_json(200, {"total": total})
             if parts[:1] == ["logs"] and len(parts) == 2:
                 data = client.fs_logs(
                     parts[1], q.get("task", [""])[0],
@@ -204,6 +209,13 @@ class RemoteClientProxy:
         return self._get_bytes(
             f"/logs/{alloc_id}?task={quote(task)}&type={quote(kind)}"
             f"&offset={offset}&limit={limit}")
+
+    def fs_logs_total(self, alloc_id: str, task: str,
+                      log_type: str = "stdout") -> int:
+        from urllib.parse import quote
+        return int(self._get_json(
+            f"/logs-total/{alloc_id}?task={quote(task)}"
+            f"&type={quote(log_type)}")["total"])
 
     def client_stats(self):
         return self._get_json("/stats")

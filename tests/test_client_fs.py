@@ -382,3 +382,52 @@ def test_fs_read_negative_offset_tails(env):
         b"6789"
     assert client.fs_read(alloc.id, "alloc/tailme.txt", offset=-99) == \
         b"0123456789"
+
+
+def test_fs_logs_follow_streams_and_ends(env):
+    """follow=true streams bytes appended AFTER attach and ends once
+    the alloc is terminal with the tail drained (reference:
+    fs_endpoint.go logs follow)."""
+    import os
+    import threading
+    import urllib.request
+
+    server, client, api = env
+    run_logged_job(server, job_id="followed", stdout="head\n")
+    alloc = wait_running(server, "followed")
+    task_name = alloc.job.task_groups[0].tasks[0].name
+    log_dir = client._safe_path(alloc.id, "alloc/logs")
+    log_path = os.path.join(log_dir, f"{task_name}.stdout.0")
+
+    url = api._url(f"/v1/client/fs/logs/{alloc.id}/{task_name}",
+                   {"type": "stdout", "offset": "0", "follow": "true"})
+    got = bytearray()
+    done = threading.Event()
+
+    def reader():
+        with urllib.request.urlopen(url) as resp:
+            while True:
+                b = resp.read1(64)     # available bytes, not block-to-64
+                if not b:
+                    break
+                got.extend(b)
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while b"head" not in bytes(got) and time.time() < deadline:
+        time.sleep(0.1)
+    assert b"head" in bytes(got)
+    with open(log_path, "ab") as f:
+        f.write(b"appended-later\n")
+    while b"appended-later" not in bytes(got) and time.time() < deadline:
+        time.sleep(0.1)
+    assert b"appended-later" in bytes(got)
+    # terminal alloc + drained tail ends the stream
+    stored = server.state.alloc_by_id(alloc.id)
+    import copy
+    upd = copy.copy(stored)
+    upd.client_status = "complete"
+    server.state.upsert_allocs([upd])
+    assert done.wait(timeout=10), "follow stream did not terminate"
