@@ -1714,6 +1714,17 @@ WAVE_K = 32            # run-block width: max picks committed per step
 WAVE_INNER = 64        # run decisions per outer buffer-commit round
 
 
+def _wave_block_shape() -> tuple:
+    """(K, INNER) defaults by backend: measured on CPU, (16, 32) runs
+    ~20% faster than the TPU-tuned (32, 64) (smaller matrices stay
+    cache-resident; the CPU pays per-element, not per-chain-step). TPU
+    keeps the tuned shape -- chain-step count dominates there."""
+    import jax as _jax
+    if _jax.default_backend() == "tpu":
+        return WAVE_K, WAVE_INNER
+    return 16, 32
+
+
 def _wave_block_enabled() -> bool:
     """Run-block dispatch gate: on by default everywhere (the CPU test
     suite then parity-gates it continuously); NOMAD_TPU_WAVE_BLOCK=0
@@ -2602,6 +2613,9 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
                 else _solve_wave_compact_impl)
         inner = functools.partial(impl, spread_alg=spread_alg,
                                   dtype_name=dtype_name, B=B)
+        if use_block:
+            k_blk, inner_blk = _wave_block_shape()
+            inner = functools.partial(inner, K=k_blk, INNER=inner_blk)
         if batched:
             inner = jax.vmap(inner)
 

@@ -43,10 +43,13 @@ def _make_case(rng, C, B):
 
 
 @pytest.mark.parametrize("spread_alg", [False, True])
-@pytest.mark.parametrize("C,B,K,L", [(40, 8, 4, 5), (160, 32, 32, 14),
-                                     (96, 32, 8, 3),
-                                     (360, 128, 32, 100)])
-def test_block_matches_classic_fuzz(C, B, K, L, spread_alg):
+@pytest.mark.parametrize("C,B,K,L,INNER",
+                         [(40, 8, 4, 5, 64), (160, 32, 32, 14, 64),
+                          (96, 32, 8, 3, 64), (360, 128, 32, 100, 64),
+                          # the CPU-production shape (binpack.py
+                          # _wave_block_shape non-TPU default)
+                          (160, 32, 16, 14, 32)])
+def test_block_matches_classic_fuzz(C, B, K, L, INNER, spread_alg):
     """spread_alg=True is the worst-fit scoring mode (falling score
     streams: runs end by losing to the runner-up instead of by
     saturation) -- a different stop-condition mix than best-fit, and a
@@ -58,7 +61,8 @@ def test_block_matches_classic_fuzz(C, B, K, L, spread_alg):
                               dtype_name="float32", B=B))
     block = jax.jit(partial(_solve_wave_block_impl,
                             spread_alg=spread_alg,
-                            dtype_name="float32", B=B, K=K))
+                            dtype_name="float32", B=B, K=K,
+                            INNER=INNER))
     for seed in range(12):
         rng = np.random.default_rng(seed * 7919 + C)
         compact, scal_f = _make_case(rng, C, B)
