@@ -213,6 +213,16 @@ def test_remote_client_forwarding(tmp_path):
                 break
             time.sleep(0.1)
         assert b"remote hello" in data
+        # follow over the PROXIED path: the cursor base comes from the
+        # remote agent's /logs-total route; tail the stream briefly
+        import urllib.request
+        url = api._url(
+            f"/v1/client/fs/logs/{alloc.id}/{task_name}",
+            {"type": "stdout", "offset": "-5", "follow": "true"})
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            first = resp.read1(64)
+        assert first, "proxied follow stream sent no initial window"
+        assert first in data, (first, data)
         stats = api.get("/v1/client/stats",
                         node_id=client.node.id)
         assert stats
