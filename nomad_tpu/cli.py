@@ -285,13 +285,30 @@ def cmd_alloc_logs(args) -> int:
     # -tail N rides the fs tail semantics (negative offset = last N
     # bytes across rotated frames, reference origin="end"); the read
     # limit must widen with N or fs_logs' 1 MiB default would return a
-    # middle slice for large tails
+    # middle slice for large tails. -n LINES gives the reference CLI's
+    # line semantics (ADVICE low #3): over-fetch a byte window from the
+    # end, keep only the last LINES lines.
     if args.tail < 0:
         print("-tail must be a positive byte count", file=sys.stderr)
+        return 1
+    if args.lines < 0:
+        print("-n must be a positive line count", file=sys.stderr)
         return 1
     api = _client(args)
     log_type = "stderr" if args.stderr else "stdout"
     offset = -args.tail if args.tail else 0
+    if args.lines:
+        fetch = args.tail or max(1 << 16, args.lines * 1024)
+        data = api.alloc_logs(args.id, args.task, log_type,
+                              offset=-fetch, limit=fetch)
+        lines = data.splitlines(keepends=True)[-args.lines:]
+        if not args.f:
+            sys.stdout.buffer.write(b"".join(lines))
+            sys.stdout.buffer.flush()
+            return 0
+        # follow starting at the last LINES lines (reference
+        # `-tail -n N -f`): resume the stream that many bytes back
+        offset = -sum(len(ln) for ln in lines)
     if args.f:
         # follow: chunked stream, printed as it arrives (reference:
         # alloc logs -f); urllib decodes the chunked framing
@@ -671,10 +688,17 @@ def cmd_operator_solver(args) -> int:
     api = _client(args)
     if args.sub2 == "status":
         st = api.get("/v1/agent/self")["stats"]["solver_guard"]
-        for k in ("checked", "ok", "probe_timed_out", "recovered_late",
-                  "host_fallback_dispatches", "backend_unavailable_total",
-                  "recovered_total"):
+        for k in ("checked", "ok", "degraded", "probe_timed_out",
+                  "recovered_late", "host_fallback_dispatches",
+                  "backend_unavailable_total", "recovered_total"):
             print(f"{k:28s} = {st.get(k)}")
+        br = st.get("breaker") or {}
+        for k in ("state", "consecutive_failures", "trips",
+                  "recoveries", "backoff_s"):
+            print(f"breaker.{k:20s} = {br.get(k)}")
+        dis = st.get("dispatch") or {}
+        for k in ("ok", "timeout", "error"):
+            print(f"dispatch.{k:19s} = {dis.get(k)}")
     elif args.sub2 == "reprobe":
         # a first-touch reprobe legitimately blocks for the in-process
         # probe deadline (<=30s) plus the subprocess transport probe
@@ -912,10 +936,17 @@ def build_parser() -> argparse.ArgumentParser:
     allog.add_argument("task")
     allog.add_argument("-stderr", action="store_true")
     allog.add_argument("-tail", type=int, default=0, metavar="BYTES",
-                       help="show only the last BYTES of output")
+                       help="show only the last BYTES bytes of output "
+                            "(byte count, like the reference's -c; "
+                            "use -n for line semantics)")
+    allog.add_argument("-n", dest="lines", type=int, default=0,
+                       metavar="LINES",
+                       help="show only the last LINES lines of output "
+                            "(the reference CLI's `-tail -n` "
+                            "semantics)")
     allog.add_argument("-f", action="store_true",
                        help="follow: stream new output until the alloc "
-                            "stops (combine with -tail)")
+                            "stops (combine with -tail/-n)")
     allog.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval", help="eval commands")

@@ -155,6 +155,9 @@ class TcpTransport:
         """One blocking request/response RPC to `addr`. Reuses a pooled
         connection per peer; a busy pooled conn falls back to an ephemeral
         one so concurrent RPCs don't serialize."""
+        from ..faultinject import faults
+        faults.fire("raft.rpc")     # chaos: delay or drop (raises a
+        # ConnectionError, so callers see an ordinary network failure)
         addr = tuple(addr)
         with self._pool_lock:
             entry = self._pool.get(addr)

@@ -367,9 +367,10 @@ class GenericScheduler:
         if cfg is None or not cfg.uses_tpu():
             return False
         # a wedged accelerator runtime must not strand worker threads:
-        # degrade to the host oracle (solver/guard.py)
-        from ..solver.guard import backend_available, note_host_fallback
-        if not backend_available():
+        # degrade to the host oracle when backend init is down OR the
+        # dispatch circuit breaker is open (solver/guard.py)
+        from ..solver.guard import dispatch_allowed, note_host_fallback
+        if not dispatch_allowed():
             note_host_fallback()
             return False
         return True

@@ -278,8 +278,8 @@ class SystemScheduler:
         cfg = self.state.scheduler_config()
         if cfg is None or not cfg.uses_tpu():
             return None
-        from ..solver.guard import backend_available, note_host_fallback
-        if not backend_available():
+        from ..solver.guard import dispatch_allowed, note_host_fallback
+        if not dispatch_allowed():
             note_host_fallback()
             return None
         from ..solver.service import TpuPlacementService, tg_solver_eligible

@@ -141,6 +141,8 @@ class EvalBroker:
                 ) -> Tuple[Optional[Evaluation], str]:
         """Blocking dequeue; returns (eval, ack-token)
         (reference: eval_broker.go:354)."""
+        from ..faultinject import faults
+        faults.fire("broker.dequeue")   # chaos: stall/error the feed
         deadline = time.time() + timeout if timeout is not None else None
         with self._lock:
             while True:

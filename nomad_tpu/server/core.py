@@ -849,6 +849,8 @@ class Server:
 
     def heartbeat(self, node_id: str) -> float:
         """Client TTL refresh (reference: heartbeat.go:93). Returns TTL."""
+        from ..faultinject import faults
+        faults.fire("heartbeat")    # chaos: stall/drop client check-ins
         node = self.state.node_by_id(node_id)
         if node is None:
             return 0.0

@@ -17,12 +17,67 @@ import secrets
 import time
 from typing import Dict, List, Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:              # pragma: no cover - env-dependent
+    AESGCM = None
 
 from ..structs.variables import (
     ROOT_KEY_STATE_ACTIVE, ROOT_KEY_STATE_INACTIVE, RootKey,
     VariableDecrypted, VariableEncrypted, VariableMetadata,
 )
+
+
+class _StdlibAead:
+    """AEAD fallback when the `cryptography` wheel is absent from the
+    image: HMAC-SHA256-CTR keystream + encrypt-then-MAC, pure stdlib.
+    Same interface and tamper behavior as AESGCM (decrypt raises on any
+    ciphertext/nonce/AAD mismatch); NOT wire-compatible with AES-GCM --
+    both sides of a cluster must run the same build, which holds here
+    (single-image deployment). Keeps Variables/workload-identity (and
+    everything that imports Server) functional instead of failing at
+    import time."""
+
+    __slots__ = ("_key",)
+    _TAG = 16
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def _stream(self, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        counter = 0
+        while len(out) < n:
+            out += hmac.new(
+                self._key,
+                nonce + counter.to_bytes(8, "big") + b"enc",
+                hashlib.sha256).digest()
+            counter += 1
+        return bytes(out[:n])
+
+    def _mac(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        msg = (len(aad).to_bytes(8, "big") + aad + nonce + ct)
+        return hmac.new(self._key, msg + b"mac",
+                        hashlib.sha256).digest()[:self._TAG]
+
+    def encrypt(self, nonce: bytes, plaintext: bytes,
+                aad: Optional[bytes]) -> bytes:
+        ks = self._stream(nonce, len(plaintext))
+        ct = bytes(a ^ b for a, b in zip(plaintext, ks))
+        return ct + self._mac(nonce, ct, aad or b"")
+
+    def decrypt(self, nonce: bytes, data: bytes,
+                aad: Optional[bytes]) -> bytes:
+        ct, tag = data[:-self._TAG], data[-self._TAG:]
+        if not hmac.compare_digest(tag, self._mac(nonce, ct,
+                                                  aad or b"")):
+            raise ValueError("authentication tag mismatch")
+        ks = self._stream(nonce, len(ct))
+        return bytes(a ^ b for a, b in zip(ct, ks))
+
+
+def _aead(key: bytes):
+    return AESGCM(key) if AESGCM is not None else _StdlibAead(key)
 
 
 def _b64url(data: bytes) -> str:
@@ -41,7 +96,7 @@ class Encrypter:
 
     def __init__(self, state):
         self.state = state
-        self._ciphers: Dict[str, AESGCM] = {}
+        self._ciphers: Dict[str, object] = {}
 
     # -- keyring -------------------------------------------------------
     def initialize(self) -> RootKey:
@@ -73,12 +128,12 @@ class Encrypter:
         self.state.upsert_root_key(key)
         return key
 
-    def _cipher(self, key_id: str) -> AESGCM:
+    def _cipher(self, key_id: str):
         if key_id not in self._ciphers:
             key = self.state.root_key_by_id(key_id)
             if key is None:
                 raise KeyError(f"unknown root key {key_id}")
-            self._ciphers[key_id] = AESGCM(key.material())
+            self._ciphers[key_id] = _aead(key.material())
         return self._ciphers[key_id]
 
     # -- variables AEAD ------------------------------------------------

@@ -149,6 +149,8 @@ class Planner:
               ) -> PlanResult:
         """Enqueue + wait (the worker-facing contract is unchanged:
         blocking submit, reference worker.go:650 SubmitPlan)."""
+        from ..faultinject import faults
+        faults.fire("plan.apply")   # chaos: raise -> eval nack/requeue
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("planner is shut down")

@@ -70,9 +70,18 @@ class Worker(threading.Thread):
         self._stop.set()
 
     def run(self) -> None:
+        # One bad iteration (including a dequeue that raises -- see the
+        # broker.dequeue fault point) must not silently kill the worker
+        # thread and halt scheduling; same rationale as BatchWorker.run.
         while not self._stop.is_set():
-            ev, token = self.server.broker.dequeue(
-                self.schedulers, timeout=0.5)
+            try:
+                ev, token = self.server.broker.dequeue(
+                    self.schedulers, timeout=0.5)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                self._stop.wait(0.5)
+                continue
             if ev is None:
                 continue
             try:
@@ -94,6 +103,8 @@ class Worker(threading.Thread):
 def invoke_scheduler(server, ev: Evaluation, token: str,
                      solve_hook=None) -> None:
     """(reference: worker.go:610 invokeScheduler)"""
+    from ..faultinject import faults
+    faults.fire("worker.invoke")    # chaos: raise -> nack -> requeue
     with metrics.measure("nomad.worker.wait_for_index"):
         server.state.block_until(ev.modify_index - 1, timeout=2.0)
     snapshot = server.state.snapshot()

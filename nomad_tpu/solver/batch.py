@@ -470,10 +470,21 @@ class SolveBarrier:
         self._waiting = []
         self._generation += 1
         lanes = [lane for lane, _ in batch]
-        try:
+
+        def solve_batch():
             results = fuse_and_solve(lanes, use_mesh=self._use_mesh,
                                      e_pad_hint=self._e_pad_hint)
             _cross_lane_fixpoint(lanes, results, self._ledger)
+            return results
+
+        try:
+            # the fused dispatch (+ the fixpoint's small re-solves) runs
+            # under the watchdog deadline: a mid-flight tunnel wedge
+            # fails EVERY waiter with DispatchFailed, and each eval then
+            # independently degrades to the host oracle (make_solve_hook)
+            # instead of stranding the whole batch
+            from .guard import run_dispatch
+            results = run_dispatch(solve_batch, label="solver.batch")
             for (lane, cell), res in zip(batch, results):
                 cell["result"] = res
         except Exception as e:  # noqa: BLE001 -- waiters must not strand
@@ -486,10 +497,18 @@ class SolveBarrier:
 def make_solve_hook(barrier: SolveBarrier):
     """The hook GenericScheduler calls instead of service.solve(): pack on
     the calling thread, solve at the barrier, materialize on the calling
-    thread."""
+    thread. A deadline-failed dispatch degrades THIS eval to the host
+    oracle (return None) -- the eval completes instead of nacking."""
     def hook(service, tg, places, nodes, penalties):
+        from .guard import DispatchFailed, note_host_fallback
+
         lane = service.pack(tg, places, nodes, penalties)
         if lane is None:
             return None          # not solver-eligible -> host fallback
-        return service.materialize(lane, *barrier.solve(lane))
+        try:
+            res = barrier.solve(lane)
+        except DispatchFailed:
+            note_host_fallback()
+            return None
+        return service.materialize(lane, *res)
     return hook

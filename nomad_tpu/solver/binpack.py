@@ -1808,10 +1808,15 @@ def _solve_wave_block_impl(compact, scal_f, scal_i, pen,
         j, slot, cursor, p, done = carry
         f0, low, yielded, order, ny, any_yield = head_state(j, slot)
 
-        # classic winner: max head, ties to the earliest window order
+        # classic winner: max head, ties to the earliest window order.
+        # The candidate set must be masked to YIELDED slots (the compact
+        # kernel's `is_best = yielded & (eff == best)` rule): if every
+        # yielded head is exactly -inf, best == neg_inf also matches
+        # non-yielded slots, and one with a smaller order value would
+        # steal the win (ADVICE low #1).
         effH = jnp.where(yielded, f0, neg_inf)
         best = jnp.max(effH)
-        w = jnp.argmin(jnp.where(effH == best, order, big))
+        w = jnp.argmin(jnp.where(yielded & (effH == best), order, big))
         oh_w = arangeB == w
 
         # winner scalars in ONE masked reduce (all integer-valued

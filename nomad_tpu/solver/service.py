@@ -287,11 +287,21 @@ class TpuPlacementService:
     def solve(self, tg, places, nodes, penalty_nodes_per_place=None
               ) -> Optional[List[TpuPlacement]]:
         """Returns one TpuPlacement per place (node=None for failures), or
-        None when the TG is not solver-eligible (caller falls back)."""
+        None when the TG is not solver-eligible OR the device dispatch
+        missed its watchdog deadline / raised (caller falls back to the
+        parity-authoritative host oracle either way -- a mid-flight
+        tunnel wedge must cost one deadline, not the worker)."""
+        from . import guard
+
         lane = self.pack(tg, places, nodes, penalty_nodes_per_place)
         if lane is None:
             return None
-        return self.materialize(lane, *dispatch_lane(lane))
+        try:
+            out = guard.run_dispatch(lambda: dispatch_lane(lane))
+        except guard.DispatchFailed:
+            guard.note_host_fallback()
+            return None
+        return self.materialize(lane, *out)
 
     def solve_system(self, tg, nodes) -> Optional[List[TpuPlacement]]:
         """Dense system-job solve: one independent fit+score per node
@@ -312,11 +322,19 @@ class TpuPlacementService:
         # placement axis to 1 so the compiled shape depends on the padded
         # node axis alone (not on how many nodes need placing this eval)
         import jax as _jax
+
+        from . import guard
         batch1 = _jax.tree_util.tree_map(
             lambda a: a[:1], lane.batch)
-        fit, score = _solve(lane.const, lane.init, batch1,
-                            spread_alg=self.spread_alg,
-                            dtype_name=lane.dtype_name)
+        try:
+            fit, score = guard.run_dispatch(
+                lambda: _solve(lane.const, lane.init, batch1,
+                               spread_alg=self.spread_alg,
+                               dtype_name=lane.dtype_name),
+                label="solver.dispatch.system")
+        except guard.DispatchFailed:
+            guard.note_host_fallback()
+            return None
         fit = np.asarray(fit)
         score = np.asarray(score)
         # lane.order is the length-n shuffled order (real nodes only);

@@ -154,6 +154,69 @@ def test_reprobe_reports_tunnel_ok_but_process_wedged(monkeypatch):
     hang.set()
 
 
+def test_reprobe_before_first_check_runs_inprocess_probe():
+    """reprobe() on a never-consulted guard must take the normal
+    in-process timed probe (adopting a subprocess verdict would let a
+    worker walk into an unguarded first jax init)."""
+    guard._reset_for_tests()
+    rep = guard.reprobe(timeout_s=30.0)
+    assert rep["recovered"] is False
+    assert rep["subprocess"] is None
+    # CPU backend in CI initializes fine
+    assert rep["first_probe_ok"] is True
+    assert rep["state"]["checked"] is True and rep["state"]["ok"] is True
+    assert guard.state()["last_reprobe"] is not None
+
+
+def test_reprobe_late_recovery_direct(monkeypatch):
+    """Direct late-recovery: the leaked init thread finished with live
+    devices after the first probe timed out; reprobe flips the guard
+    WITHOUT a subprocess probe and resets the dispatch breaker."""
+    import threading
+
+    guard._reset_for_tests()
+    guard._STATE.update(probe_timed_out=True)
+    with guard._LOCK:
+        guard._set_flags_locked(True, False)
+    done = threading.Event()
+    done.set()
+    guard._PROBE["done"] = done
+    guard._PROBE["result"] = {"n": 4}
+    # a wedged round also tripped the breaker; recovery must clear it
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "30")
+    for _ in range(guard._breaker_threshold()):
+        guard.record_dispatch_failure("timeout")
+    assert guard.breaker_state()["state"] == guard.BREAKER_OPEN
+
+    called = []
+    monkeypatch.setattr(guard, "_subprocess_probe",
+                        lambda t: called.append(t))
+    rep = guard.reprobe(timeout_s=1.0)
+    assert rep["recovered"] is True
+    assert rep["subprocess"] is None and not called
+    assert guard.backend_available() is True
+    assert guard.breaker_state()["state"] == guard.BREAKER_CLOSED
+    assert guard.state()["degraded"] is False
+
+
+def test_subprocess_probe_timeout_kills_group(monkeypatch):
+    """A hung transport probe must be killed at the deadline, not
+    block the reprobe caller (the bench.py process-group pattern)."""
+    t0 = time.time()
+    monkeypatch.setattr(guard, "_SUBPROBE_SRC",
+                        "import time\ntime.sleep(60)\n")
+    rep = guard._subprocess_probe(0.5)
+    assert rep["timed_out"] is True
+    assert rep["devices"] == 0
+    assert time.time() - t0 < 5.0
+
+
+def test_subprocess_probe_parses_device_count(monkeypatch):
+    monkeypatch.setattr(guard, "_SUBPROBE_SRC", "print('N:3')\n")
+    rep = guard._subprocess_probe(10.0)
+    assert rep == {"timed_out": False, "rc": 0, "devices": 3}
+
+
 def test_guard_state_in_agent_self_and_reprobe_endpoint():
     from nomad_tpu.api.client import ApiClient
     from nomad_tpu.api.http import HttpServer

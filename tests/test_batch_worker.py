@@ -220,16 +220,20 @@ def test_cross_lane_fixpoint_avoids_applier_retry():
 
 def test_solve_barrier_dispatch_exception_fans_out():
     """A dispatch failure must re-raise in EVERY blocked participant
-    (VERDICT r2 weak #5), so each eval nacks independently."""
+    (VERDICT r2 weak #5) as DispatchFailed (the deadline layer's
+    verdict), so each eval independently degrades to the host oracle
+    via make_solve_hook instead of nacking."""
     import threading
 
     from nomad_tpu.solver import batch as batch_mod
+    from nomad_tpu.solver import guard
     from nomad_tpu.solver.batch import SolveBarrier
 
     class BoomLane:
         def fuse_key(self):
             return ("boom",)
 
+    guard._reset_for_tests()
     orig = batch_mod.fuse_and_solve
     batch_mod.fuse_and_solve = lambda lanes, use_mesh=True, **kw: (
         (_ for _ in ()).throw(RuntimeError("device exploded")))
@@ -240,8 +244,8 @@ def test_solve_barrier_dispatch_exception_fans_out():
         def worker():
             try:
                 barrier.solve(BoomLane())
-            except RuntimeError as e:
-                errors.append(str(e))
+            except guard.DispatchFailed as e:
+                errors.append((e.kind, str(e.__cause__)))
 
         threads = [threading.Thread(target=worker) for _ in range(2)]
         for t in threads:
@@ -249,9 +253,12 @@ def test_solve_barrier_dispatch_exception_fans_out():
         barrier.done()      # third participant finished without solving
         for t in threads:
             t.join(10)
-        assert errors == ["device exploded", "device exploded"]
+        assert errors == [("error", "device exploded")] * 2
+        # the failure also counted toward the dispatch breaker
+        assert guard.breaker_state()["consecutive_failures"] == 1
     finally:
         batch_mod.fuse_and_solve = orig
+        guard._reset_for_tests()
 
 
 def test_solve_barrier_straggler_timeout_dispatches_without_it():

@@ -1,0 +1,375 @@
+"""Chaos suite: inject faults at every layer and assert the system
+degrades the way the design promises.
+
+The scenarios mirror round 5's live failure (TPU_PROBE_JOURNAL.log: the
+tunnel wedged MID-ROUND, after init had succeeded) plus the broker/raft
+failure classes: a mid-dispatch solver hang must cost one watchdog
+deadline -- never the worker; the eval must complete via the host
+oracle with parity-identical placements; the breaker must trip and then
+auto-recover once the fault clears; a failed eval must be nacked and
+requeued, never lost.
+
+Fast variants run in tier-1 (`-m chaos` selects just these); soak
+variants are additionally marked `slow`.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.benchkit import run_tier_placements
+from nomad_tpu.faultinject import FaultRegistry, InjectedFault, faults
+from nomad_tpu.server import Server
+from nomad_tpu.server.telemetry import metrics
+from nomad_tpu.solver import guard
+
+pytestmark = pytest.mark.chaos
+
+N_NODES, COUNT, SEED = 12, 6, 7
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    guard._reset_for_tests()
+    faults._reset_for_tests()
+    metrics.reset()
+    yield
+    faults._reset_for_tests()
+    guard._reset_for_tests()
+
+
+def _host_placements():
+    return run_tier_placements(3, N_NODES, COUNT, SEED, "binpack")
+
+
+def _tpu_placements():
+    return run_tier_placements(3, N_NODES, COUNT, SEED, "tpu-binpack")
+
+
+def _fast_probe_pass(monkeypatch):
+    """The breaker's subprocess transport probe re-imports jax in a
+    child (seconds); chaos recovery is driven through the solver.probe
+    fault point instead, so stub the subprocess out."""
+    monkeypatch.setattr(
+        guard, "_subprocess_probe",
+        lambda timeout: {"timed_out": False, "rc": 0, "devices": 1})
+
+
+# ----------------------------------------------------------------------
+# The acceptance scenario: mid-dispatch hang -> bounded fallback ->
+# breaker trip -> auto-recovery once the fault clears.
+
+
+def test_dispatch_hang_bounded_fallback_trip_and_autorecovery(
+        monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_DISPATCH_TIMEOUT", "0.3")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "0.05")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF_MAX", "0.2")
+    _fast_probe_pass(monkeypatch)
+
+    host = _host_placements()
+    assert host, "world must place something"
+
+    # wedge the tunnel: every dispatch hangs until the fault is
+    # disarmed; the probe point holds the breaker open meanwhile
+    faults.arm("solver.dispatch", "hang")
+    faults.arm("solver.probe", "error")
+
+    t0 = time.time()
+    degraded = _tpu_placements()
+    wall = time.time() - t0
+
+    # the worker never blocked past the deadline (one-ish timeouts of
+    # 0.3s each, not the unbounded hang), and the eval COMPLETED with
+    # the host oracle's exact placements
+    assert wall < 5.0, f"eval blocked {wall:.1f}s despite 0.3s deadline"
+    assert degraded == host, "host fallback must be parity-identical"
+
+    st = guard.state()
+    assert st["degraded"] is True
+    assert st["breaker"]["state"] in ("open", "half_open")
+    assert st["breaker"]["trips"] >= 1
+    assert st["dispatch"]["timeout"] >= 1
+    assert st["host_fallback_dispatches"] >= 1
+    assert guard.dispatch_allowed() is False
+
+    # the injected fault clears -> background probes pass -> the
+    # breaker closes WITHOUT any operator action (round 5 required a
+    # manual reprobe)
+    faults.disarm_all()
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if guard.breaker_state()["state"] == guard.BREAKER_CLOSED:
+            break
+        time.sleep(0.02)
+    st = guard.state()
+    assert st["breaker"]["state"] == guard.BREAKER_CLOSED
+    assert st["breaker"]["recoveries"] >= 1
+    assert st["degraded"] is False
+    assert guard.dispatch_allowed() is True
+
+    # and the recovered path schedules densely again, still at parity
+    recovered = _tpu_placements()
+    assert recovered == host
+
+
+def test_dispatch_exception_falls_back_parity(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_THRESHOLD", "100")
+    host = _host_placements()
+    faults.arm("solver.dispatch", "error")
+    degraded = _tpu_placements()
+    assert degraded == host
+    st = guard.state()
+    assert st["dispatch"]["error"] >= 1
+    assert st["host_fallback_dispatches"] >= 1
+    # under threshold: no trip
+    assert st["breaker"]["state"] == guard.BREAKER_CLOSED
+
+
+def test_dispatch_latency_within_deadline_no_trip(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_DISPATCH_TIMEOUT", "30")
+    host = _host_placements()
+    faults.arm("solver.dispatch", "delay", delay_s=0.05)
+    placed = _tpu_placements()
+    assert placed == host
+    st = guard.state()
+    assert st["dispatch"]["ok"] >= 1
+    assert st["dispatch"]["timeout"] == 0
+    assert st["breaker"]["state"] == guard.BREAKER_CLOSED
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("nomad.scheduler.placements_tpu", 0) > 0, \
+        "dense path must have actually dispatched"
+
+
+def test_breaker_open_routes_host_without_dispatching(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "30")
+    _fast_probe_pass(monkeypatch)
+    host = _host_placements()
+    metrics.reset()
+    for _ in range(guard._breaker_threshold()):
+        guard.record_dispatch_failure("timeout")
+    assert guard.breaker_state()["state"] == guard.BREAKER_OPEN
+    assert guard.dispatch_allowed() is False
+    placed = _tpu_placements()
+    assert placed == host
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("nomad.scheduler.placements_tpu", 0) == 0
+    assert counters.get(
+        "nomad.solver.host_fallback_dispatches", 0) >= 1
+
+
+# ----------------------------------------------------------------------
+# Eval pipeline: injected failures must nack/requeue, never lose evals.
+
+
+def _wait_placed(server, job_id, want, timeout=15.0):
+    deadline = time.time() + timeout
+    allocs = []
+    while time.time() < deadline:
+        allocs = [a for a in server.state.allocs_by_job(
+            "default", job_id) if a.desired_status == "run"]
+        if len(allocs) >= want:
+            return allocs
+        time.sleep(0.05)
+    raise AssertionError(
+        f"only {len(allocs)}/{want} allocs placed within {timeout}s")
+
+
+def test_worker_invoke_fault_eval_not_lost():
+    faults.arm("worker.invoke", "error", count=1)
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        from nomad_tpu.client import SimClient
+        client = SimClient(server, mock.node())
+        client.start()
+        job = mock.job(id="chaos-invoke")
+        job.task_groups[0].count = 2
+        server.register_job(job)
+        # first delivery raises -> nack -> requeue -> second succeeds
+        _wait_placed(server, "chaos-invoke", 2)
+        assert faults.snapshot()["faults"] == [], \
+            "count=1 fault must auto-disarm after firing"
+        counters = metrics.snapshot()["counters"]
+        assert counters.get("nomad.fault.injected.worker.invoke") == 1
+    finally:
+        server.shutdown()
+
+
+def test_plan_apply_fault_eval_not_lost():
+    faults.arm("plan.apply", "error", count=1)
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        from nomad_tpu.client import SimClient
+        client = SimClient(server, mock.node())
+        client.start()
+        job = mock.job(id="chaos-plan")
+        job.task_groups[0].count = 2
+        server.register_job(job)
+        _wait_placed(server, "chaos-plan", 2)
+    finally:
+        server.shutdown()
+
+
+def test_broker_dequeue_fault_worker_survives():
+    # an erroring dequeue must not kill the worker thread (pre-round-6
+    # the raise escaped Worker.run's try and silently halted scheduling)
+    faults.arm("broker.dequeue", "error", count=2)
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        from nomad_tpu.client import SimClient
+        client = SimClient(server, mock.node())
+        client.start()
+        job = mock.job(id="chaos-dequeue")
+        job.task_groups[0].count = 1
+        server.register_job(job)
+        _wait_placed(server, "chaos-dequeue", 1)
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Transport + heartbeat injection points.
+
+
+def test_rpc_drop_and_delay():
+    from nomad_tpu.raft.transport import TcpTransport
+
+    t = TcpTransport()
+    t.register("echo", lambda m: {"ok": True, "x": m.get("x")})
+    t.start()
+    try:
+        assert t.send(t.addr, {"type": "echo", "x": 1})["x"] == 1
+        faults.arm("raft.rpc", "drop")
+        with pytest.raises(ConnectionError):
+            t.send(t.addr, {"type": "echo", "x": 2})
+        faults.disarm("raft.rpc")
+        faults.arm("raft.rpc", "delay", delay_s=0.1)
+        t0 = time.time()
+        assert t.send(t.addr, {"type": "echo", "x": 3})["x"] == 3
+        assert time.time() - t0 >= 0.1
+    finally:
+        t.shutdown()
+
+
+def test_heartbeat_stall_still_serves():
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    try:
+        node = mock.node()
+        server.register_node(node)
+        faults.arm("heartbeat", "delay", delay_s=0.1)
+        t0 = time.time()
+        ttl = server.heartbeat(node.id)
+        assert ttl > 0
+        assert time.time() - t0 >= 0.1
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# The framework itself + the HTTP arming surface.
+
+
+def test_registry_env_arming(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FAULT_INJECT",
+                       "heartbeat=delay:0.01:2, raft.rpc=drop,"
+                       "bogus entry,typo=nosuchaction")
+    reg = FaultRegistry()
+    snap = {f["point"]: f for f in reg.snapshot()["faults"]}
+    assert snap["heartbeat"]["action"] == "delay"
+    assert snap["heartbeat"]["count"] == 2
+    assert snap["raft.rpc"]["action"] == "drop"
+    assert "typo" not in snap          # bad entries must not abort boot
+    reg.fire("heartbeat")
+    reg.fire("heartbeat")              # count exhausts -> auto-disarm
+    assert "heartbeat" not in {
+        f["point"] for f in reg.snapshot()["faults"]}
+
+
+def test_registry_error_and_count():
+    reg = FaultRegistry()
+    reg.arm("p", "error", count=2)
+    with pytest.raises(InjectedFault):
+        reg.fire("p")
+    with pytest.raises(InjectedFault):
+        reg.fire("p")
+    reg.fire("p")                      # exhausted: no-op
+    with pytest.raises(ValueError):
+        reg.arm("p", "explode")
+    assert reg.disarm("p") is False
+
+
+def test_faults_http_endpoints_and_agent_self():
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    try:
+        api = ApiClient(f"http://127.0.0.1:{http.port}")
+        snap = api.post("/v1/operator/faults",
+                        {"point": "heartbeat", "action": "delay",
+                         "delay_s": 0.01})
+        assert snap["faults"][0]["point"] == "heartbeat"
+        assert api.get("/v1/operator/faults")["faults"]
+        snap = api.post("/v1/operator/faults",
+                        {"point": "heartbeat", "disarm": True})
+        assert snap["faults"] == []
+
+        # breaker + degraded verdict ride /v1/agent/self
+        st = api.get("/v1/agent/self")["stats"]["solver_guard"]
+        assert "breaker" in st and "degraded" in st
+        assert st["breaker"]["state"] == "closed"
+    finally:
+        http.shutdown()
+        server.shutdown()
+
+
+def test_bench_stamp_reports_breaker_degraded(monkeypatch):
+    from nomad_tpu.benchkit import dispatch_health_stamp
+
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "30")
+    _fast_probe_pass(monkeypatch)
+    stamp = dispatch_health_stamp("cpu")
+    assert stamp["degraded"] == "cpu-fallback"
+    for _ in range(guard._breaker_threshold()):
+        guard.record_dispatch_failure("timeout")
+    stamp = dispatch_health_stamp("tpu")
+    assert stamp["degraded"] == "breaker-open"
+    assert stamp["dispatch_state"]["breaker_trips"] == 1
+    guard.reset_breaker()
+    stamp = dispatch_health_stamp("tpu")
+    assert stamp["degraded"] is False
+
+
+# ----------------------------------------------------------------------
+# Soak: repeated wedge/recover cycles stay parity-correct.
+
+
+@pytest.mark.slow
+def test_soak_wedge_recover_cycles(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_DISPATCH_TIMEOUT", "0.3")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "0.05")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF_MAX", "0.2")
+    _fast_probe_pass(monkeypatch)
+    host = _host_placements()
+    for cycle in range(3):
+        faults.arm("solver.dispatch", "hang")
+        faults.arm("solver.probe", "error")
+        assert _tpu_placements() == host, f"cycle {cycle} degraded"
+        faults.disarm_all()
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if guard.breaker_state()["state"] == guard.BREAKER_CLOSED:
+                break
+            time.sleep(0.02)
+        assert guard.breaker_state()["state"] == guard.BREAKER_CLOSED
+        assert _tpu_placements() == host, f"cycle {cycle} recovered"
+    assert guard.breaker_state()["recoveries"] >= 3

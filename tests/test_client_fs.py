@@ -380,6 +380,56 @@ def test_fs_logs_negative_offset_tails(env):
         b"89AB"
 
 
+def test_logs_and_fs_bad_offset_limit_return_400(env):
+    """Non-numeric offset/limit on the non-follow logs path and the fs
+    read paths must 400 with the same explicit verdict the follow path
+    gives -- never a 500 or a raw int() message (ADVICE low #2)."""
+    from nomad_tpu.api.client import ApiError
+
+    server, client, api = env
+    run_logged_job(server, job_id="badq", stdout="x\n")
+    alloc = wait_running(server, "badq")
+    task_name = alloc.job.task_groups[0].tasks[0].name
+    for path, param in (
+            (f"/v1/client/fs/logs/{alloc.id}/{task_name}?type=stdout",
+             "offset=bogus"),
+            (f"/v1/client/fs/logs/{alloc.id}/{task_name}?type=stdout",
+             "limit=bogus"),
+            (f"/v1/client/fs/cat/{alloc.id}?path=alloc/logs",
+             "offset=bogus"),
+            (f"/v1/client/fs/readat/{alloc.id}?path=alloc/logs",
+             "limit=1x")):
+        with pytest.raises(ApiError) as e:
+            api.request_raw("GET", f"{path}&{param}")
+        assert e.value.status == 400
+        assert "must be numeric" in str(e.value)
+
+
+def test_cli_alloc_logs_tail_lines(env, capsysbinary):
+    """`alloc logs -n LINES` gives the reference CLI's line semantics;
+    `-tail BYTES` stays an explicit byte count (ADVICE low #3)."""
+    from nomad_tpu import cli
+
+    server, client, api = env
+    run_logged_job(server, job_id="linelog",
+                   stdout="one\ntwo\nthree\nfour\n")
+    alloc = wait_running(server, "linelog")
+    task_name = alloc.job.task_groups[0].tasks[0].name
+    base = api.address
+    assert cli.main(["-address", base, "alloc", "logs",
+                     "-n", "2", alloc.id, task_name]) == 0
+    assert capsysbinary.readouterr().out == b"three\nfour\n"
+    # byte semantics unchanged
+    assert cli.main(["-address", base, "alloc", "logs",
+                     "-tail", "5", alloc.id, task_name]) == 0
+    assert capsysbinary.readouterr().out == b"four\n"
+    # -n caps within an explicit -tail byte window
+    assert cli.main(["-address", base, "alloc", "logs",
+                     "-tail", "10", "-n", "1", alloc.id,
+                     task_name]) == 0
+    assert capsysbinary.readouterr().out == b"four\n"
+
+
 def test_fs_read_negative_offset_tails(env):
     server, client, api = env
     run_logged_job(server, job_id="tailjob", stdout="x")
