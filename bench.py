@@ -388,26 +388,23 @@ def time_batched_path(n_nodes, e_evals, per_eval):
         server.shutdown()
 
 
-def time_fused_solver(h, nodes, e_evals, per_eval, repeats=3):
-    """Solver-only fused throughput: E distinct jobs' lanes packed from one
-    snapshot, solved as ONE coalesced dispatch (the production BatchWorker
-    solve point, minus the Python control plane that time_batched_path
-    includes). Gated: the fused results must equal each lane's solo
-    dispatch. Returns (median_dt, n_placed_per_round, mismatch)."""
+def pack_fused_lanes(h, nodes, e_evals, per_eval, tag="fused-bench"):
+    """E distinct jobs' lanes packed from one snapshot -- the input shape
+    of the production SolveBarrier solve point. Returns None when any
+    lane is solver-ineligible."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.context import EvalContext
     from nomad_tpu.scheduler.reconcile import AllocPlaceResult
-    from nomad_tpu.solver.batch import fuse_and_solve
-    from nomad_tpu.solver.service import TpuPlacementService, dispatch_lane
+    from nomad_tpu.solver.service import TpuPlacementService
     from nomad_tpu.structs import Plan
 
     snap = h.state.snapshot()
     lanes = []
     for i in range(e_evals):
-        job = mock.job(id=f"fused-bench-{i}")
+        job = mock.job(id=f"{tag}-{i}")
         job.task_groups[0].count = per_eval
         tg = job.task_groups[0]
-        plan = Plan(eval_id=f"fused-bench-eval-{i:016d}", priority=50,
+        plan = Plan(eval_id=f"{tag}-eval-{i:016d}"[-36:], priority=50,
                     job=job)
         ctx = EvalContext(snap, plan)
         places = [AllocPlaceResult(name=f"{job.id}.{tg.name}[{k}]",
@@ -417,8 +414,23 @@ def time_fused_solver(h, nodes, e_evals, per_eval, repeats=3):
                                       spread_alg=False)
         lane = service.pack(tg, places, nodes)
         if lane is None:
-            return None, 0, 0, None
+            return None
         lanes.append(lane)
+    return lanes
+
+
+def time_fused_solver(h, nodes, e_evals, per_eval, repeats=3):
+    """Solver-only fused throughput: E distinct jobs' lanes packed from one
+    snapshot, solved as ONE coalesced dispatch (the production BatchWorker
+    solve point, minus the Python control plane that time_batched_path
+    includes). Gated: the fused results must equal each lane's solo
+    dispatch. Returns (median_dt, n_placed_per_round, mismatch)."""
+    from nomad_tpu.solver.batch import fuse_and_solve
+    from nomad_tpu.solver.service import dispatch_lane
+
+    lanes = pack_fused_lanes(h, nodes, e_evals, per_eval)
+    if lanes is None:
+        return None, 0, 0, None
 
     fused = fuse_and_solve(lanes)           # warmup (incl. compile)
     mismatch = 0
@@ -585,6 +597,92 @@ def _fused_compute_only(lanes, repeats=3):
     except Exception as e:  # noqa: BLE001 -- keep the blocking number
         log(f"bench: chained compute probe failed: {e!r}")
     return blocking_dt, marginal_dt, pipelined_dt
+
+
+def time_streaming_solver(h, nodes, e_evals, per_eval, depth, rounds=6):
+    """Steady-state STREAMING dispatch through the production fused path
+    (solver/batch.py fuse_and_solve -> device-resident const cache,
+    solver/constcache.py): the same lane batch dispatched ``rounds``
+    times, first strictly sequentially (the blocking baseline), then
+    with ``depth`` dispatches in flight -- the shape a pipelined
+    SolveBarrier (NOMAD_TPU_DISPATCH_DEPTH > 1) drives in production,
+    where round trips and host packing overlap device compute.
+
+    Also measures the transfer cut: host->device bytes of the COLD
+    first dispatch (const cache empty) vs a WARM dispatch (tables
+    resident), read from the nomad.solver.dispatch_bytes counters the
+    dispatch layer maintains. Returns a dict or None."""
+    import threading
+
+    from nomad_tpu.server.telemetry import metrics
+    from nomad_tpu.solver import constcache
+    from nomad_tpu.solver.batch import fuse_and_solve
+
+    lanes = pack_fused_lanes(h, nodes, e_evals, per_eval,
+                             tag="stream-bench")
+    if lanes is None:
+        return None
+
+    def bytes_total():
+        return metrics.snapshot()["counters"].get(
+            "nomad.solver.dispatch_bytes_total", 0)
+
+    constcache.invalidate_all()           # honest cold measurement
+    b0 = bytes_total()
+    ref = fuse_and_solve(lanes)           # cold: compile + full upload
+    cold_bytes = bytes_total() - b0
+    b0 = bytes_total()
+    fuse_and_solve(lanes)                 # warm: const tables resident
+    warm_bytes = bytes_total() - b0
+    placed = sum(int((res[0] >= 0).sum()) for res in ref)
+
+    # blocking baseline: one dispatch fully fetched before the next
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        fuse_and_solve(lanes)
+    sync_dt = (time.perf_counter() - t0) / rounds
+
+    # pipelined: `depth` submitters keep up to depth dispatches in
+    # flight (each worker's fetch overlaps the others' transfers and
+    # device execution -- what the async SolveBarrier does with real
+    # eval generations)
+    n_rounds = rounds * max(depth, 1)   # longer window: steadier number
+    todo = list(range(n_rounds))
+    lock = threading.Lock()
+    mism = [0]
+
+    def pull():
+        while True:
+            with lock:
+                if not todo:
+                    return
+                todo.pop()
+            out = fuse_and_solve(lanes)
+            if any((a[0] != b[0]).any() for a, b in zip(out, ref)):
+                with lock:
+                    mism[0] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=pull) for _ in range(depth)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pipe_dt = (time.perf_counter() - t0) / max(n_rounds, 1)
+
+    snap = metrics.snapshot()["counters"]
+    hits = snap.get("nomad.solver.const_cache_hit", 0)
+    misses = snap.get("nomad.solver.const_cache_miss", 0)
+    return {
+        "placed": placed,
+        "depth": depth,
+        "sync_dt": sync_dt,
+        "pipe_dt": pipe_dt,
+        "cold_bytes": cold_bytes,
+        "warm_bytes": warm_bytes,
+        "mismatch": mism[0],
+        "const_cache_hit_rate": round(hits / max(hits + misses, 1), 4),
+    }
 
 
 def solve_once(h, job, nodes, n_placements):
@@ -811,6 +909,31 @@ def main():
             return None
         return (bdt, bevals, bplaced)
 
+    # --- streaming dispatch: sync vs depth-D pipelined, const cache warm
+    streaming = None
+    if not mismatch and os.environ.get("BENCH_SKIP_STREAMING", "") != "1":
+        depth = int(os.environ.get(
+            "BENCH_STREAM_DEPTH",
+            os.environ.get("NOMAD_TPU_DISPATCH_DEPTH", "4")))
+        depth = max(2, depth)
+        e_evals = int(os.environ.get("BENCH_FUSED_EVALS", "32"))
+        try:
+            streaming = time_streaming_solver(h, nodes, e_evals,
+                                              N_PLACEMENTS, depth)
+        except Exception as e:  # noqa: BLE001 -- report the rest anyway
+            log(f"bench: streaming solver failed: {e!r}")
+        if streaming is not None:
+            mismatch += streaming["mismatch"]
+            log(f"bench: streaming sync {streaming['sync_dt'] * 1e3:.1f}"
+                f"ms/round ({streaming['placed'] / streaming['sync_dt']:.0f}"
+                f" placements/s), depth-{depth} pipelined "
+                f"{streaming['pipe_dt'] * 1e3:.1f}ms/round "
+                f"({streaming['placed'] / streaming['pipe_dt']:.0f} "
+                f"placements/s); dispatch bytes cold "
+                f"{streaming['cold_bytes']} -> warm "
+                f"{streaming['warm_bytes']} "
+                f"(hit rate {streaming['const_cache_hit_rate']})")
+
     batched = None
     if not mismatch and os.environ.get("BENCH_SKIP_BATCHED", "") != "1":
         e_evals = int(os.environ.get("BENCH_BATCH_EVALS", "16"))
@@ -823,7 +946,7 @@ def main():
 
     _emit(platform, p50, mismatch, oracle_dt, native_dt, batched,
           n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
-          rtt=rtt)
+          rtt=rtt, streaming=streaming)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
@@ -831,7 +954,7 @@ def main():
 
 def _emit(platform, p50, mismatch, oracle_total, native_total=None,
           batched=None, n_placed=0, fused=None, batched_full=None,
-          rtt=None):
+          rtt=None, streaming=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -915,6 +1038,29 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
             if per_place_native is not None:
                 out["fused_compute_marginal_vs_native_host"] = round(
                     per_place_native / (marginal / fplaced), 4)
+    if streaming is not None:
+        # steady-state streaming: the SAME fused workload dispatched
+        # round after round with the const cache warm -- blocking
+        # baseline kept alongside the depth-D pipelined number for
+        # honesty, plus the per-dispatch transfer cut (cold = full
+        # upload, warm = deltas only)
+        placed = streaming["placed"]
+        out["streaming_sync_placements_per_sec"] = round(
+            placed / streaming["sync_dt"], 2) if streaming["sync_dt"] \
+            else 0.0
+        out["streaming_pipelined_placements_per_sec"] = round(
+            placed / streaming["pipe_dt"], 2) if streaming["pipe_dt"] \
+            else 0.0
+        out["streaming_depth"] = streaming["depth"]
+        out["dispatch_bytes_cold"] = streaming["cold_bytes"]
+        out["dispatch_bytes_warm"] = streaming["warm_bytes"]
+        if streaming["warm_bytes"]:
+            out["dispatch_bytes_cut"] = round(
+                streaming["cold_bytes"] / streaming["warm_bytes"], 2)
+        out["const_cache_hit_rate"] = streaming["const_cache_hit_rate"]
+        if native_total is not None and placed:
+            out["streaming_pipelined_vs_native_host"] = round(
+                per_place_native / (streaming["pipe_dt"] / placed), 4)
     if batched is not None:
         bdt, bevals, bplaced = batched
         out["batched_evals_per_sec"] = round(bevals / bdt, 2)

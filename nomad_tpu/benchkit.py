@@ -52,6 +52,8 @@ def dispatch_health_stamp(platform: str) -> dict:
         degraded = f"breaker-{st['breaker']['state']}"
     else:
         degraded = False
+    cc = st.get("const_cache", {})
+    pipe = st.get("dispatch_pipeline", {})
     return {
         "degraded": degraded,
         "dispatch_state": {
@@ -64,6 +66,17 @@ def dispatch_health_stamp(platform: str) -> dict:
             "dispatch_error": st["dispatch"]["error"],
             "host_fallback_dispatches": st["host_fallback_dispatches"],
             "backend_ok": st["ok"],
+        },
+        # transfer layer (ISSUE 2): shipped bytes + const-cache hit
+        # rate belong in every artifact so the delta-streaming claim is
+        # measured, not inferred
+        "transfer_state": {
+            "dispatch_bytes_total": st["dispatch"].get("bytes_total", 0),
+            "const_cache_hits": cc.get("hits", 0),
+            "const_cache_misses": cc.get("misses", 0),
+            "const_cache_bytes_saved": cc.get("bytes_saved_total", 0),
+            "const_cache_resident_bytes": cc.get("resident_bytes", 0),
+            "dispatch_depth": pipe.get("depth", 1),
         },
     }
 

@@ -697,8 +697,15 @@ def cmd_operator_solver(args) -> int:
                   "recoveries", "backoff_s"):
             print(f"breaker.{k:20s} = {br.get(k)}")
         dis = st.get("dispatch") or {}
-        for k in ("ok", "timeout", "error"):
+        for k in ("ok", "timeout", "error", "bytes_total"):
             print(f"dispatch.{k:19s} = {dis.get(k)}")
+        pipe = st.get("dispatch_pipeline") or {}
+        for k in ("depth", "in_flight"):
+            print(f"pipeline.{k:19s} = {pipe.get(k)}")
+        cc = st.get("const_cache") or {}
+        for k in ("enabled", "entries", "resident_bytes", "hits",
+                  "misses", "bytes_saved_total", "invalidations"):
+            print(f"const_cache.{k:16s} = {cc.get(k)}")
     elif args.sub2 == "reprobe":
         # a first-touch reprobe legitimately blocks for the in-process
         # probe deadline (<=30s) plus the subprocess transport probe

@@ -78,9 +78,20 @@ def pick_mesh(e: int, n: int, n_devices: Optional[int] = None):
 
 def shard_solver_inputs(mesh, const, init, batch):
     """NamedShardings for solve_eval_batch inputs: leading axis (E) on
-    'evals'; node-axis (last dim of per-node arrays) on 'nodes'."""
+    'evals'; node-axis (last dim of per-node arrays) on 'nodes'.
+
+    Sharded puts bypass the device-resident const cache (it pins
+    unsharded single-device buffers), but they still report their
+    payload so ``nomad.solver.dispatch_bytes`` covers every transport
+    path."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..solver.constcache import note_dispatch_bytes
+    note_dispatch_bytes(sum(
+        np.asarray(leaf).nbytes
+        for tree in (const, init, batch)
+        for leaf in jax.tree_util.tree_leaves(tree)))
 
     def shard_const(c):
         specs = type(c)(

@@ -158,8 +158,8 @@ class Planner:
             pending = _Pending(plan, eval_updates, self._seq)
             heapq.heappush(self._heap,
                            (-plan.priority, pending.seq, pending))
-            metrics.sample_ms("nomad.plan.queue_depth",
-                              float(len(self._heap)))
+            metrics.sample("nomad.plan.queue_depth",
+                           float(len(self._heap)))
             self._cv.notify()
         pending.event.wait()
         if pending.error is not None:

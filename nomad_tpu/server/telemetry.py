@@ -67,6 +67,7 @@ class Telemetry:
     def __init__(self):
         self._lock = threading.Lock()
         self._series: Dict[str, _Series] = {}
+        self._gauges: Dict[str, _Series] = {}
         self._counters: Dict[str, int] = {}
 
     def sample_ms(self, name: str, ms: float) -> None:
@@ -75,6 +76,17 @@ class Telemetry:
             if s is None:
                 s = self._series[name] = _Series()
             s.add(ms)
+
+    def sample(self, name: str, value: float) -> None:
+        """Gauge-style sample in the series' OWN unit (lane counts,
+        bytes, depths, ...) -- distinct from sample_ms so dashboards
+        never read a count as a latency (the `batch_lanes` series used
+        to ride the millisecond sampler and rendered as 'ms')."""
+        with self._lock:
+            s = self._gauges.get(name)
+            if s is None:
+                s = self._gauges[name] = _Series()
+            s.add(value)
 
     def measure(self, name: str):
         """Context manager timing a block into `name` (milliseconds)."""
@@ -89,13 +101,24 @@ class Telemetry:
             return {
                 "samples": {k: v.snapshot()
                             for k, v in self._series.items()},
+                # unit-free gauge series: same percentile summary, but
+                # the _ms key suffixes are a lie for these -- consumers
+                # present them unitless (see _strip_ms_keys)
+                "gauges": {k: _strip_ms_keys(v.snapshot())
+                           for k, v in self._gauges.items()},
                 "counters": dict(self._counters),
             }
 
     def reset(self) -> None:
         with self._lock:
             self._series.clear()
+            self._gauges.clear()
             self._counters.clear()
+
+
+def _strip_ms_keys(snap: dict) -> dict:
+    return {(k[:-3] if k.endswith("_ms") else k): v
+            for k, v in snap.items()}
 
 
 class _Timer:
@@ -157,6 +180,9 @@ class StatsdSink:
         for name, s in snap.get("samples", {}).items():
             if s.get("count"):
                 lines.append(f"{name}:{s.get('mean_ms', 0.0):.3f}|ms")
+        for name, s in snap.get("gauges", {}).items():
+            if s.get("count"):
+                lines.append(f"{name}:{s.get('mean', 0.0):.3f}|g")
         if not lines:
             return
         try:

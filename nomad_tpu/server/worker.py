@@ -169,7 +169,7 @@ class BatchWorker(threading.Thread):
             self.schedulers, self.width, timeout=0.5)
         if not batch:
             return
-        metrics.sample_ms("nomad.worker.batch_width", float(len(batch)))
+        metrics.sample("nomad.worker.batch_width", float(len(batch)))
         barrier = SolveBarrier(len(batch), use_mesh=self.use_mesh,
                                e_pad_hint=self.width)
         hook = make_solve_hook(barrier)

@@ -24,6 +24,9 @@ concurrency, SURVEY.md section 2.6.1).
 """
 from __future__ import annotations
 
+import functools
+import os
+import queue
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -41,6 +44,100 @@ E_BUCKETS = (1, 2, 4, 8, 16, 32)
 # barrier within this window (a bug, not a normal state), dispatch without
 # it rather than wedge every blocked eval.
 BARRIER_TIMEOUT_S = 10.0
+
+
+def dispatch_depth() -> int:
+    """Max fused dispatches in flight across the process
+    (NOMAD_TPU_DISPATCH_DEPTH). Depth 1 is the kill switch: every
+    barrier dispatches synchronously on the last-arriving thread,
+    exactly the pre-pipeline behavior. Depth > 1 routes dispatches
+    through the async pipeline so one generation's host packing and
+    transfer overlap another's device execution (the ~68ms tunnel RTT
+    and ~40ms of numpy packing per dispatch stop serializing,
+    BENCH_NOTES_r05.md)."""
+    try:
+        d = int(os.environ.get("NOMAD_TPU_DISPATCH_DEPTH", "2"))
+    except ValueError:
+        return 1
+    return max(1, min(d, 32))
+
+
+class _DispatchPipeline:
+    """Process-global async dispatch executor: a FIFO intake thread
+    starts one in-flight thread per job, never more than ``depth``
+    concurrently. Jobs from different barriers (and different
+    BatchWorkers) share the bound, so the device never sees more than
+    ``depth`` fused dispatches at once while host-side pack/fuse of the
+    next generation proceeds under an earlier one's execution."""
+
+    def __init__(self, depth: int):
+        self.depth = depth
+        self._sem = threading.Semaphore(depth)
+        self._q: "queue.Queue" = queue.Queue()
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._intake, daemon=True,
+            name="solver-dispatch-pipeline")
+        self._thread.start()
+
+    def submit(self, job) -> None:
+        self._q.put(job)
+
+    def stop(self) -> None:
+        self._q.put(None)
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def _intake(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            self._sem.acquire()
+            with self._lock:
+                self._in_flight += 1
+            threading.Thread(target=self._run_job, args=(job,),
+                             daemon=True,
+                             name="solver-dispatch-inflight").start()
+
+    def _run_job(self, job) -> None:
+        try:
+            job()
+        except Exception:  # noqa: BLE001 -- jobs guarantee their own
+            import traceback  # waiter wakeups; this is belt-and-braces
+            traceback.print_exc()
+        finally:
+            with self._lock:
+                self._in_flight -= 1
+            self._sem.release()
+
+
+_PIPELINE: Optional[_DispatchPipeline] = None
+_PIPELINE_LOCK = threading.Lock()
+
+
+def _get_pipeline(depth: int) -> _DispatchPipeline:
+    global _PIPELINE
+    with _PIPELINE_LOCK:
+        if _PIPELINE is None or _PIPELINE.depth != depth:
+            if _PIPELINE is not None:
+                _PIPELINE.stop()
+            _PIPELINE = _DispatchPipeline(depth)
+        return _PIPELINE
+
+
+def pipeline_state() -> dict:
+    """Pipeline snapshot for guard.state() / status surfaces."""
+    with _PIPELINE_LOCK:
+        pipe = _PIPELINE
+    return {
+        "depth": dispatch_depth(),
+        "in_flight": pipe.in_flight() if pipe is not None else 0,
+        "active": pipe is not None,
+    }
 
 
 def _e_bucket(e: int) -> int:
@@ -105,7 +202,10 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
         # inert padded step costs ~us; a fresh XLA compile costs seconds)
         p_pad = max(32, _e_bucket(max(
             lanes[i].batch.ask_cpu.shape[0] for i in idxs)))
-        metrics.sample_ms("nomad.solver.batch_lanes", float(e_real))
+        # gauge, not sample_ms: this is a lane COUNT; recording it
+        # through the millisecond sampler made dashboards read "lanes"
+        # as a latency series
+        metrics.sample("nomad.solver.batch_lanes", float(e_real))
         padded = {i: _pad_placement_axis(lanes[i].batch, p_pad)
                   for i in idxs}
 
@@ -145,7 +245,9 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
         t0 = time.perf_counter()
         out = _dispatch(const, init, batch, spread_alg, dtype_name,
                         use_mesh, ptab=ptab, pinit=pinit,
-                        wave=lanes[idxs[0]].wavefront_ok())
+                        wave=lanes[idxs[0]].wavefront_ok(),
+                        cache_version=getattr(lanes[idxs[0]],
+                                              "table_version", None))
         dt_ms = (time.perf_counter() - t0) * 1e3
         metrics.sample_ms("nomad.solver.dispatch", dt_ms)
         if dt_ms > 1000.0:
@@ -173,7 +275,8 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
 
 
 def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
-              use_mesh: bool, ptab=None, pinit=None, wave: bool = False):
+              use_mesh: bool, ptab=None, pinit=None, wave: bool = False,
+              cache_version=None):
     """One solve_eval_batch[_preempt] call; shards over an (evals, nodes)
     mesh when multiple devices are attached and the shapes divide the
     mesh (non-preempt path only; preemption tables stay single-device).
@@ -191,12 +294,12 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         return solve_lane_fused(const, init, batch, ptab, pinit,
                                 spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True,
-                                wave=wave)
+                                wave=wave, cache_version=cache_version)
     if wave:
         metrics.incr("nomad.solver.wavefront_dispatches")
         return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True,
-                                wave=True)
+                                wave=True, cache_version=cache_version)
     metrics.incr("nomad.solver.dense_dispatches")
 
     E = const.cpu_cap.shape[0]
@@ -222,7 +325,8 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
             n_yielded.astype(scores.dtype)[None]], axis=0))
         return combined[0], combined[1], combined[2]
     return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
-                            dtype_name=dtype_name, batched=True)
+                            dtype_name=dtype_name, batched=True,
+                            cache_version=cache_version)
 
 
 def _cross_lane_fixpoint(lanes: List[PackedLane], results: List,
@@ -411,19 +515,33 @@ def _resolve_lane_conflicts(lane, res, conflicted, accepted_own,
 class SolveBarrier:
     """Rendezvous point for one batch of eval threads.
 
-    Threads call solve() (blocking) or done() (on exit). The LAST thread to
-    arrive -- when arrivals + finished == participants -- performs the fused
-    dispatch for everyone and wakes them (baton-passing, no extra
-    dispatcher thread)."""
+    Threads call solve() (blocking) or done() (on exit). When arrivals +
+    finished == participants the batch dispatches:
+
+      - depth 1 (NOMAD_TPU_DISPATCH_DEPTH=1, the kill switch): the LAST
+        thread to arrive performs the fused dispatch for everyone and
+        wakes them (baton-passing, the pre-pipeline behavior);
+      - depth > 1 (default): the batch is handed to the process-global
+        dispatch pipeline and the arriving thread joins the waiters.
+        Up to ``depth`` fused dispatches run in flight (each under its
+        OWN guard.run_dispatch watchdog), so a later generation's host
+        packing/transfer overlaps an earlier one's device execution.
+        Completions apply in GENERATION ORDER: the cross-lane fixpoint
+        ledger charges generation g before g+1 even when g+1's device
+        work finishes first."""
 
     def __init__(self, participants: int, use_mesh: bool = True,
-                 e_pad_hint: int = 0):
+                 e_pad_hint: int = 0, depth: Optional[int] = None):
         self._cv = threading.Condition()
         self._participants = participants
         self._finished = 0
         self._waiting: List[Tuple[PackedLane, dict]] = []
         self._use_mesh = use_mesh
         self._generation = 0
+        self._depth = dispatch_depth() if depth is None else max(1, depth)
+        # generation-ordered completion for the pipelined mode
+        self._complete_cv = threading.Condition()
+        self._next_complete = 1
         # pin wave groups' eval axis to the worker's CONFIGURED width, not
         # the momentary batch size: dequeue sizes vary per iteration and
         # every fresh E bucket is a fresh XLA program
@@ -448,14 +566,19 @@ class SolveBarrier:
             self._waiting.append((lane, cell))
             if self._ready_locked():
                 self._dispatch_locked()
-            else:
+            while "result" not in cell and "error" not in cell:
                 gen = self._generation
-                while "result" not in cell and "error" not in cell:
-                    if not self._cv.wait(timeout=BARRIER_TIMEOUT_S):
-                        # straggler safety valve: dispatch what we have
-                        if self._generation == gen:
-                            self._dispatch_locked()
-                        break
+                if not self._cv.wait(timeout=BARRIER_TIMEOUT_S):
+                    # Straggler safety valve: if OUR lane is still queued
+                    # (no dispatch consumed it), dispatch what we have
+                    # rather than wedge. Either way the cell is
+                    # re-checked under the condvar -- the old code broke
+                    # out of the loop here and could read cell["result"]
+                    # before any dispatch had set it when another
+                    # generation raced the timeout.
+                    if (self._generation == gen
+                            and any(c is cell for _, c in self._waiting)):
+                        self._dispatch_locked()
             if "error" in cell:
                 raise cell["error"]
             return cell["result"]
@@ -469,7 +592,17 @@ class SolveBarrier:
         batch = self._waiting
         self._waiting = []
         self._generation += 1
+        gen = self._generation
         lanes = [lane for lane, _ in batch]
+
+        if self._depth > 1:
+            # async: hand the generation to the pipeline; the caller
+            # (an eval thread) falls back into its cv.wait loop and is
+            # woken by the completion. notify_all() is deferred to the
+            # completion path.
+            _get_pipeline(self._depth).submit(
+                functools.partial(self._dispatch_job, gen, batch, lanes))
+            return
 
         def solve_batch():
             results = fuse_and_solve(lanes, use_mesh=self._use_mesh,
@@ -491,7 +624,79 @@ class SolveBarrier:
             for _, cell in batch:
                 cell["error"] = e
         finally:
+            with self._complete_cv:
+                self._next_complete = gen + 1
             self._cv.notify_all()
+
+    def _dispatch_job(self, gen: int, batch, lanes) -> None:
+        """One in-flight generation, on a pipeline thread: fused
+        dispatch under its own watchdog, then generation-ordered
+        fixpoint + wakeup. Every cell gets exactly one result-or-error,
+        no matter what raises where."""
+        results = None
+        err: Optional[Exception] = None
+        try:
+            from .guard import run_dispatch
+            results = run_dispatch(
+                lambda: fuse_and_solve(lanes, use_mesh=self._use_mesh,
+                                       e_pad_hint=self._e_pad_hint),
+                label="solver.batch")
+        except Exception as e:  # noqa: BLE001 -- waiters must not strand
+            err = e
+        # Ordered-completion section: generation g's ledger charges land
+        # before g+1's. A started job always finishes (the watchdog
+        # bounds its device work), so the predecessor wait terminates;
+        # the timeout is a last-resort anti-wedge, not a normal path.
+        deadline = time.monotonic() + max(
+            60.0, 2.0 * _barrier_order_timeout())
+        with self._complete_cv:
+            while self._next_complete != gen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    from ..server.logbroker import log as _log
+                    _log("error", "solver",
+                         f"dispatch generation {gen} gave up waiting for "
+                         f"generation {self._next_complete} to complete; "
+                         "proceeding out of order")
+                    break
+                self._complete_cv.wait(remaining)
+        # only pay a second watchdog when the fixpoint can actually do
+        # work (its own early-return conditions); its re-solves are real
+        # device dispatches and deserve the same deadline as the fuse
+        fixpoint_needed = (
+            os.environ.get("NOMAD_TPU_BATCH_FIXPOINT", "1") != "0"
+            and (len(lanes) >= 2 or bool(self._ledger)))
+        try:
+            if err is None and fixpoint_needed:
+                try:
+                    from .guard import run_dispatch
+                    run_dispatch(
+                        lambda: _cross_lane_fixpoint(lanes, results,
+                                                     self._ledger),
+                        label="solver.batch.fixpoint")
+                except Exception as e:  # noqa: BLE001 -- same contract
+                    err = e
+        finally:
+            with self._cv:
+                for i, (_lane, cell) in enumerate(batch):
+                    if err is not None:
+                        cell["error"] = err
+                    else:
+                        cell["result"] = results[i]
+                self._cv.notify_all()
+            with self._complete_cv:
+                if self._next_complete == gen:
+                    self._next_complete = gen + 1
+                self._complete_cv.notify_all()
+
+
+def _barrier_order_timeout() -> float:
+    """Bound on how long a pipelined generation waits for its
+    predecessor before proceeding out of order (predecessors are
+    watchdog-bounded, so this only fires on a bug)."""
+    from .guard import dispatch_deadline_s
+    d = dispatch_deadline_s()
+    return d if d > 0 else 30.0
 
 
 def make_solve_hook(barrier: SolveBarrier):

@@ -853,20 +853,29 @@ _FUSED_CACHE: dict = {}
 
 
 def _fuse_trees(trees):
-    """Flatten trees and group non-empty leaves by (dtype, shape).
-    Returns (stacked buffers, per-leaf meta, treedef, group keys)."""
-    leaves, treedef = jax.tree_util.tree_flatten(trees)
-    groups: dict = {}
+    """Flatten trees and group non-empty leaves by (tree-class, dtype,
+    shape). Returns (stacked buffers, per-leaf meta, treedef, group
+    keys). The tree-class marker (0 = the NodeConst tree, 1 = the
+    mutable init/batch/preempt trees) keeps fleet-constant leaves in
+    their OWN stacked buffers even when a usage leaf shares dtype+shape
+    (cpu_cap vs used_cpu): the device-resident const cache can then
+    pin the const buffers across dispatches while the delta buffers
+    ship fresh every time."""
     metas = []
-    for leaf in leaves:
-        arr = np.asarray(leaf)
-        if arr.size == 0:
-            metas.append(("zero", arr.shape, arr.dtype.str))
-            continue
-        key = (arr.dtype.str, arr.shape)
-        rows = groups.setdefault(key, [])
-        metas.append(("buf", key, len(rows)))
-        rows.append(arr)
+    groups: dict = {}
+    per_tree = [jax.tree_util.tree_flatten(t) for t in trees]
+    treedef = jax.tree_util.tree_structure(tuple(trees))
+    for ti, (leaves, _) in enumerate(per_tree):
+        tclass = 0 if ti == 0 else 1
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            if arr.size == 0:
+                metas.append(("zero", arr.shape, arr.dtype.str))
+                continue
+            key = (tclass, arr.dtype.str, arr.shape)
+            rows = groups.setdefault(key, [])
+            metas.append(("buf", key, len(rows)))
+            rows.append(arr)
     group_keys = tuple(groups.keys())
     stacked = [np.stack(groups[k]) for k in group_keys]
     return stacked, tuple(metas), treedef, group_keys
@@ -918,23 +927,28 @@ def _make_fused_fn(metas, treedef, group_keys, spread_alg: bool,
 
 def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
                      spread_alg: bool, dtype_name: str,
-                     batched: bool = False, wave: bool = False):
+                     batched: bool = False, wave: bool = False,
+                     cache_version=None):
     """Solve with minimal transfers: returns host-side numpy
     (chosen int64, scores, n_yielded int64[, evict_rows]). When ``batched``
     every leaf carries a leading eval axis and outputs do too. ``wave``
     routes through the wavefront path (caller must have checked
     eligibility): host-side O(N) precompute + compact-table device scan
     (solve_lane_wave). Stacking chosen/n_yielded through the score dtype
-    is exact: node indexes and yield counts are < 2^24."""
+    is exact: node indexes and yield counts are < 2^24. ``cache_version``
+    tags const-tree buffers in the device-resident cache with the
+    packing snapshot's node_table_index (solver/constcache.py)."""
     from .cache import enable_compile_cache
     enable_compile_cache()
     if wave and ptab is None:
         return solve_lane_wave(const, init, batch, spread_alg=spread_alg,
-                               dtype_name=dtype_name, batched=batched)
+                               dtype_name=dtype_name, batched=batched,
+                               cache_version=cache_version)
     if wave and ptab is not None:
         return solve_lane_wave_preempt(
             const, init, batch, ptab, pinit, spread_alg=spread_alg,
-            dtype_name=dtype_name, batched=batched)
+            dtype_name=dtype_name, batched=batched,
+            cache_version=cache_version)
     trees = ((const, init, batch) if ptab is None
              else (const, init, batch, ptab, pinit))
     stacked, metas, treedef, group_keys = _fuse_trees(trees)
@@ -945,7 +959,12 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
         fn = _make_fused_fn(metas, treedef, group_keys, spread_alg,
                             dtype_name, ptab is not None, batched)
         _FUSED_CACHE[sig] = fn
-    buffers = jax.device_put(stacked)
+    from .constcache import device_put_cached
+    # only const-tree buffers (group class 0) are pinned: init/batch
+    # deltas change every dispatch and would churn the LRU
+    buffers, _ = device_put_cached(
+        stacked, version=cache_version,
+        cacheable=[k[0] == 0 for k in group_keys])
     out = fn(*buffers)
     # the 3-way output axis is leading in both forms: (3, P) or (3, E, P)
     if ptab is not None:
@@ -2438,7 +2457,7 @@ _WAVE_PREEMPT_FNS: dict = {}
 
 def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
                             spread_alg: bool, dtype_name: str,
-                            batched: bool = False):
+                            batched: bool = False, cache_version=None):
     """Windowed-preemption solve with host precompute + compact transfer;
     returns host numpy (chosen int64, scores, n_yielded int64,
     evict_rows (P, A) bool), shaped like solve_lane_fused's preempt
@@ -2509,7 +2528,8 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
         _WAVE_PREEMPT_FNS[key] = fn
     cm, cd, sf, si, pn, c0 = _put_eval_sharded(
         batched, compact.shape[0],
-        (compact, cand, scal_f, scal_i, pen, counts0))
+        (compact, cand, scal_f, scal_i, pen, counts0),
+        cache_version=cache_version)
     combined, ev = jax.device_get(fn(cm, cd, sf, si, pn, c0))
     combined = combined[..., :P]
     ev = ev[..., :P, :]
@@ -2517,19 +2537,35 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
             combined[2].astype(np.int64), np.asarray(ev))
 
 
-def _put_eval_sharded(batched: bool, e_dim: int, trees):
+def _put_eval_sharded(batched: bool, e_dim: int, trees,
+                      cache_version=None):
     """Device-put a tuple of (possibly nested) arrays, sharding the
     leading eval axis across ALL attached devices when it divides the
     device count. The fused eval axis is embarrassingly data-parallel:
     each chip runs its lanes' scans independently (no collectives;
     outputs gather on fetch). Shared by the wave and wave-preempt
-    dispatch paths so their sharding gates can't diverge."""
+    dispatch paths so their sharding gates can't diverge.
+
+    The single-device path routes through the device-resident const
+    cache (solver/constcache.py): compact tables that repeat across
+    barrier generations of one snapshot ship once and stay pinned,
+    keyed by content and tagged with ``cache_version`` (the packing
+    snapshot's node_table_index). The sharded path ships fresh -- the
+    cache stores unsharded buffers -- but still reports its bytes so
+    ``nomad.solver.dispatch_bytes`` means one thing everywhere."""
+    from .constcache import device_put_cached, note_dispatch_bytes
+
     if not (batched and jax.device_count() > 1
             and e_dim % jax.device_count() == 0):
-        return jax.device_put(trees)
+        leaves, treedef = jax.tree_util.tree_flatten(trees)
+        buffers, _ = device_put_cached(leaves, version=cache_version)
+        return jax.tree_util.tree_unflatten(treedef, buffers)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
     mesh = Mesh(np.asarray(jax.devices()), ("evals",))
     sharding = NamedSharding(mesh, PartitionSpec("evals"))
+    note_dispatch_bytes(sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(trees)))
     return tuple(
         jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
         for t in trees)
@@ -2539,7 +2575,8 @@ _WAVE_COMPACT_FNS: dict = {}
 
 
 def solve_lane_wave(const, init, batch, *, spread_alg: bool,
-                    dtype_name: str, batched: bool = False):
+                    dtype_name: str, batched: bool = False,
+                    cache_version=None):
     """Wavefront solve with host precompute + compact transfer; returns
     host numpy (chosen int64, scores, n_yielded int64), shaped like
     solve_lane_fused's non-preempt outputs. The slot-buffer width B is
@@ -2638,7 +2675,8 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
                                   ny.astype(scores.dtype)])
         _WAVE_COMPACT_FNS[key] = fn
     cm, sf, si, pn, spd = _put_eval_sharded(
-        batched, compact.shape[0], (compact, scal_f, scal_i, pen, sp))
+        batched, compact.shape[0], (compact, scal_f, scal_i, pen, sp),
+        cache_version=cache_version)
     combined = jax.device_get(fn(cm, sf, si, pn, spd))
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]

@@ -1,0 +1,246 @@
+"""Device-resident constant cache: stop re-shipping the fleet tables.
+
+Round 5's bench isolated the dispatch path's real tax
+(BENCH_NOTES_r05.md): the chip solves the 32x2000 headline batch in
+~1.2ms, but every blocking dispatch pays ~68ms of tunnel RTT plus
+~2.4MB of lane-table transfer at ~40MB/s. Most of those bytes are the
+same bytes every time -- NodeMatrix-derived caps/feasibility/spread
+columns that only change when the node table does, and usage columns
+that repeat across the barrier generations of one snapshot. CvxCluster
+(PAPERS.md) gets its 100-1000x by keeping the problem matrices resident
+and streaming only deltas; this is that move for the dispatch path.
+
+Mechanism: a content-addressed cache of device-resident buffers. Before
+a dispatch transfers an input array, its fingerprint (BLAKE2b over
+dtype/shape/bytes) is looked up; a hit reuses the pinned device buffer
+(zero bytes shipped), a miss pays one ``jax.device_put`` and pins the
+result. Content addressing makes the cache self-validating -- a stale
+entry can never be USED for changed data, it can only sit resident --
+so the version tags (the state store's ``node_table_index``, see
+state/store.py StateSnapshot) exist purely for prompt memory hygiene:
+a node-table write drops entries uploaded under older fleet versions,
+and an LRU bound (entries + resident bytes) caps what one process pins
+on device. The circuit breaker (solver/guard.py) drops everything on a
+trip or recovery: buffers created through a wedged-then-recovered
+transport are not trusted.
+
+Accounting: every dispatch path reports bytes actually shipped through
+``note_dispatch_bytes`` -> the ``nomad.solver.dispatch_bytes`` gauge +
+``nomad.solver.dispatch_bytes_total`` counter, and hits/misses ride
+``nomad.solver.const_cache_{hit,miss}`` -- so the transfer cut is
+visible in /v1/agent/self, ``operator solver status`` and bench
+artifacts rather than inferred.
+
+Kill switch: NOMAD_TPU_CONST_CACHE=0 (every dispatch ships everything,
+exactly the pre-cache behavior). Bounds: NOMAD_TPU_CONST_CACHE_ENTRIES
+(default 64), NOMAD_TPU_CONST_CACHE_MB (default 256). Arrays smaller
+than NOMAD_TPU_CONST_CACHE_MIN_BYTES (default 4096) are always shipped
+fresh -- they ARE the delta traffic the design wants on the wire, and
+caching them would churn the LRU for nothing.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_CACHE: "OrderedDict[bytes, _Entry]" = OrderedDict()
+_STATS = {
+    "hits": 0,
+    "misses": 0,
+    "bytes_shipped_total": 0,
+    "bytes_saved_total": 0,
+    "invalidations": 0,
+    "evictions": 0,
+    "resident_bytes": 0,
+}
+
+
+class _Entry:
+    __slots__ = ("buf", "nbytes", "version")
+
+    def __init__(self, buf, nbytes: int, version: Optional[int]):
+        self.buf = buf              # the pinned jax.Array
+        self.nbytes = nbytes
+        self.version = version      # node_table_index tag (hygiene only)
+
+
+def enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_CONST_CACHE", "1") != "0"
+
+
+def _max_entries() -> int:
+    try:
+        return max(1, int(os.environ.get(
+            "NOMAD_TPU_CONST_CACHE_ENTRIES", "64")))
+    except ValueError:
+        return 64
+
+
+def _max_bytes() -> int:
+    try:
+        return max(1, int(float(os.environ.get(
+            "NOMAD_TPU_CONST_CACHE_MB", "256")) * 1024 * 1024))
+    except ValueError:
+        return 256 * 1024 * 1024
+
+
+def _min_bytes() -> int:
+    try:
+        return int(os.environ.get("NOMAD_TPU_CONST_CACHE_MIN_BYTES",
+                                  "4096"))
+    except ValueError:
+        return 4096
+
+
+def _fingerprint(arr: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str((arr.dtype.str, arr.shape)).encode())
+    h.update(np.ascontiguousarray(arr).data)
+    return h.digest()
+
+
+def device_put_cached(arrays: Sequence[np.ndarray],
+                      version: Optional[int] = None,
+                      cacheable: Optional[Sequence[bool]] = None,
+                      ) -> Tuple[List, int]:
+    """Transfer ``arrays`` host->device, reusing pinned device buffers
+    for repeated content. Returns (buffers, bytes_shipped). ``version``
+    tags fresh entries with the node-table index they were uploaded
+    under (hygiene eviction on table writes); ``cacheable`` masks
+    per-array eligibility (the fused transport marks only const-tree
+    buffers, so churning usage deltas never evict resident fleet
+    tables)."""
+    import jax
+
+    from ..server.telemetry import metrics
+
+    arrays = [np.asarray(a) for a in arrays]
+    if not enabled():
+        shipped = sum(a.nbytes for a in arrays)
+        note_dispatch_bytes(shipped)
+        return list(jax.device_put(arrays)) if arrays else [], shipped
+
+    min_b = _min_bytes()
+    buffers: List = [None] * len(arrays)
+    miss_idx: List[int] = []
+    miss_fps: List[Optional[bytes]] = []
+    shipped = 0
+    hits = misses = saved = 0
+    with _LOCK:
+        for i, arr in enumerate(arrays):
+            if arr.nbytes < min_b or (
+                    cacheable is not None and not cacheable[i]):
+                miss_idx.append(i)
+                miss_fps.append(None)           # shipped, never cached
+                shipped += arr.nbytes
+                continue
+            fp = _fingerprint(arr)
+            ent = _CACHE.get(fp)
+            if ent is not None:
+                _CACHE.move_to_end(fp)
+                buffers[i] = ent.buf
+                hits += 1
+                saved += ent.nbytes
+            else:
+                miss_idx.append(i)
+                miss_fps.append(fp)
+                misses += 1
+                shipped += arr.nbytes
+    if miss_idx:
+        puts = jax.device_put([arrays[i] for i in miss_idx])
+        with _LOCK:
+            for j, i in enumerate(miss_idx):
+                buffers[i] = puts[j]
+                fp = miss_fps[j]
+                if fp is None:
+                    continue
+                _CACHE[fp] = _Entry(puts[j], arrays[i].nbytes, version)
+                _STATS["resident_bytes"] += arrays[i].nbytes
+            _evict_over_bounds_locked()
+    with _LOCK:
+        _STATS["hits"] += hits
+        _STATS["misses"] += misses
+        _STATS["bytes_shipped_total"] += shipped
+        _STATS["bytes_saved_total"] += saved
+    if hits:
+        metrics.incr("nomad.solver.const_cache_hit", hits)
+    if misses:
+        metrics.incr("nomad.solver.const_cache_miss", misses)
+    note_dispatch_bytes(shipped)
+    return buffers, shipped
+
+
+def _evict_over_bounds_locked() -> None:
+    max_e, max_b = _max_entries(), _max_bytes()
+    while _CACHE and (len(_CACHE) > max_e
+                      or _STATS["resident_bytes"] > max_b):
+        _, ent = _CACHE.popitem(last=False)
+        _STATS["resident_bytes"] -= ent.nbytes
+        _STATS["evictions"] += 1
+
+
+def note_dispatch_bytes(n: int) -> None:
+    """Record one dispatch's actual host->device payload (bytes that hit
+    the wire AFTER cache hits are subtracted). Shared by the fused,
+    wave and mesh-sharded transports so the metric means one thing."""
+    from ..server.telemetry import metrics
+
+    metrics.sample("nomad.solver.dispatch_bytes", float(n))
+    metrics.incr("nomad.solver.dispatch_bytes_total", int(n))
+
+
+def note_node_table_write(table_index: int) -> None:
+    """Node-table write hook (state/store.py): drop buffers uploaded
+    under an older fleet version. Correctness never depends on this
+    (content addressing self-validates); it keeps dead fleet versions
+    from squatting on device memory until LRU pressure finds them."""
+    if not _CACHE:
+        return
+    with _LOCK:
+        stale = [fp for fp, ent in _CACHE.items()
+                 if ent.version is not None and ent.version < table_index]
+        for fp in stale:
+            ent = _CACHE.pop(fp)
+            _STATS["resident_bytes"] -= ent.nbytes
+        if stale:
+            _STATS["invalidations"] += 1
+
+
+def invalidate_all(reason: str = "") -> None:
+    """Drop every resident buffer. Wired to breaker trips/recoveries
+    (solver/guard.py): buffers that crossed a wedged-then-recovered
+    transport are not trusted, and a fresh upload is cheap next to the
+    outage that just ended."""
+    with _LOCK:
+        had = bool(_CACHE)
+        _CACHE.clear()
+        _STATS["resident_bytes"] = 0
+        if had:
+            _STATS["invalidations"] += 1
+    if had and reason:
+        from ..server.logbroker import log as _log
+        _log("info", "solver",
+             f"const cache invalidated ({reason}); fleet tables "
+             "re-upload on next dispatch")
+
+
+def stats() -> dict:
+    with _LOCK:
+        out = dict(_STATS)
+        out["entries"] = len(_CACHE)
+    out["enabled"] = enabled()
+    return out
+
+
+def _reset_for_tests() -> None:
+    with _LOCK:
+        _CACHE.clear()
+        _STATS.update(hits=0, misses=0, bytes_shipped_total=0,
+                      bytes_saved_total=0, invalidations=0, evictions=0,
+                      resident_bytes=0)

@@ -267,6 +267,11 @@ def _trip_locked(kind: str) -> None:
     from ..server.logbroker import log as _log      # first backoff
     from ..server.telemetry import metrics
     metrics.incr("nomad.solver.breaker_trips")
+    # drop device-resident const buffers: whatever wedged the transport
+    # may have invalidated them, and nothing should dispatch against
+    # them until a recovery probe passes anyway
+    from .constcache import invalidate_all
+    invalidate_all("breaker trip")
     _log("error", "solver.guard",
          f"dispatch breaker OPEN after "
          f"{_BREAKER['consecutive_failures']} consecutive {kind}s; "
@@ -317,6 +322,10 @@ def _close_breaker_locked(why: str) -> None:
     from ..server.logbroker import log as _log
     from ..server.telemetry import metrics
     metrics.incr("nomad.solver.breaker_recoveries")
+    # re-open with a clean slate: buffers uploaded through the
+    # pre-wedge transport are not trusted across a recovery
+    from .constcache import invalidate_all
+    invalidate_all("breaker recovery")
     _log("warn", "solver.guard",
          f"dispatch breaker CLOSED ({why}); dense dispatch re-enabled")
 
@@ -520,7 +529,20 @@ def state() -> dict:
         "ok": counters.get("nomad.solver.dispatch_ok", 0),
         "timeout": counters.get("nomad.solver.dispatch_timeout", 0),
         "error": counters.get("nomad.solver.dispatch_error", 0),
+        "bytes_total": counters.get(
+            "nomad.solver.dispatch_bytes_total", 0),
     }
+    # transfer layer: device-resident const cache + async pipeline
+    # (lazy imports -- state() must stay callable without pulling the
+    # dispatch stack into light callers)
+    from .constcache import stats as _cc_stats
+    snap["const_cache"] = _cc_stats()
+    try:
+        from .batch import pipeline_state
+        snap["dispatch_pipeline"] = pipeline_state()
+    except Exception:  # noqa: BLE001 -- status must never fail the agent
+        snap["dispatch_pipeline"] = {"depth": 1, "in_flight": 0,
+                                     "active": False}
     snap["degraded"] = bool(
         (snap["checked"] and not snap["ok"])
         or breaker["state"] != BREAKER_CLOSED)

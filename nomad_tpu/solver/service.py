@@ -58,11 +58,11 @@ class PackedLane:
 
     __slots__ = ("service", "tg", "places", "nodes", "order", "const",
                  "init", "batch", "dtype_name", "spread_alg", "ptab",
-                 "pinit", "cand_allocs", "_wave")
+                 "pinit", "cand_allocs", "table_version", "_wave")
 
     def __init__(self, service, tg, places, nodes, order, const, init,
                  batch, dtype_name, spread_alg, ptab=None, pinit=None,
-                 cand_allocs=None):
+                 cand_allocs=None, table_version=None):
         self.service = service
         self.tg = tg
         self.places = places
@@ -78,6 +78,9 @@ class PackedLane:
         self.ptab = ptab
         self.pinit = pinit
         self.cand_allocs = cand_allocs
+        # node-table version of the packing snapshot: tags this lane's
+        # const buffers in the device-resident cache (constcache.py)
+        self.table_version = table_version
         self._wave = None
 
     def wavefront_ok(self) -> bool:
@@ -250,7 +253,7 @@ def dispatch_lane(lane: PackedLane):
     return solve_lane_fused(
         lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
         spread_alg=lane.spread_alg, dtype_name=lane.dtype_name,
-        wave=wave)
+        wave=wave, cache_version=lane.table_version)
 
 
 class _DeviceShim:
@@ -537,7 +540,9 @@ class TpuPlacementService:
                 return None
         return PackedLane(self, tg, places, nodes, order, const, init,
                           batch, np.dtype(dtype).name, self.spread_alg,
-                          ptab=ptab, pinit=pinit, cand_allocs=cand_allocs)
+                          ptab=ptab, pinit=pinit, cand_allocs=cand_allocs,
+                          table_version=getattr(
+                              self.ctx.state, "node_table_index", None))
 
     @staticmethod
     def _cands_hold_matching_devices(requests, cand_allocs, ptab) -> bool:

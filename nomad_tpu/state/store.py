@@ -322,6 +322,16 @@ class StateStore:
         for t in tables:
             self._table_index[t] = self._index
         self._snap_cache = None
+        if "nodes" in tables:
+            # fleet tables changed: device-resident const buffers keyed
+            # to older node-table versions are dead weight -- tell the
+            # solver's const cache (solver/constcache.py). Resolved via
+            # sys.modules so a store used without the solver stack never
+            # pays the (jax-importing) solver package import.
+            import sys as _sys
+            cc = _sys.modules.get("nomad_tpu.solver.constcache")
+            if cc is not None:
+                cc.note_node_table_write(self._index)
         self._watch_cond.notify_all()
         return self._index
 

@@ -88,9 +88,11 @@ def test_batched_evals_fuse_into_one_dispatch():
             wait_until(lambda j=job: len(committed_allocs(server, j)) == 3,
                        msg=f"{job.id} placed")
         snap = metrics.snapshot()
-        lanes = snap["samples"].get("nomad.solver.batch_lanes")
-        assert lanes is not None, sorted(snap["samples"])
-        assert lanes["max_ms"] >= 2.0, lanes   # >= 2 lanes fused at least once
+        # batch_lanes is a COUNT and now rides the unit-free gauge
+        # registry (satellite fix: it used to render as milliseconds)
+        lanes = snap["gauges"].get("nomad.solver.batch_lanes")
+        assert lanes is not None, sorted(snap["gauges"])
+        assert lanes["max"] >= 2.0, lanes   # >= 2 lanes fused at least once
         assert snap["counters"]["nomad.scheduler.placements_tpu"] == 12
         # node capacity respected: each node 4000 cpu, mock asks 500/alloc
         by_node = {}

@@ -30,12 +30,15 @@ N_NODES, COUNT, SEED = 12, 6, 7
 
 @pytest.fixture(autouse=True)
 def clean_slate():
+    from nomad_tpu.solver import constcache
     guard._reset_for_tests()
     faults._reset_for_tests()
+    constcache._reset_for_tests()
     metrics.reset()
     yield
     faults._reset_for_tests()
     guard._reset_for_tests()
+    constcache._reset_for_tests()
 
 
 def _host_placements():
@@ -346,6 +349,115 @@ def test_bench_stamp_reports_breaker_degraded(monkeypatch):
     guard.reset_breaker()
     stamp = dispatch_health_stamp("tpu")
     assert stamp["degraded"] is False
+
+
+# ----------------------------------------------------------------------
+# Pipelined dispatch (NOMAD_TPU_DISPATCH_DEPTH > 1) under injected
+# faults: every waiter gets exactly one result-or-fallback (no lost
+# evals, no double-wake), and the const cache invalidates cleanly
+# across a breaker trip/recovery cycle.
+
+
+def test_pipelined_dispatch_fault_every_waiter_exactly_one_outcome(
+        monkeypatch):
+    """solver.dispatch armed with depth>1 in flight: several concurrent
+    barrier generations fail, and each waiting eval thread must observe
+    EXACTLY one outcome (DispatchFailed -> host fallback), never a lost
+    wakeup, never two."""
+    import threading
+
+    from nomad_tpu.solver import batch as batch_mod
+    from nomad_tpu.solver.batch import SolveBarrier
+
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_THRESHOLD", "100")
+    monkeypatch.setenv("NOMAD_TPU_BATCH_FIXPOINT", "0")
+
+    class Lane:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def fuse_key(self):
+            return ("chaos",)
+
+    orig = batch_mod.fuse_and_solve
+
+    def faulted_fuse(lanes, use_mesh=True, **kw):
+        faults.fire("solver.dispatch")
+        return [("ok", ln.tag) for ln in lanes]
+
+    batch_mod.fuse_and_solve = faulted_fuse
+    faults.arm("solver.dispatch", "error")
+    outcomes = []
+    outcomes_lock = threading.Lock()
+    try:
+        # 3 generations across 3 barriers, depth 3: all in flight at once
+        barriers = [SolveBarrier(participants=2, depth=3)
+                    for _ in range(3)]
+
+        def worker(b, tag):
+            try:
+                res = barriers[b].solve(Lane(tag))
+                with outcomes_lock:
+                    outcomes.append(("result", tag, res))
+            except guard.DispatchFailed:
+                with outcomes_lock:
+                    outcomes.append(("fallback", tag, None))
+            except Exception as e:  # noqa: BLE001 -- the assertion
+                with outcomes_lock:
+                    outcomes.append(("unexpected", tag, e))
+
+        threads = [threading.Thread(target=worker, args=(b, f"{b}-{k}"))
+                   for b in range(3) for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not any(t.is_alive() for t in threads), "waiter wedged"
+        kinds = sorted(o[0] for o in outcomes)
+        tags = sorted(o[1] for o in outcomes)
+        # exactly one outcome per waiter, all fallbacks, none doubled
+        assert kinds == ["fallback"] * 6, outcomes
+        assert tags == sorted(f"{b}-{k}" for b in range(3)
+                              for k in range(2))
+    finally:
+        batch_mod.fuse_and_solve = orig
+
+
+def test_const_cache_invalidates_across_breaker_trip_and_recovery(
+        monkeypatch):
+    """Fill the device-resident cache, trip the breaker, recover: the
+    cache must drop its buffers on BOTH edges and work again after."""
+    import numpy as np
+
+    from nomad_tpu.solver import constcache
+
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "30")
+    _fast_probe_pass(monkeypatch)
+
+    table = np.full(4096, 3.0, dtype=np.float32)
+    constcache.device_put_cached([table], version=1)
+    assert constcache.stats()["entries"] == 1
+
+    for _ in range(guard._breaker_threshold()):
+        guard.record_dispatch_failure("timeout")
+    assert guard.breaker_state()["state"] == guard.BREAKER_OPEN
+    st = constcache.stats()
+    assert st["entries"] == 0, "trip must drop resident buffers"
+    assert st["invalidations"] >= 1
+
+    # buffers uploaded while the breaker is open get dropped again on
+    # the recovery edge (reprobe -> reset path closes the breaker)
+    constcache.device_put_cached([table], version=2)
+    guard.reset_breaker()
+    assert guard.breaker_state()["state"] == guard.BREAKER_CLOSED
+    st = constcache.stats()
+    assert st["entries"] == 0, "recovery must re-baseline the cache"
+    assert st["invalidations"] >= 2
+
+    # and the cache works normally after the cycle
+    _, s1 = constcache.device_put_cached([table], version=3)
+    _, s2 = constcache.device_put_cached([table], version=3)
+    assert s1 == table.nbytes and s2 == 0
 
 
 # ----------------------------------------------------------------------

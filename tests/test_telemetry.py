@@ -32,7 +32,22 @@ def test_series_stats():
     assert s["p50_ms"] == 3.0
     assert snap["counters"]["c"] == 3
     t.reset()
-    assert t.snapshot() == {"samples": {}, "counters": {}}
+    assert t.snapshot() == {"samples": {}, "gauges": {}, "counters": {}}
+
+
+def test_gauge_series_are_unit_free():
+    """Counts (lanes, widths, depths, bytes) must not render with _ms
+    keys: they ride the gauge registry (satellite fix for
+    nomad.solver.batch_lanes reading as a latency)."""
+    t = Telemetry()
+    for v in [2.0, 4.0, 8.0]:
+        t.sample("nomad.test.lanes", v)
+    g = t.snapshot()["gauges"]["nomad.test.lanes"]
+    assert g["count"] == 3
+    assert g["min"] == 2.0 and g["max"] == 8.0
+    assert not any(k.endswith("_ms") for k in g), sorted(g)
+    # gauge and timer namespaces are independent
+    assert "nomad.test.lanes" not in t.snapshot()["samples"]
 
 
 def test_measure_context_manager():
@@ -65,10 +80,11 @@ def test_scheduler_series_emitted_end_to_end():
         for name in ("nomad.plan.evaluate", "nomad.plan.submit",
                      "nomad.worker.wait_for_index",
                      "nomad.worker.invoke_scheduler_service",
-                     "nomad.broker.eval_wait",
-                     "nomad.plan.queue_depth"):
+                     "nomad.broker.eval_wait"):
             assert name in snap["samples"], (name, sorted(snap["samples"]))
             assert snap["samples"][name]["count"] >= 1
+        # depth/width counts ride the unit-free gauge registry
+        assert snap["gauges"]["nomad.plan.queue_depth"]["count"] >= 1
         assert snap["counters"]["nomad.scheduler.placements_host"] >= 2
         c.stop()
     finally:
