@@ -771,8 +771,24 @@ def main_tier(platform: str, tier: int):
     # tunnel or tripped breaker must never read as a chip result
     from nomad_tpu.benchkit import dispatch_health_stamp
     out.update(dispatch_health_stamp(platform))
+    out["trace_artifact"] = _export_trace_artifact(
+        default=f"BENCH_trace_tier{tier}.json")
     print(json.dumps(out), flush=True)
     sys.exit(1 if mismatch else 0)
+
+
+def _export_trace_artifact(default: str):
+    """Ship the eval-span flight recorder next to the BENCH_*.json
+    line (Perfetto/chrome://tracing JSON; BENCH_TRACE_OUT overrides
+    the path, empty disables)."""
+    path = os.environ.get("BENCH_TRACE_OUT", default)
+    if not path:
+        return None
+    from nomad_tpu.benchkit import export_chrome_trace
+    written = export_chrome_trace(path)
+    if written:
+        log(f"bench: eval trace artifact -> {written}")
+    return written
 
 
 def main():
@@ -1085,6 +1101,8 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # explicit degraded verdict + dispatch-layer state
     from nomad_tpu.benchkit import dispatch_health_stamp
     out.update(dispatch_health_stamp(platform))
+    out["trace_artifact"] = _export_trace_artifact(
+        default="BENCH_trace.json")
     print(json.dumps(out), flush=True)
 
 

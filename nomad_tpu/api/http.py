@@ -920,6 +920,34 @@ class ApiHandler(BaseHTTPRequestHandler):
                                                "local"),
                                "status": "alive"},
                 })
+            elif parts[:3] == ["v1", "agent", "trace"] and \
+                    len(parts) in (3, 4):
+                # eval-scoped span flight recorder (server/tracing.py):
+                # list retained traces (?degraded=1&slowest=N), export
+                # them as chrome://tracing JSON (?format=chrome), or
+                # fetch one trace by eval id. agent:read (blanket
+                # /v1/agent gate above).
+                from ..server.tracing import tracer
+                if len(parts) == 4:
+                    tr = tracer.get(parts[3])
+                    if tr is None:
+                        return self._error(
+                            404, f"no trace retained for eval "
+                                 f"{parts[3]!r}")
+                    return self._send(200, tr)
+                if q.get("format", [""])[0] == "chrome":
+                    return self._send(200, tracer.chrome_trace())
+                try:
+                    slowest = int(q.get("slowest", ["0"])[0])
+                    limit = int(q.get("limit", ["50"])[0])
+                except ValueError:
+                    return self._error(400,
+                                       "slowest/limit must be numeric")
+                degraded = q.get("degraded", ["0"])[0] in ("1", "true")
+                self._send(200, {
+                    "traces": tracer.list_traces(
+                        degraded=degraded, slowest=slowest, limit=limit),
+                    "stats": tracer.stats()})
             elif parts == ["v1", "agent", "members"]:
                 serf = getattr(self.nomad, "serf", None)
                 if serf is None:

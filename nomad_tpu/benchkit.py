@@ -81,6 +81,30 @@ def dispatch_health_stamp(platform: str) -> dict:
     }
 
 
+def export_chrome_trace(path: str) -> "str | None":
+    """Write the flight recorder's retained eval traces as a
+    chrome://tracing / Perfetto JSON artifact (the per-eval span view
+    that explains WHERE a bench round's latency went), meant to land
+    next to the BENCH_*.json line. Returns the written path, or None
+    when tracing is off or nothing was retained -- artifact emission
+    must never fail a bench run."""
+    import json
+
+    from .server.tracing import trace_enabled, tracer
+
+    if not trace_enabled():
+        return None
+    doc = tracer.chrome_trace()
+    if not doc["traceEvents"]:
+        return None
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+    except OSError:
+        return None
+    return path
+
+
 def make_fleet(rng: random.Random, h, n_nodes: int,
                racks: int = RACK_COUNT, gpus: bool = False) -> List:
     """Heterogeneous fleet: 3 machine classes, rack + datacenter spread

@@ -655,6 +655,10 @@ def cmd_operator_debug(args) -> int:
     grab("agent-health.json", "/v1/agent/health")
     grab("threads.json", "/v1/agent/pprof/goroutine")
     grab("metrics.json", "/v1/metrics")
+    try:
+        captures["traces.json"] = api.get("/v1/agent/trace", slowest=10)
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["traces.json"] = {"capture_error": repr(e)}
     grab("scheduler-config.json", "/v1/operator/scheduler/configuration")
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
@@ -721,6 +725,94 @@ def cmd_operator_solver(args) -> int:
             print("verdict            = transport healthy but this "
                   "process is wedged: restart the agent to recover")
         print(f"guard ok           = {rep['state']['ok']}")
+    return 0
+
+
+def _render_trace_waterfall(tr: dict, width: int = 48) -> str:
+    """ASCII span waterfall for one eval trace: each span a bar
+    positioned/scaled on the trace's wall-clock extent."""
+    lines = []
+    flag = (f"  DEGRADED({tr.get('degraded_reason')})"
+            if tr.get("degraded") else "")
+    lines.append(f"Eval      {tr.get('eval_id')}")
+    lines.append(f"Status    {tr.get('status')}"
+                 f"  dur={tr.get('dur_ms', 0.0):.2f}ms{flag}")
+    tags = tr.get("tags") or {}
+    if tags:
+        lines.append("Tags      " + " ".join(
+            f"{k}={v}" for k, v in sorted(tags.items())))
+    if tr.get("error"):
+        lines.append(f"Error     {tr['error']}")
+    spans = tr.get("spans") or []
+    if not spans:
+        lines.append("(no spans recorded)")
+        return "\n".join(lines)
+    t0 = min(s["t0"] for s in spans)
+    t1 = max(s["t0"] + s["dur_ms"] / 1e3 for s in spans)
+    total = max(t1 - t0, 1e-9)
+    lines.append("")
+    name_w = min(28, max(len(s["name"]) for s in spans) + 1)
+    for s in sorted(spans, key=lambda s: (s["t0"], -s["dur_ms"])):
+        off = int((s["t0"] - t0) / total * width)
+        off = min(off, width - 1)
+        ln = max(1, round(s["dur_ms"] / 1e3 / total * width))
+        bar = (" " * off + "▇" * min(ln, width - off)).ljust(width)
+        stags = " ".join(f"{k}={v}"
+                         for k, v in sorted(
+                             (s.get("tags") or {}).items()))
+        lines.append(f"  {s['name']:<{name_w}} |{bar}| "
+                     f"{s['dur_ms']:>9.2f}ms  {stags}".rstrip())
+    if tr.get("truncated_spans"):
+        lines.append(f"  ... {tr['truncated_spans']} spans truncated "
+                     "(NOMAD_TPU_TRACE_MAX_SPANS)")
+    return "\n".join(lines)
+
+
+def cmd_operator_trace(args) -> int:
+    """Eval trace forensics (rides GET /v1/agent/trace): fetch one
+    eval's span waterfall, or list/render the slowest or degraded
+    retained traces."""
+    api = _client(args)
+    if args.eval_id:
+        try:
+            tr = api.get(f"/v1/agent/trace/{args.eval_id}")
+        except ApiError as e:
+            print(f"No trace for eval {args.eval_id!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(_render_trace_waterfall(tr))
+        return 0
+    params = {}
+    if args.degraded:
+        params["degraded"] = "1"
+    if args.slowest:
+        params["slowest"] = str(args.slowest)
+    reply = api.get("/v1/agent/trace", **params)
+    traces = reply.get("traces", [])
+    stats = reply.get("stats", {})
+    if not traces:
+        print("No retained traces"
+              + ("" if stats.get("enabled", True)
+                 else " (tracing disabled: NOMAD_TPU_TRACE=0)")
+              + f"; {stats.get('dropped', 0)} dropped/sampled out.")
+        return 0
+    print(_fmt_table(
+        [[t["eval_id"][:16], t.get("tags", {}).get("lane", "-"),
+          f"{t['dur_ms']:.1f}", str(t["spans"]),
+          (t.get("degraded_reason") or
+           ("error" if t.get("error") else "-")), t["status"]]
+         for t in traces],
+        ["Eval", "Lane", "Duration(ms)", "Spans", "Degraded",
+         "Status"]))
+    if args.slowest:
+        # --slowest N renders each returned trace's waterfall in full
+        for t in traces:
+            try:
+                full = api.get(f"/v1/agent/trace/{t['eval_id']}")
+            except ApiError:
+                continue
+            print()
+            print(_render_trace_waterfall(full))
     return 0
 
 
@@ -1005,6 +1097,14 @@ def build_parser() -> argparse.ArgumentParser:
                                                   required=True)
     osol.add_parser("status").set_defaults(fn=cmd_operator_solver)
     osol.add_parser("reprobe").set_defaults(fn=cmd_operator_solver)
+    otr = op.add_parser("trace",
+                        help="eval span-waterfall forensics")
+    otr.add_argument("eval_id", nargs="?", default="")
+    otr.add_argument("--slowest", type=int, default=0,
+                     help="render the N slowest retained traces")
+    otr.add_argument("--degraded", action="store_true",
+                     help="only degraded/errored traces")
+    otr.set_defaults(fn=cmd_operator_trace)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("-log-level", dest="log_level", default="info")

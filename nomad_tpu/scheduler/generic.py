@@ -253,6 +253,8 @@ class GenericScheduler:
         task-group batches are solved in one dense dispatch on the
         accelerator (nomad_tpu/solver/); anything the dense path does not
         model falls back to the host iterator stack per placement."""
+        from ..server.tracing import tracer
+
         tpu_alg = self._tpu_algorithm()
         if tpu_alg:
             places = self._compute_placements_tpu(places)
@@ -263,6 +265,20 @@ class GenericScheduler:
 
         deployment_id = self._deployment_id()
 
+        if places:
+            with tracer.span("sched.feasibility_rank",
+                             places=len(places), tpu_carveout=tpu_alg):
+                self._place_host(places, deployment_id, tpu_alg)
+
+        # Any failures -> blocked eval for the remainder (service only)
+        if self.failed_tg_allocs and not self.batch:
+            self._queue_blocked_eval()
+        return True
+
+    def _place_host(self, places: List[AllocPlaceResult],
+                    deployment_id: str, tpu_alg: bool) -> None:
+        """Host iterator-stack placement loop (the per-place
+        feasibility/rank path the reference runs for everything)."""
         for place in places:
             tg = place.task_group
             # Penalty node: previous alloc's node when rescheduling
@@ -344,11 +360,6 @@ class GenericScheduler:
 
             self.plan.append_alloc(alloc)
 
-        # Any failures -> blocked eval for the remainder (service only)
-        if self.failed_tg_allocs and not self.batch:
-            self._queue_blocked_eval()
-        return True
-
     def _deployment_id(self) -> str:
         """Placements attach to the active deployment of the CURRENT job
         version (reference: generic_sched.go computePlacements
@@ -404,6 +415,8 @@ class GenericScheduler:
         base_nodes = getattr(self, "base_nodes", None) or \
             self.state.ready_nodes_in_pool(self.job.node_pool)
 
+        from ..server.tracing import tracer
+
         for tg_name in order:
             tg_places = groups[tg_name]
             tg = tg_places[0].task_group
@@ -417,11 +430,16 @@ class GenericScheduler:
                 {p.previous_alloc.node_id} if (p.reschedule and
                                                p.previous_alloc) else set()
                 for p in tg_places]
-            if self.solve_hook is not None:
-                solved = self.solve_hook(service, tg, tg_places, base_nodes,
-                                         penalties)
-            else:
-                solved = service.solve(tg, tg_places, base_nodes, penalties)
+            with tracer.span("solver.solve_tg", tg=tg_name,
+                             places=len(tg_places),
+                             batched=self.solve_hook is not None) as _sp:
+                if self.solve_hook is not None:
+                    solved = self.solve_hook(service, tg, tg_places,
+                                             base_nodes, penalties)
+                else:
+                    solved = service.solve(tg, tg_places, base_nodes,
+                                           penalties)
+                _sp.tag(host_fallback=solved is None)
             if solved is None:
                 fallback.extend(tg_places)
                 continue

@@ -71,13 +71,30 @@ class Harness:
     # -- driving -------------------------------------------------------------
     def process(self, factory_name_or_fn, ev: Evaluation):
         """Instantiate the scheduler for the eval type and run it
-        (reference: testing.go Process)."""
+        (reference: testing.go Process). Runs under an eval-scoped
+        trace like the server's workers, so parity harness runs and
+        bench worlds produce the same flight-recorder artifacts."""
+        from ..server.tracing import tracer
+
         snap = self.state.snapshot()
         if callable(factory_name_or_fn):
             sched = factory_name_or_fn(snap, self)
         else:
             sched = new_scheduler(factory_name_or_fn, snap, self)
-        return sched.process(ev)
+        ctx = tracer.begin(ev.id, job=ev.job_id, lane=ev.type,
+                           trigger=ev.triggered_by, source="harness")
+        err = None
+        try:
+            with tracer.activate(ctx), \
+                    tracer.span("harness.process", ctx=ctx):
+                result = sched.process(ev)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            tracer.end(ev.id, status="failed" if err else "complete",
+                       error=err)
+        return result
 
     def assert_eval_status(self, testcase, count: int, status: str) -> None:
         assert len(self.evals) == count, \

@@ -191,8 +191,16 @@ class EvalBroker:
             # time-to-dequeue (reference: eval_broker stats /
             # `nomad.broker.*_ready` age tracking)
             from .telemetry import metrics
-            metrics.sample_ms("nomad.broker.eval_wait",
-                              (time.time() - t_ready) * 1e3)
+            wait_s = time.time() - t_ready
+            metrics.sample_ms("nomad.broker.eval_wait", wait_s * 1e3)
+            # the eval's trace starts here: the wait span is recorded
+            # retroactively from the enqueue timestamp
+            from .tracing import tracer
+            ctx = tracer.begin(ev.id, job=ev.job_id, lane=ev.type,
+                               trigger=ev.triggered_by,
+                               priority=ev.priority)
+            tracer.record("broker.wait", t_ready, wait_s * 1e3, ctx=ctx,
+                          deliveries=self._evals.get(ev.id, 0))
         return ev, token
 
     def dequeue_batch(self, schedulers: List[str], max_k: int,

@@ -22,6 +22,7 @@ from ..structs import (
     NODE_STATUS_DISCONNECTED, NODE_STATUS_DOWN, NODE_STATUS_READY,
 )
 from .telemetry import metrics
+from .tracing import tracer
 
 
 class BadNodeTracker:
@@ -82,15 +83,19 @@ class _OverlaySnapshot:
 class _Pending:
     """One queued plan submission moving through the pipeline."""
 
-    __slots__ = ("plan", "eval_updates", "event", "result", "error", "seq")
+    __slots__ = ("plan", "eval_updates", "event", "result", "error",
+                 "seq", "trace_ctx")
 
-    def __init__(self, plan, eval_updates, seq):
+    def __init__(self, plan, eval_updates, seq, trace_ctx=None):
         self.plan = plan
         self.eval_updates = eval_updates
         self.event = threading.Event()
         self.result: Optional[PlanResult] = None
         self.error: Optional[BaseException] = None
         self.seq = seq
+        # the submitting eval thread's trace ctx, carried EXPLICITLY so
+        # the dispatcher/committer threads' spans land in its trace
+        self.trace_ctx = trace_ctx
 
     def resolve(self, result=None, error=None) -> None:
         self.result = result
@@ -155,7 +160,8 @@ class Planner:
             if self._shutdown:
                 raise RuntimeError("planner is shut down")
             self._seq += 1
-            pending = _Pending(plan, eval_updates, self._seq)
+            pending = _Pending(plan, eval_updates, self._seq,
+                               trace_ctx=tracer.current())
             heapq.heappush(self._heap,
                            (-plan.priority, pending.seq, pending))
             metrics.sample("nomad.plan.queue_depth",
@@ -196,7 +202,10 @@ class Planner:
         snapshot = self.state.snapshot()
         overlaid = (_OverlaySnapshot(snapshot, inflight[1])
                     if inflight is not None else snapshot)
-        with metrics.measure("nomad.plan.evaluate"):
+        with metrics.measure("nomad.plan.evaluate"), \
+                tracer.span("plan.evaluate", ctx=item.trace_ctx,
+                            overlay=inflight is not None,
+                            nodes=len(item.plan.node_allocation)):
             result = self._evaluate_plan(overlaid, item.plan)
 
         # serialize commits: wait for the previous one (its replication
@@ -211,7 +220,9 @@ class Planner:
             if not prev_ok:
                 # the overlay assumed a commit that never landed --
                 # freed-capacity assumptions may be wrong: re-verify clean
-                with metrics.measure("nomad.plan.evaluate"):
+                with metrics.measure("nomad.plan.evaluate"), \
+                        tracer.span("plan.evaluate", ctx=item.trace_ctx,
+                                    overlay=False, reverify=True):
                     result = self._evaluate_plan(self.state.snapshot(),
                                                  item.plan)
 
@@ -223,12 +234,16 @@ class Planner:
         if result.is_no_op() and not item.plan.is_no_op():
             result.refresh_index = self.state.latest_index()
             self.plans_rejected += 1
+            tracer.event("plan.rejected", ctx=item.trace_ctx,
+                         rejected=len(result.rejected_nodes))
             item.resolve(result=result)
             return None
 
         def commit(item=item, result=result):
             try:
-                with metrics.measure("nomad.plan.commit"):
+                with metrics.measure("nomad.plan.commit"), \
+                        tracer.span("plan.commit", ctx=item.trace_ctx,
+                                    rejected=len(result.rejected_nodes)):
                     index = self.state.upsert_plan_results(
                         result, item.eval_updates)
             except BaseException as e:  # noqa: BLE001 -- waiter must wake

@@ -174,7 +174,12 @@ class StatsdSink:
         lines = []
         for name, total in snap.get("counters", {}).items():
             delta = total - self._last_counts.get(name, 0)
-            if delta:
+            # a counter can only move forward; total < last means the
+            # registry was reset (metrics.reset()) or restarted -- a
+            # negative `|c` line is invalid statsd and real daemons
+            # either drop it or corrupt the gauge, so resync the
+            # baseline and emit nothing until the counter climbs again
+            if delta > 0:
                 lines.append(f"{name}:{delta}|c")
             self._last_counts[name] = total
         for name, s in snap.get("samples", {}).items():

@@ -14,6 +14,7 @@ from ..structs import (
     Evaluation, Plan, PlanResult, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
 )
 from .telemetry import metrics
+from .tracing import tracer
 
 ALL_SCHEDULERS = ["service", "batch", "system", "sysbatch", "_core"]
 
@@ -29,8 +30,12 @@ class WorkerPlanner:
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], object]:
         # (reference: worker.go:656 `nomad.plan.submit` -- wall time of the
         # whole submission incl. queue wait at the serialized applier)
-        with metrics.measure("nomad.plan.submit"):
+        with metrics.measure("nomad.plan.submit"), \
+                tracer.span("plan.submit") as sp:
             result = self.server.planner.apply(plan)
+            sp.tag(allocs=sum(len(v)
+                              for v in result.node_allocation.values()),
+                   rejected=len(result.rejected_nodes))
         new_state = None
         if result.rejected_nodes or (result.is_no_op() and not plan.is_no_op()):
             # partial/failed commit: scheduler refreshes its snapshot
@@ -87,8 +92,16 @@ class Worker(threading.Thread):
             try:
                 self._invoke_scheduler(ev, token)
                 err = self.server.broker.ack(ev.id, token)
-            except Exception:
+                tracer.end(ev.id, status="complete")
+            except Exception as e:
                 self.server.broker.nack(ev.id, token)
+                tracer.end(ev.id, status="nacked",
+                           error=f"{type(e).__name__}: {e}")
+                from .logbroker import log as _log
+                _log("error", "worker",
+                     f"eval={ev.id} job={ev.job_id} scheduler invoke "
+                     f"failed ({type(e).__name__}: {e}); nacked for "
+                     "redelivery")
                 if self.server.logger:
                     import traceback
                     traceback.print_exc()
@@ -105,19 +118,26 @@ def invoke_scheduler(server, ev: Evaluation, token: str,
     """(reference: worker.go:610 invokeScheduler)"""
     from ..faultinject import faults
     faults.fire("worker.invoke")    # chaos: raise -> nack -> requeue
-    with metrics.measure("nomad.worker.wait_for_index"):
-        server.state.block_until(ev.modify_index - 1, timeout=2.0)
-    snapshot = server.state.snapshot()
-    planner = WorkerPlanner(server, token)
-    sched_type = (ev.type if ev.type in
-                  ("service", "batch", "system", "sysbatch")
-                  else "service")
-    kwargs = {}
-    if solve_hook is not None and sched_type in ("service", "batch"):
-        kwargs["solve_hook"] = solve_hook
-    sched = new_scheduler(sched_type, snapshot, planner, **kwargs)
-    with metrics.measure(f"nomad.worker.invoke_scheduler_{sched_type}"):
-        sched.process(ev)
+    ctx = tracer.begin(ev.id, job=ev.job_id, lane=ev.type,
+                       trigger=ev.triggered_by)
+    with tracer.activate(ctx):
+        with metrics.measure("nomad.worker.wait_for_index"), \
+                tracer.span("worker.wait_for_index", ctx=ctx,
+                            min_index=ev.modify_index - 1):
+            server.state.block_until(ev.modify_index - 1, timeout=2.0)
+        snapshot = server.state.snapshot()
+        planner = WorkerPlanner(server, token)
+        sched_type = (ev.type if ev.type in
+                      ("service", "batch", "system", "sysbatch")
+                      else "service")
+        kwargs = {}
+        if solve_hook is not None and sched_type in ("service", "batch"):
+            kwargs["solve_hook"] = solve_hook
+        sched = new_scheduler(sched_type, snapshot, planner, **kwargs)
+        with metrics.measure(
+                f"nomad.worker.invoke_scheduler_{sched_type}"), \
+                tracer.span("worker.invoke", ctx=ctx, sched=sched_type):
+            sched.process(ev)
 
 
 class BatchWorker(threading.Thread):
@@ -189,8 +209,16 @@ class BatchWorker(threading.Thread):
         try:
             invoke_scheduler(self.server, ev, token, solve_hook=hook)
             self.server.broker.ack(ev.id, token)
-        except Exception:
+            tracer.end(ev.id, status="complete")
+        except Exception as e:
             self.server.broker.nack(ev.id, token)
+            tracer.end(ev.id, status="nacked",
+                       error=f"{type(e).__name__}: {e}")
+            from .logbroker import log as _log
+            _log("error", "worker",
+                 f"eval={ev.id} job={ev.job_id} batch-eval invoke "
+                 f"failed ({type(e).__name__}: {e}); nacked for "
+                 "redelivery")
             if self.server.logger:
                 import traceback
                 traceback.print_exc()

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..server.telemetry import metrics
+from ..server.tracing import tracer
 from .service import PackedLane
 
 # Pad the fused eval axis to these sizes so XLA compiles one program per
@@ -242,6 +243,7 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
                 stack(lambda i, k=k: getattr(lanes[i].pinit, k))
                 for k in lane0.pinit._fields])
 
+        t0_wall = time.time()
         t0 = time.perf_counter()
         out = _dispatch(const, init, batch, spread_alg, dtype_name,
                         use_mesh, ptab=ptab, pinit=pinit,
@@ -250,6 +252,10 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
                                               "table_version", None))
         dt_ms = (time.perf_counter() - t0) * 1e3
         metrics.sample_ms("nomad.solver.dispatch", dt_ms)
+        tracer.record("solver.dispatch", t0_wall, dt_ms,
+                      E=e_pad, e_real=e_real, P=p_pad,
+                      wave=bool(lanes[idxs[0]].wavefront_ok()), A=A,
+                      slow_compile=dt_ms > 1000.0)
         if dt_ms > 1000.0:
             # a >1s dispatch on these shapes is an XLA compile, not compute;
             # record which variant so warm-path stalls are attributable
@@ -561,7 +567,11 @@ class SolveBarrier:
         """Block until the batch dispatches; returns this lane's
         (chosen, scores, n_yielded). A dispatch failure re-raises in EVERY
         participating thread (each eval then nacks independently)."""
-        cell: dict = {}
+        # explicit trace handoff: the eval thread's ctx rides the cell
+        # so the dispatch (running on a pipeline thread at depth > 1)
+        # can record its spans into every participating eval's trace
+        cell: dict = {"trace_ctx": tracer.current()}
+        t_arrive = time.time()
         with self._cv:
             self._waiting.append((lane, cell))
             if self._ready_locked():
@@ -580,7 +590,12 @@ class SolveBarrier:
                             and any(c is cell for _, c in self._waiting)):
                         self._dispatch_locked()
             if "error" in cell:
+                tracer.record("solver.barrier", t_arrive,
+                              (time.time() - t_arrive) * 1e3,
+                              outcome="error")
                 raise cell["error"]
+            tracer.record("solver.barrier", t_arrive,
+                          (time.time() - t_arrive) * 1e3, outcome="ok")
             return cell["result"]
 
     def _ready_locked(self) -> bool:
@@ -610,6 +625,10 @@ class SolveBarrier:
             _cross_lane_fixpoint(lanes, results, self._ledger)
             return results
 
+        # group ctx over every waiting eval: the fused dispatch's spans
+        # belong to each of them (the dispatching thread is just the
+        # last arriver, its own eval is one lane among many)
+        gctx = tracer.group([c.get("trace_ctx") for _, c in batch])
         try:
             # the fused dispatch (+ the fixpoint's small re-solves) runs
             # under the watchdog deadline: a mid-flight tunnel wedge
@@ -617,7 +636,11 @@ class SolveBarrier:
             # independently degrades to the host oracle (make_solve_hook)
             # instead of stranding the whole batch
             from .guard import run_dispatch
-            results = run_dispatch(solve_batch, label="solver.batch")
+            with tracer.activate(gctx), \
+                    tracer.span("solver.fuse_dispatch", ctx=gctx,
+                                generation=gen, lanes=len(lanes),
+                                depth=1):
+                results = run_dispatch(solve_batch, label="solver.batch")
             for (lane, cell), res in zip(batch, results):
                 cell["result"] = res
         except Exception as e:  # noqa: BLE001 -- waiters must not strand
@@ -635,12 +658,22 @@ class SolveBarrier:
         no matter what raises where."""
         results = None
         err: Optional[Exception] = None
+        # explicit cross-thread handoff: this runs on a PIPELINE thread;
+        # the group ctx (every eval fused into this generation) was
+        # captured on the eval threads and rides the batch's cells
+        gctx = tracer.group([c.get("trace_ctx") for _, c in batch])
         try:
             from .guard import run_dispatch
-            results = run_dispatch(
-                lambda: fuse_and_solve(lanes, use_mesh=self._use_mesh,
-                                       e_pad_hint=self._e_pad_hint),
-                label="solver.batch")
+            with tracer.activate(gctx), \
+                    tracer.span("solver.fuse_dispatch", ctx=gctx,
+                                generation=gen, lanes=len(lanes),
+                                depth=self._depth,
+                                in_flight=pipeline_state()["in_flight"]):
+                results = run_dispatch(
+                    lambda: fuse_and_solve(
+                        lanes, use_mesh=self._use_mesh,
+                        e_pad_hint=self._e_pad_hint),
+                    label="solver.batch")
         except Exception as e:  # noqa: BLE001 -- waiters must not strand
             err = e
         # Ordered-completion section: generation g's ledger charges land
@@ -649,17 +682,18 @@ class SolveBarrier:
         # the timeout is a last-resort anti-wedge, not a normal path.
         deadline = time.monotonic() + max(
             60.0, 2.0 * _barrier_order_timeout())
-        with self._complete_cv:
-            while self._next_complete != gen:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    from ..server.logbroker import log as _log
-                    _log("error", "solver",
-                         f"dispatch generation {gen} gave up waiting for "
-                         f"generation {self._next_complete} to complete; "
-                         "proceeding out of order")
-                    break
-                self._complete_cv.wait(remaining)
+        with tracer.span("solver.order_wait", ctx=gctx, generation=gen):
+            with self._complete_cv:
+                while self._next_complete != gen:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        from ..server.logbroker import log as _log
+                        _log("error", "solver",
+                             f"dispatch generation {gen} gave up waiting "
+                             f"for generation {self._next_complete} to "
+                             "complete; proceeding out of order")
+                        break
+                    self._complete_cv.wait(remaining)
         # only pay a second watchdog when the fixpoint can actually do
         # work (its own early-return conditions); its re-solves are real
         # device dispatches and deserve the same deadline as the fuse
@@ -670,10 +704,13 @@ class SolveBarrier:
             if err is None and fixpoint_needed:
                 try:
                     from .guard import run_dispatch
-                    run_dispatch(
-                        lambda: _cross_lane_fixpoint(lanes, results,
-                                                     self._ledger),
-                        label="solver.batch.fixpoint")
+                    with tracer.activate(gctx), \
+                            tracer.span("solver.fixpoint", ctx=gctx,
+                                        generation=gen):
+                        run_dispatch(
+                            lambda: _cross_lane_fixpoint(lanes, results,
+                                                         self._ledger),
+                            label="solver.batch.fixpoint")
                 except Exception as e:  # noqa: BLE001 -- same contract
                     err = e
         finally:
@@ -707,7 +744,9 @@ def make_solve_hook(barrier: SolveBarrier):
     def hook(service, tg, places, nodes, penalties):
         from .guard import DispatchFailed, note_host_fallback
 
-        lane = service.pack(tg, places, nodes, penalties)
+        with tracer.span("solver.pack", tg=tg.name,
+                         places=len(places)):
+            lane = service.pack(tg, places, nodes, penalties)
         if lane is None:
             return None          # not solver-eligible -> host fallback
         try:
@@ -715,5 +754,6 @@ def make_solve_hook(barrier: SolveBarrier):
         except DispatchFailed:
             note_host_fallback()
             return None
-        return service.materialize(lane, *res)
+        with tracer.span("solver.materialize", tg=tg.name):
+            return service.materialize(lane, *res)
     return hook

@@ -173,6 +173,11 @@ def device_put_cached(arrays: Sequence[np.ndarray],
     if misses:
         metrics.incr("nomad.solver.const_cache_miss", misses)
     note_dispatch_bytes(shipped)
+    # per-eval attribution: a cold-transfer dispatch explains its own
+    # latency spike (the group ctx fans this out to every fused lane)
+    from ..server.tracing import tracer
+    tracer.event("solver.constcache", hits=hits, misses=misses,
+                 bytes_shipped=shipped, bytes_saved=saved)
     return buffers, shipped
 
 

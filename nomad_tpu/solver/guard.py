@@ -151,6 +151,12 @@ def note_host_fallback() -> None:
     was VERDICT r4 weak #5)."""
     from ..server.telemetry import metrics
     metrics.incr("nomad.solver.host_fallback_dispatches")
+    # pin the fallback onto the eval's trace: a degraded eval must be
+    # attributable end-to-end, not just counted fleet-wide
+    from ..server.tracing import tracer
+    tracer.mark_degraded("host_fallback",
+                         breaker=_BREAKER["state"],
+                         backend_ok=_STATE["ok"])
 
 
 # ----------------------------------------------------------------------
@@ -187,15 +193,22 @@ def run_dispatch(fn, label: str = "solver.dispatch",
     """
     from ..faultinject import faults
     from ..server.telemetry import metrics
+    from ..server.tracing import tracer
 
     timeout = dispatch_deadline_s() if timeout_s is None else timeout_s
     box: dict = {}
     done = threading.Event()
+    # explicit trace handoff: the dispatch executes on a fresh runner
+    # thread, so the caller's eval/group ctx must travel with it or
+    # every span recorded under the watchdog would be lost
+    trace_ctx = tracer.current()
+    eval_tag = ",".join(tracer.current_ids()) or "-"
 
     def runner() -> None:
         try:
-            faults.fire("solver.dispatch")
-            box["result"] = fn()
+            with tracer.activate(trace_ctx):
+                faults.fire("solver.dispatch")
+                box["result"] = fn()
         except BaseException as e:  # noqa: BLE001 -- reported to caller
             box["error"] = e
         finally:
@@ -210,17 +223,26 @@ def run_dispatch(fn, label: str = "solver.dispatch",
         if not done.wait(timeout):
             metrics.incr("nomad.solver.dispatch_timeout")
             record_dispatch_failure("timeout")
+            tracer.mark_degraded("watchdog_timeout", ctx=trace_ctx,
+                                 label=label, deadline_s=timeout)
             from ..server.logbroker import log as _log
             _log("error", "solver.guard",
-                 f"{label} exceeded its {timeout:.1f}s deadline; "
-                 "eval degrades to the host oracle (dispatch thread "
-                 "abandoned)")
+                 f"eval={eval_tag} {label} exceeded its "
+                 f"{timeout:.1f}s deadline; eval degrades to the host "
+                 "oracle (dispatch thread abandoned)")
             raise DispatchFailed(
                 "timeout", f"{label} exceeded {timeout:.1f}s deadline")
     if "error" in box:
         metrics.incr("nomad.solver.dispatch_error")
         record_dispatch_failure("error")
         err = box["error"]
+        tracer.mark_degraded("dispatch_error", ctx=trace_ctx,
+                             label=label, error=type(err).__name__)
+        from ..server.logbroker import log as _log
+        _log("error", "solver.guard",
+             f"eval={eval_tag} {label} failed "
+             f"({type(err).__name__}: {err}); eval degrades to the "
+             "host oracle")
         raise DispatchFailed(
             "error", f"{label} failed: {type(err).__name__}: {err}"
         ) from err
@@ -272,6 +294,12 @@ def _trip_locked(kind: str) -> None:
     # them until a recovery probe passes anyway
     from .constcache import invalidate_all
     invalidate_all("breaker trip")
+    # every in-flight eval is now degraded, not just the dispatch that
+    # tripped the breaker: stamp all active traces so each one is
+    # retained and attributable
+    from ..server.tracing import tracer
+    tracer.broadcast_event("breaker.trip",
+                           degraded_reason="breaker_open", kind=kind)
     _log("error", "solver.guard",
          f"dispatch breaker OPEN after "
          f"{_BREAKER['consecutive_failures']} consecutive {kind}s; "

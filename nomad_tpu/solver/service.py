@@ -295,16 +295,20 @@ class TpuPlacementService:
         parity-authoritative host oracle either way -- a mid-flight
         tunnel wedge must cost one deadline, not the worker)."""
         from . import guard
+        from ..server.tracing import tracer
 
-        lane = self.pack(tg, places, nodes, penalty_nodes_per_place)
+        with tracer.span("solver.pack", tg=tg.name, places=len(places)):
+            lane = self.pack(tg, places, nodes, penalty_nodes_per_place)
         if lane is None:
             return None
         try:
-            out = guard.run_dispatch(lambda: dispatch_lane(lane))
+            with tracer.span("solver.dispatch_solo", tg=tg.name):
+                out = guard.run_dispatch(lambda: dispatch_lane(lane))
         except guard.DispatchFailed:
             guard.note_host_fallback()
             return None
-        return self.materialize(lane, *out)
+        with tracer.span("solver.materialize", tg=tg.name):
+            return self.materialize(lane, *out)
 
     def solve_system(self, tg, nodes) -> Optional[List[TpuPlacement]]:
         """Dense system-job solve: one independent fit+score per node

@@ -30,15 +30,18 @@ N_NODES, COUNT, SEED = 12, 6, 7
 
 @pytest.fixture(autouse=True)
 def clean_slate():
+    from nomad_tpu.server.tracing import tracer
     from nomad_tpu.solver import constcache
     guard._reset_for_tests()
     faults._reset_for_tests()
     constcache._reset_for_tests()
+    tracer._reset_for_tests()
     metrics.reset()
     yield
     faults._reset_for_tests()
     guard._reset_for_tests()
     constcache._reset_for_tests()
+    tracer._reset_for_tests()
 
 
 def _host_placements():
@@ -458,6 +461,81 @@ def test_const_cache_invalidates_across_breaker_trip_and_recovery(
     _, s1 = constcache.device_put_cached([table], version=3)
     _, s2 = constcache.device_put_cached([table], version=3)
     assert s1 == table.nbytes and s2 == 0
+
+
+# ----------------------------------------------------------------------
+# Eval trace flight recorder under faults: every degraded eval must be
+# retrievable end-to-end with its root cause, and trace memory must
+# stay under the configured cap no matter how many evals degrade.
+
+
+def test_degraded_eval_trace_retained_with_root_cause(monkeypatch):
+    """Watchdog timeout -> host fallback: the eval's trace must survive
+    tail-based retention even at sample rate 0, name the root cause,
+    and carry the solve spans."""
+    from nomad_tpu.server.tracing import tracer
+
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SLOW_MS", "999999")
+    monkeypatch.setenv("NOMAD_TPU_DISPATCH_TIMEOUT", "0.3")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_THRESHOLD", "100")
+
+    host = _host_placements()
+    tracer._reset_for_tests()          # drop the host run's traces
+    faults.arm("solver.dispatch", "hang")
+    degraded = _tpu_placements()
+    faults.disarm_all()
+    assert degraded == host
+
+    traces = tracer.list_traces(degraded=True)
+    assert traces, "degraded eval left no retained trace"
+    tr = tracer.get(traces[0]["eval_id"])
+    assert tr["degraded_reason"] in ("watchdog_timeout",
+                                     "host_fallback")
+    names = {s["name"] for s in tr["spans"]}
+    assert "degraded" in names
+    assert "solver.pack" in names or "solver.dispatch_solo" in names
+    # healthy runs at sample 0 retain nothing
+    tracer._reset_for_tests()
+    _tpu_placements()
+    assert tracer.stats()["retained"] == 0
+
+
+def test_breaker_trip_stamps_inflight_traces(monkeypatch):
+    from nomad_tpu.server.tracing import tracer
+
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "30")
+    _fast_probe_pass(monkeypatch)
+    tracer.begin("inflight-1")
+    for _ in range(guard._breaker_threshold()):
+        guard.record_dispatch_failure("timeout")
+    assert guard.breaker_state()["state"] == guard.BREAKER_OPEN
+    tracer.end("inflight-1")
+    tr = tracer.get("inflight-1")
+    assert tr is not None, "trip must force retention of in-flight evals"
+    assert tr["degraded_reason"] == "breaker_open"
+
+
+def test_trace_memory_capped_under_fault_storm(monkeypatch):
+    """200 degraded (always-keep) evals against a 16-trace / 64KB cap:
+    the ring must hold the caps, keeping the newest."""
+    from nomad_tpu.server.tracing import tracer
+
+    monkeypatch.setenv("NOMAD_TPU_TRACE_CAP", "16")
+    monkeypatch.setenv("NOMAD_TPU_TRACE_MB", "0.0625")   # 64 KB
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SAMPLE", "1.0")
+    for i in range(200):
+        ctx = tracer.begin(f"storm-{i}", lane="service")
+        with tracer.activate(ctx):
+            with tracer.span("solver.fuse_dispatch", generation=i):
+                pass
+            tracer.mark_degraded("host_fallback")
+        tracer.end(f"storm-{i}")
+    st = tracer.stats()
+    assert st["retained"] <= 16
+    assert st["retained_bytes"] <= 64 * 1024
+    assert tracer.get("storm-199") is not None, "newest must survive"
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,36 @@
+"""CI wiring for scripts/check_metrics_doc.py: every telemetry series
+the code emits must have a row in docs/OPERATIONS.md's "Metrics
+reference" table (drift gate -- the `batch_lanes` rendered-as-ms bug
+survived two rounds because nobody could diff emitted vs documented)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_metrics_doc.py")
+
+
+def test_every_emitted_series_is_documented():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (
+        f"metrics doc drift:\n{proc.stdout}{proc.stderr}")
+
+
+def test_checker_detects_missing_series(tmp_path):
+    """The gate must actually bite: a source tree emitting a series the
+    doc table lacks fails the check."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("cmd_check", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    emitted = mod.emitted_series()
+    assert "nomad.plan.evaluate" in emitted
+    # f-string placeholders normalize to wildcards matching the doc's
+    # <...> convention
+    assert "nomad.worker.invoke_scheduler_*" in emitted
+    documented = mod.documented_series()
+    assert "nomad.worker.invoke_scheduler_*" in documented
+    # an undocumented series would be reported missing
+    assert "nomad.bogus.series" not in documented

@@ -50,6 +50,30 @@ def test_gauge_series_are_unit_free():
     assert "nomad.test.lanes" not in t.snapshot()["samples"]
 
 
+def test_series_ring_buffer_wraparound():
+    """Push far more than the 2048-sample window: count/total/min/max
+    must cover EVERY sample ever added, while the percentiles are
+    computed over exactly the most recent window (the ring overwrites
+    oldest-first)."""
+    from nomad_tpu.server import telemetry as tel
+
+    t = Telemetry()
+    n = tel._BUF * 2 + 500            # wraps the ring twice and a bit
+    for i in range(n):
+        t.sample_ms("w", float(i))
+    s = t.snapshot()["samples"]["w"]
+    assert s["count"] == n
+    assert s["min_ms"] == 0.0
+    assert s["max_ms"] == float(n - 1)
+    assert abs(s["mean_ms"] - (n - 1) / 2.0) < 1e-9
+    # window = the last _BUF values, regardless of ring rotation
+    window = sorted(range(n - tel._BUF, n))
+    m = len(window)
+    assert s["p50_ms"] == float(window[m // 2])
+    assert s["p95_ms"] == float(window[min(m - 1, int(m * 0.95))])
+    assert s["p99_ms"] == float(window[min(m - 1, int(m * 0.99))])
+
+
 def test_measure_context_manager():
     t = Telemetry()
     with t.measure("block"):
@@ -117,6 +141,38 @@ def test_statsd_sink_emits_deltas():
     sink.flush()
     data = recv.recv(65536).decode()
     assert "nomad.test.counter:2|c" in data
+    sink.shutdown()
+    recv.close()
+
+
+def test_statsd_sink_skips_negative_delta_after_reset():
+    """A counter regression (metrics.reset(), process restart) must NOT
+    emit an invalid negative `|c` line; the sink resyncs its baseline
+    and resumes correct deltas once the counter climbs again."""
+    import socket
+
+    from nomad_tpu.server.telemetry import StatsdSink, Telemetry
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+
+    reg = Telemetry()
+    sink = StatsdSink(f"127.0.0.1:{port}", reg, interval_s=60.0)
+    reg.incr("nomad.test.counter", 5)
+    sink.flush()
+    assert "nomad.test.counter:5|c" in recv.recv(65536).decode()
+
+    # regression: reset drops the total below the sink's baseline
+    reg.reset()
+    reg.incr("nomad.test.counter", 2)
+    sink.flush()                       # delta would be -3: must resync
+    reg.incr("nomad.test.counter", 1)
+    sink.flush()                       # after resync: clean +1 delta
+    data = recv.recv(65536).decode()
+    assert "-" not in data, f"negative statsd delta emitted: {data!r}"
+    assert "nomad.test.counter:1|c" in data
     sink.shutdown()
     recv.close()
 
