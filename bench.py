@@ -685,6 +685,69 @@ def time_streaming_solver(h, nodes, e_evals, per_eval, depth, rounds=6):
     }
 
 
+def time_pack_tax(h, nodes, n_placements, repeats=3):
+    """Host-side packing tax (ISSUE 4): cold service.pack (every pack
+    cache dropped -- node matrix, feasibility/spread/affinity memos,
+    usage base) vs warm (snapshot caches resident) at the headline
+    shape, plus the kill-switch parity gate: NOMAD_TPU_PACK_CACHE=0
+    must produce identical placements. Returns a dict or None."""
+    import numpy as np
+
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.reconcile import AllocPlaceResult
+    from nomad_tpu.solver.service import TpuPlacementService, dispatch_lane
+    from nomad_tpu.structs import Plan
+    from nomad_tpu.tensor import pack as tpack
+
+    snap = h.state.snapshot()
+
+    def one_pack(tag):
+        job = mock.job(id=f"packbench-{tag}")
+        job.task_groups[0].count = n_placements
+        tg = job.task_groups[0]
+        plan = Plan(eval_id=f"packbench-eval-{tag}", priority=50, job=job)
+        ctx = EvalContext(snap, plan)
+        places = [AllocPlaceResult(name=f"{job.id}.{tg.name}[{k}]",
+                                   task_group=tg)
+                  for k in range(n_placements)]
+        svc = TpuPlacementService(ctx, job, batch_mode=False,
+                                  spread_alg=False)
+        t0 = time.perf_counter()
+        lane = svc.pack(tg, places, nodes)
+        return time.perf_counter() - t0, lane
+
+    tpack.invalidate_pack_caches("bench cold measurement")
+    cold_dt, lane = one_pack("cold")
+    if lane is None:
+        return None
+    warm_dt = None
+    for r in range(repeats):
+        dt, lane = one_pack("warm")     # same eval id: identical work
+        warm_dt = dt if warm_dt is None else min(warm_dt, dt)
+
+    # parity: the cached lane vs a NOMAD_TPU_PACK_CACHE=0 repack of the
+    # SAME eval must place identically
+    prev = os.environ.get("NOMAD_TPU_PACK_CACHE")
+    os.environ["NOMAD_TPU_PACK_CACHE"] = "0"
+    try:
+        _, lane_off = one_pack("warm")
+    finally:
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_PACK_CACHE", None)
+        else:
+            os.environ["NOMAD_TPU_PACK_CACHE"] = prev
+    on = dispatch_lane(lane)
+    off = dispatch_lane(lane_off)
+    mismatch = int((np.asarray(on[0]) != np.asarray(off[0])).sum())
+    return {
+        "cold_ms": cold_dt * 1e3,
+        "warm_ms": warm_dt * 1e3,
+        "cut": (cold_dt / warm_dt) if warm_dt else 0.0,
+        "mismatch": mismatch,
+    }
+
+
 def solve_once(h, job, nodes, n_placements):
     """One full TPU-path eval: host-side packing + one dense solver dispatch
     + the single device->host result fetch -- the complete per-eval latency
@@ -864,6 +927,22 @@ def main():
                 break
     mismatch += native_mismatch
 
+    # --- host packing tax: cold vs warm service.pack at the headline
+    #     shape (the snapshot-scoped pack caches' claim), parity-gated
+    #     against the NOMAD_TPU_PACK_CACHE=0 kill switch
+    pack_tax = None
+    if os.environ.get("BENCH_SKIP_PACK", "") != "1":
+        try:
+            pack_tax = time_pack_tax(h, nodes, N_PLACEMENTS)
+        except Exception as e:  # noqa: BLE001 -- report the rest anyway
+            log(f"bench: pack tax probe failed: {e!r}")
+        if pack_tax is not None:
+            mismatch += pack_tax["mismatch"]
+            log(f"bench: host pack cold {pack_tax['cold_ms']:.1f}ms -> "
+                f"warm {pack_tax['warm_ms']:.1f}ms "
+                f"({pack_tax['cut']:.1f}x cut, "
+                f"killswitch_mismatch={pack_tax['mismatch']})")
+
     # --- fused solver throughput: E evals, one dispatch (the headline)
     fused = None
     if not mismatch and os.environ.get("BENCH_SKIP_FUSED", "") != "1":
@@ -962,7 +1041,7 @@ def main():
 
     _emit(platform, p50, mismatch, oracle_dt, native_dt, batched,
           n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
-          rtt=rtt, streaming=streaming)
+          rtt=rtt, streaming=streaming, pack_tax=pack_tax)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
@@ -970,7 +1049,7 @@ def main():
 
 def _emit(platform, p50, mismatch, oracle_total, native_total=None,
           batched=None, n_placed=0, fused=None, batched_full=None,
-          rtt=None, streaming=None):
+          rtt=None, streaming=None, pack_tax=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -1077,6 +1156,14 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
         if native_total is not None and placed:
             out["streaming_pipelined_vs_native_host"] = round(
                 per_place_native / (streaming["pipe_dt"] / placed), 4)
+    if pack_tax is not None:
+        # host packing tax, next to the transfer cut: cold = every pack
+        # cache dropped, warm = snapshot caches resident; the warm cut
+        # is the amortization the pack layer buys each steady-state eval
+        out["pack_ms_cold"] = round(pack_tax["cold_ms"], 2)
+        out["pack_ms_warm"] = round(pack_tax["warm_ms"], 2)
+        out["pack_warm_cut"] = round(pack_tax["cut"], 2)
+        out["pack_killswitch_mismatch"] = pack_tax["mismatch"]
     if batched is not None:
         bdt, bevals, bplaced = batched
         out["batched_evals_per_sec"] = round(bevals / bdt, 2)

@@ -54,6 +54,8 @@ def dispatch_health_stamp(platform: str) -> dict:
         degraded = False
     cc = st.get("const_cache", {})
     pipe = st.get("dispatch_pipeline", {})
+    pc = st.get("pack_cache", {})
+    ar = st.get("pack_arena", {})
     return {
         "degraded": degraded,
         "dispatch_state": {
@@ -77,6 +79,14 @@ def dispatch_health_stamp(platform: str) -> dict:
             "const_cache_bytes_saved": cc.get("bytes_saved_total", 0),
             "const_cache_resident_bytes": cc.get("resident_bytes", 0),
             "dispatch_depth": pipe.get("depth", 1),
+            # host pack layer (ISSUE 4): the warm-path claim -- packing
+            # amortized across the snapshot -- is measured, not inferred
+            "pack_cache_hits": pc.get("hits", 0),
+            "pack_cache_misses": pc.get("misses", 0),
+            "pack_usage_base_hits": pc.get("usage_base_hits", 0),
+            "pack_arena_reuses": ar.get("reuses", 0),
+            "pack_arena_resident_bytes": ar.get("resident_bytes", 0),
+            "pipeline_staged_total": pipe.get("staged_total", 0),
         },
     }
 

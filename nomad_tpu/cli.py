@@ -710,6 +710,20 @@ def cmd_operator_solver(args) -> int:
         for k in ("enabled", "entries", "resident_bytes", "hits",
                   "misses", "bytes_saved_total", "invalidations"):
             print(f"const_cache.{k:16s} = {cc.get(k)}")
+        pc = st.get("pack_cache") or {}
+        for k in ("enabled", "hits", "misses", "matrix_hits",
+                  "matrix_misses", "usage_base_hits",
+                  "usage_base_misses", "invalidations"):
+            print(f"pack_cache.{k:17s} = {pc.get(k)}")
+        ar = st.get("pack_arena") or {}
+        for k in ("enabled", "entries", "in_use", "resident_bytes",
+                  "reuses", "allocs", "evictions", "pad_fills_skipped"):
+            print(f"pack_arena.{k:17s} = {ar.get(k)}")
+        pk = st.get("pack") or {}
+        ms = pk.get("ms") or {}
+        print(f"pack.p50_ms              = {ms.get('p50_ms')}")
+        print(f"pack.cache_hit           = {pk.get('cache_hit')}")
+        print(f"pack.cache_miss          = {pk.get('cache_miss')}")
     elif args.sub2 == "reprobe":
         # a first-touch reprobe legitimately blocks for the in-process
         # probe deadline (<=30s) plus the subprocess transport probe

@@ -29,6 +29,7 @@ import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -76,14 +77,20 @@ class _DispatchPipeline:
         self._sem = threading.Semaphore(depth)
         self._q: "queue.Queue" = queue.Queue()
         self._in_flight = 0
+        self._staged = 0
         self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._intake, daemon=True,
             name="solver-dispatch-pipeline")
         self._thread.start()
 
-    def submit(self, job) -> None:
-        self._q.put(job)
+    def submit(self, job, prepare=None) -> None:
+        """``prepare`` (optional) is the job's host-side staging --
+        the arena fill for its fused generation. The intake thread runs
+        it BEFORE waiting for a dispatch slot, so generation g+1's lane
+        stacking overlaps generation g's device execution instead of
+        consuming a depth slot (the pack -> dispatch overlap)."""
+        self._q.put((job, prepare))
 
     def stop(self) -> None:
         self._q.put(None)
@@ -92,11 +99,24 @@ class _DispatchPipeline:
         with self._lock:
             return self._in_flight
 
+    def staged(self) -> int:
+        with self._lock:
+            return self._staged
+
     def _intake(self) -> None:
         while True:
-            job = self._q.get()
-            if job is None:
+            item = self._q.get()
+            if item is None:
                 return
+            job, prepare = item
+            if prepare is not None:
+                try:
+                    prepare()
+                    with self._lock:
+                        self._staged += 1
+                except Exception:  # noqa: BLE001 -- staging is best
+                    import traceback  # effort; the job re-derives (and
+                    traceback.print_exc()  # fails under its watchdog)
             self._sem.acquire()
             with self._lock:
                 self._in_flight += 1
@@ -137,6 +157,7 @@ def pipeline_state() -> dict:
     return {
         "depth": dispatch_depth(),
         "in_flight": pipe.in_flight() if pipe is not None else 0,
+        "staged_total": pipe.staged() if pipe is not None else 0,
         "active": pipe is not None,
     }
 
@@ -146,6 +167,164 @@ def _e_bucket(e: int) -> int:
         if e <= b:
             return b
     return int(2 ** np.ceil(np.log2(e)))
+
+
+# ---------------------------------------------------------------------------
+# In-place fused-stack arena.
+#
+# Every fused generation used to np.empty + copy a fresh (E, ...) buffer per
+# tree field (~tens of MB at the headline shape) just to throw it away after
+# the dispatch. Consecutive generations overwhelmingly share a fuse_key and
+# (E, P, A) shape -- the same jobs stream through the same barrier -- so the
+# stacked buffers are pooled: a generation checks an entry out, fills lanes
+# IN PLACE and returns it after the dispatch. Padding rows (the e_pad >
+# e_real replicas of lane 0) only ever need to hold a VALID lane (their
+# results are discarded and batch.active masks them inert), so once an entry
+# has been fully filled its padding rows never need rewriting -- any prior
+# generation's lane data is a valid inert lane.
+#
+# The pool is a pool (not one buffer) because the pipelined barrier fills
+# generation g+1 while g's dispatch is still in flight. Bounds:
+# NOMAD_TPU_PACK_ARENA_ENTRIES / NOMAD_TPU_PACK_ARENA_MB; kill switch
+# NOMAD_TPU_PACK_ARENA=0 (fresh buffers every generation, the pre-arena
+# behavior).
+
+
+def _arena_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_PACK_ARENA", "1") != "0"
+
+
+def _arena_max_entries() -> int:
+    try:
+        return max(1, int(os.environ.get(
+            "NOMAD_TPU_PACK_ARENA_ENTRIES", "8")))
+    except ValueError:
+        return 8
+
+
+def _arena_max_bytes() -> int:
+    try:
+        return max(1, int(float(os.environ.get(
+            "NOMAD_TPU_PACK_ARENA_MB", "512")) * 1024 * 1024))
+    except ValueError:
+        return 512 * 1024 * 1024
+
+
+class _ArenaEntry:
+    __slots__ = ("key", "trees", "nbytes", "pad_valid", "pooled")
+
+    def __init__(self, key, trees, nbytes: int):
+        self.key = key
+        self.trees = trees          # tree name -> list of np arrays
+        self.nbytes = nbytes
+        self.pad_valid = False      # padding rows hold valid lane data
+        self.pooled = True
+
+
+class _StackArena:
+    """Bounded pool of reusable stacked host buffers, keyed by fused
+    group shape. Thread-safe: concurrent generations check out distinct
+    entries; an exhausted pool allocates fresh (never blocks)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: "OrderedDict[int, _ArenaEntry]" = OrderedDict()
+        self._seq = 0
+        self._free_bytes = 0
+        self._in_use = 0
+        self._stats = {"reuses": 0, "allocs": 0, "evictions": 0,
+                       "pad_fills_skipped": 0}
+
+    def acquire(self, key, specs):
+        """specs: tree name -> list of (shape, dtype). Returns
+        (entry, reused)."""
+        if _arena_enabled():
+            with self._lock:
+                for tok, ent in self._free.items():
+                    if ent.key == key and self._specs_match(ent, specs):
+                        del self._free[tok]
+                        self._free_bytes -= ent.nbytes
+                        self._in_use += 1
+                        self._stats["reuses"] += 1
+                        return ent, True
+        trees = {}
+        nbytes = 0
+        for name, fields in specs.items():
+            arrs = []
+            for shape, dtype in fields:
+                a = np.empty(shape, dtype=dtype)
+                nbytes += a.nbytes
+                arrs.append(a)
+            trees[name] = arrs
+        ent = _ArenaEntry(key, trees, nbytes)
+        with self._lock:
+            self._stats["allocs"] += 1
+            if _arena_enabled():
+                self._in_use += 1
+            else:
+                ent.pooled = False
+        return ent, False
+
+    @staticmethod
+    def _specs_match(ent, specs) -> bool:
+        for name, fields in specs.items():
+            arrs = ent.trees.get(name)
+            if arrs is None or len(arrs) != len(fields):
+                return False
+            for a, (shape, dtype) in zip(arrs, fields):
+                if a.shape != shape or a.dtype != dtype:
+                    return False
+        return True
+
+    def release(self, ent) -> None:
+        if not ent.pooled:
+            return
+        with self._lock:
+            self._in_use -= 1
+            if not _arena_enabled():
+                return
+            self._seq += 1
+            self._free[self._seq] = ent
+            self._free_bytes += ent.nbytes
+            max_e, max_b = _arena_max_entries(), _arena_max_bytes()
+            while self._free and (len(self._free) > max_e
+                                  or self._free_bytes > max_b):
+                _, old = self._free.popitem(last=False)
+                self._free_bytes -= old.nbytes
+                self._stats["evictions"] += 1
+
+    def note_pad_skip(self, n: int = 1) -> None:
+        with self._lock:
+            self._stats["pad_fills_skipped"] += n
+
+    def clear(self, reason: str = "") -> None:
+        with self._lock:
+            self._free.clear()
+            self._free_bytes = 0
+
+    def state(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["entries"] = len(self._free)
+            out["in_use"] = self._in_use
+            out["resident_bytes"] = self._free_bytes
+        out["enabled"] = _arena_enabled()
+        return out
+
+
+_ARENA = _StackArena()
+
+
+def arena_state() -> dict:
+    """Arena snapshot for guard.state() / status surfaces (the
+    constcache.stats() analog for host-side stacked buffers)."""
+    return _ARENA.state()
+
+
+def arena_clear(reason: str = "") -> None:
+    """Drop pooled (free) buffers; wired beside the const-cache
+    invalidation on breaker trip/recovery edges."""
+    _ARENA.clear(reason)
 
 
 def _pad_placement_axis(batch, p_pad: int):
@@ -173,8 +352,163 @@ def _pad_placement_axis(batch, p_pad: int):
                    else grow(batch.ask_cores)))
 
 
+class _FusedGroup:
+    """One shape-compatible lane group, fully stacked and ready to
+    dispatch: the unit the pack->dispatch overlap stages ahead of its
+    generation's device slot."""
+
+    __slots__ = ("idxs", "const", "init", "batch", "ptab", "pinit",
+                 "A", "e_real", "e_pad", "p_pad", "wave", "spread_alg",
+                 "dtype_name", "cache_version", "entry", "arena_reused")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+def _fuse_group(lanes: List[PackedLane], idxs: List[int], key: tuple,
+                e_pad_hint: int) -> _FusedGroup:
+    """Stack one group's lanes into arena-backed (E, ...) buffers,
+    filling lanes in place and skipping padding rows that already hold
+    valid lane data from a prior generation."""
+    lane0 = lanes[idxs[0]]
+    A = 1 if lane0.ptab is not None else 0
+    e_real = len(idxs)
+    e_pad = _e_bucket(e_real)
+    if e_pad_hint and lane0.wavefront_ok():
+        e_pad = max(e_pad, _e_bucket(min(e_pad_hint, E_BUCKETS[-1])))
+    # floor of 32: many lane sizes share one compiled variant (an
+    # inert padded step costs ~us; a fresh XLA compile costs seconds)
+    p_pad = max(32, _e_bucket(max(
+        lanes[i].batch.ask_cpu.shape[0] for i in idxs)))
+    # gauge, not sample_ms: this is a lane COUNT; recording it
+    # through the millisecond sampler made dashboards read "lanes"
+    # as a latency series
+    metrics.sample("nomad.solver.batch_lanes", float(e_real))
+    padded = {i: _pad_placement_axis(lanes[i].batch, p_pad)
+              for i in idxs}
+
+    srcs = {"const": lambda i: lanes[i].const,
+            "init": lambda i: lanes[i].init,
+            "batch": lambda i: padded[i]}
+    if A > 0:
+        srcs["ptab"] = lambda i: lanes[i].ptab
+        srcs["pinit"] = lambda i: lanes[i].pinit
+    specs = {}
+    for name, src in srcs.items():
+        first = src(idxs[0])
+        specs[name] = [((e_pad,) + np.asarray(f).shape,
+                        np.asarray(f).dtype) for f in first]
+    entry, reused = _ARENA.acquire((key, e_pad, p_pad), specs)
+    if reused:
+        metrics.incr("nomad.solver.pack_arena_reuse")
+    else:
+        metrics.incr("nomad.solver.pack_arena_alloc")
+
+    skip_pad = entry.pad_valid
+    if skip_pad and e_pad > e_real:
+        _ARENA.note_pad_skip()
+    for name, src in srcs.items():
+        dsts = entry.trees[name]
+        for f_i in range(len(dsts)):
+            dst = dsts[f_i]
+            for j, li in enumerate(idxs):
+                dst[j] = np.asarray(src(li)[f_i])
+            if not skip_pad:
+                # fresh buffer: padding rows need SOME valid lane; once
+                # filled they stay valid forever (prior generations'
+                # rows are real lanes, results discarded)
+                for j in range(e_real, e_pad):
+                    dst[j] = dst[0]
+    entry.pad_valid = True
+
+    const = type(lane0.const)(*entry.trees["const"])
+    init = type(lane0.init)(*entry.trees["init"])
+    batch = type(lane0.batch)(*entry.trees["batch"])
+    # padding lanes (and stale rows from a wider prior generation) must
+    # not place anything
+    batch.active[e_real:] = False
+    ptab = type(lane0.ptab)(*entry.trees["ptab"]) if A > 0 else None
+    pinit = type(lane0.pinit)(*entry.trees["pinit"]) if A > 0 else None
+    return _FusedGroup(
+        idxs=list(idxs), const=const, init=init, batch=batch, ptab=ptab,
+        pinit=pinit, A=A, e_real=e_real, e_pad=e_pad, p_pad=p_pad,
+        wave=lane0.wavefront_ok(), spread_alg=lane0.spread_alg,
+        dtype_name=lane0.dtype_name,
+        cache_version=getattr(lane0, "table_version", None),
+        entry=entry, arena_reused=reused)
+
+
+def fuse_lanes(lanes: List[PackedLane], e_pad_hint: int = 0
+               ) -> List[_FusedGroup]:
+    """Host-side half of fuse_and_solve: group lanes by static-shape
+    signature and stack each group into arena buffers. No device work --
+    safe to run while an earlier generation's dispatch is in flight
+    (the pipeline's prepare stage)."""
+    groups: Dict[tuple, List[int]] = {}
+    for i, lane in enumerate(lanes):
+        groups.setdefault(lane.fuse_key(), []).append(i)
+    return [_fuse_group(lanes, idxs, key, e_pad_hint)
+            for key, idxs in groups.items()]
+
+
+def solve_groups(lanes: List[PackedLane], groups: List[_FusedGroup],
+                 use_mesh: bool = True
+                 ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Device half of fuse_and_solve: dispatch each fused group, map
+    results back to input-lane order, and return arena entries to the
+    pool."""
+    results: List = [None] * len(lanes)
+    try:
+        for g in groups:
+            t0_wall = time.time()
+            t0 = time.perf_counter()
+            out = _dispatch(g.const, g.init, g.batch, g.spread_alg,
+                            g.dtype_name, use_mesh, ptab=g.ptab,
+                            pinit=g.pinit, wave=g.wave,
+                            cache_version=g.cache_version)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            metrics.sample_ms("nomad.solver.dispatch", dt_ms)
+            tracer.record("solver.dispatch", t0_wall, dt_ms,
+                          E=g.e_pad, e_real=g.e_real, P=g.p_pad,
+                          wave=bool(g.wave), A=g.A,
+                          arena_reused=bool(g.arena_reused),
+                          slow_compile=dt_ms > 1000.0)
+            if dt_ms > 1000.0:
+                # a >1s dispatch on these shapes is an XLA compile, not
+                # compute; record which variant so warm-path stalls are
+                # attributable
+                metrics.incr("nomad.solver.dispatch_slow")
+                from ..server.logbroker import log as _log
+                _log("warn", "solver",
+                     f"slow dispatch {dt_ms:.0f}ms "
+                     f"(E={g.e_pad} P={g.p_pad} wave={g.wave}"
+                     f" A={g.A}) -- likely fresh XLA compile")
+            if g.A > 0:
+                chosen, scores, n_yielded, evict_rows = out
+            else:
+                chosen, scores, n_yielded = out
+            for j, li in enumerate(g.idxs):
+                p_real = lanes[li].batch.ask_cpu.shape[0]
+                res = [np.asarray(chosen[j][:p_real]).astype(np.int64),
+                       np.asarray(scores[j][:p_real]),
+                       np.asarray(n_yielded[j][:p_real]).astype(np.int64)]
+                if g.A > 0:
+                    res.append(np.asarray(evict_rows[j][:p_real]))
+                results[li] = tuple(res)
+    finally:
+        for g in groups:
+            if g.entry is not None:
+                # device results were fetched (or the dispatch failed)
+                # before release, so no in-flight transfer reads these
+                # host buffers when the next generation refills them
+                _ARENA.release(g.entry)
+                g.entry = None
+    return results
+
+
 def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
-                   e_pad_hint: int = 0
+                   e_pad_hint: int = 0, staged: Optional[dict] = None
                    ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Group lanes by static-shape signature (placement axes pad to a
     common bucket), solve each group as ONE batched dispatch, return
@@ -185,99 +519,15 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
     retry batches come in arbitrary sizes, and every fresh E bucket is a
     fresh XLA program (seconds of compile stalling the whole batch) while
     an inert wave lane costs only O(B*P) padded compute. Dense groups
-    keep the tight bucket -- their padding costs O(N*P) per lane."""
-    results: List = [None] * len(lanes)
-    groups: Dict[tuple, List[int]] = {}
-    for i, lane in enumerate(lanes):
-        groups.setdefault(lane.fuse_key(), []).append(i)
+    keep the tight bucket -- their padding costs O(N*P) per lane.
 
-    for key, idxs in groups.items():
-        dtype_name = lanes[idxs[0]].dtype_name
-        spread_alg = lanes[idxs[0]].spread_alg
-        A = 1 if lanes[idxs[0]].ptab is not None else 0
-        e_real = len(idxs)
-        e_pad = _e_bucket(e_real)
-        if e_pad_hint and lanes[idxs[0]].wavefront_ok():
-            e_pad = max(e_pad, _e_bucket(min(e_pad_hint, E_BUCKETS[-1])))
-        # floor of 32: many lane sizes share one compiled variant (an
-        # inert padded step costs ~us; a fresh XLA compile costs seconds)
-        p_pad = max(32, _e_bucket(max(
-            lanes[i].batch.ask_cpu.shape[0] for i in idxs)))
-        # gauge, not sample_ms: this is a lane COUNT; recording it
-        # through the millisecond sampler made dashboards read "lanes"
-        # as a latency series
-        metrics.sample("nomad.solver.batch_lanes", float(e_real))
-        padded = {i: _pad_placement_axis(lanes[i].batch, p_pad)
-                  for i in idxs}
-
-        def stack(attr_get):
-            first = np.asarray(attr_get(idxs[0]))
-            out = np.empty((e_pad,) + first.shape, dtype=first.dtype)
-            out[0] = first
-            for j, li in enumerate(idxs[1:], start=1):
-                out[j] = attr_get(li)
-            for j in range(e_real, e_pad):
-                out[j] = first          # padding lane: replica of lane 0
-            return out
-
-        lane0 = lanes[idxs[0]]
-        const = type(lane0.const)(*[
-            stack(lambda i, k=k: getattr(lanes[i].const, k))
-            for k in lane0.const._fields])
-        init = type(lane0.init)(*[
-            stack(lambda i, k=k: getattr(lanes[i].init, k))
-            for k in lane0.init._fields])
-        batch = type(lane0.batch)(*[
-            stack(lambda i, k=k: getattr(padded[i], k))
-            for k in lane0.batch._fields])
-        # padding lanes must not place anything
-        if e_pad > e_real:
-            batch.active[e_real:] = False
-
-        ptab = pinit = None
-        if A > 0:
-            ptab = type(lane0.ptab)(*[
-                stack(lambda i, k=k: getattr(lanes[i].ptab, k))
-                for k in lane0.ptab._fields])
-            pinit = type(lane0.pinit)(*[
-                stack(lambda i, k=k: getattr(lanes[i].pinit, k))
-                for k in lane0.pinit._fields])
-
-        t0_wall = time.time()
-        t0 = time.perf_counter()
-        out = _dispatch(const, init, batch, spread_alg, dtype_name,
-                        use_mesh, ptab=ptab, pinit=pinit,
-                        wave=lanes[idxs[0]].wavefront_ok(),
-                        cache_version=getattr(lanes[idxs[0]],
-                                              "table_version", None))
-        dt_ms = (time.perf_counter() - t0) * 1e3
-        metrics.sample_ms("nomad.solver.dispatch", dt_ms)
-        tracer.record("solver.dispatch", t0_wall, dt_ms,
-                      E=e_pad, e_real=e_real, P=p_pad,
-                      wave=bool(lanes[idxs[0]].wavefront_ok()), A=A,
-                      slow_compile=dt_ms > 1000.0)
-        if dt_ms > 1000.0:
-            # a >1s dispatch on these shapes is an XLA compile, not compute;
-            # record which variant so warm-path stalls are attributable
-            metrics.incr("nomad.solver.dispatch_slow")
-            from ..server.logbroker import log as _log
-            _log("warn", "solver",
-                 f"slow dispatch {dt_ms:.0f}ms "
-                 f"(E={e_pad} P={p_pad} wave={lanes[idxs[0]].wavefront_ok()}"
-                 f" A={A}) -- likely fresh XLA compile")
-        if A > 0:
-            chosen, scores, n_yielded, evict_rows = out
-        else:
-            chosen, scores, n_yielded = out
-        for j, li in enumerate(idxs):
-            p_real = lanes[li].batch.ask_cpu.shape[0]
-            res = [np.asarray(chosen[j][:p_real]).astype(np.int64),
-                   np.asarray(scores[j][:p_real]),
-                   np.asarray(n_yielded[j][:p_real]).astype(np.int64)]
-            if A > 0:
-                res.append(np.asarray(evict_rows[j][:p_real]))
-            results[li] = tuple(res)
-    return results
+    ``staged`` carries groups pre-filled by the pipeline's prepare stage
+    (fuse_lanes run while the previous generation was in flight) so the
+    dispatch slot pays only device work."""
+    groups = staged.get("groups") if staged else None
+    if groups is None:
+        groups = fuse_lanes(lanes, e_pad_hint)
+    return solve_groups(lanes, groups, use_mesh=use_mesh)
 
 
 def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
@@ -614,9 +864,25 @@ class SolveBarrier:
             # async: hand the generation to the pipeline; the caller
             # (an eval thread) falls back into its cv.wait loop and is
             # woken by the completion. notify_all() is deferred to the
-            # completion path.
+            # completion path. The prepare stage fills this generation's
+            # arena buffers on the intake thread BEFORE a dispatch slot
+            # frees up, overlapping host packing with the in-flight
+            # generation's device execution.
+            staged: dict = {}
+            e_pad_hint = self._e_pad_hint
+
+            def _prepare():
+                try:
+                    staged["groups"] = fuse_lanes(lanes,
+                                                  e_pad_hint=e_pad_hint)
+                except Exception:  # noqa: BLE001 -- best effort: the
+                    staged.clear()  # dispatch re-derives (and raises
+                    raise           # under its own watchdog)
+
             _get_pipeline(self._depth).submit(
-                functools.partial(self._dispatch_job, gen, batch, lanes))
+                functools.partial(self._dispatch_job, gen, batch, lanes,
+                                  staged),
+                prepare=_prepare)
             return
 
         def solve_batch():
@@ -651,11 +917,14 @@ class SolveBarrier:
                 self._next_complete = gen + 1
             self._cv.notify_all()
 
-    def _dispatch_job(self, gen: int, batch, lanes) -> None:
+    def _dispatch_job(self, gen: int, batch, lanes,
+                      staged: Optional[dict] = None) -> None:
         """One in-flight generation, on a pipeline thread: fused
         dispatch under its own watchdog, then generation-ordered
         fixpoint + wakeup. Every cell gets exactly one result-or-error,
-        no matter what raises where."""
+        no matter what raises where. ``staged`` carries arena buffers
+        the intake thread pre-filled while the previous generation was
+        in flight."""
         results = None
         err: Optional[Exception] = None
         # explicit cross-thread handoff: this runs on a PIPELINE thread;
@@ -668,11 +937,12 @@ class SolveBarrier:
                     tracer.span("solver.fuse_dispatch", ctx=gctx,
                                 generation=gen, lanes=len(lanes),
                                 depth=self._depth,
+                                staged=bool(staged and "groups" in staged),
                                 in_flight=pipeline_state()["in_flight"]):
                 results = run_dispatch(
                     lambda: fuse_and_solve(
                         lanes, use_mesh=self._use_mesh,
-                        e_pad_hint=self._e_pad_hint),
+                        e_pad_hint=self._e_pad_hint, staged=staged),
                     label="solver.batch")
         except Exception as e:  # noqa: BLE001 -- waiters must not strand
             err = e

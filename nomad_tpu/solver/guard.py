@@ -255,6 +255,21 @@ def run_dispatch(fn, label: str = "solver.dispatch",
 # Circuit breaker
 
 
+def _invalidate_pack_layer(reason: str) -> None:
+    """Drop the host-side pack caches + fused-stack arena alongside the
+    const cache on a breaker edge. Resolved via sys.modules so a guard
+    used without the pack stack never imports it; correctness does not
+    depend on this (the caches are version/snapshot-keyed) -- it
+    guarantees nothing derived before a wedge survives past recovery."""
+    import sys as _sys
+    tp = _sys.modules.get("nomad_tpu.tensor.pack")
+    if tp is not None:
+        tp.invalidate_pack_caches(reason)
+    bt = _sys.modules.get("nomad_tpu.solver.batch")
+    if bt is not None:
+        bt.arena_clear(reason)
+
+
 def _breaker_threshold() -> int:
     return max(1, int(os.environ.get("NOMAD_TPU_BREAKER_THRESHOLD", "3")))
 
@@ -294,6 +309,7 @@ def _trip_locked(kind: str) -> None:
     # them until a recovery probe passes anyway
     from .constcache import invalidate_all
     invalidate_all("breaker trip")
+    _invalidate_pack_layer("breaker trip")
     # every in-flight eval is now degraded, not just the dispatch that
     # tripped the breaker: stamp all active traces so each one is
     # retained and attributable
@@ -354,6 +370,7 @@ def _close_breaker_locked(why: str) -> None:
     # pre-wedge transport are not trusted across a recovery
     from .constcache import invalidate_all
     invalidate_all("breaker recovery")
+    _invalidate_pack_layer("breaker recovery")
     _log("warn", "solver.guard",
          f"dispatch breaker CLOSED ({why}); dense dispatch re-enabled")
 
@@ -545,7 +562,8 @@ def state() -> dict:
                    ("state", "consecutive_failures", "trips",
                     "recoveries", "last_trip_at", "last_failure",
                     "backoff_s", "last_probe")}
-    counters = metrics.snapshot().get("counters", {})
+    _msnap = metrics.snapshot()
+    counters = _msnap.get("counters", {})
     snap["backend_unavailable_total"] = counters.get(
         "nomad.solver.backend_unavailable", 0)
     snap["host_fallback_dispatches"] = counters.get(
@@ -571,6 +589,23 @@ def state() -> dict:
     except Exception:  # noqa: BLE001 -- status must never fail the agent
         snap["dispatch_pipeline"] = {"depth": 1, "in_flight": 0,
                                      "active": False}
+    # host-side pack layer: snapshot-scoped pack caches + fused-stack
+    # arena (ISSUE 4) -- same one-glance surface as the const cache
+    try:
+        from ..tensor.pack import pack_cache_stats
+        snap["pack_cache"] = pack_cache_stats()
+    except Exception:  # noqa: BLE001 -- status must never fail the agent
+        snap["pack_cache"] = {}
+    try:
+        from .batch import arena_state
+        snap["pack_arena"] = arena_state()
+    except Exception:  # noqa: BLE001 -- status must never fail the agent
+        snap["pack_arena"] = {}
+    snap["pack"] = {
+        "ms": _msnap.get("samples", {}).get("nomad.solver.pack_ms", {}),
+        "cache_hit": counters.get("nomad.solver.pack_cache_hit", 0),
+        "cache_miss": counters.get("nomad.solver.pack_cache_miss", 0),
+    }
     snap["degraded"] = bool(
         (snap["checked"] and not snap["ok"])
         or breaker["state"] != BREAKER_CLOSED)

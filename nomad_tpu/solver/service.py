@@ -21,7 +21,9 @@ from ..structs import (
     CONSTRAINT_DISTINCT_HOSTS,
 )
 from ..tensor import (
-    pack_affinities, pack_feasibility, pack_nodes, pack_spreads, pack_usage,
+    pack_affinities, pack_affinities_cached, pack_feasibility,
+    pack_feasibility_cached, pack_nodes, pack_spreads, pack_spreads_cached,
+    pack_usage,
 )
 from ..scheduler.util import shuffled_order
 
@@ -359,9 +361,36 @@ class TpuPlacementService:
         """Marshal one TG's placements into a PackedLane (numpy-backed, no
         device dispatch). Returns None when the TG is not solver-eligible.
         (Placement-axis padding for cross-eval fusing happens in
-        solver/batch.py _pad_placement_axis.)"""
+        solver/batch.py _pad_placement_axis.) Timed into
+        ``nomad.solver.pack_ms`` with pack-cache hit/miss counters and a
+        per-eval trace event, so the host-side packing tax (and the warm
+        cut the snapshot caches buy) is measured, not inferred."""
+        import time as _time
+
+        from ..server.telemetry import metrics as _tm
+        from ..server.tracing import tracer as _tracer
+        from ..tensor.pack import begin_pack_window, end_pack_window
+
+        mark = begin_pack_window()
+        t0 = _time.perf_counter()
+        lane = self._pack_inner(tg, places, nodes, penalty_nodes_per_place)
+        dt_ms = (_time.perf_counter() - t0) * 1e3
+        hits, misses = end_pack_window(mark)
+        _tm.sample_ms("nomad.solver.pack_ms", dt_ms)
+        if hits:
+            _tm.incr("nomad.solver.pack_cache_hit", hits)
+        if misses:
+            _tm.incr("nomad.solver.pack_cache_miss", misses)
+        _tracer.event("solver.pack_cache", tg=tg.name, ms=round(dt_ms, 3),
+                      hits=hits, misses=misses,
+                      eligible=lane is not None)
+        return lane
+
+    def _pack_inner(self, tg, places, nodes, penalty_nodes_per_place=None
+                    ) -> Optional[PackedLane]:
         from .binpack import (
             PlacementBatch, make_node_const, make_node_state)
+        from ..tensor.pack import pack_cache_enabled
 
         if (not tg_solver_eligible(tg, self.job, preempt=self.preempt)
                 or not places):
@@ -403,6 +432,10 @@ class TpuPlacementService:
         if (table is not None and not table.has_port_overflow
                 and proposed_by_node is None):
             usage = self._pack_usage_from_table(table, matrix, nodes, tg)
+        elif pack_cache_enabled():
+            # incremental path: snapshot-scoped base fold + this eval's
+            # own plan deltas -- O(plan) per eval instead of O(allocs)
+            usage = self._pack_usage_incremental(matrix, nodes, tg)
         else:
             if proposed_by_node is None:
                 proposed_by_node = {
@@ -411,18 +444,27 @@ class TpuPlacementService:
             usage = pack_usage(matrix, proposed_by_node, self.job.id, tg.name,
                                self.job.namespace, nodes)
 
-        feasible = pack_feasibility(self.ctx, None, tg, nodes, n_pad,
-                                    alloc_name=places[0].name,
-                                    matrix=matrix)
+        feasible = pack_feasibility_cached(
+            self.ctx, None, tg, nodes, n_pad,
+            alloc_name=places[0].name, matrix=matrix) \
+            if pack_cache_enabled() else \
+            pack_feasibility(self.ctx, None, tg, nodes, n_pad,
+                             alloc_name=places[0].name, matrix=matrix)
 
         affinities = (list(self.job.affinities) + list(tg.affinities)
                       + [a for t in tg.tasks for a in t.affinities])
-        affinity = pack_affinities(affinities, self.ctx, nodes, n_pad)
-
         spreads = list(self.job.spreads) + list(tg.spreads)
         existing_counts = self._existing_spread_counts(spreads, tg)
-        spread_info = pack_spreads(spreads, nodes, n_pad, tg.count,
-                                   existing_counts)
+        if pack_cache_enabled():
+            affinity = pack_affinities_cached(affinities, self.ctx, nodes,
+                                              n_pad, matrix=matrix)
+            spread_info = pack_spreads_cached(spreads, nodes, n_pad,
+                                              tg.count, existing_counts,
+                                              matrix=matrix)
+        else:
+            affinity = pack_affinities(affinities, self.ctx, nodes, n_pad)
+            spread_info = pack_spreads(spreads, nodes, n_pad, tg.count,
+                                       existing_counts)
 
         distinct_job_level = any(
             c.operand == CONSTRAINT_DISTINCT_HOSTS
@@ -982,6 +1024,68 @@ class TpuPlacementService:
             used_disk=packed["used_disk"], placed_jobtg=placed,
             placed_job=placed_job, port_bitmap=packed["port_words"],
             dyn_used=packed["dyn_used"])
+        self._overlay_plan_deltas(usage, nodes, tg)
+        return usage
+
+    def _pack_usage_incremental(self, matrix, nodes, tg):
+        """Incremental usage packing (the pack-cache path when the alloc
+        table can't serve): the job-independent base fold over the
+        snapshot's allocs is memoized PER SNAPSHOT (all evals of a
+        barrier generation share it), each eval copies the base, rebuilds
+        its own job's placed counts from that job's (small) alloc set and
+        overlays only its plan deltas -- semantically identical to
+        folding ctx.proposed_allocs per node, without the per-eval
+        O(nodes x allocs) walk. Bases carrying a port bitmap are refolded
+        per eval rather than memoized (an 80MB bitmap per snapshot is the
+        same trade _pack_usage_from_table's fold cache makes)."""
+        from ..tensor.pack import UsageState, _stat_incr, fold_usage_base
+
+        snap = self.ctx.state
+        token = snap.latest_index()
+        memo = snap.__dict__.get("_usage_base_memo")
+        base = None
+        if memo is not None:
+            ent = memo.get(id(matrix))
+            # identity + index check: a live store's memo must die on any
+            # write; a snapshot's latest_index() never moves
+            if ent is not None and ent[0] is matrix and ent[1] == token:
+                base = ent[2]
+        if base is None:
+            base = fold_usage_base(
+                matrix, nodes,
+                lambda nid: [a for a in snap.allocs_by_node(nid)
+                             if not a.client_terminal_status()])
+            _stat_incr("usage_base_misses")
+            if base["ports"] is None:
+                snap.__dict__.setdefault("_usage_base_memo", {})[
+                    id(matrix)] = (matrix, token, base)
+        else:
+            _stat_incr("usage_base_hits")
+
+        n_pad = matrix.n_pad
+        placed = np.zeros(n_pad, dtype=np.int32)
+        placed_job = np.zeros(n_pad, dtype=np.int32)
+        pos_of = matrix.__dict__.get("_pos_index")
+        if pos_of is None:
+            pos_of = {nid: i for i, nid in enumerate(matrix.node_ids)}
+            matrix._pos_index = pos_of
+        for a in snap.allocs_by_job(self.job.namespace, self.job.id):
+            if a.client_terminal_status():
+                continue
+            i = pos_of.get(a.node_id)
+            if i is None:
+                continue
+            placed_job[i] += 1
+            if a.task_group == tg.name:
+                placed[i] += 1
+        usage = UsageState(
+            used_cpu=base["used_cpu"].copy(),
+            used_mem=base["used_mem"].copy(),
+            used_disk=base["used_disk"].copy(),
+            placed_jobtg=placed, placed_job=placed_job,
+            port_bitmap=(base["ports"].copy()
+                         if base["ports"] is not None else None),
+            dyn_used=base["dyn_used"].copy())
         self._overlay_plan_deltas(usage, nodes, tg)
         return usage
 

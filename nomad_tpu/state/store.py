@@ -332,6 +332,12 @@ class StateStore:
             cc = _sys.modules.get("nomad_tpu.solver.constcache")
             if cc is not None:
                 cc.note_node_table_write(self._index)
+            # ... and the host-side pack caches: matrices (with their
+            # attached feasibility/spread/affinity memos) keyed to
+            # older fleet versions can never be keyed again
+            tp = _sys.modules.get("nomad_tpu.tensor.pack")
+            if tp is not None:
+                tp.note_node_table_write(self._index)
         self._watch_cond.notify_all()
         return self._index
 

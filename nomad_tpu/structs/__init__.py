@@ -6,6 +6,7 @@ from .resources import (  # noqa: F401
     DeviceRequest, NetworkResource, NodeCpuResources, NodeDeviceResource,
     NodeDiskResources, NodeMemoryResources, NodeReservedResources,
     NodeResources, Port, Resources,
+    DEFAULT_MIN_DYNAMIC_PORT, DEFAULT_MAX_DYNAMIC_PORT,
 )
 from .job import (  # noqa: F401
     Affinity, Constraint, EphemeralDisk, Job, LogConfig, MigrateStrategy,

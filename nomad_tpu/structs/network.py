@@ -10,7 +10,10 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from .resources import AllocatedPortMapping, NetworkResource, Port
+from .resources import (
+    AllocatedPortMapping, NetworkResource, Port,
+    DEFAULT_MAX_DYNAMIC_PORT, DEFAULT_MIN_DYNAMIC_PORT,
+)
 
 MAX_VALID_PORT = 65536
 
@@ -58,8 +61,8 @@ class NetworkIndex:
     def __init__(self) -> None:
         self.used: dict = {}        # host_network name -> PortBitmap
         self.node_networks: List[NetworkResource] = []
-        self.min_dynamic_port = 20000
-        self.max_dynamic_port = 32000
+        self.min_dynamic_port = DEFAULT_MIN_DYNAMIC_PORT
+        self.max_dynamic_port = DEFAULT_MAX_DYNAMIC_PORT
 
     def _bitmap(self, host_network: str = "default") -> PortBitmap:
         bm = self.used.get(host_network)

@@ -11,6 +11,14 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
+# The agent-default dynamic port range (reference: structs.go
+# DefaultMinDynamicPort/DefaultMaxDynamicPort). ONE definition: the
+# NetworkIndex seed (structs/network.py) and the tensorizer's
+# missing-node fallback (tensor/pack.py) both read these, so the
+# fallback can never silently diverge from the struct defaults.
+DEFAULT_MIN_DYNAMIC_PORT = 20000
+DEFAULT_MAX_DYNAMIC_PORT = 32000
+
 
 @dataclass
 class Port:
@@ -133,8 +141,8 @@ class NodeResources:
     disk: NodeDiskResources = field(default_factory=NodeDiskResources)
     networks: List[NetworkResource] = field(default_factory=list)
     devices: List[NodeDeviceResource] = field(default_factory=list)
-    min_dynamic_port: int = 20000
-    max_dynamic_port: int = 32000
+    min_dynamic_port: int = DEFAULT_MIN_DYNAMIC_PORT
+    max_dynamic_port: int = DEFAULT_MAX_DYNAMIC_PORT
 
     def comparable(self) -> "ComparableResources":
         return ComparableResources(

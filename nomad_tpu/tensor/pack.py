@@ -11,10 +11,15 @@ per fleet size (SURVEY.md section 7 hard part 6: bucket-and-pad).
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..structs.resources import (
+    DEFAULT_MAX_DYNAMIC_PORT, DEFAULT_MIN_DYNAMIC_PORT,
+)
 
 PORT_WORDS = 2048          # 65536 ports / 32 bits
 DEFAULT_NODE_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
@@ -102,12 +107,121 @@ def pack_nodes(nodes, n_pad: Optional[int] = None) -> NodeMatrix:
 # node table does; cache per (node-table version, node-id tuple). The id
 # tuple guards against different filtered subsets (datacenter/pool
 # eligibility differs per job) sharing a table version. Concurrent eval
-# workers hit this, hence the lock.
+# workers hit this, hence the lock. True LRU: a hit refreshes recency
+# (move_to_end), so 8+ jobs filtering different node subsets can no
+# longer thrash the hottest entry out in insertion order.
 import threading as _threading
+from collections import OrderedDict as _OrderedDict
 
-_NODE_MATRIX_CACHE: Dict[tuple, NodeMatrix] = {}
+_NODE_MATRIX_CACHE: "_OrderedDict[tuple, NodeMatrix]" = _OrderedDict()
 _NODE_MATRIX_CACHE_MAX = 8
 _NODE_MATRIX_LOCK = _threading.Lock()
+
+# ---------------------------------------------------------------------------
+# Snapshot-scoped pack caches (perf: kill the host-side packing tax).
+#
+# Between consecutive evals the node table is usually unchanged and only
+# proposed-alloc usage deltas move (the CvxCluster observation applied to
+# the eval stream, PAPERS.md): everything derived purely from (node-table
+# version, job/TG spec) is memoized ON the version-keyed NodeMatrix --
+# feasibility masks, spread tables, affinity columns -- and the
+# job-independent usage fold is memoized per snapshot (service.py keeps
+# the base + overlays each eval's own plan deltas). Invalidation rides the
+# existing hooks: a node-table write mints a new matrix key (state/store
+# _bump also drops stale-version matrices here), and the dispatch
+# breaker's trip/recovery edges clear everything (solver/guard.py).
+#
+# Kill switch: NOMAD_TPU_PACK_CACHE=0 bypasses every memo and restores
+# the per-eval repack path bit-for-bit.
+
+
+def pack_cache_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_PACK_CACHE", "1") != "0"
+
+
+_PACK_STATS = {
+    "hits": 0,              # feasibility/spread/affinity memo hits
+    "misses": 0,
+    "matrix_hits": 0,       # node-matrix cache
+    "matrix_misses": 0,
+    "usage_base_hits": 0,   # per-snapshot usage-base fold (service.py)
+    "usage_base_misses": 0,
+    "invalidations": 0,
+}
+_PACK_STATS_LOCK = _threading.Lock()
+
+# per-matrix memo bound: one matrix serves every job shape of one fleet
+# version; a pathological spec churn clears rather than grows unbounded
+_MATRIX_MEMO_MAX = 64
+
+
+# per-thread hit/miss window: service.pack attributes cache outcomes to
+# ONE eval's pack call; reading deltas off the global counters would
+# double-count under concurrent eval threads
+_PACK_TLS = _threading.local()
+
+
+def _stat_incr(name: str, n: int = 1) -> None:
+    with _PACK_STATS_LOCK:
+        _PACK_STATS[name] += n
+    bucket = ("hit" if name.endswith("hits")
+              else "miss" if name.endswith("misses") else None)
+    if bucket is not None:
+        setattr(_PACK_TLS, bucket, getattr(_PACK_TLS, bucket, 0) + n)
+
+
+def begin_pack_window() -> Tuple[int, int]:
+    """Start of one service.pack call on this thread: returns the
+    thread-local (hits, misses) watermark."""
+    return (getattr(_PACK_TLS, "hit", 0), getattr(_PACK_TLS, "miss", 0))
+
+
+def end_pack_window(mark: Tuple[int, int]) -> Tuple[int, int]:
+    """(hits, misses) this thread recorded since ``mark``."""
+    return (getattr(_PACK_TLS, "hit", 0) - mark[0],
+            getattr(_PACK_TLS, "miss", 0) - mark[1])
+
+
+def pack_cache_stats() -> dict:
+    with _PACK_STATS_LOCK:
+        out = dict(_PACK_STATS)
+    with _NODE_MATRIX_LOCK:
+        out["matrix_entries"] = len(_NODE_MATRIX_CACHE)
+    out["enabled"] = pack_cache_enabled()
+    return out
+
+
+def invalidate_pack_caches(reason: str = "") -> None:
+    """Drop every cached matrix (the attached feasibility/spread/
+    affinity/usage memos die with them). Wired to the breaker's
+    trip/recovery edges beside the const cache; correctness never
+    depends on it (caches are version/snapshot-keyed), it guarantees a
+    clean re-derivation after a wedged-then-recovered transport."""
+    with _NODE_MATRIX_LOCK:
+        had = bool(_NODE_MATRIX_CACHE)
+        _NODE_MATRIX_CACHE.clear()
+    if had:
+        _stat_incr("invalidations")
+
+
+def note_node_table_write(table_index: int) -> None:
+    """Node-table write hook (state/store.py _bump): drop matrices (and
+    their attached memos) packed under older fleet versions -- they can
+    never be keyed again and would only squat on the LRU."""
+    with _NODE_MATRIX_LOCK:
+        stale = [k for k in _NODE_MATRIX_CACHE if k[0] < table_index]
+        for k in stale:
+            del _NODE_MATRIX_CACHE[k]
+    if stale:
+        _stat_incr("invalidations")
+
+
+def _reset_pack_caches_for_tests() -> None:
+    with _NODE_MATRIX_LOCK:
+        _NODE_MATRIX_CACHE.clear()
+    with _PACK_STATS_LOCK:
+        for k in _PACK_STATS:
+            _PACK_STATS[k] = 0
 
 
 def pack_nodes_cached(nodes, node_table_index: Optional[int],
@@ -124,14 +238,109 @@ def pack_nodes_cached(nodes, node_table_index: Optional[int],
            else tuple(n.id for n in nodes))
     with _NODE_MATRIX_LOCK:
         hit = _NODE_MATRIX_CACHE.get(key)
+        if hit is not None:
+            _NODE_MATRIX_CACHE.move_to_end(key)
     if hit is not None:
+        _stat_incr("matrix_hits")
         return hit
     matrix = pack_nodes(nodes)
+    _stat_incr("matrix_misses")
     with _NODE_MATRIX_LOCK:
         while len(_NODE_MATRIX_CACHE) >= _NODE_MATRIX_CACHE_MAX:
-            _NODE_MATRIX_CACHE.pop(next(iter(_NODE_MATRIX_CACHE)))
+            _NODE_MATRIX_CACHE.popitem(last=False)
         _NODE_MATRIX_CACHE[key] = matrix
     return matrix
+
+
+def _matrix_memo(matrix, key, build):
+    """Memoize ``build()`` on the (immutable, version-keyed) NodeMatrix.
+    Results are shared across concurrent evals, so cached arrays are
+    frozen read-only -- every consumer copies before mutating (the
+    make_node_const/state assemblers permute into fresh arrays)."""
+    if matrix is None or not pack_cache_enabled():
+        return build()
+    memo = matrix.__dict__.get("_pack_memo")
+    if memo is None:
+        memo = matrix.__dict__.setdefault("_pack_memo", {})
+    hit = memo.get(key)
+    if hit is not None:
+        _stat_incr("hits")
+        return hit[0]
+    out = build()
+    _freeze(out)
+    _stat_incr("misses")
+    if len(memo) >= _MATRIX_MEMO_MAX:
+        memo.clear()
+    memo[key] = (out,)          # tuple-wrapped: None is a valid result
+    return out
+
+
+def _freeze(obj) -> None:
+    """Mark cached numpy payloads read-only (shared across evals)."""
+    if isinstance(obj, np.ndarray):
+        obj.setflags(write=False)
+    elif isinstance(obj, SpreadInfo):
+        for arr in (obj.value_index, obj.desired, obj.has_targets,
+                    obj.weights, obj.initial_counts):
+            arr.setflags(write=False)
+
+
+def _constraints_fp(constraints) -> tuple:
+    return tuple((c.l_target, c.operand, str(c.r_target))
+                 for c in constraints)
+
+
+def pack_feasibility_cached(ctx, stack_like, tg, nodes, n_pad: int,
+                            alloc_name: str = "", matrix=None
+                            ) -> np.ndarray:
+    """pack_feasibility memoized per (node-table version, constraint
+    fingerprint): the verdict is a pure function of the job/TG spec and
+    the snapshot's nodes (check_constraint reads ctx only for its regex
+    cache), and the matrix IS the (version, node-subset) key. The
+    fingerprint covers everything the checker stack reads: job + merged
+    TG/task constraints, drivers, device asks, volumes (with the alloc
+    name, which scopes per_alloc volume claims) and the network ask."""
+    from ..scheduler.stack import _tg_constraints
+
+    job = ctx.plan.job
+    drivers, constraints = _tg_constraints(tg)
+    key = ("feas",
+           _constraints_fp(job.constraints if job else []),
+           tuple(sorted(drivers)),
+           _constraints_fp(constraints),
+           repr([r for t in tg.tasks for r in t.resources.devices]),
+           repr(tg.volumes), alloc_name if tg.volumes else "",
+           repr(tg.networks[0]) if tg.networks else "")
+    return _matrix_memo(matrix, key, lambda: pack_feasibility(
+        ctx, stack_like, tg, nodes, n_pad, alloc_name=alloc_name,
+        matrix=matrix))
+
+
+def pack_spreads_cached(spreads, nodes, n_pad: int, tg_count: int,
+                        existing_value_counts=None, matrix=None
+                        ) -> Optional[SpreadInfo]:
+    """pack_spreads memoized per (node-table version, spread-spec
+    fingerprint). The existing-alloc value counts ride the key (they
+    seed value tables and initial_counts), so two evals only share an
+    entry when the whole SpreadInfo is provably identical."""
+    if not spreads:
+        return None
+    key = ("spread", repr(spreads), int(tg_count),
+           tuple(tuple(sorted(c.items())) for c in existing_value_counts)
+           if existing_value_counts else None)
+    return _matrix_memo(matrix, key, lambda: pack_spreads(
+        spreads, nodes, n_pad, tg_count, existing_value_counts))
+
+
+def pack_affinities_cached(affinities, ctx, nodes, n_pad: int,
+                           matrix=None) -> Optional[np.ndarray]:
+    """pack_affinities memoized per (node-table version, affinity-spec
+    fingerprint)."""
+    if not affinities:
+        return None
+    key = ("aff", repr(affinities))
+    return _matrix_memo(matrix, key, lambda: pack_affinities(
+        affinities, ctx, nodes, n_pad))
 
 
 @dataclass
@@ -179,7 +388,8 @@ def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
         i = index.get(nid)
         if i is None:
             continue
-        lo, hi = dyn_ranges.get(nid, (20000, 32000))
+        lo, hi = dyn_ranges.get(nid, (DEFAULT_MIN_DYNAMIC_PORT,
+                                      DEFAULT_MAX_DYNAMIC_PORT))
         for alloc in allocs:
             cr = alloc.allocated_resources.comparable()
             used_cpu[i] += cr.cpu_shares
@@ -202,6 +412,69 @@ def pack_usage(matrix: NodeMatrix, proposed_by_node: Dict[str, list],
                       used_disk=used_disk, placed_jobtg=placed,
                       placed_job=placed_job, port_bitmap=ports,
                       dyn_used=dyn_used)
+
+
+def fold_usage_base(matrix: NodeMatrix, nodes, allocs_of) -> dict:
+    """Job-independent usage fold over one node list: what every
+    non-client-terminal alloc consumes, vectorized (np.add.at over
+    per-alloc column arrays + a deduplicated bitwise_or.at port fold)
+    instead of pack_usage's per-alloc/per-port Python loop. The result
+    is the per-snapshot BASE the incremental pack path memoizes; each
+    eval copies it and overlays only its own plan deltas
+    (solver/service.py _overlay_plan_deltas). Job-scoped placed counts
+    are NOT folded here -- they depend on the asking job and are
+    rebuilt per eval from its (small) alloc set."""
+    n_pad = matrix.n_pad
+    idx: List[int] = []
+    cpu: List[float] = []
+    mem: List[float] = []
+    disk: List[float] = []
+    port_pos: List[int] = []
+    port_val: List[int] = []
+    for i, node in enumerate(nodes):
+        for alloc in allocs_of(node.id):
+            cr = alloc.allocated_resources.comparable()
+            idx.append(i)
+            cpu.append(cr.cpu_shares)
+            mem.append(cr.memory_mb)
+            disk.append(cr.disk_mb)
+            for v in alloc.allocated_resources.all_ports():
+                if 0 <= v < 65536:
+                    port_pos.append(i)
+                    port_val.append(v)
+    used_cpu = np.zeros(n_pad, dtype=np.float64)
+    used_mem = np.zeros(n_pad, dtype=np.float64)
+    used_disk = np.zeros(n_pad, dtype=np.float64)
+    if idx:
+        ii = np.asarray(idx, dtype=np.int64)
+        np.add.at(used_cpu, ii, np.asarray(cpu, dtype=np.float64))
+        np.add.at(used_mem, ii, np.asarray(mem, dtype=np.float64))
+        np.add.at(used_disk, ii, np.asarray(disk, dtype=np.float64))
+    ports = (matrix.port_bitmap.copy()
+             if matrix.port_bitmap is not None else None)
+    dyn_used = np.zeros(n_pad, dtype=np.int32)
+    if port_pos:
+        if ports is None:
+            ports = np.zeros((n_pad, PORT_WORDS), dtype=np.uint32)
+        pp = np.asarray(port_pos, dtype=np.int64)
+        pv = np.asarray(port_val, dtype=np.int64)
+        # dedupe (node, port) pairs exactly like the scalar loop's
+        # already-set check: a port counts once per node
+        keys = np.unique(pp * 65536 + pv)
+        pp, pv = keys >> 16, keys & 0xFFFF
+        words = pv >> 5
+        bits = np.uint32(1) << (pv & 31).astype(np.uint32)
+        already = (ports[pp, words] & bits) != 0
+        np.bitwise_or.at(ports, (pp, words), bits)
+        lo = np.zeros(n_pad, dtype=np.int64)
+        hi = np.full(n_pad, -1, dtype=np.int64)
+        for i, node in enumerate(nodes):
+            lo[i] = node.node_resources.min_dynamic_port
+            hi[i] = node.node_resources.max_dynamic_port
+        in_dyn = (~already) & (pv >= lo[pp]) & (pv <= hi[pp])
+        np.add.at(dyn_used, pp[in_dyn], 1)
+    return {"used_cpu": used_cpu, "used_mem": used_mem,
+            "used_disk": used_disk, "ports": ports, "dyn_used": dyn_used}
 
 
 def pack_feasibility(ctx, stack_like, tg, nodes, n_pad: int,

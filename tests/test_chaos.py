@@ -426,6 +426,155 @@ def test_pipelined_dispatch_fault_every_waiter_exactly_one_outcome(
         batch_mod.fuse_and_solve = orig
 
 
+def test_pack_cache_never_stale_across_table_write_mid_pipeline():
+    """ISSUE 4 chaos: with the pipelined barrier (depth>1) and warm
+    pack caches, a node-table write + alloc write landing BETWEEN
+    generations must never let an eval solve against a stale usage base
+    or stale fleet tables -- the post-write generation's placements
+    must equal an uncached (NOMAD_TPU_PACK_CACHE=0) control solved from
+    the same snapshot."""
+    import os
+    import threading
+
+    import numpy as np
+
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.reconcile import AllocPlaceResult
+    from nomad_tpu.solver.batch import SolveBarrier
+    from nomad_tpu.solver.service import TpuPlacementService
+    from nomad_tpu.structs import Plan
+    from nomad_tpu.tensor import pack as tpack
+
+    tpack._reset_pack_caches_for_tests()
+    h = Harness()
+    nodes = []
+    for i in range(8):
+        n = mock.node()
+        n.id = f"stale-node-{i:04d}"
+        n.compute_class()
+        nodes.append(n)
+        h.state.upsert_node(n)
+
+    def pack_round(tag, node_list):
+        snap = h.state.snapshot()
+        lanes = []
+        for i in range(2):
+            job = mock.job(id=f"stale-job-{tag}-{i}")
+            job.task_groups[0].count = 3
+            tg = job.task_groups[0]
+            plan = Plan(eval_id=f"stale-eval-{tag}-{i:021d}"[-36:],
+                        priority=50, job=job)
+            ctx = EvalContext(snap, plan)
+            places = [AllocPlaceResult(name=f"{job.id}.{tg.name}[{k}]",
+                                       task_group=tg) for k in range(3)]
+            svc = TpuPlacementService(ctx, job, batch_mode=False,
+                                      spread_alg=False)
+            lane = svc.pack(tg, places, node_list)
+            assert lane is not None
+            lanes.append(lane)
+        return lanes
+
+    def run_barrier(lanes):
+        barrier = SolveBarrier(participants=len(lanes), depth=2)
+        out = {}
+
+        def worker(i):
+            out[i] = barrier.solve(lanes[i])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(lanes))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert sorted(out) == list(range(len(lanes)))
+        return [out[i] for i in range(len(lanes))]
+
+    # generation 1: warms the matrix cache, spec memos, usage base and
+    # the fused-stack arena
+    run_barrier(pack_round("warm", nodes))
+
+    # mid-pipeline world change: a new node (table write) AND a new
+    # running alloc eating capacity on node 0
+    extra = mock.node()
+    extra.id = "stale-node-extra"
+    extra.compute_class()
+    h.state.upsert_node(extra)
+    filler = mock.job(id="stale-filler")
+    filler.task_groups[0].tasks[0].resources.cpu = 4000
+    h.state.upsert_job(filler)
+    a = mock.alloc_for(filler, nodes[0])
+    a.client_status = "running"
+    h.state.upsert_allocs([a])
+    all_nodes = nodes + [extra]
+
+    # generation 2 packs from the NEW snapshot with warm caches
+    hot = run_barrier(pack_round("after", all_nodes))
+
+    # control: identical evals, every pack cache disabled
+    os.environ["NOMAD_TPU_PACK_CACHE"] = "0"
+    os.environ["NOMAD_TPU_PACK_ARENA"] = "0"
+    try:
+        cold = run_barrier(pack_round("after", all_nodes))
+    finally:
+        os.environ.pop("NOMAD_TPU_PACK_CACHE", None)
+        os.environ.pop("NOMAD_TPU_PACK_ARENA", None)
+    for a_res, b_res in zip(hot, cold):
+        assert (np.asarray(a_res[0]) == np.asarray(b_res[0])).all(), \
+            "eval solved against a stale pack cache"
+
+
+def test_pack_caches_invalidate_across_breaker_trip_and_recovery(
+        monkeypatch):
+    """Fill the host pack caches + arena, trip the breaker, recover:
+    both edges must drop them (nothing derived before the wedge
+    survives past recovery), and packing works again after."""
+    from nomad_tpu import mock as _mock
+    from nomad_tpu.solver import batch as batch_mod
+    from nomad_tpu.tensor import pack as tpack
+
+    monkeypatch.setenv("NOMAD_TPU_BREAKER_BACKOFF", "30")
+    _fast_probe_pass(monkeypatch)
+    tpack._reset_pack_caches_for_tests()
+    batch_mod.arena_clear("test baseline")
+
+    nodes = []
+    for i in range(4):
+        n = _mock.node()
+        n.id = f"trip-node-{i:04d}"
+        n.compute_class()
+        nodes.append(n)
+    tpack.pack_nodes_cached(nodes, 5)
+    ent, _ = batch_mod._ARENA.acquire(
+        ("trip", 2, 32), {"t": [((2, 8), __import__("numpy")
+                                 .dtype("float64"))]})
+    batch_mod._ARENA.release(ent)
+    assert len(tpack._NODE_MATRIX_CACHE) == 1
+    assert batch_mod.arena_state()["entries"] == 1
+
+    for _ in range(guard._breaker_threshold()):
+        guard.record_dispatch_failure("timeout")
+    assert guard.breaker_state()["state"] == guard.BREAKER_OPEN
+    assert len(tpack._NODE_MATRIX_CACHE) == 0, \
+        "trip must drop pack caches"
+    assert batch_mod.arena_state()["entries"] == 0, \
+        "trip must drop pooled arena buffers"
+    assert tpack.pack_cache_stats()["invalidations"] >= 1
+
+    # refill while open; the recovery edge re-baselines again
+    tpack.pack_nodes_cached(nodes, 6)
+    guard.reset_breaker()
+    assert guard.breaker_state()["state"] == guard.BREAKER_CLOSED
+    assert len(tpack._NODE_MATRIX_CACHE) == 0, \
+        "recovery must re-baseline the pack caches"
+    assert tpack.pack_cache_stats()["invalidations"] >= 2
+
+    # and the cache works normally after the cycle
+    m = tpack.pack_nodes_cached(nodes, 7)
+    assert tpack.pack_nodes_cached(nodes, 7) is m
+
+
 def test_const_cache_invalidates_across_breaker_trip_and_recovery(
         monkeypatch):
     """Fill the device-resident cache, trip the breaker, recover: the
