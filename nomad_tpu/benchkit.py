@@ -115,6 +115,141 @@ def export_chrome_trace(path: str) -> "str | None":
     return path
 
 
+def run_scale_northstar(target_allocs: int, n_nodes: int = 10000,
+                        e_evals: int = 32, per_eval: int = 2000,
+                        round_timeout_s: float = 300.0,
+                        log=None) -> dict:
+    """The north-star-scale shape: drive ``target_allocs`` LIVE
+    allocations through the full production batched pipeline (Server +
+    BatchWorker eval coalescing + SolveBarrier fused dispatch +
+    group-commit plan applier) WITHOUT draining between rounds, so the
+    state store, alloc table and applier carry the accumulated fleet the
+    whole way -- the number the ROADMAP's north star is phrased in,
+    measured instead of extrapolated.
+
+    Scale hygiene baked in: the AllocTable is preallocated to the target
+    (no doubling copies under the store lock), per-placement
+    explainability stubs are pruned (NOMAD_TPU_LEAN_ALLOC_METRICS), and
+    the peak RSS rides the returned dict so the memory ceiling is part
+    of the artifact. The same code path shrinks to a tier-1 smoke at a
+    few thousand allocs (tests/test_scale_northstar.py).
+
+    Returns {"allocs", "wall_s", "placements_per_sec", "rss_mb",
+    "rounds", "truncated"}."""
+    import os
+    import resource
+    import time
+
+    from . import mock
+    from .server import Server
+    from .structs import SchedulerConfiguration
+
+    def say(msg):
+        if log is not None:
+            log(msg)
+
+    allocs_per_node = max(1, (target_allocs + n_nodes - 1) // n_nodes)
+    rounds = max(1, (target_allocs + e_evals * per_eval - 1)
+                 // (e_evals * per_eval))
+    prev_lean = os.environ.get("NOMAD_TPU_LEAN_ALLOC_METRICS")
+    os.environ["NOMAD_TPU_LEAN_ALLOC_METRICS"] = "1"
+    server = Server(num_workers=e_evals, heartbeat_ttl=3600.0,
+                    eval_batching=True, batch_width=e_evals)
+    server.state.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm="tpu-binpack"))
+    server.state.alloc_table.preallocate(
+        int(target_allocs * 1.1) + e_evals * per_eval)
+    server.start()
+    placed_total = 0
+    truncated = False
+    try:
+        # fleet provisioned so the target fits with ~40% headroom at
+        # 10cpu/32mb/10disk per alloc (tiny asks: the point is the alloc
+        # COUNT, not per-alloc weight)
+        for i in range(n_nodes):
+            n = mock.node()
+            n.id = f"nstar-node-{i:06d}"
+            n.node_resources.cpu.cpu_shares = int(allocs_per_node * 14)
+            n.node_resources.memory.memory_mb = int(allocs_per_node * 45)
+            n.node_resources.disk.disk_mb = int(allocs_per_node * 14)
+            n.compute_class()
+            server.register_node(n)
+        say(f"northstar: fleet up ({n_nodes} nodes, "
+            f"{rounds} rounds x {e_evals}x{per_eval})")
+
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            jobs = []
+            for i in range(e_evals):
+                job = mock.job(id=f"nstar-{r:03d}-{i:02d}")
+                tg = job.task_groups[0]
+                tg.count = per_eval
+                tg.ephemeral_disk.size_mb = 10
+                tg.tasks[0].resources.cpu = 10
+                tg.tasks[0].resources.memory_mb = 32
+                jobs.append(job)
+            for job in jobs:
+                server.register_job(job)
+            want = e_evals * per_eval
+            deadline = time.time() + round_timeout_s
+            placed = 0
+            while time.time() < deadline:
+                approx = sum(
+                    server.state.num_allocs_by_job(job.namespace, job.id)
+                    for job in jobs)
+                if approx >= want:
+                    placed = sum(
+                        1 for job in jobs
+                        for a in server.state.allocs_by_job(
+                            job.namespace, job.id)
+                        if a.desired_status == "run")
+                    if placed >= want:
+                        break
+                time.sleep(0.05)
+            else:
+                placed = sum(
+                    1 for job in jobs
+                    for a in server.state.allocs_by_job(job.namespace,
+                                                        job.id)
+                    if a.desired_status == "run")
+            placed_total += placed
+            if placed < want:
+                truncated = True
+                say(f"northstar: round {r} TRUNCATED "
+                    f"({placed}/{want}); stopping at {placed_total}")
+                break
+            # scale hygiene: the round's placements are LIVE for the
+            # rest of the run -- freeze them into the permanent GC
+            # generation so full collections (which JAX hooks with a
+            # per-collection callback) stop re-walking millions of
+            # immortal allocs
+            import gc
+            gc.collect()
+            gc.freeze()
+            if (r + 1) % 4 == 0 or r + 1 == rounds:
+                dt_so_far = time.perf_counter() - t0
+                say(f"northstar: {placed_total} live allocs after "
+                    f"round {r + 1}/{rounds} "
+                    f"({placed_total / dt_so_far:.0f}/s)")
+        wall = time.perf_counter() - t0
+    finally:
+        if prev_lean is None:
+            os.environ.pop("NOMAD_TPU_LEAN_ALLOC_METRICS", None)
+        else:
+            os.environ["NOMAD_TPU_LEAN_ALLOC_METRICS"] = prev_lean
+        server.shutdown()
+    rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    return {
+        "allocs": placed_total,
+        "wall_s": round(wall, 3),
+        "placements_per_sec": round(placed_total / wall, 2) if wall
+        else 0.0,
+        "rss_mb": round(rss_mb, 1),
+        "rounds": rounds,
+        "truncated": truncated,
+    }
+
+
 def make_fleet(rng: random.Random, h, n_nodes: int,
                racks: int = RACK_COUNT, gpus: bool = False) -> List:
     """Heterogeneous fleet: 3 machine classes, rack + datacenter spread

@@ -20,6 +20,10 @@ Points wired through the codebase:
   worker.invoke     server/worker.py invoke_scheduler -- an armed error
                     nacks the eval (broker requeue must not lose it)
   plan.apply        server/plan_apply.py Planner.apply
+  plan.commit       state/store.py apply_plan_results_batch -- fires
+                    per plan BEFORE its writes stage, so an armed fault
+                    splits a group commit around the injected plan
+                    (survivors commit exactly once)
   broker.dequeue    server/broker.py EvalBroker.dequeue
   heartbeat         server/core.py Server.heartbeat
   raft.rpc          raft/transport.py TcpTransport.send (delay/drop)

@@ -10,7 +10,8 @@ import time as _time
 from typing import Dict, List, Optional, Set
 
 from ..structs import (
-    AllocatedResources, AllocatedSharedResources, Allocation, Evaluation, Job,
+    AllocatedResources, AllocatedSharedResources, Allocation, AllocMetric,
+    Evaluation, Job,
     Plan, PlanResult, RescheduleEvent, RescheduleTracker, generate_uuid,
     ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST, ALLOC_DESIRED_RUN,
     ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
@@ -474,8 +475,20 @@ class GenericScheduler:
                 if sp.alloc_resources is not None
                 else AllocatedSharedResources(
                     disk_mb=tg.ephemeral_disk.size_mb))
-        metrics = self.ctx.metrics.copy()
-        metrics.nodes_evaluated = sp.n_yielded
+        import os as _os
+        if _os.environ.get("NOMAD_TPU_LEAN_ALLOC_METRICS", "") == "1":
+            # pruned stub for north-star-scale runs: the full per-
+            # placement AllocMetric copy is ~10 container objects and
+            # ~15us apiece -- at 2M live allocs that is GBs of resident
+            # explainability detail. The lean stub keeps the fields
+            # `alloc status` renders headline numbers from; placements
+            # are identical either way (metrics are explanatory only).
+            metrics = AllocMetric(nodes_evaluated=sp.n_yielded,
+                                  nodes_in_pool=self.ctx.metrics
+                                  .nodes_in_pool)
+        else:
+            metrics = self.ctx.metrics.copy_for_alloc()
+            metrics.nodes_evaluated = sp.n_yielded
         metrics.score_node(sp.node.id, "normalized-score", sp.score)
         if sp.preempted_allocs:
             # same component the host records (rank.py:575

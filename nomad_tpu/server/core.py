@@ -105,6 +105,10 @@ class Server:
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
         self.planner = Planner(self.state)
+        # group commit: one blocked-evals unblock sweep per committed
+        # plan BATCH (the per-plan sweep in on_plan_result is skipped
+        # for batch-committed results)
+        self.planner.on_batch_commit = self._on_plan_batch_commit
         self.num_workers = num_workers or max(2, (os.cpu_count() or 4))
         # Eval coalescing (solver/batch.py): one BatchWorker running
         # num_workers eval threads per batch replaces the plain worker
@@ -1016,16 +1020,34 @@ class Server:
 
     # ------------------------------------------------------------------
     # Worker callbacks
-    def on_plan_result(self, plan: Plan, result: PlanResult) -> None:
-        # Freed capacity (stops/preemptions) unblocks class-keyed evals
-        # (reference: FSM hooks into BlockedEvals on alloc updates)
+    def _on_plan_batch_commit(self, results: List[PlanResult]) -> None:
+        """ONE unblock sweep for a whole committed plan batch: the freed
+        classes of every plan in the group union before sweeping, so N
+        batched plans cost one BlockedEvals pass per class instead of N
+        (called from the plan applier's committer thread)."""
         freed_classes = set()
-        for node_id in list(result.node_update) + list(result.node_preemptions):
-            node = self.state.node_by_id(node_id)
-            if node is not None:
-                freed_classes.add(node.computed_class)
+        for result in results:
+            for node_id in (list(result.node_update)
+                            + list(result.node_preemptions)):
+                node = self.state.node_by_id(node_id)
+                if node is not None:
+                    freed_classes.add(node.computed_class)
         for cls in freed_classes:
             self.blocked_evals.unblock(cls)
+
+    def on_plan_result(self, plan: Plan, result: PlanResult) -> None:
+        # Freed capacity (stops/preemptions) unblocks class-keyed evals
+        # (reference: FSM hooks into BlockedEvals on alloc updates);
+        # batch-committed results were already swept once per group
+        if not getattr(result, "batch_unblocked", False):
+            freed_classes = set()
+            for node_id in (list(result.node_update)
+                            + list(result.node_preemptions)):
+                node = self.state.node_by_id(node_id)
+                if node is not None:
+                    freed_classes.add(node.computed_class)
+            for cls in freed_classes:
+                self.blocked_evals.unblock(cls)
         if not result.is_no_op():
             self.publish_event("PlanApplied", {
                 "eval_id": plan.eval_id,

@@ -11,6 +11,7 @@ retries against fresher state (generic_sched.go:330-356 contract).
 from __future__ import annotations
 
 import heapq
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -25,6 +26,37 @@ from .telemetry import metrics
 from .tracing import tracer
 
 
+def _batch_enabled() -> bool:
+    """NOMAD_TPU_PLAN_BATCH=0 is the kill switch: the dispatcher drains
+    one plan at a time and commits through the legacy single-plan path,
+    bit-for-bit the pre-group-commit applier."""
+    return os.environ.get("NOMAD_TPU_PLAN_BATCH", "1") != "0"
+
+
+def _batch_max() -> int:
+    try:
+        return max(1, int(os.environ.get("NOMAD_TPU_PLAN_BATCH_MAX",
+                                         "64")))
+    except ValueError:
+        return 64
+
+
+def _batch_window_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get(
+            "NOMAD_TPU_PLAN_BATCH_WINDOW_MS", "100"))) / 1e3
+    except ValueError:
+        return 0.1
+
+
+class _BatchPartial(Exception):
+    """A group commit landed for SOME of its plans only (per-plan staging
+    failure or a transaction split). Raised out of the committer future
+    so the dispatcher's next cycle re-verifies against clean state
+    instead of the now-wrong overlay; every waiter was already resolved
+    individually before this is raised."""
+
+
 class BadNodeTracker:
     """Tracks nodes that repeatedly reject plans (reference:
     plan_apply_node_tracker.go). Exceeding the threshold emits telemetry;
@@ -35,6 +67,23 @@ class BadNodeTracker:
         self.window = window
         self._hits: Dict[str, List[float]] = {}
         self._lock = threading.Lock()
+        self._last_sweep = time.time()
+
+    def _sweep_locked(self, now: float) -> None:
+        # bound the per-node dict: a node id whose whole window expired
+        # is dropped entirely. Without this the dict only ever grows --
+        # a 2M-alloc run that brushes every node id would hold every
+        # one of them for the process lifetime.
+        if now - self._last_sweep < self.window:
+            return
+        self._last_sweep = now
+        cutoff = now - self.window
+        for nid in list(self._hits):
+            hits = self._hits[nid]
+            while hits and hits[0] < cutoff:
+                hits.pop(0)
+            if not hits:
+                del self._hits[nid]
 
     def add(self, node_id: str) -> bool:
         """Record a rejection; True if the node is now 'bad'."""
@@ -45,11 +94,23 @@ class BadNodeTracker:
             cutoff = now - self.window
             while hits and hits[0] < cutoff:
                 hits.pop(0)
+            self._sweep_locked(now)
             return len(hits) >= self.threshold
 
     def score(self, node_id: str) -> int:
+        now = time.time()
         with self._lock:
-            return len(self._hits.get(node_id, ()))
+            hits = self._hits.get(node_id)
+            if hits is None:
+                return 0
+            cutoff = now - self.window
+            while hits and hits[0] < cutoff:
+                hits.pop(0)
+            if not hits:
+                del self._hits[node_id]
+                return 0
+            self._sweep_locked(now)
+            return len(hits)
 
 
 class _OverlaySnapshot:
@@ -78,6 +139,19 @@ class _OverlaySnapshot:
             if a.id not in have:
                 out.append(a)
         return out
+
+
+def _merge_results(results: List[PlanResult]) -> PlanResult:
+    """One PlanResult overlaying a whole in-flight batch. The group's
+    node sets are pairwise disjoint by construction, so the per-node
+    dict merges can never collide."""
+    merged = PlanResult(node_update={}, node_allocation={},
+                        node_preemptions={})
+    for r in results:
+        merged.node_update.update(r.node_update)
+        merged.node_allocation.update(r.node_allocation)
+        merged.node_preemptions.update(r.node_preemptions)
+    return merged
 
 
 class _Pending:
@@ -116,10 +190,24 @@ class Planner:
     capacity from stops, which the re-verify covers). Verification fans
     out per node across a pool sized NumCPU/2 like the reference's
     EvaluatePool (plan_apply.go:113-118).
+
+    GROUP COMMIT (the WAL / raft batched-apply move): instead of one
+    plan per cycle, the dispatcher drains every queued plan whose node
+    set is pairwise disjoint from the plans ahead of it (a cheap bitset
+    test over AllocTable node slots -- disjoint plans cannot observe
+    each other, so verifying them against one shared snapshot equals
+    serial verification) and commits the group as ONE store transaction:
+    one lock acquisition, one raft index bump, one snapshot
+    invalidation, one blocked-evals unblock sweep. The first plan whose
+    node set overlaps the group ends it -- it and everything behind it
+    fall back to today's serial order (requeued ahead of the next
+    cycle), so an overlapping plan never commits out of queue order.
+    The solve barrier hints an incoming fused generation
+    (``expect_plans``) so all of its plans land in one group instead of
+    trickling into several. ``NOMAD_TPU_PLAN_BATCH=0`` kills all of it.
     """
 
     def __init__(self, state: StateStore, pool_size: Optional[int] = None):
-        import os
         self.state = state
         self.bad_nodes = BadNodeTracker()
         pool_size = pool_size or max(1, (os.cpu_count() or 2) // 2)
@@ -129,6 +217,15 @@ class Planner:
             max_workers=1, thread_name_prefix="plan-commit")
         self.plans_applied = 0
         self.plans_rejected = 0
+        self.batches_committed = 0
+        # one unblock sweep per committed batch (server wires this to
+        # BlockedEvals; None = every plan unblocks individually via
+        # server.on_plan_result, the legacy path)
+        self.on_batch_commit = None
+        # group-submission hint state (expect_plans)
+        self._expect_n = 0
+        self._expect_rolling = 0.0
+        self._expect_hard = 0.0
         # priority plan queue (reference: plan_queue.go:99)
         self._cv = threading.Condition()
         self._heap: List[tuple] = []
@@ -164,6 +261,11 @@ class Planner:
                                trace_ctx=tracer.current())
             heapq.heappush(self._heap,
                            (-plan.priority, pending.seq, pending))
+            if self._expect_n > 0:
+                # one expected group member arrived: roll the window so
+                # the drain keeps holding while the generation streams in
+                self._expect_n -= 1
+                self._expect_rolling = time.monotonic() + _batch_window_s()
             metrics.sample("nomad.plan.queue_depth",
                            float(len(self._heap)))
             self._cv.notify()
@@ -172,12 +274,31 @@ class Planner:
             raise pending.error
         return pending.result
 
+    def expect_plans(self, n: int) -> None:
+        """Group-submission hint from the solve barrier: ~n plans from
+        one fused generation are about to be submitted, so the
+        dispatcher holds its drain briefly and commits them as one
+        group. Purely advisory -- a rolling per-arrival window plus a
+        hard deadline bound the wait, so over-counted hints (multi-TG
+        evals rendezvous once per TG; failed evals submit nothing) cost
+        at most the window."""
+        if n <= 0 or not _batch_enabled():
+            return
+        w = _batch_window_s()
+        now = time.monotonic()
+        with self._cv:
+            self._expect_n += n
+            self._expect_rolling = now + w
+            self._expect_hard = max(self._expect_hard, now + 10 * w)
+            self._cv.notify_all()
+
     # ------------------------------------------------------------------
     def _dispatch_loop(self) -> None:
-        # (future, PlanResult, _Pending); commits resolve their own
-        # waiters (success AND failure), so the dispatcher never has to
-        # drain eagerly -- it keeps verifying new arrivals while the
-        # commit replicates, which is the pipeline
+        # inflight = (future, merged PlanResult overlay, commit items);
+        # commits resolve their own waiters (success AND failure), so
+        # the dispatcher never has to drain eagerly -- it keeps
+        # verifying new arrivals while the commit replicates, which is
+        # the pipeline
         inflight: Optional[tuple] = None
         while True:
             with self._cv:
@@ -185,79 +306,235 @@ class Planner:
                     self._cv.wait(0.5)
                 if self._shutdown and not self._heap:
                     break
-                item = heapq.heappop(self._heap)[2]
+                items = self._drain_locked()
+            group = items
+            if len(items) > 1:
+                group, rest = self._select_group(items)
+                if rest:
+                    # conflicting plans (and everything behind them) go
+                    # back to the queue BEFORE any processing, so a
+                    # failure below can never error-resolve a plan that
+                    # is still queued for a later commit
+                    with self._cv:
+                        for it in rest:
+                            heapq.heappush(
+                                self._heap,
+                                (-it.plan.priority, it.seq, it))
+                        self._cv.notify()
             try:
-                inflight = self._process(item, inflight)
-            except BaseException as e:  # noqa: BLE001 -- waiter must wake
-                item.resolve(error=e)
+                inflight = self._process_batch(group, inflight)
+            except BaseException as e:  # noqa: BLE001 -- waiters must wake
+                for it in group:
+                    if not it.event.is_set():
+                        it.resolve(error=e)
         if inflight is not None:
             try:
                 inflight[0].result()
             except BaseException:  # noqa: BLE001 -- shutdown drain
                 pass
 
-    def _process(self, item: _Pending, inflight):
-        """Verify one plan (overlaying the in-flight commit), then submit
-        its commit asynchronously. Returns the new in-flight tuple."""
+    def _drain_locked(self) -> List[_Pending]:
+        """Pop the next commit candidates (cv held, heap non-empty).
+        Serial mode pops exactly one; batch mode drains everything
+        queued, first holding for the barrier's expected group within
+        the rolling window."""
+        if not _batch_enabled():
+            return [heapq.heappop(self._heap)[2]]
+        while self._expect_n > 0 and not self._shutdown:
+            now = time.monotonic()
+            deadline = min(self._expect_rolling, self._expect_hard)
+            if now >= deadline:
+                self._expect_n = 0      # hint over-counted: stop waiting
+                break
+            self._cv.wait(deadline - now)
+        items = []
+        limit = _batch_max()
+        while self._heap and len(items) < limit:
+            items.append(heapq.heappop(self._heap)[2])
+        return items
+
+    # ------------------------------------------------------------------
+    def _plan_node_keys(self, plan: Plan) -> Tuple[List[int], set]:
+        """The plan's touched nodes as AllocTable slots (the bitset
+        domain) plus any ids the table has never seen."""
+        table = self.state.alloc_table
+        slots: List[int] = []
+        unknown: set = set()
+        for src in (plan.node_allocation, plan.node_update,
+                    plan.node_preemptions):
+            for nid in src:
+                s = table.node_slot_of(nid)
+                if s >= 0:
+                    slots.append(s)
+                else:
+                    unknown.add(nid)
+        return slots, unknown
+
+    def _select_group(self, items: List[_Pending]
+                      ) -> Tuple[List[_Pending], List[_Pending]]:
+        """Maximal pairwise-DISJOINT prefix in queue order. Disjoint
+        node sets cannot observe each other, so the group verifies
+        against one shared snapshot and commits as one transaction with
+        results identical to serial order. The first overlapping plan
+        ends the group -- it and everything behind it keep today's
+        serial order (a later plan must never commit ahead of an
+        earlier one whose verification could see it)."""
+        import numpy as np
+        table = self.state.alloc_table
+        claimed = np.zeros(max(table.n_nodes, 1), dtype=bool)
+        claimed_unknown: set = set()
+        group: List[_Pending] = []
+        for k, it in enumerate(items):
+            slots, unknown = self._plan_node_keys(it.plan)
+            arr = np.asarray(slots, dtype=np.int64) if slots else None
+            if ((arr is not None and bool(claimed[arr].any()))
+                    or (unknown
+                        and not claimed_unknown.isdisjoint(unknown))):
+                metrics.incr("nomad.plan.batch_conflict_serialized")
+                return group, items[k:]
+            if arr is not None:
+                claimed[arr] = True
+            claimed_unknown |= unknown
+            group.append(it)
+        return group, []
+
+    def _process_batch(self, items: List[_Pending], inflight):
+        """Verify a group of plans (overlaying the in-flight commit),
+        then submit ONE grouped commit asynchronously. Returns the new
+        in-flight tuple. The caller already reduced ``items`` to a
+        pairwise-disjoint group."""
+        metrics.sample("nomad.plan.batch_size", float(len(items)))
+
         snapshot = self.state.snapshot()
         overlaid = (_OverlaySnapshot(snapshot, inflight[1])
                     if inflight is not None else snapshot)
-        with metrics.measure("nomad.plan.evaluate"), \
-                tracer.span("plan.evaluate", ctx=item.trace_ctx,
-                            overlay=inflight is not None,
-                            nodes=len(item.plan.node_allocation)):
-            result = self._evaluate_plan(overlaid, item.plan)
+        results = []
+        for it in items:
+            with metrics.measure("nomad.plan.evaluate"), \
+                    tracer.span("plan.evaluate", ctx=it.trace_ctx,
+                                overlay=inflight is not None,
+                                nodes=len(it.plan.node_allocation)):
+                results.append(self._evaluate_plan(overlaid, it.plan))
 
         # serialize commits: wait for the previous one (its replication
         # overlapped this verification, which is the whole point)
         if inflight is not None:
-            prev_future = inflight[0]
             try:
-                prev_future.result()    # waiter resolved inside commit()
+                inflight[0].result()   # waiters resolved inside commit
                 prev_ok = True
             except BaseException:  # noqa: BLE001
                 prev_ok = False
             if not prev_ok:
-                # the overlay assumed a commit that never landed --
-                # freed-capacity assumptions may be wrong: re-verify clean
-                with metrics.measure("nomad.plan.evaluate"), \
-                        tracer.span("plan.evaluate", ctx=item.trace_ctx,
-                                    overlay=False, reverify=True):
-                    result = self._evaluate_plan(self.state.snapshot(),
-                                                 item.plan)
+                # the overlay assumed a commit that never (fully)
+                # landed -- freed-capacity assumptions may be wrong:
+                # re-verify the whole group clean
+                fresh = self.state.snapshot()
+                results = []
+                for it in items:
+                    with metrics.measure("nomad.plan.evaluate"), \
+                            tracer.span("plan.evaluate",
+                                        ctx=it.trace_ctx,
+                                        overlay=False, reverify=True):
+                        results.append(
+                            self._evaluate_plan(fresh, it.plan))
 
         # bad-node hits are recorded ONCE, for the result that actually
         # decides the plan (a discarded overlay pass must not count)
-        for node_id in result.rejected_nodes:
-            self.bad_nodes.add(node_id)
-
-        if result.is_no_op() and not item.plan.is_no_op():
-            result.refresh_index = self.state.latest_index()
-            self.plans_rejected += 1
-            tracer.event("plan.rejected", ctx=item.trace_ctx,
-                         rejected=len(result.rejected_nodes))
-            item.resolve(result=result)
+        commit_items: List[Tuple[_Pending, PlanResult]] = []
+        for it, result in zip(items, results):
+            for node_id in result.rejected_nodes:
+                self.bad_nodes.add(node_id)
+            if result.is_no_op() and not it.plan.is_no_op():
+                result.refresh_index = self.state.latest_index()
+                self.plans_rejected += 1
+                tracer.event("plan.rejected", ctx=it.trace_ctx,
+                             rejected=len(result.rejected_nodes))
+                it.resolve(result=result)
+            else:
+                commit_items.append((it, result))
+        if not commit_items:
             return None
 
-        def commit(item=item, result=result):
-            try:
-                with metrics.measure("nomad.plan.commit"), \
-                        tracer.span("plan.commit", ctx=item.trace_ctx,
-                                    rejected=len(result.rejected_nodes)):
-                    index = self.state.upsert_plan_results(
-                        result, item.eval_updates)
-            except BaseException as e:  # noqa: BLE001 -- waiter must wake
-                item.resolve(error=e)
-                raise
-            result.alloc_index = index
-            if result.rejected_nodes:
-                result.refresh_index = index
-            self.plans_applied += 1
-            item.resolve(result=result)
-            return index
+        if len(commit_items) == 1:
+            it, result = commit_items[0]
+            future = self._committer.submit(self._commit_one, it, result)
+            return (future, result, commit_items)
+        future = self._committer.submit(self._commit_group, commit_items)
+        overlay = _merge_results([r for _, r in commit_items])
+        return (future, overlay, commit_items)
 
-        future = self._committer.submit(commit)
-        return (future, result, item)
+    def _commit_one(self, item: _Pending, result: PlanResult) -> int:
+        """The legacy single-plan commit (also the batch-of-one path, so
+        NOMAD_TPU_PLAN_BATCH=0 is bit-for-bit the old applier)."""
+        try:
+            with metrics.measure("nomad.plan.commit"), \
+                    tracer.span("plan.commit", ctx=item.trace_ctx,
+                                batch=1,
+                                rejected=len(result.rejected_nodes)):
+                index = self.state.upsert_plan_results(
+                    result, item.eval_updates)
+        except BaseException as e:  # noqa: BLE001 -- waiter must wake
+            item.resolve(error=e)
+            raise
+        result.alloc_index = index
+        if result.rejected_nodes:
+            result.refresh_index = index
+        self.plans_applied += 1
+        item.resolve(result=result)
+        return index
+
+    def _commit_group(self, commit_items) -> int:
+        """One grouped store transaction for N disjoint verified plans.
+        A whole-transaction failure splits the batch: each plan retries
+        serially so survivors still commit exactly once; per-plan
+        staging failures (the plan.commit chaos point) resolve only
+        their own waiter. Either failure mode poisons the overlay (the
+        raised exception) so the next cycle re-verifies clean."""
+        n = len(commit_items)
+        gctx = tracer.group([it.trace_ctx for it, _ in commit_items])
+        entries = [(r, it.eval_updates) for it, r in commit_items]
+        try:
+            with metrics.measure("nomad.plan.commit"), \
+                    tracer.activate(gctx), \
+                    tracer.span("plan.commit", ctx=gctx, batch=n,
+                                rejected=sum(len(r.rejected_nodes)
+                                             for _, r in commit_items)):
+                index, outcomes = self.state.apply_plan_results_batch(
+                    entries)
+        except BaseException:  # noqa: BLE001 -- split the batch
+            for it, r in commit_items:
+                if it.event.is_set():
+                    continue
+                try:
+                    self._commit_one(it, r)
+                except BaseException:  # noqa: BLE001 -- keep splitting
+                    pass               # (waiter already resolved inside)
+            raise _BatchPartial("group commit split to serial")
+
+        committed: List[PlanResult] = []
+        failed = False
+        for (it, r), out in zip(commit_items, outcomes):
+            if out is not None:
+                failed = True
+                it.resolve(error=out)
+                continue
+            r.alloc_index = index
+            if r.rejected_nodes:
+                r.refresh_index = index
+            r.batch_unblocked = True    # server skips per-plan unblock
+            self.plans_applied += 1
+            committed.append(r)
+            it.resolve(result=r)
+        self.batches_committed += 1
+        hook = self.on_batch_commit
+        if hook is not None and committed:
+            try:
+                hook(committed)         # ONE unblock sweep per batch
+            except Exception:  # noqa: BLE001 -- sweep must not kill
+                pass                    # the committer
+        if failed:
+            raise _BatchPartial("plan staging failed mid-batch")
+        return index
 
     # ------------------------------------------------------------------
     def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:

@@ -191,7 +191,10 @@ class BatchWorker(threading.Thread):
             return
         metrics.sample("nomad.worker.batch_width", float(len(batch)))
         barrier = SolveBarrier(len(batch), use_mesh=self.use_mesh,
-                               e_pad_hint=self.width)
+                               e_pad_hint=self.width,
+                               plan_group_hint=getattr(
+                                   self.server.planner, "expect_plans",
+                                   None))
         hook = make_solve_hook(barrier)
         threads = [
             threading.Thread(

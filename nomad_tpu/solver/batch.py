@@ -787,7 +787,8 @@ class SolveBarrier:
         work finishes first."""
 
     def __init__(self, participants: int, use_mesh: bool = True,
-                 e_pad_hint: int = 0, depth: Optional[int] = None):
+                 e_pad_hint: int = 0, depth: Optional[int] = None,
+                 plan_group_hint=None):
         self._cv = threading.Condition()
         self._participants = participants
         self._finished = 0
@@ -795,6 +796,11 @@ class SolveBarrier:
         self._use_mesh = use_mesh
         self._generation = 0
         self._depth = dispatch_depth() if depth is None else max(1, depth)
+        # called with the lane count each time a generation's results
+        # are delivered: each of those evals is about to submit a plan,
+        # so the plan applier can hold its drain and commit the whole
+        # generation as ONE group (Planner.expect_plans)
+        self._plan_group_hint = plan_group_hint
         # generation-ordered completion for the pipelined mode
         self._complete_cv = threading.Condition()
         self._next_complete = 1
@@ -913,6 +919,7 @@ class SolveBarrier:
             for _, cell in batch:
                 cell["error"] = e
         finally:
+            self._hint_plan_group(len(batch))
             with self._complete_cv:
                 self._next_complete = gen + 1
             self._cv.notify_all()
@@ -984,6 +991,7 @@ class SolveBarrier:
                 except Exception as e:  # noqa: BLE001 -- same contract
                     err = e
         finally:
+            self._hint_plan_group(len(batch))
             with self._cv:
                 for i, (_lane, cell) in enumerate(batch):
                     if err is not None:
@@ -995,6 +1003,18 @@ class SolveBarrier:
                 if self._next_complete == gen:
                     self._next_complete = gen + 1
                 self._complete_cv.notify_all()
+
+    def _hint_plan_group(self, n: int) -> None:
+        """A generation's results are about to wake n eval threads, each
+        of which will submit a plan (the host-fallback path included) --
+        tell the plan applier so they commit as one group."""
+        hint = self._plan_group_hint
+        if hint is None or n <= 0:
+            return
+        try:
+            hint(n)
+        except Exception:  # noqa: BLE001 -- advisory only
+            pass
 
 
 def _barrier_order_timeout() -> float:

@@ -71,6 +71,10 @@ class AllocTable:
         self._node_cap = 256
         self.dyn_lo = np.full(self._node_cap, 20000, dtype=np.int32)
         self.dyn_hi = np.full(self._node_cap, 32000, dtype=np.int32)
+        # verify-fold memo: one vectorized per-slot usage fold per table
+        # VERSION, shared by every plan the applier verifies between two
+        # commits (a batch of 32 plans used to pay 32 full-table folds)
+        self._verify_fold_cache: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     def register_node(self, node) -> int:
@@ -92,6 +96,14 @@ class AllocTable:
         return self._slot_of_node.get(node_id, -1)
 
     # ------------------------------------------------------------------
+    def preallocate(self, capacity: int) -> None:
+        """Grow the row arrays to ``capacity`` in ONE resize. A 2M-alloc
+        run otherwise pays ~11 doubling copies of every column (the ports
+        matrix alone is capacity x MAX_PORTS int32) while holding the
+        store lock."""
+        while self._cap < capacity:
+            self._grow()
+
     def _grow(self) -> None:
         self._cap *= 2
         for name in ("node_slot", "cpu", "mem", "disk", "live",
@@ -284,6 +296,34 @@ class AllocTable:
                 "used_disk": used_disk, "dyn_used": dyn_used,
                 "port_words": port_words, "row_slots": mapped}
 
+    def _fold_verify_all(self):
+        """Per-SLOT (used_cpu, used_mem, used_disk, special_any) under the
+        applier's live_strict filter, memoized by table version. One
+        vectorized pass over all rows serves every fold_verify call until
+        the next mutation -- the group-commit applier verifies a whole
+        batch of plans between two commits, so the fold amortizes across
+        the batch (and across the barrier's 32 lanes at headline shape)."""
+        cache = self._verify_fold_cache
+        if cache is not None and cache[0] == self.version:
+            return cache[1]
+        n = self.n_rows
+        nslots = self.n_nodes
+        used_c = np.zeros(nslots)
+        used_m = np.zeros(nslots)
+        used_d = np.zeros(nslots)
+        spec = np.zeros(nslots, dtype=bool)
+        if n and nslots:
+            slots = self.node_slot[:n]
+            live = (self.live_strict[:n] > 0) & (slots >= 0)
+            m = slots[live]
+            np.add.at(used_c, m, self.cpu[:n][live])
+            np.add.at(used_m, m, self.mem[:n][live])
+            np.add.at(used_d, m, self.disk[:n][live])
+            spec[slots[live & (self.special[:n] > 0)]] = True
+        folded = (used_c, used_m, used_d, spec)
+        self._verify_fold_cache = (self.version, folded)
+        return folded
+
     def fold_verify(self, node_ids):
         """Per-node (used_cpu, used_mem, used_disk, special_any, found)
         under the APPLIER's liveness filter (live_strict: excludes
@@ -291,27 +331,22 @@ class AllocTable:
         plan_apply.go) for the plan verifier's native pre-pass. Caller
         must hold the owning store's lock (a half-committed plan would
         tear the fold). ``found[k]`` False = node unknown to the table
-        (no allocs ever) -- usage is zero there."""
-        n = self.n_rows
+        (no allocs ever) -- usage is zero there. Returns fresh arrays
+        (callers mutate them in place while adjusting plan deltas)."""
         npos = len(node_ids)
         slots = np.fromiter(
             (self._slot_of_node.get(i, -1) for i in node_ids),
             dtype=np.int32, count=npos)
         found = slots >= 0
-        remap = np.full(self.n_nodes + 1, -1, dtype=np.int32)
-        remap[slots[found]] = np.nonzero(found)[0].astype(np.int32)
-        rows = self.node_slot[:n]
-        mapped = np.where(rows >= 0, remap[np.maximum(rows, 0)], -1)
-        live = (self.live_strict[:n] > 0) & (mapped >= 0)
-        used_c = np.zeros(npos)
-        used_m = np.zeros(npos)
-        used_d = np.zeros(npos)
-        m = mapped[live]
-        np.add.at(used_c, m, self.cpu[:n][live])
-        np.add.at(used_m, m, self.mem[:n][live])
-        np.add.at(used_d, m, self.disk[:n][live])
-        spec_any = np.zeros(npos, dtype=bool)
-        spec_any[mapped[live & (self.special[:n] > 0)]] = True
+        base_c, base_m, base_d, base_s = self._fold_verify_all()
+        if not base_c.shape[0]:
+            return (np.zeros(npos), np.zeros(npos), np.zeros(npos),
+                    np.zeros(npos, dtype=bool), found)
+        idx = np.where(found, slots, 0)
+        used_c = np.where(found, base_c[idx], 0.0)
+        used_m = np.where(found, base_m[idx], 0.0)
+        used_d = np.where(found, base_d[idx], 0.0)
+        spec_any = found & base_s[idx]
         return used_c, used_m, used_d, spec_any, found
 
     def count_placed(self, n_pad: int, mapped_slots: np.ndarray,

@@ -329,15 +329,22 @@ class StateStore:
             # sys.modules so a store used without the solver stack never
             # pays the (jax-importing) solver package import.
             import sys as _sys
+            # getattr-guarded: sys.modules can hand back a PARTIALLY
+            # initialized module while another thread is mid-import
+            # (first eval racing a node registration burst) -- the
+            # attribute simply isn't there yet, and there is nothing to
+            # invalidate before the module finished loading anyway
             cc = _sys.modules.get("nomad_tpu.solver.constcache")
-            if cc is not None:
-                cc.note_node_table_write(self._index)
+            hook = getattr(cc, "note_node_table_write", None)
+            if hook is not None:
+                hook(self._index)
             # ... and the host-side pack caches: matrices (with their
             # attached feasibility/spread/affinity memos) keyed to
             # older fleet versions can never be keyed again
             tp = _sys.modules.get("nomad_tpu.tensor.pack")
-            if tp is not None:
-                tp.note_node_table_write(self._index)
+            hook = getattr(tp, "note_node_table_write", None)
+            if hook is not None:
+                hook(self._index)
         self._watch_cond.notify_all()
         return self._index
 
@@ -1175,6 +1182,69 @@ class StateStore:
             return self._scheduler_config
 
     # -- plan application ----------------------------------------------------
+    def _stage_plan_result_locked(self, result: PlanResult,
+                                  eval_updates: Optional[List[Evaluation]]
+                                  ) -> Tuple[List[Allocation],
+                                             List[Allocation]]:
+        """Apply one plan result's dict/object writes (stop merges,
+        deployments, eval updates) WITHOUT touching the tensor table or
+        secondary indexes, which the caller batches across plans. Returns
+        (merged_stops, placements) for those deferred columnar writes.
+        Lock held; no index bump here."""
+        stops: List[Allocation] = []
+        for allocs in result.node_update.values():
+            stops.extend(allocs)
+        for allocs in result.node_preemptions.values():
+            stops.extend(allocs)
+        placements: List[Allocation] = []
+        for allocs in result.node_allocation.values():
+            placements.extend(allocs)
+
+        # Stops/preemptions update desired status on existing allocs
+        import copy as _copy
+        import time as _time
+        merged = []
+        for stop in stops:
+            existing = self._allocs.get(stop.id)
+            if existing is None:
+                continue
+            alloc = _copy.copy(existing)
+            alloc.desired_status = stop.desired_status
+            alloc.desired_description = stop.desired_description
+            alloc.preempted_by_allocation = stop.preempted_by_allocation
+            if stop.client_status:
+                alloc.client_status = stop.client_status
+            if stop.followup_eval_id:
+                alloc.followup_eval_id = stop.followup_eval_id
+            alloc.modify_index = self._index + 1
+            alloc.modify_time = _time.time()
+            self._allocs[alloc.id] = alloc
+            merged.append(alloc)
+
+        if result.deployment is not None:
+            d = result.deployment
+            existing_d = self._deployments.get(d.id)
+            if existing_d is not None:
+                d.create_index = existing_d.create_index
+            else:
+                d.create_index = self._index + 1
+            d.modify_index = self._index + 1
+            self._deployments[d.id] = d
+        for du in result.deployment_updates:
+            d = self._deployments.get(du.deployment_id)
+            if d is not None:
+                nd = _copy.copy(d)
+                nd.status = du.status
+                nd.status_description = du.status_description
+                nd.modify_index = self._index + 1
+                self._deployments[nd.id] = nd
+
+        if eval_updates:
+            for ev in eval_updates:
+                ev.modify_index = self._index + 1
+                self._evals[ev.id] = ev
+        return merged, placements
+
     def upsert_plan_results(self, result: PlanResult,
                             eval_updates: Optional[List[Evaluation]] = None
                             ) -> int:
@@ -1182,35 +1252,8 @@ class StateStore:
         (reference: state_store.go:382 UpsertPlanResults, applied by the FSM
         for ApplyPlanResultsRequestType)."""
         with self._lock:
-            stops: List[Allocation] = []
-            for allocs in result.node_update.values():
-                stops.extend(allocs)
-            for allocs in result.node_preemptions.values():
-                stops.extend(allocs)
-            placements: List[Allocation] = []
-            for allocs in result.node_allocation.values():
-                placements.extend(allocs)
-
-            # Stops/preemptions update desired status on existing allocs
-            import copy as _copy
-            import time as _time
-            merged = []
-            for stop in stops:
-                existing = self._allocs.get(stop.id)
-                if existing is None:
-                    continue
-                alloc = _copy.copy(existing)
-                alloc.desired_status = stop.desired_status
-                alloc.desired_description = stop.desired_description
-                alloc.preempted_by_allocation = stop.preempted_by_allocation
-                if stop.client_status:
-                    alloc.client_status = stop.client_status
-                if stop.followup_eval_id:
-                    alloc.followup_eval_id = stop.followup_eval_id
-                alloc.modify_index = self._index + 1
-                alloc.modify_time = _time.time()
-                self._allocs[alloc.id] = alloc
-                merged.append(alloc)
+            merged, placements = self._stage_plan_result_locked(
+                result, eval_updates)
             # refresh the tensor rows (batched): the allocs just became
             # server-terminal, and the verify fast path's live_strict
             # column mirrors the applier's AllocsByNodeTerminal(false)
@@ -1221,35 +1264,58 @@ class StateStore:
             self.alloc_table.upsert_many(merged)
 
             self._insert_allocs_locked(placements)
-            for alloc in placements:
-                self._csi_claim_locked(alloc)
-
-            if result.deployment is not None:
-                d = result.deployment
-                existing_d = self._deployments.get(d.id)
-                if existing_d is not None:
-                    d.create_index = existing_d.create_index
-                else:
-                    d.create_index = self._index + 1
-                d.modify_index = self._index + 1
-                self._deployments[d.id] = d
-            for du in result.deployment_updates:
-                d = self._deployments.get(du.deployment_id)
-                if d is not None:
-                    nd = _copy.copy(d)
-                    nd.status = du.status
-                    nd.status_description = du.status_description
-                    nd.modify_index = self._index + 1
-                    self._deployments[nd.id] = nd
-
-            if eval_updates:
-                for ev in eval_updates:
-                    ev.modify_index = self._index + 1
-                    self._evals[ev.id] = ev
+            if self._csi_volumes:
+                for alloc in placements:
+                    self._csi_claim_locked(alloc)
 
             idx = self._bump("allocs", "deployments", "evals")
             result.alloc_index = idx
             return idx
+
+    def apply_plan_results_batch(
+            self, entries: List[Tuple[PlanResult,
+                                      Optional[List[Evaluation]]]]
+            ) -> Tuple[int, List[Optional[BaseException]]]:
+        """Group commit (the WAL/raft batched-apply analog): N verified
+        plan results land as ONE store transaction -- one lock
+        acquisition, one raft-style index bump, one snapshot
+        invalidation, and ONE columnar pass through
+        ``AllocTable.upsert_many`` for the whole batch's stop merges and
+        placements instead of one per plan.
+
+        A plan whose staging raises (the ``plan.commit`` chaos point
+        fires BEFORE its writes) is skipped -- the batch splits around
+        it: surviving plans still commit exactly once, and the failing
+        plan's exception rides the returned per-entry outcome list
+        (None = committed)."""
+        from ..faultinject import faults
+        with self._lock:
+            outcomes: List[Optional[BaseException]] = []
+            merged_all: List[Allocation] = []
+            placements_all: List[Allocation] = []
+            staged: List[Tuple[PlanResult, List[Allocation]]] = []
+            for result, eval_updates in entries:
+                try:
+                    faults.fire("plan.commit")
+                    merged, placements = self._stage_plan_result_locked(
+                        result, eval_updates)
+                except BaseException as e:  # noqa: BLE001 -- split batch
+                    outcomes.append(e)
+                    continue
+                merged_all.extend(merged)
+                placements_all.extend(placements)
+                staged.append((result, placements))
+                outcomes.append(None)
+            self.alloc_table.upsert_many(merged_all)
+            self._insert_allocs_locked(placements_all)
+            if self._csi_volumes:
+                for _, placements in staged:
+                    for alloc in placements:
+                        self._csi_claim_locked(alloc)
+            idx = self._bump("allocs", "deployments", "evals")
+            for result, _ in staged:
+                result.alloc_index = idx
+            return idx, outcomes
 
     # -- snapshot passthrough reads (so StateStore satisfies the scheduler's
     #    State interface directly in tests) --------------------------------

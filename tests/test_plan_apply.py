@@ -214,3 +214,36 @@ def test_pipeline_reverifies_after_commit_failure():
         assert len(allocs) == 1
     finally:
         planner.shutdown()
+
+
+def test_bad_node_tracker_prunes_expired_windows():
+    """ISSUE 5 satellite: the per-node dict must not grow unbounded --
+    node ids whose whole rejection window expired are dropped on
+    add()/score(), so a 2M-alloc run that brushes every node id does
+    not hold all of them for the process lifetime."""
+    from nomad_tpu.server.plan_apply import BadNodeTracker
+
+    tr = BadNodeTracker(threshold=3, window=0.05)
+    for i in range(200):
+        tr.add(f"bn-node-{i:04d}")
+    assert len(tr._hits) == 200
+    time.sleep(0.06)
+    # any add() past the window sweeps the whole dict
+    tr.add("bn-node-fresh")
+    assert set(tr._hits) == {"bn-node-fresh"}
+
+    # score() prunes its own node inline and reports 0 once expired
+    tr2 = BadNodeTracker(threshold=3, window=0.05)
+    assert tr2.add("bn-a") is False
+    assert tr2.score("bn-a") == 1
+    time.sleep(0.06)
+    assert tr2.score("bn-a") == 0
+    assert "bn-a" not in tr2._hits
+
+    # pruning also keeps the threshold honest: stale hits never
+    # accumulate a node into 'bad'
+    tr3 = BadNodeTracker(threshold=2, window=0.05)
+    assert tr3.add("bn-b") is False
+    time.sleep(0.06)
+    assert tr3.add("bn-b") is False   # first hit expired
+    assert tr3.add("bn-b") is True

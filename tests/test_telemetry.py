@@ -187,3 +187,73 @@ telemetry {
 ''')
     assert cfg.telemetry.statsd_address == "127.0.0.1:8125"
     assert cfg.telemetry.interval_s == 2.5
+
+
+def test_sharded_counters_match_locked_reference_exactly():
+    """ISSUE 5 satellite: the hot incr path went lock-free (per-thread
+    shard buffers folded at read time). Aggregated counts must match a
+    plain locked implementation EXACTLY for the same increment stream,
+    including increments from ephemeral threads that die before any
+    snapshot folds them."""
+    import threading
+
+    t = Telemetry()
+    lock = threading.Lock()
+    reference = {}
+
+    def ref_incr(name, n=1):
+        with lock:
+            reference[name] = reference.get(name, 0) + n
+
+    def worker(tid):
+        for i in range(5000):
+            name = f"nomad.test.c{i % 7}"
+            t.incr(name)
+            ref_incr(name)
+            if i % 17 == 0:
+                t.incr("nomad.test.bulk", 3)
+                ref_incr("nomad.test.bulk", 3)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(8)]
+    for th in threads:
+        th.start()
+    # interleave reads with live writers: folds must never mutate a
+    # live shard (a lost increment would show as a final mismatch)
+    for _ in range(20):
+        t.snapshot()
+    for th in threads:
+        th.join()
+    assert t.snapshot()["counters"] == reference
+    # repeated snapshots stay stable once writers quiesce
+    assert t.snapshot()["counters"] == reference
+
+
+def test_sharded_counters_fold_dead_threads():
+    """Per-eval threads are ephemeral: their shards must fold into the
+    base (not leak, not drop counts) once the owners die."""
+    import threading
+
+    t = Telemetry()
+
+    def one_shot(k):
+        t.incr("nomad.test.dead", 2)
+
+    for k in range(300):     # > the 128 shard hygiene bound
+        th = threading.Thread(target=one_shot, args=(k,))
+        th.start()
+        th.join()
+    assert t.snapshot()["counters"]["nomad.test.dead"] == 600
+    with t._lock:
+        assert len(t._shards) < 300
+
+
+def test_sharded_counters_reset_invalidates_live_shards():
+    """reset() must zero the aggregate even though live threads cached
+    their shard objects; their next incr starts from a clean slate."""
+    t = Telemetry()
+    t.incr("nomad.test.r", 5)
+    t.reset()
+    assert t.snapshot()["counters"] == {}
+    t.incr("nomad.test.r", 7)   # same (main) thread, cached stale shard
+    assert t.snapshot()["counters"]["nomad.test.r"] == 7
