@@ -915,6 +915,13 @@ class ApiHandler(BaseHTTPRequestHandler):
                             if raft is not None else "true",
                         },
                         "solver_guard": solver_guard.state(),
+                        # flap damping: per-node flap scores + active
+                        # quarantines (ISSUE 6), exposed like the
+                        # breaker state so a quarantined fleet is
+                        # diagnosable from the agent endpoint
+                        "node_flaps":
+                            self.nomad.flaps.state()
+                            if hasattr(self.nomad, "flaps") else {},
                     },
                     "member": {"name": getattr(self.nomad, "name",
                                                "local"),

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import itertools
 import random
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from . import mock
 from .structs import (
@@ -246,6 +246,232 @@ def run_scale_northstar(target_allocs: int, n_nodes: int = 10000,
         else 0.0,
         "rss_mb": round(rss_mb, 1),
         "rounds": rounds,
+        "truncated": truncated,
+    }
+
+
+def run_scale_churn(live_target: int, n_nodes: int = 10000,
+                    e_evals: int = 32, per_eval: int = 2000,
+                    rounds: int = 6, churn_jobs: int = 4,
+                    flap_nodes: int = 2,
+                    round_timeout_s: float = 300.0,
+                    gc_watermark: Optional[int] = None,
+                    log=None) -> dict:
+    """Sustained-churn north star (ISSUE 6 / ROADMAP item 5): hold
+    ~``live_target`` LIVE allocations while the pipeline absorbs
+    continuous arrivals (new jobs), completions (deregister + client
+    ack), and node flaps (down -> lost-alloc reschedule -> recovery
+    through the flap damper) at steady state -- production traffic is
+    churn, not a queue drained once. Every round ends with a GC pass
+    under the terminal-alloc watermark plus table compaction, and a
+    fold-parity check of the incremental delta memos against a full
+    refold, so the run measures BOUNDED state, not accumulation.
+
+    Reports p50/p99 submit->commit latency over the arrival jobs, RSS
+    per round (growth across churn rounds is the leak signal; peak ru_
+    maxrss alone can't show re-use), and ``parity_mismatch`` (must be
+    0). The same code path shrinks to a tier-1 smoke
+    (tests/test_scale_churn.py), mirroring test_scale_northstar's
+    split; the full-scale run is bench.py ``time_scale_churn``."""
+    import os
+    import resource
+    import time
+
+    from . import mock
+    from .server import Server
+    from .structs import ALLOC_CLIENT_COMPLETE, SchedulerConfiguration
+
+    def say(msg):
+        if log is not None:
+            log(msg)
+
+    def rss_now_mb() -> float:
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * (resource.getpagesize() / 1048576.0)
+        except (OSError, ValueError, IndexError):
+            return (resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                    / 1024.0)
+
+    allocs_per_node = max(1, (live_target + n_nodes - 1) // n_nodes)
+    warmup_waves = max(1, (live_target + e_evals * per_eval - 1)
+                       // (e_evals * per_eval))
+    if gc_watermark is None:
+        gc_watermark = max(1000, live_target // 4)
+    prev_lean = os.environ.get("NOMAD_TPU_LEAN_ALLOC_METRICS")
+    os.environ["NOMAD_TPU_LEAN_ALLOC_METRICS"] = "1"
+    server = Server(num_workers=e_evals, heartbeat_ttl=3600.0,
+                    eval_batching=True, batch_width=e_evals)
+    server.state.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm="tpu-binpack"))
+    server.state.alloc_table.preallocate(
+        int(live_target * 1.2) + e_evals * per_eval)
+    server.start()
+    truncated = False
+    latencies_ms: list = []
+    rss_rounds: list = []
+    parity_mismatch = 0
+    arrivals = completions = flaps = quarantine_deferrals = 0
+    active_jobs: list = []      # insertion order = age order
+    job_seq = 0
+
+    def churn_job():
+        nonlocal job_seq
+        job = mock.job(id=f"churn-{job_seq:05d}")
+        job_seq += 1
+        tg = job.task_groups[0]
+        tg.count = per_eval
+        tg.ephemeral_disk.size_mb = 10
+        tg.tasks[0].resources.cpu = 10
+        tg.tasks[0].resources.memory_mb = 32
+        return job
+
+    def wait_placed(jobs, deadline):
+        """Block until every job's allocs are placed; records per-job
+        submit->commit latency. Returns False on timeout."""
+        pending = {(j.namespace, j.id): t0 for j, t0 in jobs}
+        while pending and time.time() < deadline:
+            for key in list(pending):
+                ns, jid = key
+                if server.state.num_allocs_by_job(ns, jid) >= per_eval:
+                    placed = sum(
+                        1 for a in server.state.allocs_by_job(ns, jid)
+                        if a.desired_status == "run")
+                    if placed >= per_eval:
+                        latencies_ms.append(
+                            (time.perf_counter() - pending.pop(key))
+                            * 1e3)
+            if pending:
+                time.sleep(0.02)
+        return not pending
+
+    try:
+        # fleet with ~60% headroom: flapped nodes and in-flight
+        # replacements need somewhere to land
+        fleet_ids = []
+        for i in range(n_nodes):
+            n = mock.node()
+            n.id = f"churn-node-{i:06d}"
+            n.node_resources.cpu.cpu_shares = int(allocs_per_node * 16)
+            n.node_resources.memory.memory_mb = int(allocs_per_node * 52)
+            n.node_resources.disk.disk_mb = int(allocs_per_node * 16)
+            n.compute_class()
+            server.register_node(n)
+            fleet_ids.append(n.id)
+        say(f"churn: fleet up ({n_nodes} nodes); warming to "
+            f"{live_target} live allocs")
+
+        for w in range(warmup_waves):
+            jobs = [churn_job() for _ in range(e_evals)]
+            batch = []
+            for job in jobs:
+                t0 = time.perf_counter()
+                server.register_job(job)
+                batch.append((job, t0))
+                active_jobs.append(job)
+            if not wait_placed(batch, time.time() + round_timeout_s):
+                truncated = True
+                say(f"churn: warmup wave {w} TRUNCATED")
+                break
+        latencies_ms.clear()        # warmup is not steady state
+        rss_rounds.append(round(rss_now_mb(), 1))
+
+        flappy = fleet_ids[:flap_nodes]
+        t_run0 = time.perf_counter()
+        for r in range(rounds):
+            if truncated:
+                break
+            # completions: the oldest jobs leave (deregister -> stop
+            # eval), and their clients ack terminal
+            leaving = active_jobs[:churn_jobs]
+            active_jobs = active_jobs[churn_jobs:]
+            for job in leaving:
+                server.deregister_job(job.namespace, job.id)
+                acks = []
+                for a in server.state.allocs_by_job(job.namespace,
+                                                    job.id):
+                    upd = a.copy_skip_job()
+                    upd.client_status = ALLOC_CLIENT_COMPLETE
+                    upd.client_terminal_time = time.time()
+                    acks.append(upd)
+                server.update_allocs_from_client(acks)
+                completions += len(acks)
+            # flaps: the same nodes go down every round, so the flap
+            # damper's escalating quarantine actually engages
+            for nid in flappy:
+                node = server.state.node_by_id(nid)
+                if node is not None and node.ready():
+                    server.update_node_status(nid, "down")
+                    flaps += 1
+            # arrivals replace the departed capacity
+            batch = []
+            for _ in range(churn_jobs):
+                job = churn_job()
+                t0 = time.perf_counter()
+                server.register_job(job)
+                batch.append((job, t0))
+                active_jobs.append(job)
+            arrivals += churn_jobs * per_eval
+            if not wait_placed(batch, time.time() + round_timeout_s):
+                truncated = True
+                say(f"churn: round {r} TRUNCATED")
+            # flapped nodes try to come back; quarantined ones are
+            # deferred (they retry next round)
+            for nid in flappy:
+                node = server.state.node_by_id(nid)
+                if node is not None and node.status == "down":
+                    rem = server.flaps.quarantine_remaining(nid)
+                    if rem > 0:
+                        quarantine_deferrals += 1
+                    server.heartbeat(nid)
+            # bounded state: terminal sweep + watermark + compaction
+            server.run_gc_once(threshold=0.0,
+                               terminal_watermark=gc_watermark)
+            parity_mismatch += \
+                server.state.alloc_table.fold_parity_mismatch()
+            rss_rounds.append(round(rss_now_mb(), 1))
+            say(f"churn: round {r + 1}/{rounds} done "
+                f"(rss {rss_rounds[-1]:.0f}MB, "
+                f"parity_mismatch={parity_mismatch})")
+        churn_wall = time.perf_counter() - t_run0
+    finally:
+        if prev_lean is None:
+            os.environ.pop("NOMAD_TPU_LEAN_ALLOC_METRICS", None)
+        else:
+            os.environ["NOMAD_TPU_LEAN_ALLOC_METRICS"] = prev_lean
+        server.shutdown()
+
+    live = sum(1 for j in active_jobs
+               for a in server.state.allocs_by_job(j.namespace, j.id)
+               if not a.terminal_status())
+    terminal = sum(1 for a in server.state.allocs()
+                   if a.terminal_status())
+    lat = sorted(latencies_ms)
+
+    def pct(p):
+        if not lat:
+            return 0.0
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2)
+
+    rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    return {
+        "live_allocs": live,
+        "terminal_allocs": terminal,
+        "rounds": rounds,
+        "churn_wall_s": round(churn_wall, 3),
+        "arrivals": arrivals,
+        "completions": completions,
+        "flaps": flaps,
+        "quarantine_deferrals": quarantine_deferrals,
+        "submit_commit_p50_ms": pct(0.50),
+        "submit_commit_p99_ms": pct(0.99),
+        "rss_mb_rounds": rss_rounds,
+        "rss_growth_mb": round(rss_rounds[-1] - rss_rounds[0], 1)
+        if len(rss_rounds) >= 2 else 0.0,
+        "rss_mb": round(rss_mb, 1),
+        "gc_watermark": gc_watermark,
+        "parity_mismatch": parity_mismatch,
         "truncated": truncated,
     }
 

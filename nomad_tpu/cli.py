@@ -742,6 +742,27 @@ def cmd_operator_solver(args) -> int:
     return 0
 
 
+def cmd_operator_node_flaps(args) -> int:
+    """Flap-damping state (rides /v1/agent/self stats.node_flaps): per-
+    node flap scores in the scoring window plus active quarantines --
+    the `operator solver status` analog for the node lifecycle layer."""
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("node_flaps") or {}
+    for k in ("enabled", "threshold", "window_s", "base_s", "max_s"):
+        print(f"{k:12s} = {st.get(k)}")
+    scores = st.get("scores") or {}
+    quarantined = st.get("quarantined") or {}
+    print(f"flapping     = {len(scores)} node(s)")
+    for nid, score in sorted(scores.items(), key=lambda kv: -kv[1]):
+        q = quarantined.get(nid)
+        print(f"  {nid:38s} score={score:<4d}"
+              + (f" quarantined {q:.1f}s" if q is not None else ""))
+    for nid, rem in sorted(quarantined.items()):
+        if nid not in scores:
+            print(f"  {nid:38s} score=0    quarantined {rem:.1f}s")
+    return 0
+
+
 def _render_trace_waterfall(tr: dict, width: int = 48) -> str:
     """ASCII span waterfall for one eval trace: each span a bar
     positioned/scaled on the trace's wall-clock extent."""
@@ -1111,6 +1132,11 @@ def build_parser() -> argparse.ArgumentParser:
                                                   required=True)
     osol.add_parser("status").set_defaults(fn=cmd_operator_solver)
     osol.add_parser("reprobe").set_defaults(fn=cmd_operator_solver)
+    onode = op.add_parser("node").add_subparsers(dest="sub2",
+                                                 required=True)
+    onode.add_parser("flaps",
+                     help="per-node flap scores + active quarantines"
+                     ).set_defaults(fn=cmd_operator_node_flaps)
     otr = op.add_parser("trace",
                         help="eval span-waterfall forensics")
     otr.add_argument("eval_id", nargs="?", default="")

@@ -23,12 +23,38 @@ FAILED_QUEUE = "_failed"
 
 
 class EvalBroker:
-    """(reference: eval_broker.go:52)"""
+    """(reference: eval_broker.go:52)
+
+    Storm admission control (ISSUE 6): mass-rescheduling fan-outs
+    (node-down eval storms) enter through ``enqueue_storm``, which
+    admits one bounded WAVE immediately and defers the rest onto the
+    delayed heap at a paced release rate; independently, every path
+    into the ready queues sheds to the delayed heap once ready depth
+    crosses ``max_ready`` -- overload degrades to deferred followup
+    evals instead of dropped work or an unbounded queue. Knobs:
+
+      NOMAD_TPU_STORM_ADMISSION=0   kill switch (today's behavior)
+      NOMAD_TPU_STORM_WAVE          evals admitted per wave (256)
+      NOMAD_TPU_STORM_RATE          deferred-release rate, evals/s (1000)
+      NOMAD_TPU_BROKER_MAX_READY    ready-depth shed bound (8192; 0=off)
+      NOMAD_TPU_BROKER_SHED_DELAY   re-defer delay on shed, s (0.5)
+    """
 
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
                  delivery_limit: int = DEFAULT_DELIVERY_LIMIT):
+        import os
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        self.admission_enabled = \
+            os.environ.get("NOMAD_TPU_STORM_ADMISSION", "1") != "0"
+        self.storm_wave = int(os.environ.get("NOMAD_TPU_STORM_WAVE",
+                                             "256"))
+        self.storm_rate = float(os.environ.get("NOMAD_TPU_STORM_RATE",
+                                               "1000"))
+        self.max_ready = int(os.environ.get("NOMAD_TPU_BROKER_MAX_READY",
+                                            "8192"))
+        self.shed_delay_s = float(os.environ.get(
+            "NOMAD_TPU_BROKER_SHED_DELAY", "0.5"))
         self._lock = threading.Condition()
         self.enabled = False
         # sched type -> heap of (-priority, seq, eval)
@@ -110,6 +136,51 @@ class EvalBroker:
                 self._process_enqueue(ev)
             self._lock.notify_all()
 
+    def _ready_depth_locked(self) -> int:
+        return sum(len(h) for s, h in self._ready.items()
+                   if s != FAILED_QUEUE)
+
+    def enqueue_storm(self, evals: List[Evaluation]) -> None:
+        """Admission-controlled mass enqueue for node-down fan-outs: the
+        first ``storm_wave`` evals (while ready depth allows) admit
+        immediately; the remainder are deferred onto the delayed heap in
+        wave-sized groups released at ``storm_rate`` evals/s. Nothing is
+        dropped -- a deferred eval is a followup eval with a later
+        release time."""
+        with self._lock:
+            if not self.enabled:
+                return
+            if not self.admission_enabled:
+                for ev in evals:
+                    self._process_enqueue(ev)
+                self._lock.notify_all()
+                return
+            now = time.time()
+            depth = self._ready_depth_locked()
+            wave = max(1, self.storm_wave)
+            admitted = deferred = 0
+            for ev in evals:
+                room = (admitted < wave
+                        and (not self.max_ready
+                             or depth + admitted < self.max_ready))
+                if room and not (ev.wait_until
+                                 and ev.wait_until > now):
+                    self._process_enqueue(ev)
+                    admitted += 1
+                    continue
+                wave_idx = deferred // wave + 1
+                release = now + wave_idx * (wave / max(1.0,
+                                                       self.storm_rate))
+                if ev.wait_until and ev.wait_until > release:
+                    release = ev.wait_until
+                self._seq += 1
+                heapq.heappush(self._delayed, (release, self._seq, ev))
+                deferred += 1
+            self._lock.notify_all()
+        if deferred:
+            from .telemetry import metrics
+            metrics.incr("nomad.broker.storm_deferred", deferred)
+
     def _process_enqueue(self, ev: Evaluation) -> None:
         if not self.enabled:
             return
@@ -129,6 +200,19 @@ class EvalBroker:
             if (other[0].namespace, other[0].job_id) == namespaced_job:
                 self._waiting[ev.id] = ev
                 return
+        # queue-depth shedding: past max_ready the eval degrades to a
+        # DEFERRED eval (delayed heap, re-admitted once depth recedes)
+        # instead of growing the ready queue without bound; also catches
+        # the delayed watcher's releases under sustained overload
+        if self.admission_enabled and self.max_ready and \
+                self._ready_depth_locked() >= self.max_ready:
+            self._seq += 1
+            heapq.heappush(self._delayed,
+                           (time.time() + self.shed_delay_s,
+                            self._seq, ev))
+            from .telemetry import metrics
+            metrics.incr("nomad.broker.shed_deferred")
+            return
         self._seq += 1
         sched = ev.type
         self._ready.setdefault(sched, [])
@@ -289,8 +373,7 @@ class EvalBroker:
     def stats(self) -> dict:
         with self._lock:
             return {
-                "total_ready": sum(len(h) for s, h in self._ready.items()
-                                   if s != FAILED_QUEUE),
+                "total_ready": self._ready_depth_locked(),
                 "total_unacked": len(self._unack),
                 "total_waiting": len(self._waiting),
                 "total_delayed": len(self._delayed),

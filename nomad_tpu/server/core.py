@@ -30,12 +30,101 @@ from ..structs import (
     TRIGGER_NODE_UPDATE, TRIGGER_PERIODIC_JOB,
 )
 from .broker import BlockedEvals, EvalBroker
-from .plan_apply import Planner
+from .plan_apply import BadNodeTracker, Planner
 from .worker import BatchWorker, Worker
 
 DEFAULT_HEARTBEAT_TTL = 10.0
 GC_EVAL_THRESHOLD = 3600.0
 GC_INTERVAL = 60.0
+# terminal allocs retained before the watermark GC pass kicks in
+# (NOMAD_TPU_GC_ALLOC_WATERMARK overrides; 0 disables the pass)
+GC_ALLOC_WATERMARK = 1_000_000
+
+
+class NodeFlapTracker(BadNodeTracker):
+    """Per-node flap damping (ISSUE 6): the heartbeat watcher records a
+    hit on every ready->down transition (BadNodeTracker's windowed
+    scoring); once a node's flap score crosses the threshold, its next
+    down->ready transition is DEFERRED by an escalating quarantine
+    window (exponential backoff in the score overshoot, capped), so one
+    sick node cannot generate an eval storm by flapping -- each flap
+    costs a node-down fan-out AND a node-up unblock sweep. Knobs:
+
+      NOMAD_TPU_FLAP=0            kill switch: immediate transitions
+                                  (today's behavior, test-gated)
+      NOMAD_TPU_FLAP_THRESHOLD    flaps in window before quarantine (3)
+      NOMAD_TPU_FLAP_WINDOW       scoring window seconds (300)
+      NOMAD_TPU_FLAP_BASE_S       first quarantine window seconds (5)
+      NOMAD_TPU_FLAP_MAX_S        quarantine cap seconds (300)
+    """
+
+    def __init__(self):
+        import os
+        self.enabled = os.environ.get("NOMAD_TPU_FLAP", "1") != "0"
+        self.flap_threshold = int(
+            os.environ.get("NOMAD_TPU_FLAP_THRESHOLD", "3"))
+        window = float(os.environ.get("NOMAD_TPU_FLAP_WINDOW", "300"))
+        self.base_s = float(os.environ.get("NOMAD_TPU_FLAP_BASE_S", "5"))
+        self.max_s = float(os.environ.get("NOMAD_TPU_FLAP_MAX_S", "300"))
+        super().__init__(threshold=self.flap_threshold, window=window)
+        self._quarantine: Dict[str, float] = {}
+
+    def record_down(self, node_id: str) -> int:
+        """A node went down: record the flap; once the score crosses the
+        threshold, arm/extend the quarantine with exponential backoff so
+        the NEXT recovery attempt is deferred. Returns the score."""
+        if not self.enabled:
+            return 0
+        self.add(node_id)
+        score = self.score(node_id)
+        if score >= self.flap_threshold:
+            hold = min(self.base_s * (2 ** (score - self.flap_threshold)),
+                       self.max_s)
+            self._quarantine[node_id] = time.time() + hold
+            from .telemetry import metrics
+            metrics.incr("nomad.heartbeat.flap_quarantined")
+        return score
+
+    def quarantine_remaining(self, node_id: str) -> float:
+        """Seconds of quarantine left (0 = free to transition ready).
+        Expired entries are reaped on read."""
+        if not self.enabled:
+            return 0.0
+        until = self._quarantine.get(node_id)
+        if until is None:
+            return 0.0
+        rem = until - time.time()
+        if rem <= 0:
+            with self._lock:
+                self._quarantine.pop(node_id, None)
+            return 0.0
+        return rem
+
+    def release(self, node_id: str) -> None:
+        """Operator override / deregistration: lift the quarantine."""
+        with self._lock:
+            self._quarantine.pop(node_id, None)
+
+    def state(self) -> dict:
+        """Operational snapshot (rides /v1/agent/self and `operator node
+        flaps`, shaped like the breaker state exposure)."""
+        now = time.time()
+        with self._lock:
+            cutoff = now - self.window
+            scores = {nid: sum(1 for t in hits if t >= cutoff)
+                      for nid, hits in self._hits.items()}
+            quarantined = {nid: round(until - now, 3)
+                           for nid, until in self._quarantine.items()
+                           if until > now}
+        return {
+            "enabled": self.enabled,
+            "threshold": self.flap_threshold,
+            "window_s": self.window,
+            "base_s": self.base_s,
+            "max_s": self.max_s,
+            "scores": {nid: s for nid, s in scores.items() if s > 0},
+            "quarantined": quarantined,
+        }
 
 
 class EventSubscription:
@@ -119,6 +208,9 @@ class Server:
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_deadlines: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
+        # flap damping: scores fed by ready->down transitions, escalating
+        # quarantine deferring down->ready (NOMAD_TPU_FLAP_* knobs)
+        self.flaps = NodeFlapTracker()
         # serializes drain pacing rounds (API thread vs drainer loop):
         # both read-compute-mark, so racing ticks could overshoot
         # migrate.max_parallel
@@ -808,6 +900,10 @@ class Server:
                 description="created by node registration"))
         node.status = NODE_STATUS_READY
         self.state.upsert_node(node)
+        # explicit re-registration is an operator/agent-restart action:
+        # it lifts any flap quarantine (the heartbeat path defers; the
+        # registration path is the documented override)
+        self.flaps.release(node.id)
         self._reset_heartbeat(node.id)
         # new capacity -> unblock evals for this class
         self.blocked_evals.unblock(node.computed_class)
@@ -822,6 +918,7 @@ class Server:
             raise ValueError(f"unknown node {node_id!r}")
         self.update_node_status(node_id, NODE_STATUS_DOWN)
         self.state.delete_node(node_id)
+        self.flaps.release(node_id)
         self.publish_event("NodeDeregistered", {"node_id": node_id})
 
     def update_node_status(self, node_id: str, status: str) -> None:
@@ -841,6 +938,12 @@ class Server:
                 from .logbroker import log as _log
                 _log("warn", "heartbeat",
                      f"node {node_id[:8]} marked {status}")
+                # flap scoring: repeated ready->down transitions arm an
+                # escalating quarantine on this node's recovery
+                score = self.flaps.record_down(node_id)
+                if score:
+                    from .telemetry import metrics
+                    metrics.incr("nomad.heartbeat.flap_recorded")
             with self._hb_lock:
                 self._heartbeat_deadlines.pop(node_id, None)
             self._create_node_evals(node_id)
@@ -860,6 +963,15 @@ class Server:
             return 0.0
         if node.status in (NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED):
             # heartbeat from a down node: it must re-register its status
+            # -- unless it is serving a flap quarantine, in which case
+            # the recovery is DEFERRED (the node keeps heartbeating and
+            # stays down; its workloads were already replaced by the
+            # node-down fan-out, so deferral costs capacity, not work)
+            rem = self.flaps.quarantine_remaining(node_id)
+            if rem > 0:
+                from .telemetry import metrics
+                metrics.incr("nomad.heartbeat.quarantine_deferred")
+                return self.heartbeat_ttl
             self.update_node_status(node_id, NODE_STATUS_READY)
         self._reset_heartbeat(node_id)
         return self.heartbeat_ttl
@@ -897,7 +1009,10 @@ class Server:
                     node_id=node_id, status=EVAL_STATUS_PENDING))
         if evals:
             self.state.upsert_evals(evals)
-            self.broker.enqueue_all(evals)
+            # node fan-outs go through storm admission: one wave admits
+            # immediately, the rest release paced (a mass node-down must
+            # not dump its whole fan-out on the ready queue at once)
+            self.broker.enqueue_storm(evals)
 
     def drain_node(self, node_id: str, strategy) -> None:
         """Start/stop a drain: mark the node ineligible and let the
@@ -1434,7 +1549,8 @@ class Server:
             if self._leader_active.is_set():
                 self.run_gc_once()
 
-    def run_gc_once(self, threshold: float = GC_EVAL_THRESHOLD) -> dict:
+    def run_gc_once(self, threshold: float = GC_EVAL_THRESHOLD,
+                    terminal_watermark: Optional[int] = None) -> dict:
         cutoff = time.time() - threshold
         gone_evals = []
         for ev in self.state.evals():
@@ -1462,8 +1578,43 @@ class Server:
                         not self.state.evals_by_job(job.namespace, job.id):
                     self.state.delete_job(job.namespace, job.id)
                     gone_jobs += 1
+        # bounded state under churn (ISSUE 6): the age-based sweep above
+        # retains up to an hour of terminal history -- at production
+        # churn rates that is unbounded relative to the live set. The
+        # watermark pass deletes the OLDEST terminal allocs beyond the
+        # bound regardless of age (their history value is marginal; the
+        # live fleet's memory ceiling is not), then compacts the tensor
+        # table's freed rows so RSS actually returns.
+        wm = self._gc_watermark(terminal_watermark)
+        compacted = self.state.compact_alloc_table() \
+            if hasattr(self.state, "compact_alloc_table") else None
+        if compacted is not None:
+            from .telemetry import metrics
+            metrics.incr("nomad.gc.table_compactions")
         return {"evals": len(gone_evals), "allocs": len(gone_allocs),
-                "jobs": gone_jobs}
+                "jobs": gone_jobs, "watermark_allocs": wm,
+                "compacted": compacted}
+
+    def _gc_watermark(self, terminal_watermark: Optional[int]) -> int:
+        """Delete the oldest terminal allocs beyond the retention bound
+        (NOMAD_TPU_GC_ALLOC_WATERMARK, 0 disables). Returns count."""
+        import os
+        wm = terminal_watermark
+        if wm is None:
+            wm = int(os.environ.get("NOMAD_TPU_GC_ALLOC_WATERMARK",
+                                    str(GC_ALLOC_WATERMARK)) or 0)
+        if wm <= 0:
+            return 0
+        terminal = [a for a in self.state.allocs() if a.terminal_status()]
+        excess = len(terminal) - wm
+        if excess <= 0:
+            return 0
+        terminal.sort(key=lambda a: a.modify_time)
+        gone = [a.id for a in terminal[:excess]]
+        self.state.delete_allocs(gone)
+        from .telemetry import metrics
+        metrics.incr("nomad.gc.watermark_allocs_deleted", len(gone))
+        return len(gone)
 
     def _run_periodic(self) -> None:
         """Cron-style launcher (reference: periodic.go:25). Supports

@@ -200,6 +200,15 @@ def note_dispatch_bytes(n: int) -> None:
     metrics.incr("nomad.solver.dispatch_bytes_total", int(n))
 
 
+def note_table_write(tables, table_index: int, delta=None) -> None:
+    """Unified store-write hook (state/store.py _notify_write_hooks):
+    every cache layer receives the same (tables, index, delta)
+    notification. The const cache only reacts to fleet-table writes;
+    the alloc delta context is for the incremental memo layers."""
+    if "nodes" in tables:
+        note_node_table_write(table_index)
+
+
 def note_node_table_write(table_index: int) -> None:
     """Node-table write hook (state/store.py): drop buffers uploaded
     under an older fleet version. Correctness never depends on this

@@ -1038,29 +1038,57 @@ class TpuPlacementService:
         O(nodes x allocs) walk. Bases carrying a port bitmap are refolded
         per eval rather than memoized (an 80MB bitmap per snapshot is the
         same trade _pack_usage_from_table's fold cache makes)."""
+        from ..state.alloc_table import pack_delta_enabled
         from ..tensor.pack import UsageState, _stat_incr, fold_usage_base
 
         snap = self.ctx.state
         token = snap.latest_index()
-        memo = snap.__dict__.get("_usage_base_memo")
         base = None
-        if memo is not None:
-            ent = memo.get(id(matrix))
-            # identity + index check: a live store's memo must die on any
-            # write; a snapshot's latest_index() never moves
-            if ent is not None and ent[0] is matrix and ent[1] == token:
-                base = ent[2]
-        if base is None:
-            base = fold_usage_base(
-                matrix, nodes,
-                lambda nid: [a for a in snap.allocs_by_node(nid)
-                             if not a.client_terminal_status()])
-            _stat_incr("usage_base_misses")
-            if base["ports"] is None:
-                snap.__dict__.setdefault("_usage_base_memo", {})[
-                    id(matrix)] = (matrix, token, base)
+        if pack_delta_enabled():
+            # matrix-attached memo: the matrix is stable across snapshots
+            # while the node table is unchanged, so a base folded for an
+            # EARLIER snapshot catches up by applying the alloc deltas
+            # the store journaled in between (_bump delta context) --
+            # O(changed allocs) per snapshot instead of O(all allocs)
+            store = getattr(snap, "_store", snap)
+            ent = getattr(matrix, "_usage_base", None)
+            if ent is not None and ent[0] is store:
+                if ent[1] == token:
+                    base = ent[2]
+                    _stat_incr("usage_base_hits")
+                elif ent[1] < token:
+                    base = self._catch_up_usage_base(
+                        matrix, store, ent, token)
+            if base is None:
+                base = fold_usage_base(
+                    matrix, nodes,
+                    lambda nid: [a for a in snap.allocs_by_node(nid)
+                                 if not a.client_terminal_status()])
+                _stat_incr("usage_base_misses")
+                if base["ports"] is None:
+                    matrix._usage_base = (store, token, base)
         else:
-            _stat_incr("usage_base_hits")
+            # NOMAD_TPU_PACK_DELTA=0 kill switch: the PR-4/5 wholesale
+            # path -- snapshot-scoped memo, full refold per snapshot
+            memo = snap.__dict__.get("_usage_base_memo")
+            if memo is not None:
+                ent = memo.get(id(matrix))
+                # identity + index check: a live store's memo must die on
+                # any write; a snapshot's latest_index() never moves
+                if ent is not None and ent[0] is matrix and \
+                        ent[1] == token:
+                    base = ent[2]
+            if base is None:
+                base = fold_usage_base(
+                    matrix, nodes,
+                    lambda nid: [a for a in snap.allocs_by_node(nid)
+                                 if not a.client_terminal_status()])
+                _stat_incr("usage_base_misses")
+                if base["ports"] is None:
+                    snap.__dict__.setdefault("_usage_base_memo", {})[
+                        id(matrix)] = (matrix, token, base)
+            else:
+                _stat_incr("usage_base_hits")
 
         n_pad = matrix.n_pad
         placed = np.zeros(n_pad, dtype=np.int32)
@@ -1088,6 +1116,48 @@ class TpuPlacementService:
             dyn_used=base["dyn_used"].copy())
         self._overlay_plan_deltas(usage, nodes, tg)
         return usage
+
+    def _catch_up_usage_base(self, matrix, store, ent, token):
+        """Advance a stale usage base to ``token`` by applying the
+        (old, new) alloc pairs the store journaled between the base's
+        index and the snapshot's -- the incremental-memo half of ISSUE
+        6's delta path. Returns the caught-up base (also re-memoized on
+        the matrix), or None when the journal can't cover the span or a
+        delta touches port state (refold instead)."""
+        from ..tensor.pack import _stat_incr
+
+        deltas_fn = getattr(store, "alloc_deltas_since", None)
+        if deltas_fn is None:
+            return None
+        covered, pairs = deltas_fn(ent[1], upto=token)
+        if not covered:
+            return None
+        pos_of = matrix.__dict__.get("_pos_index")
+        if pos_of is None:
+            pos_of = {nid: i for i, nid in enumerate(matrix.node_ids)}
+            matrix._pos_index = pos_of
+        old_base = ent[2]
+        uc = old_base["used_cpu"].copy()
+        um = old_base["used_mem"].copy()
+        ud = old_base["used_disk"].copy()
+        for old, new in pairs:
+            for a, sign in ((old, -1), (new, +1)):
+                if a is None or a.client_terminal_status():
+                    continue
+                i = pos_of.get(a.node_id)
+                if i is None:
+                    continue
+                if a.allocated_resources.all_ports():
+                    return None     # port state entered the base: refold
+                cr = a.allocated_resources.comparable()
+                uc[i] += sign * cr.cpu_shares
+                um[i] += sign * cr.memory_mb
+                ud[i] += sign * cr.disk_mb
+        base = {"used_cpu": uc, "used_mem": um, "used_disk": ud,
+                "ports": None, "dyn_used": old_base["dyn_used"]}
+        matrix._usage_base = (store, token, base)
+        _stat_incr("usage_base_delta_hits")
+        return base
 
     def _overlay_plan_deltas(self, usage, nodes, tg) -> None:
         """Apply this eval's in-flight plan to the packed usage: stops and

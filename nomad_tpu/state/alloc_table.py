@@ -11,6 +11,7 @@ incrementally at write time.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 from functools import lru_cache
 from typing import Dict, Optional
@@ -20,6 +21,16 @@ import numpy as np
 from .. import native
 
 MAX_PORTS = native.MAX_PORTS_PER_ALLOC
+
+
+def pack_delta_enabled() -> bool:
+    """Incremental fold maintenance (ISSUE 6): every alloc write adjusts
+    the resident per-slot usage/verify folds in place instead of
+    invalidating them wholesale, so sustained churn pays O(write) rather
+    than an O(rows) refold per table version. ``NOMAD_TPU_PACK_DELTA=0``
+    is the kill switch restoring the wholesale-invalidation path
+    bit-for-bit (test-gated)."""
+    return os.environ.get("NOMAD_TPU_PACK_DELTA", "1") != "0"
 
 
 @lru_cache(maxsize=65536)
@@ -73,8 +84,17 @@ class AllocTable:
         self.dyn_hi = np.full(self._node_cap, 32000, dtype=np.int32)
         # verify-fold memo: one vectorized per-slot usage fold per table
         # VERSION, shared by every plan the applier verifies between two
-        # commits (a batch of 32 plans used to pay 32 full-table folds)
+        # commits (a batch of 32 plans used to pay 32 full-table folds).
+        # Only used on the NOMAD_TPU_PACK_DELTA=0 kill-switch path; with
+        # deltas on, _fold_inc below is maintained in place instead.
         self._verify_fold_cache: Optional[tuple] = None
+        # incremental per-slot fold columns (built lazily on first use,
+        # then adjusted by every upsert/remove): uc/um/ud under the
+        # scheduler's `live` filter (serves pack()'s non-port lanes),
+        # vc/vm/vd/vspec under the applier's `live_strict` filter
+        # (serves _fold_verify_all). vspec is a COUNT of live special
+        # rows per slot (reversible, unlike the boolean OR).
+        self._fold_inc: Optional[dict] = None
 
     # ------------------------------------------------------------------
     def register_node(self, node) -> int:
@@ -82,15 +102,116 @@ class AllocTable:
         slot = self._slot_of_node.get(node.id)
         if slot is None:
             if self.n_nodes == self._node_cap:
+                grow = self._node_cap
                 self._node_cap *= 2
                 self.dyn_lo = np.resize(self.dyn_lo, self._node_cap)
                 self.dyn_hi = np.resize(self.dyn_hi, self._node_cap)
+                inc = self._fold_inc
+                if inc is not None:
+                    # new slots carry zero usage by definition
+                    for k, arr in inc.items():
+                        inc[k] = np.concatenate(
+                            [arr, np.zeros(grow, dtype=arr.dtype)])
             slot = self.n_nodes
             self._slot_of_node[node.id] = slot
             self.n_nodes += 1
         self.dyn_lo[slot] = node.node_resources.min_dynamic_port
         self.dyn_hi[slot] = node.node_resources.max_dynamic_port
         return slot
+
+    # -- incremental fold maintenance (NOMAD_TPU_PACK_DELTA) ------------
+    def _fold_inc_build(self) -> dict:
+        """Full recount into the per-slot incremental fold columns; the
+        ground truth every delta adjustment must stay equal to
+        (fold_parity_mismatch gates that in tests and the churn bench)."""
+        cap = self._node_cap
+        inc = {
+            "uc": np.zeros(cap), "um": np.zeros(cap), "ud": np.zeros(cap),
+            "vc": np.zeros(cap), "vm": np.zeros(cap), "vd": np.zeros(cap),
+            "vspec": np.zeros(cap, dtype=np.int64),
+        }
+        n = self.n_rows
+        if n:
+            slots = self.node_slot[:n]
+            ok = slots >= 0
+            live = (self.live[:n] > 0) & ok
+            m = slots[live]
+            np.add.at(inc["uc"], m, self.cpu[:n][live])
+            np.add.at(inc["um"], m, self.mem[:n][live])
+            np.add.at(inc["ud"], m, self.disk[:n][live])
+            lives = (self.live_strict[:n] > 0) & ok
+            ms = slots[lives]
+            np.add.at(inc["vc"], ms, self.cpu[:n][lives])
+            np.add.at(inc["vm"], ms, self.mem[:n][lives])
+            np.add.at(inc["vd"], ms, self.disk[:n][lives])
+            np.add.at(inc["vspec"],
+                      slots[lives & (self.special[:n] > 0)], 1)
+        self._fold_inc = inc
+        return inc
+
+    def _fold_inc_get(self) -> Optional[dict]:
+        if not pack_delta_enabled():
+            return None
+        inc = self._fold_inc
+        if inc is None:
+            inc = self._fold_inc_build()
+        return inc
+
+    def _fold_inc_row(self, row: int, sign: int) -> None:
+        """Adjust the incremental fold by one row's CURRENT column values
+        (sign -1 before overwriting/removing a row, +1 after writing)."""
+        inc = self._fold_inc
+        slot = int(self.node_slot[row])
+        if slot < 0:
+            return
+        c, m, d = self.cpu[row], self.mem[row], self.disk[row]
+        if self.live[row]:
+            inc["uc"][slot] += sign * c
+            inc["um"][slot] += sign * m
+            inc["ud"][slot] += sign * d
+        if self.live_strict[row]:
+            inc["vc"][slot] += sign * c
+            inc["vm"][slot] += sign * m
+            inc["vd"][slot] += sign * d
+            if self.special[row]:
+                inc["vspec"][slot] += sign
+
+    def _fold_inc_rows(self, rows: np.ndarray, sign: int) -> None:
+        """Vectorized _fold_inc_row over a row-index array."""
+        inc = self._fold_inc
+        if inc is None or not len(rows):
+            return
+        slots = self.node_slot[rows]
+        ok = slots >= 0
+        r, s = rows[ok], slots[ok]
+        if not len(r):
+            return
+        live = self.live[r] > 0
+        np.add.at(inc["uc"], s[live], sign * self.cpu[r][live])
+        np.add.at(inc["um"], s[live], sign * self.mem[r][live])
+        np.add.at(inc["ud"], s[live], sign * self.disk[r][live])
+        lives = self.live_strict[r] > 0
+        np.add.at(inc["vc"], s[lives], sign * self.cpu[r][lives])
+        np.add.at(inc["vm"], s[lives], sign * self.mem[r][lives])
+        np.add.at(inc["vd"], s[lives], sign * self.disk[r][lives])
+        spec = lives & (self.special[r] > 0)
+        np.add.at(inc["vspec"], s[spec], sign)
+
+    def fold_parity_mismatch(self, atol: float = 1e-6) -> int:
+        """Parity gate for the delta path: compare the incrementally
+        maintained fold against a fresh full recount; returns the number
+        of mismatching slots (0 = parity). The fresh recount replaces
+        the resident fold, so a detected drift also self-heals."""
+        saved = self._fold_inc
+        if saved is None:
+            return 0
+        fresh = self._fold_inc_build()      # re-assigns self._fold_inc
+        n = self.n_nodes
+        bad = np.zeros(n, dtype=bool)
+        for k in ("uc", "um", "ud", "vc", "vm", "vd"):
+            bad |= np.abs(saved[k][:n] - fresh[k][:n]) > atol
+        bad |= (saved["vspec"][:n] > 0) != (fresh["vspec"][:n] > 0)
+        return int(bad.sum())
 
     def node_slot_of(self, node_id: str) -> int:
         return self._slot_of_node.get(node_id, -1)
@@ -118,6 +239,7 @@ class AllocTable:
     def upsert(self, alloc) -> None:
         self.version += 1
         row = self._row_of.get(alloc.id)
+        existed = row is not None
         if row is None:
             if self._free:
                 row = self._free.pop()
@@ -127,6 +249,10 @@ class AllocTable:
                 row = self.n_rows
                 self.n_rows += 1
             self._row_of[alloc.id] = row
+        if existed and self._fold_inc is not None:
+            # retract the row's old contribution before overwriting
+            # (fresh/freed rows contribute nothing: remove() zeroes them)
+            self._fold_inc_row(row, -1)
         cr = alloc.allocated_resources.comparable()
         self.node_slot[row] = self._slot_of_node.get(alloc.node_id, -1)
         self.cpu[row] = cr.cpu_shares
@@ -139,6 +265,8 @@ class AllocTable:
         self.job_hash[row] = stable_hash(alloc.namespace, alloc.job_id)
         self.jobtg_hash[row] = stable_hash(alloc.namespace, alloc.job_id,
                                            alloc.task_group)
+        if self._fold_inc is not None:
+            self._fold_inc_row(row, +1)
         had_ports = self.ports[row, 0] >= 0
         had_overflow = row in self._overflow_rows
         self.ports[row, :] = -1
@@ -192,6 +320,7 @@ class AllocTable:
         while self.n_rows + n_new - len(self._free) > self._cap:
             self._grow()
         rows = np.empty(len(allocs), dtype=np.int64)
+        existed = np.zeros(len(allocs), dtype=bool)
         for k, a in enumerate(allocs):
             row = self._row_of.get(a.id)
             if row is None:
@@ -201,7 +330,14 @@ class AllocTable:
                     row = self.n_rows
                     self.n_rows += 1
                 self._row_of[a.id] = row
+            else:
+                existed[k] = True
             rows[k] = row
+        if self._fold_inc is not None:
+            # retract reused rows' old contributions (fresh/freed rows
+            # contribute nothing -- and fresh rows past the old n_rows
+            # hold resize garbage, so they MUST be skipped here)
+            self._fold_inc_rows(rows[existed], -1)
         slot_of = self._slot_of_node
         self.node_slot[rows] = [slot_of.get(a.node_id, -1)
                                 for a in allocs]
@@ -213,6 +349,8 @@ class AllocTable:
         self.special[rows] = special
         self.job_hash[rows] = job_hash
         self.jobtg_hash[rows] = jobtg_hash
+        if self._fold_inc is not None:
+            self._fold_inc_rows(rows, +1)
         # ports: reused rows (freed or replaced) may hold stale port
         # values -- the scalar path resets every upserted row, so the
         # batch must too (vectorized), BEFORE which the accounting
@@ -249,6 +387,8 @@ class AllocTable:
         if row is None:
             return
         self.version += 1
+        if self._fold_inc is not None:
+            self._fold_inc_row(row, -1)
         if self.ports[row, 0] >= 0:
             self.rows_with_ports -= 1
         self._overflow_rows.discard(row)
@@ -285,6 +425,24 @@ class AllocTable:
         # (potentially 80MB) bitmap fold entirely otherwise.
         use_ports = with_ports and (self.rows_with_ports > 0
                                     or port_words_seed is not None)
+        inc = None if use_ports else self._fold_inc_get()
+        if inc is not None:
+            # incremental path: gather the resident per-slot fold into the
+            # caller's node ordering -- O(nodes) per pack instead of the
+            # O(rows) native fold per table version (what sustained churn
+            # defeats). Portless lanes see exactly what native.pack_usage
+            # returns with ports=None: zero dyn_used, no bitmap.
+            used_cpu = np.zeros(n_pad, dtype=np.float64)
+            used_mem = np.zeros(n_pad, dtype=np.float64)
+            used_disk = np.zeros(n_pad, dtype=np.float64)
+            sel = node_slots_for_pad[valid]
+            used_cpu[valid] = inc["uc"][sel]
+            used_mem[valid] = inc["um"][sel]
+            used_disk[valid] = inc["ud"][sel]
+            return {"used_cpu": used_cpu, "used_mem": used_mem,
+                    "used_disk": used_disk,
+                    "dyn_used": np.zeros(n_pad, dtype=np.int32),
+                    "port_words": None, "row_slots": mapped}
         used_cpu, used_mem, used_disk, dyn_used, port_words = \
             native.pack_usage(
                 mapped.astype(np.int32), self.cpu[:n], self.mem[:n],
@@ -302,7 +460,16 @@ class AllocTable:
         vectorized pass over all rows serves every fold_verify call until
         the next mutation -- the group-commit applier verifies a whole
         batch of plans between two commits, so the fold amortizes across
-        the batch (and across the barrier's 32 lanes at headline shape)."""
+        the batch (and across the barrier's 32 lanes at headline shape).
+        With NOMAD_TPU_PACK_DELTA on (the default) the fold is served
+        straight from the incrementally-maintained columns -- no refold
+        on version change at all; the version-keyed memo below is the
+        kill-switch (wholesale invalidation) path."""
+        inc = self._fold_inc_get()
+        if inc is not None:
+            n = self.n_nodes
+            return (inc["vc"][:n], inc["vm"][:n], inc["vd"][:n],
+                    inc["vspec"][:n] > 0)
         cache = self._verify_fold_cache
         if cache is not None and cache[0] == self.version:
             return cache[1]
@@ -348,6 +515,52 @@ class AllocTable:
         used_d = np.where(found, base_d[idx], 0.0)
         spec_any = found & base_s[idx]
         return used_c, used_m, used_d, spec_any, found
+
+    # ------------------------------------------------------------------
+    def compact(self) -> dict:
+        """Rebuild row storage densely: surviving allocs are repacked
+        into rows [0, k), freed rows vanish, and capacity shrinks to the
+        smallest power-of-two bucket holding the survivors -- the memory
+        actually returns (the ports matrix alone is cap x MAX_PORTS
+        int32). Called by the core-gc loop via
+        StateStore.compact_alloc_table once the free-row count crosses
+        the watermark; caller holds the owning store's lock."""
+        items = sorted(self._row_of.items(), key=lambda kv: kv[1])
+        k = len(items)
+        src = np.fromiter((r for _, r in items), dtype=np.int64, count=k)
+        old_rows, old_cap = self.n_rows, self._cap
+        new_cap = 1024
+        while new_cap < k:
+            new_cap *= 2
+        for name, fill in (("node_slot", -1), ("cpu", 0), ("mem", 0),
+                           ("disk", 0), ("live", 0), ("live_strict", 0),
+                           ("special", 0), ("job_hash", 0),
+                           ("jobtg_hash", 0)):
+            old = getattr(self, name)
+            arr = np.full(new_cap, fill, dtype=old.dtype)
+            arr[:k] = old[src]
+            setattr(self, name, arr)
+        ports = np.full((new_cap, MAX_PORTS), -1, dtype=np.int32)
+        ports[:k] = self.ports[src]
+        self.ports = ports
+        row_map = {int(old): i for i, old in enumerate(src)}
+        self._overflow_rows = {row_map[r] for r in self._overflow_rows
+                               if r in row_map}
+        self._row_of = {aid: i for i, (aid, _) in enumerate(items)}
+        self.rows_with_ports = int((self.ports[:k, 0] >= 0).sum()) if k \
+            else 0
+        self._free = []
+        self.n_rows = k
+        self._cap = new_cap
+        self.version += 1
+        self._verify_fold_cache = None
+        self._fold_inc = None       # lazily rebuilt from the dense rows
+        return {"rows_before": old_rows, "rows_after": k,
+                "cap_before": old_cap, "cap_after": new_cap}
+
+    @property
+    def free_rows(self) -> int:
+        return len(self._free)
 
     def count_placed(self, n_pad: int, mapped_slots: np.ndarray,
                      namespace: str, job_id: str, tg_name: str):

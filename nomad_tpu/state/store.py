@@ -287,6 +287,13 @@ class StateStore:
         self._dirty_alloc_jobs: set = set()
         # watch support
         self._watch_cond = threading.Condition(self._lock)
+        # bounded journal of alloc-level write deltas: (index, pairs)
+        # where pairs is [(old_alloc|None, new_alloc|None), ...] or None
+        # for writes with no structured delta. Lets incremental memo
+        # holders (solver/service.py usage base) catch a stale fold up
+        # to the current index instead of refolding (ISSUE 6).
+        from collections import deque as _deque
+        self._alloc_deltas: "_deque" = _deque(maxlen=128)
         # tensor-resident alloc table (fed to the TPU solver's native
         # packing kernels; maintained incrementally on every write)
         self.alloc_table = AllocTable()
@@ -317,36 +324,67 @@ class StateStore:
                     return self._index
                 self._watch_cond.wait(remaining)
 
-    def _bump(self, *tables: str) -> int:
+    def _bump(self, *tables: str, delta=None) -> int:
+        """Advance the raft-style index for a logical write. ``delta``
+        carries the write's alloc-level change set -- a list of
+        (old_alloc_or_None, new_alloc_or_None) pairs -- when the caller
+        knows it (plan commits, client updates, GC deletes); cache
+        layers get it through ONE delta-aware notification instead of a
+        bare "something changed", and the bounded journal below lets
+        incremental memo holders catch a stale base up to the current
+        index by applying the missed deltas instead of refolding."""
         self._index += 1
         for t in tables:
             self._table_index[t] = self._index
         self._snap_cache = None
-        if "nodes" in tables:
-            # fleet tables changed: device-resident const buffers keyed
-            # to older node-table versions are dead weight -- tell the
-            # solver's const cache (solver/constcache.py). Resolved via
-            # sys.modules so a store used without the solver stack never
-            # pays the (jax-importing) solver package import.
-            import sys as _sys
-            # getattr-guarded: sys.modules can hand back a PARTIALLY
-            # initialized module while another thread is mid-import
-            # (first eval racing a node registration burst) -- the
-            # attribute simply isn't there yet, and there is nothing to
-            # invalidate before the module finished loading anyway
-            cc = _sys.modules.get("nomad_tpu.solver.constcache")
-            hook = getattr(cc, "note_node_table_write", None)
-            if hook is not None:
-                hook(self._index)
-            # ... and the host-side pack caches: matrices (with their
-            # attached feasibility/spread/affinity memos) keyed to
-            # older fleet versions can never be keyed again
-            tp = _sys.modules.get("nomad_tpu.tensor.pack")
-            hook = getattr(tp, "note_node_table_write", None)
-            if hook is not None:
-                hook(self._index)
+        if "allocs" in tables:
+            # journal entry even for delta=None writes: consumers learn
+            # the span is NOT coverable by deltas and must refold
+            self._alloc_deltas.append((self._index, delta))
+        self._notify_write_hooks(tables, self._index, delta)
         self._watch_cond.notify_all()
         return self._index
+
+    @staticmethod
+    def _notify_write_hooks(tables, index: int, delta) -> None:
+        """One delta-aware notification shared by every cache layer
+        (solver const cache + host pack caches). Resolved via
+        sys.modules so a store used without the solver stack never pays
+        the (jax-importing) solver package import; getattr-guarded
+        because sys.modules can hand back a PARTIALLY initialized module
+        while another thread is mid-import (first eval racing a node
+        registration burst) -- there is nothing to invalidate before
+        the module finished loading anyway."""
+        import sys as _sys
+        for mod in ("nomad_tpu.solver.constcache", "nomad_tpu.tensor.pack"):
+            m = _sys.modules.get(mod)
+            hook = getattr(m, "note_table_write", None)
+            if hook is not None:
+                hook(tables, index, delta)
+
+    def alloc_deltas_since(self, index: int, upto: Optional[int] = None):
+        """(covered, pairs): every alloc-level (old, new) change pair
+        recorded for writes in (index, upto] (upto None = current).
+        ``covered`` is False when the journal doesn't reach back that
+        far or a write in the span carried no structured delta -- the
+        consumer must refold instead of applying deltas."""
+        with self._lock:
+            pairs = []
+            hi = self._table_index.get("allocs", 0) if upto is None \
+                else upto
+            if not self._alloc_deltas:
+                return (index >= self._table_index.get("allocs", 0)
+                        or index >= hi), pairs
+            oldest = self._alloc_deltas[0][0]
+            if index < oldest - 1:
+                return False, pairs
+            for idx, delta in self._alloc_deltas:
+                if idx <= index or idx > hi:
+                    continue
+                if delta is None:
+                    return False, []
+                pairs.extend(delta)
+            return True, pairs
 
     def snapshot(self) -> StateSnapshot:
         with self._lock:
@@ -636,12 +674,15 @@ class StateStore:
     # -- allocs --------------------------------------------------------------
     def upsert_allocs(self, allocs: List[Allocation]) -> int:
         with self._lock:
-            self._insert_allocs_locked(allocs)
-            return self._bump("allocs")
+            pairs = self._insert_allocs_locked(allocs)
+            return self._bump("allocs", delta=pairs)
 
-    def _insert_allocs_locked(self, allocs: List[Allocation]) -> None:
+    def _insert_allocs_locked(self, allocs: List[Allocation]) -> list:
+        """Returns the write's (old_alloc_or_None, new_alloc) delta pairs
+        for the _bump journal."""
         import time as _time
         now = _time.time()
+        pairs = []
         for alloc in allocs:
             existing = self._allocs.get(alloc.id)
             if existing is not None:
@@ -655,17 +696,20 @@ class StateStore:
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job
             self._allocs[alloc.id] = alloc
+            pairs.append((existing, alloc))
             self._allocs_by_node.setdefault(alloc.node_id, {})[alloc.id] = None
             self._dirty_alloc_nodes.add(alloc.node_id)
             jk = (alloc.namespace, alloc.job_id)
             self._allocs_by_job.setdefault(jk, {})[alloc.id] = None
             self._dirty_alloc_jobs.add(jk)
         self.alloc_table.upsert_many(allocs)
+        return pairs
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
         """Client-side status updates (reference: Node.UpdateAlloc
         node_endpoint.go:1322 -> state UpdateAllocsFromClient)."""
         with self._lock:
+            pairs = []
             for updated in allocs:
                 existing = self._allocs.get(updated.id)
                 if existing is None:
@@ -684,8 +728,9 @@ class StateStore:
                 import time as _time
                 alloc.modify_time = _time.time()
                 self._allocs[alloc.id] = alloc
+                pairs.append((existing, alloc))
                 self.alloc_table.upsert(alloc)
-            return self._bump("allocs")
+            return self._bump("allocs", delta=pairs)
 
     def update_alloc_desired_transition(self, alloc_ids: List[str],
                                         migrate: bool = True) -> int:
@@ -694,6 +739,7 @@ class StateStore:
         with self._lock:
             import copy as _copy
             from ..structs import DesiredTransition
+            pairs = []
             for aid in alloc_ids:
                 existing = self._allocs.get(aid)
                 if existing is None:
@@ -702,13 +748,16 @@ class StateStore:
                 alloc.desired_transition = DesiredTransition(migrate=migrate)
                 alloc.modify_index = self._index + 1
                 self._allocs[aid] = alloc
-            return self._bump("allocs")
+                pairs.append((existing, alloc))
+            return self._bump("allocs", delta=pairs)
 
     def delete_allocs(self, alloc_ids: List[str]) -> int:
         with self._lock:
+            pairs = []
             for aid in alloc_ids:
                 a = self._allocs.pop(aid, None)
                 if a is not None:
+                    pairs.append((a, None))
                     ids = self._allocs_by_node.get(a.node_id)
                     if ids is not None:
                         ids.pop(aid, None)
@@ -719,7 +768,7 @@ class StateStore:
                         jids.pop(aid, None)
                     self._dirty_alloc_jobs.add(jk)
                 self.alloc_table.remove(aid)
-            return self._bump("allocs")
+            return self._bump("allocs", delta=pairs)
 
     # -- deployments ---------------------------------------------------------
     def upsert_deployment(self, deployment: Deployment) -> int:
@@ -1189,7 +1238,8 @@ class StateStore:
         """Apply one plan result's dict/object writes (stop merges,
         deployments, eval updates) WITHOUT touching the tensor table or
         secondary indexes, which the caller batches across plans. Returns
-        (merged_stops, placements) for those deferred columnar writes.
+        (merged_stops, placements, delta_pairs) -- the first two for
+        those deferred columnar writes, the pairs for the _bump journal.
         Lock held; no index bump here."""
         stops: List[Allocation] = []
         for allocs in result.node_update.values():
@@ -1204,6 +1254,7 @@ class StateStore:
         import copy as _copy
         import time as _time
         merged = []
+        pairs = []
         for stop in stops:
             existing = self._allocs.get(stop.id)
             if existing is None:
@@ -1220,6 +1271,7 @@ class StateStore:
             alloc.modify_time = _time.time()
             self._allocs[alloc.id] = alloc
             merged.append(alloc)
+            pairs.append((existing, alloc))
 
         if result.deployment is not None:
             d = result.deployment
@@ -1243,7 +1295,7 @@ class StateStore:
             for ev in eval_updates:
                 ev.modify_index = self._index + 1
                 self._evals[ev.id] = ev
-        return merged, placements
+        return merged, placements, pairs
 
     def upsert_plan_results(self, result: PlanResult,
                             eval_updates: Optional[List[Evaluation]] = None
@@ -1252,7 +1304,7 @@ class StateStore:
         (reference: state_store.go:382 UpsertPlanResults, applied by the FSM
         for ApplyPlanResultsRequestType)."""
         with self._lock:
-            merged, placements = self._stage_plan_result_locked(
+            merged, placements, pairs = self._stage_plan_result_locked(
                 result, eval_updates)
             # refresh the tensor rows (batched): the allocs just became
             # server-terminal, and the verify fast path's live_strict
@@ -1263,12 +1315,13 @@ class StateStore:
             # (tests/test_verify_fold.py pins this)
             self.alloc_table.upsert_many(merged)
 
-            self._insert_allocs_locked(placements)
+            pairs.extend(self._insert_allocs_locked(placements))
             if self._csi_volumes:
                 for alloc in placements:
                     self._csi_claim_locked(alloc)
 
-            idx = self._bump("allocs", "deployments", "evals")
+            idx = self._bump("allocs", "deployments", "evals",
+                             delta=pairs)
             result.alloc_index = idx
             return idx
 
@@ -1293,29 +1346,48 @@ class StateStore:
             outcomes: List[Optional[BaseException]] = []
             merged_all: List[Allocation] = []
             placements_all: List[Allocation] = []
+            pairs_all: list = []
             staged: List[Tuple[PlanResult, List[Allocation]]] = []
             for result, eval_updates in entries:
                 try:
                     faults.fire("plan.commit")
-                    merged, placements = self._stage_plan_result_locked(
-                        result, eval_updates)
+                    merged, placements, pairs = \
+                        self._stage_plan_result_locked(result, eval_updates)
                 except BaseException as e:  # noqa: BLE001 -- split batch
                     outcomes.append(e)
                     continue
                 merged_all.extend(merged)
                 placements_all.extend(placements)
+                pairs_all.extend(pairs)
                 staged.append((result, placements))
                 outcomes.append(None)
             self.alloc_table.upsert_many(merged_all)
-            self._insert_allocs_locked(placements_all)
+            pairs_all.extend(self._insert_allocs_locked(placements_all))
             if self._csi_volumes:
                 for _, placements in staged:
                     for alloc in placements:
                         self._csi_claim_locked(alloc)
-            idx = self._bump("allocs", "deployments", "evals")
+            idx = self._bump("allocs", "deployments", "evals",
+                             delta=pairs_all)
             for result, _ in staged:
                 result.alloc_index = idx
             return idx, outcomes
+
+    def compact_alloc_table(self, min_free: int = 4096,
+                            free_ratio: float = 0.5):
+        """Compact the tensor-resident alloc table once freed rows
+        dominate: GC'd terminal allocs leave free rows behind, and under
+        sustained churn those would otherwise pin peak-row-count RSS for
+        the process lifetime. Compacts only when the free-row count
+        exceeds BOTH ``min_free`` and ``free_ratio`` of the row span
+        (small fleets never pay the copy). Returns the compaction stats
+        dict, or None when below the watermark."""
+        with self._lock:
+            t = self.alloc_table
+            if t.free_rows < min_free or \
+                    t.free_rows < free_ratio * max(1, t.n_rows):
+                return None
+            return t.compact()
 
     # -- snapshot passthrough reads (so StateStore satisfies the scheduler's
     #    State interface directly in tests) --------------------------------

@@ -52,7 +52,22 @@ JOB_MAX_PRIORITY = 100
 # the same reason in tests; production go uuids are also not a secrecy
 # boundary). getrandbits on the shared Random is a single C call, atomic
 # under the GIL.
-_uuid_rng = random.Random(uuid.uuid4().int)
+# NOMAD_TPU_SEED_IDS pins the stream: eval ids seed the scheduler's
+# node shuffle (scheduler/util.py shuffle_seed), which is the tie-break
+# ordering for equal-score nodes -- a seeded stream makes placements
+# reproducible run-to-run (tests/conftest.py reseeds per test), and the
+# host and TPU paths derive the SAME shuffle from the id, so parity is
+# unaffected by construction.
+import os as _os
+
+_seed_env = _os.environ.get("NOMAD_TPU_SEED_IDS", "")
+_uuid_rng = random.Random(int(_seed_env) if _seed_env
+                          else uuid.uuid4().int)
+
+
+def reseed_ids(seed: int) -> None:
+    """Re-pin the id stream (test hook: deterministic tie-breaks)."""
+    _uuid_rng.seed(seed)
 
 
 _UUID_VARIANT = "89ab"

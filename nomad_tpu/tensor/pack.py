@@ -146,6 +146,10 @@ _PACK_STATS = {
     "matrix_misses": 0,
     "usage_base_hits": 0,   # per-snapshot usage-base fold (service.py)
     "usage_base_misses": 0,
+    # stale base advanced by applying journaled alloc deltas instead of
+    # refolding (service.py _catch_up_usage_base; counts as a hit in the
+    # per-eval window)
+    "usage_base_delta_hits": 0,
     "invalidations": 0,
 }
 _PACK_STATS_LOCK = _threading.Lock()
@@ -202,6 +206,17 @@ def invalidate_pack_caches(reason: str = "") -> None:
         _NODE_MATRIX_CACHE.clear()
     if had:
         _stat_incr("invalidations")
+
+
+def note_table_write(tables, table_index: int, delta=None) -> None:
+    """Unified store-write hook (state/store.py _notify_write_hooks):
+    one delta-aware notification shared with the solver const cache.
+    Fleet-table writes drop stale matrices here; alloc writes carry
+    their (old, new) delta pairs, which the matrix-attached usage-base
+    memos consume lazily via StateStore.alloc_deltas_since (the journal
+    the same _bump call appended to)."""
+    if "nodes" in tables:
+        note_node_table_write(table_index)
 
 
 def note_node_table_write(table_index: int) -> None:

@@ -1,0 +1,179 @@
+"""Flap-dampened node lifecycle (ISSUE 6): repeated ready->down
+transitions feed a per-node flap score (NodeFlapTracker extends
+BadNodeTracker's windowed scoring); past the threshold, the node's
+down->ready recovery is deferred by an escalating quarantine window so
+one sick node cannot generate an eval storm. NOMAD_TPU_FLAP=0 restores
+today's immediate transitions (test-gated), and the flap state rides
+/v1/agent/self + `operator node flaps` like the breaker state does.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.core import NodeFlapTracker
+from nomad_tpu.structs import NODE_STATUS_DOWN, NODE_STATUS_READY
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1, heartbeat_ttl=60.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def register(server, i=0):
+    n = mock.node()
+    n.id = f"flap-node-{i:04d}"
+    n.compute_class()
+    server.register_node(n)
+    return n
+
+
+def flap_once(server, node_id):
+    server.update_node_status(node_id, NODE_STATUS_DOWN)
+    server.heartbeat(node_id)
+
+
+# ----------------------------------------------------------------------
+# Tracker unit behavior
+
+
+def test_tracker_quarantine_escalates_and_caps(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FLAP_THRESHOLD", "2")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_BASE_S", "4")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_MAX_S", "10")
+    t = NodeFlapTracker()
+    assert t.record_down("n1") == 1
+    assert t.quarantine_remaining("n1") == 0.0      # below threshold
+    assert t.record_down("n1") == 2
+    rem2 = t.quarantine_remaining("n1")
+    assert 0 < rem2 <= 4.0                          # base * 2^0
+    assert t.record_down("n1") == 3
+    rem3 = t.quarantine_remaining("n1")
+    assert rem2 < rem3 <= 8.0                       # base * 2^1
+    t.record_down("n1")
+    assert t.quarantine_remaining("n1") <= 10.0     # capped at max
+    # release lifts it immediately (register_node's override path)
+    t.release("n1")
+    assert t.quarantine_remaining("n1") == 0.0
+
+
+def test_tracker_killswitch(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FLAP", "0")
+    t = NodeFlapTracker()
+    for _ in range(10):
+        assert t.record_down("n1") == 0
+    assert t.quarantine_remaining("n1") == 0.0
+    assert t.state()["enabled"] is False
+
+
+def test_tracker_state_surface(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FLAP_THRESHOLD", "2")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_BASE_S", "30")
+    t = NodeFlapTracker()
+    t.record_down("a")
+    t.record_down("a")
+    t.record_down("b")
+    st = t.state()
+    assert st["enabled"] and st["threshold"] == 2
+    assert st["scores"] == {"a": 2, "b": 1}
+    assert "a" in st["quarantined"] and st["quarantined"]["a"] > 0
+    assert "b" not in st["quarantined"]
+
+
+# ----------------------------------------------------------------------
+# Server integration
+
+
+def test_single_flap_recovers_immediately(server, monkeypatch):
+    """Below the threshold nothing changes: one down->ready transition
+    is as immediate as it was before flap damping existed."""
+    n = register(server)
+    flap_once(server, n.id)
+    assert server.state.node_by_id(n.id).status == NODE_STATUS_READY
+
+
+def test_repeat_flapper_quarantined_then_recovers(server, monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FLAP_THRESHOLD", "2")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_BASE_S", "0.4")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_MAX_S", "0.4")
+    server.flaps = NodeFlapTracker()
+    n = register(server)
+    flap_once(server, n.id)
+    assert server.state.node_by_id(n.id).status == NODE_STATUS_READY
+    # second flap crosses the threshold: the heartbeat no longer
+    # resurrects the node...
+    server.update_node_status(n.id, NODE_STATUS_DOWN)
+    assert server.heartbeat(n.id) == server.heartbeat_ttl
+    assert server.state.node_by_id(n.id).status == NODE_STATUS_DOWN
+    # ...until the quarantine window passes
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        server.heartbeat(n.id)
+        if server.state.node_by_id(n.id).status == NODE_STATUS_READY:
+            break
+        time.sleep(0.05)
+    assert server.state.node_by_id(n.id).status == NODE_STATUS_READY
+
+
+def test_killswitch_restores_immediate_transitions(server, monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FLAP", "0")
+    server.flaps = NodeFlapTracker()
+    n = register(server)
+    for _ in range(6):
+        flap_once(server, n.id)
+        assert server.state.node_by_id(n.id).status == NODE_STATUS_READY
+
+
+def test_reregistration_lifts_quarantine(server, monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_FLAP_THRESHOLD", "1")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_BASE_S", "60")
+    server.flaps = NodeFlapTracker()
+    n = register(server)
+    server.update_node_status(n.id, NODE_STATUS_DOWN)
+    server.heartbeat(n.id)
+    assert server.state.node_by_id(n.id).status == NODE_STATUS_DOWN
+    # explicit re-registration is the operator override
+    server.register_node(n)
+    assert server.state.node_by_id(n.id).status == NODE_STATUS_READY
+    assert server.flaps.quarantine_remaining(n.id) == 0.0
+
+
+def test_flap_state_on_agent_self_and_cli(server, monkeypatch):
+    """The operational surface: /v1/agent/self stats.node_flaps and
+    `operator node flaps` both render the tracker state."""
+    import io
+    from contextlib import redirect_stdout
+
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("NOMAD_TPU_FLAP_THRESHOLD", "1")
+    monkeypatch.setenv("NOMAD_TPU_FLAP_BASE_S", "60")
+    server.flaps = NodeFlapTracker()
+    n = register(server)
+    server.update_node_status(n.id, NODE_STATUS_DOWN)
+    server.heartbeat(n.id)
+
+    http = HttpServer(server, port=0)
+    http.start()
+    addr = f"http://127.0.0.1:{http.port}"
+    try:
+        api = ApiClient(addr)
+        flaps = api.get("/v1/agent/self")["stats"]["node_flaps"]
+        assert flaps["enabled"] is True
+        assert flaps["scores"].get(n.id) == 1
+        assert n.id in flaps["quarantined"]
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["-address", addr, "operator", "node", "flaps"])
+        assert rc == 0
+        out = buf.getvalue()
+        assert n.id in out and "quarantined" in out
+    finally:
+        http.shutdown()

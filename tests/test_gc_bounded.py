@@ -1,0 +1,92 @@
+"""Bounded-state GC (ISSUE 6): the core-gc loop's terminal-alloc
+watermark pass deletes the oldest terminal history past the retention
+bound regardless of age (the hour-long age sweep alone is unbounded
+relative to the live set under churn), and compacts the alloc table's
+freed rows so the memory actually returns.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_RUNNING
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=0, heartbeat_ttl=60.0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def seed(server, n_terminal=30, n_live=10):
+    n = mock.node()
+    n.compute_class()
+    server.register_node(n)
+    job = mock.job(id="gc-job")
+    server.state.upsert_job(job)
+    terminal, live = [], []
+    for i in range(n_terminal + n_live):
+        a = mock.alloc_for(job, n)
+        if i < n_terminal:
+            a.client_status = ALLOC_CLIENT_COMPLETE
+            terminal.append(a)
+        else:
+            a.client_status = ALLOC_CLIENT_RUNNING
+            live.append(a)
+        server.state.upsert_allocs([a])
+    return terminal, live
+
+
+def test_watermark_deletes_oldest_terminal_first(server):
+    terminal, live = seed(server)
+    # fresh terminal allocs: the age-based sweep (1h threshold) keeps
+    # everything; the watermark pass must still bound them
+    out = server.run_gc_once(terminal_watermark=10)
+    assert out["watermark_allocs"] == 20
+    remaining = [a for a in server.state.allocs()
+                 if a.terminal_status()]
+    assert len(remaining) == 10
+    # oldest went first: survivors are the most recently written
+    oldest_ids = {a.id for a in terminal[:20]}
+    assert not oldest_ids & {a.id for a in remaining}
+    # live allocs untouched
+    assert len([a for a in server.state.allocs()
+                if not a.terminal_status()]) == len(live)
+
+
+def test_watermark_disabled_keeps_everything(server):
+    terminal, _ = seed(server)
+    out = server.run_gc_once(terminal_watermark=0)
+    assert out["watermark_allocs"] == 0
+    assert len([a for a in server.state.allocs()
+                if a.terminal_status()]) == len(terminal)
+
+
+def test_watermark_env_default(server, monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_GC_ALLOC_WATERMARK", "5")
+    terminal, _ = seed(server)
+    out = server.run_gc_once()
+    assert out["watermark_allocs"] == len(terminal) - 5
+
+
+def test_gc_compacts_freed_table_rows(server, monkeypatch):
+    """After the watermark pass frees enough rows, the table compacts
+    (thresholds lowered for the smoke shape) and folds stay exact."""
+    terminal, live = seed(server, n_terminal=40, n_live=8)
+    server.state.alloc_table._fold_inc_get()
+    orig = server.state.compact_alloc_table
+
+    def eager_compact(min_free=4096, free_ratio=0.5):
+        return orig(min_free=8, free_ratio=0.3)
+
+    monkeypatch.setattr(server.state, "compact_alloc_table",
+                        eager_compact)
+    out = server.run_gc_once(terminal_watermark=4)
+    assert out["compacted"] is not None
+    t = server.state.alloc_table
+    assert t.free_rows == 0
+    assert t.n_rows == 4 + len(live)
+    assert t.fold_parity_mismatch() == 0

@@ -1,0 +1,295 @@
+"""Incremental memo deltas (ISSUE 6): the alloc table's verify/usage
+folds are maintained in place by every write (NOMAD_TPU_PACK_DELTA)
+instead of refolding per table version, plans carry their delta context
+through StateStore._bump into one shared cache notification, and the
+solver's usage-base memo catches a stale base up by applying journaled
+deltas. Every incremental result is parity-gated against the
+NOMAD_TPU_PACK_DELTA=0 kill switch (the PR-4/5 wholesale path) bit for
+bit, mirroring how the PR 4/5 kill switches are test-gated.
+"""
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.alloc_table import AllocTable, pack_delta_enabled
+from nomad_tpu.tensor import pack as tpack
+
+
+@pytest.fixture(autouse=True)
+def clean_caches():
+    tpack._reset_pack_caches_for_tests()
+    yield
+    tpack._reset_pack_caches_for_tests()
+
+
+def build_store(n_nodes=8):
+    store = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"pd-node-{i:04d}"
+        n.compute_class()
+        store.upsert_node(n)
+        nodes.append(n)
+    return store, nodes
+
+
+def churn_ops(store, nodes, seed=7, n_jobs=6, per_job=12):
+    """A deterministic mixed write load: placements (batch + scalar),
+    client-terminal transitions, and deletions."""
+    import random
+    rng = random.Random(seed)
+    all_allocs = []
+    for j in range(n_jobs):
+        job = mock.job(id=f"pd-job-{j}")
+        store.upsert_job(job)
+        allocs = []
+        for k in range(per_job):
+            a = mock.alloc_for(job, nodes[rng.randrange(len(nodes))])
+            a.client_status = "running"
+            allocs.append(a)
+        if j % 2:
+            store.upsert_allocs(allocs)          # batch path
+        else:
+            for a in allocs:                     # scalar path
+                store.upsert_allocs([a])
+        all_allocs.extend(allocs)
+    # a third complete, a sixth is deleted outright
+    done = [a for i, a in enumerate(all_allocs) if i % 3 == 0]
+    for a in done:
+        upd = a.copy_skip_job()
+        upd.client_status = "complete"
+        store.update_allocs_from_client([upd])
+    store.delete_allocs([a.id for i, a in enumerate(all_allocs)
+                         if i % 6 == 1])
+    return all_allocs
+
+
+def snapshot_folds(store, node_ids):
+    t = store.alloc_table
+    uc, um, ud, spec, found = t.fold_verify(node_ids)
+    slots = np.fromiter((t.node_slot_of(i) for i in node_ids),
+                        dtype=np.int32, count=len(node_ids))
+    packed = t.pack(len(node_ids), slots, with_ports=False)
+    return (uc, um, ud, spec, found, packed["used_cpu"],
+            packed["used_mem"], packed["used_disk"], packed["dyn_used"])
+
+
+# ----------------------------------------------------------------------
+# Incremental fold vs full refold (parity gate)
+
+
+def test_incremental_fold_parity_after_mixed_churn():
+    store, nodes = build_store()
+    # force the incremental fold alive BEFORE the churn, so every write
+    # path below exercises the delta adjustments
+    store.alloc_table._fold_inc_get()
+    churn_ops(store, nodes)
+    assert store.alloc_table.fold_parity_mismatch() == 0
+
+
+def test_incremental_fold_parity_with_special_allocs():
+    """Port-carrying allocs set the special flag; the count-based vspec
+    column must stay reversible through add/remove cycles (a boolean OR
+    could never clear back out incrementally)."""
+    from nomad_tpu.structs.resources import AllocatedPortMapping
+
+    store, nodes = build_store(4)
+    store.alloc_table._fold_inc_get()
+    job = mock.job(id="pd-ports")
+    store.upsert_job(job)
+    allocs = []
+    for k in range(6):
+        a = mock.alloc_for(job, nodes[k % 4])
+        a.client_status = "running"
+        a.allocated_resources.shared.ports = [
+            AllocatedPortMapping(label="http", value=21000 + k)]
+        allocs.append(a)
+    store.upsert_allocs(allocs)
+    node_ids = [n.id for n in nodes]
+    _, _, _, spec_before, _ = store.alloc_table.fold_verify(node_ids)
+    assert spec_before.any()
+    store.delete_allocs([a.id for a in allocs])
+    uc, um, ud, spec, found = store.alloc_table.fold_verify(node_ids)
+    assert not spec.any()
+    assert uc.sum() == 0 and um.sum() == 0 and ud.sum() == 0
+    assert store.alloc_table.fold_parity_mismatch() == 0
+
+
+def test_killswitch_restores_wholesale_path_bitwise(monkeypatch):
+    """NOMAD_TPU_PACK_DELTA=0 must reproduce the exact same fold and
+    pack trees via the version-keyed wholesale path."""
+    store_a, nodes_a = build_store()
+    store_a.alloc_table._fold_inc_get()
+    churn_ops(store_a, nodes_a)
+    with_delta = snapshot_folds(store_a, [n.id for n in nodes_a])
+    assert pack_delta_enabled()
+
+    monkeypatch.setenv("NOMAD_TPU_PACK_DELTA", "0")
+    assert not pack_delta_enabled()
+    store_b, nodes_b = build_store()
+    churn_ops(store_b, nodes_b)
+    without = snapshot_folds(store_b, [n.id for n in nodes_b])
+    for got, want in zip(with_delta, without):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_node_slot_growth_keeps_fold_aligned():
+    """Registering nodes past the slot capacity grows the incremental
+    arrays; usage folded before and after must stay slot-aligned."""
+    store, nodes = build_store(2)
+    t = store.alloc_table
+    t._fold_inc_get()
+    job = mock.job(id="pd-grow")
+    store.upsert_job(job)
+    a = mock.alloc_for(job, nodes[0])
+    a.client_status = "running"
+    store.upsert_allocs([a])
+    # force a slot-capacity doubling
+    for i in range(t._node_cap + 4):
+        n = mock.node()
+        n.id = f"pd-extra-{i:05d}"
+        n.compute_class()
+        store.upsert_node(n)
+    assert t.fold_parity_mismatch() == 0
+
+
+# ----------------------------------------------------------------------
+# Compaction (bounded state)
+
+
+def test_compact_preserves_rows_and_folds():
+    store, nodes = build_store()
+    t = store.alloc_table
+    t._fold_inc_get()
+    allocs = churn_ops(store, nodes)
+    survivors = [a.id for a in allocs if a.id in t._row_of]
+    before = snapshot_folds(store, [n.id for n in nodes])
+    rows_before, free_before = t.n_rows, t.free_rows
+    assert free_before > 0          # churn_ops deleted a sixth
+    stats = t.compact()
+    assert stats["rows_after"] == rows_before - free_before
+    assert t.free_rows == 0
+    assert sorted(t._row_of) == sorted(survivors)
+    after = snapshot_folds(store, [n.id for n in nodes])
+    for got, want in zip(after, before):
+        np.testing.assert_array_equal(got, want)
+    assert t.fold_parity_mismatch() == 0
+
+
+def test_compact_shrinks_capacity():
+    t = AllocTable(initial_capacity=1024)
+    t.preallocate(16384)
+    assert t._cap >= 16384
+    stats = t.compact()
+    assert stats["cap_after"] == 1024 and t._cap == 1024
+
+
+def test_store_compact_watermark_gates():
+    """compact_alloc_table only pays the copy past BOTH thresholds."""
+    store, nodes = build_store(2)
+    job = mock.job(id="pd-wm")
+    store.upsert_job(job)
+    allocs = []
+    for k in range(20):
+        a = mock.alloc_for(job, nodes[k % 2])
+        allocs.append(a)
+    store.upsert_allocs(allocs)
+    store.delete_allocs([a.id for a in allocs[:10]])
+    assert store.compact_alloc_table() is None          # < min_free
+    assert store.compact_alloc_table(min_free=4) is not None
+    assert store.alloc_table.free_rows == 0
+
+
+# ----------------------------------------------------------------------
+# Delta-aware _bump notification + journal (satellite)
+
+
+def test_bump_passes_plan_delta_to_shared_hook(monkeypatch):
+    """The cache-invalidation hooks must receive the write's delta
+    context (old/new alloc pairs), not just 'something changed'."""
+    seen = []
+
+    def spy(tables, index, delta=None):
+        seen.append((tuple(tables), index, delta))
+
+    monkeypatch.setattr(tpack, "note_table_write", spy)
+    store, nodes = build_store(2)
+    job = mock.job(id="pd-hook")
+    store.upsert_job(job)
+    a = mock.alloc_for(job, nodes[0])
+    store.upsert_allocs([a])
+    alloc_writes = [s for s in seen if "allocs" in s[0]]
+    assert alloc_writes
+    tables, index, delta = alloc_writes[-1]
+    assert delta and delta[0][0] is None and delta[0][1].id == a.id
+    # node writes flow through the SAME notification shape
+    assert any("nodes" in s[0] for s in seen)
+
+
+def test_alloc_delta_journal_coverage_and_upto():
+    store, nodes = build_store(2)
+    job = mock.job(id="pd-journal")
+    store.upsert_job(job)
+    a = mock.alloc_for(job, nodes[0])
+    idx0 = store.latest_index()
+    store.upsert_allocs([a])
+    idx1 = store.latest_index()
+    upd = a.copy_skip_job()
+    upd.client_status = "complete"
+    store.update_allocs_from_client([upd])
+    idx2 = store.latest_index()
+
+    covered, pairs = store.alloc_deltas_since(idx0)
+    assert covered and len(pairs) == 2
+    assert pairs[0][0] is None and pairs[0][1].id == a.id
+    assert pairs[1][0].id == a.id and \
+        pairs[1][1].client_status == "complete"
+    # upto excludes the later write
+    covered, pairs = store.alloc_deltas_since(idx0, upto=idx1)
+    assert covered and len(pairs) == 1
+    # a span older than the bounded journal is not covered
+    for k in range(200):
+        b = mock.alloc_for(job, nodes[k % 2])
+        store.upsert_allocs([b])
+    covered, _ = store.alloc_deltas_since(idx0)
+    assert not covered
+
+
+def test_usage_base_catches_up_via_journal():
+    """Across two snapshots of one store, the matrix-attached usage base
+    must advance by applying journaled deltas (usage_base_delta_hits)
+    and match a cold refold exactly."""
+    from nomad_tpu.tensor.pack import fold_usage_base
+
+    from tests.test_pack_cache import build_world, make_service
+
+    h, nodes = build_world(8, with_allocs=4)
+    svc, tg, places = make_service(h, nodes, 0)
+    matrix = tpack.pack_nodes_cached(
+        nodes, h.state.snapshot().node_table_index)
+    u1 = svc._pack_usage_incremental(matrix, nodes, tg)
+    base0 = tpack.pack_cache_stats()
+
+    # churn between snapshots: one more alloc lands
+    j = mock.job(id="pd-ub-churn")
+    h.state.upsert_job(j)
+    extra = mock.alloc_for(j, nodes[0])
+    extra.client_status = "running"
+    h.state.upsert_allocs([extra])
+
+    svc2, tg2, _ = make_service(h, nodes, 1)
+    u2 = svc2._pack_usage_incremental(matrix, nodes, tg2)
+    stats = tpack.pack_cache_stats()
+    assert stats["usage_base_delta_hits"] == \
+        base0["usage_base_delta_hits"] + 1
+
+    snap = h.state.snapshot()
+    cold = fold_usage_base(
+        matrix, nodes,
+        lambda nid: [x for x in snap.allocs_by_node(nid)
+                     if not x.client_terminal_status()])
+    np.testing.assert_array_equal(u2.used_cpu, cold["used_cpu"])
+    np.testing.assert_array_equal(u2.used_mem, cold["used_mem"])
+    np.testing.assert_array_equal(u2.used_disk, cold["used_disk"])

@@ -1,0 +1,138 @@
+"""Reschedule-delay coverage (ISSUE 6 satellite): the
+scheduler/reconcile.py delay computation's constant / exponential /
+fibonacci branches and max-delay cap, the attempts-window expiry in
+reschedule_eligible, and the should_force_reschedule override -- the
+edge branches the e2e suite never pins directly.
+"""
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import (
+    _reschedule_delay, reschedule_eligible,
+)
+from nomad_tpu.structs import ReschedulePolicy
+from nomad_tpu.structs.alloc import (
+    DesiredTransition, RescheduleEvent, RescheduleTracker,
+)
+
+NOW = 1_700_000_000.0
+
+
+def policy(**kw):
+    kw.setdefault("delay_s", 10.0)
+    kw.setdefault("max_delay_s", 3600.0)
+    kw.setdefault("unlimited", True)
+    return ReschedulePolicy(**kw)
+
+
+def failed_alloc(events=(), terminal_at=NOW, force=False):
+    job = mock.job(id="rd-job")
+    node = mock.node()
+    a = mock.alloc_for(job, node)
+    a.client_status = "failed"
+    a.client_terminal_time = terminal_at
+    if events:
+        a.reschedule_tracker = RescheduleTracker(events=list(events))
+    if force:
+        a.desired_transition = DesiredTransition(force_reschedule=True)
+    return a
+
+
+# ----------------------------------------------------------------------
+# _reschedule_delay branches
+
+
+def test_first_attempt_is_base_delay_for_every_function():
+    for fn in ("constant", "exponential", "fibonacci", "unknown"):
+        assert _reschedule_delay(policy(delay_function=fn), 0) == 10.0
+
+
+def test_constant_stays_flat():
+    p = policy(delay_function="constant")
+    assert [_reschedule_delay(p, k) for k in range(5)] == [10.0] * 5
+
+
+def test_exponential_doubles_then_caps():
+    p = policy(delay_function="exponential", max_delay_s=100.0)
+    assert [_reschedule_delay(p, k) for k in range(5)] == \
+        [10.0, 20.0, 40.0, 80.0, 100.0]
+
+
+def test_fibonacci_advances_then_caps():
+    p = policy(delay_function="fibonacci", max_delay_s=75.0)
+    # a=b=10 -> 10, 20, 30, 50, 75(cap of 80)
+    assert [_reschedule_delay(p, k) for k in range(1, 6)] == \
+        [10.0, 20.0, 30.0, 50.0, 75.0]
+
+
+def test_unknown_function_falls_back_to_base():
+    p = policy(delay_function="linear??")
+    assert _reschedule_delay(p, 7) == 10.0
+
+
+def test_zero_max_delay_means_uncapped():
+    p = policy(delay_function="exponential", max_delay_s=0.0)
+    assert _reschedule_delay(p, 6) == 10.0 * 2 ** 6
+
+
+# ----------------------------------------------------------------------
+# reschedule_eligible: attempts window + wait_until
+
+
+def test_no_policy_is_never_eligible():
+    ok, wait = reschedule_eligible(None, failed_alloc(), NOW, False)
+    assert (ok, wait) == (False, 0.0)
+
+
+def test_attempts_exhausted_within_window():
+    p = policy(unlimited=False, attempts=2, interval_s=300.0)
+    events = [RescheduleEvent(reschedule_time=NOW - 100),
+              RescheduleEvent(reschedule_time=NOW - 50)]
+    ok, _ = reschedule_eligible(p, failed_alloc(events), NOW, False)
+    assert ok is False
+
+
+def test_attempts_window_expiry_restores_eligibility():
+    """Events older than interval_s no longer count against attempts."""
+    p = policy(unlimited=False, attempts=2, interval_s=300.0,
+               delay_function="constant")
+    events = [RescheduleEvent(reschedule_time=NOW - 400),   # expired
+              RescheduleEvent(reschedule_time=NOW - 50)]    # counts
+    ok, wait = reschedule_eligible(p, failed_alloc(events), NOW, False)
+    assert ok is True
+    # 1 attempt in window -> constant delay from the terminal time
+    assert wait == NOW + 10.0
+
+
+def test_unlimited_counts_all_events_for_delay():
+    """With unlimited=True every event feeds the backoff exponent, even
+    ones outside the interval window."""
+    p = policy(delay_function="exponential", interval_s=300.0)
+    events = [RescheduleEvent(reschedule_time=NOW - 10_000),
+              RescheduleEvent(reschedule_time=NOW - 5_000),
+              RescheduleEvent(reschedule_time=NOW - 50)]
+    ok, wait = reschedule_eligible(p, failed_alloc(events), NOW, False)
+    assert ok is True
+    assert wait == NOW + 10.0 * 2 ** 3
+
+
+def test_elapsed_delay_reschedules_now():
+    """A failure older than its computed delay waits zero."""
+    p = policy(delay_function="constant")
+    a = failed_alloc(events=[RescheduleEvent(reschedule_time=NOW - 60)],
+                     terminal_at=NOW - 30.0)
+    ok, wait = reschedule_eligible(p, a, NOW, False)
+    assert (ok, wait) == (True, 0.0)
+
+
+def test_force_reschedule_overrides_everything():
+    """`alloc stop`-style force_reschedule bypasses both the attempts
+    limit and the delay."""
+    p = policy(unlimited=False, attempts=1, interval_s=300.0)
+    events = [RescheduleEvent(reschedule_time=NOW - 10)]
+    a = failed_alloc(events, force=True)
+    ok, wait = reschedule_eligible(p, a, NOW, False)
+    assert (ok, wait) == (True, 0.0)
+    # sanity: without the override the same alloc is ineligible
+    a2 = failed_alloc(events)
+    assert reschedule_eligible(p, a2, NOW, False)[0] is False
