@@ -391,6 +391,11 @@ def time_batched_path(n_nodes, e_evals, per_eval):
             "rejected": server.planner.plans_rejected,
             "group_commits": server.planner.batches_committed,
         }
+        # quality + saturation fields captured while this server (the
+        # e2e measurement the ROADMAP's next bets are judged by) still
+        # owns the observatory -- shutdown detaches it
+        from nomad_tpu.benchkit import quality_stamp
+        time_batched_path.last_quality = quality_stamp()
         return dt, e_evals, placed
     finally:
         server.shutdown()
@@ -899,8 +904,9 @@ def main_tier(platform: str, tier: int):
     }
     # explicit degraded verdict + breaker/dispatch state: a wedged
     # tunnel or tripped breaker must never read as a chip result
-    from nomad_tpu.benchkit import dispatch_health_stamp
+    from nomad_tpu.benchkit import artifact_stamp, dispatch_health_stamp
     out.update(dispatch_health_stamp(platform))
+    out.update(artifact_stamp())
     out["trace_artifact"] = _export_trace_artifact(
         default=f"BENCH_trace_tier{tier}.json")
     print(json.dumps(out), flush=True)
@@ -1297,8 +1303,18 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # a CPU-fallback / breaker-degraded artifact must never read as a
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state
-    from nomad_tpu.benchkit import dispatch_health_stamp
+    from nomad_tpu.benchkit import artifact_stamp, dispatch_health_stamp
     out.update(dispatch_health_stamp(platform))
+    # quality scoreboard + per-stage saturation from the headline e2e
+    # server (ISSUE 7): quality_fragmentation / quality_drift /
+    # stage_busy_pct_* so solver changes are judged on placement
+    # QUALITY, not just throughput
+    quality = getattr(time_batched_path, "last_quality", None)
+    if quality is not None:
+        out.update(quality)
+    # provenance: round/run ids + git SHA so trend tooling (and
+    # scripts/check_bench_regress.py) can line artifacts up
+    out.update(artifact_stamp())
     out["trace_artifact"] = _export_trace_artifact(
         default="BENCH_trace.json")
     print(json.dumps(out), flush=True)

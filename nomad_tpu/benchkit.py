@@ -17,6 +17,7 @@ Tiers (BASELINE.md "Targets"):
 from __future__ import annotations
 
 import itertools
+import os
 import random
 from typing import Dict, List, Optional, Tuple
 
@@ -89,6 +90,68 @@ def dispatch_health_stamp(platform: str) -> dict:
             "pipeline_staged_total": pipe.get("staged_total", 0),
         },
     }
+
+
+def artifact_stamp(repo_root: Optional[str] = None) -> dict:
+    """Provenance stamp for every bench artifact so trend tooling can
+    line BENCH_rNN.json files up without guessing (ISSUE 7 satellite):
+
+    - ``round_id``: ``BENCH_ROUND_ID`` env when set, else derived as
+      max(existing BENCH_rNN round numbers) + 1;
+    - ``git_sha``: HEAD at run time (None outside a git checkout);
+    - ``run_id``: a wall-clock-free monotonic sequence number persisted
+      in ``.bench_run_seq`` next to the artifacts -- two runs of the
+      same round stay distinguishable and orderable even on machines
+      with a wandering clock.
+
+    Never raises: a bench run must not die on provenance."""
+    import re
+    import subprocess
+
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    sha = None
+    try:
+        sha = subprocess.check_output(
+            ["git", "-C", root, "rev-parse", "--short", "HEAD"],
+            stderr=subprocess.DEVNULL, timeout=10).decode().strip() or None
+    except Exception:  # noqa: BLE001 -- not a git checkout / no git
+        pass
+    round_id = os.environ.get("BENCH_ROUND_ID")
+    if not round_id:
+        seen = [0]
+        try:
+            for name in os.listdir(root):
+                m = re.match(r"BENCH_r(\d+)", name)
+                if m:
+                    seen.append(int(m.group(1)))
+        except OSError:
+            pass
+        round_id = f"r{max(seen) + 1:02d}"
+    seq_path = os.path.join(root, ".bench_run_seq")
+    run_id = 0
+    try:
+        with open(seq_path, encoding="utf-8") as f:
+            run_id = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        pass
+    run_id += 1
+    try:
+        with open(seq_path, "w", encoding="utf-8") as f:
+            f.write(str(run_id))
+    except OSError:
+        pass
+    return {"round_id": round_id, "git_sha": sha, "run_id": run_id}
+
+
+def quality_stamp() -> dict:
+    """Quality/saturation artifact fields (ISSUE 7): fragmentation,
+    shadow-audit drift/mismatch counts and per-stage busy shares from
+    the process-global observatory.  Call while the measured Server is
+    still attached (its shutdown detaches the observatory)."""
+    from .server.quality import observatory
+
+    return observatory.bench_fields()
 
 
 def export_chrome_trace(path: str) -> "str | None":

@@ -660,6 +660,9 @@ def cmd_operator_debug(args) -> int:
     except Exception as e:  # noqa: BLE001 -- partial bundles beat none
         captures["traces.json"] = {"capture_error": repr(e)}
     grab("scheduler-config.json", "/v1/operator/scheduler/configuration")
+    # quality scoreboard + shadow-audit + saturation attribution next
+    # to the metrics.json snapshot it contextualizes (ISSUE 7)
+    grab("quality.json", "/v1/operator/quality")
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
     grab("jobs.json", "/v1/jobs")
@@ -816,6 +819,9 @@ def cmd_operator_trace(args) -> int:
                   file=sys.stderr)
             return 1
         print(_render_trace_waterfall(tr))
+        if getattr(args, "quality", False):
+            print()
+            _print_quality_summary(api)
         return 0
     params = {}
     if args.degraded:
@@ -830,6 +836,9 @@ def cmd_operator_trace(args) -> int:
               + ("" if stats.get("enabled", True)
                  else " (tracing disabled: NOMAD_TPU_TRACE=0)")
               + f"; {stats.get('dropped', 0)} dropped/sampled out.")
+        if getattr(args, "quality", False):
+            print()
+            _print_quality_summary(api)
         return 0
     print(_fmt_table(
         [[t["eval_id"][:16], t.get("tags", {}).get("lane", "-"),
@@ -848,6 +857,85 @@ def cmd_operator_trace(args) -> int:
                 continue
             print()
             print(_render_trace_waterfall(full))
+    if getattr(args, "quality", False):
+        # degraded-eval triage context: were the degraded evals also
+        # DRIFTING (shadow audit), and which stage is saturated?
+        print()
+        _print_quality_summary(api)
+    return 0
+
+
+def _print_quality_summary(api) -> None:
+    try:
+        rep = api.get("/v1/operator/quality")
+    except ApiError as e:
+        print(f"(quality report unavailable: {e})")
+        return
+    if not rep.get("enabled"):
+        print("quality observatory disabled (NOMAD_TPU_QUALITY=0)")
+        return
+    a = rep.get("audit") or {}
+    print(f"shadow audit   audited={a.get('audited', 0)} "
+          f"drift_max={a.get('score_drift_max', 0.0)} "
+          f"mismatches={a.get('decision_mismatch_total', 0)}"
+          + (f"  ALERT({a['alert']['reason']})" if a.get("alert")
+             else ""))
+    sat = rep.get("saturation") or {}
+    if sat.get("bottleneck"):
+        b = sat["stages"][sat["bottleneck"]]
+        print(f"bottleneck     {sat['bottleneck']} "
+              f"(L={b['littles_l']}, busy={b['busy_pct']}%, "
+              f"p99={b['p99_ms']}ms)")
+
+
+def cmd_operator_quality(args) -> int:
+    """Quality scoreboard + shadow-oracle audit + pipeline saturation
+    attribution (rides GET /v1/operator/quality)."""
+    api = _client(args)
+    rep = api.get("/v1/operator/quality")
+    if not rep.get("enabled"):
+        print("quality observatory disabled (NOMAD_TPU_QUALITY=0)")
+        return 0
+    p = rep.get("placement") or {}
+    if not p.get("attached"):
+        print("quality observatory not attached to a running server")
+    else:
+        fleet = p["fleet"]
+        print(f"fleet          {fleet['nodes']} nodes "
+              f"({fleet['ready']} ready, {fleet['occupied']} occupied), "
+              f"{fleet['live_allocs']} live allocs")
+        print(f"fragmentation  {p['fragmentation_index']}")
+        pe = p["packing_efficiency"]
+        print(f"packing_eff    cpu={pe['cpu']} mem={pe['mem']}")
+        for dim in ("cpu", "mem"):
+            u = p["utilization"][dim]
+            bars = "".join(
+                " .:-=+*#%@"[min(9, int(c * 9 / max(max(u["hist"]), 1)))]
+                for c in u["hist"])
+            print(f"util[{dim}]      mean={u['mean']} p50={u['p50']} "
+                  f"p90={u['p90']} max={u['max']}  |{bars}| (0->1)")
+        churn = p["churn"]
+        print("churn          " + " ".join(
+            f"{k}={churn[k]}" for k in
+            ("placements", "stops", "preemptions", "reschedules",
+             "completions", "failures", "rejected_nodes")))
+        for name, s in sorted((p.get("scores") or {}).items()):
+            print(f"score[{name}]  n={s['count']} "
+                  f"mean={s['mean']:.4f} p50={s.get('p50', 0):.4f} "
+                  f"p99={s.get('p99', 0):.4f}")
+    _print_quality_summary(api)
+    sat = rep.get("saturation") or {}
+    stages = sat.get("stages") or {}
+    if stages:
+        print()
+        print(_fmt_table(
+            [[st, d["kind"], str(d["count"]), f"{d['mean_ms']:.2f}",
+              f"{d['p99_ms']:.2f}", f"{d['busy_pct']:.2f}",
+              f"{d['littles_l']:.3f}",
+              f"{d['share_of_recorded_pct']:.1f}"]
+             for st, d in sorted(stages.items())],
+            ["Stage", "Kind", "Count", "Mean(ms)", "p99(ms)",
+             "Busy%", "L", "Share%"]))
     return 0
 
 
@@ -1144,7 +1232,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="render the N slowest retained traces")
     otr.add_argument("--degraded", action="store_true",
                      help="only degraded/errored traces")
+    otr.add_argument("--quality", action="store_true",
+                     help="append the quality scoreboard / shadow-audit"
+                     " context (drift, mismatches, bottleneck) below"
+                     " the traces")
     otr.set_defaults(fn=cmd_operator_trace)
+    oq = op.add_parser("quality",
+                       help="placement-quality scoreboard, shadow-"
+                       "oracle audit + pipeline saturation report")
+    oq.set_defaults(fn=cmd_operator_quality)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("-log-level", dest="log_level", default="info")

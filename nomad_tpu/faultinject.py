@@ -27,6 +27,11 @@ Points wired through the codebase:
   broker.dequeue    server/broker.py EvalBroker.dequeue
   heartbeat         server/core.py Server.heartbeat
   raft.rpc          raft/transport.py TcpTransport.send (delay/drop)
+  quality.skew      server/quality.py shadow-audit capture -- an armed
+                    error corrupts the captured solve's scores the way
+                    real solver numerics drift would, so chaos drills
+                    prove the drift gauge + audit alert fire
+                    (placements themselves are untouched)
 
 Actions: ``error`` raises InjectedFault; ``drop`` raises InjectedDrop
 (a ConnectionError, so transport callers treat it as a network failure);

@@ -238,6 +238,11 @@ class Server:
         gc.set_threshold(700, g1, 1000)
         from .logbroker import _StdlibBridge
         _StdlibBridge.install()     # stdlib logging -> /v1/agent/monitor
+        # quality & saturation observatory (ISSUE 7): binds the store's
+        # write-delta hook + the tracer's span sink; a no-op (prior
+        # paths bit-for-bit) under NOMAD_TPU_QUALITY=0
+        from .quality import observatory
+        observatory.attach(self.state)
         self._start_background()
         self.establish_leadership()
 
@@ -374,6 +379,8 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        from .quality import observatory
+        observatory.detach(self.state)
         if getattr(self, "wan", None) is not None:
             self.wan.shutdown()
             self.wan = None

@@ -440,10 +440,15 @@ class Planner:
 
         # bad-node hits are recorded ONCE, for the result that actually
         # decides the plan (a discarded overlay pass must not count)
+        from .quality import observatory as _quality
         commit_items: List[Tuple[_Pending, PlanResult]] = []
         for it, result in zip(items, results):
             for node_id in result.rejected_nodes:
                 self.bad_nodes.add(node_id)
+            # placement-failure churn: rejected placements never reach
+            # the alloc-delta journal, so the quality scoreboard learns
+            # about them here (no-op while the observatory is detached)
+            _quality.note_rejected(len(result.rejected_nodes))
             if result.is_no_op() and not it.plan.is_no_op():
                 result.refresh_index = self.state.latest_index()
                 self.plans_rejected += 1

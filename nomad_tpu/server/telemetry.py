@@ -24,6 +24,19 @@ from typing import Dict, List
 
 _BUF = 2048
 
+# The summary keys every rendering surface exposes for a timer series
+# (_Series.snapshot); gauge series render the same keys with the _ms
+# suffix stripped (_strip_ms_keys). The /v1/metrics JSON and the
+# Prometheus text exposition both derive from these lists, so a key
+# added here renders everywhere -- the rendering-parity test in
+# tests/test_telemetry.py gates that the two surfaces agree (the
+# Prometheus surface used to hand-list keys and silently dropped p99
+# while emitting a never-produced `last_ms`).
+TIMER_SUMMARY_KEYS = ("count", "mean_ms", "min_ms", "max_ms",
+                      "p50_ms", "p95_ms", "p99_ms")
+GAUGE_SUMMARY_KEYS = tuple(k[:-3] if k.endswith("_ms") else k
+                           for k in TIMER_SUMMARY_KEYS)
+
 
 class _Series:
     __slots__ = ("count", "total", "vmin", "vmax", "buf", "pos")

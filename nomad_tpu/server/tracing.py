@@ -56,6 +56,19 @@ def trace_enabled() -> bool:
     return os.environ.get("NOMAD_TPU_TRACE", "1") != "0"
 
 
+# Span-stream sink (server/quality.py saturation attribution): every
+# recorded span's (name, dur_ms) is offered to the sink regardless of
+# trace retention/sampling -- stage histograms must see the full
+# stream, not the retained tail. None (the default, and whenever
+# NOMAD_TPU_QUALITY=0 keeps the observatory detached) is a no-op.
+_SPAN_SINK = None
+
+
+def set_span_sink(sink) -> None:
+    global _SPAN_SINK
+    _SPAN_SINK = sink
+
+
 def _slow_ms() -> float:
     try:
         return float(os.environ.get("NOMAD_TPU_TRACE_SLOW_MS", "250"))
@@ -310,6 +323,12 @@ class Tracer:
         records the enqueue->dequeue wait retroactively at pop time)."""
         if not trace_enabled():
             return
+        sink = _SPAN_SINK
+        if sink is not None:
+            try:
+                sink(name, dur_ms)
+            except Exception:  # noqa: BLE001 -- accounting only
+                pass
         ctx = self._resolve(ctx)
         if ctx is None:
             return

@@ -1044,6 +1044,10 @@ def make_solve_hook(barrier: SolveBarrier):
         except DispatchFailed:
             note_host_fallback()
             return None
+        # shadow-oracle audit (server/quality.py): sampled capture of
+        # this lane's fused-solve result for background host replay
+        from ..server.quality import observatory as _quality
+        _quality.maybe_capture_audit(lane, res[0], res[1])
         with tracer.span("solver.materialize", tg=tg.name):
             return service.materialize(lane, *res)
     return hook

@@ -309,6 +309,11 @@ class TpuPlacementService:
         except guard.DispatchFailed:
             guard.note_host_fallback()
             return None
+        # shadow-oracle audit (server/quality.py): deterministic
+        # eval-id-hash sample of solved lanes, re-scored/re-solved on
+        # the host in the background; no-op while detached
+        from ..server.quality import observatory as _quality
+        _quality.maybe_capture_audit(lane, out[0], out[1])
         with tracer.span("solver.materialize", tg=tg.name):
             return self.materialize(lane, *out)
 

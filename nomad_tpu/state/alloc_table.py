@@ -216,6 +216,24 @@ class AllocTable:
     def node_slot_of(self, node_id: str) -> int:
         return self._slot_of_node.get(node_id, -1)
 
+    def usage_by_node(self) -> Dict[str, tuple]:
+        """Per-node-id (used_cpu, used_mem, used_disk) under the
+        scheduler's `live` filter, served from the incremental fold
+        columns (built on demand).  Caller holds the owning store's
+        lock.  On the NOMAD_TPU_PACK_DELTA=0 kill-switch path the fold
+        is computed fresh and NOT retained, so the wholesale-
+        invalidation write path stays bit-for-bit untouched."""
+        inc = self._fold_inc_get()
+        transient = inc is None
+        if transient:
+            inc = self._fold_inc_build()
+            self._fold_inc = None
+        out = {}
+        for nid, slot in self._slot_of_node.items():
+            out[nid] = (float(inc["uc"][slot]), float(inc["um"][slot]),
+                        float(inc["ud"][slot]))
+        return out
+
     # ------------------------------------------------------------------
     def preallocate(self, capacity: int) -> None:
         """Grow the row arrays to ``capacity`` in ONE resize. A 2M-alloc

@@ -294,6 +294,12 @@ class StateStore:
         # to the current index instead of refolding (ISSUE 6).
         from collections import deque as _deque
         self._alloc_deltas: "_deque" = _deque(maxlen=128)
+        # quality observatory hook (server/quality.py): set by
+        # QualityObservatory.attach, receives every write's tables +
+        # delta pairs alongside the module-level cache hooks. None
+        # (the NOMAD_TPU_QUALITY=0 default for unattached stores) is
+        # the prior path bit-for-bit.
+        self._quality_hook = None
         # tensor-resident alloc table (fed to the TPU solver's native
         # packing kernels; maintained incrementally on every write)
         self.alloc_table = AllocTable()
@@ -341,6 +347,9 @@ class StateStore:
             # journal entry even for delta=None writes: consumers learn
             # the span is NOT coverable by deltas and must refold
             self._alloc_deltas.append((self._index, delta))
+        hook = self._quality_hook
+        if hook is not None:
+            hook(tables, self._index, delta)
         self._notify_write_hooks(tables, self._index, delta)
         self._watch_cond.notify_all()
         return self._index
@@ -1372,6 +1381,15 @@ class StateStore:
             for result, _ in staged:
                 result.alloc_index = idx
             return idx, outcomes
+
+    def quality_usage_by_node(self) -> Dict[str, tuple]:
+        """Per-node-id live usage served from the alloc table's
+        incrementally-maintained fold columns, under the store lock --
+        an independent accounting the quality layer's churn parity test
+        triangulates against (delta-journal dict vs wholesale store
+        fold vs this tensor-table fold)."""
+        with self._lock:
+            return self.alloc_table.usage_by_node()
 
     def compact_alloc_table(self, min_free: int = 4096,
                             free_ratio: float = 0.5):

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Bench-trend gate: compare a fresh BENCH artifact's headline fields
+against the previous round's json with configurable tolerances.
+
+Usage:
+    scripts/check_bench_regress.py NEW.json [--against OLD.json]
+        [--tol FIELD=FRAC ...] [--require FIELD ...]
+
+Without --against, the previous artifact is auto-discovered from the
+repo root: the BENCH_rNN.json with the highest round number strictly
+below the new artifact's (stamped ``round_id``, falling back to the
+filename).  Every artifact carries ``round_id``/``git_sha``/``run_id``
+via benchkit.artifact_stamp, so the pairing is by stamp, not mtime.
+
+A field regresses when it moves in its BAD direction by more than the
+tolerance fraction: throughput-style fields (higher-better) must not
+drop below ``prev * (1 - tol)``; latency/RSS-style fields
+(lower-better) must not rise above ``prev * (1 + tol)``.  Fields
+missing on either side are skipped with a warning (a new round may add
+metrics; an old one may predate them) unless listed in --require.
+Hard invariants regardless of tolerances: ``parity_mismatch`` must be
+0 and ``degraded`` must not be newly truthy.
+
+Exit 0: no regression.  Exit 1: regressions listed on stdout.
+Exit 2: usage/IO errors.  The comparison logic is pure
+(`compare_artifacts`) and tier-1-gated on fixture artifacts by
+tests/test_bench_regress.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# field -> (direction, default tolerance fraction)
+HEADLINE_FIELDS = {
+    "value": ("higher", 0.10),
+    "fused_placements_per_sec": ("higher", 0.10),
+    "fused_compute_placements_per_sec": ("higher", 0.10),
+    "fused_compute_marginal_placements_per_sec": ("higher", 0.10),
+    "batched_full_placements_per_sec": ("higher", 0.10),
+    "streaming_pipelined_placements_per_sec": ("higher", 0.15),
+    "scale_placements_per_sec": ("higher", 0.15),
+    "pack_warm_cut": ("higher", 0.25),
+    "dispatch_bytes_cut": ("higher", 0.25),
+    "control_plane_tax": ("lower", 0.15),
+    "churn_p50_ms": ("lower", 0.25),
+    "churn_p99_ms": ("lower", 0.25),
+    "churn_rss_growth_mb": ("lower", 0.50),
+    "scale_rss_mb": ("lower", 0.15),
+    "quality_fragmentation": ("lower", 0.25),
+    "quality_drift": ("lower", 0.50),
+}
+
+
+def compare_artifacts(prev: dict, cur: dict,
+                      tol_overrides: dict | None = None,
+                      require: tuple = ()) -> tuple:
+    """Pure comparison: returns (regressions, warnings) -- lists of
+    human-readable strings; empty regressions = gate passes."""
+    tol_overrides = tol_overrides or {}
+    regressions, warnings = [], []
+
+    # hard invariants first: a parity break or a newly degraded run is
+    # never excused by a tolerance
+    if cur.get("parity_mismatch"):
+        regressions.append(
+            f"parity_mismatch={cur['parity_mismatch']} (must be 0)")
+    if cur.get("degraded") and not prev.get("degraded"):
+        regressions.append(
+            f"run newly degraded: {cur['degraded']!r} "
+            f"(previous round was healthy)")
+
+    for field, (direction, default_tol) in sorted(HEADLINE_FIELDS.items()):
+        tol = tol_overrides.get(field, default_tol)
+        pv, cv = prev.get(field), cur.get(field)
+        if pv is None or cv is None:
+            missing = [s for s, v in (("previous", pv), ("current", cv))
+                       if v is None]
+            msg = f"{field}: missing in {'/'.join(missing)} artifact"
+            if field in require:
+                regressions.append(msg + " (required)")
+            else:
+                warnings.append(msg)
+            continue
+        try:
+            pv, cv = float(pv), float(cv)
+        except (TypeError, ValueError):
+            warnings.append(f"{field}: non-numeric ({pv!r} -> {cv!r})")
+            continue
+        if direction == "higher":
+            floor = pv * (1.0 - tol)
+            if cv < floor:
+                regressions.append(
+                    f"{field}: {cv:g} < {pv:g} - {tol:.0%} "
+                    f"(floor {floor:g})")
+        else:
+            # a zero/near-zero previous value gets an absolute epsilon
+            # so 0 -> 0.001 noise does not fail a 25% relative gate
+            ceil = pv * (1.0 + tol) if pv > 1e-9 else tol
+            if cv > ceil:
+                regressions.append(
+                    f"{field}: {cv:g} > {pv:g} + {tol:.0%} "
+                    f"(ceiling {ceil:g})")
+    return regressions, warnings
+
+
+def _round_num(artifact: dict, path: str) -> int:
+    rid = str(artifact.get("round_id") or "")
+    m = re.match(r"r?(\d+)", rid) or \
+        re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def discover_previous(cur_path: str, cur: dict,
+                      root: str = ROOT) -> str | None:
+    """Latest BENCH_rNN.json with a round number strictly below the
+    current artifact's (same-round reruns are not a trend)."""
+    cur_round = _round_num(cur, cur_path)
+    best, best_n = None, -1
+    for name in os.listdir(root):
+        m = re.match(r"BENCH_r(\d+)\.json$", name)
+        if not m:
+            continue
+        n = int(m.group(1))
+        path = os.path.join(root, name)
+        if os.path.abspath(path) == os.path.abspath(cur_path):
+            continue
+        if (cur_round < 0 or n < cur_round) and n > best_n:
+            best, best_n = path, n
+    return best
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifact", help="fresh BENCH json")
+    ap.add_argument("--against", default=None,
+                    help="previous round's BENCH json "
+                    "(default: auto-discover BENCH_rNN.json)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="FIELD=FRAC",
+                    help="override a field's tolerance fraction")
+    ap.add_argument("--require", action="append", default=[],
+                    metavar="FIELD",
+                    help="fail (not warn) when FIELD is missing")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.artifact, encoding="utf-8") as f:
+            cur = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: cannot read {args.artifact}: {e}")
+        return 2
+    prev_path = args.against or discover_previous(args.artifact, cur)
+    if prev_path is None:
+        print("no previous BENCH_rNN.json found; nothing to gate")
+        return 0
+    try:
+        with open(prev_path, encoding="utf-8") as f:
+            prev = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: cannot read {prev_path}: {e}")
+        return 2
+
+    overrides = {}
+    for spec in args.tol:
+        field, _, frac = spec.partition("=")
+        try:
+            overrides[field] = float(frac)
+        except ValueError:
+            print(f"ERROR: bad --tol {spec!r} (want FIELD=FRAC)")
+            return 2
+
+    regressions, warnings = compare_artifacts(
+        prev, cur, overrides, tuple(args.require))
+    for w in warnings:
+        print(f"warning: {w}")
+    tag = (f"{prev.get('round_id', '?')}@{prev.get('git_sha', '?')} -> "
+           f"{cur.get('round_id', '?')}@{cur.get('git_sha', '?')}")
+    if regressions:
+        print(f"{len(regressions)} regression(s) vs {prev_path} ({tag}):")
+        for r in regressions:
+            print(f"  {r}")
+        return 1
+    print(f"no regressions vs {prev_path} ({tag})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

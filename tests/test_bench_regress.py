@@ -1,0 +1,155 @@
+"""Bench-trend gate logic (scripts/check_bench_regress.py) + the
+artifact provenance stamp (benchkit.artifact_stamp) on fixture
+artifacts -- the gate's own logic is tier-1-tested so a broken
+comparator can't silently wave a regressed round through."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "check_bench_regress",
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "check_bench_regress.py"))
+cbr = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(cbr)
+
+
+def art(**kw):
+    base = {
+        "value": 1000.0, "batched_full_placements_per_sec": 100.0,
+        "churn_p99_ms": 50.0, "parity_mismatch": 0, "degraded": False,
+        "round_id": "r06", "git_sha": "abc1234", "run_id": 7,
+    }
+    base.update(kw)
+    return base
+
+
+def test_clean_round_passes():
+    reg, _ = cbr.compare_artifacts(art(), art())
+    assert reg == []
+
+
+def test_improvement_passes():
+    reg, _ = cbr.compare_artifacts(
+        art(), art(value=2000.0, churn_p99_ms=10.0))
+    assert reg == []
+
+
+def test_throughput_drop_past_tolerance_fails():
+    reg, _ = cbr.compare_artifacts(art(value=1000.0), art(value=850.0))
+    assert any(r.startswith("value:") for r in reg)
+    # within the 10% default tolerance: passes
+    reg, _ = cbr.compare_artifacts(art(value=1000.0), art(value=950.0))
+    assert reg == []
+
+
+def test_latency_rise_past_tolerance_fails():
+    reg, _ = cbr.compare_artifacts(
+        art(churn_p99_ms=50.0), art(churn_p99_ms=80.0))
+    assert any(r.startswith("churn_p99_ms:") for r in reg)
+    reg, _ = cbr.compare_artifacts(
+        art(churn_p99_ms=50.0), art(churn_p99_ms=55.0))
+    assert reg == []
+
+
+def test_tolerance_override():
+    reg, _ = cbr.compare_artifacts(
+        art(value=1000.0), art(value=850.0), {"value": 0.20})
+    assert reg == []
+    reg, _ = cbr.compare_artifacts(
+        art(value=1000.0), art(value=990.0), {"value": 0.001})
+    assert any(r.startswith("value:") for r in reg)
+
+
+def test_missing_field_warns_unless_required():
+    prev = art()
+    del prev["churn_p99_ms"]
+    reg, warn = cbr.compare_artifacts(prev, art())
+    assert reg == []
+    assert any("churn_p99_ms" in w for w in warn)
+    reg, _ = cbr.compare_artifacts(prev, art(),
+                                   require=("churn_p99_ms",))
+    assert any("churn_p99_ms" in r and "required" in r for r in reg)
+
+
+def test_hard_invariants_ignore_tolerances():
+    reg, _ = cbr.compare_artifacts(art(), art(parity_mismatch=3))
+    assert any("parity_mismatch" in r for r in reg)
+    reg, _ = cbr.compare_artifacts(
+        art(), art(degraded="breaker-open"))
+    assert any("degraded" in r for r in reg)
+    # a previously-degraded baseline doesn't re-flag
+    reg, _ = cbr.compare_artifacts(
+        art(degraded="cpu-fallback"), art(degraded="cpu-fallback"))
+    assert reg == []
+
+
+def test_zero_baseline_lower_better_uses_epsilon():
+    # zero baseline -> the tolerance fraction acts as an absolute
+    # ceiling (0.50 for quality_drift): noise under it passes, a real
+    # drift excursion over it fails
+    reg, _ = cbr.compare_artifacts(
+        art(quality_drift=0.0), art(quality_drift=0.6))
+    assert any(r.startswith("quality_drift:") for r in reg)
+    reg, _ = cbr.compare_artifacts(
+        art(quality_drift=0.0), art(quality_drift=0.4))
+    assert reg == []
+    reg, _ = cbr.compare_artifacts(
+        art(quality_drift=0.0), art(quality_drift=0.0))
+    assert reg == []
+
+
+def test_discover_previous_by_round(tmp_path):
+    for n, v in ((4, 900.0), (5, 950.0)):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps(art(round_id=f"r{n:02d}", value=v)))
+    cur_path = tmp_path / "BENCH_r06.json"
+    cur = art(round_id="r06")
+    cur_path.write_text(json.dumps(cur))
+    prev = cbr.discover_previous(str(cur_path), cur, root=str(tmp_path))
+    assert prev is not None and prev.endswith("BENCH_r05.json")
+    # the current artifact itself is never its own baseline
+    prev = cbr.discover_previous(
+        str(tmp_path / "BENCH_r05.json"), art(round_id="r05"),
+        root=str(tmp_path))
+    assert prev.endswith("BENCH_r04.json")
+
+
+def test_main_end_to_end(tmp_path, capsys):
+    old = tmp_path / "BENCH_r05.json"
+    old.write_text(json.dumps(art(round_id="r05")))
+    new = tmp_path / "BENCH_r06.json"
+    new.write_text(json.dumps(art(round_id="r06", value=500.0)))
+    rc = cbr.main([str(new), "--against", str(old)])
+    assert rc == 1
+    assert "value:" in capsys.readouterr().out
+    new.write_text(json.dumps(art(round_id="r06", value=1100.0)))
+    assert cbr.main([str(new), "--against", str(old)]) == 0
+
+
+def test_artifact_stamp_monotonic_and_derived(tmp_path):
+    from nomad_tpu.benchkit import artifact_stamp
+
+    (tmp_path / "BENCH_r07.json").write_text("{}")
+    s1 = artifact_stamp(repo_root=str(tmp_path))
+    s2 = artifact_stamp(repo_root=str(tmp_path))
+    # wall-clock-free monotonic run id, persisted next to the artifacts
+    assert s2["run_id"] == s1["run_id"] + 1
+    assert s1["round_id"] == "r08"          # max existing + 1
+    assert (tmp_path / ".bench_run_seq").read_text() == str(s2["run_id"])
+
+
+def test_artifact_stamp_env_round_and_real_repo(monkeypatch, tmp_path):
+    from nomad_tpu.benchkit import artifact_stamp
+
+    monkeypatch.setenv("BENCH_ROUND_ID", "r99")
+    s = artifact_stamp(repo_root=str(tmp_path))
+    assert s["round_id"] == "r99"
+    monkeypatch.delenv("BENCH_ROUND_ID")
+    # against the real repo root: a git checkout stamps a SHA
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        cbr.__file__)))
+    s = artifact_stamp(repo_root=repo_root)
+    assert s["git_sha"] is None or len(s["git_sha"]) >= 7

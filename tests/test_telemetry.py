@@ -257,3 +257,44 @@ def test_sharded_counters_reset_invalidates_live_shards():
     assert t.snapshot()["counters"] == {}
     t.incr("nomad.test.r", 7)   # same (main) thread, cached stale shard
     assert t.snapshot()["counters"]["nomad.test.r"] == 7
+
+
+def test_prometheus_rendering_parity_with_snapshot():
+    """Satellite (ISSUE 7): the Prometheus text surface renders EVERY
+    summary key the /v1/metrics JSON snapshot carries for timer and
+    gauge series -- p50/p99 included -- with identical values. The two
+    surfaces share telemetry's TIMER_/GAUGE_SUMMARY_KEYS, so this
+    pins that a key added to the snapshot cannot silently miss one
+    surface (p99 did, and a never-produced `last_ms` was advertised)."""
+    from nomad_tpu.api.http import prometheus_text
+    from nomad_tpu.server.telemetry import (
+        GAUGE_SUMMARY_KEYS, TIMER_SUMMARY_KEYS,
+    )
+
+    t = Telemetry()
+    for v in (1.0, 2.0, 3.0, 10.0, 100.0):
+        t.sample_ms("nomad.test.timer", v)
+        t.sample("nomad.test.gauge", v * 2)
+    t.incr("nomad.test.counter", 4)
+    snap = t.snapshot()
+    m = {"samples": snap["samples"], "gauges": snap["gauges"],
+         "counters": snap["counters"], "plans_applied": 1,
+         "plans_rejected": 0, "state_index": 9,
+         "tpu_placement_ratio": 0.5}
+    text = prometheus_text(m)
+    lines = dict(ln.rsplit(" ", 1) for ln in text.splitlines()
+                 if ln and not ln.startswith("#"))
+
+    timer = snap["samples"]["nomad.test.timer"]
+    assert set(TIMER_SUMMARY_KEYS) <= set(timer)
+    for k in TIMER_SUMMARY_KEYS:
+        assert float(lines[f"nomad_test_timer_{k}"]) == float(timer[k])
+    gauge = snap["gauges"]["nomad.test.gauge"]
+    assert set(GAUGE_SUMMARY_KEYS) <= set(gauge)
+    for k in GAUGE_SUMMARY_KEYS:
+        assert float(lines[f"nomad_test_gauge_{k}"]) == float(gauge[k])
+    # p99 specifically (the key the old hand-list dropped), and the
+    # never-produced `last_ms` the old list advertised stays gone
+    assert "nomad_test_timer_p99_ms" in lines
+    assert "nomad_test_timer_last_ms" not in lines
+    assert float(lines["nomad_test_counter"]) == 4.0
