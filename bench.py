@@ -401,6 +401,98 @@ def time_batched_path(n_nodes, e_evals, per_eval):
         server.shutdown()
 
 
+def time_lpq(n_nodes, e_evals, per_eval):
+    """The whole-queue LP-relaxation tier (ISSUE 8) end to end: E
+    distinct jobs coalesced by the LPQ batch worker into joint
+    alloc x node solves, rounded + repaired, committed through the
+    group applier. Returns a dict of lpq_* artifact fields or None."""
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server
+    from nomad_tpu.solver import lpq as lpq_mod
+    from nomad_tpu.structs import SchedulerConfiguration
+
+    env_overrides = {
+        # gather the whole registration burst into one joint solve
+        "NOMAD_TPU_LPQ_BATCH": os.environ.get(
+            "NOMAD_TPU_LPQ_BATCH", str(e_evals)),
+        "NOMAD_TPU_LPQ_GATHER_MS": os.environ.get(
+            "NOMAD_TPU_LPQ_GATHER_MS", "400"),
+    }
+    saved = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    server = Server(num_workers=e_evals, heartbeat_ttl=3600.0,
+                    eval_batching=True, batch_width=e_evals)
+    server.state.set_scheduler_config(
+        SchedulerConfiguration(scheduler_algorithm="tpu-lpq"))
+    server.start()
+    try:
+        for i in range(n_nodes):
+            n = mock.node()
+            n.id = f"lpq-node-{i:06d}"
+            n.node_resources.cpu.cpu_shares = (2000, 4000, 8000)[i % 3]
+            n.node_resources.memory.memory_mb = (4096, 8192, 16384)[i % 3]
+            n.compute_class()
+            server.register_node(n)
+        jobs = []
+        for i in range(e_evals):
+            job = mock.job(id=f"lpq-bench-{i}")
+            job.task_groups[0].count = per_eval
+            jobs.append(job)
+        lpq_mod._reset_for_tests()
+        t0 = time.perf_counter()
+        for job in jobs:
+            server.register_job(job)
+        want = e_evals * per_eval
+        deadline = time.time() + 600
+        placed = 0
+        while time.time() < deadline:
+            approx = sum(
+                server.state.num_allocs_by_job(job.namespace, job.id)
+                for job in jobs)
+            if approx >= want:
+                placed = sum(
+                    1 for job in jobs
+                    for a in server.state.allocs_by_job(job.namespace,
+                                                        job.id)
+                    if a.desired_status == "run")
+                if placed >= want:
+                    break
+            time.sleep(0.02)
+        dt = time.perf_counter() - t0
+        stats = lpq_mod.lpq_stats()
+        if placed < want:
+            log(f"bench: lpq TRUNCATED ({placed}/{want} placed); "
+                f"dropping metric")
+            return None
+        # zero capacity violations is an acceptance invariant: the
+        # repair pass must keep the applier from ever rejecting an
+        # LP-tier plan on capacity
+        rejected = server.planner.plans_rejected
+        log(f"bench: lpq {e_evals} evals x {per_eval} in {dt:.3f}s "
+            f"({placed} placed, {placed / dt:.0f} placements/s, "
+            f"{stats['evals_per_solve']:.1f} evals/solve, "
+            f"repair_rate={stats['repair_rate']:.4f}, "
+            f"quality_delta={stats['quality_delta']}, "
+            f"applier_rejected={rejected})")
+        return {
+            "lpq_placements_per_sec": round(placed / dt, 2),
+            "lpq_evals_per_solve": round(stats["evals_per_solve"], 2),
+            "lpq_repair_rate": round(stats["repair_rate"], 5),
+            "lpq_quality_delta": stats["quality_delta"],
+            "lpq_frag_delta": stats["frag_delta"],
+            "lpq_solves": stats["solves"],
+            "lpq_greedy_lanes": stats["greedy_lanes"],
+            "lpq_planner_rejected": rejected,
+        }
+    finally:
+        server.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def pack_fused_lanes(h, nodes, e_evals, per_eval, tag="fused-bench"):
     """E distinct jobs' lanes packed from one snapshot -- the input shape
     of the production SolveBarrier solve point. Returns None when any
@@ -1112,6 +1204,18 @@ def main():
         e_evals = int(os.environ.get("BENCH_FUSED_EVALS", "32"))
         batched_full = run_batched("headline shape", e_evals, N_PLACEMENTS)
 
+    # --- whole-queue LP tier: the same e2e pipeline with tpu-lpq
+    #     selected -- evals/solve amortization + quality delta vs the
+    #     greedy replay of the same queue (ISSUE 8)
+    lpq = None
+    if not mismatch and os.environ.get("BENCH_SKIP_LPQ", "") != "1":
+        lpq_evals = int(os.environ.get("BENCH_LPQ_EVALS", "128"))
+        lpq_per = int(os.environ.get("BENCH_LPQ_PER_EVAL", "8"))
+        try:
+            lpq = time_lpq(N_NODES, lpq_evals, lpq_per)
+        except Exception as e:  # noqa: BLE001 -- report the rest anyway
+            log(f"bench: lpq tier failed: {e!r}")
+
     # --- north-star scale: ~2M LIVE allocs through the batched pipeline
     #     (accumulating, never drained) -- the ROADMAP number measured
     #     instead of extrapolated. AllocTable preallocated, per-placement
@@ -1126,7 +1230,7 @@ def main():
     _emit(platform, p50, mismatch, oracle_dt, native_dt, batched,
           n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
           rtt=rtt, streaming=streaming, pack_tax=pack_tax, scale=scale,
-          churn=churn)
+          churn=churn, lpq=lpq)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
@@ -1135,7 +1239,7 @@ def main():
 def _emit(platform, p50, mismatch, oracle_total, native_total=None,
           batched=None, n_placed=0, fused=None, batched_full=None,
           rtt=None, streaming=None, pack_tax=None, scale=None,
-          churn=None):
+          churn=None, lpq=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -1275,6 +1379,12 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
             # SAME workload shape (1.0 = no tax)
             out["control_plane_tax"] = round(
                 (fused[2] / fused[0]) / (bplaced / bdt), 2)
+    if lpq is not None:
+        # whole-queue LP tier: dispatch amortization (evals per joint
+        # solve), throughput, and quality vs a greedy replay of the
+        # SAME queue -- repair_rate is the rounding-health signal
+        # (docs/OPERATIONS.md "LP queue tier")
+        out.update(lpq)
     if scale is not None:
         # north-star scale: live-alloc count actually placed, steady
         # throughput across the accumulating run, and the memory

@@ -36,6 +36,15 @@ def _register_builtins() -> None:
     register_scheduler(
         "batch", lambda state, planner, **kw:
         GenericScheduler(state, planner, batch=True, **kw))
+    # the whole-queue LP-relaxation tier (ISSUE 8): reference semantics
+    # are unchanged (stock GenericScheduler per eval); the tier differs
+    # only in its solve hook, which rendezvouses the coalesced queue at
+    # solver/lpq.py's LpqBarrier instead of the greedy SolveBarrier.
+    # The LPQ worker selects this entry when NOMAD_TPU_LPQ is live and
+    # SchedulerConfiguration picks the tpu-lpq algorithm.
+    register_scheduler(
+        "tpu-lpq", lambda state, planner, batch=False, **kw:
+        GenericScheduler(state, planner, batch=batch, **kw))
     register_scheduler(
         "system", lambda state, planner, **kw:
         SystemScheduler(state, planner, sysbatch=False, **kw))

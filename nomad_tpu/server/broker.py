@@ -315,6 +315,42 @@ class EvalBroker:
                 out.append((nxt, tok))
         return out
 
+    def dequeue_lpq(self, schedulers: List[str], max_k: int,
+                    timeout: Optional[float] = None,
+                    gather_s: float = 0.0
+                    ) -> List[Tuple[Evaluation, str]]:
+        """Whole-queue coalescer for the LP tier (ISSUE 8): like
+        dequeue_batch, but after draining what's immediately ready it
+        keeps GATHERING for up to ``gather_s`` -- an in-flight
+        registration burst lands in one joint solve instead of
+        fragmenting into per-arrival micro-batches.  Same distinct-jobs
+        invariant; still bounded by ``max_k``."""
+        out = self.dequeue_batch(schedulers, max_k, timeout=timeout)
+        if not out or len(out) >= max_k or gather_s <= 0:
+            return out
+        deadline = time.time() + gather_s
+        jobs = {(ev.namespace, ev.job_id) for ev, _ in out}
+        gathered = 0
+        with self._lock:
+            while len(out) < max_k:
+                self._check_nack_timeouts_locked()
+                popped = self._pop_ready_locked(schedulers,
+                                                exclude_jobs=jobs)
+                if popped is not None:
+                    ev, tok = popped
+                    jobs.add((ev.namespace, ev.job_id))
+                    out.append((ev, tok))
+                    gathered += 1
+                    continue
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._lock.wait(min(remaining, 0.05))
+        if gathered:
+            from .telemetry import metrics
+            metrics.incr("nomad.broker.lpq_gathered", gathered)
+        return out
+
     def _check_nack_timeouts_locked(self) -> None:
         now = time.time()
         for eid, (ev, token, dl) in list(self._unack.items()):

@@ -301,8 +301,18 @@ class Server:
                 # TWO overlapping batch workers: a straggler eval convoys
                 # only its own batch while the other worker keeps draining
                 # the queue (and packs the next dispatch while the device
-                # is busy with the current one).
-                for i in range(2):
+                # is busy with the current one).  The LP-queue tier wants
+                # the OPPOSITE: one worker, so the pending queue coalesces
+                # into the widest possible joint solve instead of being
+                # split between competing drains (the workers re-check the
+                # tier per batch, so runtime algorithm flips still work).
+                from ..solver.lpq import lpq_active
+                n_batch_workers = 1 if lpq_active(self.state) else 2
+                if n_batch_workers == 1:
+                    _log("info", "server",
+                         "LP-queue scheduler tier active (tpu-lpq): "
+                         "single coalescing batch worker")
+                for i in range(n_batch_workers):
                     w = BatchWorker(self, i, width=self.batch_width)
                     w.start()
                     self.workers.append(w)

@@ -420,7 +420,7 @@ class _AuditItem:
                  "cpu_cap", "mem_cap", "disk_cap", "feasible",
                  "used_cpu", "used_mem", "used_disk", "placed",
                  "ask_cpu", "ask_mem", "ask_disk", "count", "limit",
-                 "spread_alg", "chosen", "scores", "skewed")
+                 "spread_alg", "chosen", "scores", "skewed", "lpq")
 
 
 def _lane_simple(lane) -> bool:
@@ -550,7 +550,7 @@ class _ShadowAuditor:
     def wants(self, eval_id: str) -> bool:
         return _sample_coord(eval_id) < _audit_sample()
 
-    def capture(self, lane, chosen, scores) -> bool:
+    def capture(self, lane, chosen, scores, lpq: bool = False) -> bool:
         """Snapshot one solved lane for background audit.  Called on the
         eval thread AFTER the dispatch returned, for already-sampled
         evals (the caller gates on ``wants``); must stay cheap -- array
@@ -582,6 +582,7 @@ class _ShadowAuditor:
         item.count = int(np.asarray(b.count)[0])
         item.limit = int(np.asarray(b.limit)[0])
         item.spread_alg = bool(lane.spread_alg)
+        item.lpq = lpq
         cap = _audit_places_cap()
         item.chosen = np.asarray(chosen, dtype=np.int64)[:cap].copy()
         item.scores = np.asarray(scores, dtype=np.float64)[:cap].copy()
@@ -639,17 +640,27 @@ class _ShadowAuditor:
             if mismatches else -1
 
         tol = _drift_tol()
-        violating = drift > tol or mismatches > 0
+        lpq = bool(getattr(item, "lpq", False))
+        # LP-queue solves: the joint relaxation is SUPPOSED to diverge
+        # from the greedy per-eval oracle (global vs order-dependent
+        # packing) -- divergence is informational, score fidelity still
+        # gates (the LP tier reports host-formula scores, so real drift
+        # means broken score math, not a different optimum)
+        violating = drift > tol or (mismatches > 0 and not lpq)
         metrics.sample("nomad.quality.score_drift", drift)
         metrics.incr("nomad.quality.audit_total")
         if mismatches:
-            metrics.incr("nomad.quality.decision_mismatch", mismatches)
+            metrics.incr("nomad.quality.lpq_divergence" if lpq
+                         else "nomad.quality.decision_mismatch",
+                         mismatches)
 
         res = {
             "eval_id": item.eval_id, "job_id": item.job_id,
             "tg": item.tg_name, "places": len(item.chosen),
             "score_drift": round(drift, 9),
-            "decision_mismatches": mismatches,
+            "decision_mismatches": 0 if lpq else mismatches,
+            "greedy_divergence": mismatches if lpq else 0,
+            "lpq": lpq,
             "first_mismatch_place": first_bad,
             "skew_injected": item.skewed,
             "violating": violating,
@@ -663,7 +674,8 @@ class _ShadowAuditor:
 
         with self._lock:
             self._audited += 1
-            self._mismatch_total += mismatches
+            if not lpq:
+                self._mismatch_total += mismatches
             self._drift_max = max(self._drift_max, drift)
             if violating:
                 self._consecutive_bad += 1
@@ -873,10 +885,15 @@ class QualityObservatory:
             tracing.set_span_sink(None)
 
     # -- capture entry points (hot-path gates first) --------------------
-    def maybe_capture_audit(self, lane, chosen, scores) -> None:
+    def maybe_capture_audit(self, lane, chosen, scores,
+                            lpq: bool = False) -> None:
         """Offer one solved lane (chosen positions + scores) for the
         shadow audit + score-distribution sampling.  Deterministic
-        eval-id-hash sample: identical runs audit identical evals."""
+        eval-id-hash sample: identical runs audit identical evals.
+        ``lpq`` marks LP-queue-tier solves: score drift still gates,
+        but divergence from the greedy re-solve is the tier's PURPOSE
+        (global vs order-dependent packing) -- counted separately in
+        ``nomad.quality.lpq_divergence``, never into the alert."""
         if not quality_enabled() or not self.active:
             return
         try:
@@ -888,7 +905,7 @@ class QualityObservatory:
             ok = ch >= 0
             if ok.any():
                 self.placement.note_scores_bulk(sc[ok])
-            self.audit.capture(lane, ch, sc)
+            self.audit.capture(lane, ch, sc, lpq=lpq)
         except Exception:  # noqa: BLE001 -- observability only
             pass
 

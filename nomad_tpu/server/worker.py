@@ -114,8 +114,11 @@ class Worker(threading.Thread):
 
 
 def invoke_scheduler(server, ev: Evaluation, token: str,
-                     solve_hook=None) -> None:
-    """(reference: worker.go:610 invokeScheduler)"""
+                     solve_hook=None, sched_factory=None) -> None:
+    """(reference: worker.go:610 invokeScheduler). ``sched_factory``
+    overrides the factory entry used for service/batch evals -- the LPQ
+    tier passes "tpu-lpq" so its evals construct through the scheduler
+    factory boundary (scheduler/factory.py) like every other tier."""
     from ..faultinject import faults
     faults.fire("worker.invoke")    # chaos: raise -> nack -> requeue
     ctx = tracer.begin(ev.id, job=ev.job_id, lane=ev.type,
@@ -131,9 +134,14 @@ def invoke_scheduler(server, ev: Evaluation, token: str,
                       ("service", "batch", "system", "sysbatch")
                       else "service")
         kwargs = {}
-        if solve_hook is not None and sched_type in ("service", "batch"):
-            kwargs["solve_hook"] = solve_hook
-        sched = new_scheduler(sched_type, snapshot, planner, **kwargs)
+        name = sched_type
+        if sched_type in ("service", "batch"):
+            if solve_hook is not None:
+                kwargs["solve_hook"] = solve_hook
+            if sched_factory is not None:
+                name = sched_factory
+                kwargs["batch"] = sched_type == "batch"
+        sched = new_scheduler(name, snapshot, planner, **kwargs)
         with metrics.measure(
                 f"nomad.worker.invoke_scheduler_{sched_type}"), \
                 tracer.span("worker.invoke", ctx=ctx, sched=sched_type):
@@ -184,6 +192,15 @@ class BatchWorker(threading.Thread):
 
     def _run_batch(self) -> None:
         from ..solver.batch import SolveBarrier, make_solve_hook
+        from ..solver.lpq import lpq_active
+
+        # second scheduler tier (ISSUE 8): when SchedulerConfiguration
+        # picks tpu-lpq (and NOMAD_TPU_LPQ isn't killed), this worker
+        # becomes the whole-queue coalescer instead; checked per batch
+        # so runtime algorithm flips take effect without a restart
+        if lpq_active(self.server.state):
+            self._run_lpq_batch()
+            return
 
         batch = self.server.broker.dequeue_batch(
             self.schedulers, self.width, timeout=0.5)
@@ -208,9 +225,45 @@ class BatchWorker(threading.Thread):
         self.evals_processed += len(batch)
         self.batches_processed += 1
 
-    def _run_one(self, ev: Evaluation, token: str, barrier, hook) -> None:
+    def _run_lpq_batch(self) -> None:
+        """One LP-queue generation: drain up to NOMAD_TPU_LPQ_BATCH
+        compatible pending evals (broker.dequeue_lpq gathers briefly for
+        a fuller batch), run each eval's scheduler on its own thread
+        through the tpu-lpq factory entry, and rendezvous every dense
+        solve into ONE whole-queue LP relaxation (solver/lpq.py)."""
+        from ..solver.lpq import (
+            LpqBarrier, lpq_batch_width, lpq_gather_s, make_lpq_hook,
+        )
+
+        batch = self.server.broker.dequeue_lpq(
+            self.schedulers, lpq_batch_width(), timeout=0.5,
+            gather_s=lpq_gather_s())
+        if not batch:
+            return
+        metrics.sample("nomad.worker.lpq_batch_width", float(len(batch)))
+        barrier = LpqBarrier(len(batch),
+                             plan_group_hint=getattr(
+                                 self.server.planner, "expect_plans",
+                                 None))
+        hook = make_lpq_hook(barrier)
+        threads = [
+            threading.Thread(
+                target=self._run_one,
+                args=(ev, token, barrier, hook, "tpu-lpq"),
+                daemon=True, name=f"lpq-eval-{ev.id[:8]}")
+            for ev, token in batch]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.evals_processed += len(batch)
+        self.batches_processed += 1
+
+    def _run_one(self, ev: Evaluation, token: str, barrier, hook,
+                 sched_factory=None) -> None:
         try:
-            invoke_scheduler(self.server, ev, token, solve_hook=hook)
+            invoke_scheduler(self.server, ev, token, solve_hook=hook,
+                             sched_factory=sched_factory)
             self.server.broker.ack(ev.id, token)
             tracer.end(ev.id, status="complete")
         except Exception as e:

@@ -60,11 +60,12 @@ class PackedLane:
 
     __slots__ = ("service", "tg", "places", "nodes", "order", "const",
                  "init", "batch", "dtype_name", "spread_alg", "ptab",
-                 "pinit", "cand_allocs", "table_version", "_wave")
+                 "pinit", "cand_allocs", "table_version", "matrix",
+                 "_wave")
 
     def __init__(self, service, tg, places, nodes, order, const, init,
                  batch, dtype_name, spread_alg, ptab=None, pinit=None,
-                 cand_allocs=None, table_version=None):
+                 cand_allocs=None, table_version=None, matrix=None):
         self.service = service
         self.tg = tg
         self.places = places
@@ -83,6 +84,10 @@ class PackedLane:
         # node-table version of the packing snapshot: tags this lane's
         # const buffers in the device-resident cache (constcache.py)
         self.table_version = table_version
+        # version-keyed NodeMatrix the lane packed from: its identity is
+        # the node-universe key the LP-queue tier groups lanes by, and
+        # its node_ids are the canonical node axis (solver/lpq.py)
+        self.matrix = matrix
         self._wave = None
 
     def wavefront_ok(self) -> bool:
@@ -593,7 +598,8 @@ class TpuPlacementService:
                           batch, np.dtype(dtype).name, self.spread_alg,
                           ptab=ptab, pinit=pinit, cand_allocs=cand_allocs,
                           table_version=getattr(
-                              self.ctx.state, "node_table_index", None))
+                              self.ctx.state, "node_table_index", None),
+                          matrix=matrix)
 
     @staticmethod
     def _cands_hold_matching_devices(requests, cand_allocs, ptab) -> bool:

@@ -31,6 +31,21 @@ TABLES = ("nodes", "jobs", "evals", "allocs", "deployments", "node_pools",
           "namespaces", "csi_volumes", "csi_plugins", "services")
 
 
+def _delta_journal_cap() -> int:
+    """Alloc-delta journal capacity (NOMAD_TPU_DELTA_JOURNAL, default
+    128 entries = the ISSUE-6 fixed bound).  One entry per alloc-table
+    write: a group-committed LP batch is ONE entry regardless of pair
+    count, but high write fan-out (serial applier, client updates)
+    wraps the journal and forces incremental-memo holders into
+    wholesale rebuilds -- watch nomad.state.delta_journal_overflow."""
+    import os
+    try:
+        return max(8, int(os.environ.get("NOMAD_TPU_DELTA_JOURNAL",
+                                         "128")))
+    except ValueError:
+        return 128
+
+
 class StateSnapshot:
     """An immutable point-in-time view (reference: state.StateSnapshot).
 
@@ -291,9 +306,15 @@ class StateStore:
         # where pairs is [(old_alloc|None, new_alloc|None), ...] or None
         # for writes with no structured delta. Lets incremental memo
         # holders (solver/service.py usage base) catch a stale fold up
-        # to the current index instead of refolding (ISSUE 6).
+        # to the current index instead of refolding (ISSUE 6). Capacity
+        # is a knob (NOMAD_TPU_DELTA_JOURNAL): an LP-queue batch commits
+        # thousands of pairs in one plan group, and a journal sized for
+        # per-eval commits silently degrades every incremental-memo
+        # consumer to wholesale rebuilds (counted in
+        # nomad.state.delta_journal_overflow).
         from collections import deque as _deque
-        self._alloc_deltas: "_deque" = _deque(maxlen=128)
+        self._alloc_deltas: "_deque" = _deque(
+            maxlen=_delta_journal_cap())
         # quality observatory hook (server/quality.py): set by
         # QualityObservatory.attach, receives every write's tables +
         # delta pairs alongside the module-level cache hooks. None
@@ -386,6 +407,11 @@ class StateStore:
                         or index >= hi), pairs
             oldest = self._alloc_deltas[0][0]
             if index < oldest - 1:
+                # the journal wrapped past the consumer's base index: an
+                # overflow-forced wholesale rebuild (raise
+                # NOMAD_TPU_DELTA_JOURNAL if this counts up under load)
+                from ..server.telemetry import metrics as _tm
+                _tm.incr("nomad.state.delta_journal_overflow")
                 return False, pairs
             for idx, delta in self._alloc_deltas:
                 if idx <= index or idx > hi:

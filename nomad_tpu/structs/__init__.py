@@ -67,7 +67,7 @@ from .config import (  # noqa: F401
     Namespace, NamespaceNodePoolConfiguration,
     PreemptionConfig, SchedulerConfiguration,
     SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU_BINPACK,
-    SCHED_ALG_TPU_SPREAD,
+    SCHED_ALG_TPU_LPQ, SCHED_ALG_TPU_SPREAD,
 )
 from .acl import (  # noqa: F401
     ACLPolicy, ACLRole, ACLToken,

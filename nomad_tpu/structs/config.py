@@ -14,6 +14,10 @@ SCHED_ALG_BINPACK = "binpack"
 SCHED_ALG_SPREAD = "spread"
 SCHED_ALG_TPU_BINPACK = "tpu-binpack"
 SCHED_ALG_TPU_SPREAD = "tpu-spread"
+# whole-queue LP-relaxation tier (solver/lpq.py): binpack scoring, but
+# the coalesced pending queue solves as ONE dense relaxation; the
+# NOMAD_TPU_LPQ=0 kill switch degrades it to tpu-binpack bit-for-bit
+SCHED_ALG_TPU_LPQ = "tpu-lpq"
 
 
 @dataclass
@@ -51,7 +55,8 @@ class SchedulerConfiguration:
 
     def uses_tpu(self) -> bool:
         return self.scheduler_algorithm in (SCHED_ALG_TPU_BINPACK,
-                                            SCHED_ALG_TPU_SPREAD)
+                                            SCHED_ALG_TPU_SPREAD,
+                                            SCHED_ALG_TPU_LPQ)
 
 
 @dataclass

@@ -7,10 +7,12 @@ Usage:
         [--tol FIELD=FRAC ...] [--require FIELD ...]
 
 Without --against, the previous artifact is auto-discovered from the
-repo root: the BENCH_rNN.json with the highest round number strictly
-below the new artifact's (stamped ``round_id``, falling back to the
-filename).  Every artifact carries ``round_id``/``git_sha``/``run_id``
-via benchkit.artifact_stamp, so the pairing is by stamp, not mtime.
+repo root: the artifact OF THE SAME VARIANT (headline BENCH_rNN.json
+vs suffixed BENCH_rNN_tier3.json / BENCH_rNN_headline.json -- suffixes
+never cross-pair) with the highest round number strictly below the new
+artifact's (stamped ``round_id``, falling back to the filename).
+Every artifact carries ``round_id``/``git_sha``/``run_id`` via
+benchkit.artifact_stamp, so the pairing is by stamp, not mtime.
 
 A field regresses when it moves in its BAD direction by more than the
 tolerance fraction: throughput-style fields (higher-better) must not
@@ -54,6 +56,9 @@ HEADLINE_FIELDS = {
     "scale_rss_mb": ("lower", 0.15),
     "quality_fragmentation": ("lower", 0.25),
     "quality_drift": ("lower", 0.50),
+    "lpq_placements_per_sec": ("higher", 0.15),
+    "lpq_evals_per_solve": ("higher", 0.25),
+    "lpq_repair_rate": ("lower", 0.50),
 }
 
 
@@ -116,15 +121,31 @@ def _round_num(artifact: dict, path: str) -> int:
     return int(m.group(1)) if m else -1
 
 
+_ARTIFACT = re.compile(r"BENCH_r(\d+)((?:_[A-Za-z0-9]+)*)\.json$")
+
+
+def _round_suffix(path: str) -> str:
+    """The artifact's variant suffix: '' for headline BENCH_rNN.json,
+    '_tier3'/'_headline'/... for tiered artifacts."""
+    m = _ARTIFACT.match(os.path.basename(path))
+    return m.group(2) if m else ""
+
+
 def discover_previous(cur_path: str, cur: dict,
                       root: str = ROOT) -> str | None:
-    """Latest BENCH_rNN.json with a round number strictly below the
-    current artifact's (same-round reruns are not a trend)."""
+    """Latest BENCH artifact OF THE SAME VARIANT with a round number
+    strictly below the current artifact's (same-round reruns are not a
+    trend).  Suffixed artifacts (BENCH_r05_tier3.json,
+    BENCH_r05_headline.json) only ever pair with the same suffix:
+    comparing a tier's fields against a headline artifact -- or
+    resolving "previous round" THROUGH a tiered artifact -- gates
+    apples against oranges."""
     cur_round = _round_num(cur, cur_path)
+    cur_suffix = _round_suffix(cur_path)
     best, best_n = None, -1
     for name in os.listdir(root):
-        m = re.match(r"BENCH_r(\d+)\.json$", name)
-        if not m:
+        m = _ARTIFACT.match(name)
+        if not m or m.group(2) != cur_suffix:
             continue
         n = int(m.group(1))
         path = os.path.join(root, name)

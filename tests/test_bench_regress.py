@@ -153,3 +153,47 @@ def test_artifact_stamp_env_round_and_real_repo(monkeypatch, tmp_path):
         cbr.__file__)))
     s = artifact_stamp(repo_root=repo_root)
     assert s["git_sha"] is None or len(s["git_sha"]) >= 7
+
+
+def test_discover_previous_ignores_suffixed_artifacts(tmp_path):
+    """Tiered/suffixed artifacts (BENCH_r05_tier3.json,
+    BENCH_r05_headline.json) must never be resolved as the "previous
+    round" of a headline artifact -- their fields are a different
+    measurement."""
+    (tmp_path / "BENCH_r04.json").write_text(
+        json.dumps(art(round_id="r04")))
+    (tmp_path / "BENCH_r05_tier3.json").write_text(
+        json.dumps(art(round_id="r05")))
+    (tmp_path / "BENCH_r05_headline.json").write_text(
+        json.dumps(art(round_id="r05")))
+    cur = art(round_id="r06")
+    prev = cbr.discover_previous(
+        str(tmp_path / "BENCH_r06.json"), cur, root=str(tmp_path))
+    assert prev == str(tmp_path / "BENCH_r04.json")
+
+
+def test_discover_previous_pairs_same_suffix(tmp_path):
+    """A suffixed artifact pairs with the SAME suffix of an earlier
+    round -- never with the headline json (tier fields vs headline
+    fields is apples vs oranges)."""
+    (tmp_path / "BENCH_r05.json").write_text(
+        json.dumps(art(round_id="r05")))
+    (tmp_path / "BENCH_r05_tier3.json").write_text(
+        json.dumps(art(round_id="r05")))
+    (tmp_path / "BENCH_r04_tier3.json").write_text(
+        json.dumps(art(round_id="r04")))
+    cur = art(round_id="r06")
+    prev = cbr.discover_previous(
+        str(tmp_path / "BENCH_r06_tier3.json"), cur, root=str(tmp_path))
+    assert prev == str(tmp_path / "BENCH_r05_tier3.json")
+
+
+def test_discover_previous_none_for_unmatched_suffix(tmp_path):
+    """No same-suffix predecessor -> nothing to gate (None), not a
+    cross-variant comparison."""
+    (tmp_path / "BENCH_r05.json").write_text(
+        json.dumps(art(round_id="r05")))
+    cur = art(round_id="r06")
+    assert cbr.discover_previous(
+        str(tmp_path / "BENCH_r06_headline.json"), cur,
+        root=str(tmp_path)) is None

@@ -1,0 +1,464 @@
+"""Whole-queue LP-relaxation scheduler tier (ISSUE 8): the tpu-lpq
+second tier behind the scheduler factory -- queue coalescing, joint
+solve + rounding, host-side feasibility repair (zero capacity
+violations committed), preemption via the host oracle, the greedy
+kill-switch parity, and the quality comparison surfaces."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.telemetry import metrics
+from nomad_tpu.solver import lpq
+from nomad_tpu.structs import (
+    PreemptionConfig, SchedulerConfiguration,
+    ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_EVICT, EVAL_STATUS_BLOCKED,
+)
+
+
+def wait_until(cond, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def make_server(n_nodes=6, cpu=4000, mem=8192, alg="tpu-lpq",
+                preemption=False, node_prefix="lpq-node"):
+    cfg = SchedulerConfiguration(scheduler_algorithm=alg)
+    if preemption:
+        cfg.preemption_config = PreemptionConfig(
+            service_scheduler_enabled=True)
+    server = Server(num_workers=4, heartbeat_ttl=3600.0,
+                    eval_batching=True)
+    server.state.set_scheduler_config(cfg)
+    server.start()
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"{node_prefix}-{i:04d}"
+        n.node_resources.cpu.cpu_shares = cpu
+        n.node_resources.memory.memory_mb = mem
+        n.compute_class()
+        server.register_node(n)
+    return server
+
+
+def committed(server, job):
+    return [a for a in server.state.allocs_by_job(job.namespace, job.id)
+            if a.desired_status == "run"]
+
+
+def run_queue(server, n_jobs, per_eval, tag, atomic=True):
+    """Register n_jobs and (optionally) enqueue their evals in ONE
+    broker lock acquisition so the whole queue lands in one batch."""
+    from nomad_tpu.structs import Evaluation, generate_uuid
+
+    jobs = []
+    for i in range(n_jobs):
+        job = mock.job(id=f"{tag}-{i}")
+        job.task_groups[0].count = per_eval
+        jobs.append(job)
+    if not atomic:
+        for job in jobs:
+            server.register_job(job)
+        return jobs
+    evs = []
+    for j in jobs:
+        server.state.upsert_job(j)
+        ev = Evaluation(id=generate_uuid(), namespace=j.namespace,
+                        priority=j.priority, type=j.type,
+                        triggered_by="job-register", job_id=j.id,
+                        status="pending")
+        evs.append(ev)
+    server.state.upsert_evals(evs)
+    server.broker.enqueue_all(evs)
+    return jobs
+
+
+def assert_no_capacity_violation(server, jobs, cpu_cap, mem_cap):
+    """The acceptance invariant: committed usage never exceeds any
+    node's capacity (the repair pass's whole job)."""
+    by_node = {}
+    for job in jobs:
+        for a in committed(server, job):
+            cr = a.allocated_resources.comparable()
+            e = by_node.setdefault(a.node_id, [0.0, 0.0])
+            e[0] += cr.cpu_shares
+            e[1] += cr.memory_mb
+    for nid, (c, m) in by_node.items():
+        assert c <= cpu_cap and m <= mem_cap, \
+            f"capacity violated on {nid}: cpu={c}/{cpu_cap} mem={m}/{mem_cap}"
+    return by_node
+
+
+def test_factory_registration():
+    """tpu-lpq registers behind the same scheduler factory boundary as
+    every other tier, and uses_tpu() admits it to the dense gate."""
+    from nomad_tpu.scheduler.factory import registered_schedulers
+    from nomad_tpu.structs import SCHED_ALG_TPU_LPQ
+
+    assert "tpu-lpq" in registered_schedulers()
+    assert SchedulerConfiguration(
+        scheduler_algorithm=SCHED_ALG_TPU_LPQ).uses_tpu()
+    # and the factory entry builds a working GenericScheduler
+    from nomad_tpu.scheduler.factory import new_scheduler
+    from nomad_tpu.scheduler.generic import GenericScheduler
+    sched = new_scheduler("tpu-lpq", None, None, batch=True)
+    assert isinstance(sched, GenericScheduler) and sched.batch
+
+
+def test_lpq_active_gates():
+    """lpq_active: algorithm selection AND kill switch both gate."""
+    class FakeState:
+        def __init__(self, alg):
+            self._cfg = SchedulerConfiguration(scheduler_algorithm=alg)
+
+        def scheduler_config(self):
+            return self._cfg
+
+    assert lpq.lpq_active(FakeState("tpu-lpq"))
+    assert not lpq.lpq_active(FakeState("tpu-binpack"))
+    os.environ["NOMAD_TPU_LPQ"] = "0"
+    try:
+        assert not lpq.lpq_active(FakeState("tpu-lpq"))
+    finally:
+        os.environ.pop("NOMAD_TPU_LPQ")
+
+
+def test_dequeue_lpq_gathers_inflight_arrivals():
+    """The coalescer's gather window pulls evals that arrive AFTER the
+    immediate drain into the same batch (distinct jobs preserved)."""
+    import threading
+
+    from nomad_tpu.server.broker import EvalBroker
+    from nomad_tpu.structs import Evaluation, generate_uuid
+
+    broker = EvalBroker()
+    broker.set_enabled(True)
+
+    def ev(i):
+        return Evaluation(id=generate_uuid(), namespace="default",
+                          job_id=f"gather-{i}", priority=50,
+                          type="service", triggered_by="job-register",
+                          status="pending")
+
+    broker.enqueue_all([ev(0), ev(1)])
+    late = ev(2)
+    t = threading.Timer(0.1, lambda: broker.enqueue(late))
+    t.start()
+    try:
+        batch = broker.dequeue_lpq(["service"], max_k=10, timeout=1.0,
+                                   gather_s=0.8)
+    finally:
+        t.cancel()
+    assert len(batch) == 3
+    assert {e.job_id for e, _ in batch} == {"gather-0", "gather-1",
+                                            "gather-2"}
+    for e, tok in batch:
+        assert broker.ack(e.id, tok) is None
+
+
+def test_lpq_e2e_coalesced_joint_solve():
+    """K jobs land in ONE whole-queue LP solve; every alloc commits with
+    capacity respected and the applier never rejects."""
+    metrics.reset()
+    lpq._reset_for_tests()
+    server = make_server(n_nodes=8)
+    try:
+        jobs = run_queue(server, 4, 3, "lpq-e2e")
+        for job in jobs:
+            wait_until(lambda j=job: len(committed(server, j)) == 3,
+                       msg=f"{job.id} placed")
+        stats = lpq.lpq_stats()
+        assert stats["solves"] >= 1
+        assert stats["lanes_total"] >= 4
+        assert stats["evals_per_solve"] >= 2.0, stats
+        assert stats["placements"] == 12
+        assert server.planner.plans_rejected == 0
+        assert_no_capacity_violation(server, jobs, 4000, 8192)
+        snap = metrics.snapshot()
+        assert snap["counters"].get("nomad.lpq.solves", 0) >= 1
+        assert snap["gauges"].get("nomad.worker.lpq_batch_width"), \
+            sorted(snap["gauges"])
+        # the batch-level quality comparison ran
+        assert stats["quality_delta"] is not None
+    finally:
+        server.shutdown()
+
+
+def test_lpq_repair_pass_zero_capacity_violations():
+    """Over-subscribed queue: 6 evals x 2 asks onto 8 slots. The LP
+    rounding collides, the repair pass re-routes (repairs > 0), exactly
+    the fleet's capacity commits (zero violations, zero applier
+    rejections) and the remainder becomes blocked evals -- never a
+    silent overcommit."""
+    metrics.reset()
+    lpq._reset_for_tests()
+    # each 2200-cpu node fits 4 mock allocs (500 cpu / 256 mb)
+    server = make_server(n_nodes=2, cpu=2200, mem=4096,
+                         node_prefix="tight")
+    try:
+        jobs = run_queue(server, 6, 2, "lpq-press")
+        wait_until(lambda: sum(len(committed(server, j))
+                               for j in jobs) >= 8,
+                   msg="fleet capacity filled")
+        time.sleep(0.5)     # let the losers' blocked evals register
+        stats = lpq.lpq_stats()
+        by_node = assert_no_capacity_violation(server, jobs, 2200, 4096)
+        assert sum(len(committed(server, j)) for j in jobs) == 8
+        assert all(v[0] <= 2200 for v in by_node.values())
+        assert server.planner.plans_rejected == 0, \
+            "repair must pre-empt applier capacity rejections"
+        assert stats["failed"] >= 1
+        # the overflow placements were evicted back to the greedy rule
+        # and counted
+        assert stats["repairs"] >= 1
+        blocked = [e for j in jobs
+                   for e in server.state.evals_by_job(j.namespace, j.id)
+                   if e.status == EVAL_STATUS_BLOCKED]
+        assert blocked, "failed placements must block, not vanish"
+    finally:
+        server.shutdown()
+
+
+def test_lpq_multi_tg_eval_sequences_within_batch():
+    """A 2-TG job through the LP tier: TG2's generation must see TG1's
+    commitments (plan overlay + cross-generation ledger) -- no
+    overcommit on the shared nodes."""
+    metrics.reset()
+    lpq._reset_for_tests()
+    server = make_server(n_nodes=2, cpu=1100, mem=4096)
+    try:
+        import copy
+
+        job = mock.job(id="lpq-two-tg")
+        tg1 = job.task_groups[0]
+        tg1.count = 2
+        tg2 = copy.deepcopy(tg1)
+        tg2.name = "second"
+        tg2.count = 2
+        job.task_groups.append(tg2)
+        server.register_job(job)
+        wait_until(lambda: len(committed(server, job)) == 4,
+                   msg="all 4 allocs placed")
+        by_node = {}
+        for a in committed(server, job):
+            by_node.setdefault(a.node_id, 0)
+            by_node[a.node_id] += 1
+        assert sorted(by_node.values()) == [2, 2], by_node
+    finally:
+        server.shutdown()
+
+
+def test_lpq_preemption_negative_value_host_oracle():
+    """Preemption through the LP tier: a full node stays feasible via
+    the negative-value relief term; the committed eviction set comes
+    from the HOST preemption oracle and rides the plan as
+    node_preemptions (client-visible evict)."""
+    metrics.reset()
+    lpq._reset_for_tests()
+    server = make_server(n_nodes=1, preemption=True,
+                         node_prefix="preempt")
+    try:
+        node = server.state.nodes()[0]
+        lows = []
+        for i in range(2):
+            j = mock.job(priority=20)
+            j.task_groups[0].tasks[0].resources.cpu = 1800
+            j.task_groups[0].tasks[0].resources.memory_mb = 512
+            server.state.upsert_job(j)
+            a = mock.alloc_for(j, node, i)
+            a.client_status = ALLOC_CLIENT_RUNNING
+            lows.append(a)
+        server.state.upsert_allocs(lows)
+
+        high = mock.job(id="lpq-high", priority=70)
+        high.task_groups[0].count = 1
+        high.task_groups[0].tasks[0].resources.cpu = 2000
+        server.register_job(high)
+
+        def done():
+            placed = committed(server, high)
+            evicted = [a for a in server.state.allocs()
+                       if a.desired_status == ALLOC_DESIRED_EVICT]
+            return placed and evicted
+
+        wait_until(done, msg="high-priority job preempted via LP tier")
+        stats = lpq.lpq_stats()
+        assert stats["preempt_evictions"] >= 1, stats
+        assert stats["placements"] >= 1
+        evicted_ids = {a.id for a in server.state.allocs()
+                       if a.desired_status == ALLOC_DESIRED_EVICT}
+        assert evicted_ids <= {a.id for a in lows}
+        # the equal/higher-priority placement itself was never evicted
+        placed = committed(server, high)[0]
+        assert placed.node_id == node.id
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.parametrize("off_alg", ["killswitch", "binpack"])
+def test_lpq_killswitch_restores_greedy_bitforbit(off_alg):
+    """NOMAD_TPU_LPQ=0 under the tpu-lpq algorithm must produce the
+    EXACT placements of the greedy tpu-binpack tier on the same seeded
+    world -- and never touch the LP solver."""
+    from nomad_tpu.structs.job import reseed_ids
+
+    def run(alg, kill):
+        reseed_ids(0xC0FFEE)
+        metrics.reset()
+        lpq._reset_for_tests()
+        if kill:
+            os.environ["NOMAD_TPU_LPQ"] = "0"
+        try:
+            # 3 capacity tiers, 1 node each; each job best-fits exactly
+            # one tier, so greedy placements are order-independent and
+            # the comparison is exact regardless of batch splits
+            server = Server(num_workers=4, heartbeat_ttl=3600.0,
+                            eval_batching=True)
+            server.state.set_scheduler_config(
+                SchedulerConfiguration(scheduler_algorithm=alg))
+            server.start()
+            for i, cpu in enumerate((1000, 2000, 4000)):
+                n = mock.node()
+                n.id = f"par-node-{i}"
+                n.node_resources.cpu.cpu_shares = cpu
+                n.node_resources.memory.memory_mb = 8192
+                n.compute_class()
+                server.register_node(n)
+            jobs = []
+            for i, ask in enumerate((900, 1900, 3900)):
+                job = mock.job(id=f"par-{i}")
+                job.task_groups[0].count = 1
+                job.task_groups[0].tasks[0].resources.cpu = ask
+                jobs.append(job)
+            try:
+                for job in jobs:
+                    server.register_job(job)
+                for job in jobs:
+                    wait_until(lambda j=job: len(committed(server, j)) == 1,
+                               msg=f"{job.id} placed ({alg})")
+                placements = {
+                    (a.job_id, a.name): a.node_id
+                    for j in jobs for a in committed(server, j)}
+                return placements, lpq.lpq_stats()
+            finally:
+                server.shutdown()
+        finally:
+            os.environ.pop("NOMAD_TPU_LPQ", None)
+
+    if off_alg == "killswitch":
+        got, stats = run("tpu-lpq", kill=True)
+    else:
+        got, stats = run("tpu-binpack", kill=False)
+    want, _ = run("tpu-binpack", kill=False)
+    assert got == want, (got, want)
+    if off_alg == "killswitch":
+        # the kill switch never enters the LP solver
+        assert stats["solves"] == 0 and stats["lanes_total"] == 0, stats
+
+
+def test_lpq_ineligible_lanes_ride_greedy_path_in_generation():
+    """A lane the LP does not model (distinct_hosts) solves on the
+    greedy fused path inside the SAME barrier generation -- complete
+    behavior, counted in nomad.lpq.greedy_lanes."""
+    from nomad_tpu.structs import Constraint, CONSTRAINT_DISTINCT_HOSTS
+
+    metrics.reset()
+    lpq._reset_for_tests()
+    server = make_server(n_nodes=4)
+    try:
+        plain = mock.job(id="lpq-plain")
+        plain.task_groups[0].count = 2
+        distinct = mock.job(id="lpq-distinct")
+        distinct.task_groups[0].count = 2
+        distinct.constraints.append(Constraint(
+            operand=CONSTRAINT_DISTINCT_HOSTS, r_target="true"))
+        run_jobs = [plain, distinct]
+        from nomad_tpu.structs import Evaluation, generate_uuid
+        evs = []
+        for j in run_jobs:
+            server.state.upsert_job(j)
+            evs.append(Evaluation(
+                id=generate_uuid(), namespace=j.namespace,
+                priority=j.priority, type=j.type,
+                triggered_by="job-register", job_id=j.id,
+                status="pending"))
+        server.state.upsert_evals(evs)
+        server.broker.enqueue_all(evs)
+        for j in run_jobs:
+            wait_until(lambda jj=j: len(committed(server, jj)) == 2,
+                       msg=f"{j.id} placed")
+        # distinct_hosts honored
+        nodes_used = [a.node_id for a in committed(server, distinct)]
+        assert len(set(nodes_used)) == 2, nodes_used
+        stats = lpq.lpq_stats()
+        assert stats["greedy_lanes"] >= 1, stats
+        assert stats["lanes_total"] >= 1, stats
+    finally:
+        server.shutdown()
+
+
+def test_lpq_audit_divergence_never_alerts():
+    """LP decisions diverging from the greedy oracle count into
+    nomad.quality.lpq_divergence, never decision_mismatch / the audit
+    alert (score fidelity still gates)."""
+    from nomad_tpu.server.quality import observatory
+
+    metrics.reset()
+    lpq._reset_for_tests()
+    os.environ["NOMAD_TPU_QUALITY_AUDIT_SAMPLE"] = "1.0"
+    server = make_server(n_nodes=6)
+    try:
+        jobs = run_queue(server, 4, 3, "lpq-audit")
+        for job in jobs:
+            wait_until(lambda j=job: len(committed(server, j)) == 3,
+                       msg=f"{job.id} placed")
+        assert observatory.audit.wait_idle(15.0)
+        rep = observatory.audit.report()
+        assert rep["audited"] >= 1, rep
+        assert rep["decision_mismatch_total"] == 0, rep
+        assert rep["alert"] is None, rep
+        # score fidelity: the LP tier reports host-formula scores
+        assert rep["score_drift_max"] <= 1e-6, rep
+        snap = metrics.snapshot()
+        assert snap["counters"].get(
+            "nomad.quality.decision_mismatch", 0) == 0
+    finally:
+        os.environ.pop("NOMAD_TPU_QUALITY_AUDIT_SAMPLE", None)
+        server.shutdown()
+
+
+@pytest.mark.slow
+def test_lpq_thousand_eval_queue():
+    """The acceptance shape: a batched queue of >= 1000 evals commits
+    with zero capacity violations, >= 100 evals amortized per joint
+    solve, and packing quality no worse than the greedy replay."""
+    metrics.reset()
+    lpq._reset_for_tests()
+    os.environ["NOMAD_TPU_LPQ_BATCH"] = "256"
+    os.environ["NOMAD_TPU_LPQ_GATHER_MS"] = "400"
+    try:
+        server = make_server(n_nodes=300)
+        try:
+            jobs = run_queue(server, 1000, 1, "lpq-scale", atomic=False)
+            wait_until(lambda: sum(len(committed(server, j))
+                                   for j in jobs) == 1000,
+                       timeout=600, msg="1000-eval queue committed")
+            stats = lpq.lpq_stats()
+            assert_no_capacity_violation(server, jobs, 4000, 8192)
+            assert server.planner.plans_rejected == 0
+            assert stats["evals_per_solve"] >= 100, stats
+            # quality no worse than greedy on the same queue
+            assert stats["quality_delta"] is not None
+            assert stats["quality_delta"] >= -1e-6, stats
+            assert stats["frag_delta"] <= 1e-6, stats
+        finally:
+            server.shutdown()
+    finally:
+        os.environ.pop("NOMAD_TPU_LPQ_BATCH", None)
+        os.environ.pop("NOMAD_TPU_LPQ_GATHER_MS", None)

@@ -293,3 +293,59 @@ def test_usage_base_catches_up_via_journal():
     np.testing.assert_array_equal(u2.used_cpu, cold["used_cpu"])
     np.testing.assert_array_equal(u2.used_mem, cold["used_mem"])
     np.testing.assert_array_equal(u2.used_disk, cold["used_disk"])
+
+
+# ----------------------------------------------------------------------
+# Delta-journal capacity knob + overflow accounting (ISSUE 8 satellite)
+
+
+def test_delta_journal_capacity_knob(monkeypatch):
+    """NOMAD_TPU_DELTA_JOURNAL sizes the alloc-delta journal: a span
+    that overflows the default 128 entries stays coverable under a
+    larger bound (an LP batch's plan group is one entry, but serial
+    write fan-out is many)."""
+    monkeypatch.setenv("NOMAD_TPU_DELTA_JOURNAL", "512")
+    store, nodes = build_store(2)
+    job = mock.job(id="pd-knob")
+    store.upsert_job(job)
+    idx0 = store.latest_index()
+    for k in range(300):
+        a = mock.alloc_for(job, nodes[k % 2])
+        store.upsert_allocs([a])
+    covered, pairs = store.alloc_deltas_since(idx0)
+    assert covered and len(pairs) == 300
+    # the default bound would have wrapped at 128
+    assert store._alloc_deltas.maxlen == 512
+
+
+def test_delta_journal_overflow_counter(monkeypatch):
+    """An overflow-forced wholesale rebuild (journal wrapped past the
+    consumer's base index) counts into
+    nomad.state.delta_journal_overflow; an uncoverable-but-not-wrapped
+    span (delta-less write) does not."""
+    from nomad_tpu.server.telemetry import metrics
+
+    monkeypatch.setenv("NOMAD_TPU_DELTA_JOURNAL", "16")
+    metrics.reset()
+    store, nodes = build_store(2)
+    job = mock.job(id="pd-overflow")
+    store.upsert_job(job)
+    idx0 = store.latest_index()
+    for k in range(40):                 # wraps the 16-entry journal
+        a = mock.alloc_for(job, nodes[k % 2])
+        store.upsert_allocs([a])
+    covered, _ = store.alloc_deltas_since(idx0)
+    assert not covered
+    snap = metrics.snapshot()
+    assert snap["counters"].get(
+        "nomad.state.delta_journal_overflow", 0) == 1
+
+    # a covered read does not bump the counter
+    idx1 = store.latest_index()
+    a = mock.alloc_for(job, nodes[0])
+    store.upsert_allocs([a])
+    covered, pairs = store.alloc_deltas_since(idx1)
+    assert covered and len(pairs) == 1
+    snap = metrics.snapshot()
+    assert snap["counters"].get(
+        "nomad.state.delta_journal_overflow", 0) == 1

@@ -1,0 +1,214 @@
+"""Host preemption-oracle semantics pinned as ground truth (ISSUE 8
+satellite): the LP-queue tier folds preemption in as negative-value
+terms and delegates the actual eviction sets to
+scheduler/preemption.py's Preemptor -- these tests pin the oracle
+paths the tier (and the dense kernels' parity gates) lean on:
+priority ordering, partial-preemption sufficiency, and the
+no-eviction-of-equal-priority floor (preemption.go:666,678)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.preemption import (
+    Preemptor, basic_resource_distance, filter_and_group_preemptible,
+    score_for_task_group,
+)
+from nomad_tpu.structs import (
+    AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
+)
+
+
+def make_node(cpu=4000, mem=8192, disk=100 * 1024):
+    n = mock.node()
+    n.node_resources.cpu.cpu_shares = cpu
+    n.node_resources.memory.memory_mb = mem
+    n.node_resources.disk.disk_mb = disk
+    n.compute_class()
+    return n
+
+
+def make_alloc(node, priority=20, cpu=1000, mem=512, disk=150,
+               job_id=None, max_parallel=0):
+    j = mock.job(priority=priority)
+    if job_id:
+        j.id = job_id
+    j.task_groups[0].tasks[0].resources.cpu = cpu
+    j.task_groups[0].tasks[0].resources.memory_mb = mem
+    if max_parallel:
+        from nomad_tpu.structs import MigrateStrategy
+        j.task_groups[0].migrate = MigrateStrategy(
+            max_parallel=max_parallel)
+    a = mock.alloc_for(j, node, 0)
+    a.allocated_resources = AllocatedResources(
+        tasks={"web": AllocatedTaskResources(cpu_shares=cpu,
+                                             memory_mb=mem)},
+        shared=AllocatedSharedResources(disk_mb=disk))
+    return a
+
+
+def ask(cpu=1000, mem=512, disk=150):
+    return AllocatedResources(
+        tasks={"web": AllocatedTaskResources(cpu_shares=cpu,
+                                             memory_mb=mem)},
+        shared=AllocatedSharedResources(disk_mb=disk))
+
+
+def preemptor_for(node, candidates, job_priority=70,
+                  job_ns_id=("default", "asker")):
+    p = Preemptor(job_priority, None, job_ns_id)
+    p.set_node(node)
+    p.set_preemptions([])
+    p.set_candidates(candidates)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# priority ordering
+# ---------------------------------------------------------------------------
+
+def test_lowest_priority_groups_evict_first():
+    """Candidates group by job priority ascending; the oracle drains the
+    lowest tier before touching higher ones (preemption.go:666)."""
+    node = make_node(cpu=4000, mem=8192)
+    low = make_alloc(node, priority=10, cpu=1900, mem=3500)
+    mid = make_alloc(node, priority=40, cpu=1900, mem=3500)
+    p = preemptor_for(node, [mid, low], job_priority=70)
+    # ask fits after evicting ONE candidate; both suffice -- the
+    # lower-priority one must be chosen
+    evicted = p.preempt_for_task_group(ask(cpu=1900, mem=3500))
+    assert [a.id for a in evicted] == [low.id]
+
+
+def test_filter_and_group_sorts_ascending():
+    node = make_node()
+    a30 = make_alloc(node, priority=30)
+    a10 = make_alloc(node, priority=10)
+    a20 = make_alloc(node, priority=20)
+    groups = filter_and_group_preemptible(70, [a30, a10, a20])
+    assert [prio for prio, _ in groups] == [10, 20, 30]
+    assert groups[0][1][0].id == a10.id
+
+
+def test_cross_group_escalation_when_lowest_insufficient():
+    """When the lowest tier alone can't free the ask, the oracle walks
+    up into the next priority group rather than giving up."""
+    node = make_node(cpu=4000, mem=8192)
+    low = make_alloc(node, priority=10, cpu=1500, mem=3000)
+    mid = make_alloc(node, priority=30, cpu=1500, mem=3000)
+    hi = make_alloc(node, priority=55, cpu=900, mem=2000)  # ineligible
+    p = preemptor_for(node, [hi, mid, low], job_priority=70)
+    evicted = p.preempt_for_task_group(ask(cpu=2800, mem=5500))
+    assert {a.id for a in evicted} == {low.id, mid.id}
+    assert hi.id not in {a.id for a in evicted}
+
+
+# ---------------------------------------------------------------------------
+# partial-preemption sufficiency
+# ---------------------------------------------------------------------------
+
+def test_partial_preemption_stops_at_sufficiency():
+    """The oracle evicts the MINIMAL sufficient set: once the ask fits,
+    remaining candidates survive (greedy pick + superset filter,
+    preemption.go:705)."""
+    node = make_node(cpu=4000, mem=8192)
+    victims = [make_alloc(node, priority=20, cpu=1200, mem=2500)
+               for _ in range(3)]
+    p = preemptor_for(node, victims, job_priority=70)
+    # free after 3 victims placed: 4000-3600=400 cpu; ask 1500 needs
+    # exactly ONE eviction (400+1200 >= 1500)
+    evicted = p.preempt_for_task_group(ask(cpu=1500, mem=2500))
+    assert len(evicted) == 1
+    assert evicted[0].id in {v.id for v in victims}
+
+
+def test_superset_filter_drops_redundant_evictions():
+    """A small + a large candidate where the large alone suffices: the
+    filter must not also evict the small one."""
+    node = make_node(cpu=4000, mem=8192)
+    small = make_alloc(node, priority=20, cpu=600, mem=1000)
+    large = make_alloc(node, priority=20, cpu=3000, mem=6000)
+    p = preemptor_for(node, [small, large], job_priority=70)
+    evicted = p.preempt_for_task_group(ask(cpu=3200, mem=6200))
+    assert [a.id for a in evicted] == [large.id]
+
+
+def test_insufficient_capacity_returns_empty():
+    """When even evicting EVERY eligible candidate can't fit the ask,
+    the oracle returns [] (never a partial, pointless eviction)."""
+    node = make_node(cpu=4000, mem=8192)
+    victims = [make_alloc(node, priority=20, cpu=800, mem=1500)
+               for _ in range(2)]
+    p = preemptor_for(node, victims, job_priority=70)
+    assert p.preempt_for_task_group(ask(cpu=4200, mem=2000)) == []
+
+
+def test_resource_distance_prefers_closest_fit():
+    """Greedy pick order is by basic resource distance: the candidate
+    whose footprint best matches the remaining need goes first."""
+    need = ask(cpu=1000, mem=1000).comparable()
+    close = ask(cpu=900, mem=950).comparable()
+    far = ask(cpu=100, mem=100).comparable()
+    assert basic_resource_distance(need, close) < \
+        basic_resource_distance(need, far)
+    # max_parallel penalty dominates distance once exceeded
+    assert score_for_task_group(need, close, max_parallel=1,
+                                num_preempted=1) > \
+        score_for_task_group(need, far, max_parallel=0, num_preempted=5)
+
+
+# ---------------------------------------------------------------------------
+# the priority floor: no eviction of equal (or near) priority
+# ---------------------------------------------------------------------------
+
+def test_no_eviction_within_priority_floor():
+    """Only allocs at least 10 priority levels below are eligible
+    (preemption.go:678): equal priority never evicts, delta 9 never
+    evicts, delta 10 does."""
+    node = make_node(cpu=4000, mem=8192)
+    equal = make_alloc(node, priority=70, cpu=3500, mem=7000)
+    p = preemptor_for(node, [equal], job_priority=70)
+    assert p.preempt_for_task_group(ask(cpu=1000, mem=1000)) == []
+
+    delta9 = make_alloc(node, priority=61, cpu=3500, mem=7000)
+    p = preemptor_for(node, [delta9], job_priority=70)
+    assert p.preempt_for_task_group(ask(cpu=1000, mem=1000)) == []
+
+    delta10 = make_alloc(node, priority=60, cpu=3500, mem=7000)
+    p = preemptor_for(node, [delta10], job_priority=70)
+    evicted = p.preempt_for_task_group(ask(cpu=1000, mem=1000))
+    assert [a.id for a in evicted] == [delta10.id]
+
+
+def test_own_job_and_terminal_candidates_never_evict():
+    """set_candidates filters the scheduling job's own allocs and
+    terminal allocs before the search ever sees them."""
+    node = make_node(cpu=4000, mem=8192)
+    own = make_alloc(node, priority=20, cpu=3500, mem=7000,
+                     job_id="asker")
+    p = preemptor_for(node, [own], job_priority=70,
+                      job_ns_id=("default", "asker"))
+    assert p.current_allocs == []
+    assert p.preempt_for_task_group(ask(cpu=1000, mem=1000)) == []
+
+    dead = make_alloc(node, priority=20, cpu=3500, mem=7000)
+    dead.desired_status = "stop"
+    dead.client_status = "complete"
+    p = preemptor_for(node, [dead], job_priority=70)
+    assert p.current_allocs == []
+
+
+def test_max_parallel_penalty_spreads_evictions():
+    """With current preemptions at a TG's migrate.max_parallel, further
+    evictions of that TG are penalized -- a same-distance candidate
+    from another group wins."""
+    node = make_node(cpu=4000, mem=8192)
+    a1 = make_alloc(node, priority=20, cpu=1500, mem=3000,
+                    job_id="tg-a", max_parallel=1)
+    a2 = make_alloc(node, priority=20, cpu=1500, mem=3000,
+                    job_id="tg-b")
+    p = Preemptor(70, None, ("default", "asker"))
+    p.set_node(node)
+    # one eviction of tg-a already in this plan: its penalty applies
+    p.set_preemptions([a1])
+    p.set_candidates([a1, a2])
+    evicted = p.preempt_for_task_group(ask(cpu=1400, mem=2800))
+    assert [a.id for a in evicted] == [a2.id]
