@@ -26,3 +26,10 @@ Layout (mirrors SURVEY.md section 2 component inventory):
 """
 
 __version__ = "0.1.0"
+
+# NOMAD_TPU_LOCKCHECK=1 installs the lock-order sanitizer before any
+# package module constructs its locks (lockcheck.py); unset/0 is a true
+# no-op -- one env read, threading untouched.
+from . import lockcheck as _lockcheck  # noqa: E402
+
+_lockcheck.maybe_install_from_env()

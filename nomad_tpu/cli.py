@@ -663,6 +663,14 @@ def cmd_operator_debug(args) -> int:
     # quality scoreboard + shadow-audit + saturation attribution next
     # to the metrics.json snapshot it contextualizes (ISSUE 7)
     grab("quality.json", "/v1/operator/quality")
+    # lock-order sanitizer findings as their own bundle member: the
+    # deadlock-witness stacks belong next to threads.json when an
+    # operator is untangling a wedge (ISSUE 9)
+    try:
+        captures["lockcheck.json"] = (
+            captures["agent-self.json"]["stats"]["lockcheck"])
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["lockcheck.json"] = {"capture_error": repr(e)}
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
     grab("jobs.json", "/v1/jobs")
@@ -764,6 +772,51 @@ def cmd_operator_node_flaps(args) -> int:
         if nid not in scores:
             print(f"  {nid:38s} score=0    quarantined {rem:.1f}s")
     return 0
+
+
+def cmd_operator_lockcheck(args) -> int:
+    """Lock-order sanitizer report (rides /v1/agent/self
+    stats.lockcheck): acquisition-order cycles with both witness
+    stacks, locks held across dispatch/fault-point/blocking waits, and
+    escaped-frame bare acquires. Enable with NOMAD_TPU_LOCKCHECK=1 on
+    the agent; off is a true no-op and reports enabled=False."""
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("lockcheck") or {}
+    for k in ("enabled", "wait_ms", "locks", "acquires", "edges",
+              "edges_dropped", "reports_dropped", "cycle_count"):
+        print(f"{k:15s} = {st.get(k)}")
+    if not st.get("enabled") and not st.get("cycle_count"):
+        print("(checker disabled: set NOMAD_TPU_LOCKCHECK=1 on the "
+              "agent to record lock orders)")
+    for i, cyc in enumerate(st.get("cycles") or []):
+        print(f"\nCYCLE {i}: potential deadlock over "
+              f"{' -> '.join(cyc.get('locks') or [])}")
+        for e in cyc.get("edges") or []:
+            print(f"  edge {e.get('from')} -> {e.get('to')} "
+                  f"[thread {e.get('thread')}]")
+            if args.stacks:
+                for ln in (e.get("stack") or "").rstrip().splitlines():
+                    print(f"    {ln}")
+    ha = st.get("held_across") or []
+    if ha:
+        print(f"\nheld-across violations: {len(ha)}")
+        for v in ha:
+            held = ", ".join(h.get("lock", "?")
+                             for h in v.get("held") or [])
+            det = f" ({v['detail']})" if v.get("detail") else ""
+            print(f"  {v.get('kind')}{det} holding [{held}] "
+                  f"[thread {v.get('thread')}]")
+            if args.stacks:
+                for ln in (v.get("stack") or "").rstrip().splitlines():
+                    print(f"    {ln}")
+    esc = st.get("escaped") or []
+    if esc:
+        print(f"\nescaped-frame bare acquires: {len(esc)}")
+        for v in esc:
+            print(f"  {v.get('lock')} acquired at "
+                  f"{v.get('acquired_at')} in {v.get('in_function')}()"
+                  f" [{v.get('reason')}, thread {v.get('thread')}]")
+    return 1 if st.get("cycle_count") else 0
 
 
 def _render_trace_waterfall(tr: dict, width: int = 48) -> str:
@@ -1225,6 +1278,12 @@ def build_parser() -> argparse.ArgumentParser:
     onode.add_parser("flaps",
                      help="per-node flap scores + active quarantines"
                      ).set_defaults(fn=cmd_operator_node_flaps)
+    olc = op.add_parser("lockcheck",
+                        help="lock-order sanitizer report (cycles, "
+                        "held-across, escaped-frame acquires)")
+    olc.add_argument("--stacks", action="store_true",
+                     help="print the witness stacks under each finding")
+    olc.set_defaults(fn=cmd_operator_lockcheck)
     otr = op.add_parser("trace",
                         help="eval span-waterfall forensics")
     otr.add_argument("eval_id", nargs="?", default="")

@@ -55,7 +55,26 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import lockcheck
+
 ACTIONS = ("error", "drop", "delay", "hang")
+
+# The registered point inventory: every ``faults.fire(point)`` call
+# site in the tree must name a member (scripts/nomadlint.py
+# fire-registered rule parses this tuple; tests/test_chaos.py pins the
+# chaos-suite inventory against it). Register the point HERE in the
+# same change that adds the call site, with the module that fires it.
+POINTS = (
+    "solver.dispatch",      # solver/guard.py (inside the watchdog)
+    "solver.probe",         # solver/guard.py (breaker recovery probe)
+    "worker.invoke",        # server/worker.py invoke_scheduler
+    "plan.apply",           # server/plan_apply.py Planner.apply
+    "plan.commit",          # state/store.py apply_plan_results_batch
+    "broker.dequeue",       # server/broker.py EvalBroker.dequeue
+    "heartbeat",            # server/core.py Server.heartbeat
+    "raft.rpc",             # raft/transport.py TcpTransport.send
+    "quality.skew",         # server/quality.py shadow-audit capture
+)
 
 
 class InjectedFault(Exception):
@@ -162,7 +181,14 @@ class FaultRegistry:
     # ------------------------------------------------------------------
     def fire(self, point: str) -> None:
         """Called at an injection point. No-op unless the point is armed
-        (one attribute read on the unarmed path)."""
+        (one attribute read on the unarmed path, plus one module-attr
+        read for the lock sanitizer, active only under
+        NOMAD_TPU_LOCKCHECK=1)."""
+        if lockcheck._ACTIVE:
+            # a fault point may hang/raise BY DESIGN: holding a lock
+            # across one turns an injected solver wedge into a
+            # control-plane wedge (lockcheck held_across report)
+            lockcheck.note_fire(point)
         if not self._armed:
             return
         with self._lock:

@@ -117,6 +117,9 @@ class _DispatchPipeline:
                 except Exception:  # noqa: BLE001 -- staging is best
                     import traceback  # effort; the job re-derives (and
                     traceback.print_exc()  # fails under its watchdog)
+            # nomadlint: waive=bare-acquire -- the depth slot is
+            # deliberately released by the runner thread in _run_job's
+            # finally; a try/finally here would double-release it
             self._sem.acquire()
             with self._lock:
                 self._in_flight += 1

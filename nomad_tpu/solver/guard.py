@@ -194,7 +194,13 @@ def run_dispatch(fn, label: str = "solver.dispatch",
     from ..faultinject import faults
     from ..server.telemetry import metrics
     from ..server.tracing import tracer
+    from .. import lockcheck
 
+    if lockcheck._ACTIVE:
+        # a dispatch can burn a full watchdog deadline; entering one
+        # while holding locks starves every peer of those locks for the
+        # same deadline (lockcheck held_across report)
+        lockcheck.note_dispatch(label)
     timeout = dispatch_deadline_s() if timeout_s is None else timeout_s
     box: dict = {}
     done = threading.Event()

@@ -10,6 +10,7 @@ behavior is always complete.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Dict, List, Optional, Tuple
 
@@ -996,9 +997,7 @@ class TpuPlacementService:
         lock = store._lock if store is not None else None
 
         with_ports = bool(tg.networks)
-        if lock is not None:
-            lock.acquire()
-        try:
+        with (lock if lock is not None else contextlib.nullcontext()):
             # fold cache: all lanes of one barrier generation pack from
             # the same table version against the same (version-keyed)
             # matrix -- fold once, hand out copies (the overlay mutates
@@ -1018,9 +1017,6 @@ class TpuPlacementService:
             placed, placed_job = table.count_placed(
                 n_pad, packed["row_slots"], self.job.namespace, self.job.id,
                 tg.name)
-        finally:
-            if lock is not None:
-                lock.release()
         if not with_ports:
             # cached arrays are shared across lanes: the overlay below
             # mutates usage in place, so each lane works on copies

@@ -1,0 +1,609 @@
+#!/usr/bin/env python3
+"""nomadlint: the repo-invariant lint driver (AST-based).
+
+One gate for the invariants that keep the concurrent control plane
+honest -- the static complement of the runtime lock-order sanitizer
+(nomad_tpu/lockcheck.py).  Scans nomad_tpu/ + bench.py (rules that
+read docs/tests pull those in too) and fails listing violations.
+
+AST rules:
+
+  fire-registered    every ``faults.fire("<point>")`` call site names
+                     a literal member of nomad_tpu/faultinject.py
+                     ``POINTS`` -- an unregistered point is a chaos
+                     scenario nobody can arm
+  killswitch-tested  every knob row in docs/OPERATIONS.md whose
+                     description says "kill switch" is referenced by
+                     at least one test under tests/ (a kill switch
+                     without a parity test is a rollback nobody
+                     verified)
+  telemetry-literal  telemetry series names are string literals or
+                     normalizable f-strings/ternaries (a computed name
+                     can never be checked against the metrics doc)
+  telemetry-kind     no series is emitted as two kinds (e.g. both
+                     counter and timer) -- exactly the class of bug
+                     that rendered ``batch_lanes`` as ms for 2 rounds
+  sleep-under-lock   no ``time.sleep``, blocking/indefinite dequeue or
+                     wait, or device dispatch statically inside a
+                     ``with <lock>:`` block -- one sleeping holder
+                     starves every peer for the duration
+  bare-acquire       a bare ``<x>.acquire()`` statement requires a
+                     try/finally releasing the same receiver (either
+                     immediately following, or an enclosing try) -- an
+                     exception between acquire and release wedges the
+                     lock forever
+
+Legacy checkers, invocable as rules under this driver (their
+standalone scripts keep working; tests/test_metrics_doc.py etc. are
+unchanged):
+
+  metrics-doc        scripts/check_metrics_doc.py
+  knob-doc           scripts/check_knob_doc.py
+  bench-regress      scripts/check_bench_regress.py (takes the
+                     artifact argv after ``--``, e.g.
+                     ``nomadlint.py --rule bench-regress -- BENCH.json``)
+
+The default run (no ``--rule``) is every AST rule plus metrics-doc and
+knob-doc; bench-regress needs an artifact argument so it only runs
+when selected.  Tier-1 gates the default run via
+tests/test_nomadlint.py.
+
+Waivers (per rule, justification REQUIRED after ``--``)::
+
+    something.acquire()   # nomadlint: waive=bare-acquire -- released
+                          # by the runner thread when the job retires
+
+on the violating line or the line directly above it.  A waiver without
+a ``--`` justification does not suppress anything.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib.util
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WAIVER = re.compile(
+    r"nomadlint:\s*waive=([A-Za-z0-9_,-]+)\s*--\s*\S")
+
+# telemetry emit methods -> series kind (server/telemetry.py contract;
+# _count is tracing.py's guarded incr wrapper)
+_TELEMETRY_KINDS = {"incr": "counter", "sample": "gauge",
+                    "sample_ms": "timer", "measure": "timer",
+                    "_count": "counter"}
+# receiver tails that identify a telemetry call (avoids random.sample
+# and friends); _count is a self-method in tracing.py
+_TELEMETRY_RECV = re.compile(r"(?:^|\.)(?:metrics|_tm|t)$")
+
+_LOCKISH = re.compile(r"(?:lock|mutex|cv|cond|sem)\w*$", re.IGNORECASE)
+
+_DISPATCH_CALLS = {"run_dispatch", "solve_lane_fused", "fuse_and_solve",
+                   "solve_groups", "block_until_ready", "device_put"}
+
+
+class Violation:
+    __slots__ = ("rule", "path", "line", "msg")
+
+    def __init__(self, rule: str, path: str, line: int, msg: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.msg = msg
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+class Ctx:
+    """Everything the rules read, built once per run. ``root`` is
+    swappable so rule fixture tests lint a synthetic tree."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.files: List[Tuple[str, str, ast.AST]] = []
+        self.parse_errors: List[Violation] = []
+        scan = []
+        bench = os.path.join(root, "bench.py")
+        if os.path.exists(bench):
+            scan.append(bench)
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(root, "nomad_tpu")):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            scan.extend(os.path.join(dirpath, f)
+                        for f in sorted(filenames) if f.endswith(".py"))
+        for path in scan:
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                tree = ast.parse(text, filename=rel)
+            except (OSError, SyntaxError) as e:
+                self.parse_errors.append(Violation(
+                    "parse", rel, getattr(e, "lineno", 0) or 0,
+                    f"cannot parse: {e}"))
+                continue
+            self.files.append((rel, text, tree))
+
+    # -- lazy context shared by repo-level rules -----------------------
+    def doc_text(self) -> str:
+        try:
+            with open(os.path.join(self.root, "docs", "OPERATIONS.md"),
+                      encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def test_texts(self) -> Dict[str, str]:
+        out = {}
+        tdir = os.path.join(self.root, "tests")
+        if not os.path.isdir(tdir):
+            return out
+        for name in sorted(os.listdir(tdir)):
+            if not name.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(tdir, name),
+                          encoding="utf-8") as f:
+                    out[f"tests/{name}"] = f.read()
+            except OSError:
+                continue
+        return out
+
+    def fire_points(self) -> Optional[set]:
+        """POINTS tuple parsed from nomad_tpu/faultinject.py (None if
+        the file or the assignment is absent)."""
+        for rel, _text, tree in self.files:
+            if rel != os.path.join("nomad_tpu", "faultinject.py"):
+                continue
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "POINTS"
+                        for t in node.targets):
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        return {e.value for e in node.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)}
+            return None
+        return None
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 -- lint must not crash on exotica
+        return "<?>"
+
+
+def _normalize_name(node) -> Optional[str]:
+    """Literal / normalizable telemetry name, placeholders as '*';
+    None when the name cannot be statically derived."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return re.sub(r"\{[^}]*\}", "*", node.value)
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    if isinstance(node, ast.IfExp):
+        a = _normalize_name(node.body)
+        b = _normalize_name(node.orelse)
+        if a is not None and b is not None:
+            # both arms contribute; kind stability checks each
+            return a if a == b else f"{a}|{b}"
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        a = _normalize_name(node.left)
+        b = _normalize_name(node.right)
+        if a is not None and b is not None:
+            return a + b
+        return None
+    return None
+
+
+# ----------------------------------------------------------------------
+# AST rules
+
+
+def rule_fire_registered(ctx: Ctx) -> List[Violation]:
+    points = ctx.fire_points()
+    out: List[Violation] = []
+    if points is None:
+        out.append(Violation("fire-registered",
+                             "nomad_tpu/faultinject.py", 0,
+                             "no POINTS registry found"))
+        return out
+    for rel, _text, tree in ctx.files:
+        if rel.endswith(os.path.join("nomad_tpu", "faultinject.py")):
+            continue            # the registry/dispatcher itself
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fire"):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                out.append(Violation(
+                    "fire-registered", rel, node.lineno,
+                    f"fire() point must be a string literal, got "
+                    f"`{_unparse(arg)}`"))
+                continue
+            if arg.value not in points:
+                out.append(Violation(
+                    "fire-registered", rel, node.lineno,
+                    f"fire point {arg.value!r} is not registered in "
+                    f"faultinject.POINTS"))
+    return out
+
+
+def rule_killswitch_tested(ctx: Ctx) -> List[Violation]:
+    doc = ctx.doc_text()
+    if not doc:
+        return [Violation("killswitch-tested", "docs/OPERATIONS.md", 0,
+                          "docs/OPERATIONS.md missing or unreadable")]
+    tests = ctx.test_texts()
+    blob = "\n".join(tests.values())
+    out: List[Violation] = []
+    for i, line in enumerate(doc.splitlines(), 1):
+        s = line.lstrip()
+        if not s.startswith("|"):
+            continue
+        if not re.search(r"kill[ -]switch", s, re.IGNORECASE):
+            continue
+        for knob in re.findall(r"`(NOMAD_TPU_[A-Z0-9_]+)`", s):
+            if knob not in blob:
+                out.append(Violation(
+                    "killswitch-tested", "docs/OPERATIONS.md", i,
+                    f"kill-switch knob {knob} is not referenced by any "
+                    f"test under tests/ (no parity gate)"))
+    return out
+
+
+def rule_telemetry(ctx: Ctx) -> List[Violation]:
+    """Shared scan for telemetry-literal and telemetry-kind."""
+    out: List[Violation] = []
+    seen: Dict[str, Tuple[str, str, int]] = {}   # name -> (kind, at)
+    for rel, _text, tree in ctx.files:
+        if rel.endswith(os.path.join("nomad_tpu", "server",
+                                     "telemetry.py")):
+            continue            # the sink's own generic dispatch
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _TELEMETRY_KINDS
+                    and node.args):
+                continue
+            recv = _unparse(node.func.value)
+            if node.func.attr == "_count":
+                if recv != "self":
+                    continue
+            elif not _TELEMETRY_RECV.search(recv):
+                continue
+            name = _normalize_name(node.args[0])
+            if name is None:
+                out.append(Violation(
+                    "telemetry-literal", rel, node.lineno,
+                    f"telemetry series name must be a literal or "
+                    f"normalizable f-string, got "
+                    f"`{_unparse(node.args[0])}`"))
+                continue
+            kind = _TELEMETRY_KINDS[node.func.attr]
+            for arm in name.split("|"):
+                if not arm.startswith("nomad."):
+                    continue
+                prev = seen.get(arm)
+                if prev is None:
+                    seen[arm] = (kind, rel, node.lineno)
+                elif prev[0] != kind:
+                    out.append(Violation(
+                        "telemetry-kind", rel, node.lineno,
+                        f"series {arm!r} emitted as {kind} here but as "
+                        f"{prev[0]} at {prev[1]}:{prev[2]} -- one "
+                        f"series, one kind"))
+    return out
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    s = _unparse(expr)
+    tail = s.split(".")[-1]
+    return bool(_LOCKISH.search(tail))
+
+
+class _UnderLockVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, out: List[Violation]):
+        self.rel = rel
+        self.out = out
+        self.lock_stack: List[str] = []
+        self.ctx_stack: List[str] = []
+
+    # don't cross into code that merely gets DEFINED under the lock
+    def visit_FunctionDef(self, node):
+        if not self.lock_stack:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if not self.lock_stack:
+            self.generic_visit(node)
+
+    def visit_With(self, node):
+        for i in node.items:        # context exprs: not yet under it
+            self.visit(i.context_expr)
+        lockish = [i for i in node.items
+                   if _is_lockish(i.context_expr)]
+        ctxs = [_unparse(i.context_expr) for i in node.items]
+        self.lock_stack.extend(_unparse(i.context_expr)
+                               for i in lockish)
+        self.ctx_stack.extend(ctxs)
+        for stmt in node.body:
+            self.visit(stmt)
+        if lockish:
+            del self.lock_stack[-len(lockish):]
+        del self.ctx_stack[-len(ctxs):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if not self.lock_stack:
+            return
+        held = self.lock_stack[-1]
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name == "sleep" and isinstance(fn, ast.Attribute) \
+                and "time" in _unparse(fn.value):
+            self.out.append(Violation(
+                "sleep-under-lock", self.rel, node.lineno,
+                f"time.sleep inside `with {held}:` -- the holder "
+                f"sleeps, every waiter starves"))
+        elif name == "get" and isinstance(fn, ast.Attribute):
+            kw = {k.arg for k in node.keywords}
+            blocking_kw = not node.args and kw <= {"block", "timeout"}
+            blocking_pos = (len(node.args) == 1 and not kw
+                            and isinstance(node.args[0], ast.Constant)
+                            and node.args[0].value is True)
+            if blocking_kw or blocking_pos:
+                self.out.append(Violation(
+                    "sleep-under-lock", self.rel, node.lineno,
+                    f"blocking dequeue `{_unparse(fn)}(...)` inside "
+                    f"`with {held}:`"))
+        elif name in ("wait", "join") and isinstance(fn, ast.Attribute) \
+                and not node.args and not node.keywords:
+            recv = _unparse(fn.value)
+            if recv not in self.ctx_stack:
+                self.out.append(Violation(
+                    "sleep-under-lock", self.rel, node.lineno,
+                    f"indefinite `{recv}.{name}()` inside "
+                    f"`with {held}:` (a condvar may wait on its own "
+                    f"lock; anything else blocks the holder forever)"))
+        elif name in _DISPATCH_CALLS:
+            self.out.append(Violation(
+                "sleep-under-lock", self.rel, node.lineno,
+                f"device dispatch `{name}(...)` inside `with {held}:`"
+                f" -- a dispatch can burn a full watchdog deadline"))
+
+
+def rule_sleep_under_lock(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        _UnderLockVisitor(rel, out).visit(tree)
+    return out
+
+
+def _finally_releases(try_node: ast.Try, recv: str) -> bool:
+    for stmt in try_node.finalbody:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release" \
+                    and _unparse(node.func.value) == recv:
+                return True
+    return False
+
+
+def rule_bare_acquire(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+
+    def walk(rel: str, body: list, try_stack: list) -> None:
+        for i, stmt in enumerate(body):
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and isinstance(stmt.value.func, ast.Attribute) \
+                    and stmt.value.func.attr == "acquire":
+                recv = _unparse(stmt.value.func.value)
+                ok = any(_finally_releases(t, recv) for t in try_stack)
+                if not ok and i + 1 < len(body) \
+                        and isinstance(body[i + 1], ast.Try) \
+                        and _finally_releases(body[i + 1], recv):
+                    ok = True
+                if not ok:
+                    out.append(Violation(
+                        "bare-acquire", rel, stmt.lineno,
+                        f"bare `{recv}.acquire()` without a try/finally"
+                        f" releasing it -- an exception here wedges the"
+                        f" lock forever"))
+            for field in ("body", "orelse", "handlers", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if not sub:
+                    continue
+                if field == "handlers":
+                    for h in sub:
+                        walk(rel, h.body, try_stack)
+                    continue
+                nested = try_stack
+                if isinstance(stmt, ast.Try) and field in ("body",
+                                                           "orelse"):
+                    nested = try_stack + [stmt]
+                walk(rel, sub, nested)
+
+    for rel, _text, tree in ctx.files:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                walk(rel, node.body, [])
+    return out
+
+
+AST_RULES = {
+    "fire-registered": rule_fire_registered,
+    "killswitch-tested": rule_killswitch_tested,
+    "telemetry": rule_telemetry,           # emits -literal and -kind
+    "sleep-under-lock": rule_sleep_under_lock,
+    "bare-acquire": rule_bare_acquire,
+}
+# ids a violation may carry (for --rule selection and waiver matching)
+RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
+            "telemetry-kind", "sleep-under-lock", "bare-acquire")
+
+LEGACY_RULES = ("metrics-doc", "knob-doc", "bench-regress")
+
+
+# ----------------------------------------------------------------------
+# waivers + driver
+
+
+def _load_legacy(name: str):
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"check_{name.replace('-', '_')}.py")
+    spec = importlib.util.spec_from_file_location(
+        f"_nomadlint_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_legacy(name: str, argv: List[str]) -> int:
+    mod = _load_legacy(name)
+    try:
+        if name == "bench-regress":
+            return mod.main(argv or [])
+        return mod.main()
+    except SystemExit as e:         # legacy argparse usage errors
+        return int(e.code or 0)
+
+
+def apply_waivers(root: str, violations: List[Violation]
+                  ) -> Tuple[List[Violation], int]:
+    """Drop violations waived at the site (or the line above) with a
+    justified `# nomadlint: waive=<rule> -- reason` comment."""
+    kept: List[Violation] = []
+    waived = 0
+    lines_cache: Dict[str, List[str]] = {}
+    for v in violations:
+        path = os.path.join(root, v.path)
+        lines = lines_cache.get(path)
+        if lines is None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+            lines_cache[path] = lines
+        def _line_waives(ln: int) -> bool:
+            if not 1 <= ln <= len(lines):
+                return False
+            m = _WAIVER.search(lines[ln - 1])
+            return bool(m and v.rule in m.group(1).split(","))
+
+        # the violating line, then the contiguous comment block above
+        # it (multi-line justifications are the norm)
+        hit = _line_waives(v.line)
+        ln = v.line - 1
+        while not hit and 1 <= ln <= len(lines) \
+                and lines[ln - 1].lstrip().startswith("#"):
+            hit = _line_waives(ln)
+            ln -= 1
+        if hit:
+            waived += 1
+        else:
+            kept.append(v)
+    return kept, waived
+
+
+def run_ast_rules(root: str, rules: List[str]) -> Tuple[List[Violation],
+                                                        int]:
+    ctx = Ctx(root)
+    violations = list(ctx.parse_errors)
+    for key, fn in AST_RULES.items():
+        ids = (("telemetry-literal", "telemetry-kind")
+               if key == "telemetry" else (key,))
+        if not any(r in rules for r in ids):
+            continue
+        violations.extend(v for v in fn(ctx) if v.rule in rules)
+    return apply_waivers(root, violations)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="nomadlint",
+        description="repo-invariant lint driver (see module docstring)")
+    p.add_argument("--root", default=ROOT,
+                   help="repo root to lint (fixture tests point this "
+                   "at a synthetic tree)")
+    p.add_argument("--rule", action="append", default=[],
+                   help="run only this rule id (repeatable); default: "
+                   "all AST rules + metrics-doc + knob-doc")
+    p.add_argument("--list", action="store_true",
+                   help="list rule ids and exit")
+    p.add_argument("rest", nargs="*",
+                   help="extra argv for legacy rules (bench-regress "
+                   "artifact)")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for r in RULE_IDS:
+            print(r)
+        for r in LEGACY_RULES:
+            print(f"{r} (legacy: scripts/check_"
+                  f"{r.replace('-', '_')}.py)")
+        return 0
+
+    known = set(RULE_IDS) | set(LEGACY_RULES)
+    for r in args.rule:
+        if r not in known:
+            print(f"unknown rule {r!r} (see --list)")
+            return 2
+    selected = args.rule or (list(RULE_IDS) + ["metrics-doc",
+                                               "knob-doc"])
+
+    rc = 0
+    ast_selected = [r for r in selected if r in RULE_IDS]
+    if ast_selected:
+        kept, waived = run_ast_rules(args.root, ast_selected)
+        for v in sorted(kept, key=lambda v: (v.path, v.line)):
+            print(f"{v.path}:{v.line}: [{v.rule}] {v.msg}")
+        note = f" ({waived} waived)" if waived else ""
+        if kept:
+            print(f"nomadlint: {len(kept)} violation(s){note}")
+            rc = 1
+        else:
+            print(f"nomadlint: AST rules clean{note} "
+                  f"[{', '.join(ast_selected)}]")
+    for name in LEGACY_RULES:
+        if name not in selected:
+            continue
+        if args.root != ROOT:
+            print(f"nomadlint: skipping legacy rule {name} under "
+                  f"--root (it scans the real repo)")
+            continue
+        lrc = run_legacy(name, args.rest or None)
+        if lrc:
+            print(f"nomadlint: legacy rule {name} failed (rc={lrc})")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""Lock-order sanitizer tests (ISSUE 9 tentpole): seeded AB/BA
+deadlock orderings must be reported with BOTH witness stacks, the
+kill-switch path must be bit-for-bit inert (no wrapper classes
+observable), and the held-across / escaped-frame detectors must fire
+on seeded violations.  The sanitizer itself runs over the chaos /
+dispatch-pipeline / plan-batch / churn suites via the conftest
+fixture; these tests pin its own semantics.
+"""
+import queue
+import threading
+import time
+
+import _thread
+
+import pytest
+
+from nomad_tpu import lockcheck
+
+
+@pytest.fixture(autouse=True)
+def _clean_checker():
+    """Every test leaves the real threading factories restored and the
+    checker state empty, pass or fail."""
+    yield
+    lockcheck.disable()
+    lockcheck._reset_for_tests()
+
+
+def test_killswitch_is_inert(monkeypatch):
+    """NOMAD_TPU_LOCKCHECK=0 (or unset) is a true no-op: the factories
+    are the C primitives and no wrapper classes are observable."""
+    monkeypatch.setenv("NOMAD_TPU_LOCKCHECK", "0")
+    lockcheck.maybe_install_from_env()
+    assert not lockcheck.enabled()
+    assert threading.Lock is lockcheck._REAL_LOCK
+    assert threading.RLock is lockcheck._REAL_RLOCK
+    assert threading.Condition is lockcheck._REAL_CONDITION
+    assert isinstance(threading.Lock(), _thread.LockType)
+    assert type(threading.RLock()).__module__ == "_thread"
+    assert isinstance(threading.Condition(), threading.Condition)
+    st = lockcheck.state()
+    assert st["enabled"] is False and st["locks"] == 0
+
+
+def test_env_knob_installs(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_LOCKCHECK", "1")
+    lockcheck.maybe_install_from_env()
+    assert lockcheck.enabled()
+    lk = threading.Lock()
+    assert type(lk).__name__ == "_LockWrapper"
+    # and disable restores the primitives for everyone after us
+    lockcheck.disable()
+    assert isinstance(threading.Lock(), _thread.LockType)
+
+
+def test_seeded_ab_ba_cycle_both_witness_stacks():
+    """The satellite acceptance fixture: an AB ordering in one thread
+    and a BA ordering in another is a potential deadlock even though
+    neither run actually deadlocks; the cycle report must carry the
+    witness stack of BOTH conflicting edges."""
+    lockcheck.enable()
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def order_ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def order_ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    for fn in (order_ab, order_ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    st = lockcheck.state()
+    assert st["cycle_count"] == 1
+    cyc = st["cycles"][0]
+    assert len(cyc["edges"]) == 2
+    stacks = [e["stack"] for e in cyc["edges"]]
+    assert any("order_ab" in s for s in stacks)
+    assert any("order_ba" in s for s in stacks)
+    # both witnesses name the seeded functions' acquire lines
+    assert all("test_lockcheck.py" in s for s in stacks)
+    threads = {e["thread"] for e in cyc["edges"]}
+    assert len(threads) == 2
+
+
+def test_consistent_order_and_reentry_are_clean():
+    lockcheck.enable()
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    rlock = threading.RLock()
+
+    def order_ab():
+        with lock_a:
+            with lock_b:
+                with rlock:
+                    with rlock:      # re-entry: no self-edge
+                        pass
+
+    for _ in range(2):
+        t = threading.Thread(target=order_ab)
+        t.start()
+        t.join()
+    with lock_a:                     # same order from the main thread
+        with lock_b:
+            pass
+    st = lockcheck.state()
+    assert st["cycle_count"] == 0
+    assert st["edges"] >= 2
+
+
+def test_cycle_metric_emitted():
+    from nomad_tpu.server.telemetry import metrics
+    metrics.reset()
+    lockcheck.enable()
+    lock_a, lock_b = threading.Lock(), threading.Lock()
+    with lock_a:
+        with lock_b:
+            pass
+    with lock_b:
+        with lock_a:
+            pass
+    assert lockcheck.state()["cycle_count"] == 1
+    assert metrics.snapshot()["counters"].get(
+        "nomad.lockcheck.cycle") == 1
+    metrics.reset()
+
+
+def test_held_across_fire_and_dispatch():
+    """Firing a fault point or entering a device dispatch while
+    holding a lock is the wedge-amplifier hazard class."""
+    from nomad_tpu.faultinject import faults
+    from nomad_tpu.solver import guard
+    lockcheck.enable()
+    lk = threading.Lock()
+    with lk:
+        faults.fire("heartbeat")             # unarmed: still a hazard
+    with lk:
+        assert guard.run_dispatch(lambda: 42, timeout_s=5.0) == 42
+    st = lockcheck.state()
+    kinds = {v["kind"] for v in st["held_across"]}
+    assert "faultinject.fire:heartbeat" in kinds
+    assert any(k.startswith("solver.dispatch:") for k in kinds)
+    for v in st["held_across"]:
+        assert v["held"] and v["stack"]
+
+
+def test_blocking_waits_past_threshold(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_LOCKCHECK_WAIT_MS", "50")
+    lockcheck.enable()
+    lk = threading.Lock()
+    q = queue.Queue()
+    with lk:
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.12)
+    cv = threading.Condition()
+    with lk:
+        with cv:
+            cv.wait(timeout=0.12)
+    # a wait holding nothing else is NOT a finding
+    cv2 = threading.Condition()
+    with cv2:
+        cv2.wait(timeout=0.12)
+    kinds = [v["kind"] for v in lockcheck.state()["held_across"]]
+    assert kinds.count("queue.get") == 1
+    assert kinds.count("condition.wait") == 1
+
+
+def test_escaped_frame_bare_acquire():
+    lockcheck.enable()
+    lk = threading.Lock()
+    release = threading.Event()
+
+    def worker():
+        def takes_and_leaks():
+            lk.acquire()             # bare, escapes this frame
+        takes_and_leaks()
+        release.wait(5)
+        lk.release()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    try:
+        st = lockcheck.state()
+        assert any(e["reason"] == "frame-exited"
+                   and e["in_function"] == "takes_and_leaks"
+                   for e in st["escaped"]), st["escaped"]
+    finally:
+        release.set()
+        t.join()
+    # a bare acquire still inside its frame is NOT an escape
+    lockcheck._reset_for_tests()
+    lk2 = threading.Lock()
+    lk2.acquire()
+    try:
+        assert lockcheck.state()["escaped"] == []
+    finally:
+        lk2.release()
+
+
+def test_agent_self_and_operator_cli_surface(capsys):
+    """stats.lockcheck rides /v1/agent/self; `operator lockcheck`
+    renders it and exits 1 when cycles exist."""
+    from nomad_tpu import cli
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.server import Server
+
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        st = ApiClient(base).get("/v1/agent/self")["stats"]["lockcheck"]
+        assert st["enabled"] is False and st["cycles"] == []
+
+        assert cli.main(["-address", base,
+                         "operator", "lockcheck"]) == 0
+        assert "enabled" in capsys.readouterr().out
+
+        lockcheck.enable()
+        lock_a, lock_b = threading.Lock(), threading.Lock()
+        with lock_a:
+            with lock_b:
+                pass
+        with lock_b:
+            with lock_a:
+                pass
+        rc = cli.main(["-address", base,
+                       "operator", "lockcheck", "--stacks"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "CYCLE 0" in out and "test_lockcheck.py" in out
+    finally:
+        http.shutdown()
+        server.shutdown()

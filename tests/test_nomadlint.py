@@ -1,0 +1,374 @@
+"""nomadlint driver gate + per-rule fixture tests (ISSUE 9).
+
+THE tier-1 gate is ``test_repo_lint_clean``: the default driver run
+(every AST rule + metrics-doc + knob-doc) must exit 0 against the real
+tree.  Everything else proves the rules actually BITE: each one gets a
+synthetic tree seeding the violation it exists to catch, because a
+linter that never fired is indistinguishable from one that can't.
+"""
+import importlib.util
+import json
+import os
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "nomadlint", os.path.join(ROOT, "scripts", "nomadlint.py"))
+nl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(nl)
+
+ALL_AST = list(nl.RULE_IDS)
+
+# the registry every fixture tree shares (fire-registered parses it)
+_FAULTINJECT = """
+POINTS = (
+    "good.point",
+)
+"""
+
+
+def _tree(tmp_path, files):
+    """Write a synthetic repo tree and return its root."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def _rules(root, rules):
+    kept, waived = nl.run_ast_rules(root, rules)
+    return kept, waived
+
+
+# ----------------------------------------------------------------------
+# THE gate + driver surface
+
+
+def test_repo_lint_clean(capsys):
+    """Default run (AST rules + metrics-doc + knob-doc) exits 0 against
+    the real repo -- the tier-1 exit-code gate the ISSUE wires in."""
+    assert nl.main([]) == 0, capsys.readouterr().out
+
+
+def test_list_names_every_rule(capsys):
+    assert nl.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for rule in list(nl.RULE_IDS) + list(nl.LEGACY_RULES):
+        assert rule in out
+
+
+def test_unknown_rule_is_an_error(capsys):
+    assert nl.main(["--rule", "no-such-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().out
+
+
+def test_legacy_rules_run_under_the_driver(capsys):
+    """metrics-doc and knob-doc stay green when invoked as driver
+    rules (their standalone scripts and tests are unchanged)."""
+    assert nl.main(["--rule", "metrics-doc"]) == 0
+    assert nl.main(["--rule", "knob-doc"]) == 0
+    capsys.readouterr()
+
+
+def test_legacy_bench_regress_gets_driver_argv(capsys):
+    """bench-regress receives the argv after `--`; an unreadable
+    artifact is a failure the driver surfaces as rc 1."""
+    rc = nl.main(["--rule", "bench-regress", "--",
+                  "/nonexistent/BENCH.json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bench-regress failed" in out
+
+
+def test_legacy_bench_regress_passes_on_identical_pair(tmp_path,
+                                                       capsys):
+    art = {"schema": 1, "placements_per_sec": 100.0}
+    cur = tmp_path / "BENCH_new.json"
+    prev = tmp_path / "BENCH_old.json"
+    cur.write_text(json.dumps(art))
+    prev.write_text(json.dumps(art))
+    rc = nl.main(["--rule", "bench-regress", "--",
+                  str(cur), "--against", str(prev)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_legacy_rules_skipped_under_fixture_root(tmp_path, capsys):
+    """--root points rules at a synthetic tree; the legacy checkers
+    scan the real repo so the driver skips them rather than lint the
+    wrong tree."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/faultinject.py": _FAULTINJECT,
+        "docs/OPERATIONS.md": "| `NOMAD_TPU_X` | on | a knob row |\n",
+    })
+    assert nl.main(["--root", root]) == 0
+    assert "skipping legacy rule" in capsys.readouterr().out
+
+
+def test_parse_error_is_a_violation(tmp_path, capsys):
+    root = _tree(tmp_path, {"nomad_tpu/bad.py": "def broken(:\n"})
+    assert nl.main(["--root", root]) == 1
+    assert "[parse]" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# fire-registered
+
+
+def test_fire_registered_fires_on_unregistered_point(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/faultinject.py": _FAULTINJECT,
+        "nomad_tpu/mod.py": """
+            def f(faults, name):
+                faults.fire("good.point")
+                faults.fire("never.registered")
+                faults.fire(name)
+            """,
+    })
+    kept, _ = _rules(root, ["fire-registered"])
+    msgs = [v.msg for v in kept]
+    assert len(kept) == 2
+    assert any("never.registered" in m for m in msgs)
+    assert any("string literal" in m for m in msgs)
+
+
+def test_fire_registered_requires_a_registry(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/faultinject.py": "x = 1\n",
+    })
+    kept, _ = _rules(root, ["fire-registered"])
+    assert len(kept) == 1 and "no POINTS registry" in kept[0].msg
+
+
+def test_every_chaos_point_inventory_member_is_registered():
+    """The real registry covers every fire() call site (the rule gates
+    it) AND the chaos suite can arm every registered point: POINTS is
+    the shared inventory."""
+    from nomad_tpu.faultinject import POINTS, faults
+
+    assert len(POINTS) == len(set(POINTS)) >= 9
+    for point in POINTS:
+        faults.arm(point, "error", count=0)
+    try:
+        armed = {f["point"] for f in faults.snapshot()["faults"]}
+        assert set(POINTS) <= armed
+    finally:
+        faults.disarm_all()
+
+
+# ----------------------------------------------------------------------
+# killswitch-tested
+
+
+def test_killswitch_tested_fires_without_a_parity_test(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/__init__.py": "",
+        "docs/OPERATIONS.md": """
+            | Knob | Default | Effect |
+            |---|---|---|
+            | `NOMAD_TPU_COVERED` | on | `0` is the kill switch |
+            | `NOMAD_TPU_ORPHAN` | on | `0` is the kill switch |
+            | `NOMAD_TPU_PLAIN` | 5 | not a rollback knob |
+            """,
+        "tests/test_parity.py": """
+            def test_kill_switch(monkeypatch):
+                monkeypatch.setenv("NOMAD_TPU_COVERED", "0")
+            """,
+    })
+    kept, _ = _rules(root, ["killswitch-tested"])
+    assert len(kept) == 1
+    assert "NOMAD_TPU_ORPHAN" in kept[0].msg
+
+
+# ----------------------------------------------------------------------
+# telemetry-literal / telemetry-kind
+
+
+def test_telemetry_literal_fires_on_computed_name(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/faultinject.py": _FAULTINJECT,
+        "nomad_tpu/mod.py": """
+            def f(metrics, series, point):
+                metrics.incr(series)                  # computed: BAD
+                metrics.incr("nomad.ok.literal")
+                metrics.incr(f"nomad.ok.{point}")     # normalizable
+            """,
+    })
+    kept, _ = _rules(root, ["telemetry-literal"])
+    assert len(kept) == 1
+    assert "`series`" in kept[0].msg
+
+
+def test_telemetry_kind_fires_on_counter_vs_timer(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def f(metrics):
+                metrics.incr("nomad.x.flips")
+                metrics.sample_ms("nomad.x.flips", 3.0)
+                metrics.incr("nomad.x.stable")
+                metrics.incr("nomad.x.stable")
+            """,
+    })
+    kept, _ = _rules(root, ["telemetry-kind"])
+    assert len(kept) == 1
+    assert "nomad.x.flips" in kept[0].msg
+    assert "one series, one kind" in kept[0].msg
+
+
+def test_telemetry_rules_ignore_non_telemetry_receivers(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def f(random, population, series):
+                random.sample(population, 3)
+                population.sample(series)
+            """,
+    })
+    kept, _ = _rules(root, ["telemetry-literal", "telemetry-kind"])
+    assert kept == []
+
+
+# ----------------------------------------------------------------------
+# sleep-under-lock
+
+
+def test_sleep_under_lock_fires_on_each_hazard(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import time
+
+            def f(self, q, ev):
+                with self._lock:
+                    time.sleep(0.5)
+                    q.get()
+                    q.get(timeout=1.0)
+                    ev.wait()
+                    run_dispatch(lambda: 1)
+            """,
+    })
+    kept, _ = _rules(root, ["sleep-under-lock"])
+    assert len(kept) == 5
+    msgs = "\n".join(v.msg for v in kept)
+    assert "time.sleep" in msgs
+    assert "blocking dequeue" in msgs
+    assert "ev.wait()" in msgs
+    assert "device dispatch" in msgs
+
+
+def test_sleep_under_lock_clean_cases(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import time
+
+            def f(self, q, cv):
+                with self._lock:
+                    q.get_nowait()
+                    q.get(False)          # non-blocking poll
+
+                    def deferred():       # defined, not run, under it
+                        time.sleep(1)
+                with cv:
+                    cv.wait()             # a condvar waits on its OWN
+                time.sleep(0.1)           # lock; and no lock held here
+            """,
+    })
+    kept, _ = _rules(root, ["sleep-under-lock"])
+    assert kept == []
+
+
+# ----------------------------------------------------------------------
+# bare-acquire
+
+
+def test_bare_acquire_fires_without_try_finally(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def f(self):
+                self._lock.acquire()
+                self.counter += 1
+                self._lock.release()
+            """,
+    })
+    kept, _ = _rules(root, ["bare-acquire"])
+    assert len(kept) == 1
+    assert "self._lock" in kept[0].msg
+
+
+def test_bare_acquire_clean_with_try_finally(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def immediate(self):
+                self._lock.acquire()
+                try:
+                    self.counter += 1
+                finally:
+                    self._lock.release()
+
+            def enclosing(self, other):
+                try:
+                    self._lock.acquire()
+                    other.acquire()       # released by a DIFFERENT
+                finally:                  # receiver's finally: still
+                    self._lock.release()  # a violation for `other`
+            """,
+    })
+    kept, _ = _rules(root, ["bare-acquire"])
+    assert len(kept) == 1
+    assert "`other.acquire()`" in kept[0].msg
+
+
+# ----------------------------------------------------------------------
+# waivers
+
+
+def test_waiver_with_justification_suppresses(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def f(self):
+                # nomadlint: waive=bare-acquire -- released by the
+                # runner thread when the job retires
+                self._sem.acquire()
+            """,
+    })
+    kept, waived = _rules(root, ["bare-acquire"])
+    assert kept == [] and waived == 1
+
+
+def test_waiver_on_the_violating_line(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": (
+            "def f(self):\n"
+            "    self._sem.acquire()"
+            "  # nomadlint: waive=bare-acquire -- handed off\n"),
+    })
+    kept, waived = _rules(root, ["bare-acquire"])
+    assert kept == [] and waived == 1
+
+
+def test_waiver_without_justification_suppresses_nothing(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def f(self):
+                # nomadlint: waive=bare-acquire
+                self._sem.acquire()
+            """,
+    })
+    kept, waived = _rules(root, ["bare-acquire"])
+    assert len(kept) == 1 and waived == 0
+
+
+def test_waiver_is_per_rule(tmp_path):
+    """A bare-acquire waiver does not blanket-suppress other rules on
+    the same line."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import time
+
+            def f(self):
+                with self._lock:
+                    # nomadlint: waive=bare-acquire -- wrong rule
+                    time.sleep(1)
+            """,
+    })
+    kept, waived = _rules(root, ["sleep-under-lock"])
+    assert len(kept) == 1 and waived == 0
