@@ -571,6 +571,8 @@ def _tunnel_rtt():
     read as (RTT + real work). On local-attached hardware it is ~0."""
     import jax
     import numpy as np
+    # nomadlint: waive=no-callsite-jit -- one-shot RTT probe program,
+    # constructed once per bench run (not a steady-state call site)
     fn = jax.jit(lambda x: x + 1.0)
     x = jax.device_put(np.zeros(8, dtype=np.float32))
     np.asarray(fn(x))
@@ -628,6 +630,8 @@ def _fused_compute_only(lanes, repeats=3):
     inner = jax.vmap(functools.partial(
         impl, B=B, spread_alg=lanes[0].spread_alg,
         dtype_name=lanes[0].dtype_name))
+    # nomadlint: waive=no-callsite-jit -- one-shot bench kernel for this
+    # run's fixed shapes; constructed once, timed across its warm calls
     fn = jax.jit(inner)
     dev = jax.device_put((compact, scal_f, scal_i, pen))
     out = fn(*dev)
@@ -657,6 +661,8 @@ def _fused_compute_only(lanes, repeats=3):
                 return s, None
             last, _ = jax.lax.scan(once, jnp.float32(0), None, length=R)
             return last
+        # nomadlint: waive=no-callsite-jit -- one-shot streaming-bench
+        # program, built once per (R, shapes) measurement
         return jax.jit(run)
 
     # pipelined dispatch: R rounds of device_put + execute + fetch
@@ -996,8 +1002,10 @@ def main_tier(platform: str, tier: int):
     }
     # explicit degraded verdict + breaker/dispatch state: a wedged
     # tunnel or tripped breaker must never read as a chip result
-    from nomad_tpu.benchkit import artifact_stamp, dispatch_health_stamp
+    from nomad_tpu.benchkit import (
+        artifact_stamp, dispatch_health_stamp, jitcheck_stamp)
     out.update(dispatch_health_stamp(platform))
+    out.update(jitcheck_stamp())
     out.update(artifact_stamp())
     out["trace_artifact"] = _export_trace_artifact(
         default=f"BENCH_trace_tier{tier}.json")
@@ -1413,8 +1421,12 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # a CPU-fallback / breaker-degraded artifact must never read as a
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state
-    from nomad_tpu.benchkit import artifact_stamp, dispatch_health_stamp
+    from nomad_tpu.benchkit import (
+        artifact_stamp, dispatch_health_stamp, jitcheck_stamp)
     out.update(dispatch_health_stamp(platform))
+    # dispatch discipline (ISSUE 10): retraces/host syncs/x64 leaks
+    # observed this run, gated by scripts/check_bench_regress.py
+    out.update(jitcheck_stamp())
     # quality scoreboard + per-stage saturation from the headline e2e
     # server (ISSUE 7): quality_fragmentation / quality_drift /
     # stage_busy_pct_* so solver changes are judged on placement

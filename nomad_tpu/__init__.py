@@ -33,3 +33,11 @@ __version__ = "0.1.0"
 from . import lockcheck as _lockcheck  # noqa: E402
 
 _lockcheck.maybe_install_from_env()
+
+# NOMAD_TPU_JITCHECK=1 installs the device-dispatch discipline
+# sanitizer before any module constructs a jitted callable
+# (jitcheck.py); unset/0 is a true no-op -- one env read, jax
+# untouched (and not even imported).
+from . import jitcheck as _jitcheck  # noqa: E402
+
+_jitcheck.maybe_install_from_env()

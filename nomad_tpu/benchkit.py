@@ -92,6 +92,24 @@ def dispatch_health_stamp(platform: str) -> dict:
     }
 
 
+def jitcheck_stamp() -> dict:
+    """Dispatch-discipline fields for bench artifacts (ISSUE 10):
+    steady-state retraces, hot-path host syncs and x64 leaks observed
+    during the run. All zero when the sanitizer is off (the default)
+    -- the regress gate (scripts/check_bench_regress.py) only bites on
+    a round that RAN the sanitizer and found violations, and on any
+    round where a previously-zero field goes positive."""
+    from . import jitcheck
+
+    st = jitcheck.state()
+    return {
+        "jitcheck_enabled": st["enabled"],
+        "jit_retrace_count": st["retrace_count"],
+        "jit_host_sync_count": st["host_sync_count"],
+        "jit_x64_leaks": st["x64_leak_count"],
+    }
+
+
 def artifact_stamp(repo_root: Optional[str] = None) -> dict:
     """Provenance stamp for every bench artifact so trend tooling can
     line BENCH_rNN.json files up without guessing (ISSUE 7 satellite):

@@ -671,6 +671,14 @@ def cmd_operator_debug(args) -> int:
             captures["agent-self.json"]["stats"]["lockcheck"])
     except Exception as e:  # noqa: BLE001 -- partial bundles beat none
         captures["lockcheck.json"] = {"capture_error": repr(e)}
+    # dispatch-discipline sanitizer findings as their own member: the
+    # retrace/host-sync witnesses belong next to traces.json when an
+    # operator is untangling a slow TPU path (ISSUE 10)
+    try:
+        captures["jitcheck.json"] = (
+            captures["agent-self.json"]["stats"]["jitcheck"])
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["jitcheck.json"] = {"capture_error": repr(e)}
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
     grab("jobs.json", "/v1/jobs")
@@ -817,6 +825,54 @@ def cmd_operator_lockcheck(args) -> int:
                   f"{v.get('acquired_at')} in {v.get('in_function')}()"
                   f" [{v.get('reason')}, thread {v.get('thread')}]")
     return 1 if st.get("cycle_count") else 0
+
+
+def cmd_operator_jitcheck(args) -> int:
+    """Dispatch-discipline sanitizer report (rides /v1/agent/self
+    stats.jitcheck): steady-state retraces with witness signature
+    pairs, hot-path host syncs with span attribution, dtype drift and
+    fingerprint-cache mutations. Enable with NOMAD_TPU_JITCHECK=1 on
+    the agent; off is a true no-op and reports enabled=False. Exit 1
+    when steady-state retraces exist."""
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("jitcheck") or {}
+    for k in ("enabled", "warmup", "jits", "calls", "traces",
+              "site_count", "retrace_count", "late_trace_count",
+              "host_sync_count", "sanctioned_fetches",
+              "x64_leak_count", "mutation_count", "reports_dropped"):
+        print(f"{k:20s} = {st.get(k)}")
+    if not st.get("enabled") and not st.get("retrace_count"):
+        print("(checker disabled: set NOMAD_TPU_JITCHECK=1 on the "
+              "agent to account traces)")
+    if args.sites:
+        for s in st.get("sites") or []:
+            print(f"  site {s.get('site'):42s} jits={s.get('jits'):<3d}"
+                  f" calls={s.get('calls'):<6d}"
+                  f" traces={s.get('traces'):<4d}"
+                  f" sigs={s.get('sigs'):<4d}"
+                  f" steady={s.get('steady')}")
+    for i, r in enumerate(st.get("retraces") or []):
+        w = r.get("witness") or {}
+        print(f"\nRETRACE {i}: {r.get('site')} traced "
+              f"{r.get('count')}x for one abstract signature")
+        print(f"  new  {r.get('signature')}")
+        for old in w.get("old") or []:
+            print(f"  old  {old}")
+        print(f"  [thread {r.get('thread')}]")
+    for r in st.get("late_traces") or []:
+        print(f"late trace (report-only): {r.get('site')} "
+              f"new sig {r.get('signature')} after steady state")
+    for r in st.get("host_syncs") or []:
+        print(f"hot-path host sync: {r.get('kind')} at {r.get('site')} "
+              f"x{r.get('count')} (dispatch {r.get('label')!r}, "
+              f"evals {r.get('evals')})")
+    for r in st.get("dtype_drift") or []:
+        print(f"dtype drift: {r.get('kind')} at {r.get('site')} "
+              f"({r.get('where')}, {r.get('leaves')} leaves)")
+    for r in st.get("mutations") or []:
+        print(f"cache mutation: {r.get('kind')} at {r.get('site')} -- "
+              f"{r.get('detail')}")
+    return 1 if st.get("retrace_count") else 0
 
 
 def _render_trace_waterfall(tr: dict, width: int = 48) -> str:
@@ -1284,6 +1340,13 @@ def build_parser() -> argparse.ArgumentParser:
     olc.add_argument("--stacks", action="store_true",
                      help="print the witness stacks under each finding")
     olc.set_defaults(fn=cmd_operator_lockcheck)
+    ojc = op.add_parser("jitcheck",
+                        help="dispatch-discipline sanitizer report "
+                        "(steady-state retraces, hot-path host syncs, "
+                        "dtype drift, cache mutations)")
+    ojc.add_argument("--sites", action="store_true",
+                     help="print the per-call-site trace table")
+    ojc.set_defaults(fn=cmd_operator_jitcheck)
     otr = op.add_parser("trace",
                         help="eval span-waterfall forensics")
     otr.add_argument("eval_id", nargs="?", default="")

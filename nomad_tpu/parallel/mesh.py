@@ -16,6 +16,7 @@ stays on CPU exactly as nomad/plan_apply.go stays authoritative.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import numpy as np
@@ -74,6 +75,26 @@ def pick_mesh(e: int, n: int, n_devices: Optional[int] = None):
     if e_par * n_par < 2:
         return None
     return make_mesh(e_par * n_par, eval_parallel=e_par)
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
+    """One jitted mesh-sharded dense-solve program per (mesh, static
+    args). jax.sharding.Mesh hashes by device grid + axis names, so
+    the fresh-but-equal Mesh each pick_mesh() builds hits this cache
+    -- the dispatch path used to construct a new ``jax.jit`` closure
+    per fused dispatch, which re-traced the whole program every
+    generation (the exact steady-state-retrace class jitcheck.py
+    exists to catch; nomadlint's no-callsite-jit pins the fix)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..solver.binpack import solve_eval_batch
+
+    return jax.jit(
+        lambda c, i, b: solve_eval_batch(
+            c, i, b, spread_alg=spread_alg, dtype_name=dtype_name),
+        out_shardings=NamedSharding(mesh, P()))
 
 
 def shard_solver_inputs(mesh, const, init, batch):

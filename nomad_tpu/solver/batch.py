@@ -238,6 +238,17 @@ class _StackArena:
         self._stats = {"reuses": 0, "allocs": 0, "evictions": 0,
                        "pad_fills_skipped": 0}
 
+    @staticmethod
+    def _set_writeable(ent, flag: bool) -> None:
+        """Pooled buffers are frozen while they sit in the free list
+        (the frozen-memo invariant, ISSUE 10): a generation writing
+        into a buffer it already released -- while a reused checkout or
+        an in-flight transfer may still read it -- raises instead of
+        silently corrupting a lane."""
+        for arrs in ent.trees.values():
+            for a in arrs:
+                a.setflags(write=flag)
+
     def acquire(self, key, specs):
         """specs: tree name -> list of (shape, dtype). Returns
         (entry, reused)."""
@@ -249,6 +260,7 @@ class _StackArena:
                         self._free_bytes -= ent.nbytes
                         self._in_use += 1
                         self._stats["reuses"] += 1
+                        self._set_writeable(ent, True)
                         return ent, True
         trees = {}
         nbytes = 0
@@ -286,6 +298,7 @@ class _StackArena:
             self._in_use -= 1
             if not _arena_enabled():
                 return
+            self._set_writeable(ent, False)
             self._seq += 1
             self._free[self._seq] = ent
             self._free_bytes += ent.nbytes
@@ -545,7 +558,7 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
     import jax
     import jax.numpy as jnp
 
-    from .binpack import solve_eval_batch, solve_lane_fused
+    from .binpack import solve_lane_fused
 
     if ptab is not None:
         if wave:
@@ -569,19 +582,19 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         mesh = pick_mesh(E, N)
 
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import mesh_solve_fn
         metrics.incr("nomad.solver.mesh_dispatches")
         with mesh:
             s_const, s_init, s_batch = shard_solver_inputs(
                 mesh, const, init, batch)
-            fn = jax.jit(
-                lambda c, i, b: solve_eval_batch(
-                    c, i, b, spread_alg=spread_alg, dtype_name=dtype_name),
-                out_shardings=NamedSharding(mesh, P()))
+            fn = mesh_solve_fn(mesh, spread_alg, dtype_name)
             chosen, scores, n_yielded, _ = fn(s_const, s_init, s_batch)
-        combined = np.asarray(jnp.concatenate([
-            chosen.astype(scores.dtype)[None], scores[None],
-            n_yielded.astype(scores.dtype)[None]], axis=0))
+        from .. import jitcheck
+        with jitcheck.sanctioned_fetch():
+            # the mesh path's one bulk fetch: gather + host copy
+            combined = np.asarray(jnp.concatenate([
+                chosen.astype(scores.dtype)[None], scores[None],
+                n_yielded.astype(scores.dtype)[None]], axis=0))
         return combined[0], combined[1], combined[2]
     return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
                             dtype_name=dtype_name, batched=True,

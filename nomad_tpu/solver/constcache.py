@@ -126,6 +126,8 @@ def device_put_cached(arrays: Sequence[np.ndarray],
         note_dispatch_bytes(shipped)
         return list(jax.device_put(arrays)) if arrays else [], shipped
 
+    from .. import jitcheck
+
     min_b = _min_bytes()
     buffers: List = [None] * len(arrays)
     miss_idx: List[int] = []
@@ -141,6 +143,15 @@ def device_put_cached(arrays: Sequence[np.ndarray],
                 shipped += arr.nbytes
                 continue
             fp = _fingerprint(arr)
+            # frozen-memo invariant (ISSUE 10): the fingerprint IS a
+            # promise about this array's content -- freeze the source
+            # so a write after fingerprinting raises instead of
+            # desynchronizing host intent from the resident buffer.
+            # Sources here are always the fused transport's fresh
+            # np.stack / compact-pack outputs, never caller state.
+            arr.setflags(write=False)
+            if jitcheck._ACTIVE:
+                jitcheck.note_fingerprint(arr, fp)
             ent = _CACHE.get(fp)
             if ent is not None:
                 _CACHE.move_to_end(fp)

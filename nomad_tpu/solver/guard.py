@@ -194,7 +194,7 @@ def run_dispatch(fn, label: str = "solver.dispatch",
     from ..faultinject import faults
     from ..server.telemetry import metrics
     from ..server.tracing import tracer
-    from .. import lockcheck
+    from .. import jitcheck, lockcheck
 
     if lockcheck._ACTIVE:
         # a dispatch can burn a full watchdog deadline; entering one
@@ -211,6 +211,12 @@ def run_dispatch(fn, label: str = "solver.dispatch",
     eval_tag = ",".join(tracer.current_ids()) or "-"
 
     def runner() -> None:
+        # jitcheck hot region: host syncs between here and the fn()
+        # return are hot-path syncs (jitcheck.py check b). Gated on one
+        # module-attr read when off, like the lockcheck hook above.
+        hot = jitcheck._ACTIVE
+        if hot:
+            jitcheck.note_dispatch_begin(label)
         try:
             with tracer.activate(trace_ctx):
                 faults.fire("solver.dispatch")
@@ -218,6 +224,8 @@ def run_dispatch(fn, label: str = "solver.dispatch",
         except BaseException as e:  # noqa: BLE001 -- reported to caller
             box["error"] = e
         finally:
+            if hot:
+                jitcheck.note_dispatch_end()
             done.set()
 
     if timeout <= 0:

@@ -1046,7 +1046,8 @@ class TpuPlacementService:
         per eval rather than memoized (an 80MB bitmap per snapshot is the
         same trade _pack_usage_from_table's fold cache makes)."""
         from ..state.alloc_table import pack_delta_enabled
-        from ..tensor.pack import UsageState, _stat_incr, fold_usage_base
+        from ..tensor.pack import (
+            UsageState, _stat_incr, fold_usage_base, freeze_usage_base)
 
         snap = self.ctx.state
         token = snap.latest_index()
@@ -1073,6 +1074,7 @@ class TpuPlacementService:
                                  if not a.client_terminal_status()])
                 _stat_incr("usage_base_misses")
                 if base["ports"] is None:
+                    freeze_usage_base(base)
                     matrix._usage_base = (store, token, base)
         else:
             # NOMAD_TPU_PACK_DELTA=0 kill switch: the PR-4/5 wholesale
@@ -1092,6 +1094,7 @@ class TpuPlacementService:
                                  if not a.client_terminal_status()])
                 _stat_incr("usage_base_misses")
                 if base["ports"] is None:
+                    freeze_usage_base(base)
                     snap.__dict__.setdefault("_usage_base_memo", {})[
                         id(matrix)] = (matrix, token, base)
             else:
@@ -1162,6 +1165,8 @@ class TpuPlacementService:
                 ud[i] += sign * cr.disk_mb
         base = {"used_cpu": uc, "used_mem": um, "used_disk": ud,
                 "ports": None, "dyn_used": old_base["dyn_used"]}
+        from ..tensor.pack import freeze_usage_base
+        freeze_usage_base(base)
         matrix._usage_base = (store, token, base)
         _stat_incr("usage_base_delta_hits")
         return base

@@ -42,6 +42,8 @@ def _hints(cls: type) -> Dict[str, Any]:
     hints = _HINT_CACHE.get(cls)
     if hints is None:
         hints = typing.get_type_hints(cls)
+        # nomadlint: waive=frozen-memo -- typing hints (dicts of types),
+        # not numpy payloads; nothing to freeze
         _HINT_CACHE[cls] = hints
     return hints
 

@@ -260,6 +260,7 @@ def pack_nodes_cached(nodes, node_table_index: Optional[int],
         return hit
     matrix = pack_nodes(nodes)
     _stat_incr("matrix_misses")
+    freeze_matrix(matrix)
     with _NODE_MATRIX_LOCK:
         while len(_NODE_MATRIX_CACHE) >= _NODE_MATRIX_CACHE_MAX:
             _NODE_MATRIX_CACHE.popitem(last=False)
@@ -291,13 +292,52 @@ def _matrix_memo(matrix, key, build):
 
 
 def _freeze(obj) -> None:
-    """Mark cached numpy payloads read-only (shared across evals)."""
+    """Mark cached numpy payloads read-only (shared across evals) and
+    register them with the dispatch-discipline sanitizer's frozen-memo
+    registry (jitcheck.py check d) when it is recording."""
     if isinstance(obj, np.ndarray):
         obj.setflags(write=False)
+        _note_frozen(obj)
     elif isinstance(obj, SpreadInfo):
         for arr in (obj.value_index, obj.desired, obj.has_targets,
                     obj.weights, obj.initial_counts):
             arr.setflags(write=False)
+            _note_frozen(arr)
+
+
+def _note_frozen(arr) -> None:
+    from .. import jitcheck
+    if jitcheck._ACTIVE:
+        jitcheck.note_frozen(arr)
+
+
+def freeze_matrix(matrix: NodeMatrix) -> None:
+    """Freeze a NodeMatrix's array payloads before it enters the
+    version-keyed cache: matrices are shared by every concurrent eval
+    of a fleet version, and every consumer already copies (the
+    make_node_const/state assemblers permute into fresh arrays,
+    pack_usage copies the port bitmap, native.pack copies the
+    port_words seed). The frozen-memo invariant makes that contract
+    enforced instead of conventional."""
+    for arr in (matrix.cpu_cap, matrix.mem_cap, matrix.disk_cap,
+                matrix.dyn_free, matrix.valid, matrix.class_codes,
+                matrix.port_bitmap):
+        if isinstance(arr, np.ndarray):
+            arr.setflags(write=False)
+            _note_frozen(arr)
+
+
+def freeze_usage_base(base: dict) -> None:
+    """Freeze a memoized usage-base fold (solver/service.py): the base
+    is shared by every eval of a snapshot and each eval copies before
+    overlaying its own plan deltas -- enforce that copy-before-write
+    contract like the other pack memos."""
+    for k in ("used_cpu", "used_mem", "used_disk", "dyn_used"):
+        base[k].setflags(write=False)
+        _note_frozen(base[k])
+    if base.get("ports") is not None:
+        base["ports"].setflags(write=False)
+        _note_frozen(base["ports"])
 
 
 def _constraints_fp(constraints) -> tuple:

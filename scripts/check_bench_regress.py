@@ -59,6 +59,12 @@ HEADLINE_FIELDS = {
     "lpq_placements_per_sec": ("higher", 0.15),
     "lpq_evals_per_solve": ("higher", 0.25),
     "lpq_repair_rate": ("lower", 0.50),
+    # dispatch discipline (ISSUE 10): all three are 0 on a healthy
+    # round; the zero-previous epsilon rule means ANY positive count is
+    # a regression (a steady-state retrace or hot-path sync crept in)
+    "jit_retrace_count": ("lower", 0.0),
+    "jit_host_sync_count": ("lower", 0.0),
+    "jit_x64_leaks": ("lower", 0.0),
 }
 
 

@@ -33,6 +33,31 @@ AST rules:
                      exception between acquire and release wedges the
                      lock forever
 
+Dispatch-hygiene rules (ISSUE 10, the static complement of the
+runtime dispatch-discipline sanitizer nomad_tpu/jitcheck.py):
+
+  no-callsite-jit    every ``jax.jit`` is constructed at module level
+                     or inside an ``lru_cache``'d shape-bucket
+                     factory -- a jit built per call defeats the
+                     compile cache and re-traces every generation
+  no-host-sync-hot   no ``jax.device_get`` / ``.item()`` /
+                     ``block_until_ready`` inside a solver hot
+                     function (one that calls a dispatch/transfer
+                     primitive) or statically inside a ``with
+                     <lock>:`` block; the designed one-bulk-fetch
+                     sites mark themselves with
+                     ``with jitcheck.sanctioned_fetch():``
+  dtype-threaded     device-kernel modules (nomad_tpu/solver/,
+                     nomad_tpu/parallel/) take their dtype through
+                     the static ``dtype_name`` arg -- no bare
+                     ``jnp.float64`` / float64 dtype literals in jnp
+                     calls (on TPU f64 is emulated; a leaked float64
+                     table doubles transfer and compute)
+  frozen-memo        arrays stored into memo/cache containers are
+                     frozen first (a freeze/setflags call in the same
+                     function) -- the runtime counterpart is
+                     jitcheck's writeable=False invariant
+
 Legacy checkers, invocable as rules under this driver (their
 standalone scripts keep working; tests/test_metrics_doc.py etc. are
 unchanged):
@@ -456,16 +481,314 @@ def rule_bare_acquire(ctx: Ctx) -> List[Violation]:
     return out
 
 
+# ----------------------------------------------------------------------
+# dispatch-hygiene rules (ISSUE 10)
+
+
+class _JitSiteVisitor(ast.NodeVisitor):
+    """no-callsite-jit: a ``jax.jit`` reference inside a function body
+    is only allowed when some enclosing function is decorated with an
+    ``lru_cache`` (the shape-bucket factory pattern); module level is
+    always fine."""
+
+    def __init__(self, rel: str, out: List[Violation]):
+        self.rel = rel
+        self.out = out
+        self.fn_depth = 0
+        self.lru_depth = 0
+
+    def visit_FunctionDef(self, node):
+        lru = any("lru_cache" in _unparse(d) or
+                  _unparse(d).split("(")[0].endswith("cache")
+                  for d in node.decorator_list)
+        self.fn_depth += 1
+        if lru:
+            self.lru_depth += 1
+        self.generic_visit(node)
+        if lru:
+            self.lru_depth -= 1
+        self.fn_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.fn_depth += 1
+        self.generic_visit(node)
+        self.fn_depth -= 1
+
+    def visit_Attribute(self, node):
+        if (node.attr == "jit" and isinstance(node.ctx, ast.Load)
+                and _unparse(node.value) == "jax"
+                and self.fn_depth > 0 and self.lru_depth == 0):
+            self.out.append(Violation(
+                "no-callsite-jit", self.rel, node.lineno,
+                "jax.jit constructed at a call site -- a fresh jit "
+                "per call defeats the compile cache (steady-state "
+                "retrace); hoist to module level or behind an "
+                "lru_cache'd shape-bucket factory"))
+        self.generic_visit(node)
+
+
+def rule_no_callsite_jit(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if rel.endswith(os.path.join("nomad_tpu", "jitcheck.py")):
+            continue            # the patcher itself handles raw jit
+        _JitSiteVisitor(rel, out).visit(tree)
+    return out
+
+
+# a function that calls any of these is a solver hot function: its
+# body runs on (or stages for) the dispatch path
+_HOT_MARKERS = {"device_put_cached", "_put_eval_sharded", "run_dispatch",
+                "solve_lane_fused", "solve_lane_wave",
+                "solve_lane_wave_preempt", "fuse_and_solve",
+                "solve_groups", "solve_eval_batch",
+                "solve_eval_batch_preempt", "mesh_solve_fn"}
+_SYNC_ATTRS = {"device_get", "item", "block_until_ready"}
+
+
+def _is_sanctioned_with(node: ast.With) -> bool:
+    return any(_unparse(i.context_expr).endswith("sanctioned_fetch()")
+               for i in node.items)
+
+
+class _HotSyncVisitor(ast.NodeVisitor):
+    """Within ONE hot function body: flag device fetches outside a
+    ``with jitcheck.sanctioned_fetch():`` block."""
+
+    def __init__(self, rel: str, out: List[Violation]):
+        self.rel = rel
+        self.out = out
+        self.sanct = 0
+
+    def visit_FunctionDef(self, node):
+        pass                    # nested defs get their own hot check
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        sanct = _is_sanctioned_with(node)
+        if sanct:
+            self.sanct += 1
+        self.generic_visit(node)
+        if sanct:
+            self.sanct -= 1
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if self.sanct:
+            return
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTRS:
+            self.out.append(Violation(
+                "no-host-sync-hot", self.rel, node.lineno,
+                f"host sync `{_unparse(fn)}(...)` inside a solver hot "
+                f"function -- each sync serializes the dispatch "
+                f"pipeline; route through the one sanctioned bulk "
+                f"fetch (`with jitcheck.sanctioned_fetch():`)"))
+
+
+class _SyncUnderLockVisitor(ast.NodeVisitor):
+    """Device fetches statically inside ``with <lock>:`` -- a fetch can
+    burn a watchdog deadline while every peer waits on the lock."""
+
+    def __init__(self, rel: str, out: List[Violation]):
+        self.rel = rel
+        self.out = out
+        self.lock_depth = 0
+
+    def visit_FunctionDef(self, node):
+        if not self.lock_depth:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        for i in node.items:
+            self.visit(i.context_expr)
+        lockish = sum(1 for i in node.items
+                      if _is_lockish(i.context_expr))
+        self.lock_depth += lockish
+        for stmt in node.body:
+            self.visit(stmt)
+        self.lock_depth -= lockish
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if not self.lock_depth:
+            return
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in ("device_get", "item"):
+            self.out.append(Violation(
+                "no-host-sync-hot", self.rel, node.lineno,
+                f"device fetch `{_unparse(fn)}(...)` inside a "
+                f"`with <lock>:` block -- the holder blocks on the "
+                f"device while every waiter starves"))
+
+
+def rule_no_host_sync_hot(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    solver_dirs = (os.path.join("nomad_tpu", "solver"),
+                   os.path.join("nomad_tpu", "parallel"))
+    for rel, _text, tree in ctx.files:
+        if rel.startswith(solver_dirs):
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                calls = {
+                    (c.func.attr if isinstance(c.func, ast.Attribute)
+                     else c.func.id if isinstance(c.func, ast.Name)
+                     else "")
+                    for c in ast.walk(node)
+                    if isinstance(c, ast.Call)}
+                if not calls & _HOT_MARKERS:
+                    continue
+                v = _HotSyncVisitor(rel, out)
+                for stmt in node.body:
+                    v.visit(stmt)
+        _SyncUnderLockVisitor(rel, out).visit(tree)
+    # a fetch can be flagged by both the hot-function and under-lock
+    # scans; one report per line is enough
+    seen: set = set()
+    deduped = []
+    for v in out:
+        key = (v.path, v.line)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(v)
+    return deduped
+
+
+_F64_LITERALS = {"jnp.float64", "np.float64", "jax.numpy.float64"}
+
+
+def rule_dtype_threaded(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    kernel_dirs = (os.path.join("nomad_tpu", "solver"),
+                   os.path.join("nomad_tpu", "parallel"))
+    for rel, _text, tree in ctx.files:
+        if not rel.startswith(kernel_dirs):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _unparse(node) == "jnp.float64":
+                out.append(Violation(
+                    "dtype-threaded", rel, node.lineno,
+                    "bare jnp.float64 in device-kernel code -- thread "
+                    "the dtype through the kernel's static "
+                    "`dtype_name` arg (f64 is emulated on TPU)"))
+            elif isinstance(node, ast.Call):
+                recv = _unparse(node.func)
+                if not recv.startswith(("jnp.", "jax.numpy.")):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    val = _unparse(kw.value)
+                    lit = (isinstance(kw.value, ast.Constant)
+                           and kw.value.value == "float64")
+                    if lit or val in _F64_LITERALS:
+                        out.append(Violation(
+                            "dtype-threaded", rel, node.lineno,
+                            f"float64 dtype literal in `{recv}(...)` "
+                            f"-- thread the dtype through the static "
+                            f"`dtype_name` arg"))
+    # a `jnp.zeros(..., dtype=jnp.float64)` call trips both scans --
+    # one report per line is enough
+    seen: set = set()
+    deduped = []
+    for v in out:
+        key = (v.path, v.line)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(v)
+    return deduped
+
+
+_FREEZE_CALLS = {"_freeze", "setflags", "freeze_matrix",
+                 "freeze_usage_base", "note_frozen", "_note_frozen",
+                 "_set_writeable"}
+_MEMOISH_TAIL = re.compile(r"(memos?$)|(^_?[A-Z0-9_]*CACHE$)")
+
+
+def _memoish_subscript(target) -> Optional[str]:
+    """The store-target name when ``target`` is a subscript into a
+    memo/cache container (``memo[k] = v``, ``_X_CACHE[k] = v``)."""
+    if not isinstance(target, ast.Subscript):
+        return None
+    base = _unparse(target.value)
+    tail = base.split(".")[-1]
+    if _MEMOISH_TAIL.search(tail):
+        return base
+    return None
+
+
+def rule_frozen_memo(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            # innermost wins: don't re-scan nested defs from the outer
+            body_nodes = []
+            stack = list(fn.body)
+            has_freeze = False
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                body_nodes.append(node)
+                if isinstance(node, ast.Call):
+                    name = (node.func.attr
+                            if isinstance(node.func, ast.Attribute)
+                            else node.func.id
+                            if isinstance(node.func, ast.Name) else "")
+                    if name in _FREEZE_CALLS:
+                        has_freeze = True
+                stack.extend(ast.iter_child_nodes(node))
+            if has_freeze:
+                continue
+            for node in body_nodes:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    base = _memoish_subscript(target)
+                    if base is not None:
+                        out.append(Violation(
+                            "frozen-memo", rel, node.lineno,
+                            f"array stored into `{base}[...]` without "
+                            f"a freeze -- memoized payloads are "
+                            f"shared across evals and must be "
+                            f"writeable=False (jitcheck invariant)"))
+    return out
+
+
 AST_RULES = {
     "fire-registered": rule_fire_registered,
     "killswitch-tested": rule_killswitch_tested,
     "telemetry": rule_telemetry,           # emits -literal and -kind
     "sleep-under-lock": rule_sleep_under_lock,
     "bare-acquire": rule_bare_acquire,
+    "no-callsite-jit": rule_no_callsite_jit,
+    "no-host-sync-hot": rule_no_host_sync_hot,
+    "dtype-threaded": rule_dtype_threaded,
+    "frozen-memo": rule_frozen_memo,
 }
 # ids a violation may carry (for --rule selection and waiver matching)
 RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
-            "telemetry-kind", "sleep-under-lock", "bare-acquire")
+            "telemetry-kind", "sleep-under-lock", "bare-acquire",
+            "no-callsite-jit", "no-host-sync-hot", "dtype-threaded",
+            "frozen-memo")
 
 LEGACY_RULES = ("metrics-doc", "knob-doc", "bench-regress")
 

@@ -54,6 +54,48 @@ _LOCKCHECK_SUITES = {
     "test_churn_storm",
 }
 
+# The dispatch-heavy suites run under the device-dispatch discipline
+# sanitizer in tier-1 (ISSUE 10): a steady-state retrace (same abstract
+# signature traced twice at one site -- the compile cache was defeated)
+# or an unsanctioned hot-path host sync FAILS the test; late traces /
+# dtype drift / cache mutations surface as warnings.
+_JITCHECK_SUITES = {
+    "test_dispatch_pipeline", "test_lpq", "test_solver_parity",
+}
+
+
+@pytest.fixture(autouse=True)
+def _jitcheck_sanitizer(request):
+    if request.module.__name__ not in _JITCHECK_SUITES:
+        yield
+        return
+    from nomad_tpu import jitcheck
+
+    jitcheck.enable()
+    try:
+        yield
+        st = jitcheck.state()
+    finally:
+        jitcheck.disable()
+        jitcheck._reset_for_tests()
+    for v in (st["late_traces"] + st["dtype_drift"] + st["mutations"]):
+        warnings.warn(f"jitcheck finding (report-only): {v}")
+    problems = []
+    for r in st["retraces"]:
+        problems.append(
+            f"STEADY-STATE RETRACE at {r['site']}: signature "
+            f"{r['signature']} traced {r['count']}x "
+            f"(witness old={r['witness']['old']})\n{r['stack']}")
+    for r in st["host_syncs"]:
+        problems.append(
+            f"HOT-PATH HOST SYNC {r['kind']} at {r['site']} x"
+            f"{r['count']} (dispatch {r['label']!r}, evals "
+            f"{r['evals']})\n{r['stack']}")
+    if problems:
+        pytest.fail(
+            "dispatch-discipline sanitizer found violation(s) during "
+            "this test:\n" + "\n".join(problems), pytrace=False)
+
 
 @pytest.fixture(autouse=True)
 def _lockcheck_sanitizer(request):

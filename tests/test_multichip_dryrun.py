@@ -20,8 +20,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 @pytest.mark.skipif(jax.device_count() < 2,
                     reason="needs the virtual multi-device mesh")
 def test_dryrun_multichip_parity(monkeypatch):
+    """The dryrun runs UNDER the dispatch-discipline sanitizer
+    (ISSUE 10): the upcoming mesh/pjit work (ROADMAP 1) inherits the
+    retrace/host-sync gate from day one -- a sharding refactor that
+    rebuilds its jitted program per dispatch or pulls scalars off
+    device mid-flight fails here, not in a TPU bench round."""
+    from nomad_tpu import jitcheck
+
     monkeypatch.setenv("MULTICHIP_EVALS", "8")
     monkeypatch.setenv("MULTICHIP_PLACE", "32")
     monkeypatch.setenv("MULTICHIP_NODES", "1024")
     import __graft_entry__ as graft
-    graft.dryrun_multichip(jax.device_count())
+    jitcheck.enable()
+    try:
+        graft.dryrun_multichip(jax.device_count())
+        st = jitcheck.state()
+    finally:
+        jitcheck.disable()
+        jitcheck._reset_for_tests()
+    assert st["retraces"] == [], st["retraces"]
+    assert st["host_syncs"] == [], st["host_syncs"]

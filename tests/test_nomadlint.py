@@ -372,3 +372,181 @@ def test_waiver_is_per_rule(tmp_path):
     })
     kept, waived = _rules(root, ["sleep-under-lock"])
     assert len(kept) == 1 and waived == 0
+
+
+# ----------------------------------------------------------------------
+# no-callsite-jit (ISSUE 10)
+
+
+def test_no_callsite_jit_fires_inside_plain_function(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import functools
+            import jax
+
+            SOLVE = jax.jit(lambda x: x)          # module level: fine
+
+            @functools.lru_cache(maxsize=None)
+            def factory(n_pad):                   # factory: fine
+                return jax.jit(lambda x: x + n_pad)
+
+            def bad(x):
+                fn = jax.jit(lambda y: y * 2)     # per call: BAD
+                return fn(x)
+            """,
+    })
+    kept, _ = _rules(root, ["no-callsite-jit"])
+    assert len(kept) == 1
+    assert "lru_cache" in kept[0].msg
+    assert kept[0].line == 12
+
+
+def test_no_callsite_jit_partial_at_module_level_is_clean(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import functools
+            import jax
+
+            solve = functools.partial(
+                jax.jit, static_argnames=("dtype_name",))(lambda x: x)
+            """,
+    })
+    kept, _ = _rules(root, ["no-callsite-jit"])
+    assert kept == []
+
+
+# ----------------------------------------------------------------------
+# no-host-sync-hot
+
+
+def test_no_host_sync_hot_fires_in_hot_function(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            import jax
+
+            def hot(lane):
+                out = run_dispatch(lambda: lane)
+                v = out.item()                     # BAD: scalar pull
+                return jax.device_get(out), v      # BAD: unsanctioned
+
+            def sanctioned(jitcheck, out):
+                run_dispatch(lambda: out)
+                with jitcheck.sanctioned_fetch():
+                    return jax.device_get(out)     # the designed fetch
+
+            def cold(out):
+                return jax.device_get(out)         # not a hot function
+            """,
+    })
+    kept, _ = _rules(root, ["no-host-sync-hot"])
+    assert len(kept) == 2
+    msgs = "\n".join(v.msg for v in kept)
+    assert "out.item" in msgs and "jax.device_get" in msgs
+
+
+def test_no_host_sync_hot_fires_under_lock(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import jax
+
+            def f(self, out):
+                with self._lock:
+                    return jax.device_get(out)
+            """,
+    })
+    kept, _ = _rules(root, ["no-host-sync-hot"])
+    assert len(kept) == 1
+    assert "with <lock>" in kept[0].msg
+
+
+# ----------------------------------------------------------------------
+# dtype-threaded
+
+
+def test_dtype_threaded_fires_on_bare_float64(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def kernel(x):
+                a = jnp.zeros(4, dtype=jnp.float64)     # BAD
+                b = jnp.asarray(x, dtype="float64")     # BAD
+                c = np.zeros(4, dtype=np.float64)       # host: fine
+                return a, b, c
+
+            def threaded(x, dtype_name):
+                return jnp.zeros(4, dtype=jnp.dtype(dtype_name))
+            """,
+    })
+    kept, _ = _rules(root, ["dtype-threaded"])
+    assert len(kept) == 2
+    assert all("dtype_name" in v.msg for v in kept)
+
+
+def test_dtype_threaded_ignores_non_kernel_dirs(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/server/mod.py": """
+            import jax.numpy as jnp
+
+            def host_report(x):
+                return jnp.zeros(4, dtype=jnp.float64)
+            """,
+    })
+    kept, _ = _rules(root, ["dtype-threaded"])
+    assert kept == []
+
+
+# ----------------------------------------------------------------------
+# frozen-memo
+
+
+def test_frozen_memo_fires_without_freeze(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def cache_it(memo, key, arr):
+                memo[key] = arr                     # BAD: no freeze
+
+            def cache_frozen(memo, key, arr):
+                arr.setflags(write=False)
+                memo[key] = arr
+
+            def not_a_memo(rows, key, arr):
+                rows[key] = arr                     # plain container
+            """,
+    })
+    kept, _ = _rules(root, ["frozen-memo"])
+    assert len(kept) == 1
+    assert "cache_it" not in kept[0].msg and kept[0].line == 3
+    assert "memo" in kept[0].msg
+
+
+def test_frozen_memo_module_cache_store(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            _BIG_CACHE = {}
+
+            def store(key, arr):
+                _BIG_CACHE[key] = arr               # BAD
+            """,
+    })
+    kept, _ = _rules(root, ["frozen-memo"])
+    assert len(kept) == 1
+    assert "_BIG_CACHE" in kept[0].msg
+
+
+def test_new_rules_listed_and_clean_on_real_tree(capsys):
+    """--list names the dispatch-hygiene rules and the real tree is
+    clean under them (justified waivers only) -- the acceptance gate
+    for ISSUE 10's lint half. (The default run in
+    test_repo_lint_clean covers them too; this pins the rule ids.)"""
+    assert nl.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("no-callsite-jit", "no-host-sync-hot",
+                 "dtype-threaded", "frozen-memo"):
+        assert rule in out
+    assert nl.main(["--rule", "no-callsite-jit",
+                    "--rule", "no-host-sync-hot",
+                    "--rule", "dtype-threaded",
+                    "--rule", "frozen-memo"]) == 0, \
+        capsys.readouterr().out
