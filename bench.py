@@ -1003,9 +1003,11 @@ def main_tier(platform: str, tier: int):
     # explicit degraded verdict + breaker/dispatch state: a wedged
     # tunnel or tripped breaker must never read as a chip result
     from nomad_tpu.benchkit import (
-        artifact_stamp, dispatch_health_stamp, jitcheck_stamp)
+        artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
+        statecheck_stamp)
     out.update(dispatch_health_stamp(platform))
     out.update(jitcheck_stamp())
+    out.update(statecheck_stamp())
     out.update(artifact_stamp())
     out["trace_artifact"] = _export_trace_artifact(
         default=f"BENCH_trace_tier{tier}.json")
@@ -1422,11 +1424,13 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state
     from nomad_tpu.benchkit import (
-        artifact_stamp, dispatch_health_stamp, jitcheck_stamp)
+        artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
+        statecheck_stamp)
     out.update(dispatch_health_stamp(platform))
     # dispatch discipline (ISSUE 10): retraces/host syncs/x64 leaks
     # observed this run, gated by scripts/check_bench_regress.py
     out.update(jitcheck_stamp())
+    out.update(statecheck_stamp())
     # quality scoreboard + per-stage saturation from the headline e2e
     # server (ISSUE 7): quality_fragmentation / quality_drift /
     # stage_busy_pct_* so solver changes are judged on placement

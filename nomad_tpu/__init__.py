@@ -41,3 +41,11 @@ _lockcheck.maybe_install_from_env()
 from . import jitcheck as _jitcheck  # noqa: E402
 
 _jitcheck.maybe_install_from_env()
+
+# NOMAD_TPU_STATECHECK=1 installs the MVCC snapshot-isolation &
+# state-aliasing sanitizer before any store/table is constructed
+# (statecheck.py); unset/0 is a true no-op -- one env read, the state
+# classes untouched.
+from . import statecheck as _statecheck  # noqa: E402
+
+_statecheck.maybe_install_from_env()

@@ -906,6 +906,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                 from ..solver import guard as solver_guard
                 from .. import jitcheck as _jitcheck
                 from .. import lockcheck as _lockcheck
+                from .. import statecheck as _statecheck
                 cfg = self.nomad.state.scheduler_config()
                 raft = getattr(self.nomad, "raft", None)
                 self._send(200, {
@@ -941,6 +942,12 @@ class ApiHandler(BaseHTTPRequestHandler):
                         # fingerprint-cache mutations; enabled=False
                         # when off (the default)
                         "jitcheck": _jitcheck.state(sites=True),
+                        # MVCC snapshot-isolation sanitizer report
+                        # (statecheck.py): torn reads, aliasing
+                        # writes, delta-journal gaps, write-skew
+                        # witnesses and stale version-keyed memos;
+                        # enabled=False when off (the default)
+                        "statecheck": _statecheck.state(),
                     },
                     "member": {"name": getattr(self.nomad, "name",
                                                "local"),

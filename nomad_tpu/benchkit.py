@@ -110,6 +110,26 @@ def jitcheck_stamp() -> dict:
     }
 
 
+def statecheck_stamp() -> dict:
+    """Snapshot-isolation fields for bench artifacts (ISSUE 11): torn
+    reads, aliasing writes, journal gaps, write skews and stale memos
+    observed during the run. All zero when the sanitizer is off (the
+    default) -- the regress gate (scripts/check_bench_regress.py) only
+    bites on a round that RAN the sanitizer and found violations, and
+    on any round where a previously-zero field goes positive."""
+    from . import statecheck
+
+    st = statecheck.state()
+    return {
+        "statecheck_enabled": st["enabled"],
+        "state_torn_reads": st["torn_read_count"],
+        "state_aliasing_writes": st["aliasing_write_count"],
+        "state_journal_gaps": st["journal_gap_count"],
+        "state_write_skews": st["write_skew_count"],
+        "state_stale_memos": st["stale_memo_count"],
+    }
+
+
 def artifact_stamp(repo_root: Optional[str] = None) -> dict:
     """Provenance stamp for every bench artifact so trend tooling can
     line BENCH_rNN.json files up without guessing (ISSUE 7 satellite):
@@ -238,7 +258,7 @@ def run_scale_northstar(target_allocs: int, n_nodes: int = 10000,
                     eval_batching=True, batch_width=e_evals)
     server.state.set_scheduler_config(
         SchedulerConfiguration(scheduler_algorithm="tpu-binpack"))
-    server.state.alloc_table.preallocate(
+    server.state.preallocate_allocs(
         int(target_allocs * 1.1) + e_evals * per_eval)
     server.start()
     placed_total = 0
@@ -386,7 +406,7 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
                     eval_batching=True, batch_width=e_evals)
     server.state.set_scheduler_config(
         SchedulerConfiguration(scheduler_algorithm="tpu-binpack"))
-    server.state.alloc_table.preallocate(
+    server.state.preallocate_allocs(
         int(live_target * 1.2) + e_evals * per_eval)
     server.start()
     truncated = False

@@ -679,6 +679,15 @@ def cmd_operator_debug(args) -> int:
             captures["agent-self.json"]["stats"]["jitcheck"])
     except Exception as e:  # noqa: BLE001 -- partial bundles beat none
         captures["jitcheck.json"] = {"capture_error": repr(e)}
+    # snapshot-isolation sanitizer findings as their own member: the
+    # torn-read/aliasing witnesses belong next to lockcheck.json when
+    # an operator is untangling a cross-worker state corruption
+    # (ISSUE 11)
+    try:
+        captures["statecheck.json"] = (
+            captures["agent-self.json"]["stats"]["statecheck"])
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["statecheck.json"] = {"capture_error": repr(e)}
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
     grab("jobs.json", "/v1/jobs")
@@ -873,6 +882,105 @@ def cmd_operator_jitcheck(args) -> int:
         print(f"cache mutation: {r.get('kind')} at {r.get('site')} -- "
               f"{r.get('detail')}")
     return 1 if st.get("retrace_count") else 0
+
+
+def cmd_operator_statecheck(args) -> int:
+    """MVCC snapshot-isolation sanitizer report (rides /v1/agent/self
+    stats.statecheck): torn snapshot reads and aliasing writes with
+    witness stacks, delta-journal coverage gaps, write-skew witnesses
+    and stale version-keyed memos. Enable with NOMAD_TPU_STATECHECK=1
+    on the agent; off is a true no-op and reports enabled=False. Exit
+    1 when torn reads or aliasing writes exist."""
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("statecheck") or {}
+    for k in ("enabled", "reads", "mutations", "scopes",
+              "journal_writes", "batch_commits", "memo_serves",
+              "published_arrays", "registered_rows",
+              "torn_read_count", "aliasing_write_count",
+              "journal_gap_count", "write_skew_count",
+              "stale_memo_count", "drift_count", "reports_dropped"):
+        print(f"{k:20s} = {st.get(k)}")
+    if not st.get("enabled") and not st.get("torn_read_count"):
+        print("(checker disabled: set NOMAD_TPU_STATECHECK=1 on the "
+              "agent to record store discipline)")
+    for i, r in enumerate(st.get("torn_reads") or []):
+        print(f"\nTORN READ {i}: {r.get('kind')} in {r.get('op')} at "
+              f"{r.get('site')} versions {r.get('versions')} "
+              f"(evals {r.get('evals')}, thread {r.get('thread')})")
+        if args.stacks:
+            for ln in (r.get("stack") or "").rstrip().splitlines():
+                print(f"    {ln}")
+    for i, r in enumerate(st.get("aliasing_writes") or []):
+        print(f"\nALIASING WRITE {i}: {r.get('kind')} at "
+              f"{r.get('site')} -- {r.get('detail')} "
+              f"[thread {r.get('thread')}]")
+        if args.stacks:
+            for ln in (r.get("stack") or "").rstrip().splitlines():
+                print(f"    {ln}")
+    for r in st.get("journal_gaps") or []:
+        print(f"journal gap (report-only): delta-less allocs write at "
+              f"{r.get('site')} (tables {r.get('tables')})")
+    for r in st.get("write_skews") or []:
+        print(f"write skew (report-only): node {r.get('node')} touched "
+              f"by plans {r.get('plans')} in ONE batch commit")
+    for r in st.get("stale_memos") or []:
+        print(f"stale memo: {r.get('kind')} at {r.get('site')} entry "
+              f"v{r.get('entry_version')} vs live "
+              f"v{r.get('live_version')}")
+    for r in st.get("drifts") or []:
+        print(f"snapshot drift (designed, report-only): {r.get('op')} "
+              f"at {r.get('site')} versions {r.get('versions')}")
+    return 1 if (st.get("torn_read_count")
+                 or st.get("aliasing_write_count")) else 0
+
+
+def cmd_operator_sanitizers(args) -> int:
+    """One-table summary of all three sanitizers (lockcheck, jitcheck,
+    statecheck) off /v1/agent/self. Exit 1 when any hard violation
+    class is non-zero (cycles / steady-state retraces / torn reads /
+    aliasing writes)."""
+    api = _client(args)
+    stats = api.get("/v1/agent/self")["stats"]
+    lc = stats.get("lockcheck") or {}
+    jc = stats.get("jitcheck") or {}
+    sc = stats.get("statecheck") or {}
+    rows = [
+        ("lockcheck", lc.get("enabled"),
+         {"cycles": lc.get("cycle_count", 0),
+          "held_across": len(lc.get("held_across") or []),
+          "escaped": len(lc.get("escaped") or [])},
+         ("cycles",)),
+        ("jitcheck", jc.get("enabled"),
+         {"retraces": jc.get("retrace_count", 0),
+          "host_syncs": jc.get("host_sync_count", 0),
+          "x64_leaks": jc.get("x64_leak_count", 0),
+          "mutations": jc.get("mutation_count", 0)},
+         ("retraces",)),
+        ("statecheck", sc.get("enabled"),
+         {"torn_reads": sc.get("torn_read_count", 0),
+          "aliasing": sc.get("aliasing_write_count", 0),
+          "journal_gaps": sc.get("journal_gap_count", 0),
+          "write_skews": sc.get("write_skew_count", 0),
+          "stale_memos": sc.get("stale_memo_count", 0)},
+         ("torn_reads", "aliasing")),
+    ]
+    rc = 0
+    print(f"{'sanitizer':12s} {'enabled':8s} {'verdict':8s} findings")
+    for name, enabled, counts, hard in rows:
+        bad = any(counts.get(k) for k in hard)
+        soft = any(v for v in counts.values())
+        verdict = ("FAIL" if bad else
+                   "warn" if soft else
+                   "clean" if enabled else "off")
+        if bad:
+            rc = 1
+        detail = " ".join(f"{k}={v}" for k, v in counts.items())
+        print(f"{name:12s} {str(bool(enabled)):8s} {verdict:8s} "
+              f"{detail}")
+    if rc == 0 and not any(r[1] for r in rows):
+        print("(all sanitizers disabled: set NOMAD_TPU_LOCKCHECK/"
+              "JITCHECK/STATECHECK=1 to record)")
+    return rc
 
 
 def _render_trace_waterfall(tr: dict, width: int = 48) -> str:
@@ -1340,6 +1448,17 @@ def build_parser() -> argparse.ArgumentParser:
     olc.add_argument("--stacks", action="store_true",
                      help="print the witness stacks under each finding")
     olc.set_defaults(fn=cmd_operator_lockcheck)
+    osc = op.add_parser("statecheck",
+                        help="MVCC snapshot-isolation sanitizer report "
+                        "(torn reads / aliasing writes / journal gaps "
+                        "/ write skew / stale memos)")
+    osc.add_argument("--stacks", action="store_true",
+                     help="print witness stacks per finding")
+    osc.set_defaults(fn=cmd_operator_statecheck)
+    osan = op.add_parser("sanitizers",
+                         help="one-table summary of lockcheck + "
+                         "jitcheck + statecheck state")
+    osan.set_defaults(fn=cmd_operator_sanitizers)
     ojc = op.add_parser("jitcheck",
                         help="dispatch-discipline sanitizer report "
                         "(steady-state retraces, hot-path host syncs, "

@@ -122,10 +122,26 @@ def build_launcher(root: str, argv: List[str], env: Dict[str, str],
         f"export {k}={shlex.quote(str(v))}\n" for k, v in env.items()
         if k.isidentifier())
     lines.append(exports.rstrip("\n"))
-    lines.append(
-        f"exec chroot {shlex.quote(root)} /bin/sh -c "
-        + shlex.quote(f"cd {shlex.quote(workdir)} 2>/dev/null || cd /; "
-                      f"exec {_sh_quote(argv)}"))
+    # util-linux `unshare --fork` leaves SIGINT/SIGTERM set to SIG_IGN
+    # in the forked child (the supervisor ignores them while waiting,
+    # and dispositions are inherited across fork+exec) -- and POSIX sh
+    # can neither trap nor reset a signal that was ignored on entry, so
+    # a payload's `trap ... TERM` silently never fires and every
+    # graceful stop escalates to SIGKILL.  GNU coreutils env
+    # --default-signal resets the dispositions between unshare and the
+    # payload; probe for support so non-GNU env degrades to the old
+    # (ungraceful) behavior instead of failing the launch.
+    exec_line = (f"exec chroot {shlex.quote(root)} /bin/sh -c "
+                 + shlex.quote(
+                     f"cd {shlex.quote(workdir)} 2>/dev/null || cd /; "
+                     f"exec {_sh_quote(argv)}"))
+    lines.append("if env --default-signal=SIGINT,SIGTERM true "
+                 "2>/dev/null; then")
+    lines.append("  " + exec_line.replace(
+        "exec chroot", "exec env --default-signal=SIGINT,SIGTERM "
+        "chroot", 1))
+    lines.append("fi")
+    lines.append(exec_line)
     return "\n".join(lines) + "\n"
 
 

@@ -546,6 +546,15 @@ class Planner:
         """Per-node re-verification (reference: evaluatePlanPlacements :507).
         Nodes whose placements no longer fit are trimmed from the result
         (partial commit) unless plan.all_at_once."""
+        # snapshot-isolation sanitizer (statecheck.py, inert no-op
+        # context when off): verification is the one consumer whose
+        # table reads MUST all observe a single version -- two versions
+        # inside this scope means the store lock was dropped mid-verify
+        from ..statecheck import strict_scope
+        with strict_scope("plan.verify"):
+            return self._evaluate_plan_scoped(snapshot, plan)
+
+    def _evaluate_plan_scoped(self, snapshot, plan: Plan) -> PlanResult:
         result = PlanResult(
             node_update={k: list(v) for k, v in plan.node_update.items()},
             node_allocation={},

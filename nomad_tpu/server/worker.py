@@ -142,9 +142,14 @@ def invoke_scheduler(server, ev: Evaluation, token: str,
                 name = sched_factory
                 kwargs["batch"] = sched_type == "batch"
         sched = new_scheduler(name, snapshot, planner, **kwargs)
+        from ..statecheck import eval_scope
         with metrics.measure(
                 f"nomad.worker.invoke_scheduler_{sched_type}"), \
-                tracer.span("worker.invoke", ctx=ctx, sched=sched_type):
+                tracer.span("worker.invoke", ctx=ctx, sched=sched_type), \
+                eval_scope(snapshot):
+            # snapshot-isolation sanitizer scope (statecheck.py, inert
+            # no-op context when the checker is off): the eval's table
+            # reads are grouped and attributed to this trace span
             sched.process(ev)
 
 

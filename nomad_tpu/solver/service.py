@@ -1008,11 +1008,30 @@ class TpuPlacementService:
             if not with_ports and cached is not None \
                     and cached[0] is table and cached[1] == table.version:
                 packed = cached[2]
+            if packed is not None:
+                from .. import statecheck
+                if statecheck._ACTIVE:
+                    # the served fold's version token must match the
+                    # table version this lane packs under (statecheck
+                    # check e; the hit condition above guarantees it --
+                    # this guards the keying against refactors)
+                    statecheck.note_memo_served(
+                        "fold_cache", cached[1], table.version)
             if packed is None:
                 slots = self._node_slots(table, matrix, nodes, n_pad)
                 packed = table.pack(n_pad, slots, with_ports,
                                     port_words_seed=matrix.port_bitmap)
                 if not with_ports:
+                    # the cached fold is shared across every lane of the
+                    # generation; each lane copies before overlaying, so
+                    # freeze the shared arrays to make that contract
+                    # enforced (jitcheck/statecheck frozen-memo
+                    # invariant) instead of conventional
+                    from ..tensor.pack import _freeze
+                    for _arr in (packed["used_cpu"], packed["used_mem"],
+                                 packed["used_disk"], packed["dyn_used"],
+                                 packed["row_slots"]):
+                        _freeze(_arr)
                     matrix._fold_cache = (table, table.version, packed)
             placed, placed_job = table.count_placed(
                 n_pad, packed["row_slots"], self.job.namespace, self.job.id,
@@ -1064,6 +1083,12 @@ class TpuPlacementService:
                 if ent[1] == token:
                     base = ent[2]
                     _stat_incr("usage_base_hits")
+                    from .. import statecheck
+                    if statecheck._ACTIVE:
+                        # version-token discipline (statecheck check e):
+                        # a hit must serve exactly the snapshot's index
+                        statecheck.note_memo_served(
+                            "usage_base", ent[1], token)
                 elif ent[1] < token:
                     base = self._catch_up_usage_base(
                         matrix, store, ent, token)
@@ -1087,6 +1112,10 @@ class TpuPlacementService:
                 if ent is not None and ent[0] is matrix and \
                         ent[1] == token:
                     base = ent[2]
+                    from .. import statecheck
+                    if statecheck._ACTIVE:
+                        statecheck.note_memo_served(
+                            "usage_base", ent[1], token)
             if base is None:
                 base = fold_usage_base(
                     matrix, nodes,

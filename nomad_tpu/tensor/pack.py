@@ -257,6 +257,13 @@ def pack_nodes_cached(nodes, node_table_index: Optional[int],
             _NODE_MATRIX_CACHE.move_to_end(key)
     if hit is not None:
         _stat_incr("matrix_hits")
+        from .. import statecheck
+        if statecheck._ACTIVE:
+            # served-entry version must be the version the caller's
+            # snapshot pins (statecheck check e; equal by construction
+            # today -- this guards the keying against refactors)
+            statecheck.note_memo_served("node_matrix", key[0],
+                                        node_table_index)
         return hit
     matrix = pack_nodes(nodes)
     _stat_incr("matrix_misses")
@@ -287,6 +294,10 @@ def _matrix_memo(matrix, key, build):
     _stat_incr("misses")
     if len(memo) >= _MATRIX_MEMO_MAX:
         memo.clear()
+    # nomadlint: waive=version-keyed-memo -- the container itself is
+    # version-scoped: it lives on a NodeMatrix that is keyed by
+    # (node_table_index, node-id tuple) in _NODE_MATRIX_CACHE and dies
+    # with that fleet version; keys here are job/TG spec fingerprints
     memo[key] = (out,)          # tuple-wrapped: None is a valid result
     return out
 
@@ -306,9 +317,14 @@ def _freeze(obj) -> None:
 
 
 def _note_frozen(arr) -> None:
-    from .. import jitcheck
+    from .. import jitcheck, statecheck
     if jitcheck._ACTIVE:
         jitcheck.note_frozen(arr)
+    if statecheck._ACTIVE:
+        # frozen memo payloads are exactly the "reachable from a
+        # published snapshot/memo" set the snapshot-isolation
+        # sanitizer re-fingerprints (statecheck.py check b)
+        statecheck.note_published(arr)
 
 
 def freeze_matrix(matrix: NodeMatrix) -> None:

@@ -65,6 +65,15 @@ HEADLINE_FIELDS = {
     "jit_retrace_count": ("lower", 0.0),
     "jit_host_sync_count": ("lower", 0.0),
     "jit_x64_leaks": ("lower", 0.0),
+    # snapshot isolation (ISSUE 11): all five are 0 on a healthy
+    # round; any positive count vs a zero round is a regression (a
+    # torn read / aliasing write / silent journal gap / write skew /
+    # stale memo crept in)
+    "state_torn_reads": ("lower", 0.0),
+    "state_aliasing_writes": ("lower", 0.0),
+    "state_journal_gaps": ("lower", 0.0),
+    "state_write_skews": ("lower", 0.0),
+    "state_stale_memos": ("lower", 0.0),
 }
 
 

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""check_sanitizer_gates: the three tier-1 sanitizer fixtures cover the
+suites they claim (ISSUE 11 satellite).
+
+The conftest sanitizer fixtures (``_lockcheck_sanitizer``,
+``_jitcheck_sanitizer``, ``_statecheck_sanitizer``) gate whole suites:
+a suite silently dropping out of its ``_*_SUITES`` set -- a rename, a
+typo, a merge accident -- removes the gate without failing anything.
+This script asserts:
+
+  * each of the three ``_*_SUITES`` assignments exists in
+    tests/conftest.py and is a set of string literals;
+  * every suite a set names exists as ``tests/<name>.py`` (a claimed
+    gate over a deleted/renamed module covers nothing);
+  * each set's matching autouse fixture function exists and reads its
+    set;
+  * the coverage inventory matches EXPECTED below -- growing or
+    shrinking a sanitizer's coverage is a reviewed change, not a
+    drive-by (update both, like faultinject.POINTS).
+
+Exit 0 = all gates in place; nonzero lists the drift.  Tier-1 gated by
+tests/test_sanitizer_gates.py.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the pinned inventory: sanitizer set name -> (fixture name, suites)
+EXPECTED = {
+    "_LOCKCHECK_SUITES": ("_lockcheck_sanitizer", {
+        "test_chaos", "test_dispatch_pipeline", "test_plan_batch",
+        "test_churn_storm",
+    }),
+    "_JITCHECK_SUITES": ("_jitcheck_sanitizer", {
+        "test_dispatch_pipeline", "test_lpq", "test_solver_parity",
+    }),
+    "_STATECHECK_SUITES": ("_statecheck_sanitizer", {
+        "test_plan_batch", "test_pack_delta", "test_churn_storm",
+        "test_lpq",
+    }),
+}
+
+
+def _parse_conftest(path: str):
+    """(sets, fixtures, errors): the ``_*_SUITES`` set literals, the
+    function names defined, and any structural problems."""
+    errors = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError) as e:
+        return {}, {}, [f"cannot parse {path}: {e}"]
+    sets = {}
+    fixtures = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and \
+                        t.id.endswith("_SUITES"):
+                    if not isinstance(node.value, (ast.Set, ast.Tuple,
+                                                   ast.List)):
+                        errors.append(
+                            f"{t.id} is not a set/tuple/list literal")
+                        continue
+                    vals = set()
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            vals.add(e.value)
+                        else:
+                            errors.append(
+                                f"{t.id} holds a non-literal element")
+                    sets[t.id] = vals
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            reads = {n.id for n in ast.walk(node)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            fixtures[node.name] = reads
+    return sets, fixtures, errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="check_sanitizer_gates",
+        description="assert the conftest sanitizer fixtures cover the "
+        "suites they claim")
+    p.add_argument("--conftest",
+                   default=os.path.join(ROOT, "tests", "conftest.py"),
+                   help="conftest path (fixture tests point this at a "
+                   "synthetic file)")
+    p.add_argument("--tests-dir", default=None,
+                   help="tests directory (defaults to the conftest's)")
+    args = p.parse_args(argv)
+    tests_dir = args.tests_dir or os.path.dirname(
+        os.path.abspath(args.conftest))
+
+    sets, fixtures, errors = _parse_conftest(args.conftest)
+
+    for set_name, (fixture_name, expected_suites) in EXPECTED.items():
+        got = sets.get(set_name)
+        if got is None:
+            errors.append(f"{set_name} missing from conftest")
+            continue
+        if got != expected_suites:
+            extra = sorted(got - expected_suites)
+            missing = sorted(expected_suites - got)
+            drift = []
+            if extra:
+                drift.append(f"unpinned additions {extra}")
+            if missing:
+                drift.append(f"dropped suites {missing}")
+            errors.append(
+                f"{set_name} coverage drifted from the pinned "
+                f"inventory: {'; '.join(drift)} (update EXPECTED in "
+                f"scripts/check_sanitizer_gates.py alongside the "
+                f"conftest change)")
+        for suite in sorted(got):
+            if not os.path.exists(
+                    os.path.join(tests_dir, f"{suite}.py")):
+                errors.append(
+                    f"{set_name} names {suite!r} but "
+                    f"tests/{suite}.py does not exist -- the gate "
+                    f"covers nothing")
+        reads = fixtures.get(fixture_name)
+        if reads is None:
+            errors.append(f"fixture {fixture_name} missing from "
+                          f"conftest")
+        elif set_name not in reads:
+            errors.append(f"fixture {fixture_name} does not read "
+                          f"{set_name} -- the set gates nothing")
+
+    # the complement: a _*_SUITES set in conftest that EXPECTED does
+    # not know is an unreviewed fourth gate (or a typo'd rename)
+    for set_name in sorted(sets):
+        if set_name not in EXPECTED:
+            errors.append(f"unexpected suites set {set_name} in "
+                          f"conftest (add it to EXPECTED or rename)")
+
+    if errors:
+        for e in errors:
+            print(f"sanitizer-gates: {e}")
+        print(f"sanitizer-gates: {len(errors)} problem(s)")
+        return 1
+    n = sum(len(s) for s in sets.values())
+    print(f"sanitizer gates in place: {len(sets)} fixtures covering "
+          f"{n} suite entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,30 @@ runtime dispatch-discipline sanitizer nomad_tpu/jitcheck.py):
                      function) -- the runtime counterpart is
                      jitcheck's writeable=False invariant
 
+Store-discipline rules (ISSUE 11, the static complement of the MVCC
+snapshot-isolation sanitizer nomad_tpu/statecheck.py):
+
+  no-direct-table-write  AllocTable mutators and StateStore internals
+                     (``_allocs``/``_nodes``/... dict writes, alloc-
+                     table column stores) are only touched from
+                     ``nomad_tpu/state/`` -- everything else goes
+                     through the store's locked write API
+  version-keyed-memo store-derived caches (``*_CACHE``/``*memo*``
+                     containers in solver/tensor/server modules) must
+                     key on a table version/index/token/fingerprint
+                     component -- a content-blind key serves stale
+                     state forever
+  no-snapshot-escape a ``state.snapshot()`` handle stored into a
+                     module global or a long-lived ``self.`` attribute
+                     outlives its consistency window (snapshots are
+                     per-eval views, not caches)
+  delta-carried      ``_bump("allocs"...)`` calls in the store carry
+                     ``delta=`` (the alloc-delta journal entry) or a
+                     justified waiver -- a delta-less write silently
+                     degrades every incremental-memo holder to
+                     wholesale rebuilds (statecheck check c is the
+                     runtime twin)
+
 Legacy checkers, invocable as rules under this driver (their
 standalone scripts keep working; tests/test_metrics_doc.py etc. are
 unchanged):
@@ -773,6 +797,220 @@ def rule_frozen_memo(ctx: Ctx) -> List[Violation]:
     return out
 
 
+# ----------------------------------------------------------------------
+# store-discipline rules (ISSUE 11)
+
+# AllocTable mutators; calling one on an alloc_table receiver outside
+# nomad_tpu/state/ bypasses the store's locked write API
+_TABLE_MUTATORS = {"upsert", "upsert_many", "remove", "register_node",
+                   "compact", "preallocate", "_grow", "_fold_inc_build",
+                   "_fold_inc_row", "_fold_inc_rows"}
+# store-internal table dicts; subscript/attr writes to these outside
+# state/ are direct index corruption. The receiver must look like a
+# store/state handle: brokers and trackers own private dicts with the
+# same names (broker self._evals) that are theirs to write.
+_STORE_INTERNALS = re.compile(
+    r"(?:store|state)\w*\._(allocs|nodes|jobs|evals|deployments|"
+    r"allocs_by_node|allocs_by_job|table_index|alloc_deltas)\b")
+_STATE_DIR = os.path.join("nomad_tpu", "state")
+
+
+def _is_table_recv(expr: ast.AST) -> bool:
+    s = _unparse(expr)
+    return "alloc_table" in s or s in ("table", "t", "tbl")
+
+
+def rule_no_direct_table_write(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if rel.startswith(_STATE_DIR) or \
+                rel.endswith(os.path.join("nomad_tpu", "statecheck.py")):
+            continue            # the owner and its sanitizer
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _TABLE_MUTATORS \
+                    and _is_table_recv(node.func.value):
+                out.append(Violation(
+                    "no-direct-table-write", rel, node.lineno,
+                    f"AllocTable mutator "
+                    f"`{_unparse(node.func)}(...)` outside "
+                    f"nomad_tpu/state/ -- table writes go through the "
+                    f"store's locked write API (upsert_allocs / "
+                    f"upsert_plan_results / compact_alloc_table)"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    s = _unparse(t)
+                    if ".alloc_table." in s or _STORE_INTERNALS.search(s):
+                        out.append(Violation(
+                            "no-direct-table-write", rel, node.lineno,
+                            f"store/table internals written directly "
+                            f"(`{s} = ...`) outside nomad_tpu/state/"))
+    return out
+
+
+_MEMO_NAME = re.compile(r"(memo|cache)", re.IGNORECASE)
+_VERSION_WORDS = re.compile(
+    r"version|index|token|fingerprint|\bfp\b|digest|snapshot|hash")
+# module dirs whose caches derive from store state (jobspec/structs
+# codecs are content-keyed and out of scope)
+_STORE_DERIVED_DIRS = (os.path.join("nomad_tpu", "solver"),
+                       os.path.join("nomad_tpu", "tensor"),
+                       os.path.join("nomad_tpu", "server"))
+
+
+def _key_mentions_version(fn: ast.AST, key_node: ast.AST) -> bool:
+    """Whether the memo key expression (or, for a plain Name, any
+    assignment to it inside the same function) carries a table
+    version/index/token/fingerprint component."""
+    if _VERSION_WORDS.search(_unparse(key_node)):
+        return True
+    if isinstance(key_node, ast.Name):
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == key_node.id
+                    for t in sub.targets):
+                if _VERSION_WORDS.search(_unparse(sub.value)):
+                    return True
+    return False
+
+
+def _is_call_scoped(fn: ast.AST, base_node: ast.AST) -> bool:
+    """A container freshly bound to a dict literal inside the same
+    function is call-scoped (a per-call lookup memo like service.py's
+    node_cache), not a cross-call cache -- staleness dies with the
+    frame."""
+    if not isinstance(base_node, ast.Name):
+        return False
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            value = sub.value
+            if value is None:
+                continue
+            if any(isinstance(t, ast.Name) and t.id == base_node.id
+                   for t in targets):
+                if isinstance(value, ast.Dict) or (
+                        isinstance(value, ast.Call)
+                        and _unparse(value.func) in ("dict",
+                                                     "OrderedDict",
+                                                     "defaultdict")):
+                    return True
+    return False
+
+
+def rule_version_keyed_memo(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if not rel.startswith(_STORE_DERIVED_DIRS):
+            continue
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        base = _unparse(target.value)
+                        tail = base.split(".")[-1]
+                        if not _MEMO_NAME.search(tail):
+                            continue
+                        if _key_mentions_version(fn, target.slice):
+                            continue
+                        # the version token may ride the ENTRY instead
+                        # of the key when the hit path checks it
+                        # (usage-base memos store (store, token, base))
+                        if _VERSION_WORDS.search(_unparse(node.value)):
+                            continue
+                        if _is_call_scoped(fn, target.value):
+                            continue
+                        out.append(Violation(
+                            "version-keyed-memo", rel, node.lineno,
+                            f"store-derived cache `{base}[...]` keyed "
+                            f"without a table version/index/token/"
+                            f"fingerprint component -- a content-blind "
+                            f"key serves stale state after the next "
+                            f"table write"))
+                    elif isinstance(target, ast.Attribute) \
+                            and _MEMO_NAME.search(target.attr):
+                        if _VERSION_WORDS.search(_unparse(node.value)):
+                            continue
+                        out.append(Violation(
+                            "version-keyed-memo", rel, node.lineno,
+                            f"store-derived memo attribute "
+                            f"`{_unparse(target)}` assigned without a "
+                            f"version/index/token component in the "
+                            f"cached value"))
+    return out
+
+
+_SNAPSHOT_CALL = re.compile(r"(state|store|_store)\w*\.snapshot\(\)")
+
+
+def rule_no_snapshot_escape(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _SNAPSHOT_CALL.search(_unparse(node.value)):
+                continue
+            for target in node.targets:
+                s = _unparse(target)
+                if not (isinstance(target, ast.Attribute)
+                        and s.startswith("self.")):
+                    continue
+                out.append(Violation(
+                    "no-snapshot-escape", rel, node.lineno,
+                    f"state snapshot stored into long-lived attribute "
+                    f"`{s}` -- snapshots are per-eval consistency "
+                    f"windows; holding one pins every object of its "
+                    f"generation and serves stale reads forever"))
+        # module-level globals: snapshot call in a top-level assign
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    _SNAPSHOT_CALL.search(_unparse(stmt.value)):
+                out.append(Violation(
+                    "no-snapshot-escape", rel, stmt.lineno,
+                    f"state snapshot bound to module global "
+                    f"`{_unparse(stmt.targets[0])}`"))
+    return out
+
+
+def rule_delta_carried(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if not rel.startswith(_STATE_DIR):
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_bump"):
+                continue
+            touches_allocs = any(
+                (isinstance(a, ast.Constant) and a.value == "allocs")
+                or isinstance(a, ast.Starred)   # _bump(*TABLES)
+                for a in node.args)
+            if not touches_allocs:
+                continue
+            if any(k.arg == "delta" for k in node.keywords):
+                continue
+            out.append(Violation(
+                "delta-carried", rel, node.lineno,
+                f"`{_unparse(node.func)}(\"allocs\", ...)` without "
+                f"`delta=` -- the journal entry is an uncoverable gap "
+                f"and every incremental-memo holder refolds wholesale "
+                f"(pass the (old, new) pairs or waive with the reason "
+                f"the write is wholesale by design)"))
+    return out
+
+
 AST_RULES = {
     "fire-registered": rule_fire_registered,
     "killswitch-tested": rule_killswitch_tested,
@@ -783,12 +1021,17 @@ AST_RULES = {
     "no-host-sync-hot": rule_no_host_sync_hot,
     "dtype-threaded": rule_dtype_threaded,
     "frozen-memo": rule_frozen_memo,
+    "no-direct-table-write": rule_no_direct_table_write,
+    "version-keyed-memo": rule_version_keyed_memo,
+    "no-snapshot-escape": rule_no_snapshot_escape,
+    "delta-carried": rule_delta_carried,
 }
 # ids a violation may carry (for --rule selection and waiver matching)
 RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
             "telemetry-kind", "sleep-under-lock", "bare-acquire",
             "no-callsite-jit", "no-host-sync-hot", "dtype-threaded",
-            "frozen-memo")
+            "frozen-memo", "no-direct-table-write", "version-keyed-memo",
+            "no-snapshot-escape", "delta-carried")
 
 LEGACY_RULES = ("metrics-doc", "knob-doc", "bench-regress")
 
@@ -817,10 +1060,14 @@ def run_legacy(name: str, argv: List[str]) -> int:
         return int(e.code or 0)
 
 
-def apply_waivers(root: str, violations: List[Violation]
+def apply_waivers(root: str, violations: List[Violation],
+                  used: Optional[set] = None
                   ) -> Tuple[List[Violation], int]:
     """Drop violations waived at the site (or the line above) with a
-    justified `# nomadlint: waive=<rule> -- reason` comment."""
+    justified `# nomadlint: waive=<rule> -- reason` comment.  When
+    ``used`` is provided, every (path, line, rule) whose waiver comment
+    actually suppressed something is recorded into it -- the --stats
+    stale-waiver inventory is the complement of that set."""
     kept: List[Violation] = []
     waived = 0
     lines_cache: Dict[str, List[str]] = {}
@@ -838,7 +1085,10 @@ def apply_waivers(root: str, violations: List[Violation]
             if not 1 <= ln <= len(lines):
                 return False
             m = _WAIVER.search(lines[ln - 1])
-            return bool(m and v.rule in m.group(1).split(","))
+            ok = bool(m and v.rule in m.group(1).split(","))
+            if ok and used is not None:
+                used.add((v.path, ln, v.rule))
+            return ok
 
         # the violating line, then the contiguous comment block above
         # it (multi-line justifications are the norm)
@@ -853,6 +1103,67 @@ def apply_waivers(root: str, violations: List[Violation]
         else:
             kept.append(v)
     return kept, waived
+
+
+def collect_waiver_comments(root: str) -> List[Tuple[str, int, str]]:
+    """Every ``nomadlint: waive=<rules>`` comment in the scanned tree
+    as (rel_path, line, rule) triples -- one per rule id the comment
+    names."""
+    out: List[Tuple[str, int, str]] = []
+    scan = []
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        scan.append(bench)
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "nomad_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        scan.extend(os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py"))
+    for path in scan:
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, 1):
+            m = _WAIVER.search(line)
+            if not m:
+                continue
+            for rule in m.group(1).split(","):
+                out.append((rel, i, rule))
+    return out
+
+
+def run_stats(root: str, rules: List[str]) -> Tuple[dict, List[tuple]]:
+    """--stats: per-rule fired/waived counts plus the stale-waiver
+    inventory (waiver comments that no longer suppress anything on
+    their line -- removable)."""
+    ctx = Ctx(root)
+    violations = list(ctx.parse_errors)
+    for key, fn in AST_RULES.items():
+        ids = (("telemetry-literal", "telemetry-kind")
+               if key == "telemetry" else (key,))
+        if not any(r in rules for r in ids):
+            continue
+        violations.extend(v for v in fn(ctx) if v.rule in rules)
+    used: set = set()
+    kept, _waived = apply_waivers(root, violations, used=used)
+    fired: Dict[str, int] = {r: 0 for r in rules}
+    kept_counts: Dict[str, int] = {r: 0 for r in rules}
+    for v in violations:
+        fired[v.rule] = fired.get(v.rule, 0) + 1
+    for v in kept:
+        kept_counts[v.rule] = kept_counts.get(v.rule, 0) + 1
+    waived_by_rule = {r: fired.get(r, 0) - kept_counts.get(r, 0)
+                      for r in fired}
+    comments = collect_waiver_comments(root)
+    used_lines = {(p, ln) for (p, ln, _r) in used}
+    stale = [(p, ln, rule) for (p, ln, rule) in comments
+             if rule in rules and (p, ln) not in used_lines]
+    stats = {"fired": fired, "waived": waived_by_rule,
+             "kept": len(kept), "waiver_comments": len(comments)}
+    return stats, stale
 
 
 def run_ast_rules(root: str, rules: List[str]) -> Tuple[List[Violation],
@@ -880,10 +1191,34 @@ def main(argv=None) -> int:
                    "all AST rules + metrics-doc + knob-doc")
     p.add_argument("--list", action="store_true",
                    help="list rule ids and exit")
+    p.add_argument("--stats", action="store_true",
+                   help="per-rule fire/waiver inventory + stale-waiver "
+                   "detection (a waiver whose rule no longer fires on "
+                   "its line is removable); exit 1 when stale waivers "
+                   "exist")
     p.add_argument("rest", nargs="*",
                    help="extra argv for legacy rules (bench-regress "
                    "artifact)")
     args = p.parse_args(argv)
+
+    if args.stats:
+        rules = args.rule or list(RULE_IDS)
+        ast_rules = [r for r in rules if r in RULE_IDS]
+        stats, stale = run_stats(args.root, ast_rules)
+        print(f"{'rule':24s} {'fired':>6s} {'waived':>7s} {'kept':>5s}")
+        for r in ast_rules:
+            f = stats["fired"].get(r, 0)
+            w = stats["waived"].get(r, 0)
+            print(f"{r:24s} {f:6d} {w:7d} {f - w:5d}")
+        print(f"waiver comments in tree: {stats['waiver_comments']}")
+        if stale:
+            print(f"\nstale waivers (rule no longer fires on that "
+                  f"line -- removable): {len(stale)}")
+            for path, line, rule in stale:
+                print(f"  {path}:{line}: waive={rule}")
+            return 1
+        print("no stale waivers")
+        return 0
 
     if args.list:
         for r in RULE_IDS:

@@ -63,6 +63,51 @@ _JITCHECK_SUITES = {
     "test_dispatch_pipeline", "test_lpq", "test_solver_parity",
 }
 
+# The store-heaviest suites run under the MVCC snapshot-isolation
+# sanitizer in tier-1 (ISSUE 11): a torn snapshot read (two table
+# versions observed inside one read / one strict verify scope) or an
+# aliasing write (mutation of state reachable from a published
+# snapshot or version-keyed memo) FAILS the test; journal gaps,
+# write-skew witnesses and stale memos surface as warnings until the
+# first triage round.
+_STATECHECK_SUITES = {
+    "test_plan_batch", "test_pack_delta", "test_churn_storm",
+    "test_lpq",
+}
+
+
+@pytest.fixture(autouse=True)
+def _statecheck_sanitizer(request):
+    if request.module.__name__ not in _STATECHECK_SUITES:
+        yield
+        return
+    from nomad_tpu import statecheck
+
+    statecheck.enable()
+    try:
+        yield
+        st = statecheck.state()
+    finally:
+        statecheck.disable()
+        statecheck._reset_for_tests()
+    for v in (st["journal_gaps"] + st["write_skews"]
+              + st["stale_memos"] + st["drifts"]):
+        warnings.warn(f"statecheck finding (report-only): {v}")
+    problems = []
+    for r in st["torn_reads"]:
+        problems.append(
+            f"TORN SNAPSHOT READ ({r['kind']}) in {r['op']} at "
+            f"{r['site']}: versions {r['versions']} (evals "
+            f"{r['evals']})\n{r['stack']}")
+    for r in st["aliasing_writes"]:
+        problems.append(
+            f"ALIASING WRITE ({r['kind']}) at {r['site']}: "
+            f"{r['detail']}\n{r.get('stack', '')}")
+    if problems:
+        pytest.fail(
+            "snapshot-isolation sanitizer found violation(s) during "
+            "this test:\n" + "\n".join(problems), pytrace=False)
+
 
 @pytest.fixture(autouse=True)
 def _jitcheck_sanitizer(request):

@@ -102,7 +102,8 @@ def test_operator_debug_bundle(agent, tmp_path, monkeypatch):
         names = {n.split("/", 1)[1] for n in tar.getnames()}
         assert {"agent-self.json", "threads.json", "metrics.json",
                 "nodes.json", "jobs.json", "evaluations.json",
-                "monitor.log"} <= names
+                "monitor.log", "lockcheck.json", "jitcheck.json",
+                "statecheck.json"} <= names
         for member in tar.getmembers():
             if member.name.endswith("agent-self.json"):
                 self_info = json.load(tar.extractfile(member))

@@ -550,3 +550,217 @@ def test_new_rules_listed_and_clean_on_real_tree(capsys):
                     "--rule", "dtype-threaded",
                     "--rule", "frozen-memo"]) == 0, \
         capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# store-discipline rules (ISSUE 11)
+
+
+def test_no_direct_table_write_fires_outside_state(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/rogue.py": """
+            def corrupt(server, alloc):
+                server.state.alloc_table.upsert(alloc)      # BAD
+                server.state.alloc_table.cpu[0] = 9.0       # BAD
+                store = server.state
+                store._allocs[alloc.id] = alloc             # BAD
+
+            def fine_reads(server, ids):
+                return server.state.alloc_table.fold_verify(ids)
+            """,
+        "nomad_tpu/state/owner.py": """
+            def legit(self, alloc):
+                self.alloc_table.upsert(alloc)   # the owner may
+            """,
+    })
+    kept, _ = _rules(root, ["no-direct-table-write"])
+    assert len(kept) == 3, kept
+    assert all(v.path == "nomad_tpu/rogue.py" for v in kept)
+    assert any("mutator" in v.msg.lower() or "upsert" in v.msg
+               for v in kept)
+
+
+def test_no_direct_table_write_ignores_private_twins(tmp_path):
+    """A broker's own ``self._evals`` dict is its to write -- only
+    store/state receivers are the rule's business."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/server/broker.py": """
+            class Broker:
+                def track(self, ev):
+                    self._evals[ev.id] = ev     # broker-private dict
+            """,
+    })
+    kept, _ = _rules(root, ["no-direct-table-write"])
+    assert kept == []
+
+
+def test_version_keyed_memo_fires_on_content_blind_key(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/caches.py": """
+            _SOLVE_CACHE = {}
+
+            def remember(job_id, result):
+                _SOLVE_CACHE[job_id] = result       # BAD: no version
+
+            def remember_versioned(job_id, version, result):
+                key = (version, job_id)
+                _SOLVE_CACHE[key] = result          # version-keyed
+
+            def remember_token_in_entry(job_id, token, result):
+                _SOLVE_CACHE[job_id] = (token, result)  # entry-token
+
+            def per_call_lookup(nodes):
+                node_cache = {}
+                for n in nodes:
+                    node_cache[n.id] = n            # call-scoped
+                return node_cache
+            """,
+    })
+    kept, _ = _rules(root, ["version-keyed-memo"])
+    assert len(kept) == 1, kept
+    assert kept[0].line == 5
+
+
+def test_version_keyed_memo_scoped_to_store_derived_dirs(tmp_path):
+    """Codec/jobspec content caches are out of scope -- keys there are
+    content, not fleet state."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/structs/codec.py": """
+            _HINT_CACHE = {}
+
+            def hints(cls):
+                _HINT_CACHE[cls] = dir(cls)
+            """,
+    })
+    kept, _ = _rules(root, ["version-keyed-memo"])
+    assert kept == []
+
+
+def test_no_snapshot_escape_fires_on_attr_and_global(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/server/holder.py": """
+            class Sched:
+                def __init__(self, server):
+                    self._snap = server.state.snapshot()   # BAD
+
+                def process(self, server):
+                    snap = server.state.snapshot()         # local: fine
+                    return snap.nodes()
+            """,
+        "nomad_tpu/server/globalsnap.py": """
+            import nomad_tpu.server.core as core
+
+            SNAP = core.SERVER.state.snapshot()            # BAD
+            """,
+    })
+    kept, _ = _rules(root, ["no-snapshot-escape"])
+    assert len(kept) == 2, kept
+    assert {v.path for v in kept} == {"nomad_tpu/server/holder.py",
+                                      "nomad_tpu/server/globalsnap.py"}
+
+
+def test_no_snapshot_escape_ignores_other_snapshots(tmp_path):
+    """metrics.snapshot() / faults.snapshot() are registry dumps, not
+    MVCC state views."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/server/tele.py": """
+            class Sink:
+                def __init__(self, metrics):
+                    self._last = metrics.snapshot()
+            """,
+    })
+    kept, _ = _rules(root, ["no-snapshot-escape"])
+    assert kept == []
+
+
+def test_delta_carried_fires_on_deltaless_allocs_bump(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/state/store.py": """
+            class Store:
+                def delete_allocs(self, ids):
+                    pairs = [(i, None) for i in ids]
+                    return self._bump("allocs", delta=pairs)
+
+                def sloppy_write(self):
+                    return self._bump("allocs")            # BAD
+
+                def node_write(self):
+                    return self._bump("nodes")             # not allocs
+            """,
+    })
+    kept, _ = _rules(root, ["delta-carried"])
+    assert len(kept) == 1
+    assert kept[0].line == 8
+
+
+def test_store_discipline_rules_clean_on_real_tree(capsys):
+    """The acceptance gate for ISSUE 11's lint half: the real tree is
+    clean under all four store-discipline rules (justified waivers
+    only)."""
+    assert nl.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("no-direct-table-write", "version-keyed-memo",
+                 "no-snapshot-escape", "delta-carried"):
+        assert rule in out
+    assert nl.main(["--rule", "no-direct-table-write",
+                    "--rule", "version-keyed-memo",
+                    "--rule", "no-snapshot-escape",
+                    "--rule", "delta-carried"]) == 0, \
+        capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# --stats (ISSUE 11 satellite)
+
+
+def test_stats_inventory_and_stale_waiver(tmp_path, capsys):
+    """--stats prints per-rule fired/waived/kept counts and lists
+    waivers whose rule no longer fires on their line (removable),
+    exiting 1 while any exist."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import time
+
+            def live_waiver(lock):
+                with lock:
+                    # nomadlint: waive=sleep-under-lock -- test fixture
+                    time.sleep(1)
+
+            def unwaived(lock):
+                with lock:
+                    time.sleep(2)
+
+            def stale(x):
+                # nomadlint: waive=sleep-under-lock -- nothing sleeps
+                # here anymore
+                return x
+            """,
+    })
+    rc = nl.main(["--root", root, "--stats",
+                  "--rule", "sleep-under-lock"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "sleep-under-lock" in out
+    # 2 fired, 1 waived, 1 kept
+    import re as _re
+    m = _re.search(r"sleep-under-lock\s+(\d+)\s+(\d+)\s+(\d+)", out)
+    assert m and (m.group(1), m.group(2), m.group(3)) == ("2", "1", "1")
+    assert "stale waivers" in out
+    assert "nomad_tpu/mod.py:14" in out
+
+
+def test_stats_clean_tree_exits_zero(tmp_path, capsys):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def fine():
+                return 1
+            """,
+    })
+    assert nl.main(["--root", root, "--stats"]) == 0
+    assert "no stale waivers" in capsys.readouterr().out
+
+
+def test_stats_on_real_tree_has_no_stale_waivers(capsys):
+    """Every standing waiver in the repo still suppresses something --
+    dead waivers cannot accumulate."""
+    assert nl.main(["--stats"]) == 0, capsys.readouterr().out

@@ -349,3 +349,83 @@ def test_delta_journal_overflow_counter(monkeypatch):
     snap = metrics.snapshot()
     assert snap["counters"].get(
         "nomad.state.delta_journal_overflow", 0) == 1
+
+
+def test_journal_overflow_under_concurrent_readers_never_tears():
+    """ISSUE 11 satellite: ``alloc_deltas_since`` racing ``upsert_many``
+    writers must return a COVERABLE range or an explicit gap
+    (covered=False), never a partially-applied delta set.  Writers
+    commit fixed-size batches whose pairs share a per-batch job id;
+    a torn read would surface as a batch appearing with only part of
+    its pairs.  The journal is shrunk so readers race real overflow,
+    not just the happy path."""
+    import threading
+
+    store, nodes = build_store(4)
+    base_job = mock.job(id="pd-race")
+    store.upsert_job(base_job)
+    BATCH = 7
+    ROUNDS = 60
+    stop = threading.Event()
+    problems = []
+
+    def writer():
+        for r in range(ROUNDS):
+            job = mock.job(id=f"pd-race-{r}")
+            allocs = [mock.alloc_for(job, nodes[k % len(nodes)],
+                                     index=k) for k in range(BATCH)]
+            store.upsert_allocs(allocs)
+        stop.set()
+
+    def reader():
+        last = store.latest_index()
+        while True:
+            upto = store.table_index("allocs")
+            covered, pairs = store.alloc_deltas_since(last, upto=upto)
+            if covered:
+                # every write's batch must arrive WHOLE: count pairs
+                # per batch job id -- a partial batch is a torn set
+                per_batch = {}
+                for old, new in pairs:
+                    a = new if new is not None else old
+                    per_batch.setdefault(a.job_id, 0)
+                    per_batch[a.job_id] += 1
+                for jid, count in per_batch.items():
+                    if jid.startswith("pd-race-") and count != BATCH:
+                        problems.append(
+                            f"partial batch {jid}: {count}/{BATCH}")
+                last = upto
+            else:
+                # explicit gap (overflow or delta-less write): the
+                # reader refolds by resetting its base -- legitimate,
+                # never wrong data
+                last = store.table_index("allocs")
+            if stop.is_set():
+                # one final drain after the writer finished
+                upto = store.table_index("allocs")
+                covered, pairs = store.alloc_deltas_since(last,
+                                                          upto=upto)
+                break
+
+    # shrink the journal so overflow actually happens mid-race
+    import os
+    old = os.environ.get("NOMAD_TPU_DELTA_JOURNAL")
+    os.environ["NOMAD_TPU_DELTA_JOURNAL"] = "16"
+    try:
+        from collections import deque
+        with store._lock:
+            store._alloc_deltas = deque(store._alloc_deltas, maxlen=16)
+        threads = [threading.Thread(target=writer),
+                   threading.Thread(target=reader),
+                   threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        if old is None:
+            os.environ.pop("NOMAD_TPU_DELTA_JOURNAL", None)
+        else:
+            os.environ["NOMAD_TPU_DELTA_JOURNAL"] = old
+    assert problems == [], problems

@@ -1,0 +1,116 @@
+"""check_sanitizer_gates gate (ISSUE 11 satellite): the three conftest
+sanitizer fixtures (lockcheck / jitcheck / statecheck) cover exactly
+the suites the pinned inventory claims, every claimed suite module
+exists, and drift in any direction fails loudly.
+"""
+import importlib.util
+import os
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "check_sanitizer_gates",
+    os.path.join(ROOT, "scripts", "check_sanitizer_gates.py"))
+csg = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(csg)
+
+
+def test_real_conftest_gates_in_place(capsys):
+    """THE tier-1 gate: the real conftest matches the pinned
+    inventory."""
+    assert csg.main([]) == 0
+    assert "gates in place" in capsys.readouterr().out
+
+
+def test_inventory_is_pinned():
+    """The EXPECTED inventory names all three sanitizers; growing a
+    fourth (or renaming one) is a reviewed change here too."""
+    assert set(csg.EXPECTED) == {
+        "_LOCKCHECK_SUITES", "_JITCHECK_SUITES", "_STATECHECK_SUITES"}
+    # statecheck covers the ISSUE-11 suites
+    assert csg.EXPECTED["_STATECHECK_SUITES"][1] == {
+        "test_plan_batch", "test_pack_delta", "test_churn_storm",
+        "test_lpq"}
+
+
+def _fake_conftest(tmp_path, body):
+    p = tmp_path / "conftest.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+_OK_STUB = """
+_LOCKCHECK_SUITES = {
+    "test_chaos", "test_dispatch_pipeline", "test_plan_batch",
+    "test_churn_storm",
+}
+_JITCHECK_SUITES = {
+    "test_dispatch_pipeline", "test_lpq", "test_solver_parity",
+}
+_STATECHECK_SUITES = {
+    "test_plan_batch", "test_pack_delta", "test_churn_storm",
+    "test_lpq",
+}
+
+
+def _lockcheck_sanitizer(request):
+    return request in _LOCKCHECK_SUITES
+
+
+def _jitcheck_sanitizer(request):
+    return request in _JITCHECK_SUITES
+
+
+def _statecheck_sanitizer(request):
+    return request in _STATECHECK_SUITES
+"""
+
+
+def test_fixture_stub_passes(tmp_path, capsys):
+    path = _fake_conftest(tmp_path, _OK_STUB)
+    assert csg.main(["--conftest", path,
+                     "--tests-dir", os.path.join(ROOT, "tests")]) == 0
+    capsys.readouterr()
+
+
+def test_dropped_suite_fails(tmp_path, capsys):
+    """A suite silently dropping out of a set is exactly the drift the
+    script exists to catch."""
+    body = _OK_STUB.replace('"test_pack_delta", "test_churn_storm",\n    "test_lpq",',
+                            '"test_churn_storm",\n    "test_lpq",')
+    path = _fake_conftest(tmp_path, body)
+    assert csg.main(["--conftest", path,
+                     "--tests-dir", os.path.join(ROOT, "tests")]) == 1
+    out = capsys.readouterr().out
+    assert "coverage drifted" in out and "test_pack_delta" in out
+
+
+def test_missing_suite_module_fails(tmp_path, capsys):
+    body = _OK_STUB.replace('"test_lpq",\n}\n\n\ndef _lockcheck',
+                            '"test_lpq", "test_never_written",\n}'
+                            '\n\n\ndef _lockcheck')
+    path = _fake_conftest(tmp_path, body)
+    assert csg.main(["--conftest", path,
+                     "--tests-dir", os.path.join(ROOT, "tests")]) == 1
+    out = capsys.readouterr().out
+    assert "test_never_written" in out and "does not exist" in out
+
+
+def test_fixture_not_reading_set_fails(tmp_path, capsys):
+    body = _OK_STUB.replace(
+        "def _statecheck_sanitizer(request):\n"
+        "    return request in _STATECHECK_SUITES",
+        "def _statecheck_sanitizer(request):\n    return True")
+    path = _fake_conftest(tmp_path, body)
+    assert csg.main(["--conftest", path,
+                     "--tests-dir", os.path.join(ROOT, "tests")]) == 1
+    assert "does not read" in capsys.readouterr().out
+
+
+def test_unexpected_fourth_gate_fails(tmp_path, capsys):
+    body = _OK_STUB + "\n_MYSTERY_SUITES = {\"test_chaos\"}\n"
+    path = _fake_conftest(tmp_path, body)
+    assert csg.main(["--conftest", path,
+                     "--tests-dir", os.path.join(ROOT, "tests")]) == 1
+    assert "_MYSTERY_SUITES" in capsys.readouterr().out
