@@ -774,11 +774,15 @@ def time_streaming_solver(h, nodes, e_evals, per_eval, depth, rounds=6):
                     mism[0] += 1
 
     t0 = time.perf_counter()
-    threads = [threading.Thread(target=pull) for _ in range(depth)]
+    threads = [threading.Thread(target=pull, daemon=True)
+               for _ in range(depth)]
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        # bounded join (nomadlint join-with-timeout): a wedged solver
+        # pull must not hang the bench invisibly
+        while t.is_alive():
+            t.join(timeout=30.0)
     pipe_dt = (time.perf_counter() - t0) / max(n_rounds, 1)
 
     snap = metrics.snapshot()["counters"]

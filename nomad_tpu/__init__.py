@@ -49,3 +49,11 @@ _jitcheck.maybe_install_from_env()
 from . import statecheck as _statecheck  # noqa: E402
 
 _statecheck.maybe_install_from_env()
+
+# NOMAD_TPU_SCHEDCHECK=1 installs the deterministic schedule explorer
+# and roots a controlled run at the importing thread (schedcheck.py);
+# unset/0 is a true no-op -- one env read, Thread/Event/queue/sleep
+# untouched and no controller observable.
+from . import schedcheck as _schedcheck  # noqa: E402
+
+_schedcheck.maybe_install_from_env()

@@ -906,6 +906,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                 from ..solver import guard as solver_guard
                 from .. import jitcheck as _jitcheck
                 from .. import lockcheck as _lockcheck
+                from .. import schedcheck as _schedcheck
                 from .. import statecheck as _statecheck
                 cfg = self.nomad.state.scheduler_config()
                 raft = getattr(self.nomad, "raft", None)
@@ -948,6 +949,12 @@ class ApiHandler(BaseHTTPRequestHandler):
                         # witnesses and stale version-keyed memos;
                         # enabled=False when off (the default)
                         "statecheck": _statecheck.state(),
+                        # deterministic schedule explorer report
+                        # (schedcheck.py): run/seed/policy state,
+                        # decision counters, manifested-deadlock and
+                        # replay-divergence counterexamples;
+                        # enabled=False when off (the default)
+                        "schedcheck": _schedcheck.state(),
                     },
                     "member": {"name": getattr(self.nomad, "name",
                                                "local"),

@@ -688,6 +688,15 @@ def cmd_operator_debug(args) -> int:
             captures["agent-self.json"]["stats"]["statecheck"])
     except Exception as e:  # noqa: BLE001 -- partial bundles beat none
         captures["statecheck.json"] = {"capture_error": repr(e)}
+    # deterministic-schedule explorer findings as their own member:
+    # the deadlock/divergence counterexamples (seed + decision trace)
+    # belong next to lockcheck.json when an operator is replaying a
+    # concurrency wedge (ISSUE 12)
+    try:
+        captures["schedcheck.json"] = (
+            captures["agent-self.json"]["stats"]["schedcheck"])
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["schedcheck.json"] = {"capture_error": repr(e)}
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
     grab("jobs.json", "/v1/jobs")
@@ -934,16 +943,106 @@ def cmd_operator_statecheck(args) -> int:
                  or st.get("aliasing_write_count")) else 0
 
 
+def cmd_operator_schedcheck(args) -> int:
+    """Deterministic schedule explorer (rides /v1/agent/self
+    stats.schedcheck): run/seed/policy state, decision counters, and
+    the deadlock/divergence counterexamples.  ``--replay SEED``
+    re-runs a built-in scenario under the exact recorded interleaving
+    LOCALLY (no agent round-trip) with lockcheck+statecheck armed;
+    ``--explore N`` sweeps N seeds.  Exit 1 when violations (or agent
+    deadlock reports) exist."""
+    from nomad_tpu import schedcheck
+
+    def _print_run(res) -> int:
+        print(f"seed         = {res.seed}")
+        print(f"policy       = {res.policy}")
+        print(f"decisions    = {res.decisions}")
+        print(f"fingerprint  = {res.fingerprint}")
+        if res.error is not None:
+            print(f"error        = {res.error!r}")
+        print(f"violations   = {len(res.violations)}")
+        for v in res.violations:
+            sched = v.get("schedule") or {}
+            at = (f" @ step {sched.get('step')}"
+                  if sched.get("step") is not None else "")
+            detail = " ".join(
+                f"{k}={v[k]}" for k in ("op", "site", "node", "plans",
+                                        "versions", "locks")
+                if v.get(k) is not None)
+            print(f"  [{v['checker']}] {v['kind']}{at} {detail}")
+        return 1 if res.violations else 0
+
+    if args.replay is not None:
+        fn = schedcheck.SCENARIOS.get(args.scenario)
+        if fn is None:
+            print(f"unknown scenario {args.scenario!r} (have: "
+                  f"{', '.join(sorted(schedcheck.SCENARIOS))})")
+            return 2
+        res = schedcheck.replay(fn, args.replay, policy=args.policy)
+        return _print_run(res)
+    if args.explore is not None:
+        fn = schedcheck.SCENARIOS.get(args.scenario)
+        if fn is None:
+            print(f"unknown scenario {args.scenario!r} (have: "
+                  f"{', '.join(sorted(schedcheck.SCENARIOS))})")
+            return 2
+        agg = schedcheck.explore(fn, seeds=args.explore,
+                                 policy=args.policy)
+        print(f"explored     = {len(agg.runs)} schedules "
+              f"(scenario {args.scenario})")
+        print(f"violations   = {len(agg.violations)} across seeds "
+              f"{agg.seeds_with_violations}")
+        for r in agg.runs:
+            if r.violations:
+                print(f"--- seed {r.seed} "
+                      f"(replay: operator schedcheck --replay {r.seed} "
+                      f"--scenario {args.scenario})")
+                _print_run(r)
+        return 1 if agg.violations else 0
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("schedcheck") or {}
+    for k in ("enabled", "run_active", "seed", "policy", "depth",
+              "park_s", "runs", "decisions", "parks", "preemptions",
+              "timeout_wakes", "deadlock_count", "divergence_count",
+              "threads_managed", "reports_dropped"):
+        print(f"{k:16s} = {st.get(k)}")
+    if not st.get("enabled") and not st.get("deadlock_count"):
+        print("(checker disabled: set NOMAD_TPU_SCHEDCHECK=1 on the "
+              "agent to control schedules)")
+    lr = st.get("last_run") or {}
+    if lr:
+        print(f"last run: seed={lr.get('seed')} "
+              f"policy={lr.get('policy')} "
+              f"decisions={lr.get('decisions')} "
+              f"fingerprint={lr.get('fingerprint')}")
+    for r in st.get("reports") or []:
+        if r.get("kind") == "deadlock":
+            waiting = ", ".join(
+                f"{w.get('thread')} on {w.get('on')}"
+                for w in r.get("waiting") or [])
+            print(f"\nDEADLOCK @ seed {r.get('schedule_seed')} step "
+                  f"{r.get('step')} ({r.get('policy')}): [{waiting}]")
+            print(f"  replay: operator schedcheck --replay "
+                  f"{r.get('schedule_seed')}")
+        else:
+            print(f"\nDIVERGENCE @ seed {r.get('schedule_seed')}: "
+                  f"expected {r.get('expected')} got {r.get('got')} "
+                  f"(the scenario changed between record and replay)")
+    return 1 if (st.get("deadlock_count")
+                 or st.get("divergence_count")) else 0
+
+
 def cmd_operator_sanitizers(args) -> int:
-    """One-table summary of all three sanitizers (lockcheck, jitcheck,
-    statecheck) off /v1/agent/self. Exit 1 when any hard violation
-    class is non-zero (cycles / steady-state retraces / torn reads /
-    aliasing writes)."""
+    """One-table summary of all four sanitizers (lockcheck, jitcheck,
+    statecheck, schedcheck) off /v1/agent/self. Exit 1 when any hard
+    violation class is non-zero (cycles / steady-state retraces /
+    torn reads / aliasing writes / manifested deadlocks)."""
     api = _client(args)
     stats = api.get("/v1/agent/self")["stats"]
     lc = stats.get("lockcheck") or {}
     jc = stats.get("jitcheck") or {}
     sc = stats.get("statecheck") or {}
+    dc = stats.get("schedcheck") or {}
     rows = [
         ("lockcheck", lc.get("enabled"),
          {"cycles": lc.get("cycle_count", 0),
@@ -963,6 +1062,11 @@ def cmd_operator_sanitizers(args) -> int:
           "write_skews": sc.get("write_skew_count", 0),
           "stale_memos": sc.get("stale_memo_count", 0)},
          ("torn_reads", "aliasing")),
+        ("schedcheck", dc.get("enabled"),
+         {"deadlocks": dc.get("deadlock_count", 0),
+          "divergences": dc.get("divergence_count", 0),
+          "preemptions": dc.get("preemptions", 0)},
+         ("deadlocks", "divergences")),
     ]
     rc = 0
     print(f"{'sanitizer':12s} {'enabled':8s} {'verdict':8s} findings")
@@ -979,7 +1083,7 @@ def cmd_operator_sanitizers(args) -> int:
               f"{detail}")
     if rc == 0 and not any(r[1] for r in rows):
         print("(all sanitizers disabled: set NOMAD_TPU_LOCKCHECK/"
-              "JITCHECK/STATECHECK=1 to record)")
+              "JITCHECK/STATECHECK/SCHEDCHECK=1 to record)")
     return rc
 
 
@@ -1457,8 +1561,26 @@ def build_parser() -> argparse.ArgumentParser:
     osc.set_defaults(fn=cmd_operator_statecheck)
     osan = op.add_parser("sanitizers",
                          help="one-table summary of lockcheck + "
-                         "jitcheck + statecheck state")
+                         "jitcheck + statecheck + schedcheck state")
     osan.set_defaults(fn=cmd_operator_sanitizers)
+    odc = op.add_parser("schedcheck",
+                        help="deterministic schedule explorer report, "
+                        "seeded replay of a recorded interleaving, or "
+                        "a local seed sweep")
+    odc.add_argument("--replay", type=int, default=None, metavar="SEED",
+                     help="re-run the scenario under this exact "
+                     "schedule seed (local; lockcheck+statecheck "
+                     "armed)")
+    odc.add_argument("--explore", type=int, default=None, metavar="N",
+                     help="sweep N schedule seeds locally and "
+                     "aggregate violations")
+    odc.add_argument("--scenario", default="broker-smoke",
+                     help="built-in scenario for --replay/--explore "
+                     "(broker-smoke, planted-write-skew, "
+                     "planted-torn-read)")
+    odc.add_argument("--policy", default=None,
+                     help="schedule policy: random (default), pct, rr")
+    odc.set_defaults(fn=cmd_operator_schedcheck)
     ojc = op.add_parser("jitcheck",
                         help="dispatch-discipline sanitizer report "
                         "(steady-state retraces, hot-path host syncs, "

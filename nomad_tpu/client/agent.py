@@ -61,7 +61,7 @@ class SimClient(threading.Thread):
         self.server = server
         self.node = node
         self.poll_interval = poll_interval
-        self._stop = threading.Event()
+        self._stop_ev = threading.Event()
         self._frozen = threading.Event()   # simulate network partition
         self._tasks: Dict[str, _TaskState] = {}
         self._last_hb = 0.0
@@ -75,12 +75,12 @@ class SimClient(threading.Thread):
         self._frozen.clear()
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_ev.set()
 
     # ----------------------------------------------------------------------
     def run(self) -> None:
         self.server.register_node(self.node)
-        while not self._stop.is_set():
+        while not self._stop_ev.is_set():
             if not self._frozen.is_set():
                 self._heartbeat_if_due()
                 self._reconcile_allocs()

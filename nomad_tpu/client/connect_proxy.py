@@ -112,8 +112,12 @@ def _handle(conn: socket.socket, dial) -> None:
     rev = threading.Thread(target=_pump, args=(remote, conn), daemon=True)
     fwd.start()
     rev.start()
-    fwd.join()
-    rev.join()
+    for pump in (fwd, rev):
+        # bounded join (nomadlint join-with-timeout): the pumps run
+        # until the connection closes; re-check so a wedged socket
+        # stays a diagnosable live thread, not an invisible hang
+        while pump.is_alive():
+            pump.join(timeout=30.0)
     for s in (conn, remote):
         try:
             s.close()

@@ -205,7 +205,10 @@ class MockDriver(Driver):
         if inst.run_for > 0:
             inst.stopped.wait(inst.run_for)
         else:
-            inst.stopped.wait()          # run forever until stopped
+            # run forever until stopped; bounded re-check (nomadlint
+            # join-with-timeout) keeps the parked task diagnosable
+            while not inst.stopped.wait(60.0):
+                pass
         if inst.exit_result is None:
             if inst.stopped.is_set():
                 inst.exit_result = ExitResult(exit_code=0,

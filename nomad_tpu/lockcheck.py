@@ -55,6 +55,13 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import _thread
 
+# the deterministic-schedule sibling (schedcheck.py): the wrappers
+# below double as its lock/condvar interposition points, gated on one
+# module-attr read when it is off (same pattern as guard.py's
+# lockcheck._ACTIVE gate). schedcheck's module top imports only the
+# stdlib, so this import can never cycle.
+from . import schedcheck as _schedcheck
+
 # the real factories, captured before any patching can happen
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
@@ -227,6 +234,9 @@ def _record_cycle_locked(nodes: List[int]) -> Optional[dict]:
                         "to": _sites.get(b, "?"),
                         "thread": "?", "stack": "<unwitnessed>"})
                   for a, b in edges],
+        # replayable counterexample: the active schedcheck run's seed
+        # + decision step (None outside a controlled schedule)
+        "schedule": _schedcheck.witness(),
     }
     _cycles.append(cyc)
     return cyc
@@ -343,6 +353,8 @@ class _LockWrapper:
             _counters["locks"] += 1
 
     def acquire(self, blocking=True, timeout=-1):
+        if blocking and _schedcheck._ACTIVE:
+            _schedcheck.lock_gate(self._lc_inner)
         ok = self._lc_inner.acquire(blocking, timeout)
         if ok:
             _record_acquire(self, True, sys._getframe(1))
@@ -351,8 +363,12 @@ class _LockWrapper:
     def release(self):
         self._lc_inner.release()
         _record_release(self)
+        if _schedcheck._ACTIVE:
+            _schedcheck.lock_released(self._lc_inner)
 
     def __enter__(self):
+        if _schedcheck._ACTIVE:
+            _schedcheck.lock_gate(self._lc_inner)
         # nomadlint: waive=bare-acquire -- this IS the lock: the paired
         # release is __exit__ by context-manager protocol
         self._lc_inner.acquire()
@@ -362,6 +378,8 @@ class _LockWrapper:
     def __exit__(self, *exc):
         _record_release(self)
         self._lc_inner.release()
+        if _schedcheck._ACTIVE:
+            _schedcheck.lock_released(self._lc_inner)
         return False
 
     def locked(self):
@@ -371,9 +389,13 @@ class _LockWrapper:
     def _release_save(self):
         _record_release(self, full=True)
         if self._lc_kind == "rlock":
-            return self._lc_inner._release_save()
-        self._lc_inner.release()
-        return None
+            state = self._lc_inner._release_save()
+        else:
+            self._lc_inner.release()
+            state = None
+        if _schedcheck._ACTIVE:
+            _schedcheck.lock_released(self._lc_inner)
+        return state
 
     def _acquire_restore(self, state):
         if self._lc_kind == "rlock":
@@ -404,9 +426,24 @@ class _LockWrapper:
 class _InstrumentedCondition(_REAL_CONDITION):
     """Real Condition over an instrumented lock; times waits so a
     thread parked on a condvar while holding OTHER locks past the
-    threshold is reported."""
+    threshold is reported.  Under an active schedcheck run, wait and
+    notify route through the controller instead of the OS: the waiter
+    parks virtually (no wall clock burns) and notify makes it runnable
+    at the next scheduling decision -- which is what makes condvar
+    handoff order a deterministic function of the schedule seed."""
 
     def wait(self, timeout=None):
+        if _schedcheck._ACTIVE and _schedcheck.managed_active():
+            state = self._release_save()
+            try:
+                notified = _schedcheck.cond_wait_gate(
+                    id(self), timed=timeout is not None)
+            finally:
+                inner = getattr(self._lock, "_lc_inner", None)
+                if inner is not None:
+                    _schedcheck.lock_gate(inner, "cond.reacquire")
+                self._acquire_restore(state)
+            return notified
         if not _ACTIVE:
             return super().wait(timeout)
         others = _held_other(exclude=self._lock)
@@ -420,6 +457,16 @@ class _InstrumentedCondition(_REAL_CONDITION):
             if dt_ms >= _wait_ms:
                 _note_held_across("condition.wait", others,
                                   f"{dt_ms:.0f}ms")
+
+    def notify(self, n=1):
+        super().notify(n)
+        if _schedcheck._ACTIVE:
+            _schedcheck.cond_notify(id(self), n)
+
+    def notify_all(self):
+        super().notify_all()
+        if _schedcheck._ACTIVE:
+            _schedcheck.cond_notify(id(self), None)
 
 
 # ----------------------------------------------------------------------

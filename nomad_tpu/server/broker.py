@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import schedcheck
 from ..structs import (
     Evaluation, EVAL_STATUS_BLOCKED, EVAL_STATUS_PENDING,
     TRIGGER_MAX_DISCONNECT_TIMEOUT, TRIGGER_QUEUED_ALLOCS,
@@ -106,6 +107,11 @@ class EvalBroker:
                     return
                 now = time.time()
                 while self._delayed and self._delayed[0][0] <= now:
+                    if schedcheck._ACTIVE:
+                        # schedule-explorer interposition: each
+                        # delayed-heap release is a decision point
+                        # (one module-attr read when off)
+                        schedcheck.yield_point("broker.delayed_pop")
                     _, _, ev = heapq.heappop(self._delayed)
                     self._enqueue_locked(ev)
                 if now - last_failed_retry >= self.nack_timeout / 2:

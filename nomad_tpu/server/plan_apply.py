@@ -252,7 +252,13 @@ class Planner:
         """Enqueue + wait (the worker-facing contract is unchanged:
         blocking submit, reference worker.go:650 SubmitPlan)."""
         from ..faultinject import faults
+        from .. import schedcheck
         faults.fire("plan.apply")   # chaos: raise -> eval nack/requeue
+        if schedcheck._ACTIVE:
+            # schedule-explorer interposition: plan submission is the
+            # worker->applier rendezvous whose ordering the N-worker
+            # refactor multiplies (one module-attr read when off)
+            schedcheck.yield_point("plan.submit")
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("planner is shut down")
@@ -269,7 +275,11 @@ class Planner:
             metrics.sample("nomad.plan.queue_depth",
                            float(len(self._heap)))
             self._cv.notify()
-        pending.event.wait()
+        # bounded re-check (nomadlint join-with-timeout): the
+        # dispatcher resolves every pending entry, success or failure,
+        # but a wedged commit should park us re-checkably, not forever
+        while not pending.event.wait(5.0):
+            pass
         if pending.error is not None:
             raise pending.error
         return pending.result

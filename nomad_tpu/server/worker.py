@@ -68,24 +68,24 @@ class Worker(threading.Thread):
         self.worker_id = worker_id
         self.schedulers = schedulers or ["service", "batch", "system",
                                          "sysbatch"]
-        self._stop = threading.Event()
+        self._stop_ev = threading.Event()
         self.evals_processed = 0
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_ev.set()
 
     def run(self) -> None:
         # One bad iteration (including a dequeue that raises -- see the
         # broker.dequeue fault point) must not silently kill the worker
         # thread and halt scheduling; same rationale as BatchWorker.run.
-        while not self._stop.is_set():
+        while not self._stop_ev.is_set():
             try:
                 ev, token = self.server.broker.dequeue(
                     self.schedulers, timeout=0.5)
             except Exception:
                 import traceback
                 traceback.print_exc()
-                self._stop.wait(0.5)
+                self._stop_ev.wait(0.5)
                 continue
             if ev is None:
                 continue
@@ -176,24 +176,24 @@ class BatchWorker(threading.Thread):
         self.schedulers = schedulers or ["service", "batch", "system",
                                          "sysbatch"]
         self.use_mesh = use_mesh
-        self._stop = threading.Event()
+        self._stop_ev = threading.Event()
         self.evals_processed = 0
         self.batches_processed = 0
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_ev.set()
 
     def run(self) -> None:
         # This thread may be the server's only scheduling path: one bad
         # iteration must not silently halt all scheduling (same rationale
         # as Server._supervised for watcher threads).
-        while not self._stop.is_set():
+        while not self._stop_ev.is_set():
             try:
                 self._run_batch()
             except Exception:
                 import traceback
                 traceback.print_exc()
-                self._stop.wait(0.5)
+                self._stop_ev.wait(0.5)
 
     def _run_batch(self) -> None:
         from ..solver.batch import SolveBarrier, make_solve_hook
@@ -226,7 +226,11 @@ class BatchWorker(threading.Thread):
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # bounded join (nomadlint join-with-timeout): an eval
+            # thread wedged past the dispatch watchdog must surface as
+            # a live diagnosable thread, not an invisible infinite join
+            while t.is_alive():
+                t.join(timeout=5.0)
         self.evals_processed += len(batch)
         self.batches_processed += 1
 
@@ -260,7 +264,10 @@ class BatchWorker(threading.Thread):
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # bounded join (nomadlint join-with-timeout), as in
+            # _run_batch above
+            while t.is_alive():
+                t.join(timeout=5.0)
         self.evals_processed += len(batch)
         self.batches_processed += 1
 

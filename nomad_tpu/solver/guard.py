@@ -121,7 +121,12 @@ def backend_available(timeout_s: float = 0.0) -> bool:
         _STATE["probe_started_at"] = time.time()
         _STATE["probe_timeout_s"] = timeout
         t.start()
-        ok = done.wait(timeout) and result["n"] > 0
+        # the probe deadline is REAL time (schedcheck must not expire
+        # it virtually early, or a healthy backend reads as down and
+        # every eval silently degrades to the host oracle)
+        from .. import schedcheck
+        with schedcheck.real_time():
+            ok = done.wait(timeout) and result["n"] > 0
         _set_flags_locked(True, ok)
         _STATE["probe_timed_out"] = not done.is_set()
         if not ok:
@@ -194,13 +199,17 @@ def run_dispatch(fn, label: str = "solver.dispatch",
     from ..faultinject import faults
     from ..server.telemetry import metrics
     from ..server.tracing import tracer
-    from .. import jitcheck, lockcheck
+    from .. import jitcheck, lockcheck, schedcheck
 
     if lockcheck._ACTIVE:
         # a dispatch can burn a full watchdog deadline; entering one
         # while holding locks starves every peer of those locks for the
         # same deadline (lockcheck held_across report)
         lockcheck.note_dispatch(label)
+    if schedcheck._ACTIVE:
+        # schedule-explorer interposition: dispatch entry is a
+        # decision point (one module-attr read when off)
+        schedcheck.yield_point("guard.run_dispatch")
     timeout = dispatch_deadline_s() if timeout_s is None else timeout_s
     box: dict = {}
     done = threading.Event()
@@ -234,7 +243,13 @@ def run_dispatch(fn, label: str = "solver.dispatch",
         t = threading.Thread(target=runner, daemon=True,
                              name=f"dispatch-{label}")
         t.start()
-        if not done.wait(timeout):
+        # the watchdog deadline is REAL time: under a schedcheck run
+        # this wait must not be virtualized into an early timeout (a
+        # falsely-expired deadline would degrade the eval to the host
+        # oracle and break kill-switch parity)
+        with schedcheck.real_time():
+            expired = not done.wait(timeout)
+        if expired:
             metrics.incr("nomad.solver.dispatch_timeout")
             record_dispatch_failure("timeout")
             tracer.mark_degraded("watchdog_timeout", ctx=trace_ctx,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import schedcheck
 from .alloc_table import AllocTable
 from ..structs import (
     ACL_TOKEN_TYPE_MANAGEMENT, ACLPolicy, ACLToken, Allocation, Deployment,
@@ -360,6 +361,10 @@ class StateStore:
         bare "something changed", and the bounded journal below lets
         incremental memo holders catch a stale base up to the current
         index by applying the missed deltas instead of refolding."""
+        if schedcheck._ACTIVE:
+            # schedule-explorer interposition: every index bump is a
+            # decision point (one module-attr read when off)
+            schedcheck.yield_point("store._bump")
         self._index += 1
         for t in tables:
             self._table_index[t] = self._index
@@ -1387,6 +1392,10 @@ class StateStore:
         plan's exception rides the returned per-entry outcome list
         (None = committed)."""
         from ..faultinject import faults
+        if schedcheck._ACTIVE:
+            # schedule-explorer interposition: a batch commit is the
+            # write-skew decision point ROADMAP-2's N workers multiply
+            schedcheck.yield_point("store.apply_batch")
         with self._lock:
             outcomes: List[Optional[BaseException]] = []
             merged_all: List[Allocation] = []

@@ -220,7 +220,12 @@ def _report(lst: List[dict], keys: set, key, payload: dict) -> bool:
     """Dedup + cap + record one finding; returns True when it is new.
     Callers emit their own counter with a literal series name (the
     metrics-doc checker reads emit sites, and one finding = one
-    increment of its class counter)."""
+    increment of its class counter).  Findings recorded during an
+    active schedcheck run carry its schedule witness (seed + policy +
+    decision step): ``operator schedcheck --replay <seed>`` re-runs
+    the interleaving that manifested them."""
+    from . import schedcheck
+    payload.setdefault("schedule", schedcheck.witness())
     with _slock:
         if key in keys:
             return False

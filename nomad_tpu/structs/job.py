@@ -58,23 +58,63 @@ JOB_MAX_PRIORITY = 100
 # reproducible run-to-run (tests/conftest.py reseeds per test), and the
 # host and TPU paths derive the SAME shuffle from the id, so parity is
 # unaffected by construction.
+#
+# The stream is PER-THREAD (ISSUE 12 deflake): with one shared stream,
+# concurrent draws interleave nondeterministically, so WHICH eval got
+# WHICH id -- and therefore the equal-score node shuffle -- depended
+# on thread timing even under a pinned seed (schedcheck replay
+# root-caused this as the residual e2e placement nondeterminism beyond
+# the PR-6 reseed).  Each thread derives its stream from (base seed,
+# thread name): thread names are deterministic (scheduler-worker-N,
+# batch-eval-<id8>), so a thread's k-th draw is schedule-independent.
+# The thread that calls reseed_ids keeps the base stream itself, which
+# preserves the exact pre-ISSUE-12 id sequence for single-threaded
+# runs.  The remaining freedom -- which WORKER thread mints a
+# followup eval's id -- is the eval->worker assignment, controlled
+# only under a schedcheck run (docs/OPERATIONS.md runbook).
+import hashlib as _hashlib
 import os as _os
+import threading as _threading
 
 _seed_env = _os.environ.get("NOMAD_TPU_SEED_IDS", "")
-_uuid_rng = random.Random(int(_seed_env) if _seed_env
-                          else uuid.uuid4().int)
+_id_base: List[Optional[int]] = [int(_seed_env) if _seed_env else None]
+_id_epoch = [0]
+_id_tls = _threading.local()
 
 
 def reseed_ids(seed: int) -> None:
-    """Re-pin the id stream (test hook: deterministic tie-breaks)."""
-    _uuid_rng.seed(seed)
+    """Re-pin the id stream (test hook: deterministic tie-breaks).
+    The calling thread takes the base stream; every other thread
+    derives its own from (seed, thread name) on first draw."""
+    _id_base[0] = seed
+    _id_epoch[0] += 1
+    _id_tls.rng = random.Random(seed)
+    _id_tls.epoch = _id_epoch[0]
+
+
+def _thread_rng() -> random.Random:
+    rng = getattr(_id_tls, "rng", None)
+    if rng is not None and getattr(_id_tls, "epoch", -1) == _id_epoch[0]:
+        return rng
+    base = _id_base[0]
+    if base is None:
+        seed = uuid.uuid4().int          # unseeded: fresh entropy
+    else:
+        name = _threading.current_thread().name
+        seed = int.from_bytes(
+            _hashlib.blake2b(f"{base}:{name}".encode(),
+                             digest_size=8).digest(), "little")
+    rng = random.Random(seed)
+    _id_tls.rng = rng
+    _id_tls.epoch = _id_epoch[0]
+    return rng
 
 
 _UUID_VARIANT = "89ab"
 
 
 def generate_uuid() -> str:
-    h = f"{_uuid_rng.getrandbits(128):032x}"
+    h = f"{_thread_rng().getrandbits(128):032x}"
     # force the RFC-4122 version (4) and variant (10xx) nibbles so the
     # output validates as a real uuid4 everywhere
     return (f"{h[:8]}-{h[8:12]}-4{h[13:16]}-"

@@ -1,14 +1,14 @@
 #!/usr/bin/env python3
-"""check_sanitizer_gates: the three tier-1 sanitizer fixtures cover the
-suites they claim (ISSUE 11 satellite).
+"""check_sanitizer_gates: the four tier-1 sanitizer fixtures cover the
+suites they claim (ISSUE 11 satellite; ISSUE 12 added the fourth).
 
 The conftest sanitizer fixtures (``_lockcheck_sanitizer``,
-``_jitcheck_sanitizer``, ``_statecheck_sanitizer``) gate whole suites:
-a suite silently dropping out of its ``_*_SUITES`` set -- a rename, a
-typo, a merge accident -- removes the gate without failing anything.
-This script asserts:
+``_jitcheck_sanitizer``, ``_statecheck_sanitizer``,
+``_schedcheck_explorer``) gate whole suites: a suite silently dropping
+out of its ``_*_SUITES`` set -- a rename, a typo, a merge accident --
+removes the gate without failing anything.  This script asserts:
 
-  * each of the three ``_*_SUITES`` assignments exists in
+  * each of the four ``_*_SUITES`` assignments exists in
     tests/conftest.py and is a set of string literals;
   * every suite a set names exists as ``tests/<name>.py`` (a claimed
     gate over a deleted/renamed module covers nothing);
@@ -42,6 +42,9 @@ EXPECTED = {
     "_STATECHECK_SUITES": ("_statecheck_sanitizer", {
         "test_plan_batch", "test_pack_delta", "test_churn_storm",
         "test_lpq",
+    }),
+    "_SCHEDCHECK_SUITES": ("_schedcheck_explorer", {
+        "test_batch_worker", "test_plan_batch", "test_churn_storm",
     }),
 }
 

@@ -82,6 +82,28 @@ snapshot-isolation sanitizer nomad_tpu/statecheck.py):
                      wholesale rebuilds (statecheck check c is the
                      runtime twin)
 
+Schedule-hygiene rules (ISSUE 12, the static complement of the
+deterministic schedule explorer nomad_tpu/schedcheck.py):
+
+  join-with-timeout  no indefinite ``Thread.join()`` / ``Event.wait()``
+                     outside shutdown paths -- a wedged thread must
+                     surface as a diagnosable stall, not an invisible
+                     infinite join (and a bounded loop gives schedcheck
+                     an interposition point)
+  no-sleep-sync      tests/ may not synchronize threads via bare
+                     ``time.sleep`` in a test body (the #1 source of
+                     1-core flakes); poll loops and nested
+                     simulated-work stubs are exempt
+  daemon-declared    every repo ``threading.Thread(...)`` sets
+                     ``daemon=`` explicitly (daemon-ness inherits from
+                     the creator, so an undeclared spawn site's
+                     shutdown behavior depends on its caller)
+
+Output/maintenance flags: ``--sarif PATH`` additionally emits the kept
+violations as SARIF 2.1.0 for CI/editor annotations;
+``--fix-stale-waivers [--apply]`` deletes waiver comment lines whose
+every named rule no longer fires there (dry-run by default).
+
 Legacy checkers, invocable as rules under this driver (their
 standalone scripts keep working; tests/test_metrics_doc.py etc. are
 unchanged):
@@ -983,6 +1005,138 @@ def rule_no_snapshot_escape(ctx: Ctx) -> List[Violation]:
     return out
 
 
+# ----------------------------------------------------------------------
+# schedule-hygiene rules (ISSUE 12, the static complement of the
+# deterministic schedule explorer nomad_tpu/schedcheck.py)
+
+_SHUTDOWNISH = re.compile(
+    r"shutdown|stop|close|teardown|drain|destroy|reap|finalize|"
+    r"cleanup|__exit__|join|wait", re.IGNORECASE)
+_EVENTISH = re.compile(
+    r"(?:event|stop|stopped|done|ready|started|kill|exit)$",
+    re.IGNORECASE)
+_PROCISH = re.compile(r"(?:proc|process|popen)\w*$", re.IGNORECASE)
+
+
+def rule_join_with_timeout(ctx: Ctx) -> List[Violation]:
+    """No indefinite ``Thread.join()`` / ``Event.wait()`` outside
+    shutdown paths: an argless join/wait on a wedged thread turns one
+    stuck eval into an invisible control-plane wedge -- a bounded
+    ``while t.is_alive(): t.join(timeout=...)`` keeps the stall
+    observable (and gives schedcheck an interposition point)."""
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if _SHUTDOWNISH.search(fn.name):
+                continue            # shutdown paths may drain forever
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    continue
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and not node.args and not node.keywords):
+                    continue
+                recv = _unparse(node.func.value)
+                tail = recv.split(".")[-1]
+                if node.func.attr == "join":
+                    if _PROCISH.search(tail):
+                        continue    # subprocess reaps are not threads
+                    out.append(Violation(
+                        "join-with-timeout", rel, node.lineno,
+                        f"indefinite `{recv}.join()` outside a "
+                        f"shutdown path -- a wedged thread hangs the "
+                        f"caller invisibly; use a bounded "
+                        f"`while t.is_alive(): t.join(timeout=...)`"))
+                elif node.func.attr == "wait" and \
+                        _EVENTISH.search(tail):
+                    out.append(Violation(
+                        "join-with-timeout", rel, node.lineno,
+                        f"indefinite `{recv}.wait()` outside a "
+                        f"shutdown path -- an unset event parks the "
+                        f"caller forever; pass a timeout and re-check"))
+    return out
+
+
+def rule_no_sleep_sync(ctx: Ctx) -> List[Violation]:
+    """tests/ may not synchronize threads via bare ``time.sleep`` in a
+    test body: "sleep and hope the worker got there" is the #1 source
+    of 1-core flakes.  Poll loops (sleep inside while/for, wait_until)
+    and simulated-work stubs (sleep inside a nested def) are fine --
+    the rule flags straight-line sleeps in ``test_*`` bodies only."""
+    out: List[Violation] = []
+    tdir = os.path.join(ctx.root, "tests")
+    if not os.path.isdir(tdir):
+        return out
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".py"):
+            continue
+        rel = f"tests/{name}"
+        try:
+            with open(os.path.join(tdir, name), encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except (OSError, SyntaxError):
+            continue                # tier-1 collection owns this
+        for fn in ast.walk(tree):
+            if not (isinstance(fn, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                    and fn.name.startswith("test_")):
+                continue
+
+            def walk(node, in_loop):
+                for ch in ast.iter_child_nodes(node):
+                    if isinstance(ch, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.Lambda)):
+                        continue    # nested stubs simulate work
+                    loop = in_loop or isinstance(
+                        node, (ast.While, ast.For))
+                    if isinstance(ch, ast.Call) \
+                            and isinstance(ch.func, ast.Attribute) \
+                            and ch.func.attr == "sleep" \
+                            and _unparse(ch.func.value) \
+                            .split(".")[-1].endswith("time") \
+                            and not loop:
+                        out.append(Violation(
+                            "no-sleep-sync", rel, ch.lineno,
+                            f"bare `{_unparse(ch.func)}"
+                            f"({_unparse(ch.args[0]) if ch.args else ''})`"
+                            f" in a test body synchronizes threads by "
+                            f"wall clock -- the #1 source of 1-core "
+                            f"flakes; poll a predicate (wait_until) or "
+                            f"use an event/condition"))
+                    walk(ch, loop)
+
+            walk(fn, False)
+    return out
+
+
+def rule_daemon_declared(ctx: Ctx) -> List[Violation]:
+    """Every repo ``threading.Thread(...)`` sets ``daemon=``
+    explicitly: daemon-ness is inherited from the CREATOR by default,
+    so the same spawn site produces a process-pinning non-daemon
+    thread or a silently-killed daemon depending on who called it."""
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _unparse(node.func) in ("threading.Thread",
+                                                "Thread")):
+                continue
+            if any(k.arg == "daemon" for k in node.keywords):
+                continue
+            out.append(Violation(
+                "daemon-declared", rel, node.lineno,
+                "threading.Thread(...) without an explicit daemon= -- "
+                "daemon-ness inherits from the creator, so this spawn "
+                "site's shutdown behavior depends on who calls it"))
+    return out
+
+
 def rule_delta_carried(ctx: Ctx) -> List[Violation]:
     out: List[Violation] = []
     for rel, _text, tree in ctx.files:
@@ -1025,13 +1179,17 @@ AST_RULES = {
     "version-keyed-memo": rule_version_keyed_memo,
     "no-snapshot-escape": rule_no_snapshot_escape,
     "delta-carried": rule_delta_carried,
+    "join-with-timeout": rule_join_with_timeout,
+    "no-sleep-sync": rule_no_sleep_sync,
+    "daemon-declared": rule_daemon_declared,
 }
 # ids a violation may carry (for --rule selection and waiver matching)
 RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
             "telemetry-kind", "sleep-under-lock", "bare-acquire",
             "no-callsite-jit", "no-host-sync-hot", "dtype-threaded",
             "frozen-memo", "no-direct-table-write", "version-keyed-memo",
-            "no-snapshot-escape", "delta-carried")
+            "no-snapshot-escape", "delta-carried", "join-with-timeout",
+            "no-sleep-sync", "daemon-declared")
 
 LEGACY_RULES = ("metrics-doc", "knob-doc", "bench-regress")
 
@@ -1107,18 +1265,21 @@ def apply_waivers(root: str, violations: List[Violation],
 
 def collect_waiver_comments(root: str) -> List[Tuple[str, int, str]]:
     """Every ``nomadlint: waive=<rules>`` comment in the scanned tree
-    as (rel_path, line, rule) triples -- one per rule id the comment
+    (nomad_tpu/ + bench.py + tests/, which no-sleep-sync lints) as
+    (rel_path, line, rule) triples -- one per rule id the comment
     names."""
     out: List[Tuple[str, int, str]] = []
     scan = []
     bench = os.path.join(root, "bench.py")
     if os.path.exists(bench):
         scan.append(bench)
-    for dirpath, dirnames, filenames in os.walk(
-            os.path.join(root, "nomad_tpu")):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        scan.extend(os.path.join(dirpath, f)
-                    for f in sorted(filenames) if f.endswith(".py"))
+    for sub in ("nomad_tpu", "tests"):
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(root, sub)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            scan.extend(os.path.join(dirpath, f)
+                        for f in sorted(filenames)
+                        if f.endswith(".py"))
     for path in scan:
         rel = os.path.relpath(path, root)
         try:
@@ -1133,6 +1294,17 @@ def collect_waiver_comments(root: str) -> List[Tuple[str, int, str]]:
             for rule in m.group(1).split(","):
                 out.append((rel, i, rule))
     return out
+
+
+def _rule_scans(path: str, rule: str) -> bool:
+    """Whether ``rule`` scans ``path`` at all: a waiver can only be
+    stale where its rule could fire (tests/ is linted only by
+    no-sleep-sync; a lint-fixture string under tests/ that happens to
+    contain a waiver comment for a code rule is not a stale waiver)."""
+    in_tests = path.replace(os.sep, "/").startswith("tests/")
+    if rule == "no-sleep-sync":
+        return in_tests
+    return not in_tests
 
 
 def run_stats(root: str, rules: List[str]) -> Tuple[dict, List[tuple]]:
@@ -1160,10 +1332,85 @@ def run_stats(root: str, rules: List[str]) -> Tuple[dict, List[tuple]]:
     comments = collect_waiver_comments(root)
     used_lines = {(p, ln) for (p, ln, _r) in used}
     stale = [(p, ln, rule) for (p, ln, rule) in comments
-             if rule in rules and (p, ln) not in used_lines]
+             if rule in rules and (p, ln) not in used_lines
+             and _rule_scans(p, rule)]
     stats = {"fired": fired, "waived": waived_by_rule,
              "kept": len(kept), "waiver_comments": len(comments)}
     return stats, stale
+
+
+def to_sarif(violations: List[Violation], rules: List[str]) -> dict:
+    """SARIF 2.1.0 document for CI/editor annotation surfaces: one run,
+    one driver (nomadlint), one result per kept violation."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                    ".json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "nomadlint",
+                "informationUri":
+                    "https://github.com/nomad-tpu/nomad-tpu",
+                "rules": [{"id": r} for r in sorted(set(rules))],
+            }},
+            "results": [{
+                "ruleId": v.rule,
+                "level": "error",
+                "message": {"text": v.msg},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {
+                        "uri": v.path.replace(os.sep, "/")},
+                    "region": {"startLine": max(1, v.line)},
+                }}],
+            } for v in sorted(violations,
+                              key=lambda v: (v.path, v.line))],
+        }],
+    }
+
+
+def fix_stale_waivers(root: str, rules: List[str],
+                      apply: bool = False) -> List[Tuple[str, int]]:
+    """Delete waiver comment lines whose every named rule no longer
+    fires on their line (the --stats removable inventory).  Dry-run by
+    default: returns the (path, line) list; ``apply=True`` rewrites
+    the files.  A comment naming several rules is only removed when
+    ALL of them are stale there."""
+    _stats, stale = run_stats(root, rules)
+    stale_set = {(p, ln, r) for (p, ln, r) in stale}
+    by_line: Dict[Tuple[str, int], List[str]] = {}
+    for (p, ln, r) in collect_waiver_comments(root):
+        by_line.setdefault((p, ln), []).append(r)
+    removable = sorted(
+        (p, ln) for (p, ln), rs in by_line.items()
+        if all(r in rules and (p, ln, r) in stale_set for r in rs))
+    if not apply:
+        return removable
+    by_file: Dict[str, List[int]] = {}
+    for p, ln in removable:
+        by_file.setdefault(p, []).append(ln)
+    for p, lns in by_file.items():
+        path = os.path.join(root, p)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines(keepends=True)
+        except OSError:
+            continue
+        for ln in sorted(lns, reverse=True):
+            if not 1 <= ln <= len(lines):
+                continue
+            text = lines[ln - 1]
+            if text.lstrip().startswith("#"):
+                del lines[ln - 1]       # whole-line waiver comment
+            else:
+                # trailing waiver on a code line: strip the comment
+                lines[ln - 1] = re.sub(
+                    r"\s*#\s*nomadlint:.*$", "",
+                    text.rstrip("\n")) + (
+                        "\n" if text.endswith("\n") else "")
+        with open(path, "w", encoding="utf-8") as f:
+            f.writelines(lines)
+    return removable
 
 
 def run_ast_rules(root: str, rules: List[str]) -> Tuple[List[Violation],
@@ -1196,10 +1443,32 @@ def main(argv=None) -> int:
                    "detection (a waiver whose rule no longer fires on "
                    "its line is removable); exit 1 when stale waivers "
                    "exist")
+    p.add_argument("--sarif", metavar="PATH", default=None,
+                   help="also write the kept violations as SARIF "
+                   "2.1.0 to PATH ('-' = stdout) for CI/editor "
+                   "annotations")
+    p.add_argument("--fix-stale-waivers", action="store_true",
+                   help="delete waiver comment lines --stats flags as "
+                   "removable; DRY-RUN by default (lists them), pass "
+                   "--apply to rewrite the files")
+    p.add_argument("--apply", action="store_true",
+                   help="with --fix-stale-waivers: actually rewrite")
     p.add_argument("rest", nargs="*",
                    help="extra argv for legacy rules (bench-regress "
                    "artifact)")
     args = p.parse_args(argv)
+
+    if args.fix_stale_waivers:
+        rules = [r for r in (args.rule or list(RULE_IDS))
+                 if r in RULE_IDS]
+        removed = fix_stale_waivers(args.root, rules, apply=args.apply)
+        verb = "removed" if args.apply else "would remove (dry-run; " \
+            "pass --apply to rewrite)"
+        for path, line in removed:
+            print(f"  {path}:{line}")
+        print(f"fix-stale-waivers: {len(removed)} waiver line(s) "
+              f"{verb}")
+        return 0
 
     if args.stats:
         rules = args.rule or list(RULE_IDS)
@@ -1249,6 +1518,16 @@ def main(argv=None) -> int:
         else:
             print(f"nomadlint: AST rules clean{note} "
                   f"[{', '.join(ast_selected)}]")
+        if args.sarif:
+            import json
+            doc = to_sarif(kept, ast_selected)
+            if args.sarif == "-":
+                print(json.dumps(doc, indent=2))
+            else:
+                with open(args.sarif, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, indent=2)
+                print(f"nomadlint: SARIF written to {args.sarif} "
+                      f"({len(kept)} result(s))")
     for name in LEGACY_RULES:
         if name not in selected:
             continue

@@ -69,6 +69,7 @@ def test_monitor_endpoint_streams_and_filters(agent):
 
     t = threading.Thread(target=consume, daemon=True)
     t.start()
+    # nomadlint: waive=no-sleep-sync -- the log-broker sink attach has no observable predicate; settle before emitting
     time.sleep(0.3)          # let the sink attach
     log("debug", "test", "invisible")
     log("warn", "test", "visible")

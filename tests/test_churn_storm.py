@@ -94,7 +94,20 @@ def test_node_fanout_rides_storm_admission():
     server = Server(num_workers=0, heartbeat_ttl=60.0)
     server.start()
     try:
+        # num_workers=0 still spawns the default worker pool (0 is
+        # falsy); those workers raced the depth assertions below and
+        # won only by 1-core timing luck (schedcheck root-caused it:
+        # under a controlled schedule they dequeue first).  Stop them
+        # -- this test asserts BROKER depths, not eval processing.
+        for w in server.workers:
+            w.stop()
+        for w in server.workers:
+            while w.is_alive():
+                w.join(timeout=1.0)
         server.broker.storm_wave = 3
+        # slow the deferred release far past the test window so the
+        # delayed watcher cannot re-admit before the stats read
+        server.broker.storm_rate = 0.5
         n = mock.node()
         n.compute_class()
         server.register_node(n)

@@ -285,6 +285,7 @@ def test_client_restore_completes_after_restart(dev_server, tmp_path):
 
     # hard-stop the agent (no graceful stop of tasks), then restart
     client._shutdown.set()
+    # nomadlint: waive=no-sleep-sync -- hard-stop settle: the agent exposes no fully-stopped predicate
     time.sleep(0.2)
 
     client2 = Client(LocalServerConn(dev_server), str(tmp_path),

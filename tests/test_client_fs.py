@@ -111,6 +111,7 @@ def test_hoststats_collector_standalone():
     from nomad_tpu.client.hoststats import HostStatsCollector
     c = HostStatsCollector("/")
     first = c.collect()
+    # nomadlint: waive=no-sleep-sync -- real-time spacing between two collector samples is the subject
     time.sleep(0.05)
     second = c.collect()
     assert second["memory"]["total"] == first["memory"]["total"]

@@ -99,6 +99,7 @@ def test_missing_volume_blocks_placement(server):
     c.start()
     try:
         server.register_job(csi_job(vol_source="nonexistent"))
+        # nomadlint: waive=no-sleep-sync -- negative check: settle, then assert NO alloc went live
         time.sleep(1.0)
         assert [a for a in server.state.allocs_by_job("default", "dbjob")
                 if not a.terminal_status()] == []
@@ -125,6 +126,7 @@ def test_single_writer_volume_serializes_claims(server):
 
         # second writer: can only land on the claim-holding node
         server.register_job(csi_job(job_id="writer2"))
+        # nomadlint: waive=no-sleep-sync -- negative check: settle, then assert no wrong-node placement
         time.sleep(1.0)
         for a in server.state.allocs_by_job("default", "writer2"):
             if not a.terminal_status():

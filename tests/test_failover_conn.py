@@ -44,6 +44,7 @@ def test_failover_conn_survives_server_loss(tmp_path):
         https[current].shutdown()
         # heartbeats keep landing via another server: the node must NOT
         # go down even after several TTL windows
+        # nomadlint: waive=no-sleep-sync -- negative check over real TTL windows: the node must NOT go down
         time.sleep(2.5)
         leader = wait_for_leader(servers)
         node = leader.state.node_by_id(node_id)

@@ -184,9 +184,13 @@ def test_escaped_frame_bare_acquire():
         release.wait(5)
         lk.release()
 
-    t = threading.Thread(target=worker)
+    t = threading.Thread(target=worker, daemon=True)
     t.start()
-    time.sleep(0.05)
+    # deterministic sync (ISSUE 12 deflake): poll for the acquire to
+    # land instead of sleeping a fixed 50ms and hoping
+    deadline = time.time() + 5.0
+    while not lk.locked() and time.time() < deadline:
+        time.sleep(0.005)
     try:
         st = lockcheck.state()
         assert any(e["reason"] == "frame-exited"

@@ -206,6 +206,7 @@ def test_lpq_repair_pass_zero_capacity_violations():
         wait_until(lambda: sum(len(committed(server, j))
                                for j in jobs) >= 8,
                    msg="fleet capacity filled")
+        # nomadlint: waive=no-sleep-sync -- blocked-eval registration exposes no count to poll
         time.sleep(0.5)     # let the losers' blocked evals register
         stats = lpq.lpq_stats()
         by_node = assert_no_capacity_violation(server, jobs, 2200, 4096)

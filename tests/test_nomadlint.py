@@ -710,6 +710,191 @@ def test_store_discipline_rules_clean_on_real_tree(capsys):
 
 
 # ----------------------------------------------------------------------
+# schedule-hygiene rules (ISSUE 12)
+
+
+def test_join_with_timeout_fires_and_exempts_shutdown(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            def pump(self, t, proc):
+                t.join()                    # BAD: indefinite join
+                self._done.wait()           # BAD: indefinite event wait
+                proc.wait()                 # subprocess reap: fine
+                while t.is_alive():
+                    t.join(timeout=5.0)     # bounded: fine
+
+            def shutdown(self, t):
+                t.join()                    # shutdown path: fine
+            """,
+    })
+    kept, _ = _rules(root, ["join-with-timeout"])
+    assert len(kept) == 2, kept
+    msgs = "\n".join(v.msg for v in kept)
+    assert "t.join()" in msgs and "self._done.wait()" in msgs
+
+
+def test_no_sleep_sync_fires_in_test_body_only(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/__init__.py": "",
+        "tests/test_mod.py": """
+            import time
+
+            def test_sync_by_sleep(server):
+                server.start()
+                time.sleep(0.3)             # BAD: sleep-as-sync
+                assert server.done
+
+            def test_poll_loop_is_fine(server):
+                while not server.done:
+                    time.sleep(0.01)        # poll interval: fine
+
+            def test_nested_stub_is_fine(server):
+                def slow_commit():
+                    time.sleep(0.5)         # simulated work: fine
+                server.commit_fn = slow_commit
+
+            def helper_not_a_test():
+                time.sleep(1.0)             # not a test body
+            """,
+    })
+    kept, _ = _rules(root, ["no-sleep-sync"])
+    assert len(kept) == 1, kept
+    assert kept[0].path == "tests/test_mod.py" and kept[0].line == 6
+
+
+def test_daemon_declared_fires_without_kwarg(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)            # BAD
+                good = threading.Thread(target=fn, daemon=True)
+                also = threading.Thread(target=fn, daemon=False)
+                return t, good, also
+            """,
+    })
+    kept, _ = _rules(root, ["daemon-declared"])
+    assert len(kept) == 1
+    assert kept[0].line == 5
+
+
+def test_schedule_hygiene_rules_clean_on_real_tree(capsys):
+    """The acceptance gate for ISSUE 12's lint half: the real tree is
+    clean under all three schedule-hygiene rules (justified waivers
+    only)."""
+    assert nl.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("join-with-timeout", "no-sleep-sync",
+                 "daemon-declared"):
+        assert rule in out
+    assert nl.main(["--rule", "join-with-timeout",
+                    "--rule", "no-sleep-sync",
+                    "--rule", "daemon-declared"]) == 0, \
+        capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# --sarif (ISSUE 12 satellite)
+
+
+def test_sarif_round_trip_on_seeded_violation(tmp_path, capsys):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": """
+            import time
+
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+            """,
+    })
+    out_path = str(tmp_path / "out.sarif")
+    rc = nl.main(["--root", root, "--rule", "sleep-under-lock",
+                  "--sarif", out_path])
+    capsys.readouterr()
+    assert rc == 1
+    with open(out_path) as f:
+        doc = json.load(f)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "nomadlint"
+    assert any(r["id"] == "sleep-under-lock"
+               for r in run["tool"]["driver"]["rules"])
+    res = run["results"]
+    assert len(res) == 1
+    assert res[0]["ruleId"] == "sleep-under-lock"
+    assert res[0]["level"] == "error"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "nomad_tpu/mod.py"
+    assert loc["region"]["startLine"] == 6
+
+
+def test_sarif_clean_tree_has_no_results(tmp_path, capsys):
+    root = _tree(tmp_path, {
+        "nomad_tpu/mod.py": "def fine():\n    return 1\n",
+    })
+    out_path = str(tmp_path / "clean.sarif")
+    rc = nl.main(["--root", root, "--rule", "sleep-under-lock",
+                  "--sarif", out_path])
+    capsys.readouterr()
+    assert rc == 0
+    with open(out_path) as f:
+        doc = json.load(f)
+    assert doc["runs"][0]["results"] == []
+
+
+# ----------------------------------------------------------------------
+# --fix-stale-waivers (ISSUE 12 satellite)
+
+_WAIVER_TREE = {
+    "nomad_tpu/mod.py": """
+        import time
+
+        def live(lock):
+            with lock:
+                # nomadlint: waive=sleep-under-lock -- fixture
+                time.sleep(1)
+
+        def stale(x):
+            # nomadlint: waive=sleep-under-lock -- nothing here
+            return x
+
+        def half_stale(lock):
+            with lock:
+                # nomadlint: waive=sleep-under-lock,bare-acquire -- x
+                time.sleep(2)
+        """,
+}
+
+
+def test_fix_stale_waivers_dry_run_lists_only(tmp_path, capsys):
+    root = _tree(tmp_path, _WAIVER_TREE)
+    before = (tmp_path / "nomad_tpu/mod.py").read_text()
+    rc = nl.main(["--root", root, "--fix-stale-waivers"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dry-run" in out and "nomad_tpu/mod.py:10" in out
+    assert "1 waiver line(s)" in out
+    # the tree is untouched
+    assert (tmp_path / "nomad_tpu/mod.py").read_text() == before
+
+
+def test_fix_stale_waivers_apply_rewrites(tmp_path, capsys):
+    root = _tree(tmp_path, _WAIVER_TREE)
+    rc = nl.main(["--root", root, "--fix-stale-waivers", "--apply"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "removed" in out
+    text = (tmp_path / "nomad_tpu/mod.py").read_text()
+    # the stale waiver line is gone; the live one (still suppressing a
+    # sleep-under-lock) and the half-stale multi-rule one survive
+    assert text.count("nomadlint: waive=") == 2
+    assert "nothing here" not in text
+    # idempotent + the tree still lints the same
+    kept, waived = _rules(root, ["sleep-under-lock"])
+    assert kept == [] and waived == 2
+
+
+# ----------------------------------------------------------------------
 # --stats (ISSUE 11 satellite)
 
 

@@ -227,6 +227,7 @@ def test_bad_node_tracker_prunes_expired_windows():
     for i in range(200):
         tr.add(f"bn-node-{i:04d}")
     assert len(tr._hits) == 200
+    # nomadlint: waive=no-sleep-sync -- the tracker's real-time expiry window is the subject
     time.sleep(0.06)
     # any add() past the window sweeps the whole dict
     tr.add("bn-node-fresh")
@@ -236,6 +237,7 @@ def test_bad_node_tracker_prunes_expired_windows():
     tr2 = BadNodeTracker(threshold=3, window=0.05)
     assert tr2.add("bn-a") is False
     assert tr2.score("bn-a") == 1
+    # nomadlint: waive=no-sleep-sync -- the tracker's real-time expiry window is the subject
     time.sleep(0.06)
     assert tr2.score("bn-a") == 0
     assert "bn-a" not in tr2._hits
@@ -244,6 +246,7 @@ def test_bad_node_tracker_prunes_expired_windows():
     # accumulate a node into 'bad'
     tr3 = BadNodeTracker(threshold=2, window=0.05)
     assert tr3.add("bn-b") is False
+    # nomadlint: waive=no-sleep-sync -- the tracker's real-time expiry window is the subject
     time.sleep(0.06)
     assert tr3.add("bn-b") is False   # first hit expired
     assert tr3.add("bn-b") is True

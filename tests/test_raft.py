@@ -319,6 +319,7 @@ def test_cluster_persistence(tmp_path):
     finally:
         for s in servers:
             s.shutdown()
+    # nomadlint: waive=no-sleep-sync -- socket teardown settle before rebind; no predicate exposed
     time.sleep(0.2)
 
     # restart from the WALs: state must recover without the network

@@ -1,7 +1,8 @@
-"""check_sanitizer_gates gate (ISSUE 11 satellite): the three conftest
-sanitizer fixtures (lockcheck / jitcheck / statecheck) cover exactly
-the suites the pinned inventory claims, every claimed suite module
-exists, and drift in any direction fails loudly.
+"""check_sanitizer_gates gate (ISSUE 11 satellite; ISSUE 12 added the
+fourth gate): the four conftest sanitizer fixtures (lockcheck /
+jitcheck / statecheck / schedcheck) cover exactly the suites the
+pinned inventory claims, every claimed suite module exists, and drift
+in any direction fails loudly.
 """
 import importlib.util
 import os
@@ -24,14 +25,18 @@ def test_real_conftest_gates_in_place(capsys):
 
 
 def test_inventory_is_pinned():
-    """The EXPECTED inventory names all three sanitizers; growing a
-    fourth (or renaming one) is a reviewed change here too."""
+    """The EXPECTED inventory names all four sanitizers; growing a
+    fifth (or renaming one) is a reviewed change here too."""
     assert set(csg.EXPECTED) == {
-        "_LOCKCHECK_SUITES", "_JITCHECK_SUITES", "_STATECHECK_SUITES"}
+        "_LOCKCHECK_SUITES", "_JITCHECK_SUITES", "_STATECHECK_SUITES",
+        "_SCHEDCHECK_SUITES"}
     # statecheck covers the ISSUE-11 suites
     assert csg.EXPECTED["_STATECHECK_SUITES"][1] == {
         "test_plan_batch", "test_pack_delta", "test_churn_storm",
         "test_lpq"}
+    # the schedule explorer covers the ISSUE-12 suites
+    assert csg.EXPECTED["_SCHEDCHECK_SUITES"][1] == {
+        "test_batch_worker", "test_plan_batch", "test_churn_storm"}
 
 
 def _fake_conftest(tmp_path, body):
@@ -52,6 +57,9 @@ _STATECHECK_SUITES = {
     "test_plan_batch", "test_pack_delta", "test_churn_storm",
     "test_lpq",
 }
+_SCHEDCHECK_SUITES = {
+    "test_batch_worker", "test_plan_batch", "test_churn_storm",
+}
 
 
 def _lockcheck_sanitizer(request):
@@ -64,6 +72,10 @@ def _jitcheck_sanitizer(request):
 
 def _statecheck_sanitizer(request):
     return request in _STATECHECK_SUITES
+
+
+def _schedcheck_explorer(request):
+    return request in _SCHEDCHECK_SUITES
 """
 
 
@@ -87,9 +99,9 @@ def test_dropped_suite_fails(tmp_path, capsys):
 
 
 def test_missing_suite_module_fails(tmp_path, capsys):
-    body = _OK_STUB.replace('"test_lpq",\n}\n\n\ndef _lockcheck',
-                            '"test_lpq", "test_never_written",\n}'
-                            '\n\n\ndef _lockcheck')
+    body = _OK_STUB.replace(
+        '"test_lpq",\n}\n_SCHEDCHECK',
+        '"test_lpq", "test_never_written",\n}\n_SCHEDCHECK')
     path = _fake_conftest(tmp_path, body)
     assert csg.main(["--conftest", path,
                      "--tests-dir", os.path.join(ROOT, "tests")]) == 1

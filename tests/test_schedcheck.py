@@ -1,0 +1,461 @@
+"""Deterministic schedule explorer tests (ISSUE 12 tentpole): the
+kill-switch path must be a true no-op (Thread/Event/queue/time.sleep
+untouched, no controller observable), enabled runs must be bit-for-bit
+identical to disabled ones on a real dispatch + plan-commit cycle, the
+same seed must produce the same schedule fingerprint, and THE gauntlet:
+the planted write-skew and planted torn read are each found within
+<=64 explored schedules, `replay` of the reported seed reproduces the
+identical violation witness twice in a row, and 200 uncontrolled runs
+find nothing.  Plus the ISSUE-12 satellites: schedcheck+lockcheck
+co-enablement yields ONE wrapped lock layer in either order, `operator
+sanitizers` aggregates all four checkers with the exit-code matrix,
+and the per-thread id streams pin the deflake root cause.
+
+Kill-switch knob under test: NOMAD_TPU_SCHEDCHECK (and the seed knob
+NOMAD_TPU_SCHEDCHECK_SEED).
+"""
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import lockcheck, mock, schedcheck, statecheck
+
+
+@pytest.fixture(autouse=True)
+def _clean_checker():
+    """Every test leaves the real entry points restored and all
+    checker state empty, pass or fail."""
+    yield
+    schedcheck.disable()
+    schedcheck._reset_for_tests()
+    lockcheck.disable()
+    lockcheck._reset_for_tests()
+    statecheck.disable()
+    statecheck._reset_for_tests()
+
+
+# ----------------------------------------------------------------------
+# kill switch + parity
+
+
+def test_killswitch_is_inert(monkeypatch):
+    """NOMAD_TPU_SCHEDCHECK=0 (or unset) is a true no-op: the stdlib
+    entry points are the raw functions and no controller exists."""
+    monkeypatch.setenv("NOMAD_TPU_SCHEDCHECK", "0")
+    schedcheck.maybe_install_from_env()
+    assert not schedcheck.enabled()
+    assert threading.Thread.start is schedcheck._REAL_THREAD_START
+    assert threading.Thread.join is schedcheck._REAL_THREAD_JOIN
+    assert threading.Event.wait is schedcheck._REAL_EVENT_WAIT
+    assert threading.Event.set is schedcheck._REAL_EVENT_SET
+    assert time.sleep is schedcheck._REAL_SLEEP
+    st = schedcheck.state()
+    assert st["enabled"] is False and st["runs"] == 0
+    assert schedcheck.witness() is None
+    schedcheck.yield_point("off")        # inert, no controller
+    assert schedcheck.state()["decisions"] == 0
+
+
+def test_env_knob_installs(monkeypatch):
+    monkeypatch.setenv("NOMAD_TPU_SCHEDCHECK", "1")
+    monkeypatch.setenv("NOMAD_TPU_SCHEDCHECK_SEED", "7")
+    schedcheck.maybe_install_from_env()
+    assert schedcheck.enabled()
+    st = schedcheck.state()
+    assert st["run_active"] and st["seed"] == 7
+    assert threading.Thread.start is not schedcheck._REAL_THREAD_START
+    # and disable restores the raw entry points for everyone after us
+    schedcheck.disable()
+    assert threading.Thread.start is schedcheck._REAL_THREAD_START
+    assert time.sleep is schedcheck._REAL_SLEEP
+    assert queue.Queue.get is schedcheck._REAL_QUEUE_GET
+
+
+def test_enabled_cycle_is_bitwise_identical():
+    """The acceptance parity gate: the same dispatch + plan-commit
+    cycle under a controlled run returns bit-for-bit what the raw path
+    returns (the controller only orders threads; it never touches
+    values, and the dispatch watchdog keeps real-time semantics)."""
+    from test_statecheck import _dispatch_and_commit
+
+    off_solved, off_nodes, off_idx = _dispatch_and_commit(i=0)
+    schedcheck.enable()
+    schedcheck.begin_run(seed=3)
+    try:
+        on_solved, on_nodes, on_idx = _dispatch_and_commit(i=0)
+        st = schedcheck.state()
+    finally:
+        schedcheck.end_run()
+        schedcheck.disable()
+    assert off_nodes == on_nodes and off_idx == on_idx
+    for a, b in zip(off_solved, on_solved):
+        np.testing.assert_array_equal(a, b)
+    assert st["run_active"] and st["deadlock_count"] == 0
+
+
+# ----------------------------------------------------------------------
+# controller determinism
+
+
+def test_same_seed_same_fingerprint():
+    """Same seed => bit-identical thread schedule: the decision-trace
+    fingerprint is reproducible run-to-run."""
+    r1 = schedcheck.run_schedule(schedcheck.scenario_broker_smoke, 5)
+    r2 = schedcheck.run_schedule(schedcheck.scenario_broker_smoke, 5)
+    assert r1.decisions > 0
+    assert r1.fingerprint == r2.fingerprint
+    assert r1.decisions == r2.decisions
+    assert r1.violations == [] and r2.violations == []
+
+
+def test_all_policies_run_clean_smoke():
+    for policy in ("random", "pct", "rr"):
+        res = schedcheck.run_schedule(
+            schedcheck.scenario_broker_smoke, 1, policy=policy)
+        assert res.violations == [], (policy, res.violations)
+        assert res.decisions > 0
+
+
+# ----------------------------------------------------------------------
+# THE gauntlet (acceptance criteria)
+
+
+def test_gauntlet_write_skew_found_within_64_schedules():
+    res = schedcheck.explore(
+        schedcheck.scenario_planted_write_skew, seeds=64)
+    seeds = res.seeds_with_violations
+    assert seeds, "planted write-skew not found in 64 schedules"
+    assert min(seeds) < 64
+    v = [v for v in res.violations if v["kind"] == "write_skew"]
+    assert v, res.violations
+    assert v[0]["schedule"]["schedule_seed"] in seeds
+    assert v[0]["schedule"]["step"] > 0
+
+
+def test_gauntlet_torn_read_found_within_64_schedules():
+    res = schedcheck.explore(
+        schedcheck.scenario_planted_torn_read, seeds=64)
+    seeds = res.seeds_with_violations
+    assert seeds, "planted torn read not found in 64 schedules"
+    v = [v for v in res.violations if v["kind"] == "torn_read"]
+    assert v, res.violations
+    assert v[0]["schedule"]["schedule_seed"] in seeds
+
+
+def test_gauntlet_uncontrolled_runs_find_nothing():
+    """200 uncontrolled runs of each planted scenario: the racy
+    windows are microseconds wide and thread-spawn serialized -- the
+    OS scheduler never splits them (which is WHY schedcheck exists).
+    GIL preemption is pinned down for the sweep so the baseline is
+    honest about what free-running threads explore on this host."""
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(10.0)
+    statecheck.enable()
+    try:
+        for _ in range(200):
+            schedcheck.scenario_planted_write_skew()
+            schedcheck.scenario_planted_torn_read()
+        st = statecheck.state()
+    finally:
+        sys.setswitchinterval(old)
+        statecheck.disable()
+    assert st["write_skew_count"] == 0, st["write_skews"]
+    assert st["torn_read_count"] == 0, st["torn_reads"]
+
+
+def test_gauntlet_replay_reproduces_identical_witness_twice():
+    """--replay of the reported seed reproduces the identical
+    violation witness twice in a row (the acceptance replay gate)."""
+    for scenario, kind, fields in (
+            (schedcheck.scenario_planted_write_skew, "write_skew",
+             ("node", "plans")),
+            (schedcheck.scenario_planted_torn_read, "torn_read",
+             ("op", "versions"))):
+        res = schedcheck.explore(scenario, seeds=64)
+        assert res.seeds_with_violations, kind
+        seed = res.seeds_with_violations[0]
+        first = schedcheck.replay(scenario, seed)
+        second = schedcheck.replay(
+            scenario, seed, expect_fingerprint=first.fingerprint)
+
+        def witness(run):
+            return [(v["kind"],) + tuple(str(v.get(f)) for f in fields)
+                    for v in run.violations if v["kind"] == kind]
+
+        assert witness(first), (kind, first.violations)
+        assert witness(first) == witness(second)
+        assert first.fingerprint == second.fingerprint
+        assert schedcheck.state()["divergence_count"] == 0
+
+
+def test_replay_divergence_detected():
+    """Replaying a seed against a CHANGED scenario diverges: the
+    fingerprint mismatch is counted and reported."""
+    base = schedcheck.run_schedule(
+        schedcheck.scenario_planted_write_skew, 2)
+    schedcheck.replay(schedcheck.scenario_planted_torn_read, 2,
+                      expect_fingerprint=base.fingerprint)
+    st = schedcheck.state()
+    assert st["divergence_count"] == 1
+    rep = [r for r in st["reports"] if r["kind"] == "divergence"]
+    assert rep and rep[0]["expected"] == base.fingerprint
+
+
+# ----------------------------------------------------------------------
+# manifested deadlocks
+
+
+def _scenario_event_deadlock():
+    """Two threads each waiting (untimed) for the OTHER to signal: a
+    textbook circular wait the controller manifests and reports."""
+    e1, e2 = threading.Event(), threading.Event()
+
+    def a():
+        e1.wait()
+        e2.set()
+
+    def b():
+        e2.wait()
+        e1.set()
+
+    threads = [threading.Thread(target=a, daemon=True, name="dl-a"),
+               threading.Thread(target=b, daemon=True, name="dl-b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        while t.is_alive():
+            t.join(timeout=5.0)
+
+
+def test_deadlock_manifested_and_replayable():
+    res = schedcheck.run_schedule(_scenario_event_deadlock, 1)
+    dl = [v for v in res.violations if v["kind"] == "deadlock"]
+    assert dl, res.violations
+    st = schedcheck.state()
+    assert st["deadlock_count"] >= 1
+    rep = [r for r in st["reports"] if r["kind"] == "deadlock"]
+    assert rep
+    assert rep[0]["schedule_seed"] == 1
+    waiting = {w["thread"] for w in rep[0]["waiting"]}
+    assert {"dl-a", "dl-b"} & waiting
+    assert rep[0]["trace_tail"]
+
+
+# ----------------------------------------------------------------------
+# co-enablement: one wrapped lock layer in either enable order
+
+
+def _assert_single_layer():
+    lk = threading.Lock()
+    assert type(lk).__name__ == "_LockWrapper", type(lk)
+    # the inner primitive is RAW -- not a second wrapper layer
+    assert not hasattr(lk._lc_inner, "_lc_inner"), lk._lc_inner
+    cv = threading.Condition()
+    assert type(cv).__name__ == "_InstrumentedCondition", type(cv)
+    assert not hasattr(cv._lock._lc_inner, "_lc_inner")
+
+
+def test_coenable_lockcheck_then_schedcheck_single_layer():
+    lockcheck.enable()
+    schedcheck.enable()
+    schedcheck.begin_run(seed=0)
+    _assert_single_layer()
+
+
+def test_coenable_schedcheck_then_lockcheck_single_layer():
+    schedcheck.enable()
+    schedcheck.begin_run(seed=0)
+    lockcheck.enable()
+    _assert_single_layer()
+
+
+def test_violation_reports_carry_schedule_witness():
+    """lockcheck cycles recorded during a controlled run carry the
+    schedule witness (the counterexample hook)."""
+    lockcheck.enable()
+    schedcheck.enable()
+    schedcheck.begin_run(seed=9)
+    a, b = threading.Lock(), threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    st = lockcheck.state()
+    assert st["cycle_count"] == 1
+    sched = st["cycles"][0]["schedule"]
+    assert sched and sched["schedule_seed"] == 9
+    # consume the expected finding so the autouse cleanup is quiet
+    schedcheck.end_run()
+
+
+# ----------------------------------------------------------------------
+# per-thread id streams (deflake satellite)
+
+
+def test_per_thread_id_streams_are_interleaving_independent():
+    """The deflake pin: each thread's k-th draw depends only on (base
+    seed, thread name), never on how draws interleave across
+    threads."""
+    from nomad_tpu.structs.job import generate_uuid, reseed_ids
+
+    def draws_in_thread(name, n):
+        out = []
+
+        def run():
+            out.extend(generate_uuid() for _ in range(n))
+
+        t = threading.Thread(target=run, name=name, daemon=True)
+        t.start()
+        t.join()
+        return out
+
+    reseed_ids(42)
+    main_first = [generate_uuid() for _ in range(3)]
+    thread_after = draws_in_thread("stream-probe", 3)
+
+    # reversed interleaving: thread draws before main does
+    reseed_ids(42)
+    thread_before = draws_in_thread("stream-probe", 3)
+    main_second = [generate_uuid() for _ in range(3)]
+
+    assert main_first == main_second
+    assert thread_after == thread_before
+    assert set(main_first).isdisjoint(thread_after)
+    # distinct thread names get distinct streams
+    reseed_ids(42)
+    other = draws_in_thread("stream-other", 3)
+    assert other != thread_before
+
+
+def test_reseed_keeps_single_thread_stream_stable():
+    from nomad_tpu.structs.job import generate_uuid, reseed_ids
+
+    reseed_ids(7)
+    a = [generate_uuid() for _ in range(4)]
+    reseed_ids(7)
+    b = [generate_uuid() for _ in range(4)]
+    assert a == b
+
+
+# ----------------------------------------------------------------------
+# surfaces: CLI replay/explore, agent self, sanitizers matrix
+
+
+def test_operator_schedcheck_cli_replay_and_explore(capsys):
+    from nomad_tpu import cli
+
+    res = schedcheck.explore(
+        schedcheck.scenario_planted_write_skew, seeds=64)
+    seed = res.seeds_with_violations[0]
+    rc = cli.main(["operator", "schedcheck", "--replay", str(seed),
+                   "--scenario", "planted-write-skew"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "write_skew" in out and f"seed         = {seed}" in out
+
+    rc = cli.main(["operator", "schedcheck", "--explore", "2",
+                   "--scenario", "broker-smoke"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "explored" in out
+
+    rc = cli.main(["operator", "schedcheck", "--replay", "0",
+                   "--scenario", "no-such-scenario"])
+    assert rc == 2
+    assert "unknown scenario" in capsys.readouterr().out
+
+
+def test_agent_self_and_sanitizers_matrix(capsys):
+    """stats.schedcheck rides /v1/agent/self; `operator sanitizers`
+    shows all FOUR checkers and the exit-code matrix holds: every
+    checker enabled and clean = 0, any hard class = 1."""
+    from nomad_tpu import cli, jitcheck
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.server import Server
+
+    server = Server(num_workers=1, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        st = ApiClient(base).get(
+            "/v1/agent/self")["stats"]["schedcheck"]
+        assert st["enabled"] is False and st["reports"] == []
+
+        # all four enabled at once, clean -> exit 0
+        lockcheck.enable()
+        jitcheck.enable()
+        statecheck.enable()
+        schedcheck.enable()
+        try:
+            assert cli.main(["-address", base,
+                             "operator", "sanitizers"]) == 0
+            out = capsys.readouterr().out
+            for name in ("lockcheck", "jitcheck", "statecheck",
+                         "schedcheck"):
+                assert name in out
+            assert "FAIL" not in out
+
+            # any hard class -> exit 1 (seed a torn read)
+            s = server.state
+            n = mock.node()
+            s.upsert_node(n)
+            job = mock.job(id="matrix-job")
+            s.upsert_allocs([mock.alloc_for(job, n)])
+            with statecheck.strict_scope("matrix.verify"):
+                with s._lock:
+                    s.alloc_table.fold_verify([n.id])
+                s.upsert_allocs([mock.alloc_for(job, n, index=1)])
+                with s._lock:
+                    s.alloc_table.fold_verify([n.id])
+            rc = cli.main(["-address", base, "operator", "sanitizers"])
+            out = capsys.readouterr().out
+            assert rc == 1 and "FAIL" in out
+        finally:
+            jitcheck.disable()
+            jitcheck._reset_for_tests()
+
+        # schedcheck hard class alone also exits 1
+        statecheck._reset_for_tests()
+        schedcheck.run_schedule(_scenario_event_deadlock, 0)
+        rc = cli.main(["-address", base, "operator", "sanitizers"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "deadlocks=1" in out
+
+        rc = cli.main(["-address", base, "operator", "schedcheck"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "DEADLOCK" in out and "--replay 0" in out
+    finally:
+        http.shutdown()
+        server.shutdown()
+
+
+def test_debug_bundle_contains_schedcheck_json(tmp_path):
+    from nomad_tpu import cli
+    from nomad_tpu.api.http import HttpServer
+    from nomad_tpu.server import Server
+    import tarfile
+
+    server = Server(num_workers=0, heartbeat_ttl=30.0)
+    server.start()
+    http = HttpServer(server, port=0)
+    http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    out = str(tmp_path / "bundle.tgz")
+    try:
+        assert cli.main(["-address", base, "operator", "debug",
+                         "-duration", "0.2", "-output", out]) == 0
+        with tarfile.open(out) as tar:
+            names = [m.name.split("/", 1)[1] for m in tar.getmembers()]
+        assert "schedcheck.json" in names
+    finally:
+        http.shutdown()
+        server.shutdown()

@@ -74,6 +74,7 @@ def test_batch_job_runs_to_completion(cluster):
         if a.client_status == ALLOC_CLIENT_COMPLETE]) == 3,
         msg="batch allocs complete")
     # completed batch allocs are NOT replaced
+    # nomadlint: waive=no-sleep-sync -- negative check: settle, then assert completed allocs were NOT replaced
     time.sleep(0.5)
     allocs = server.state.allocs_by_job(job.namespace, job.id)
     assert len(allocs) == 3
@@ -401,6 +402,7 @@ def test_canary_never_shrinks_old_version(cluster):
 
     wait_until(lambda: len(canaries()) == 1, msg="canary running")
     # let several eval/watcher rounds pass; the v0 alloc must survive
+    # nomadlint: waive=no-sleep-sync -- negative check: settle, then assert the v0 alloc survived
     time.sleep(1.0)
     v0 = [a for a in running_allocs(server, job2) if a.job_version == 0]
     assert len(v0) == 1, [(a.job_version, a.name, a.client_status)
@@ -501,6 +503,7 @@ def test_eval_broker_pause_resume(cluster):
     job.task_groups[0].count = 1
     job.task_groups[0].tasks[0].config = {}
     server.register_job(job)
+    # nomadlint: waive=no-sleep-sync -- negative check: settle, then assert nothing scheduled while paused
     time.sleep(0.6)
     assert not running_allocs(server, job), "scheduled while paused"
     server.apply_scheduler_config(

@@ -123,6 +123,7 @@ def test_consul_provider_not_in_catalog(server):
         assert wait(lambda: [
             a for a in server.state.allocs_by_job("default", "legacy")
             if not a.terminal_status()])
+        # nomadlint: waive=no-sleep-sync -- negative check: settle, then assert services were NOT registered
         time.sleep(0.3)
         assert server.state.services_by_name("default", "legacy-svc") == []
     finally:

@@ -187,6 +187,7 @@ def test_http_ndjson_stream(server):
 
         t = threading.Thread(target=consume, daemon=True)
         t.start()
+        # nomadlint: waive=no-sleep-sync -- the event subscription attach has no observable predicate
         time.sleep(0.3)          # let the subscription attach
         server.register_job(mock.job(id="s1"))
         server.register_node(mock.node())     # filtered out

@@ -77,6 +77,7 @@ def test_series_ring_buffer_wraparound():
 def test_measure_context_manager():
     t = Telemetry()
     with t.measure("block"):
+        # nomadlint: waive=no-sleep-sync -- simulated work: the measured duration is the subject
         time.sleep(0.01)
     s = t.snapshot()["samples"]["block"]
     assert s["count"] == 1

@@ -33,6 +33,7 @@ def test_begin_span_end_roundtrip():
     ctx = tracer.begin("ev-1", job="j1", lane="service")
     with tracer.activate(ctx):
         with tracer.span("stage.a", step=1):
+            # nomadlint: waive=no-sleep-sync -- simulated work: the measured span duration is the subject
             time.sleep(0.01)
         with tracer.span("stage.b", ctx=ctx):
             pass
@@ -273,6 +274,7 @@ def test_http_trace_surface():
     _finish("h-deg")
     ctx = tracer.begin("h-ok")
     with tracer.span("stage.a", ctx=ctx):
+        # nomadlint: waive=no-sleep-sync -- simulated work: the measured span duration is the subject
         time.sleep(0.01)
     _finish("h-ok")
 
