@@ -1008,10 +1008,13 @@ def main_tier(platform: str, tier: int):
     # tunnel or tripped breaker must never read as a chip result
     from nomad_tpu.benchkit import (
         artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
-        statecheck_stamp)
+        statecheck_stamp, xferobs_stamp)
     out.update(dispatch_health_stamp(platform))
     out.update(jitcheck_stamp())
     out.update(statecheck_stamp())
+    # transfer ledger + tunnel-model fields (ISSUE 13): byte parity and
+    # per-dispatch payload are gated per round like the sanitizers
+    out.update(xferobs_stamp())
     out.update(artifact_stamp())
     out["trace_artifact"] = _export_trace_artifact(
         default=f"BENCH_trace_tier{tier}.json")
@@ -1429,12 +1432,17 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # explicit degraded verdict + dispatch-layer state
     from nomad_tpu.benchkit import (
         artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
-        statecheck_stamp)
+        statecheck_stamp, xferobs_stamp)
     out.update(dispatch_health_stamp(platform))
     # dispatch discipline (ISSUE 10): retraces/host syncs/x64 leaks
     # observed this run, gated by scripts/check_bench_regress.py
     out.update(jitcheck_stamp())
     out.update(statecheck_stamp())
+    # transfer ledger + tunnel-model fields (ISSUE 13): payload bytes
+    # decomposed per dispatch, byte parity vs dispatch_bytes_total
+    # (must be 0), and the live rtt/bandwidth fit -- the r05 manual
+    # tunnel diagnosis as a standing, regress-gated readout
+    out.update(xferobs_stamp())
     # quality scoreboard + per-stage saturation from the headline e2e
     # server (ISSUE 7): quality_fragmentation / quality_drift /
     # stage_busy_pct_* so solver changes are judged on placement

@@ -904,6 +904,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                 # solver_guard block is TPU-native: a degraded backend
                 # must be visible to operators, VERDICT r4 weak #5)
                 from ..solver import guard as solver_guard
+                from ..solver import xferobs as _xferobs
                 from .. import jitcheck as _jitcheck
                 from .. import lockcheck as _lockcheck
                 from .. import schedcheck as _schedcheck
@@ -925,6 +926,12 @@ class ApiHandler(BaseHTTPRequestHandler):
                             if raft is not None else "true",
                         },
                         "solver_guard": solver_guard.state(),
+                        # transfer & device-residency observatory
+                        # (solver/xferobs.py): per-dispatch payload
+                        # ledger by tree group, const-cache residency
+                        # map, live tunnel-model fit;
+                        # {"enabled": False} under the kill switch
+                        "xferobs": _xferobs.state(),
                         # flap damping: per-node flap scores + active
                         # quarantines (ISSUE 6), exposed like the
                         # breaker state so a quarantined fleet is

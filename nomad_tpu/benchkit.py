@@ -130,6 +130,18 @@ def statecheck_stamp() -> dict:
     }
 
 
+def xferobs_stamp() -> dict:
+    """Transfer-observatory artifact fields (ISSUE 13): ledger byte
+    decomposition totals, byte parity vs the dispatch_bytes counter
+    (must be 0), and the live tunnel-model fit -- so payload-bytes
+    regressions and link-model drift are gated per round
+    (scripts/check_bench_regress.py direction rows) instead of
+    rediscovered by manual capture."""
+    from .solver import xferobs
+
+    return xferobs.bench_fields()
+
+
 def artifact_stamp(repo_root: Optional[str] = None) -> dict:
     """Provenance stamp for every bench artifact so trend tooling can
     line BENCH_rNN.json files up without guessing (ISSUE 7 satellite):
@@ -202,12 +214,19 @@ def export_chrome_trace(path: str) -> "str | None":
     import json
 
     from .server.tracing import trace_enabled, tracer
+    from .solver import xferobs
 
     if not trace_enabled():
         return None
     doc = tracer.chrome_trace()
     if not doc["traceEvents"]:
+        # no retained eval spans -> no artifact (the counter tracks
+        # annotate the span view; they are not a trace by themselves)
         return None
+    # Perfetto counter tracks (ISSUE 13): shipped bytes / resident
+    # bytes / in-flight depth per retained dispatch record, rendered as
+    # counter lanes under the eval spans
+    doc["traceEvents"].extend(xferobs.counter_events())
     try:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(doc, f)

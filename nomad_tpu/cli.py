@@ -697,6 +697,14 @@ def cmd_operator_debug(args) -> int:
             captures["agent-self.json"]["stats"]["schedcheck"])
     except Exception as e:  # noqa: BLE001 -- partial bundles beat none
         captures["schedcheck.json"] = {"capture_error": repr(e)}
+    # transfer ledger + residency map + tunnel fit as their own member:
+    # the byte decomposition belongs next to metrics.json when an
+    # operator is untangling a slow or bloated dispatch path (ISSUE 13)
+    try:
+        captures["xferobs.json"] = (
+            captures["agent-self.json"]["stats"]["xferobs"])
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["xferobs.json"] = {"capture_error": repr(e)}
     grab("autopilot-health.json", "/v1/operator/autopilot/health")
     grab("nodes.json", "/v1/nodes")
     grab("jobs.json", "/v1/jobs")
@@ -1085,6 +1093,72 @@ def cmd_operator_sanitizers(args) -> int:
         print("(all sanitizers disabled: set NOMAD_TPU_LOCKCHECK/"
               "JITCHECK/STATECHECK/SCHEDCHECK=1 to record)")
     return rc
+
+
+def cmd_operator_transfers(args) -> int:
+    """Transfer & device-residency observatory (rides /v1/agent/self
+    stats.xferobs): the per-dispatch payload ledger decomposed by tree
+    group (shipped vs cache-resident bytes), the sanctioned-fetch
+    result-byte table, the const-cache residency map (per-entry
+    bytes/version/age/hits + high watermark), and the live tunnel-model
+    fit (rtt/bandwidth/crossover). Exit 1 when the ledger's byte parity
+    against nomad.solver.dispatch_bytes_total is nonzero."""
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("xferobs") or {}
+    if not st.get("enabled", False):
+        print("transfer observatory disabled (NOMAD_TPU_XFEROBS=0)")
+        return 0
+
+    def mb(n):
+        return f"{(n or 0) / 1048576.0:.3f}"
+
+    for k in ("dispatches", "shipped_bytes_total",
+              "resident_bytes_total", "fetched_bytes_total",
+              "counter_mirror_bytes", "parity_bytes"):
+        print(f"{k:22s} = {st.get(k)}")
+    groups = st.get("groups") or {}
+    if groups:
+        print()
+        print(_fmt_table(
+            [[g, mb(d["shipped_bytes"]), mb(d["resident_bytes"]),
+              str(d["shipped_arrays"]), str(d["resident_arrays"])]
+             for g, d in sorted(groups.items())],
+            ["Group", "Shipped(MB)", "Resident(MB)", "Ships", "Hits"]))
+    fetches = st.get("fetches") or {}
+    if fetches:
+        print()
+        print(_fmt_table(
+            [[g, mb(d["bytes"]), str(d["fetches"])]
+             for g, d in sorted(fetches.items())],
+            ["Fetch", "Bytes(MB)", "Count"]))
+    fit = st.get("tunnel")
+    print()
+    if fit:
+        bw = fit.get("bw_mbps")
+        xo = fit.get("crossover_bytes")
+        print(f"tunnel fit: rtt={fit.get('rtt_ms')}ms "
+              f"bw={bw if bw is not None else '?'}MB/s "
+              f"samples={fit.get('samples')} "
+              f"residual={fit.get('residual_rms_ms')}ms"
+              + (f" crossover={xo}B" if xo is not None else "")
+              + (f" (skipped {fit.get('skipped_slow')} compile-slow)"
+                 if fit.get("skipped_slow") else ""))
+    else:
+        print("tunnel fit: insufficient samples")
+    res = st.get("residency") or {}
+    if res:
+        print(f"residency: {res.get('entries')} pinned entries, "
+              f"{mb(res.get('resident_bytes'))}MB resident "
+              f"(hwm {mb(res.get('resident_hwm_bytes'))}MB, "
+              f"{res.get('evictions')} evictions, "
+              f"{res.get('invalidations')} invalidations)")
+        top = res.get("top") or []
+        if top:
+            print(_fmt_table(
+                [[e["id"], mb(e["bytes"]), str(e.get("version")),
+                  f"{e['age_s']:.0f}", str(e["hits"])] for e in top],
+                ["Entry", "MB", "Version", "Age(s)", "Hits"]))
+    return 1 if st.get("parity_bytes") else 0
 
 
 def _render_trace_waterfall(tr: dict, width: int = 48) -> str:
@@ -1588,6 +1662,10 @@ def build_parser() -> argparse.ArgumentParser:
     ojc.add_argument("--sites", action="store_true",
                      help="print the per-call-site trace table")
     ojc.set_defaults(fn=cmd_operator_jitcheck)
+    otx = op.add_parser("transfers",
+                        help="transfer ledger + device-residency map "
+                        "+ live tunnel-model fit (xferobs)")
+    otx.set_defaults(fn=cmd_operator_transfers)
     otr = op.add_parser("trace",
                         help="eval span-waterfall forensics")
     otr.add_argument("eval_id", nargs="?", default="")

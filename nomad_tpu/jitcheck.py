@@ -123,6 +123,9 @@ _counters = {"jits": 0, "calls": 0, "traces": 0, "retraces": 0,
              "host_syncs": 0, "sanctioned_fetches": 0, "x64_leaks": 0,
              "weak_scalars": 0, "mutations": 0, "reports_dropped": 0,
              "sigs_dropped": 0}
+# sanctioned-fetch counts by ledger tag (the fetch-accounted tags the
+# xferobs fetch decomposition uses)
+_sanct_tags: Dict[str, int] = {}
 
 _tls = threading.local()
 
@@ -473,12 +476,21 @@ class _SanctionedFetch:
     """Marks the designed one-bulk-fetch-per-dispatch sites: a
     device_get inside this block is the fused transport doing its job,
     not a hot-path sync. nomadlint's no-host-sync-hot rule recognizes
-    the same marker statically."""
+    the same marker statically, and its fetch-accounted rule requires
+    every site to pass the transfer-ledger tag (``tag``) naming the
+    transport, so per-tag sanctioned-fetch counts line up with the
+    xferobs fetch decomposition."""
+
+    def __init__(self, tag: str = ""):
+        self._tag = tag
 
     def __enter__(self):
         if _ACTIVE:
             self._entered = True
-            _tls_state()["sanct"] += 1
+            st = _tls_state()
+            st["sanct"] += 1
+            self._prev_tag = st.get("sanct_tag", "")
+            st["sanct_tag"] = self._tag
         else:
             self._entered = False
         return self
@@ -487,11 +499,12 @@ class _SanctionedFetch:
         if self._entered:
             st = _tls_state()
             st["sanct"] = max(0, st["sanct"] - 1)
+            st["sanct_tag"] = self._prev_tag
         return False
 
 
-def sanctioned_fetch() -> _SanctionedFetch:
-    return _SanctionedFetch()
+def sanctioned_fetch(tag: str = "") -> _SanctionedFetch:
+    return _SanctionedFetch(tag)
 
 
 def _note_sync(kind: str) -> None:
@@ -502,6 +515,10 @@ def _note_sync(kind: str) -> None:
         return
     if st["sanct"] > 0:
         _counters["sanctioned_fetches"] += 1
+        tag = st.get("sanct_tag", "")
+        if tag:
+            with _slock:
+                _sanct_tags[tag] = _sanct_tags.get(tag, 0) + 1
         return
     site = _repo_site() or "?"
     evals = _span_ids()
@@ -765,6 +782,7 @@ def state(sites: bool = False) -> dict:
             "late_trace_count": len(_late_traces),
             "host_sync_count": len(_host_syncs),
             "sanctioned_fetches": _counters["sanctioned_fetches"],
+            "sanctioned_by_tag": dict(_sanct_tags),
             "x64_leak_count": sum(1 for d in _dtype_drift
                                   if d["kind"] == "float64"),
             "weak_scalar_count": sum(1 for d in _dtype_drift
@@ -803,5 +821,6 @@ def _reset_for_tests() -> None:
         _frozen.clear()
         _fps_bytes[0] = _fps_bytes[1] = 0
         _rehash_cursor[0] = 0
+        _sanct_tags.clear()
         for k in _counters:
             _counters[k] = 0

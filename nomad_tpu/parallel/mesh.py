@@ -108,7 +108,17 @@ def shard_solver_inputs(mesh, const, init, batch):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..solver import xferobs
     from ..solver.constcache import note_dispatch_bytes
+    # per-tree ledger attribution rides the same walk the byte counter
+    # uses, so mesh-sharded puts decompose like the fused transport's
+    # (gated so the kill switch skips the extra tree walks entirely)
+    if xferobs.enabled():
+        for name, tree in (("const", const), ("init", init),
+                           ("batch", batch)):
+            xferobs.note_payload("mesh_" + name, sum(
+                np.asarray(leaf).nbytes
+                for leaf in jax.tree_util.tree_leaves(tree)))
     note_dispatch_bytes(sum(
         np.asarray(leaf).nbytes
         for tree in (const, init, batch)

@@ -749,6 +749,11 @@ _STAGE_OF: Dict[str, Tuple[str, str]] = {
     "solver.dispatch_solo": ("dispatch", "busy"),
     "solver.constcache": ("dispatch", "busy"),
     "solver.fixpoint": ("dispatch", "busy"),
+    # transfer-vs-compute split (solver/xferobs.py): the tunnel model's
+    # predicted wire share of each dispatch vs the remainder -- the
+    # dispatch stage decomposed into link time and chip time
+    "solver.xfer_transfer": ("dispatch.transfer", "busy"),
+    "solver.xfer_compute": ("dispatch.compute", "busy"),
     "plan.submit": ("commit.wait", "wait"),
     "plan.evaluate": ("commit", "busy"),
     "plan.commit": ("commit", "busy"),

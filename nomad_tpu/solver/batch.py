@@ -36,6 +36,7 @@ import numpy as np
 
 from ..server.telemetry import metrics
 from ..server.tracing import tracer
+from . import xferobs
 from .service import PackedLane
 
 # Pad the fused eval axis to these sizes so XLA compiles one program per
@@ -479,11 +480,25 @@ def solve_groups(lanes: List[PackedLane], groups: List[_FusedGroup],
         for g in groups:
             t0_wall = time.time()
             t0 = time.perf_counter()
-            out = _dispatch(g.const, g.init, g.batch, g.spread_alg,
-                            g.dtype_name, use_mesh, ptab=g.ptab,
-                            pinit=g.pinit, wave=g.wave,
-                            cache_version=g.cache_version)
-            dt_ms = (time.perf_counter() - t0) * 1e3
+            # transfer-ledger record for this generation: the payload
+            # notes the transports emit below land in it, and its
+            # (bytes, wall-ms) pair feeds the live tunnel model. The
+            # finally guarantees the record's deferred notes fold into
+            # the ledger even when the dispatch raises -- byte parity
+            # vs dispatch_bytes_total must survive error paths.
+            if xferobs.enabled():
+                xferobs.begin_dispatch(
+                    E=g.e_pad, e_real=g.e_real, P=g.p_pad,
+                    wave=bool(g.wave), A=g.A,
+                    in_flight=pipeline_state()["in_flight"])
+            try:
+                out = _dispatch(g.const, g.init, g.batch, g.spread_alg,
+                                g.dtype_name, use_mesh, ptab=g.ptab,
+                                pinit=g.pinit, wave=g.wave,
+                                cache_version=g.cache_version)
+            finally:
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                xferobs.end_dispatch(dt_ms, t0_wall)
             metrics.sample_ms("nomad.solver.dispatch", dt_ms)
             tracer.record("solver.dispatch", t0_wall, dt_ms,
                           E=g.e_pad, e_real=g.e_real, P=g.p_pad,
@@ -590,11 +605,12 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
             fn = mesh_solve_fn(mesh, spread_alg, dtype_name)
             chosen, scores, n_yielded, _ = fn(s_const, s_init, s_batch)
         from .. import jitcheck
-        with jitcheck.sanctioned_fetch():
+        with jitcheck.sanctioned_fetch("mesh"):
             # the mesh path's one bulk fetch: gather + host copy
             combined = np.asarray(jnp.concatenate([
                 chosen.astype(scores.dtype)[None], scores[None],
                 n_yielded.astype(scores.dtype)[None]], axis=0))
+        xferobs.note_fetch(combined.nbytes, "mesh")
         return combined[0], combined[1], combined[2]
     return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
                             dtype_name=dtype_name, batched=True,
@@ -924,11 +940,15 @@ class SolveBarrier:
             # independently degrades to the host oracle (make_solve_hook)
             # instead of stranding the whole batch
             from .guard import run_dispatch
+            xfer_tok = xferobs.mark()
             with tracer.activate(gctx), \
                     tracer.span("solver.fuse_dispatch", ctx=gctx,
                                 generation=gen, lanes=len(lanes),
-                                depth=1):
+                                depth=1) as sp:
                 results = run_dispatch(solve_batch, label="solver.batch")
+                # waterfall annotation: shipped/resident bytes + tunnel
+                # predicted-vs-actual for this generation's dispatches
+                sp.tag(**xferobs.span_tags(xfer_tok))
             for (lane, cell), res in zip(batch, results):
                 cell["result"] = res
         except Exception as e:  # noqa: BLE001 -- waiters must not strand
@@ -956,17 +976,22 @@ class SolveBarrier:
         gctx = tracer.group([c.get("trace_ctx") for _, c in batch])
         try:
             from .guard import run_dispatch
+            xfer_tok = xferobs.mark()
             with tracer.activate(gctx), \
                     tracer.span("solver.fuse_dispatch", ctx=gctx,
                                 generation=gen, lanes=len(lanes),
                                 depth=self._depth,
                                 staged=bool(staged and "groups" in staged),
-                                in_flight=pipeline_state()["in_flight"]):
+                                in_flight=pipeline_state()["in_flight"]
+                                ) as sp:
                 results = run_dispatch(
                     lambda: fuse_and_solve(
                         lanes, use_mesh=self._use_mesh,
                         e_pad_hint=self._e_pad_hint, staged=staged),
                     label="solver.batch")
+                # waterfall annotation: shipped/resident bytes + tunnel
+                # predicted-vs-actual for this generation's dispatches
+                sp.tag(**xferobs.span_tags(xfer_tok))
         except Exception as e:  # noqa: BLE001 -- waiters must not strand
             err = e
         # Ordered-completion section: generation g's ledger charges land

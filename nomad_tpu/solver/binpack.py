@@ -851,27 +851,36 @@ def solve_eval_batch(const: NodeConst, init: NodeState, batch: PlacementBatch,
 # in ONE jax.device_put, and re-sliced INSIDE the jit (free -- XLA fuses
 # the slices away). Outputs are stacked in-jit and fetched once.
 
+# group-class -> transfer-ledger tree-group name (solver/xferobs.py):
+# position i is the i-th tree handed to _fuse_trees
+_FUSE_TREE_NAMES = ("const", "init", "batch", "ptab", "pinit")
+
+
 def _fuse_trees(trees):
-    """Flatten trees and group non-empty leaves by (tree-class, dtype,
+    """Flatten trees and group non-empty leaves by (tree-index, dtype,
     shape). Returns (stacked buffers, per-leaf meta, treedef, group
-    keys). The tree-class marker (0 = the NodeConst tree, 1 = the
+    keys). The tree-index marker (0 = the NodeConst tree; 1.. = the
     mutable init/batch/preempt trees) keeps fleet-constant leaves in
     their OWN stacked buffers even when a usage leaf shares dtype+shape
-    (cpu_cap vs used_cpu): the device-resident const cache can then
-    pin the const buffers across dispatches while the delta buffers
-    ship fresh every time."""
+    (cpu_cap vs used_cpu): the device-resident const cache can then pin
+    the const buffers across dispatches while the delta buffers ship
+    fresh every time.  Keying by the full tree index (not just the
+    const/delta class) additionally keeps init, batch and the
+    preemption port tables in separate buffers, so the transfer ledger
+    (solver/xferobs.py) can decompose every dispatch's bytes by tree
+    group; same bytes either way, one stacked buffer more or less per
+    shape bucket."""
     metas = []
     groups: dict = {}
     per_tree = [jax.tree_util.tree_flatten(t) for t in trees]
     treedef = jax.tree_util.tree_structure(tuple(trees))
     for ti, (leaves, _) in enumerate(per_tree):
-        tclass = 0 if ti == 0 else 1
         for leaf in leaves:
             arr = np.asarray(leaf)
             if arr.size == 0:
                 metas.append(("zero", arr.shape, arr.dtype.str))
                 continue
-            key = (tclass, arr.dtype.str, arr.shape)
+            key = (ti, arr.dtype.str, arr.shape)
             rows = groups.setdefault(key, [])
             metas.append(("buf", key, len(rows)))
             rows.append(arr)
@@ -960,22 +969,28 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
     stacked, metas, treedef, group_keys = _fuse_trees(trees)
     fn = _make_fused_fn(metas, treedef, group_keys, spread_alg,
                         dtype_name, ptab is not None, batched)
+    from . import xferobs
     from .constcache import device_put_cached
-    # only const-tree buffers (group class 0) are pinned: init/batch
-    # deltas change every dispatch and would churn the LRU
+    # only const-tree buffers (tree index 0) are pinned: init/batch
+    # deltas change every dispatch and would churn the LRU. Tags name
+    # each stacked buffer's tree group for the transfer ledger.
     buffers, _ = device_put_cached(
         stacked, version=cache_version,
-        cacheable=[k[0] == 0 for k in group_keys])
+        cacheable=[k[0] == 0 for k in group_keys],
+        tags=[_FUSE_TREE_NAMES[k[0]] for k in group_keys])
     out = fn(*buffers)
     # the 3-way output axis is leading in both forms: (3, P) or (3, E, P)
     if ptab is not None:
-        with jitcheck.sanctioned_fetch():
+        with jitcheck.sanctioned_fetch("fused_preempt"):
             # the ONE designed bulk fetch of the fused transport
             combined, evict_rows = jax.device_get(out)
+        xferobs.note_fetch(
+            xferobs.tree_nbytes((combined, evict_rows)), "fused_preempt")
         return (combined[0].astype(np.int64), combined[1],
                 combined[2].astype(np.int64), np.asarray(evict_rows))
-    with jitcheck.sanctioned_fetch():
+    with jitcheck.sanctioned_fetch("fused"):
         combined = jax.device_get(out)
+    xferobs.note_fetch(xferobs.tree_nbytes(combined), "fused")
     return (combined[0].astype(np.int64), combined[1],
             combined[2].astype(np.int64))
 
@@ -2540,10 +2555,13 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
     cm, cd, sf, si, pn, c0 = _put_eval_sharded(
         batched, compact.shape[0],
         (compact, cand, scal_f, scal_i, pen, counts0),
-        cache_version=cache_version)
+        cache_version=cache_version, tag="compact_preempt")
     out = fn(cm, cd, sf, si, pn, c0)
-    with jitcheck.sanctioned_fetch():
+    with jitcheck.sanctioned_fetch("wave_preempt"):
         combined, ev = jax.device_get(out)
+    from . import xferobs
+    xferobs.note_fetch(xferobs.tree_nbytes((combined, ev)),
+                       "wave_preempt")
     combined = combined[..., :P]
     ev = ev[..., :P, :]
     return (combined[0].astype(np.int64), combined[1],
@@ -2551,7 +2569,7 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
 
 
 def _put_eval_sharded(batched: bool, e_dim: int, trees,
-                      cache_version=None):
+                      cache_version=None, tag: str = "compact"):
     """Device-put a tuple of (possibly nested) arrays, sharding the
     leading eval axis across ALL attached devices when it divides the
     device count. The fused eval axis is embarrassingly data-parallel:
@@ -2565,20 +2583,27 @@ def _put_eval_sharded(batched: bool, e_dim: int, trees,
     keyed by content and tagged with ``cache_version`` (the packing
     snapshot's node_table_index). The sharded path ships fresh -- the
     cache stores unsharded buffers -- but still reports its bytes so
-    ``nomad.solver.dispatch_bytes`` means one thing everywhere."""
+    ``nomad.solver.dispatch_bytes`` means one thing everywhere.
+    ``tag`` is the transfer ledger's tree-group attribution for these
+    tables (the wave transports ship merged compact tables that can't
+    decompose into const/init/batch)."""
+    from . import xferobs
     from .constcache import device_put_cached, note_dispatch_bytes
 
     if not (batched and jax.device_count() > 1
             and e_dim % jax.device_count() == 0):
         leaves, treedef = jax.tree_util.tree_flatten(trees)
-        buffers, _ = device_put_cached(leaves, version=cache_version)
+        buffers, _ = device_put_cached(leaves, version=cache_version,
+                                       tags=[tag] * len(leaves))
         return jax.tree_util.tree_unflatten(treedef, buffers)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
     mesh = Mesh(np.asarray(jax.devices()), ("evals",))
     sharding = NamedSharding(mesh, PartitionSpec("evals"))
-    note_dispatch_bytes(sum(
+    total = sum(
         np.asarray(leaf).nbytes
-        for leaf in jax.tree_util.tree_leaves(trees)))
+        for leaf in jax.tree_util.tree_leaves(trees))
+    note_dispatch_bytes(total)
+    xferobs.note_payload(tag, total)
     return tuple(
         jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
         for t in trees)
@@ -2698,8 +2723,10 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
         batched, compact.shape[0], (compact, scal_f, scal_i, pen, sp),
         cache_version=cache_version)
     out = fn(cm, sf, si, pn, spd)
-    with jitcheck.sanctioned_fetch():
+    with jitcheck.sanctioned_fetch("wave"):
         combined = jax.device_get(out)
+    from . import xferobs
+    xferobs.note_fetch(xferobs.tree_nbytes(combined), "wave")
     # slice padded placement steps back off (outputs are [..., :p_pad])
     combined = combined[..., :P]
     return (combined[0].astype(np.int64), combined[1],

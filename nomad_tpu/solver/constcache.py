@@ -43,6 +43,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
@@ -62,12 +63,16 @@ _STATS = {
 
 
 class _Entry:
-    __slots__ = ("buf", "nbytes", "version")
+    __slots__ = ("buf", "nbytes", "version", "created_at", "hits")
 
     def __init__(self, buf, nbytes: int, version: Optional[int]):
         self.buf = buf              # the pinned jax.Array
         self.nbytes = nbytes
         self.version = version      # node_table_index tag (hygiene only)
+        # residency-map facts (solver/xferobs.py): age + hit count make
+        # stale-version occupancy and eviction pressure first-class
+        self.created_at = time.time()
+        self.hits = 0
 
 
 def enabled() -> bool:
@@ -108,6 +113,7 @@ def _fingerprint(arr: np.ndarray) -> bytes:
 def device_put_cached(arrays: Sequence[np.ndarray],
                       version: Optional[int] = None,
                       cacheable: Optional[Sequence[bool]] = None,
+                      tags: Optional[Sequence[str]] = None,
                       ) -> Tuple[List, int]:
     """Transfer ``arrays`` host->device, reusing pinned device buffers
     for repeated content. Returns (buffers, bytes_shipped). ``version``
@@ -115,14 +121,22 @@ def device_put_cached(arrays: Sequence[np.ndarray],
     under (hygiene eviction on table writes); ``cacheable`` masks
     per-array eligibility (the fused transport marks only const-tree
     buffers, so churning usage deltas never evict resident fleet
-    tables)."""
+    tables); ``tags`` names each array's tree group for the transfer
+    ledger (solver/xferobs.py) -- cache-hit bytes attribute as
+    *resident*, everything else as *shipped*."""
     import jax
 
     from ..server.telemetry import metrics
+    from . import xferobs
+
+    def tag_of(i: int) -> str:
+        return tags[i] if tags is not None else "untagged"
 
     arrays = [np.asarray(a) for a in arrays]
     if not enabled():
         shipped = sum(a.nbytes for a in arrays)
+        for i, a in enumerate(arrays):
+            xferobs.note_payload(tag_of(i), a.nbytes)
         note_dispatch_bytes(shipped)
         return list(jax.device_put(arrays)) if arrays else [], shipped
 
@@ -134,6 +148,7 @@ def device_put_cached(arrays: Sequence[np.ndarray],
     miss_fps: List[Optional[bytes]] = []
     shipped = 0
     hits = misses = saved = 0
+    hit_idx: List[int] = []
     with _LOCK:
         for i, arr in enumerate(arrays):
             if arr.nbytes < min_b or (
@@ -155,9 +170,11 @@ def device_put_cached(arrays: Sequence[np.ndarray],
             ent = _CACHE.get(fp)
             if ent is not None:
                 _CACHE.move_to_end(fp)
+                ent.hits += 1
                 buffers[i] = ent.buf
                 hits += 1
                 saved += ent.nbytes
+                hit_idx.append(i)
             else:
                 miss_idx.append(i)
                 miss_fps.append(fp)
@@ -179,6 +196,15 @@ def device_put_cached(arrays: Sequence[np.ndarray],
         _STATS["misses"] += misses
         _STATS["bytes_shipped_total"] += shipped
         _STATS["bytes_saved_total"] += saved
+        resident_now = _STATS["resident_bytes"]
+    # ledger attribution outside _LOCK (xferobs has its own lock; keep
+    # the order leaf-like for lockcheck): hit bytes are *resident*,
+    # everything in miss_idx actually crossed the wire
+    for i in hit_idx:
+        xferobs.note_payload(tag_of(i), arrays[i].nbytes, resident=True)
+    for i in miss_idx:
+        xferobs.note_payload(tag_of(i), arrays[i].nbytes)
+    xferobs.note_resident_level(resident_now)
     if hits:
         metrics.incr("nomad.solver.const_cache_hit", hits)
     if misses:
@@ -204,11 +230,29 @@ def _evict_over_bounds_locked() -> None:
 def note_dispatch_bytes(n: int) -> None:
     """Record one dispatch's actual host->device payload (bytes that hit
     the wire AFTER cache hits are subtracted). Shared by the fused,
-    wave and mesh-sharded transports so the metric means one thing."""
+    wave and mesh-sharded transports so the metric means one thing.
+    Every increment is mirrored into the transfer ledger
+    (solver/xferobs.py note_shipped) as the reconciliation base its
+    byte-parity gate compares the tagged decomposition against."""
     from ..server.telemetry import metrics
+    from . import xferobs
 
     metrics.sample("nomad.solver.dispatch_bytes", float(n))
     metrics.incr("nomad.solver.dispatch_bytes_total", int(n))
+    xferobs.note_shipped(int(n))
+
+
+def residency() -> List[dict]:
+    """Device-residency map (solver/xferobs.py): one row per pinned
+    entry -- bytes, upload version, age, hit count -- so stale-version
+    occupancy and eviction pressure are readable, not inferred."""
+    now = time.time()
+    with _LOCK:
+        return [{"id": fp.hex()[:12], "bytes": ent.nbytes,
+                 "version": ent.version,
+                 "age_s": round(now - ent.created_at, 1),
+                 "hits": ent.hits}
+                for fp, ent in _CACHE.items()]
 
 
 def note_table_write(tables, table_index: int, delta=None) -> None:
@@ -235,6 +279,10 @@ def note_node_table_write(table_index: int) -> None:
             _STATS["resident_bytes"] -= ent.nbytes
         if stale:
             _STATS["invalidations"] += 1
+        resident_now = _STATS["resident_bytes"]
+    if stale:
+        from . import xferobs
+        xferobs.note_resident_level(resident_now)
 
 
 def invalidate_all(reason: str = "") -> None:
@@ -248,6 +296,9 @@ def invalidate_all(reason: str = "") -> None:
         _STATS["resident_bytes"] = 0
         if had:
             _STATS["invalidations"] += 1
+    if had:
+        from . import xferobs
+        xferobs.note_resident_level(0)
     if had and reason:
         from ..server.logbroker import log as _log
         _log("info", "solver",

@@ -74,6 +74,16 @@ HEADLINE_FIELDS = {
     "state_journal_gaps": ("lower", 0.0),
     "state_write_skews": ("lower", 0.0),
     "state_stale_memos": ("lower", 0.0),
+    # transfer observatory (ISSUE 13): the per-dispatch payload must
+    # not bloat (ROADMAP-4 wants it SHRINKING toward KB), the fitted
+    # link must not slow down, and the ledger's byte parity vs
+    # dispatch_bytes_total is 0 on a healthy round -- any positive
+    # parity vs a zero round means a transport's bytes escaped the
+    # decomposition
+    "xfer_shipped_bytes_per_dispatch": ("lower", 0.25),
+    "xfer_rtt_ms": ("lower", 0.50),
+    "xfer_bw_mbps": ("higher", 0.50),
+    "xfer_ledger_parity": ("lower", 0.0),
 }
 
 

@@ -57,6 +57,12 @@ runtime dispatch-discipline sanitizer nomad_tpu/jitcheck.py):
                      frozen first (a freeze/setflags call in the same
                      function) -- the runtime counterpart is
                      jitcheck's writeable=False invariant
+  fetch-accounted    every ``jitcheck.sanctioned_fetch(...)`` site
+                     passes a non-empty string-literal ledger tag
+                     (ISSUE 13): the transfer observatory attributes
+                     fetched result bytes per transport, and an
+                     untagged fetch is a payload the ledger cannot
+                     decompose
 
 Store-discipline rules (ISSUE 11, the static complement of the MVCC
 snapshot-isolation sanitizer nomad_tpu/statecheck.py):
@@ -595,8 +601,12 @@ _SYNC_ATTRS = {"device_get", "item", "block_until_ready"}
 
 
 def _is_sanctioned_with(node: ast.With) -> bool:
-    return any(_unparse(i.context_expr).endswith("sanctioned_fetch()")
-               for i in node.items)
+    # matches both the bare marker and the tagged form the
+    # fetch-accounted rule requires (sanctioned_fetch("wave"))
+    return any(
+        isinstance(i.context_expr, ast.Call)
+        and _unparse(i.context_expr.func).endswith("sanctioned_fetch")
+        for i in node.items)
 
 
 class _HotSyncVisitor(ast.NodeVisitor):
@@ -816,6 +826,39 @@ def rule_frozen_memo(ctx: Ctx) -> List[Violation]:
                             f"a freeze -- memoized payloads are "
                             f"shared across evals and must be "
                             f"writeable=False (jitcheck invariant)"))
+    return out
+
+
+def rule_fetch_accounted(ctx: Ctx) -> List[Violation]:
+    """Every ``sanctioned_fetch(...)`` context manager carries a
+    non-empty string-literal ledger tag naming the transport: the
+    transfer observatory (solver/xferobs.py) decomposes fetched result
+    bytes by that tag, so an untagged site is a payload the ledger
+    cannot attribute."""
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if rel.endswith(os.path.join("nomad_tpu", "jitcheck.py")):
+            continue            # the marker's own definition/dispatch
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                ce = item.context_expr
+                if not (isinstance(ce, ast.Call)
+                        and _unparse(ce.func).endswith(
+                            "sanctioned_fetch")):
+                    continue
+                arg = ce.args[0] if ce.args else None
+                ok = (isinstance(arg, ast.Constant)
+                      and isinstance(arg.value, str) and arg.value)
+                if not ok:
+                    out.append(Violation(
+                        "fetch-accounted", rel, ce.lineno,
+                        "sanctioned_fetch() without a string-literal "
+                        "ledger tag -- pass the transport name "
+                        "(e.g. sanctioned_fetch(\"wave\")) so the "
+                        "transfer ledger can attribute the fetched "
+                        "bytes"))
     return out
 
 
@@ -1175,6 +1218,7 @@ AST_RULES = {
     "no-host-sync-hot": rule_no_host_sync_hot,
     "dtype-threaded": rule_dtype_threaded,
     "frozen-memo": rule_frozen_memo,
+    "fetch-accounted": rule_fetch_accounted,
     "no-direct-table-write": rule_no_direct_table_write,
     "version-keyed-memo": rule_version_keyed_memo,
     "no-snapshot-escape": rule_no_snapshot_escape,
@@ -1187,7 +1231,8 @@ AST_RULES = {
 RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
             "telemetry-kind", "sleep-under-lock", "bare-acquire",
             "no-callsite-jit", "no-host-sync-hot", "dtype-threaded",
-            "frozen-memo", "no-direct-table-write", "version-keyed-memo",
+            "frozen-memo", "fetch-accounted", "no-direct-table-write",
+            "version-keyed-memo",
             "no-snapshot-escape", "delta-carried", "join-with-timeout",
             "no-sleep-sync", "daemon-declared")
 

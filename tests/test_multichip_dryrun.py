@@ -26,10 +26,16 @@ def test_dryrun_multichip_parity(monkeypatch):
     rebuilds its jitted program per dispatch or pulls scalars off
     device mid-flight fails here, not in a TPU bench round."""
     from nomad_tpu import jitcheck
+    from nomad_tpu.solver import xferobs
 
     monkeypatch.setenv("MULTICHIP_EVALS", "8")
     monkeypatch.setenv("MULTICHIP_PLACE", "32")
     monkeypatch.setenv("MULTICHIP_NODES", "1024")
+    # the transfer observatory (ISSUE 13) rides the dryrun explicitly:
+    # its ledger notes must not introduce retraces or host syncs on
+    # the sharded transports, and the mesh bytes must reconcile
+    monkeypatch.setenv("NOMAD_TPU_XFEROBS", "1")
+    xferobs._reset_for_tests()
     import __graft_entry__ as graft
     jitcheck.enable()
     try:
@@ -40,3 +46,5 @@ def test_dryrun_multichip_parity(monkeypatch):
         jitcheck._reset_for_tests()
     assert st["retraces"] == [], st["retraces"]
     assert st["host_syncs"] == [], st["host_syncs"]
+    assert xferobs.parity() == 0
+    xferobs._reset_for_tests()

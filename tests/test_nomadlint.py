@@ -553,6 +553,50 @@ def test_new_rules_listed_and_clean_on_real_tree(capsys):
 
 
 # ----------------------------------------------------------------------
+# fetch-accounted (ISSUE 13)
+
+
+def test_fetch_accounted_fires_on_untagged_site(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            def fetch(jitcheck, jax, out, tag):
+                with jitcheck.sanctioned_fetch():       # BAD: no tag
+                    a = jax.device_get(out)
+                with jitcheck.sanctioned_fetch(""):     # BAD: empty
+                    b = jax.device_get(out)
+                with jitcheck.sanctioned_fetch(tag):    # BAD: computed
+                    c = jax.device_get(out)
+                with jitcheck.sanctioned_fetch("wave"):  # ok
+                    d = jax.device_get(out)
+                return a, b, c, d
+            """,
+    })
+    kept, _ = _rules(root, ["fetch-accounted"])
+    assert len(kept) == 3
+    assert all("ledger tag" in v.msg for v in kept)
+
+
+def test_fetch_accounted_clean_and_waivable(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            def fetch(jitcheck, jax, out):
+                # nomadlint: waive=fetch-accounted -- fixture reason
+                with jitcheck.sanctioned_fetch():
+                    return jax.device_get(out)
+            """,
+    })
+    kept, waived = _rules(root, ["fetch-accounted"])
+    assert kept == [] and waived == 1
+
+
+def test_fetch_accounted_clean_on_real_tree(capsys):
+    """Every real sanctioned_fetch site carries its transport tag --
+    the acceptance gate for ISSUE 13's lint half."""
+    assert nl.main(["--rule", "fetch-accounted"]) == 0, \
+        capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
 # store-discipline rules (ISSUE 11)
 
 
