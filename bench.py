@@ -1008,10 +1008,11 @@ def main_tier(platform: str, tier: int):
     # tunnel or tripped breaker must never read as a chip result
     from nomad_tpu.benchkit import (
         artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
-        statecheck_stamp, xferobs_stamp)
+        shardcheck_stamp, statecheck_stamp, xferobs_stamp)
     out.update(dispatch_health_stamp(platform))
     out.update(jitcheck_stamp())
     out.update(statecheck_stamp())
+    out.update(shardcheck_stamp())
     # transfer ledger + tunnel-model fields (ISSUE 13): byte parity and
     # per-dispatch payload are gated per round like the sanitizers
     out.update(xferobs_stamp())
@@ -1432,12 +1433,15 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # explicit degraded verdict + dispatch-layer state
     from nomad_tpu.benchkit import (
         artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
-        statecheck_stamp, xferobs_stamp)
+        shardcheck_stamp, statecheck_stamp, xferobs_stamp)
     out.update(dispatch_health_stamp(platform))
     # dispatch discipline (ISSUE 10): retraces/host syncs/x64 leaks
     # observed this run, gated by scripts/check_bench_regress.py
     out.update(jitcheck_stamp())
     out.update(statecheck_stamp())
+    # sharding discipline (ISSUE 15): spec drift / implicit transfers /
+    # collective excess observed this run, zero-tolerance gated
+    out.update(shardcheck_stamp())
     # transfer ledger + tunnel-model fields (ISSUE 13): payload bytes
     # decomposed per dispatch, byte parity vs dispatch_bytes_total
     # (must be 0), and the live rtt/bandwidth fit -- the r05 manual

@@ -57,3 +57,11 @@ _statecheck.maybe_install_from_env()
 from . import schedcheck as _schedcheck  # noqa: E402
 
 _schedcheck.maybe_install_from_env()
+
+# NOMAD_TPU_SHARDCHECK=1 installs the sharding-discipline sanitizer
+# before any mesh program is constructed (shardcheck.py); unset/0 is a
+# true no-op -- one env read, the parallel/mesh.py entry points
+# untouched (and jax not even imported).
+from . import shardcheck as _shardcheck  # noqa: E402
+
+_shardcheck.maybe_install_from_env()

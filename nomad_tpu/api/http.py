@@ -908,6 +908,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                 from .. import jitcheck as _jitcheck
                 from .. import lockcheck as _lockcheck
                 from .. import schedcheck as _schedcheck
+                from .. import shardcheck as _shardcheck
                 from .. import statecheck as _statecheck
                 cfg = self.nomad.state.scheduler_config()
                 raft = getattr(self.nomad, "raft", None)
@@ -962,6 +963,14 @@ class ApiHandler(BaseHTTPRequestHandler):
                         # replay-divergence counterexamples;
                         # enabled=False when off (the default)
                         "schedcheck": _schedcheck.state(),
+                        # sharding-discipline sanitizer report
+                        # (shardcheck.py): spec drift vs the
+                        # parallel/mesh.py registry, implicit
+                        # transfers into mesh callables, collective-
+                        # budget excess and per-shard byte parity;
+                        # enabled=False when off (the default)
+                        "shardcheck": _shardcheck.state(
+                            programs=True),
                     },
                     "member": {"name": getattr(self.nomad, "name",
                                                "local"),

@@ -130,6 +130,25 @@ def statecheck_stamp() -> dict:
     }
 
 
+def shardcheck_stamp() -> dict:
+    """Sharding-discipline fields for bench artifacts (ISSUE 15):
+    spec drift vs the parallel/mesh.py registry, implicit transfers
+    into mesh callables and collective-budget excess observed during
+    the run. All zero when the sanitizer is off (the default) -- the
+    regress gate (scripts/check_bench_regress.py) only bites on a
+    round that RAN the sanitizer and found violations, and on any
+    round where a previously-zero field goes positive."""
+    from . import shardcheck
+
+    st = shardcheck.state()
+    return {
+        "shardcheck_enabled": st["enabled"],
+        "shard_spec_drift": st["spec_drift_count"],
+        "shard_implicit_xfer": st["implicit_xfer_count"],
+        "shard_collective_excess": st["collective_excess_count"],
+    }
+
+
 def xferobs_stamp() -> dict:
     """Transfer-observatory artifact fields (ISSUE 13): ledger byte
     decomposition totals, byte parity vs the dispatch_bytes counter
@@ -555,6 +574,22 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
                 f"(rss {rss_rounds[-1]:.0f}MB, "
                 f"parity_mismatch={parity_mismatch})")
         churn_wall = time.perf_counter() - t_run0
+        # settle before reading: the final round's replacement
+        # placements and stop-acks commit asynchronously, so an
+        # immediate live count can race them a couple of allocs high
+        # or low (the tier-1 smoke asserts EXACT target).  A bounded
+        # poll until the count holds the target removes the race
+        # without weakening the gate -- a genuinely accumulating or
+        # leaking run never settles and still fails the assert.
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            live_now = sum(
+                1 for j in active_jobs
+                for a in server.state.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status())
+            if live_now == live_target:
+                break
+            time.sleep(0.05)
     finally:
         if prev_lean is None:
             os.environ.pop("NOMAD_TPU_LEAN_ALLOC_METRICS", None)

@@ -697,6 +697,15 @@ def cmd_operator_debug(args) -> int:
             captures["agent-self.json"]["stats"]["schedcheck"])
     except Exception as e:  # noqa: BLE001 -- partial bundles beat none
         captures["schedcheck.json"] = {"capture_error": repr(e)}
+    # sharding-discipline sanitizer findings as their own member: the
+    # spec-drift/implicit-transfer witnesses and the per-program
+    # collective inventory belong next to jitcheck.json when an
+    # operator is untangling a slow or bloated mesh path (ISSUE 15)
+    try:
+        captures["shardcheck.json"] = (
+            captures["agent-self.json"]["stats"]["shardcheck"])
+    except Exception as e:  # noqa: BLE001 -- partial bundles beat none
+        captures["shardcheck.json"] = {"capture_error": repr(e)}
     # transfer ledger + residency map + tunnel fit as their own member:
     # the byte decomposition belongs next to metrics.json when an
     # operator is untangling a slow or bloated dispatch path (ISSUE 13)
@@ -1040,17 +1049,107 @@ def cmd_operator_schedcheck(args) -> int:
                  or st.get("divergence_count")) else 0
 
 
+def cmd_operator_shardcheck(args) -> int:
+    """Sharding-discipline sanitizer report (rides /v1/agent/self
+    stats.shardcheck): spec drift vs the parallel/mesh.py registry,
+    implicit transfers into mesh callables, collective-budget excess
+    and per-shard byte parity, each with witness stacks.  Enable with
+    NOMAD_TPU_SHARDCHECK=1 on the agent; off is a true no-op and
+    reports enabled=False.  ``--compile-audit`` runs LOCALLY (no agent
+    round-trip): it compiles the registered mesh programs for an
+    8-device CPU mesh and prints the collective/bytes inventory.
+    Exit 1 when spec drift, implicit transfers or collective excess
+    exist (or the compile audit errors)."""
+    from nomad_tpu import shardcheck
+
+    if args.compile_audit:
+        shardcheck.ensure_virtual_devices(args.devices)
+        inv = shardcheck.compile_audit(n_devices=args.devices,
+                                       nodes=args.nodes)
+        if "error" in inv:
+            print(f"compile-audit error: {inv['error']}")
+            return 1
+        print(f"mesh         = {inv['mesh']} over {inv['devices']} "
+              f"devices")
+        print(f"probe shape  = E x P x N = {inv['shape']}")
+        print(f"\n{'group':12s} {'total_bytes':>12s} "
+              f"{'per_shard_bytes':>16s}")
+        for g, row in sorted(inv["per_shard_budget"].items()):
+            print(f"{g:12s} {row['total_bytes']:12d} "
+                  f"{row['declared_per_shard_bytes']:16d}")
+        rc = 0
+        for p in inv["programs"]:
+            print(f"\nprogram: {p['program']}")
+            if "audit_error" in p:
+                print(f"  AUDIT ERROR: {p['audit_error']}")
+                rc = 1
+                continue
+            cols = p.get("collectives") or {}
+            if cols:
+                for op, n in sorted(cols.items()):
+                    print(f"  {op:20s} x{n}")
+            else:
+                print("  (no collectives)")
+            for k in ("flops", "bytes_accessed"):
+                if k in p:
+                    print(f"  {k:20s} {p[k]:.0f}")
+        return rc
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("shardcheck") or {}
+    for k in ("enabled", "hlo_audit", "wrapped_dispatches",
+              "sanctioned_puts", "leaves_checked", "programs_audited",
+              "baselines_recorded", "spec_drift_count",
+              "implicit_xfer_count", "collective_excess_count",
+              "shard_parity_count", "audit_errors",
+              "reports_dropped"):
+        print(f"{k:24s} = {st.get(k)}")
+    if not st.get("enabled") and not st.get("spec_drift_count"):
+        print("(checker disabled: set NOMAD_TPU_SHARDCHECK=1 on the "
+              "agent to record sharding discipline)")
+    for i, r in enumerate(st.get("spec_drift") or []):
+        print(f"\nSPEC DRIFT {i}: {r.get('kind')} {r.get('group')}."
+              f"{r.get('field')} declared {r.get('declared')} actual "
+              f"{r.get('actual')} (amplification "
+              f"{r.get('amplification_bytes')} bytes, thread "
+              f"{r.get('thread')})")
+        if args.stacks:
+            for ln in (r.get("stack") or "").rstrip().splitlines():
+                print(f"    {ln}")
+    for i, r in enumerate(st.get("implicit_xfers") or []):
+        print(f"\nIMPLICIT TRANSFER {i}: {r.get('kind')} "
+              f"{r.get('group')}.{r.get('field')} ({r.get('bytes')} "
+              f"bytes) -- {r.get('detail')}")
+        if args.stacks:
+            for ln in (r.get("stack") or "").rstrip().splitlines():
+                print(f"    {ln}")
+    for i, r in enumerate(st.get("collective_excess") or []):
+        print(f"\nCOLLECTIVE EXCESS {i}: {r.get('excess')} in "
+              f"{r.get('program') or r.get('family')}")
+        for ln in r.get("witness_instructions") or []:
+            print(f"    {ln}")
+    for r in st.get("shard_parity_reports") or []:
+        print(f"shard byte parity: {r.get('group')}.{r.get('field')} "
+              f"declared {r.get('declared_per_device')} vs actual "
+              f"{r.get('actual_per_device')} bytes/device over "
+              f"{r.get('devices')} devices")
+    return 1 if (st.get("spec_drift_count")
+                 or st.get("implicit_xfer_count")
+                 or st.get("collective_excess_count")) else 0
+
+
 def cmd_operator_sanitizers(args) -> int:
-    """One-table summary of all four sanitizers (lockcheck, jitcheck,
-    statecheck, schedcheck) off /v1/agent/self. Exit 1 when any hard
-    violation class is non-zero (cycles / steady-state retraces /
-    torn reads / aliasing writes / manifested deadlocks)."""
+    """One-table summary of all five sanitizers (lockcheck, jitcheck,
+    statecheck, schedcheck, shardcheck) off /v1/agent/self. Exit 1
+    when any hard violation class is non-zero (cycles / steady-state
+    retraces / torn reads / aliasing writes / manifested deadlocks /
+    spec drift / implicit transfers / collective excess)."""
     api = _client(args)
     stats = api.get("/v1/agent/self")["stats"]
     lc = stats.get("lockcheck") or {}
     jc = stats.get("jitcheck") or {}
     sc = stats.get("statecheck") or {}
     dc = stats.get("schedcheck") or {}
+    hc = stats.get("shardcheck") or {}
     rows = [
         ("lockcheck", lc.get("enabled"),
          {"cycles": lc.get("cycle_count", 0),
@@ -1075,6 +1174,12 @@ def cmd_operator_sanitizers(args) -> int:
           "divergences": dc.get("divergence_count", 0),
           "preemptions": dc.get("preemptions", 0)},
          ("deadlocks", "divergences")),
+        ("shardcheck", hc.get("enabled"),
+         {"spec_drift": hc.get("spec_drift_count", 0),
+          "implicit_xfer": hc.get("implicit_xfer_count", 0),
+          "collective_excess": hc.get("collective_excess_count", 0),
+          "shard_parity": hc.get("shard_parity_count", 0)},
+         ("spec_drift", "implicit_xfer", "collective_excess")),
     ]
     rc = 0
     print(f"{'sanitizer':12s} {'enabled':8s} {'verdict':8s} findings")
@@ -1091,7 +1196,7 @@ def cmd_operator_sanitizers(args) -> int:
               f"{detail}")
     if rc == 0 and not any(r[1] for r in rows):
         print("(all sanitizers disabled: set NOMAD_TPU_LOCKCHECK/"
-              "JITCHECK/STATECHECK/SCHEDCHECK=1 to record)")
+              "JITCHECK/STATECHECK/SCHEDCHECK/SHARDCHECK=1 to record)")
     return rc
 
 
@@ -1635,8 +1740,28 @@ def build_parser() -> argparse.ArgumentParser:
     osc.set_defaults(fn=cmd_operator_statecheck)
     osan = op.add_parser("sanitizers",
                          help="one-table summary of lockcheck + "
-                         "jitcheck + statecheck + schedcheck state")
+                         "jitcheck + statecheck + schedcheck + "
+                         "shardcheck state")
     osan.set_defaults(fn=cmd_operator_sanitizers)
+    ohc = op.add_parser("shardcheck",
+                        help="sharding-discipline sanitizer report "
+                        "(spec drift / implicit transfers / "
+                        "collective budget / per-shard byte parity), "
+                        "or an offline mesh-program compile audit")
+    ohc.add_argument("--stacks", action="store_true",
+                     help="print witness stacks per finding")
+    ohc.add_argument("--compile-audit", action="store_true",
+                     dest="compile_audit",
+                     help="compile the registered mesh programs for "
+                     "a virtual CPU mesh and print the collective/"
+                     "bytes inventory (local; no agent round-trip)")
+    ohc.add_argument("--devices", type=int, default=8,
+                     help="device count for --compile-audit "
+                     "(default 8)")
+    ohc.add_argument("--nodes", type=int, default=256,
+                     help="probe fleet size for --compile-audit "
+                     "(default 256; rounded to the mesh node axis)")
+    ohc.set_defaults(fn=cmd_operator_shardcheck)
     odc = op.add_parser("schedcheck",
                         help="deterministic schedule explorer report, "
                         "seeded replay of a recorded interleaving, or "

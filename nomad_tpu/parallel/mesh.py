@@ -13,13 +13,43 @@ shards two axes over a jax.sharding.Mesh:
 No NCCL/MPI analog is needed: collectives ride ICI within a slice and DCN
 across slices, and the host-side control plane (raft-analog, plan applier)
 stays on CPU exactly as nomad/plan_apply.go stays authoritative.
+
+This module is also the repo's ONE home for sharding intent (ISSUE 15):
+``SPEC_GROUPS`` declares the intended ``PartitionSpec`` per dispatch tree
+group, every ``Mesh`` is built by a factory here, and every
+``jax.device_put`` carrying a ``NamedSharding`` lives here -- enforced
+statically by nomadlint's spec-declared / mesh-factory / no-implicit-put
+rules and at runtime by the sharding-discipline sanitizer
+(nomad_tpu/shardcheck.py), which compares what XLA actually did against
+what this registry declares.
 """
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import numpy as np
+
+
+def _single_flight(fn):
+    """Serialize program-factory invocations: lru_cache does not
+    single-flight, so two pipelined generations racing one cold
+    (mesh, statics) bucket would both trace/compile the program --
+    wasted seconds of XLA work and jitcheck's fresh-identical-closure
+    retrace pattern (same guard as the solver/binpack.py factories)."""
+    lock = threading.Lock()
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with lock:
+            return fn(*args, **kwargs)
+    # the lru wrapper's cache management stays reachable (tests and
+    # the jitcheck gauntlet rebuild buckets via cache_clear); not a
+    # store-derived memo, so version-keyed-memo has nothing to key
+    for attr in ("cache_clear", "cache_info"):
+        setattr(wrapped, attr, getattr(fn, attr))
+    return wrapped
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -78,6 +108,113 @@ def pick_mesh(e: int, n: int, n_devices: Optional[int] = None):
 
 
 @functools.lru_cache(maxsize=None)
+def eval_axis_mesh(n_devices: int):
+    """1D ('evals',) mesh over the first ``n_devices`` devices -- the
+    wave/wave-preempt compact transports shard only their fused eval
+    axis (per-step work is O(B); nothing N-heavy to split)."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:n_devices]), ("evals",))
+
+
+# ----------------------------------------------------------------------
+# sharding-spec registry (ISSUE 15): the declared PartitionSpec per
+# dispatch tree group.  ``shard_solver_inputs`` puts by these specs, the
+# shardcheck sanitizer compares every mesh callable's actual shardings
+# against them, and ``shardcheck --compile-audit`` prints the per-group
+# per-shard byte budgets they imply.  A spec change here IS the reviewed
+# sharding-contract change; constructing PartitionSpec/NamedSharding
+# anywhere outside nomad_tpu/parallel/ is a lint violation
+# (spec-declared).
+
+
+def const_partition_specs(c):
+    """NodeConst: per-node columns shard (evals, nodes); per-eval
+    scalars/tables without a node axis shard (evals) only."""
+    from jax.sharding import PartitionSpec as P
+
+    return type(c)(
+        cpu_cap=P("evals", "nodes"), mem_cap=P("evals", "nodes"),
+        disk_cap=P("evals", "nodes"), feasible=P("evals", "nodes"),
+        affinity=P("evals", "nodes"), has_affinity=P("evals"),
+        distinct_hosts=P("evals"), distinct_job_level=P("evals"),
+        spread_vidx=P("evals", None, "nodes"),
+        spread_desired=P("evals"), spread_has_targets=P("evals"),
+        spread_weights=P("evals"), spread_sum_weights=P("evals"),
+        n_spreads=P("evals"),
+        dp_vidx=P("evals", None, "nodes"), dp_limit=P("evals"),
+        dp_tg_scope=P("evals"),
+        dev_aff=P("evals", None, None, "nodes"),
+        dev_count=P("evals"), dev_sum_weight=P("evals"),
+        mhz_per_core=P("evals", "nodes"))
+
+
+def state_partition_specs(s):
+    """NodeState: usage columns shard (evals, nodes); spread/distinct
+    counters are per-eval tables."""
+    from jax.sharding import PartitionSpec as P
+
+    return type(s)(
+        used_cpu=P("evals", "nodes"), used_mem=P("evals", "nodes"),
+        used_disk=P("evals", "nodes"), placed=P("evals", "nodes"),
+        placed_job=P("evals", "nodes"),
+        static_free=P("evals", "nodes"), dyn_avail=P("evals", "nodes"),
+        spread_counts=P("evals"),
+        dp_counts=P("evals"),
+        dev_free=P("evals", None, None, "nodes"),
+        cores_free=P("evals", "nodes"))
+
+
+def batch_partition_specs(b):
+    """PlacementBatch: every per-placement column is (E, P) --
+    data-parallel on the eval axis, replicated over node shards."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _leaf: P("evals"), b)
+
+
+def output_partition_specs(out):
+    """Mesh solve outputs gather fully replicated: the select/argmax
+    collectives ARE the program's sanctioned cross-shard traffic, and
+    the single bulk fetch reads identical buffers from any device."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _leaf: P(), out)
+
+
+def eval_axis_partition_specs(tree):
+    """Wave/wave-preempt compact tables: leading fused-eval axis only."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _leaf: P("evals"), tree)
+
+
+# group tag -> spec-tree builder; the tags line up with the transfer
+# ledger's tree groups (solver/xferobs.py) so the shardcheck per-shard
+# byte rows land next to the bytes they decompose
+SPEC_GROUPS = {
+    "mesh_const": const_partition_specs,
+    "mesh_init": state_partition_specs,
+    "mesh_batch": batch_partition_specs,
+    "mesh_out": output_partition_specs,
+    "compact": eval_axis_partition_specs,
+    "compact_preempt": eval_axis_partition_specs,
+}
+
+
+def declared_specs(group: str, tree):
+    """The registry's intended PartitionSpec tree for ``tree`` under
+    ``group`` (KeyError on an unregistered group: a new dispatch tree
+    group must declare its sharding here first)."""
+    return SPEC_GROUPS[group](tree)
+
+
+@_single_flight
+@functools.lru_cache(maxsize=None)
 def mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
     """One jitted mesh-sharded dense-solve program per (mesh, static
     args). jax.sharding.Mesh hashes by device grid + axis names, so
@@ -98,15 +235,16 @@ def mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
 
 
 def shard_solver_inputs(mesh, const, init, batch):
-    """NamedShardings for solve_eval_batch inputs: leading axis (E) on
-    'evals'; node-axis (last dim of per-node arrays) on 'nodes'.
+    """NamedShardings for solve_eval_batch inputs, by the registry's
+    declared specs: leading axis (E) on 'evals'; node-axis (last dim of
+    per-node arrays) on 'nodes'.
 
     Sharded puts bypass the device-resident const cache (it pins
     unsharded single-device buffers), but they still report their
     payload so ``nomad.solver.dispatch_bytes`` covers every transport
     path."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
     from ..solver import xferobs
     from ..solver.constcache import note_dispatch_bytes
@@ -124,42 +262,36 @@ def shard_solver_inputs(mesh, const, init, batch):
         for tree in (const, init, batch)
         for leaf in jax.tree_util.tree_leaves(tree)))
 
-    def shard_const(c):
-        specs = type(c)(
-            cpu_cap=P("evals", "nodes"), mem_cap=P("evals", "nodes"),
-            disk_cap=P("evals", "nodes"), feasible=P("evals", "nodes"),
-            affinity=P("evals", "nodes"), has_affinity=P("evals"),
-            distinct_hosts=P("evals"), distinct_job_level=P("evals"),
-            spread_vidx=P("evals", None, "nodes"),
-            spread_desired=P("evals"), spread_has_targets=P("evals"),
-            spread_weights=P("evals"), spread_sum_weights=P("evals"),
-            n_spreads=P("evals"),
-            dp_vidx=P("evals", None, "nodes"), dp_limit=P("evals"),
-            dp_tg_scope=P("evals"),
-            dev_aff=P("evals", None, None, "nodes"),
-            dev_count=P("evals"), dev_sum_weight=P("evals"),
-            mhz_per_core=P("evals", "nodes"))
+    def put(group, tree):
+        specs = declared_specs(group, tree)
         return jax.tree.map(
             lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
-            c, specs)
+            tree, specs)
 
-    def shard_state(s):
-        specs = type(s)(
-            used_cpu=P("evals", "nodes"), used_mem=P("evals", "nodes"),
-            used_disk=P("evals", "nodes"), placed=P("evals", "nodes"),
-            placed_job=P("evals", "nodes"),
-            static_free=P("evals", "nodes"), dyn_avail=P("evals", "nodes"),
-            spread_counts=P("evals"),
-            dp_counts=P("evals"),
-            dev_free=P("evals", None, None, "nodes"),
-            cores_free=P("evals", "nodes"))
-        return jax.tree.map(
-            lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
-            s, specs)
+    return (put("mesh_const", const), put("mesh_init", init),
+            put("mesh_batch", batch))
 
-    def shard_batch(b):
-        return jax.tree.map(
-            lambda leaf: jax.device_put(
-                leaf, NamedSharding(mesh, P("evals"))), b)
 
-    return shard_const(const), shard_state(init), shard_batch(batch)
+def shard_eval_axis(trees, tag: str = "compact"):
+    """Device-put a tuple of (possibly nested) arrays, sharding the
+    leading eval axis across ALL attached devices. The fused eval axis
+    is embarrassingly data-parallel: each chip runs its lanes' scans
+    independently (no collectives; outputs gather on fetch). Callers
+    (solver/binpack.py ``_put_eval_sharded``) gate on divisibility;
+    ``tag`` is the transfer ledger's tree-group attribution."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..solver import xferobs
+    from ..solver.constcache import note_dispatch_bytes
+
+    mesh = eval_axis_mesh(jax.device_count())
+    total = sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(trees))
+    note_dispatch_bytes(total)
+    xferobs.note_payload(tag, total)
+    sharding = NamedSharding(mesh, P("evals"))
+    return tuple(
+        jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
+        for t in trees)

@@ -302,16 +302,40 @@ class EvalBroker:
         concurrently (the reference broker's pending-per-job invariant).
         This is the coalescing entry point the batched solver needs
         (SURVEY.md section 7 hard part 5); the reference contract is
-        one-eval-per-dequeue (eval_broker.go:354)."""
+        one-eval-per-dequeue (eval_broker.go:354).
+
+        The blocking first pop and the greedy drain happen under ONE
+        lock acquisition (ISSUE 15 deflake, found via the controlled-
+        schedule explorer): the old two-step -- dequeue() returning,
+        then re-acquiring the lock to drain -- left a window where the
+        OTHER overlapping batch worker's blocking dequeue popped the
+        second eval of an atomically-enqueued burst, splitting it into
+        two 1-lane batches and defeating exactly the coalescing this
+        entry point exists for (the cross-lane fixpoint only sees
+        conflicts inside one fused generation)."""
+        from ..faultinject import faults
+        faults.fire("broker.dequeue")   # chaos: stall/error the feed
         out: List[Tuple[Evaluation, str]] = []
-        ev, token = self.dequeue(schedulers, timeout=timeout)
-        if ev is None:
-            return out
-        out.append((ev, token))
-        jobs = {(ev.namespace, ev.job_id)}
+        deadline = time.time() + timeout if timeout is not None else None
         with self._lock:
-            while len(out) < max_k:
+            while True:
+                if not self.enabled:
+                    return out
                 self._check_nack_timeouts_locked()
+                popped = self._pop_ready_locked(schedulers)
+                if popped is not None:
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return out
+                    self._lock.wait(min(remaining, 0.5))
+                else:
+                    self._lock.wait(0.5)
+            ev, token = popped
+            out.append((ev, token))
+            jobs = {(ev.namespace, ev.job_id)}
+            while len(out) < max_k:
                 popped = self._pop_ready_locked(schedulers,
                                                 exclude_jobs=jobs)
                 if popped is None:

@@ -30,6 +30,7 @@ shuffled_order); callers map chosen indexes back to node ids.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -37,6 +38,29 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import jitcheck
+
+
+def _single_flight(fn):
+    """Serialize invocations of a program factory: functools.lru_cache
+    does NOT single-flight, so two pipelined generations hitting one
+    COLD shape bucket concurrently would both execute the factory --
+    a duplicated multi-second XLA trace/compile of the same program,
+    and exactly the fresh-identical-closure-per-call pattern jitcheck
+    flags as a steady-state retrace (found by the dispatch-pipeline
+    overlap test racing a cold wave bucket).  Warm lookups pay one
+    uncontended lock acquire per dispatch."""
+    lock = threading.Lock()
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with lock:
+            return fn(*args, **kwargs)
+    # the lru wrapper's cache management stays reachable (tests and
+    # the jitcheck gauntlet rebuild buckets via cache_clear); not a
+    # store-derived memo, so version-keyed-memo has nothing to key
+    for attr in ("cache_clear", "cache_info"):
+        setattr(wrapped, attr, getattr(fn, attr))
+    return wrapped
 
 MAX_SKIP = 3               # select.go maxSkip
 SKIP_THRESHOLD = 0.0       # select.go skipScoreThreshold
@@ -889,6 +913,7 @@ def _fuse_trees(trees):
     return stacked, tuple(metas), treedef, group_keys
 
 
+@_single_flight
 @functools.lru_cache(maxsize=None)
 def _make_fused_fn(metas, treedef, group_keys, spread_alg: bool,
                    dtype_name: str, preempt: bool, batched: bool):
@@ -2471,6 +2496,7 @@ def _solve_wave_preempt_impl(compact, cand, scal_f, scal_i, pen, counts0,
     return chosen, scores, n_yielded, evict_rows
 
 
+@_single_flight
 @functools.lru_cache(maxsize=None)
 def _wave_preempt_program(cm_shape, cd_shape, c0_shape,
                           spread_alg: bool, dtype_name: str,
@@ -2587,8 +2613,7 @@ def _put_eval_sharded(batched: bool, e_dim: int, trees,
     ``tag`` is the transfer ledger's tree-group attribution for these
     tables (the wave transports ship merged compact tables that can't
     decompose into const/init/batch)."""
-    from . import xferobs
-    from .constcache import device_put_cached, note_dispatch_bytes
+    from .constcache import device_put_cached
 
     if not (batched and jax.device_count() > 1
             and e_dim % jax.device_count() == 0):
@@ -2596,19 +2621,15 @@ def _put_eval_sharded(batched: bool, e_dim: int, trees,
         buffers, _ = device_put_cached(leaves, version=cache_version,
                                        tags=[tag] * len(leaves))
         return jax.tree_util.tree_unflatten(treedef, buffers)
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec
-    mesh = Mesh(np.asarray(jax.devices()), ("evals",))
-    sharding = NamedSharding(mesh, PartitionSpec("evals"))
-    total = sum(
-        np.asarray(leaf).nbytes
-        for leaf in jax.tree_util.tree_leaves(trees))
-    note_dispatch_bytes(total)
-    xferobs.note_payload(tag, total)
-    return tuple(
-        jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), t)
-        for t in trees)
+    # sharded route: the mesh factory, the PartitionSpec and the
+    # NamedSharding put all live in parallel/mesh.py (the sharding-spec
+    # registry; nomadlint's mesh-factory / no-implicit-put rules pin
+    # the discipline)
+    from ..parallel.mesh import shard_eval_axis
+    return shard_eval_axis(trees, tag=tag)
 
 
+@_single_flight
 @functools.lru_cache(maxsize=None)
 def _wave_compact_program(cm_shape, sp_shape, spread_alg: bool,
                           dtype_name: str, batched: bool, B: int,

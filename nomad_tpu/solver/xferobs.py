@@ -73,9 +73,10 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "enabled", "note_payload", "note_shipped", "note_fetch",
-    "note_resident_level", "begin_dispatch", "end_dispatch", "mark",
-    "span_tags", "tree_nbytes", "state", "parity", "bench_fields",
-    "counter_events", "residency_report",
+    "note_resident_level", "note_shard_bytes", "begin_dispatch",
+    "end_dispatch", "mark", "span_tags", "tree_nbytes", "state",
+    "parity", "shard_parity", "bench_fields", "counter_events",
+    "residency_report",
 ]
 
 # dispatches slower than this are XLA compiles, not transfers (the
@@ -206,6 +207,14 @@ class _Ledger:
             # group -> [shipped_bytes, resident_bytes,
             #           shipped_arrays, resident_arrays]
             self._groups: Dict[str, List[int]] = {}
+            # per-shard rows (ISSUE 15, recorded by the shardcheck
+            # sanitizer): group -> device label -> [declared_bytes,
+            # actual_bytes] -- declared derives from the spec registry
+            # (parallel/mesh.py SPEC_GROUPS), actual from the array's
+            # real sharding; shard_parity() is the zero-tolerance
+            # reconciliation between them (a replicated-when-declared-
+            # sharded fleet table breaks it on every device row)
+            self._shard_rows: Dict[str, Dict[str, List[int]]] = {}
             # fetch tag -> [bytes, fetches]
             self._fetches: Dict[str, List[int]] = {}
             self._shipped_mirror = 0   # note_shipped reconciliation base
@@ -276,6 +285,24 @@ class _Ledger:
             self._resident_level = int(nbytes)
             if nbytes > self._resident_hwm:
                 self._resident_hwm = int(nbytes)
+
+    def note_shard_bytes(self, group: str, device: str,
+                         declared: int, actual: int) -> None:
+        with self._lock:
+            rows = self._shard_rows.get(group)
+            if rows is None:
+                rows = self._shard_rows[group] = {}
+            row = rows.get(device)
+            if row is None:
+                row = rows[device] = [0, 0]
+            row[0] += int(declared)
+            row[1] += int(actual)
+
+    def shard_parity(self) -> int:
+        with self._lock:
+            return sum(abs(row[0] - row[1])
+                       for rows in self._shard_rows.values()
+                       for row in rows.values())
 
     # -- dispatch records -----------------------------------------------
     def begin_dispatch(self, **meta) -> None:
@@ -355,6 +382,14 @@ class _Ledger:
                       for g, v in sorted(self._groups.items())}
             fetches = {g: {"bytes": v[0], "fetches": v[1]}
                        for g, v in sorted(self._fetches.items())}
+            per_shard = {
+                g: {d: {"declared_bytes": row[0], "actual_bytes": row[1]}
+                    for d, row in sorted(rows.items())}
+                for g, rows in sorted(self._shard_rows.items())}
+            shard_parity = sum(
+                abs(row[0] - row[1])
+                for rows in self._shard_rows.values()
+                for row in rows.values())
             tagged = sum(v[0] for v in self._groups.values())
             resident = sum(v[1] for v in self._groups.values())
             fetched = sum(v[0] for v in self._fetches.values())
@@ -362,6 +397,8 @@ class _Ledger:
             return {
                 "groups": groups,
                 "fetches": fetches,
+                "per_shard": per_shard,
+                "shard_parity_bytes": shard_parity,
                 "shipped_bytes_total": tagged,
                 "resident_bytes_total": resident,
                 "fetched_bytes_total": fetched,
@@ -428,6 +465,18 @@ def note_resident_level(nbytes: int) -> None:
     if not enabled():
         return
     _LEDGER.note_resident_level(nbytes)
+
+
+def note_shard_bytes(group: str, device: str, declared: int,
+                     actual: int) -> None:
+    """One per-shard ledger row for a mesh tree group (ISSUE 15):
+    ``declared`` bytes the spec registry says this device should hold
+    vs ``actual`` bytes its real sharding gives it.  Recorded by the
+    shardcheck sanitizer's wrapped mesh transports; absent (and this a
+    no-op) when neither observatory is on."""
+    if not enabled():
+        return
+    _LEDGER.note_shard_bytes(group, device, declared, actual)
 
 
 def begin_dispatch(**meta) -> None:
@@ -517,6 +566,16 @@ def parity() -> int:
     if not enabled():
         return 0
     return _LEDGER.parity()
+
+
+def shard_parity() -> int:
+    """Sum over the per-shard rows of |declared - actual| bytes.  0 =
+    every mesh shard holds exactly what the spec registry declares;
+    anything else is a sharding-layout drift (e.g. a silently
+    replicated fleet table burning N x the per-shard budget)."""
+    if not enabled():
+        return 0
+    return _LEDGER.shard_parity()
 
 
 def residency_report(top: int = 12) -> dict:

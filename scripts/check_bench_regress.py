@@ -74,6 +74,13 @@ HEADLINE_FIELDS = {
     "state_journal_gaps": ("lower", 0.0),
     "state_write_skews": ("lower", 0.0),
     "state_stale_memos": ("lower", 0.0),
+    # sharding discipline (ISSUE 15): all three are 0 on a healthy
+    # round; any positive count vs a zero round is a regression (a
+    # replicated-when-declared-sharded table, a silent reshard into a
+    # mesh callable, or an unbudgeted steady-state collective crept in)
+    "shard_spec_drift": ("lower", 0.0),
+    "shard_implicit_xfer": ("lower", 0.0),
+    "shard_collective_excess": ("lower", 0.0),
     # transfer observatory (ISSUE 13): the per-dispatch payload must
     # not bloat (ROADMAP-4 wants it SHRINKING toward KB), the fitted
     # link must not slow down, and the ledger's byte parity vs

@@ -1,14 +1,16 @@
 #!/usr/bin/env python3
-"""check_sanitizer_gates: the four tier-1 sanitizer fixtures cover the
-suites they claim (ISSUE 11 satellite; ISSUE 12 added the fourth).
+"""check_sanitizer_gates: the five tier-1 sanitizer fixtures cover the
+suites they claim (ISSUE 11 satellite; ISSUE 12 added the fourth,
+ISSUE 15 the fifth).
 
 The conftest sanitizer fixtures (``_lockcheck_sanitizer``,
 ``_jitcheck_sanitizer``, ``_statecheck_sanitizer``,
-``_schedcheck_explorer``) gate whole suites: a suite silently dropping
+``_schedcheck_explorer``, ``_shardcheck_sanitizer``) gate whole
+suites: a suite silently dropping
 out of its ``_*_SUITES`` set -- a rename, a typo, a merge accident --
 removes the gate without failing anything.  This script asserts:
 
-  * each of the four ``_*_SUITES`` assignments exists in
+  * each of the five ``_*_SUITES`` assignments exists in
     tests/conftest.py and is a set of string literals;
   * every suite a set names exists as ``tests/<name>.py`` (a claimed
     gate over a deleted/renamed module covers nothing);
@@ -45,6 +47,9 @@ EXPECTED = {
     }),
     "_SCHEDCHECK_SUITES": ("_schedcheck_explorer", {
         "test_batch_worker", "test_plan_batch", "test_churn_storm",
+    }),
+    "_SHARDCHECK_SUITES": ("_shardcheck_sanitizer", {
+        "test_multichip_dryrun", "test_dispatch_pipeline",
     }),
 }
 

@@ -88,6 +88,27 @@ snapshot-isolation sanitizer nomad_tpu/statecheck.py):
                      wholesale rebuilds (statecheck check c is the
                      runtime twin)
 
+Shard-hygiene rules (ISSUE 15, the static complement of the
+sharding-discipline sanitizer nomad_tpu/shardcheck.py):
+
+  spec-declared      ``PartitionSpec`` / ``NamedSharding`` are only
+                     constructed inside ``nomad_tpu/parallel/`` -- the
+                     spec registry (parallel/mesh.py ``SPEC_GROUPS``)
+                     is the ONE home for sharding intent; an inline
+                     spec elsewhere is a sharding contract no
+                     sanitizer compares against
+  mesh-factory       ``jax.sharding.Mesh`` is only constructed by the
+                     parallel/ factories (``make_mesh`` /
+                     ``pick_mesh`` / ``eval_axis_mesh``) -- an inline
+                     Mesh defeats the factory's lru-cache keying and
+                     silently forks the topology the registry
+                     declares specs against
+  no-implicit-put    ``jax.device_put`` carrying a sharding argument
+                     only inside ``nomad_tpu/parallel/`` -- everything
+                     else routes through ``shard_solver_inputs`` /
+                     ``device_put_cached`` so the transfer ledger and
+                     the per-shard byte rows see every sharded upload
+
 Schedule-hygiene rules (ISSUE 12, the static complement of the
 deterministic schedule explorer nomad_tpu/schedcheck.py):
 
@@ -1208,6 +1229,120 @@ def rule_delta_carried(ctx: Ctx) -> List[Violation]:
     return out
 
 
+# ----------------------------------------------------------------------
+# shard-hygiene rules (ISSUE 15)
+
+_PARALLEL_DIR = os.path.join("nomad_tpu", "parallel") + os.sep
+# the runtime sanitizer inspects shardings (it never constructs puts)
+# and is allowed to name the classes it audits
+_SHARDCHECK_FILE = os.path.join("nomad_tpu", "shardcheck.py")
+
+_SHARDING_CLASSES = ("PartitionSpec", "NamedSharding", "Mesh")
+
+
+def _sharding_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local names bound to jax.sharding classes in this module
+    (``from jax.sharding import PartitionSpec as P`` binds P), so the
+    rules catch the repo's aliasing idiom, not just the full names."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.startswith("jax.sharding"):
+            for alias in node.names:
+                if alias.name in _SHARDING_CLASSES:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _called_sharding_class(node: ast.Call,
+                           aliases: Dict[str, str]) -> Optional[str]:
+    """The jax.sharding class a Call constructs, or None: a direct
+    alias call (``P(...)``) or an attribute chain ending in one
+    (``jax.sharding.NamedSharding(...)``)."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return aliases.get(f.id)
+    if isinstance(f, ast.Attribute) and f.attr in _SHARDING_CLASSES:
+        recv = _unparse(f.value)
+        if recv.endswith("sharding") or recv == "jax":
+            return f.attr
+    return None
+
+
+def _shard_rule_scans(rel: str) -> bool:
+    return not (rel.startswith(_PARALLEL_DIR)
+                or rel == _SHARDCHECK_FILE)
+
+
+def rule_spec_declared(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if not _shard_rule_scans(rel):
+            continue
+        aliases = _sharding_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = _called_sharding_class(node, aliases)
+            if cls in ("PartitionSpec", "NamedSharding"):
+                out.append(Violation(
+                    "spec-declared", rel, node.lineno,
+                    f"`{cls}(...)` constructed outside "
+                    f"nomad_tpu/parallel/ -- sharding intent lives in "
+                    f"the parallel/mesh.py spec registry "
+                    f"(SPEC_GROUPS/declared_specs); an inline spec is "
+                    f"a contract shardcheck never compares against"))
+    return out
+
+
+def rule_mesh_factory(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if not _shard_rule_scans(rel):
+            continue
+        aliases = _sharding_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _called_sharding_class(node, aliases) == "Mesh":
+                out.append(Violation(
+                    "mesh-factory", rel, node.lineno,
+                    f"`Mesh(...)` constructed outside the parallel/ "
+                    f"factories -- build meshes via make_mesh/"
+                    f"pick_mesh/eval_axis_mesh so the topology stays "
+                    f"one lru-cache-keyed artifact the spec registry "
+                    f"declares against"))
+    return out
+
+
+def rule_no_implicit_put(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, _text, tree in ctx.files:
+        if not _shard_rule_scans(rel):
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, (ast.Name, ast.Attribute))):
+                continue
+            name = (node.func.id if isinstance(node.func, ast.Name)
+                    else node.func.attr)
+            if name != "device_put":
+                continue
+            shard_args = [a for a in node.args[1:]] + [
+                k.value for k in node.keywords
+                if k.arg in ("device", "sharding", "out_shardings")]
+            if any(re.search(r"[Ss]harding", _unparse(a))
+                   for a in shard_args):
+                out.append(Violation(
+                    "no-implicit-put", rel, node.lineno,
+                    f"`device_put` with a sharding argument outside "
+                    f"nomad_tpu/parallel/ -- route sharded uploads "
+                    f"through shard_solver_inputs/shard_eval_axis (or "
+                    f"device_put_cached for unsharded buffers) so the "
+                    f"transfer ledger's per-shard rows see them"))
+    return out
+
+
 AST_RULES = {
     "fire-registered": rule_fire_registered,
     "killswitch-tested": rule_killswitch_tested,
@@ -1226,6 +1361,9 @@ AST_RULES = {
     "join-with-timeout": rule_join_with_timeout,
     "no-sleep-sync": rule_no_sleep_sync,
     "daemon-declared": rule_daemon_declared,
+    "spec-declared": rule_spec_declared,
+    "mesh-factory": rule_mesh_factory,
+    "no-implicit-put": rule_no_implicit_put,
 }
 # ids a violation may carry (for --rule selection and waiver matching)
 RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
@@ -1234,7 +1372,8 @@ RULE_IDS = ("fire-registered", "killswitch-tested", "telemetry-literal",
             "frozen-memo", "fetch-accounted", "no-direct-table-write",
             "version-keyed-memo",
             "no-snapshot-escape", "delta-carried", "join-with-timeout",
-            "no-sleep-sync", "daemon-declared")
+            "no-sleep-sync", "daemon-declared", "spec-declared",
+            "mesh-factory", "no-implicit-put")
 
 LEGACY_RULES = ("metrics-doc", "knob-doc", "bench-regress")
 

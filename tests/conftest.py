@@ -89,6 +89,21 @@ _SCHEDCHECK_SUITES = {
 }
 _SCHEDCHECK_SEEDS = (11, 23, 37, 53)
 
+# The mesh-dispatching suites run under the sharding-discipline
+# sanitizer in tier-1 (ISSUE 15): a spec drift (actual sharding !=
+# the parallel/mesh.py registry's declaration, e.g. a silently
+# replicated fleet table) or an implicit transfer (host array /
+# differently-sharded array entering a mesh callable) FAILS the test;
+# collective-budget excess and per-shard byte-parity findings surface
+# as warnings here (the multichip dryrun asserts all four classes
+# zero itself).  The compile-time HLO audit doubles one XLA compile
+# per mesh program, so it runs only on the dryrun (whose programs
+# already pay seconds-long compiles) and stays off for the
+# dispatch-pipeline suite.
+_SHARDCHECK_SUITES = {
+    "test_multichip_dryrun", "test_dispatch_pipeline",
+}
+
 
 @pytest.fixture(autouse=True)
 def _schedcheck_explorer(request):
@@ -147,6 +162,47 @@ def _schedcheck_explorer(request):
         pytest.fail(
             "deterministic schedule explorer found violation(s) "
             "during this test:\n" + "\n".join(problems), pytrace=False)
+
+
+@pytest.fixture(autouse=True)
+def _shardcheck_sanitizer(request):
+    if request.module.__name__ not in _SHARDCHECK_SUITES:
+        yield
+        return
+    from nomad_tpu import shardcheck
+
+    hlo_prev = os.environ.get("NOMAD_TPU_SHARDCHECK_HLO")
+    if request.module.__name__ != "test_multichip_dryrun":
+        os.environ["NOMAD_TPU_SHARDCHECK_HLO"] = "0"
+    shardcheck.enable()
+    try:
+        yield
+        st = shardcheck.state()
+    finally:
+        shardcheck.disable()
+        shardcheck._reset_for_tests()
+        if hlo_prev is None:
+            os.environ.pop("NOMAD_TPU_SHARDCHECK_HLO", None)
+        else:
+            os.environ["NOMAD_TPU_SHARDCHECK_HLO"] = hlo_prev
+    for v in (st["collective_excess"] + st["shard_parity_reports"]):
+        warnings.warn(f"shardcheck finding (report-only here): {v}")
+    problems = []
+    for r in st["spec_drift"]:
+        problems.append(
+            f"SPEC DRIFT ({r['kind']}) {r['group']}.{r['field']}: "
+            f"declared {r.get('declared')} actual {r.get('actual')} "
+            f"(amplification {r.get('amplification_bytes')} bytes)\n"
+            f"{r.get('stack', '')}")
+    for r in st["implicit_xfers"]:
+        problems.append(
+            f"IMPLICIT TRANSFER ({r['kind']}) {r['group']}."
+            f"{r['field']} ({r['bytes']} bytes): {r['detail']}\n"
+            f"{r.get('stack', '')}")
+    if problems:
+        pytest.fail(
+            "sharding-discipline sanitizer found violation(s) during "
+            "this test:\n" + "\n".join(problems), pytrace=False)
 
 
 @pytest.fixture(autouse=True)

@@ -104,7 +104,8 @@ def test_operator_debug_bundle(agent, tmp_path, monkeypatch):
         assert {"agent-self.json", "threads.json", "metrics.json",
                 "nodes.json", "jobs.json", "evaluations.json",
                 "monitor.log", "lockcheck.json", "jitcheck.json",
-                "statecheck.json"} <= names
+                "statecheck.json", "schedcheck.json",
+                "shardcheck.json"} <= names
         for member in tar.getmembers():
             if member.name.endswith("agent-self.json"):
                 self_info = json.load(tar.extractfile(member))

@@ -71,19 +71,37 @@ def test_dequeue_batch_distinct_jobs():
 
 def test_batched_evals_fuse_into_one_dispatch():
     """K jobs registered together must place via a fused multi-lane
-    dispatch (batch_lanes sample > 1), with every alloc correct."""
+    dispatch (batch_lanes sample > 1), with every alloc correct.
+
+    Deflake (ISSUE 15 satellite): the fuse-width assert depends on the
+    evals actually RENDEZVOUSING in one broker dequeue -- but
+    register_job enqueues each eval under its own broker lock
+    acquisition, so on a 1-core host a polling batch worker could
+    dequeue job 0 alone before jobs 1..3 existed and legally fuse a
+    1-lane dispatch (~1/5 runs).  Enqueue all four evals ATOMICALLY
+    (one enqueue_all, the same idiom the fixpoint test uses): any
+    dequeue_batch now sees all four distinct jobs or none, which is
+    the pipeline condition the `lanes >= 2` assert actually depends
+    on, instead of a thread-timing race."""
+    from nomad_tpu.structs import Evaluation, generate_uuid
+
     metrics.reset()
     server, nodes = make_server(n_nodes=8, width=4)
     try:
         jobs = []
+        evs = []
         for i in range(4):
             job = mock.job(id=f"batch-job-{i}")
             job.task_groups[0].count = 3
             jobs.append(job)
-        # register together so the broker has them all ready before the
-        # batch worker's next dequeue
-        for job in jobs:
-            server.register_job(job)
+            server.state.upsert_job(job)
+            evs.append(Evaluation(
+                id=generate_uuid(), namespace=job.namespace,
+                priority=job.priority, type=job.type,
+                triggered_by="job-register", job_id=job.id,
+                status="pending"))
+        server.state.upsert_evals(evs)
+        server.broker.enqueue_all(evs)
         for job in jobs:
             wait_until(lambda j=job: len(committed_allocs(server, j)) == 3,
                        msg=f"{job.id} placed")
@@ -171,7 +189,21 @@ def test_cross_lane_fixpoint_avoids_applier_retry():
     node, with spare capacity elsewhere: the barrier's conflict fixpoint
     must settle the loser onto the spare node BEFORE plan submission, so
     the applier commits both plans with zero rejections (no retry round
-    trips through the broker)."""
+    trips through the broker).
+
+    Deflake (ISSUE 15 satellite): the `fixpoint_conflicts >= 1` assert
+    depends on both evals solving in ONE barrier generation -- the
+    fuse-width condition.  On a cold process the first eval's packing
+    path pays the jit warmup, so the 10s straggler valve could fire
+    and dispatch the early arriver ALONE: each eval then picks its
+    node sequentially, no conflict ever happens, and the assert loses
+    to thread timing (the test failed deterministically when run
+    standalone, and ~1/5 in-suite on the 1-core host).  Widening the
+    straggler valve for the test makes the barrier actually await the
+    rendezvous the assert depends on; the valve's own semantics have
+    their own test below."""
+    from nomad_tpu.solver import batch as batch_mod
+
     metrics.reset()
     # one TIGHT node (fits exactly one 500cpu/256mb mock alloc; best-fit
     # scores it highest for BOTH evals regardless of shuffle order) plus
@@ -183,6 +215,8 @@ def test_cross_lane_fixpoint_avoids_applier_retry():
     spare.node_resources.memory.memory_mb = 8192
     spare.compute_class()
     server.register_node(spare)
+    orig_timeout = batch_mod.BARRIER_TIMEOUT_S
+    batch_mod.BARRIER_TIMEOUT_S = 120.0
     try:
         from nomad_tpu.structs import Evaluation, generate_uuid
 
@@ -217,6 +251,7 @@ def test_cross_lane_fixpoint_avoids_applier_retry():
             "nomad.solver.fixpoint_conflicts", 0) >= 1, \
             sorted(snap["counters"])
     finally:
+        batch_mod.BARRIER_TIMEOUT_S = orig_timeout
         server.shutdown()
 
 

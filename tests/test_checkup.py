@@ -1,0 +1,86 @@
+"""checkup driver gate (ISSUE 15 satellite): one entry point, one
+combined exit code for nomadlint + knob-doc + metrics-doc +
+sanitizer-gates, with merged SARIF output.
+
+THE tier-1 gate is ``test_checkup_clean_on_real_tree``; the rest prove
+the combinator semantics (any component failing fails the run, --only
+selection, SARIF merge) without depending on the real tree being
+dirty."""
+import importlib.util
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "checkup", os.path.join(ROOT, "scripts", "checkup.py"))
+cu = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(cu)
+
+
+def test_checkup_clean_on_real_tree(capsys):
+    """THE gate: every static suite green through the one driver."""
+    assert cu.main([]) == 0, capsys.readouterr().out
+    out = capsys.readouterr().out
+    for name in ("nomadlint", "knob-doc", "metrics-doc",
+                 "sanitizer-gates"):
+        assert f"== {name}: ok" in out
+    assert "-> exit 0" in out
+
+
+def test_list_names_every_component(capsys):
+    assert cu.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in cu.COMPONENTS:
+        assert name in out
+
+
+def test_unknown_component_is_an_error(capsys):
+    assert cu.main(["--only", "no-such-thing"]) == 2
+    assert "unknown component" in capsys.readouterr().out
+
+
+def test_only_selects_a_subset(capsys):
+    assert cu.main(["--only", "sanitizer-gates"]) == 0
+    out = capsys.readouterr().out
+    assert "== sanitizer-gates: ok" in out
+    assert "nomadlint" not in out      # the others did not run
+
+
+def test_component_failure_fails_the_run(capsys, monkeypatch):
+    """Any component's nonzero rc fails the combined run, its output
+    lines surface, and its findings land in the merged SARIF."""
+    monkeypatch.setitem(
+        cu.COMPONENTS, "knob-doc",
+        lambda: (1, ["NOMAD_TPU_PLANTED missing from the knob table"],
+                 [{"ruleId": "knob-doc", "level": "error",
+                   "message": {"text": "NOMAD_TPU_PLANTED missing"},
+                   "locations": [{"physicalLocation": {
+                       "artifactLocation": {
+                           "uri": "scripts/check_knob_doc.py"},
+                       "region": {"startLine": 1}}}]}]))
+    rc = cu.main(["--only", "knob-doc", "--only", "sanitizer-gates",
+                  "--sarif", "-"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "== knob-doc: FAIL" in out
+    assert "NOMAD_TPU_PLANTED missing from the knob table" in out
+    assert "== sanitizer-gates: ok" in out
+    assert "knob-doc=FAIL" in out and "sanitizer-gates=ok" in out
+    doc = json.loads(out[out.index("{"):])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "checkup"
+    assert [r["ruleId"] for r in run["results"]] == ["knob-doc"]
+
+
+def test_sarif_merges_components_on_clean_tree(tmp_path, capsys):
+    """--sarif on a clean tree writes a valid empty-results document
+    (the CI annotation surface stays parseable either way)."""
+    out_path = tmp_path / "checkup.sarif"
+    assert cu.main(["--only", "sanitizer-gates",
+                    "--sarif", str(out_path)]) == 0
+    capsys.readouterr()
+    doc = json.loads(out_path.read_text())
+    assert doc["runs"][0]["results"] == []
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "checkup"

@@ -266,3 +266,37 @@ def test_eval_axis_padding_lanes_are_inert():
             assert (res[0] == ref[0]).all()
     finally:
         os.environ.pop("NOMAD_TPU_WAVEFRONT", None)
+
+
+def test_program_factories_single_flight():
+    """lru_cache does not single-flight: two pipelined generations
+    racing ONE cold shape bucket used to both execute the factory,
+    duplicating the XLA trace/compile and constructing two identical
+    jits at one site -- the fresh-identical-closure pattern the
+    jitcheck fixture (correctly) failed as a steady-state retrace the
+    moment the overlap test raced a cold wave bucket. The factories
+    now serialize invocations: every concurrent cold caller must get
+    THE SAME program object."""
+    import threading
+
+    from nomad_tpu.solver.binpack import _wave_compact_program
+
+    # a shape-bucket key no other test uses: genuinely cold
+    key = ((7, 64, 9), (0, 7), False, "float32", True, 16, False)
+    results = [None] * 8
+    start = threading.Barrier(8)
+
+    def racer(i):
+        start.wait()
+        results[i] = _wave_compact_program(*key)
+
+    threads = [threading.Thread(target=racer, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        while t.is_alive():
+            t.join(timeout=5.0)
+    assert all(r is results[0] for r in results), results
+    # warm path: same object again, no rebuild
+    assert _wave_compact_program(*key) is results[0]

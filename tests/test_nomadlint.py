@@ -823,6 +823,109 @@ def test_daemon_declared_fires_without_kwarg(tmp_path):
     assert kept[0].line == 5
 
 
+# ----------------------------------------------------------------------
+# shard-hygiene rules (ISSUE 15)
+
+
+def test_spec_declared_fires_outside_parallel(tmp_path):
+    """An inline PartitionSpec/NamedSharding outside nomad_tpu/parallel/
+    is a sharding contract the registry (and shardcheck) never sees --
+    including the repo's `as P` aliasing idiom."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def put(mesh, x, jax):
+                spec = P("evals", "nodes")                 # BAD
+                return jax.device_put(x, NamedSharding(mesh, spec))
+            """,
+        "nomad_tpu/parallel/mesh.py": """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def declared(mesh):
+                return NamedSharding(mesh, P("evals"))     # home turf
+            """,
+    })
+    kept, _ = _rules(root, ["spec-declared"])
+    assert {(v.path, v.line) for v in kept} == {
+        ("nomad_tpu/solver/mod.py", 5),
+        ("nomad_tpu/solver/mod.py", 6)}, kept
+    assert all("registry" in v.msg for v in kept)
+
+
+def test_spec_declared_waivable(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            from jax.sharding import PartitionSpec
+
+            # nomadlint: waive=spec-declared -- bench-only probe spec
+            spec = PartitionSpec("evals")
+            """,
+    })
+    kept, waived = _rules(root, ["spec-declared"])
+    assert kept == [] and waived == 1
+
+
+def test_mesh_factory_fires_on_inline_mesh(tmp_path):
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            import numpy as np
+            from jax.sharding import Mesh
+
+            def topology(jax):
+                return Mesh(np.asarray(jax.devices()), ("evals",))
+            """,
+        "nomad_tpu/parallel/mesh.py": """
+            from jax.sharding import Mesh
+
+            def make_mesh(grid):
+                return Mesh(grid, ("evals", "nodes"))      # the factory
+            """,
+    })
+    kept, _ = _rules(root, ["mesh-factory"])
+    assert len(kept) == 1, kept
+    assert kept[0].path == "nomad_tpu/solver/mod.py"
+    assert "make_mesh" in kept[0].msg
+
+
+def test_no_implicit_put_fires_on_sharded_put(tmp_path):
+    """device_put carrying a sharding outside parallel/ bypasses the
+    ledger's per-shard rows; plain (unsharded) puts stay legal
+    everywhere."""
+    root = _tree(tmp_path, {
+        "nomad_tpu/solver/mod.py": """
+            import jax
+
+            def ship(x, sharding, mesh_sharding):
+                a = jax.device_put(x, sharding)            # BAD
+                b = jax.device_put(x, device=mesh_sharding)  # BAD
+                c = jax.device_put(x)                      # plain: fine
+                d = jax.device_put(x, jax.devices()[0])    # device: fine
+                return a, b, c, d
+            """,
+        "nomad_tpu/parallel/mesh.py": """
+            import jax
+
+            def shard_eval_axis(x, sharding):
+                return jax.device_put(x, sharding)         # home turf
+            """,
+    })
+    kept, _ = _rules(root, ["no-implicit-put"])
+    assert {v.line for v in kept} == {5, 6}, kept
+    assert all(v.path == "nomad_tpu/solver/mod.py" for v in kept)
+    assert all("shard_solver_inputs" in v.msg for v in kept)
+
+
+def test_shard_hygiene_rules_clean_on_real_tree(capsys):
+    """The acceptance gate for ISSUE 15's lint half: the real tree is
+    clean under all three shard-hygiene rules (the binpack wave
+    transport now routes through parallel/mesh.py)."""
+    assert nl.main(["--rule", "spec-declared", "--rule", "mesh-factory",
+                    "--rule", "no-implicit-put"]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+
+
 def test_schedule_hygiene_rules_clean_on_real_tree(capsys):
     """The acceptance gate for ISSUE 12's lint half: the real tree is
     clean under all three schedule-hygiene rules (justified waivers

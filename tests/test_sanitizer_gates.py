@@ -1,8 +1,8 @@
 """check_sanitizer_gates gate (ISSUE 11 satellite; ISSUE 12 added the
-fourth gate): the four conftest sanitizer fixtures (lockcheck /
-jitcheck / statecheck / schedcheck) cover exactly the suites the
-pinned inventory claims, every claimed suite module exists, and drift
-in any direction fails loudly.
+fourth gate, ISSUE 15 the fifth): the five conftest sanitizer fixtures
+(lockcheck / jitcheck / statecheck / schedcheck / shardcheck) cover
+exactly the suites the pinned inventory claims, every claimed suite
+module exists, and drift in any direction fails loudly.
 """
 import importlib.util
 import os
@@ -25,11 +25,11 @@ def test_real_conftest_gates_in_place(capsys):
 
 
 def test_inventory_is_pinned():
-    """The EXPECTED inventory names all four sanitizers; growing a
-    fifth (or renaming one) is a reviewed change here too."""
+    """The EXPECTED inventory names all five sanitizers; growing a
+    sixth (or renaming one) is a reviewed change here too."""
     assert set(csg.EXPECTED) == {
         "_LOCKCHECK_SUITES", "_JITCHECK_SUITES", "_STATECHECK_SUITES",
-        "_SCHEDCHECK_SUITES"}
+        "_SCHEDCHECK_SUITES", "_SHARDCHECK_SUITES"}
     # statecheck covers the ISSUE-11 suites
     assert csg.EXPECTED["_STATECHECK_SUITES"][1] == {
         "test_plan_batch", "test_pack_delta", "test_churn_storm",
@@ -37,6 +37,10 @@ def test_inventory_is_pinned():
     # the schedule explorer covers the ISSUE-12 suites
     assert csg.EXPECTED["_SCHEDCHECK_SUITES"][1] == {
         "test_batch_worker", "test_plan_batch", "test_churn_storm"}
+    # the sharding sanitizer covers the ISSUE-15 suites (the executed
+    # multichip gate + the mesh-dispatching pipeline suite)
+    assert csg.EXPECTED["_SHARDCHECK_SUITES"][1] == {
+        "test_multichip_dryrun", "test_dispatch_pipeline"}
 
 
 def _fake_conftest(tmp_path, body):
@@ -60,6 +64,9 @@ _STATECHECK_SUITES = {
 _SCHEDCHECK_SUITES = {
     "test_batch_worker", "test_plan_batch", "test_churn_storm",
 }
+_SHARDCHECK_SUITES = {
+    "test_multichip_dryrun", "test_dispatch_pipeline",
+}
 
 
 def _lockcheck_sanitizer(request):
@@ -76,6 +83,10 @@ def _statecheck_sanitizer(request):
 
 def _schedcheck_explorer(request):
     return request in _SCHEDCHECK_SUITES
+
+
+def _shardcheck_sanitizer(request):
+    return request in _SHARDCHECK_SUITES
 """
 
 
@@ -120,7 +131,7 @@ def test_fixture_not_reading_set_fails(tmp_path, capsys):
     assert "does not read" in capsys.readouterr().out
 
 
-def test_unexpected_fourth_gate_fails(tmp_path, capsys):
+def test_unexpected_extra_gate_fails(tmp_path, capsys):
     body = _OK_STUB + "\n_MYSTERY_SUITES = {\"test_chaos\"}\n"
     path = _fake_conftest(tmp_path, body)
     assert csg.main(["--conftest", path,
