@@ -922,6 +922,42 @@ def time_scale_churn(mismatch):
     return out
 
 
+def time_worker_scaling(mismatch):
+    """Crash-safe N-worker control plane scaling (ISSUE 16): e2e
+    placements/s through the supervised PLAIN worker pool for each
+    size in BENCH_WSCALE_POOLS (default 1,2,4,8) at fold parity 0 via
+    benchkit.run_worker_scaling -- the proof number for ROADMAP 2a's
+    multi-worker scheduling. Skipped on BENCH_SKIP_WORKER_SCALING=1 or
+    an earlier parity failure. Returns the result dict or None."""
+    if mismatch or os.environ.get("BENCH_SKIP_WORKER_SCALING",
+                                  "") == "1":
+        return None
+    from nomad_tpu.benchkit import run_worker_scaling
+
+    pools = tuple(
+        int(s) for s in os.environ.get(
+            "BENCH_WSCALE_POOLS", "1,2,4,8").split(",") if s.strip())
+    n_nodes = int(os.environ.get("BENCH_WSCALE_NODES", "2000"))
+    jobs = int(os.environ.get("BENCH_WSCALE_JOBS", "16"))
+    per_eval = int(os.environ.get("BENCH_WSCALE_PER_EVAL", "250"))
+    try:
+        out = run_worker_scaling(
+            pool_sizes=pools, n_nodes=n_nodes, jobs=jobs,
+            per_eval=per_eval, log=log)
+    except Exception as e:  # noqa: BLE001 -- report the rest anyway
+        log(f"bench: worker-scaling run failed: {e!r}")
+        return None
+    summary = ", ".join(
+        f"N={n}: {v:.0f}/s"
+        for n, v in sorted(out["placements_per_sec"].items()))
+    log(f"bench: worker scaling ({out['placed_per_size']} placements "
+        f"per size) {summary}; best vs 1 worker "
+        f"{out['speedup_best_vs_1']:.2f}x, "
+        f"parity_mismatch={out['parity_mismatch']}"
+        f"{', TRUNCATED' if out['truncated'] else ''}")
+    return out
+
+
 def solve_once(h, job, nodes, n_placements):
     """One full TPU-path eval: host-side packing + one dense solver dispatch
     + the single device->host result fetch -- the complete per-eval latency
@@ -1245,10 +1281,14 @@ def main():
     #     (the regime production traffic actually is)
     churn = time_scale_churn(mismatch)
 
+    # --- N-worker control plane scaling: e2e placements/s through the
+    #     supervised plain worker pool for N in {1,2,4,8} (ISSUE 16)
+    wscale = time_worker_scaling(mismatch)
+
     _emit(platform, p50, mismatch, oracle_dt, native_dt, batched,
           n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
           rtt=rtt, streaming=streaming, pack_tax=pack_tax, scale=scale,
-          churn=churn, lpq=lpq)
+          churn=churn, lpq=lpq, wscale=wscale)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
@@ -1257,7 +1297,7 @@ def main():
 def _emit(platform, p50, mismatch, oracle_total, native_total=None,
           batched=None, n_placed=0, fused=None, batched_full=None,
           rtt=None, streaming=None, pack_tax=None, scale=None,
-          churn=None, lpq=None):
+          churn=None, lpq=None, wscale=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -1428,6 +1468,17 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
         out["churn_quarantine_deferrals"] = churn["quarantine_deferrals"]
         out["churn_parity_mismatch"] = churn["parity_mismatch"]
         out["churn_truncated"] = churn["truncated"]
+    if wscale is not None:
+        # N-worker control plane scaling (ISSUE 16): e2e placements/s
+        # through the supervised plain pool per size, at fold parity 0
+        # -- flat per-size fields so the regress gate can trend each N
+        out["worker_scaling_pools"] = wscale["pool_sizes"]
+        for n, v in wscale["placements_per_sec"].items():
+            out[f"worker_scaling_pps_n{n}"] = v
+        out["worker_scaling_speedup"] = wscale["speedup_best_vs_1"]
+        out["worker_scaling_parity_mismatch"] = \
+            wscale["parity_mismatch"]
+        out["worker_scaling_truncated"] = wscale["truncated"]
     # a CPU-fallback / breaker-degraded artifact must never read as a
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state

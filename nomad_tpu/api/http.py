@@ -940,6 +940,21 @@ class ApiHandler(BaseHTTPRequestHandler):
                         "node_flaps":
                             self.nomad.flaps.state()
                             if hasattr(self.nomad, "flaps") else {},
+                        # supervised worker pool (ISSUE 16): per-slot
+                        # liveness/progress, death/wedge/restart
+                        # counters; enabled=False under
+                        # NOMAD_TPU_WORKER_SUPERVISE=0
+                        "worker_pool":
+                            self.nomad.supervisor.state()
+                            if hasattr(self.nomad, "supervisor")
+                            else {},
+                        # poison-eval dead letters (ISSUE 16): evals
+                        # that exhausted their delivery limit
+                        # NOMAD_TPU_POISON_AFTER times; released via
+                        # POST /v1/operator/quarantine
+                        "eval_quarantine":
+                            self.nomad.broker.quarantine_state()
+                            if hasattr(self.nomad, "broker") else {},
                         # lock-order sanitizer report (lockcheck.py):
                         # cycles/held-across/escaped-frame findings,
                         # {"enabled": False, ...} when the checker is
@@ -1664,6 +1679,24 @@ class ApiHandler(BaseHTTPRequestHandler):
                 except (ValueError, TypeError) as e:
                     return self._error(400, str(e))
                 self._send(200, _faults.snapshot())
+            elif parts == ["v1", "operator", "quarantine"]:
+                # release poison-eval dead letters (ISSUE 16; the
+                # blanket /v1/operator POST gate above requires
+                # operator:write). Body: {"eval_id": "..."} for one,
+                # {"release_all": true} for the whole set.
+                body = self._body()
+                if body.get("release_all"):
+                    released = self.nomad.broker.release_quarantined()
+                elif body.get("eval_id"):
+                    released = self.nomad.broker.release_quarantined(
+                        body["eval_id"])
+                else:
+                    return self._error(
+                        400, "eval_id or release_all required")
+                self._send(200, {
+                    "released": released,
+                    "quarantine":
+                        self.nomad.broker.quarantine_state()})
             elif parts[:2] == ["v1", "var"] and len(parts) >= 3:
                 path = "/".join(parts[2:])
                 if not self._check(acl.allow_variable_op(ns, path, "write")):

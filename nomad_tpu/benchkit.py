@@ -631,6 +631,115 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
     }
 
 
+def run_worker_scaling(pool_sizes=(1, 2, 4, 8), n_nodes: int = 2000,
+                       jobs: int = 16, per_eval: int = 250,
+                       timeout_s: float = 300.0, log=None) -> dict:
+    """Crash-safe N-worker control plane scaling (ISSUE 16): the same
+    end-to-end placement workload (``jobs`` jobs x ``per_eval`` allocs
+    each) pushed through the supervised PLAIN worker pool at each size
+    in ``pool_sizes``, reporting e2e placements/s per size at fold
+    parity 0.  eval_batching stays OFF on purpose: the axis under test
+    is scheduler-loop parallelism across N workers racing the
+    group-commit applier (cross-worker serialization and all), not
+    dispatch fusion -- the fused path has its own headline.  A size
+    that cannot finish inside ``timeout_s`` marks the run truncated
+    (never silently published as complete)."""
+    import os
+    import time as _time
+
+    from . import mock
+    from .server import Server
+
+    def say(msg):
+        if log is not None:
+            log(msg)
+
+    total = jobs * per_eval
+    allocs_per_node = max(1, (total * 13 // 10 + n_nodes - 1)
+                          // n_nodes)
+    prev_lean = os.environ.get("NOMAD_TPU_LEAN_ALLOC_METRICS")
+    os.environ["NOMAD_TPU_LEAN_ALLOC_METRICS"] = "1"
+    pps: dict = {}
+    walls: dict = {}
+    parity_mismatch = 0
+    truncated = False
+    try:
+        for size in pool_sizes:
+            server = Server(num_workers=int(size), heartbeat_ttl=3600.0,
+                            eval_batching=False)
+            server.start()
+            try:
+                for i in range(n_nodes):
+                    n = mock.node()
+                    n.id = f"wscale-{size}-node-{i:06d}"
+                    n.node_resources.cpu.cpu_shares = \
+                        int(allocs_per_node * 16)
+                    n.node_resources.memory.memory_mb = \
+                        int(allocs_per_node * 52)
+                    n.node_resources.disk.disk_mb = \
+                        int(allocs_per_node * 16)
+                    n.compute_class()
+                    server.register_node(n)
+                batch = []
+                t0 = _time.perf_counter()
+                for k in range(jobs):
+                    job = mock.job(id=f"wscale-{size}-job-{k:04d}")
+                    tg = job.task_groups[0]
+                    tg.count = per_eval
+                    tg.ephemeral_disk.size_mb = 10
+                    tg.tasks[0].resources.cpu = 10
+                    tg.tasks[0].resources.memory_mb = 32
+                    server.register_job(job)
+                    batch.append(job)
+                deadline = _time.time() + timeout_s
+                pending = {(j.namespace, j.id) for j in batch}
+                while pending and _time.time() < deadline:
+                    for key in list(pending):
+                        ns, jid = key
+                        placed = sum(
+                            1 for a in server.state.allocs_by_job(ns,
+                                                                  jid)
+                            if a.desired_status == "run")
+                        if placed >= per_eval:
+                            pending.discard(key)
+                    if pending:
+                        _time.sleep(0.02)
+                wall = _time.perf_counter() - t0
+                if pending:
+                    truncated = True
+                    say(f"worker-scaling: pool={size} TRUNCATED "
+                        f"({len(pending)}/{jobs} jobs unplaced after "
+                        f"{timeout_s:.0f}s)")
+                placed_total = total - len(pending) * per_eval
+                walls[int(size)] = round(wall, 3)
+                pps[int(size)] = round(placed_total / wall, 2) \
+                    if wall > 0 else 0.0
+                parity_mismatch += \
+                    server.state.alloc_table.fold_parity_mismatch()
+                say(f"worker-scaling: pool={size} -> "
+                    f"{pps[int(size)]:.0f} placements/s "
+                    f"({placed_total} placed in {wall:.2f}s, "
+                    f"parity_mismatch={parity_mismatch})")
+            finally:
+                server.shutdown()
+    finally:
+        if prev_lean is None:
+            os.environ.pop("NOMAD_TPU_LEAN_ALLOC_METRICS", None)
+        else:
+            os.environ["NOMAD_TPU_LEAN_ALLOC_METRICS"] = prev_lean
+    base = pps.get(int(pool_sizes[0])) or 0.0
+    best = max(pps.values()) if pps else 0.0
+    return {
+        "pool_sizes": [int(s) for s in pool_sizes],
+        "placements_per_sec": pps,
+        "wall_s": walls,
+        "placed_per_size": total,
+        "speedup_best_vs_1": round(best / base, 3) if base else 0.0,
+        "parity_mismatch": parity_mismatch,
+        "truncated": truncated,
+    }
+
+
 def make_fleet(rng: random.Random, h, n_nodes: int,
                racks: int = RACK_COUNT, gpus: bool = False) -> List:
     """Heterogeneous fleet: 3 machine classes, rack + datacenter spread

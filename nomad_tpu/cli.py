@@ -817,6 +817,54 @@ def cmd_operator_node_flaps(args) -> int:
     return 0
 
 
+def cmd_operator_workers(args) -> int:
+    """Supervised worker pool state (rides /v1/agent/self
+    stats.worker_pool): per-slot liveness + progress-heartbeat age,
+    and the supervisor's death/wedge/restart counters (ISSUE 16)."""
+    api = _client(args)
+    st = api.get("/v1/agent/self")["stats"].get("worker_pool") or {}
+    for k in ("enabled", "stall_s", "restart_base_s", "restart_max_s",
+              "restarts_total", "deaths_detected", "wedges_detected",
+              "pending_restarts"):
+        print(f"{k:16s} = {st.get(k)}")
+    workers = st.get("workers") or []
+    print(f"workers          = {len(workers)}")
+    for w in workers:
+        print(f"  {w['name']:28s} alive={str(w['alive']).lower():5s} "
+              f"evals={w['evals_processed']:<8d} "
+              f"progress_age={w['progress_age_s']:.1f}s")
+    return 0
+
+
+def cmd_operator_evals_quarantine(args) -> int:
+    """Poison-eval dead-letter set (rides /v1/agent/self
+    stats.eval_quarantine): evals that exhausted their delivery limit
+    NOMAD_TPU_POISON_AFTER times and were pulled from the retry loop.
+    --release <id> / --release-all re-admit with a clean slate once
+    the root cause is fixed (ISSUE 16)."""
+    api = _client(args)
+    if getattr(args, "release", None) or getattr(args, "release_all",
+                                                 False):
+        body = ({"release_all": True} if args.release_all
+                else {"eval_id": args.release})
+        out = api.post("/v1/operator/quarantine", body)
+        released = out.get("released") or []
+        print(f"released {len(released)} eval(s)")
+        for eid in released:
+            print(f"  {eid}")
+        st = out.get("quarantine") or {}
+    else:
+        st = api.get("/v1/agent/self")["stats"].get(
+            "eval_quarantine") or {}
+    for k in ("poison_after", "delivery_limit", "total"):
+        print(f"{k:14s} = {st.get(k)}")
+    for rec in st.get("evals") or []:
+        print(f"  {rec['id']:34s} job={rec['job_id']:20s} "
+              f"type={rec['type']:8s} strikes={rec['strikes']:<3d} "
+              f"age={rec['age_s']:.1f}s trigger={rec['triggered_by']}")
+    return 0
+
+
 def cmd_operator_lockcheck(args) -> int:
     """Lock-order sanitizer report (rides /v1/agent/self
     stats.lockcheck): acquisition-order cycles with both witness
@@ -1725,6 +1773,21 @@ def build_parser() -> argparse.ArgumentParser:
     onode.add_parser("flaps",
                      help="per-node flap scores + active quarantines"
                      ).set_defaults(fn=cmd_operator_node_flaps)
+    op.add_parser("workers",
+                  help="supervised scheduler worker pool state "
+                  "(liveness, progress heartbeats, restarts)"
+                  ).set_defaults(fn=cmd_operator_workers)
+    oevals = op.add_parser("evals").add_subparsers(dest="sub2",
+                                                   required=True)
+    oq = oevals.add_parser("quarantine",
+                           help="poison-eval dead letters; release "
+                           "with --release <id> / --release-all")
+    oq.add_argument("--release", metavar="EVAL_ID", default=None,
+                    help="re-admit one quarantined eval")
+    oq.add_argument("--release-all", action="store_true",
+                    dest="release_all",
+                    help="re-admit every quarantined eval")
+    oq.set_defaults(fn=cmd_operator_evals_quarantine)
     olc = op.add_parser("lockcheck",
                         help="lock-order sanitizer report (cycles, "
                         "held-across, escaped-frame acquires)")

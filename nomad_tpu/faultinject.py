@@ -19,6 +19,11 @@ Points wired through the codebase:
                     tests hold "the tunnel is still wedged")
   worker.invoke     server/worker.py invoke_scheduler -- an armed error
                     nacks the eval (broker requeue must not lose it)
+  worker.crash      server/worker.py Worker.run / BatchWorker._run_batch
+                    -- an armed error KILLS the worker thread mid-eval
+                    (no nack: the leased eval is orphaned until the
+                    broker's nack-timeout sweep redelivers it; the
+                    WorkerSupervisor must restart the pool slot)
   plan.apply        server/plan_apply.py Planner.apply
   plan.commit       state/store.py apply_plan_results_batch -- fires
                     per plan BEFORE its writes stage, so an armed fault
@@ -68,6 +73,7 @@ POINTS = (
     "solver.dispatch",      # solver/guard.py (inside the watchdog)
     "solver.probe",         # solver/guard.py (breaker recovery probe)
     "worker.invoke",        # server/worker.py invoke_scheduler
+    "worker.crash",         # server/worker.py worker loops (kills thread)
     "plan.apply",           # server/plan_apply.py Planner.apply
     "plan.commit",          # state/store.py apply_plan_results_batch
     "broker.dequeue",       # server/broker.py EvalBroker.dequeue

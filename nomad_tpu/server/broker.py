@@ -39,6 +39,17 @@ class EvalBroker:
       NOMAD_TPU_STORM_RATE          deferred-release rate, evals/s (1000)
       NOMAD_TPU_BROKER_MAX_READY    ready-depth shed bound (8192; 0=off)
       NOMAD_TPU_BROKER_SHED_DELAY   re-defer delay on shed, s (0.5)
+
+    Poison-eval quarantine (ISSUE 16): an eval that exhausts its
+    ``delivery_limit`` redeliveries ``NOMAD_TPU_POISON_AFTER`` times --
+    each exhaustion is a full cycle of crashing/wedging/erroring every
+    worker that leased it -- moves to a dead-letter dict instead of the
+    failed-queue retry loop.  The queue degrades gracefully (waiting
+    evals for the job promote past it; nothing crash-loops the pool);
+    the eval stays visible via ``stats()``/``quarantine_state()`` on
+    /v1/agent/self and releasable via ``release_quarantined`` (the
+    `operator evals quarantine` CLI).  ``NOMAD_TPU_POISON_AFTER=0``
+    kills the quarantine: today's infinite failed-queue retry.
     """
 
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
@@ -56,6 +67,8 @@ class EvalBroker:
                                             "8192"))
         self.shed_delay_s = float(os.environ.get(
             "NOMAD_TPU_BROKER_SHED_DELAY", "0.5"))
+        self.poison_after = int(os.environ.get(
+            "NOMAD_TPU_POISON_AFTER", "3"))
         self._lock = threading.Condition()
         self.enabled = False
         # sched type -> heap of (-priority, seq, eval)
@@ -64,6 +77,10 @@ class EvalBroker:
         self._waiting: Dict[str, Evaluation] = {}   # dedup: pending per job
         self._evals: Dict[str, int] = {}            # eval id -> dequeue count
         self._delayed: list = []                    # (wait_until, seq, eval)
+        # poison-eval dead letters: id -> {"eval", "strikes", "at"};
+        # strikes count delivery-limit exhaustions per eval id
+        self._quarantine: Dict[str, dict] = {}
+        self._poison_strikes: Dict[str, int] = {}
         self._seq = 0
         self._stats = {"total_ready": 0, "total_unacked": 0,
                        "total_blocked": 0, "total_waiting": 0}
@@ -83,6 +100,8 @@ class EvalBroker:
                 self._waiting.clear()
                 self._evals.clear()
                 self._delayed = []
+                self._quarantine.clear()
+                self._poison_strikes.clear()
             self._lock.notify_all()
         if enabled and not was:
             self._start_delayed_watcher()
@@ -190,6 +209,8 @@ class EvalBroker:
     def _process_enqueue(self, ev: Evaluation) -> None:
         if not self.enabled:
             return
+        if ev.id in self._quarantine:
+            return  # dead-lettered: only an operator release re-admits
         if ev.id in self._evals and ev.id not in self._unack:
             return  # already tracked and ready
         if ev.wait_until and ev.wait_until > time.time():
@@ -390,6 +411,14 @@ class EvalBroker:
 
     def _requeue_or_fail_locked(self, ev: Evaluation) -> None:
         if self._evals.get(ev.id, 0) >= self.delivery_limit:
+            # one poison strike per exhausted delivery cycle: the eval
+            # burned delivery_limit leases (worker crashes, wedges past
+            # the nack timeout, or scheduler errors) without one ack
+            strikes = self._poison_strikes.get(ev.id, 0) + 1
+            self._poison_strikes[ev.id] = strikes
+            if self.poison_after and strikes >= self.poison_after:
+                self._quarantine_locked(ev, strikes)
+                return
             self._seq += 1
             self._ready.setdefault(FAILED_QUEUE, [])
             heapq.heappush(self._ready[FAILED_QUEUE],
@@ -402,6 +431,81 @@ class EvalBroker:
             heapq.heappush(self._ready[ev.type], (-ev.priority, self._seq, ev))
         self._lock.notify_all()
 
+    def _quarantine_locked(self, ev: Evaluation, strikes: int) -> None:
+        """Dead-letter a poison eval: it has exhausted its delivery
+        limit ``strikes`` times.  Never retried automatically -- the
+        operator releases it (release_quarantined) once the cause is
+        fixed; meanwhile the job's waiting evals promote past it so the
+        queue never wedges behind the poison."""
+        self._quarantine[ev.id] = {"eval": ev, "strikes": strikes,
+                                   "at": time.time()}
+        self._evals.pop(ev.id, None)
+        self._enqueued_at.pop(ev.id, None)
+        from .telemetry import metrics
+        metrics.incr("nomad.broker.eval_quarantined")
+        from .logbroker import log as _log
+        _log("error", "broker",
+             f"eval={ev.id} job={ev.job_id} QUARANTINED after "
+             f"{strikes} exhausted delivery cycles "
+             f"({self.delivery_limit} leases each); operator release "
+             f"required (`operator evals quarantine`)")
+        self._promote_waiting_locked(ev)
+        self._lock.notify_all()
+
+    def quarantine_state(self) -> dict:
+        """Operational snapshot of the dead-letter set (rides
+        /v1/agent/self and `operator evals quarantine`)."""
+        now = time.time()
+        with self._lock:
+            evals = [{"id": rec["eval"].id,
+                      "job_id": rec["eval"].job_id,
+                      "namespace": rec["eval"].namespace,
+                      "type": rec["eval"].type,
+                      "triggered_by": rec["eval"].triggered_by,
+                      "strikes": rec["strikes"],
+                      "age_s": round(now - rec["at"], 3)}
+                     for _, rec in sorted(self._quarantine.items())]
+        return {"poison_after": self.poison_after,
+                "delivery_limit": self.delivery_limit,
+                "total": len(evals), "evals": evals}
+
+    def release_quarantined(self,
+                            eval_id: Optional[str] = None) -> List[str]:
+        """Operator release: re-admit dead-lettered eval(s) with a
+        clean delivery/strike slate (eval_id=None releases all).
+        Returns the released ids."""
+        released: List[str] = []
+        with self._lock:
+            ids = [eval_id] if eval_id is not None \
+                else sorted(self._quarantine)
+            for eid in ids:
+                rec = self._quarantine.pop(eid, None)
+                if rec is None:
+                    continue
+                self._poison_strikes.pop(eid, None)
+                self._evals.pop(eid, None)
+                self._process_enqueue(rec["eval"])
+                released.append(eid)
+            if released:
+                self._lock.notify_all()
+        if released:
+            from .telemetry import metrics
+            metrics.incr("nomad.broker.quarantine_released",
+                         len(released))
+        return released
+
+    # ------------------------------------------------------------------
+    def token_outstanding(self, eval_id: str, token: str) -> bool:
+        """True iff (eval_id, token) is still THE outstanding lease.
+        The plan applier's stale-worker fence (reference: the plan
+        endpoint's EvalToken validation): a worker whose lease expired
+        into a nack-timeout redelivery -- it wedged, or its supervisor
+        gave it up for dead -- must not commit plans; the replacement
+        delivery owns the eval."""
+        with self._lock:
+            entry = self._unack.get(eval_id)
+            return entry is not None and entry[1] == token
+
     # ------------------------------------------------------------------
     def ack(self, eval_id: str, token: str) -> Optional[str]:
         """(reference: eval_broker.go:555). Releases the job's waiting eval."""
@@ -412,6 +516,8 @@ class EvalBroker:
             ev = entry[0]
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
+            # a successful delivery clears the eval's poison record
+            self._poison_strikes.pop(eval_id, None)
             self._promote_waiting_locked(ev)
             self._lock.notify_all()
             return None
@@ -444,6 +550,7 @@ class EvalBroker:
                 "total_waiting": len(self._waiting),
                 "total_delayed": len(self._delayed),
                 "total_failed": len(self._ready.get(FAILED_QUEUE, [])),
+                "total_quarantined": len(self._quarantine),
                 "by_scheduler": {s: len(h) for s, h in self._ready.items()},
             }
 

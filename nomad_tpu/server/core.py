@@ -127,6 +127,180 @@ class NodeFlapTracker(BadNodeTracker):
         }
 
 
+class WorkerSupervisor:
+    """Crash-safe scheduler worker pool (ISSUE 16, ROADMAP 2a): owns
+    health of the leader's N workers.  Each worker touches a progress
+    heartbeat (``last_progress``) every loop iteration; the supervisor
+    detects DEATH (thread exit -- a worker.crash injection, an OOM, a
+    BaseException escaping the loop) and WEDGING (no progress past
+    ``NOMAD_TPU_WORKER_STALL_S``, the PR-1 guard-watchdog shape) and
+    respawns the slot with escalating backoff (the NodeFlapTracker
+    escalation shape from PR 6: ``min(base * 2**(n-1), max)`` over
+    consecutive restarts, score reset once a replacement survives).
+
+    Exactly-once safety does NOT live here: a dead worker's leased
+    evals ride the broker's nack-timeout redelivery, and a wedged
+    worker that later wakes dies at the stale-lease fence
+    (WorkerPlanner.submit_plan).  The supervisor only restores
+    scheduling CAPACITY.  Knobs:
+
+      NOMAD_TPU_WORKER_SUPERVISE=0     kill switch: bare pool exactly
+                                       as before (no watcher thread)
+      NOMAD_TPU_WORKER_STALL_S         wedge threshold seconds (30)
+      NOMAD_TPU_WORKER_CHECK_S         health-check cadence s (0.5)
+      NOMAD_TPU_WORKER_RESTART_BASE_S  first restart backoff s (0.25)
+      NOMAD_TPU_WORKER_RESTART_MAX_S   restart backoff cap s (15)
+    """
+
+    def __init__(self, server):
+        import os
+        self.server = server
+        self.enabled = os.environ.get(
+            "NOMAD_TPU_WORKER_SUPERVISE", "1") != "0"
+        self.stall_s = float(os.environ.get(
+            "NOMAD_TPU_WORKER_STALL_S", "30"))
+        self.check_s = float(os.environ.get(
+            "NOMAD_TPU_WORKER_CHECK_S", "0.5"))
+        self.base_s = float(os.environ.get(
+            "NOMAD_TPU_WORKER_RESTART_BASE_S", "0.25"))
+        self.max_s = float(os.environ.get(
+            "NOMAD_TPU_WORKER_RESTART_MAX_S", "15"))
+        self._factory = None    # slot index -> fresh unstarted worker
+        self._stop_ev = threading.Event()
+        self._gen = 0           # bumped per begin(): stale watchers exit
+        self._thread: Optional[threading.Thread] = None
+        self._pending: Dict[int, float] = {}   # slot -> respawn time
+        self._consecutive: Dict[int, int] = {}
+        self._spawned_at: Dict[int, float] = {}
+        self.restarts_total = 0
+        self.deaths_detected = 0
+        self.wedges_detected = 0
+
+    def begin(self, factory) -> None:
+        """Start supervising ``server.workers`` (called under
+        _leader_lock right after the pool spawns; ``factory`` rebuilds
+        one worker for a slot index, same flavor as the pool)."""
+        if not self.enabled:
+            return
+        self._factory = factory
+        now = time.monotonic()
+        self._pending.clear()
+        self._consecutive.clear()
+        self._spawned_at = {i: now
+                            for i in range(len(self.server.workers))}
+        self._stop_ev.clear()
+        # a fresh watcher per leadership term: any previous term's
+        # thread sees the generation bump and exits lazily (joining it
+        # here could deadlock -- it may be waiting on _leader_lock)
+        self._gen += 1
+        self._thread = threading.Thread(
+            target=self._run, args=(self._gen,), daemon=True,
+            name=f"worker-supervisor-{self._gen}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+
+    def _run(self, gen: int) -> None:
+        import traceback
+        while not self._stop_ev.wait(self.check_s):
+            if gen != self._gen:
+                return      # superseded by a newer leadership term
+            try:
+                self._check_once()
+            except Exception:
+                from .logbroker import log as _log
+                _log("error", "server",
+                     f"worker supervisor check error: "
+                     f"{traceback.format_exc()}")
+
+    def _check_once(self) -> None:
+        from .logbroker import log as _log
+        from .telemetry import metrics
+        with self.server._leader_lock:
+            if (not self.server._leader_active.is_set()
+                    or self._stop_ev.is_set()):
+                return
+            now = time.monotonic()
+            for i, w in enumerate(self.server.workers):
+                if i in self._pending:
+                    if now >= self._pending[i]:
+                        self._respawn_locked(i)
+                    continue
+                if not w.is_alive():
+                    self.deaths_detected += 1
+                    metrics.incr("nomad.worker.supervisor_death")
+                    _log("error", "server",
+                         f"worker {w.name} DIED (thread exit); "
+                         f"restarting slot {i} with backoff")
+                    self._schedule_restart_locked(i, now)
+                    continue
+                age = now - getattr(w, "last_progress", now)
+                if self.stall_s > 0 and age > self.stall_s:
+                    self.wedges_detected += 1
+                    metrics.incr("nomad.worker.supervisor_wedge")
+                    _log("error", "server",
+                         f"worker {w.name} WEDGED ({age:.1f}s without "
+                         f"progress > stall threshold "
+                         f"{self.stall_s:.1f}s); abandoning thread and "
+                         f"restarting slot {i}")
+                    # the hung thread may never exit; stop() it, leave
+                    # it as an abandoned daemon -- its leased evals
+                    # redeliver via nack-timeout, and any plan it wakes
+                    # to submit dies at the stale-lease fence
+                    w.stop()
+                    self._schedule_restart_locked(i, now)
+                    continue
+                # healthy: once a replacement outlives the stall
+                # window, its slot's escalation score resets
+                if (self._consecutive.get(i)
+                        and now - self._spawned_at.get(i, now)
+                        > max(self.stall_s, 2 * self.base_s)):
+                    self._consecutive.pop(i, None)
+
+    def _schedule_restart_locked(self, slot: int, now: float) -> None:
+        n = self._consecutive.get(slot, 0) + 1
+        self._consecutive[slot] = n
+        hold = min(self.base_s * (2 ** (n - 1)), self.max_s)
+        self._pending[slot] = now + hold
+
+    def _respawn_locked(self, slot: int) -> None:
+        from .logbroker import log as _log
+        from .telemetry import metrics
+        self._pending.pop(slot, None)
+        w = self._factory(slot)
+        w.start()
+        self.server.workers[slot] = w
+        self._spawned_at[slot] = time.monotonic()
+        self.restarts_total += 1
+        metrics.incr("nomad.worker.supervisor_restart")
+        _log("warn", "server",
+             f"worker slot {slot} restarted as {w.name} "
+             f"(consecutive restart #{self._consecutive.get(slot, 0)})")
+
+    def state(self) -> dict:
+        """Operational snapshot (rides /v1/agent/self, shaped like the
+        node_flaps / breaker exposures)."""
+        now = time.monotonic()
+        workers = list(self.server.workers)
+        return {
+            "enabled": self.enabled,
+            "stall_s": self.stall_s,
+            "restart_base_s": self.base_s,
+            "restart_max_s": self.max_s,
+            "restarts_total": self.restarts_total,
+            "deaths_detected": self.deaths_detected,
+            "wedges_detected": self.wedges_detected,
+            "pending_restarts": len(self._pending),
+            "workers": [
+                {"name": w.name, "alive": w.is_alive(),
+                 "evals_processed": w.evals_processed,
+                 "progress_age_s": round(
+                     now - getattr(w, "last_progress", now), 3)}
+                for w in workers],
+        }
+
+
 class EventSubscription:
     """One consumer's filtered live event queue (reference:
     nomad/stream/event_broker.go Subscription)."""
@@ -205,6 +379,10 @@ class Server:
         self.eval_batching = eval_batching
         self.batch_width = batch_width or self.num_workers
         self.workers: List[Worker] = []
+        # crash-safe pool supervision (ISSUE 16): death/wedge detection
+        # + escalating-backoff restarts; NOMAD_TPU_WORKER_SUPERVISE=0
+        # keeps the bare unsupervised pool
+        self.supervisor = WorkerSupervisor(self)
         self.heartbeat_ttl = heartbeat_ttl
         self._heartbeat_deadlines: Dict[str, float] = {}
         self._hb_lock = threading.Lock()
@@ -316,12 +494,21 @@ class Server:
                     w = BatchWorker(self, i, width=self.batch_width)
                     w.start()
                     self.workers.append(w)
+                spawn = self._spawn_batch_worker
             else:
                 for i in range(self.num_workers):
                     w = Worker(self, i)
                     w.start()
                     self.workers.append(w)
+                spawn = self._spawn_worker
             self._leader_active.set()
+            self.supervisor.begin(spawn)
+
+    def _spawn_batch_worker(self, i: int) -> BatchWorker:
+        return BatchWorker(self, i, width=self.batch_width)
+
+    def _spawn_worker(self, i: int) -> Worker:
+        return Worker(self, i)
 
     def revoke_leadership(self) -> None:
         """(reference: leader.go revokeLeadership -- drain workers, disable
@@ -330,6 +517,7 @@ class Server:
             if not self._leader_active.is_set():
                 return
             self._leader_active.clear()
+            self.supervisor.stop()
             for w in self.workers:
                 w.stop()
             self.workers = []
@@ -389,6 +577,7 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        self.supervisor.stop()
         from .quality import observatory
         observatory.detach(self.state)
         if getattr(self, "wan", None) is not None:

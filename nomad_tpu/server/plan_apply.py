@@ -49,6 +49,28 @@ def _batch_window_s() -> float:
         return 0.1
 
 
+def _xworker_backoff_s() -> float:
+    """First cross-worker conflict backoff (ISSUE 16): when two
+    WORKERS' plans contend for the same nodes, the dispatcher holds its
+    next drain briefly so the in-flight commit lands and the serialized
+    plan re-verifies against fresh state instead of churning the
+    overlay re-verify path. Escalates per consecutive conflicted cycle
+    (the NodeFlapTracker shape), capped by the _MAX knob; 0 disables."""
+    try:
+        return max(0.0, float(os.environ.get(
+            "NOMAD_TPU_PLAN_XWORKER_BACKOFF_MS", "2"))) / 1e3
+    except ValueError:
+        return 0.002
+
+
+def _xworker_backoff_max_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get(
+            "NOMAD_TPU_PLAN_XWORKER_BACKOFF_MAX_MS", "20"))) / 1e3
+    except ValueError:
+        return 0.02
+
+
 class _BatchPartial(Exception):
     """A group commit landed for SOME of its plans only (per-plan staging
     failure or a transaction split). Raised out of the committer future
@@ -158,9 +180,10 @@ class _Pending:
     """One queued plan submission moving through the pipeline."""
 
     __slots__ = ("plan", "eval_updates", "event", "result", "error",
-                 "seq", "trace_ctx")
+                 "seq", "trace_ctx", "worker", "conflict_retries")
 
-    def __init__(self, plan, eval_updates, seq, trace_ctx=None):
+    def __init__(self, plan, eval_updates, seq, trace_ctx=None,
+                 worker=None):
         self.plan = plan
         self.eval_updates = eval_updates
         self.event = threading.Event()
@@ -170,6 +193,11 @@ class _Pending:
         # the submitting eval thread's trace ctx, carried EXPLICITLY so
         # the dispatcher/committer threads' spans land in its trace
         self.trace_ctx = trace_ctx
+        # submitting worker identity (thread name): distinguishes
+        # same-worker batch conflicts from CROSS-worker contention in
+        # _select_group's serialization accounting (ISSUE 16)
+        self.worker = worker
+        self.conflict_retries = 0
 
     def resolve(self, result=None, error=None) -> None:
         self.result = result
@@ -226,6 +254,14 @@ class Planner:
         self._expect_n = 0
         self._expect_rolling = 0.0
         self._expect_hard = 0.0
+        # cross-worker serialization backoff (ISSUE 16): consecutive
+        # conflicted drain cycles escalate a bounded hold before the
+        # next drain (min(base * 2**(n-1), max)); any clean cycle
+        # resets.  Serialization itself is deterministic queue order
+        # (-priority, seq): the conflicted plan retains its seq, so it
+        # drains FIRST next cycle -- retry is bounded by construction.
+        self._conflict_streak = 0
+        self._backoff_until = 0.0
         # priority plan queue (reference: plan_queue.go:99)
         self._cv = threading.Condition()
         self._heap: List[tuple] = []
@@ -247,10 +283,12 @@ class Planner:
 
     # ------------------------------------------------------------------
     def apply(self, plan: Plan,
-              eval_updates: Optional[List[Evaluation]] = None
-              ) -> PlanResult:
+              eval_updates: Optional[List[Evaluation]] = None,
+              worker: Optional[str] = None) -> PlanResult:
         """Enqueue + wait (the worker-facing contract is unchanged:
-        blocking submit, reference worker.go:650 SubmitPlan)."""
+        blocking submit, reference worker.go:650 SubmitPlan).
+        ``worker`` names the submitting pool worker (falls back to the
+        submitting thread) for cross-worker conflict accounting."""
         from ..faultinject import faults
         from .. import schedcheck
         faults.fire("plan.apply")   # chaos: raise -> eval nack/requeue
@@ -263,8 +301,12 @@ class Planner:
             if self._shutdown:
                 raise RuntimeError("planner is shut down")
             self._seq += 1
+            # worker stays None for direct (non-pool) submitters: the
+            # cross-worker counter must only tally POOL contention, not
+            # ad-hoc applier callers
             pending = _Pending(plan, eval_updates, self._seq,
-                               trace_ctx=tracer.current())
+                               trace_ctx=tracer.current(),
+                               worker=worker)
             heapq.heappush(self._heap,
                            (-plan.priority, pending.seq, pending))
             if self._expect_n > 0:
@@ -350,6 +392,14 @@ class Planner:
         the rolling window."""
         if not _batch_enabled():
             return [heapq.heappop(self._heap)[2]]
+        # cross-worker conflict backoff (bounded by the _MAX knob):
+        # holding the drain lets the in-flight commit land so the
+        # serialized plan re-verifies against fresh state
+        while not self._shutdown:
+            rem = self._backoff_until - time.monotonic()
+            if rem <= 0:
+                break
+            self._cv.wait(min(rem, _xworker_backoff_max_s()))
         while self._expect_n > 0 and not self._shutdown:
             now = time.monotonic()
             deadline = min(self._expect_rolling, self._expect_hard)
@@ -394,18 +444,46 @@ class Planner:
         claimed = np.zeros(max(table.n_nodes, 1), dtype=bool)
         claimed_unknown: set = set()
         group: List[_Pending] = []
+        group_workers: set = set()
         for k, it in enumerate(items):
             slots, unknown = self._plan_node_keys(it.plan)
             arr = np.asarray(slots, dtype=np.int64) if slots else None
             if ((arr is not None and bool(claimed[arr].any()))
                     or (unknown
                         and not claimed_unknown.isdisjoint(unknown))):
-                metrics.incr("nomad.plan.batch_conflict_serialized")
+                it.conflict_retries += 1
+                if (it.worker is not None and group_workers
+                        and it.worker not in group_workers):
+                    # node-overlapping plans from DIFFERENT pool
+                    # workers (ISSUE 16): the N-worker contention case.
+                    # Serialized deterministically in queue order (never
+                    # rejected) -- the conflicted plan keeps its seq, so
+                    # it drains first next cycle and commits against the
+                    # state this group just wrote.  The first retry
+                    # re-drains IMMEDIATELY: the group commit it
+                    # conflicted with is already in flight and verify
+                    # overlays it, so a hold would only tax the applier
+                    # loop (a flat per-conflict hold measured as a ~27%
+                    # batched-pipeline throughput drop).  Only a plan
+                    # that RE-conflicts arms the escalating bounded
+                    # backoff, giving the in-flight commit time to land.
+                    metrics.incr("nomad.plan.cross_worker_serialized")
+                    if it.conflict_retries >= 2:
+                        self._conflict_streak += 1
+                        hold = min(_xworker_backoff_s()
+                                   * (2 ** (self._conflict_streak - 1)),
+                                   _xworker_backoff_max_s())
+                        self._backoff_until = time.monotonic() + hold
+                else:
+                    metrics.incr("nomad.plan.batch_conflict_serialized")
                 return group, items[k:]
             if arr is not None:
                 claimed[arr] = True
             claimed_unknown |= unknown
             group.append(it)
+            if it.worker is not None:
+                group_workers.add(it.worker)
+        self._conflict_streak = 0
         return group, []
 
     def _process_batch(self, items: List[_Pending], inflight):

@@ -7,6 +7,7 @@ SubmitPlan :650 / UpdateEval :721 / CreateEval :760 / ReblockEval :802).
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from ..scheduler.factory import new_scheduler
@@ -19,20 +20,66 @@ from .tracing import tracer
 ALL_SCHEDULERS = ["service", "batch", "system", "sysbatch", "_core"]
 
 
+class WorkerCrash(BaseException):
+    """Injected worker death (the ``worker.crash`` fault point).
+    BaseException on purpose: it must ESCAPE the per-iteration
+    ``except Exception`` guards in the worker loops and kill the thread
+    the way a real segfault/OOM would -- no nack, no cleanup, leased
+    evals left orphaned for the broker's nack-timeout redelivery."""
+
+
+class StaleEvalToken(Exception):
+    """A worker tried to submit a plan on an expired or superseded
+    broker lease: its eval was redelivered after a nack-timeout
+    (typically because this worker wedged past the supervisor's stall
+    threshold and a replacement took over).  The plan must not commit
+    -- the outstanding delivery owns the eval now (reference:
+    plan_apply.go's EvalToken check against the broker's outstanding
+    set).  This is what makes a wedged-then-woken zombie worker safe:
+    its stale plan dies here instead of double-placing."""
+
+
+def _fire_crash_point() -> None:
+    """``worker.crash`` chaos point: an armed error kills the worker
+    thread mid-eval (contrast ``worker.invoke``, whose error takes the
+    orderly nack path).  Armed hang/delay actions pass through fire()
+    directly and wedge the loop instead -- that exercises the
+    supervisor's stall detector rather than its death detector."""
+    from ..faultinject import InjectedFault, faults
+    try:
+        faults.fire("worker.crash")
+    except InjectedFault as e:
+        raise WorkerCrash(str(e)) from e
+
+
 class WorkerPlanner:
     """Planner interface handed to schedulers; routes through the leader's
     plan applier and raft-equivalent state writes."""
 
-    def __init__(self, server, eval_token: str):
+    def __init__(self, server, eval_token: str, eval_id: str = "",
+                 worker_name: Optional[str] = None):
         self.server = server
         self.eval_token = eval_token
+        self.eval_id = eval_id
+        self.worker_name = worker_name
 
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], object]:
+        # stale-lease fence (reference: the plan applier's EvalToken
+        # check): a worker whose lease lapsed (nack-timeout redelivery
+        # after a wedge/crash) must not commit -- exactly-once placement
+        # belongs to the outstanding delivery
+        if self.eval_id and not self.server.broker.token_outstanding(
+                self.eval_id, self.eval_token):
+            metrics.incr("nomad.plan.stale_token_rejected")
+            raise StaleEvalToken(
+                f"eval {self.eval_id} lease {self.eval_token} is no "
+                f"longer outstanding; plan rejected")
         # (reference: worker.go:656 `nomad.plan.submit` -- wall time of the
         # whole submission incl. queue wait at the serialized applier)
         with metrics.measure("nomad.plan.submit"), \
                 tracer.span("plan.submit") as sp:
-            result = self.server.planner.apply(plan)
+            result = self.server.planner.apply(
+                plan, worker=self.worker_name)
             sp.tag(allocs=sum(len(v)
                               for v in result.node_allocation.values()),
                    rejected=len(result.rejected_nodes))
@@ -70,6 +117,11 @@ class Worker(threading.Thread):
                                          "sysbatch"]
         self._stop_ev = threading.Event()
         self.evals_processed = 0
+        # progress heartbeat for the WorkerSupervisor's stall detector:
+        # touched every loop iteration (idle dequeues included -- an
+        # idle worker is not wedged), so only a thread hung inside
+        # dequeue/invoke ages past NOMAD_TPU_WORKER_STALL_S
+        self.last_progress = time.monotonic()
 
     def stop(self) -> None:
         self._stop_ev.set()
@@ -79,6 +131,7 @@ class Worker(threading.Thread):
         # broker.dequeue fault point) must not silently kill the worker
         # thread and halt scheduling; same rationale as BatchWorker.run.
         while not self._stop_ev.is_set():
+            self.last_progress = time.monotonic()
             try:
                 ev, token = self.server.broker.dequeue(
                     self.schedulers, timeout=0.5)
@@ -89,6 +142,11 @@ class Worker(threading.Thread):
                 continue
             if ev is None:
                 continue
+            # chaos: an armed worker.crash kills this thread HERE --
+            # after the lease was minted, before any ack/nack path --
+            # so the eval is orphaned exactly the way a real worker
+            # death mid-eval orphans it
+            _fire_crash_point()
             try:
                 self._invoke_scheduler(ev, token)
                 err = self.server.broker.ack(ev.id, token)
@@ -110,15 +168,18 @@ class Worker(threading.Thread):
     def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
         """(reference: worker.go:610 invokeScheduler). The snapshot must be
         at least as fresh as the eval's creation (snapshotMinIndex :591)."""
-        invoke_scheduler(self.server, ev, token)
+        invoke_scheduler(self.server, ev, token, worker_name=self.name)
 
 
 def invoke_scheduler(server, ev: Evaluation, token: str,
-                     solve_hook=None, sched_factory=None) -> None:
+                     solve_hook=None, sched_factory=None,
+                     worker_name=None) -> None:
     """(reference: worker.go:610 invokeScheduler). ``sched_factory``
     overrides the factory entry used for service/batch evals -- the LPQ
     tier passes "tpu-lpq" so its evals construct through the scheduler
-    factory boundary (scheduler/factory.py) like every other tier."""
+    factory boundary (scheduler/factory.py) like every other tier.
+    ``worker_name`` identifies the owning POOL worker (not the per-eval
+    thread) for the plan applier's cross-worker conflict accounting."""
     from ..faultinject import faults
     faults.fire("worker.invoke")    # chaos: raise -> nack -> requeue
     ctx = tracer.begin(ev.id, job=ev.job_id, lane=ev.type,
@@ -129,7 +190,8 @@ def invoke_scheduler(server, ev: Evaluation, token: str,
                             min_index=ev.modify_index - 1):
             server.state.block_until(ev.modify_index - 1, timeout=2.0)
         snapshot = server.state.snapshot()
-        planner = WorkerPlanner(server, token)
+        planner = WorkerPlanner(server, token, eval_id=ev.id,
+                                worker_name=worker_name)
         sched_type = (ev.type if ev.type in
                       ("service", "batch", "system", "sysbatch")
                       else "service")
@@ -179,6 +241,10 @@ class BatchWorker(threading.Thread):
         self._stop_ev = threading.Event()
         self.evals_processed = 0
         self.batches_processed = 0
+        # supervisor progress heartbeat (see Worker.last_progress);
+        # additionally touched per completed eval thread (_run_one), so
+        # a long legitimate batch still shows progress
+        self.last_progress = time.monotonic()
 
     def stop(self) -> None:
         self._stop_ev.set()
@@ -188,6 +254,7 @@ class BatchWorker(threading.Thread):
         # iteration must not silently halt all scheduling (same rationale
         # as Server._supervised for watcher threads).
         while not self._stop_ev.is_set():
+            self.last_progress = time.monotonic()
             try:
                 self._run_batch()
             except Exception:
@@ -211,6 +278,11 @@ class BatchWorker(threading.Thread):
             self.schedulers, self.width, timeout=0.5)
         if not batch:
             return
+        # chaos: an armed worker.crash kills the whole BatchWorker here
+        # -- every eval of the just-leased batch is orphaned at once
+        # (the eval threads were never spawned, so no barrier is left
+        # waiting on a dead participant)
+        _fire_crash_point()
         metrics.sample("nomad.worker.batch_width", float(len(batch)))
         barrier = SolveBarrier(len(batch), use_mesh=self.use_mesh,
                                e_pad_hint=self.width,
@@ -249,6 +321,8 @@ class BatchWorker(threading.Thread):
             gather_s=lpq_gather_s())
         if not batch:
             return
+        # chaos: whole-batch worker death, as in _run_batch above
+        _fire_crash_point()
         metrics.sample("nomad.worker.lpq_batch_width", float(len(batch)))
         barrier = LpqBarrier(len(batch),
                              plan_group_hint=getattr(
@@ -275,7 +349,8 @@ class BatchWorker(threading.Thread):
                  sched_factory=None) -> None:
         try:
             invoke_scheduler(self.server, ev, token, solve_hook=hook,
-                             sched_factory=sched_factory)
+                             sched_factory=sched_factory,
+                             worker_name=self.name)
             self.server.broker.ack(ev.id, token)
             tracer.end(ev.id, status="complete")
         except Exception as e:
@@ -291,4 +366,5 @@ class BatchWorker(threading.Thread):
                 import traceback
                 traceback.print_exc()
         finally:
+            self.last_progress = time.monotonic()
             barrier.done()

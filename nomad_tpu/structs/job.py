@@ -72,6 +72,16 @@ JOB_MAX_PRIORITY = 100
 # runs.  The remaining freedom -- which WORKER thread mints a
 # followup eval's id -- is the eval->worker assignment, controlled
 # only under a schedcheck run (docs/OPERATIONS.md runbook).
+#
+# The seed additionally folds in a PER-NAME INCARNATION counter
+# (ISSUE 16): the supervisor respawns a crashed worker under the SAME
+# slot name, and a name-only seed would make the replacement REPLAY
+# the dead thread's uuid stream from draw #1 -- colliding alloc ids
+# across jobs and corrupting the by-job index (the worker-kill chaos
+# drill caught this).  The n-th thread to derive a given name within
+# a reseed epoch gets (base, name, n); n=0 for the first -- so every
+# non-restart run keeps the exact pre-fix sequence -- and the counter
+# resets on reseed_ids so per-test reproducibility is unaffected.
 import hashlib as _hashlib
 import os as _os
 import threading as _threading
@@ -80,14 +90,19 @@ _seed_env = _os.environ.get("NOMAD_TPU_SEED_IDS", "")
 _id_base: List[Optional[int]] = [int(_seed_env) if _seed_env else None]
 _id_epoch = [0]
 _id_tls = _threading.local()
+_id_incarnations: dict = {}
+_id_inc_lock = _threading.Lock()
 
 
 def reseed_ids(seed: int) -> None:
     """Re-pin the id stream (test hook: deterministic tie-breaks).
     The calling thread takes the base stream; every other thread
-    derives its own from (seed, thread name) on first draw."""
+    derives its own from (seed, thread name, incarnation) on first
+    draw."""
     _id_base[0] = seed
     _id_epoch[0] += 1
+    with _id_inc_lock:
+        _id_incarnations.clear()
     _id_tls.rng = random.Random(seed)
     _id_tls.epoch = _id_epoch[0]
 
@@ -101,8 +116,14 @@ def _thread_rng() -> random.Random:
         seed = uuid.uuid4().int          # unseeded: fresh entropy
     else:
         name = _threading.current_thread().name
+        with _id_inc_lock:
+            inc = _id_incarnations.get(name, 0)
+            _id_incarnations[name] = inc + 1
+        # inc=0 keeps the legacy "{base}:{name}" seed so first
+        # incarnations reproduce the exact pre-fix stream
+        tag = f"{base}:{name}" if inc == 0 else f"{base}:{name}:{inc}"
         seed = int.from_bytes(
-            _hashlib.blake2b(f"{base}:{name}".encode(),
+            _hashlib.blake2b(tag.encode(),
                              digest_size=8).digest(), "little")
     rng = random.Random(seed)
     _id_tls.rng = rng

@@ -53,6 +53,13 @@ HEADLINE_FIELDS = {
     "churn_p50_ms": ("lower", 0.25),
     "churn_p99_ms": ("lower", 0.25),
     "churn_rss_growth_mb": ("lower", 0.50),
+    # N-worker control plane scaling (ISSUE 16): e2e throughput per
+    # pool size through the supervised plain worker pool; the parity
+    # field is 0 on a healthy round (any positive count regresses)
+    "worker_scaling_pps_n1": ("higher", 0.25),
+    "worker_scaling_pps_n4": ("higher", 0.25),
+    "worker_scaling_pps_n8": ("higher", 0.25),
+    "worker_scaling_parity_mismatch": ("lower", 0.0),
     "scale_rss_mb": ("lower", 0.15),
     "quality_fragmentation": ("lower", 0.25),
     "quality_drift": ("lower", 0.50),

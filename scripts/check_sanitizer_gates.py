@@ -43,10 +43,11 @@ EXPECTED = {
     }),
     "_STATECHECK_SUITES": ("_statecheck_sanitizer", {
         "test_plan_batch", "test_pack_delta", "test_churn_storm",
-        "test_lpq",
+        "test_lpq", "test_worker_pool",
     }),
     "_SCHEDCHECK_SUITES": ("_schedcheck_explorer", {
         "test_batch_worker", "test_plan_batch", "test_churn_storm",
+        "test_worker_pool",
     }),
     "_SHARDCHECK_SUITES": ("_shardcheck_sanitizer", {
         "test_multichip_dryrun", "test_dispatch_pipeline",

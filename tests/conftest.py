@@ -72,7 +72,7 @@ _JITCHECK_SUITES = {
 # first triage round.
 _STATECHECK_SUITES = {
     "test_plan_batch", "test_pack_delta", "test_churn_storm",
-    "test_lpq",
+    "test_lpq", "test_worker_pool",
 }
 
 # The interleaving-heaviest suites (broker-fed batch workers, the
@@ -86,6 +86,7 @@ _STATECHECK_SUITES = {
 # the interposition set and the schedule degraded to best-effort).
 _SCHEDCHECK_SUITES = {
     "test_batch_worker", "test_plan_batch", "test_churn_storm",
+    "test_worker_pool",
 }
 _SCHEDCHECK_SEEDS = (11, 23, 37, 53)
 

@@ -172,8 +172,19 @@ def test_flap_storm_every_lost_alloc_replaced_exactly_once(monkeypatch):
             max_ready = max(max_ready,
                             server.broker.stats()["total_ready"])
 
-        # kill 25% for good (they never come back)
-        dead = clients[:2]
+        # kill 25% for good (they never come back) -- specifically
+        # clients whose nodes HOLD allocs.  Binpack concentrates the 12
+        # allocs on a few of the 8 nodes, so freezing an arbitrary pair
+        # could freeze only EMPTY nodes; then the storm loses nothing,
+        # because a flapped survivor can recover before its node-down
+        # eval processes (the reconciler correctly leaves allocs on a
+        # bounced-back ready node running -- no loss guarantee there).
+        # A frozen LOADED node stays down forever, so its allocs are
+        # deterministically marked lost whenever the eval runs.
+        loaded = {a.node_id for a in running()}
+        dead = sorted(clients,
+                      key=lambda c: c.node.id not in loaded)[:2]
+        assert any(c.node.id in loaded for c in dead)
         for c in dead:
             c.freeze()
         # flap the rest twice via the heartbeat fault point: a bounded

@@ -30,13 +30,14 @@ def test_inventory_is_pinned():
     assert set(csg.EXPECTED) == {
         "_LOCKCHECK_SUITES", "_JITCHECK_SUITES", "_STATECHECK_SUITES",
         "_SCHEDCHECK_SUITES", "_SHARDCHECK_SUITES"}
-    # statecheck covers the ISSUE-11 suites
+    # statecheck covers the ISSUE-11 suites (+ the ISSUE-16 pool drill)
     assert csg.EXPECTED["_STATECHECK_SUITES"][1] == {
         "test_plan_batch", "test_pack_delta", "test_churn_storm",
-        "test_lpq"}
-    # the schedule explorer covers the ISSUE-12 suites
+        "test_lpq", "test_worker_pool"}
+    # the schedule explorer covers the ISSUE-12 suites (+ ISSUE 16)
     assert csg.EXPECTED["_SCHEDCHECK_SUITES"][1] == {
-        "test_batch_worker", "test_plan_batch", "test_churn_storm"}
+        "test_batch_worker", "test_plan_batch", "test_churn_storm",
+        "test_worker_pool"}
     # the sharding sanitizer covers the ISSUE-15 suites (the executed
     # multichip gate + the mesh-dispatching pipeline suite)
     assert csg.EXPECTED["_SHARDCHECK_SUITES"][1] == {
@@ -59,10 +60,11 @@ _JITCHECK_SUITES = {
 }
 _STATECHECK_SUITES = {
     "test_plan_batch", "test_pack_delta", "test_churn_storm",
-    "test_lpq",
+    "test_lpq", "test_worker_pool",
 }
 _SCHEDCHECK_SUITES = {
     "test_batch_worker", "test_plan_batch", "test_churn_storm",
+    "test_worker_pool",
 }
 _SHARDCHECK_SUITES = {
     "test_multichip_dryrun", "test_dispatch_pipeline",
@@ -111,8 +113,9 @@ def test_dropped_suite_fails(tmp_path, capsys):
 
 def test_missing_suite_module_fails(tmp_path, capsys):
     body = _OK_STUB.replace(
-        '"test_lpq",\n}\n_SCHEDCHECK',
-        '"test_lpq", "test_never_written",\n}\n_SCHEDCHECK')
+        '"test_lpq", "test_worker_pool",\n}\n_SCHEDCHECK',
+        '"test_lpq", "test_worker_pool", "test_never_written",\n}\n'
+        '_SCHEDCHECK')
     path = _fake_conftest(tmp_path, body)
     assert csg.main(["--conftest", path,
                      "--tests-dir", os.path.join(ROOT, "tests")]) == 1

@@ -343,6 +343,38 @@ def test_reseed_keeps_single_thread_stream_stable():
     assert a == b
 
 
+def test_same_name_respawn_does_not_replay_id_stream():
+    """ISSUE 16 regression: the supervisor respawns a crashed worker
+    under the SAME slot name.  A name-only seed made the replacement
+    replay the dead thread's uuid stream from draw #1, colliding alloc
+    ids across jobs (the worker-kill chaos drill surfaced this as a
+    corrupted by-job index).  Each incarnation of a name must get a
+    fresh stream -- yet the n-th incarnation must be reproducible
+    across reseeds, so schedcheck replay still holds."""
+    from nomad_tpu.structs.job import generate_uuid, reseed_ids
+
+    def draws_in_thread(name, n):
+        out = []
+
+        def run():
+            out.extend(generate_uuid() for _ in range(n))
+
+        t = threading.Thread(target=run, name=name, daemon=True)
+        t.start()
+        t.join()
+        return out
+
+    reseed_ids(99)
+    first = draws_in_thread("scheduler-worker-1", 4)
+    respawn = draws_in_thread("scheduler-worker-1", 4)
+    assert set(first).isdisjoint(respawn)
+
+    # reproducible per incarnation: replay sees the same two streams
+    reseed_ids(99)
+    assert draws_in_thread("scheduler-worker-1", 4) == first
+    assert draws_in_thread("scheduler-worker-1", 4) == respawn
+
+
 # ----------------------------------------------------------------------
 # surfaces: CLI replay/explore, agent self, sanitizers matrix
 
