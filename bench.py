@@ -958,6 +958,138 @@ def time_worker_scaling(mismatch):
     return out
 
 
+def time_worker_scaling_ab(mismatch):
+    """NOMAD_TPU_NATIVE_CP=0 leg of the worker-scaling readout
+    (ISSUE 17): the same e2e pool harness with the native control
+    plane killed, at reduced pool sizes (BENCH_WSCALE_AB_POOLS,
+    default "1,4") -- the A/B showing what the native hot paths buy
+    the N-worker pool. Skipped on BENCH_SKIP_WORKER_SCALING=1 /
+    BENCH_SKIP_WSCALE_AB=1 or an earlier parity failure."""
+    if mismatch or os.environ.get("BENCH_SKIP_WORKER_SCALING",
+                                  "") == "1" \
+            or os.environ.get("BENCH_SKIP_WSCALE_AB", "") == "1":
+        return None
+    from nomad_tpu.benchkit import run_worker_scaling
+
+    pools = tuple(
+        int(s) for s in os.environ.get(
+            "BENCH_WSCALE_AB_POOLS", "1,4").split(",") if s.strip())
+    n_nodes = int(os.environ.get("BENCH_WSCALE_NODES", "2000"))
+    jobs = int(os.environ.get("BENCH_WSCALE_JOBS", "16"))
+    per_eval = int(os.environ.get("BENCH_WSCALE_PER_EVAL", "250"))
+    prev = os.environ.get("NOMAD_TPU_NATIVE_CP")
+    os.environ["NOMAD_TPU_NATIVE_CP"] = "0"
+    try:
+        out = run_worker_scaling(
+            pool_sizes=pools, n_nodes=n_nodes, jobs=jobs,
+            per_eval=per_eval, log=log)
+    except Exception as e:  # noqa: BLE001 -- report the rest anyway
+        log(f"bench: worker-scaling A/B (native CP off) failed: {e!r}")
+        return None
+    finally:
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_NATIVE_CP", None)
+        else:
+            os.environ["NOMAD_TPU_NATIVE_CP"] = prev
+    summary = ", ".join(
+        f"N={n}: {v:.0f}/s"
+        for n, v in sorted(out["placements_per_sec"].items()))
+    log(f"bench: worker scaling A/B (NOMAD_TPU_NATIVE_CP=0) {summary}, "
+        f"parity_mismatch={out['parity_mismatch']}"
+        f"{', TRUNCATED' if out['truncated'] else ''}")
+    return out
+
+
+def time_eval_fixed(h, job, nodes, repeats=40):
+    """Per-eval FIXED-cost microbench (ISSUE 17): the control-plane
+    work an eval pays no matter how fast the solver is -- advance and
+    build a state snapshot, verify a plan's asks against the columnar
+    fold state, commit and materialize the result -- with the solver
+    entirely out of the loop (the plan's allocs are prebuilt). The
+    table is seeded to BENCH_EVAL_FIXED_SEED live allocs first: the
+    wholesale snapshot copy this microbench exists to expose is
+    O(live allocs), invisible on a near-empty table. Both arms run in
+    the SAME process/world -- ``eval_fixed_ms`` with the native control
+    plane, ``eval_fixed_nocp_ms`` with NOMAD_TPU_NATIVE_CP=0 -- so the
+    step is read within-round, immune to cross-round box noise. Each
+    iteration's commit advances the alloc journal, so the NEXT
+    iteration's snapshot exercises the real delta-advance path.
+    Returns the result dict or None; BENCH_SKIP_EVAL_FIXED=1 skips."""
+    if os.environ.get("BENCH_SKIP_EVAL_FIXED", "") == "1":
+        return None
+    from nomad_tpu import mock
+    from nomad_tpu.server.plan_apply import Planner
+
+    from nomad_tpu.structs import Plan
+
+    per_plan = int(os.environ.get("BENCH_EVAL_FIXED_ALLOCS", "50"))
+    seed = int(os.environ.get("BENCH_EVAL_FIXED_SEED", "50000"))
+    live = len(h.state.snapshot()._allocs)
+    if live < seed:
+        batch = []
+        for i in range(seed - live):
+            a = mock.alloc_for(job, nodes[i % len(nodes)], 0)
+            tr = a.allocated_resources.tasks["web"]
+            tr.cpu_shares = 1
+            tr.memory_mb = 1
+            batch.append(a)
+            if len(batch) >= 5000:
+                h.state.upsert_allocs(batch)
+                batch = []
+        if batch:
+            h.state.upsert_allocs(batch)
+
+    def one_arm(arm, native_cp):
+        prev = os.environ.get("NOMAD_TPU_NATIVE_CP")
+        if native_cp:
+            os.environ.pop("NOMAD_TPU_NATIVE_CP", None)
+        else:
+            os.environ["NOMAD_TPU_NATIVE_CP"] = "0"
+        planner = Planner(h.state)
+        times = []
+        rejected = 0
+        try:
+            for r in range(repeats):
+                # prebuild outside the timed window: alloc CONSTRUCTION
+                # is the scheduler's cost, not the control plane's
+                allocs = []
+                for i in range(per_plan):
+                    a = mock.alloc_for(
+                        job, nodes[(r * per_plan + i) % len(nodes)], 0)
+                    tr = a.allocated_resources.tasks["web"]
+                    tr.cpu_shares = 1
+                    tr.memory_mb = 1
+                    allocs.append(a)
+                t0 = time.perf_counter()
+                plan = Plan(eval_id=f"bench-fixed-{arm}{r:026d}",
+                            priority=50, job=job)
+                for a in allocs:
+                    plan.append_alloc(a)
+                result = planner.apply(plan)
+                times.append(time.perf_counter() - t0)
+                rejected += len(result.rejected_nodes)
+        finally:
+            planner.shutdown()
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_NATIVE_CP", None)
+            else:
+                os.environ["NOMAD_TPU_NATIVE_CP"] = prev
+        return statistics.median(times), rejected
+
+    p50, rejected = one_arm("a", True)
+    p50_nocp, rejected_nocp = one_arm("b", False)
+    cut = p50_nocp / p50 if p50 else 0.0
+    log(f"bench: eval fixed cost {p50 * 1e3:.2f}ms p50 native vs "
+        f"{p50_nocp * 1e3:.2f}ms NOMAD_TPU_NATIVE_CP=0 ({cut:.2f}x) "
+        f"over {repeats} evals x {per_plan} asks on a "
+        f"{max(live, seed)}-alloc table "
+        f"(rejected_nodes={rejected + rejected_nocp})")
+    return {"eval_fixed_ms": round(p50 * 1e3, 3),
+            "eval_fixed_nocp_ms": round(p50_nocp * 1e3, 3),
+            "per_plan": per_plan, "seed": max(live, seed),
+            "rejected": rejected + rejected_nocp}
+
+
 def solve_once(h, job, nodes, n_placements):
     """One full TPU-path eval: host-side packing + one dense solver dispatch
     + the single device->host result fetch -- the complete per-eval latency
@@ -1201,9 +1333,23 @@ def main():
     #     the fused measurement, so batched_full vs fused is an
     #     apples-to-apples control-plane-tax readout)
     def run_batched(tag, e_evals, per_eval):
+        # opt-in best-of-N (BENCH_BATCHED_BEST_OF): the pipeline is
+        # multi-threaded, so single draws on a contended/1-core box swing
+        # 2-4x on scheduler luck (r07/r08 notes); max throughput over a
+        # couple of complete rounds de-noises the readout. Default stays
+        # 1 -- extra rounds also inflate the cumulative xfer ledger's
+        # dispatch mix, so stamped rounds keep single-draw parity with
+        # prior artifacts unless the operator opts in.
+        best_of = max(1, int(os.environ.get("BENCH_BATCHED_BEST_OF",
+                                            "1")))
         try:
             bdt, bevals, bplaced = time_batched_path(
                 N_NODES, e_evals, per_eval)
+            for _ in range(best_of - 1):
+                dt2, ev2, pl2 = time_batched_path(
+                    N_NODES, e_evals, per_eval)
+                if dt2 > 0.0 and (bdt == 0.0 or pl2 / dt2 > bplaced / bdt):
+                    bdt, bevals, bplaced = dt2, ev2, pl2
         except Exception as e:  # noqa: BLE001 -- report the rest anyway
             log(f"bench: e2e pipeline ({tag}) failed: {e!r}")
             return None
@@ -1285,10 +1431,24 @@ def main():
     #     supervised plain worker pool for N in {1,2,4,8} (ISSUE 16)
     wscale = time_worker_scaling(mismatch)
 
+    # --- same harness, native control plane KILLED (ISSUE 17 A/B):
+    #     what the GIL-free verify/fold/materialize path buys the pool
+    wscale_ab = time_worker_scaling_ab(mismatch)
+
+    # --- per-eval fixed cost: snapshot+verify+commit with the solver
+    #     out of the loop (ISSUE 17 headline microbench); runs LAST
+    #     because it accumulates allocs into the bench world
+    eval_fixed = None
+    try:
+        eval_fixed = time_eval_fixed(h, job, nodes)
+    except Exception as e:  # noqa: BLE001 -- report the rest anyway
+        log(f"bench: eval fixed-cost probe failed: {e!r}")
+
     _emit(platform, p50, mismatch, oracle_dt, native_dt, batched,
           n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
           rtt=rtt, streaming=streaming, pack_tax=pack_tax, scale=scale,
-          churn=churn, lpq=lpq, wscale=wscale)
+          churn=churn, lpq=lpq, wscale=wscale, wscale_ab=wscale_ab,
+          eval_fixed=eval_fixed)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
@@ -1297,7 +1457,8 @@ def main():
 def _emit(platform, p50, mismatch, oracle_total, native_total=None,
           batched=None, n_placed=0, fused=None, batched_full=None,
           rtt=None, streaming=None, pack_tax=None, scale=None,
-          churn=None, lpq=None, wscale=None):
+          churn=None, lpq=None, wscale=None, wscale_ab=None,
+          eval_fixed=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -1479,6 +1640,20 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
         out["worker_scaling_parity_mismatch"] = \
             wscale["parity_mismatch"]
         out["worker_scaling_truncated"] = wscale["truncated"]
+    if wscale_ab is not None:
+        # ISSUE 17 A/B: the same pool harness with NOMAD_TPU_NATIVE_CP=0
+        # -- the native-control-plane win read directly off the artifact
+        for n, v in wscale_ab["placements_per_sec"].items():
+            out[f"worker_scaling_pps_n{n}_nocp"] = v
+        out["worker_scaling_ab_parity_mismatch"] = \
+            wscale_ab["parity_mismatch"]
+    if eval_fixed is not None:
+        # ISSUE 17 headline: per-eval fixed cost (snapshot + plan verify
+        # + commit, solver out of the loop), regress-gated lower-better
+        out["eval_fixed_ms"] = eval_fixed["eval_fixed_ms"]
+        out["eval_fixed_nocp_ms"] = eval_fixed["eval_fixed_nocp_ms"]
+        out["eval_fixed_allocs_per_plan"] = eval_fixed["per_plan"]
+        out["eval_fixed_table_allocs"] = eval_fixed["seed"]
     # a CPU-fallback / breaker-degraded artifact must never read as a
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state

@@ -271,6 +271,73 @@ void nt_solve_eval(int32_t n_nodes, const double* cpu_cap,
   }
 }
 
-int32_t nt_abi_version() { return 2; }
+// Whole-group plan verification against the columnar fold state
+// (reference: nomad/plan_apply.go evaluateNodePlan over a plan batch).
+// One call applies a plan group's deltas to the folded usage and compares
+// every touched node, so the applier's verify pre-pass holds the GIL only
+// while gathering plan-sized entry arrays, not for the arithmetic.
+//
+// Inputs:
+//   tbl_cpu/tbl_mem/tbl_disk  AllocTable columns (full table)
+//   tbl_live_strict           uint8 column; dead rows contribute nothing
+//   d_row/d_pos/d_sign        n_delta row-backed deltas: for each entry,
+//                             used[dim][d_pos] += d_sign * tbl[dim][d_row]
+//                             iff tbl_live_strict[d_row] (stops,
+//                             preemptions, in-place replacements,
+//                             overlay-removed allocs)
+//   a_pos/a_cpu/a_mem/a_disk  n_ask direct value entries; a_into_used[e]
+//   a_into_used               routes the entry into used (in-flight
+//                             overlay adds) or ask (this plan's
+//                             placements)
+//   cpu_cap/mem_cap/disk_cap  per-node caps minus node-reserved
+//   used_*/ask_*              in/out node-axis accumulators (used_* seeded
+//                             from the fold; ask_* caller-zeroed)
+// Output: out_dim[k] = 0 ok, 1 cpu, 2 memory, 3 disk.
+//
+// Entries are applied strictly in order (e then compare), so float
+// accumulation order matches the Python oracle's traversal order and the
+// numpy fallback's sequential np.add.at -- bitwise-parity-gated.
+void nt_verify_plan(const double* tbl_cpu, const double* tbl_mem,
+                    const double* tbl_disk, const uint8_t* tbl_live_strict,
+                    const int64_t* d_row, const int32_t* d_pos,
+                    const int8_t* d_sign, int64_t n_delta,
+                    const int32_t* a_pos, const double* a_cpu,
+                    const double* a_mem, const double* a_disk,
+                    const int8_t* a_into_used, int64_t n_ask,
+                    const double* cpu_cap, const double* mem_cap,
+                    const double* disk_cap, double* used_cpu,
+                    double* used_mem, double* used_disk, double* ask_cpu,
+                    double* ask_mem, double* ask_disk, int64_t n,
+                    int32_t* out_dim) {
+  for (int64_t e = 0; e < n_delta; ++e) {
+    const int64_t row = d_row[e];
+    if (!tbl_live_strict[row]) continue;
+    const int32_t k = d_pos[e];
+    const double s = (double)d_sign[e];
+    used_cpu[k] += s * tbl_cpu[row];
+    used_mem[k] += s * tbl_mem[row];
+    used_disk[k] += s * tbl_disk[row];
+  }
+  for (int64_t e = 0; e < n_ask; ++e) {
+    const int32_t k = a_pos[e];
+    if (a_into_used[e]) {
+      used_cpu[k] += a_cpu[e];
+      used_mem[k] += a_mem[e];
+      used_disk[k] += a_disk[e];
+    } else {
+      ask_cpu[k] += a_cpu[e];
+      ask_mem[k] += a_mem[e];
+      ask_disk[k] += a_disk[e];
+    }
+  }
+  for (int64_t k = 0; k < n; ++k) {
+    if (used_cpu[k] + ask_cpu[k] > cpu_cap[k]) out_dim[k] = 1;
+    else if (used_mem[k] + ask_mem[k] > mem_cap[k]) out_dim[k] = 2;
+    else if (used_disk[k] + ask_disk[k] > disk_cap[k]) out_dim[k] = 3;
+    else out_dim[k] = 0;
+  }
+}
+
+int32_t nt_abi_version() { return 3; }
 
 }  // extern "C"

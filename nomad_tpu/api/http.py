@@ -69,6 +69,11 @@ def _sample_profile(seconds: float, hz: int):
 
 
 def to_jsonable(obj):
+    hydrate = getattr(obj, "__nomad_hydrate__", None)
+    if hydrate is not None:
+        # lazy struct stub (structs.alloc.LazyAllocMetric): an API read
+        # is a first struct access -- render the hydrated record
+        obj = hydrate()
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: to_jsonable(v)
                 for k, v in dataclasses.asdict(obj).items()}

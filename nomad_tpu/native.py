@@ -16,8 +16,20 @@ import numpy as np
 PORT_WORDS = 2048
 MAX_PORTS_PER_ALLOC = 8
 
+# Bumped whenever the C ABI changes shape; load() refuses a stale .so so a
+# half-upgraded tree falls back to numpy instead of corrupting memory.
+ABI_VERSION = 3
+
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+
+
+def native_cp_enabled() -> bool:
+    """Kill switch for the native control-plane hot paths (plan verify,
+    delta-advanced snapshots, lazy alloc materialization). Default on;
+    ``NOMAD_TPU_NATIVE_CP=0`` restores the pre-native Python paths
+    bit-for-bit (the parity oracle)."""
+    return os.environ.get("NOMAD_TPU_NATIVE_CP", "") != "0"
 
 
 def _find_library() -> Optional[str]:
@@ -40,10 +52,12 @@ def load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(path)
-        if lib.nt_abi_version() != 2:
+        if lib.nt_abi_version() != ABI_VERSION:
             return None
         d = ctypes.POINTER(ctypes.c_double)
         i32 = ctypes.POINTER(ctypes.c_int32)
+        i64 = ctypes.POINTER(ctypes.c_int64)
+        i8 = ctypes.POINTER(ctypes.c_int8)
         u8 = ctypes.POINTER(ctypes.c_uint8)
         u32 = ctypes.POINTER(ctypes.c_uint32)
         u64 = ctypes.POINTER(ctypes.c_uint64)
@@ -57,6 +71,13 @@ def load() -> Optional[ctypes.CDLL]:
             u32, ctypes.c_int64, i32, ctypes.c_int32, u8]
         lib.nt_verify_fit.argtypes = [d, d, d, d, d, d, d, d, d,
                                       ctypes.c_int64, i32]
+        lib.nt_verify_plan.argtypes = [
+            d, d, d, u8,                          # table columns
+            i64, i32, i8, ctypes.c_int64,         # row deltas
+            i32, d, d, d, i8, ctypes.c_int64,     # direct ask entries
+            d, d, d,                              # caps
+            d, d, d, d, d, d,                     # used/ask accumulators
+            ctypes.c_int64, i32]
         lib.nt_solve_eval.argtypes = [
             ctypes.c_int32, d, d, d, d, d, d, i32, u8,
             ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
@@ -295,3 +316,76 @@ def verify_fit(cpu_cap, mem_cap, disk_cap, used_cpu, used_mem, used_disk,
                    np.where(used_mem + ask_mem > mem_cap, 2,
                             np.where(used_disk + ask_disk > disk_cap, 3, 0)))
     return out.astype(np.int32)
+
+
+def verify_plan(tbl_cpu, tbl_mem, tbl_disk, tbl_live_strict,
+                d_row, d_pos, d_sign, a_pos, a_cpu, a_mem, a_disk,
+                a_into_used, cpu_cap, mem_cap, disk_cap,
+                used_cpu, used_mem, used_disk) -> np.ndarray:
+    """Whole-group plan verification: apply a plan group's row-backed
+    deltas (``used[d_pos] += d_sign * tbl[d_row]`` where the row is still
+    live_strict) and direct value entries (into used for in-flight overlay
+    adds, into ask for this group's placements), then compare
+    ``used + ask`` against caps per node. Entries apply strictly in order,
+    so float accumulation matches the Python oracle's traversal order.
+    Mutates used_* in place; returns failing dim per node (0 ok, 1 cpu,
+    2 memory, 3 disk). The GIL is released for the whole call when the
+    library is loaded; the fallback applies the same entries in the same
+    order in Python, bitwise-identical."""
+    n = len(cpu_cap)
+    n_delta, n_ask = len(d_row), len(a_pos)
+    out = np.zeros(n, dtype=np.int32)
+    ask_c = np.zeros(n, dtype=np.float64)
+    ask_m = np.zeros(n, dtype=np.float64)
+    ask_d = np.zeros(n, dtype=np.float64)
+    lib = load()
+    if lib is not None and n:
+        tbl = [np.ascontiguousarray(a, dtype=np.float64)
+               for a in (tbl_cpu, tbl_mem, tbl_disk)]
+        ls = np.ascontiguousarray(tbl_live_strict, dtype=np.uint8)
+        d_row = np.ascontiguousarray(d_row, dtype=np.int64)
+        d_pos = np.ascontiguousarray(d_pos, dtype=np.int32)
+        d_sign = np.ascontiguousarray(d_sign, dtype=np.int8)
+        a_pos = np.ascontiguousarray(a_pos, dtype=np.int32)
+        a_c, a_m, a_d = [np.ascontiguousarray(a, dtype=np.float64)
+                         for a in (a_cpu, a_mem, a_disk)]
+        a_iu = np.ascontiguousarray(a_into_used, dtype=np.int8)
+        caps = [np.ascontiguousarray(a, dtype=np.float64)
+                for a in (cpu_cap, mem_cap, disk_cap)]
+        lib.nt_verify_plan(
+            *[_ptr(a, ctypes.c_double) for a in tbl],
+            _ptr(ls, ctypes.c_uint8),
+            _ptr(d_row, ctypes.c_int64), _ptr(d_pos, ctypes.c_int32),
+            _ptr(d_sign, ctypes.c_int8), n_delta,
+            _ptr(a_pos, ctypes.c_int32),
+            _ptr(a_c, ctypes.c_double), _ptr(a_m, ctypes.c_double),
+            _ptr(a_d, ctypes.c_double), _ptr(a_iu, ctypes.c_int8), n_ask,
+            *[_ptr(a, ctypes.c_double) for a in caps],
+            _ptr(used_cpu, ctypes.c_double), _ptr(used_mem, ctypes.c_double),
+            _ptr(used_disk, ctypes.c_double),
+            _ptr(ask_c, ctypes.c_double), _ptr(ask_m, ctypes.c_double),
+            _ptr(ask_d, ctypes.c_double), n, _ptr(out, ctypes.c_int32))
+        return out
+
+    # numpy fallback: entries apply one at a time in order, so the float
+    # accumulation order is identical to the C loop (bitwise parity)
+    for e in range(n_delta):
+        row = int(d_row[e])
+        if not tbl_live_strict[row]:
+            continue
+        k, s = int(d_pos[e]), float(d_sign[e])
+        used_cpu[k] += s * tbl_cpu[row]
+        used_mem[k] += s * tbl_mem[row]
+        used_disk[k] += s * tbl_disk[row]
+    for e in range(n_ask):
+        k = int(a_pos[e])
+        if a_into_used[e]:
+            used_cpu[k] += a_cpu[e]
+            used_mem[k] += a_mem[e]
+            used_disk[k] += a_disk[e]
+        else:
+            ask_c[k] += a_cpu[e]
+            ask_m[k] += a_mem[e]
+            ask_d[k] += a_disk[e]
+    return verify_fit(cpu_cap, mem_cap, disk_cap, used_cpu, used_mem,
+                      used_disk, ask_c, ask_m, ask_d)

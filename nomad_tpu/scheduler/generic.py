@@ -9,9 +9,10 @@ from __future__ import annotations
 import time as _time
 from typing import Dict, List, Optional, Set
 
+from .. import native as _native
 from ..structs import (
     AllocatedResources, AllocatedSharedResources, Allocation, AllocMetric,
-    Evaluation, Job,
+    Evaluation, Job, LazyAllocMetric,
     Plan, PlanResult, RescheduleEvent, RescheduleTracker, generate_uuid,
     ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST, ALLOC_DESIRED_RUN,
     ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
@@ -462,6 +463,18 @@ class GenericScheduler:
                 # lock at 64K placements/round (34% of thread-time)
                 from ..server.telemetry import metrics as _tm
                 _tm.incr("nomad.scheduler.placements_tpu", n_solved)
+                import os as _os
+                if _native.native_cp_enabled():
+                    if _os.environ.get(
+                            "NOMAD_TPU_LEAN_ALLOC_METRICS", "") == "1":
+                        # lean stubs preempt the lazy path: count them
+                        # as materialize fallbacks so the runbook's
+                        # hits/fallbacks split stays truthful
+                        _tm.incr("nomad.native.materialize_fallbacks",
+                                 n_solved)
+                    else:
+                        _tm.incr("nomad.native.materialize_hits",
+                                 n_solved)
         return fallback
 
     def _append_solved_alloc(self, sp, deployment_id: str) -> None:
@@ -476,6 +489,7 @@ class GenericScheduler:
                 else AllocatedSharedResources(
                     disk_mb=tg.ephemeral_disk.size_mb))
         import os as _os
+        lazy = False
         if _os.environ.get("NOMAD_TPU_LEAN_ALLOC_METRICS", "") == "1":
             # pruned stub for north-star-scale runs: the full per-
             # placement AllocMetric copy is ~10 container objects and
@@ -486,17 +500,34 @@ class GenericScheduler:
             metrics = AllocMetric(nodes_evaluated=sp.n_yielded,
                                   nodes_in_pool=self.ctx.metrics
                                   .nodes_in_pool)
+        elif _native.native_cp_enabled():
+            # native control plane (ISSUE 17): defer the per-placement
+            # AllocMetric build to first struct access -- the batch
+            # path's object + dict churn was a profiled slice of the
+            # per-eval fixed cost. Placements are identical either way
+            # (metrics are explanatory only); hydration reproduces the
+            # eager copy_for_alloc content from the same shared base.
+            lazy = True
+            preempt_score = None
+            if sp.preempted_allocs:
+                from .rank import net_priority, preemption_score as _ps
+                preempt_score = _ps(net_priority(sp.preempted_allocs))
+            metrics = LazyAllocMetric(self.ctx.metrics, sp.node.id,
+                                      sp.score, sp.n_yielded,
+                                      preempt_score)
         else:
             metrics = self.ctx.metrics.copy_for_alloc()
             metrics.nodes_evaluated = sp.n_yielded
-        metrics.score_node(sp.node.id, "normalized-score", sp.score)
-        if sp.preempted_allocs:
-            # same component the host records (rank.py:575
-            # PreemptionScoringIterator -> preemption_score(net_priority))
-            from .rank import net_priority, preemption_score
-            metrics.score_node(
-                sp.node.id, "preemption",
-                preemption_score(net_priority(sp.preempted_allocs)))
+        if not lazy:
+            metrics.score_node(sp.node.id, "normalized-score", sp.score)
+            if sp.preempted_allocs:
+                # same component the host records (rank.py:575
+                # PreemptionScoringIterator ->
+                # preemption_score(net_priority))
+                from .rank import net_priority, preemption_score
+                metrics.score_node(
+                    sp.node.id, "preemption",
+                    preemption_score(net_priority(sp.preempted_allocs)))
         alloc = Allocation(
             id=generate_uuid(),
             namespace=self.job.namespace,

@@ -763,6 +763,11 @@ class Planner:
             if fc[3]:
                 plain_nodes[k] = False
 
+        if native.native_cp_enabled():
+            return self._fast_check_native(
+                plan, node_ids, n, table, store, caps, valid,
+                plain_nodes, pos_of, overlay_removed, inflight)
+
         with store._lock:
             used_c, used_m, used_d, spec_any, _found = \
                 table.fold_verify(node_ids)
@@ -840,6 +845,124 @@ class Planner:
 
         plain = plain_nodes & ~spec_any
         dims = native.verify_fit(*caps, used_c, used_m, used_d, *asks)
+        names = {1: "cpu", 2: "memory", 3: "disk"}
+        rejects = {node_ids[k]: names[int(dims[k])]
+                   for k in range(n) if valid[k] and dims[k] != 0}
+        fit = {node_ids[k] for k in range(n)
+               if valid[k] and dims[k] == 0 and plain[k]}
+        return rejects, fit
+
+    def _fast_check_native(self, plan: Plan, node_ids, n, table, store,
+                           caps, valid, plain_nodes, pos_of,
+                           overlay_removed, inflight
+                           ) -> Tuple[Dict[str, str], set]:
+        """Native verify pre-pass (``NOMAD_TPU_NATIVE_CP``, default on):
+        gather the plan group's deltas as plan-sized entry arrays under
+        the store lock -- dict lookups only, no float arithmetic -- then
+        ONE nt_verify_plan call applies them against the table columns
+        and compares every touched node with the GIL released.
+        Decision-identical to the Python pre-pass above: entries apply in
+        the same traversal order, the kernel skips dead rows exactly
+        where subtract_row did, and the final compare is verify_fit's.
+        The store lock is held across the kernel call so the columns it
+        reads cannot be rewritten mid-verify; the GIL release still lets
+        solver/broker/client threads run underneath."""
+        import numpy as np
+        from .. import native
+
+        d_row: list = []
+        d_pos: list = []
+        a_pos: list = []
+        a_cpu: list = []
+        a_mem: list = []
+        a_disk: list = []
+        a_iu: list = []
+        with store._lock:
+            used_c, used_m, used_d, spec_any, _found = \
+                table.fold_verify(node_ids)
+            row_of = table._row_of
+            subtracted: set = set()
+
+            def subtract_row(alloc_id: str, k: int) -> None:
+                # at-most-once per alloc id, matching the Python path's
+                # set-union semantics; liveness is checked by the kernel
+                # (a dead row contributes zero either way)
+                if alloc_id in subtracted:
+                    return
+                row = row_of.get(alloc_id)
+                if row is None:
+                    return
+                subtracted.add(alloc_id)
+                d_row.append(row)
+                d_pos.append(k)
+
+            for nid, allocs in plan.node_update.items():
+                k = pos_of.get(nid)
+                if k is not None:
+                    for a in allocs:
+                        subtract_row(a.id, k)
+            for nid, allocs in plan.node_preemptions.items():
+                k = pos_of.get(nid)
+                if k is not None:
+                    for a in allocs:
+                        subtract_row(a.id, k)
+            for nid, allocs in plan.node_allocation.items():
+                k = pos_of.get(nid)
+                if k is None:
+                    continue
+                for a in allocs:
+                    # in-place update: the existing row is REPLACED
+                    subtract_row(a.id, k)
+                    cr = a.allocated_resources.comparable()
+                    a_pos.append(k)
+                    a_cpu.append(cr.cpu_shares)
+                    a_mem.append(cr.memory_mb)
+                    a_disk.append(cr.disk_mb)
+                    a_iu.append(0)
+                    if plain_nodes[k] and self._alloc_special(a):
+                        plain_nodes[k] = False
+            if overlay_removed:
+                slot_to_k = {table.node_slot_of(nid): k
+                             for nid, k in pos_of.items()}
+                for aid in overlay_removed:
+                    row = row_of.get(aid)
+                    if row is not None and table.live_strict[row]:
+                        k = slot_to_k.get(int(table.node_slot[row]))
+                        if k is not None:
+                            subtract_row(aid, k)
+            if inflight is not None:
+                # pipelined previous plan: counts only if its row hasn't
+                # landed in the table yet (see the Python path)
+                for nid, allocs in inflight.node_allocation.items():
+                    k = pos_of.get(nid)
+                    if k is None:
+                        continue
+                    for a in allocs:
+                        if a.id in row_of:
+                            continue
+                        cr = a.allocated_resources.comparable()
+                        a_pos.append(k)
+                        a_cpu.append(cr.cpu_shares)
+                        a_mem.append(cr.memory_mb)
+                        a_disk.append(cr.disk_mb)
+                        a_iu.append(1)
+                        if plain_nodes[k] and self._alloc_special(a):
+                            plain_nodes[k] = False
+
+            dims = native.verify_plan(
+                table.cpu, table.mem, table.disk, table.live_strict,
+                np.asarray(d_row, dtype=np.int64),
+                np.asarray(d_pos, dtype=np.int32),
+                np.full(len(d_row), -1, dtype=np.int8),
+                np.asarray(a_pos, dtype=np.int32),
+                np.asarray(a_cpu, dtype=np.float64),
+                np.asarray(a_mem, dtype=np.float64),
+                np.asarray(a_disk, dtype=np.float64),
+                np.asarray(a_iu, dtype=np.int8),
+                caps[0], caps[1], caps[2], used_c, used_m, used_d)
+        metrics.incr("nomad.native.verify_hits" if native.available()
+                     else "nomad.native.verify_fallbacks")
+        plain = plain_nodes & ~spec_any
         names = {1: "cpu", 2: "memory", 3: "disk"}
         rejects = {node_ids[k]: names[int(dims[k])]
                    for k in range(n) if valid[k] and dims[k] != 0}

@@ -867,6 +867,11 @@ class TpuPlacementService:
                     for t in tg.tasks},
                 shared=AllocatedSharedResources(
                     disk_mb=tg.ephemeral_disk.size_mb))
+            # warm the instance-cached comparable view once: every
+            # downstream consumer (plan verify entries, alloc-table
+            # upsert derivation) hits the shared object's cache instead
+            # of each paying the first-call reduction
+            shared_res.comparable()
         for pi, place in enumerate(places):
             pos = int(chosen[pi])
             if pos < 0:

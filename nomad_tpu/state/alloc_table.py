@@ -323,13 +323,26 @@ class AllocTable:
         # derive EVERYTHING before the first state mutation: a raising
         # alloc mid-batch must not leave reserved-but-unwritten rows
         # (stale resized data would fold phantom usage)
-        crs = [a.allocated_resources.comparable() for a in allocs]
-        all_ports = [a.allocated_resources.all_ports() for a in allocs]
+        # batches routinely share AllocatedResources objects across
+        # allocs of one task group (prebuilt TPU-path resources), so
+        # memoize the derived views by object identity -- the `allocs`
+        # list pins every object alive for the memo's whole lifetime
+        _derived: dict = {}
+        crs = []
+        all_ports = []
+        special = []
+        for a in allocs:
+            ar = a.allocated_resources
+            got = _derived.get(id(ar))
+            if got is None:
+                got = (ar.comparable(), ar.all_ports(),
+                       1 if ar.has_special_dimensions() else 0)
+                _derived[id(ar)] = got
+            crs.append(got[0])
+            all_ports.append(got[1])
+            special.append(got[2])
         live = [0 if a.client_terminal_status() else 1 for a in allocs]
         live_strict = [0 if a.terminal_status() else 1 for a in allocs]
-        special = [
-            1 if a.allocated_resources.has_special_dimensions() else 0
-            for a in allocs]
         job_hash = [stable_hash(a.namespace, a.job_id) for a in allocs]
         jobtg_hash = [stable_hash(a.namespace, a.job_id, a.task_group)
                       for a in allocs]

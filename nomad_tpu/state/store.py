@@ -47,6 +47,79 @@ def _delta_journal_cap() -> int:
         return 128
 
 
+class _DeltaAllocs:
+    """Journal-patched snapshot alloc mapping (ISSUE 17): the previous
+    snapshot's mapping advanced copy-on-write by the alloc-delta journal
+    span, instead of rebuilt with a wholesale ``dict(store._allocs)``
+    copy (~250K dict inserts per snapshot at north-star scale).
+
+    ``base`` is a frozen plain dict shared with an earlier snapshot and
+    is NEVER mutated; ``over`` holds inserted/replaced allocs; ``dead``
+    tombstones ids deleted from base. Each advance copies the (bounded
+    small) overlay, so chains never deepen past one level, and the store
+    flattens back to a plain dict when the overlay outgrows its budget
+    (StateStore._snapshot_allocs_locked). Iteration yields base order
+    first, then overlay order -- replaced allocs move to the tail, which
+    the snapshot read API tolerates (id-keyed lookups and unordered
+    scans); the kill-switch path keeps exact dict-copy order."""
+
+    __slots__ = ("_base", "_over", "_dead")
+
+    def __init__(self, base: dict, over: dict, dead: set):
+        self._base = base
+        self._over = over
+        self._dead = dead
+
+    def get(self, key, default=None):
+        v = self._over.get(key)
+        if v is not None:
+            return v
+        if key in self._dead:
+            return default
+        return self._base.get(key, default)
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __contains__(self, key) -> bool:
+        return (key in self._over
+                or (key not in self._dead and key in self._base))
+
+    def __len__(self) -> int:
+        n = len(self._base) - len(self._dead)
+        for k in self._over:
+            if k in self._base:
+                n -= 1
+        return n + len(self._over)
+
+    def __iter__(self):
+        base, over, dead = self._base, self._over, self._dead
+        for k in base:
+            if k not in dead and k not in over:
+                yield k
+        yield from over
+
+    def keys(self):
+        return list(self)
+
+    def values(self):
+        base, over, dead = self._base, self._over, self._dead
+        out = [v for k, v in base.items()
+               if k not in dead and k not in over]
+        out.extend(over.values())
+        return out
+
+    def items(self):
+        base, over, dead = self._base, self._over, self._dead
+        out = [(k, v) for k, v in base.items()
+               if k not in dead and k not in over]
+        out.extend(over.items())
+        return out
+
+
 class StateSnapshot:
     """An immutable point-in-time view (reference: state.StateSnapshot).
 
@@ -63,7 +136,7 @@ class StateSnapshot:
             self._nodes = dict(store._nodes)
             self._jobs = dict(store._jobs)
             self._evals = dict(store._evals)
-            self._allocs = dict(store._allocs)
+            self._allocs = store._snapshot_allocs_locked()
             self._deployments = dict(store._deployments)
             self._node_pools = dict(store._node_pools)
             self._scheduler_config = store._scheduler_config
@@ -301,6 +374,10 @@ class StateStore:
         self._snap_prev = None
         self._dirty_alloc_nodes: set = set()
         self._dirty_alloc_jobs: set = set()
+        # (alloc table index, mapping) of the last snapshot's alloc view:
+        # the base the next snapshot delta-advances from (ISSUE 17,
+        # native control plane; see _snapshot_allocs_locked)
+        self._snap_alloc_prev: Optional[Tuple[int, object]] = None
         # watch support
         self._watch_cond = threading.Condition(self._lock)
         # bounded journal of alloc-level write deltas: (index, pairs)
@@ -425,6 +502,58 @@ class StateStore:
                     return False, []
                 pairs.extend(delta)
             return True, pairs
+
+    def _snapshot_allocs_locked(self):
+        """The alloc mapping for a snapshot under construction (caller
+        holds the store lock). Native-CP path (``NOMAD_TPU_NATIVE_CP``,
+        default on): delta-advance the previous snapshot's mapping by
+        the journal span -- O(changed allocs) instead of the wholesale
+        ~len(_allocs)-insert dict copy that dominated snapshot build at
+        north-star scale. The wholesale rebuild stays as the
+        journal-gap/overflow fallback AND, with the kill switch off, as
+        the bit-for-bit oracle."""
+        from .. import native
+        if not native.native_cp_enabled():
+            return dict(self._allocs)
+        from ..server.telemetry import metrics as _tm
+        idx = self._table_index.get("allocs", 0)
+        prev = self._snap_alloc_prev
+        if prev is not None:
+            prev_idx, prev_map = prev
+            if prev_idx == idx:
+                # a write to another table invalidated the snapshot
+                # cache without touching allocs: reuse the frozen map
+                _tm.incr("nomad.native.snapshot_hits")
+                return prev_map
+            covered, pairs = self.alloc_deltas_since(prev_idx, upto=idx)
+            if covered:
+                if isinstance(prev_map, _DeltaAllocs):
+                    base = prev_map._base
+                    over = dict(prev_map._over)
+                    dead = set(prev_map._dead)
+                else:
+                    base, over, dead = prev_map, {}, set()
+                for old, new in pairs:
+                    if new is not None:
+                        over[new.id] = new
+                        dead.discard(new.id)
+                    elif old is not None:
+                        over.pop(old.id, None)
+                        if old.id in base:
+                            dead.add(old.id)
+                # flatten once the overlay outgrows its budget: lookup
+                # and scan costs scale with the overlay, and a big
+                # overlay means the next wholesale copy is cheap
+                # relative to the churn that built it
+                if len(over) + len(dead) <= max(1024, len(base) // 8):
+                    view = _DeltaAllocs(base, over, dead)
+                    self._snap_alloc_prev = (idx, view)
+                    _tm.incr("nomad.native.snapshot_hits")
+                    return view
+        allocs = dict(self._allocs)
+        self._snap_alloc_prev = (idx, allocs)
+        _tm.incr("nomad.native.snapshot_fallbacks")
+        return allocs
 
     def snapshot(self) -> StateSnapshot:
         with self._lock:

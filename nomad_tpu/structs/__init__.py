@@ -31,6 +31,7 @@ from .node import (  # noqa: F401
 )
 from .alloc import (  # noqa: F401
     AllocDeploymentStatus, AllocMetric, Allocation, Deployment,
+    LazyAllocMetric,
     DeploymentState, DeploymentStatusUpdate, DesiredTransition, Evaluation,
     NetworkStatus, Plan, PlanResult, RescheduleEvent, RescheduleTracker,
     ALLOC_DESIRED_RUN, ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT,

@@ -186,6 +186,72 @@ class AllocMetric:
             coalesced_failures=self.coalesced_failures)
 
 
+class LazyAllocMetric:
+    """Deferred per-placement AllocMetric (ISSUE 17, native control
+    plane): the TPU batch path attaches this stub instead of building
+    the ~10-container explainability record per placement, and the real
+    AllocMetric is hydrated from the eval's base metric on first struct
+    access (API reads, ``alloc status``, the quality audit).
+
+    Hydration is transparent: any attribute access forwards to the
+    hydrated record, deepcopy (``dataclasses.asdict`` on the owning
+    Allocation) hydrates first, and the struct codec / HTTP jsonifier
+    hydrate via ``__nomad_hydrate__``. The base metric is the eval's
+    ``ctx.metrics``, whose aggregate containers ``copy_for_alloc``
+    already shares copy-on-write -- hydrating later reads the same
+    shared containers the eager copy would have aliased.  The SCALAR
+    fields are a different story: ``copy_for_alloc`` freezes them by
+    value at copy time and later selects in the same eval keep
+    mutating the base (``allocation_time_ns`` per select, filter and
+    exhaustion counts per ranking walk), so the stub captures them at
+    construction -- the exact values the eager copy would have
+    frozen."""
+
+    __slots__ = ("_base", "_node_id", "_score", "_n_yielded",
+                 "_preempt_score", "_scalars", "_real")
+
+    def __init__(self, base: AllocMetric, node_id: str, score: float,
+                 n_yielded: int, preempt_score: Optional[float] = None):
+        self._base = base
+        self._node_id = node_id
+        self._score = score
+        self._n_yielded = n_yielded
+        self._preempt_score = preempt_score
+        self._scalars = (base.nodes_filtered, base.nodes_in_pool,
+                         base.nodes_exhausted, base.allocation_time_ns,
+                         base.coalesced_failures)
+        self._real = None
+
+    def _hydrate(self) -> AllocMetric:
+        real = self._real
+        if real is None:
+            real = self._base.copy_for_alloc()
+            (real.nodes_filtered, real.nodes_in_pool,
+             real.nodes_exhausted, real.allocation_time_ns,
+             real.coalesced_failures) = self._scalars
+            real.nodes_evaluated = self._n_yielded
+            real.score_node(self._node_id, "normalized-score", self._score)
+            if self._preempt_score is not None:
+                real.score_node(self._node_id, "preemption",
+                                self._preempt_score)
+            self._real = real
+        return real
+
+    def __nomad_hydrate__(self) -> AllocMetric:
+        return self._hydrate()
+
+    def __getattr__(self, name):
+        return getattr(self._hydrate(), name)
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+        return _copy.deepcopy(self._hydrate(), memo)
+
+    def __repr__(self) -> str:
+        state = "hydrated" if self._real is not None else "lazy"
+        return f"<LazyAllocMetric {state} node={self._node_id}>"
+
+
 @dataclass
 class NetworkStatus:
     interface_name: str = ""

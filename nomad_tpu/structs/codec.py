@@ -20,6 +20,11 @@ _HINT_CACHE: Dict[type, Dict[str, Any]] = {}
 def encode(obj: Any) -> Any:
     """Structural encode to JSON-able primitives. No type tags: decode is
     driven by the target class's type hints instead."""
+    hydrate = getattr(obj, "__nomad_hydrate__", None)
+    if hydrate is not None:
+        # lazy struct stub (alloc.LazyAllocMetric): serialization is a
+        # first struct access -- encode the hydrated record
+        obj = hydrate()
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {f.name: encode(getattr(obj, f.name))
                 for f in dataclasses.fields(obj)}

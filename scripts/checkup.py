@@ -11,6 +11,11 @@ One command, one exit code for every static gate the repo carries:
                    telemetry series in the metrics reference table
   sanitizer-gates  scripts/check_sanitizer_gates.py -- the conftest
                    sanitizer fixtures cover their pinned suites
+  native           build native/ (cmake, else g++), assert the ABI
+                   stamp matches nomad_tpu.native.ABI_VERSION, and
+                   require a registered numpy-fallback parity test for
+                   every exported C kernel (skip-with-notice when no
+                   C++ toolchain exists)
 
 ``checkup`` runs them all (or a ``--only NAME`` subset, repeatable)
 and exits nonzero when ANY component fails -- the one pre-merge gate
@@ -93,6 +98,104 @@ def _run_script(script: str, component: str
     return rc, lines, results
 
 
+def _native_results(msgs: List[str]) -> List[dict]:
+    return [{
+        "ruleId": "native",
+        "level": "error",
+        "message": {"text": m},
+        "locations": [{"physicalLocation": {
+            "artifactLocation": {"uri": "native/pack_kernels.cc"},
+            "region": {"startLine": 1},
+        }}],
+    } for m in msgs]
+
+
+def _run_native() -> Tuple[int, List[str], List[dict]]:
+    """The native control-plane gate (ISSUE 17): build native/ (cmake
+    when present, else the direct g++ path), assert the built library's
+    ABI stamp matches nomad_tpu.native.ABI_VERSION, and fail when any
+    exported C kernel lacks a registered numpy-fallback parity test in
+    tests/test_native.py::KERNEL_PARITY_TESTS.  With no C++ toolchain
+    at all the gate skips with a notice (rc 0) -- the parity-registry
+    check still runs, it is pure source inspection."""
+    import re
+    import shutil
+    import subprocess
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from nomad_tpu import native
+
+    lines: List[str] = []
+    failures: List[str] = []
+
+    built = native.available()
+    if not built and shutil.which("cmake"):
+        try:
+            subprocess.run(
+                ["cmake", "-S", os.path.join(ROOT, "native"),
+                 "-B", os.path.join(ROOT, "native", "build")],
+                check=True, capture_output=True, timeout=180)
+            subprocess.run(
+                ["cmake", "--build",
+                 os.path.join(ROOT, "native", "build")],
+                check=True, capture_output=True, timeout=180)
+            native._load_attempted = False
+            native._lib = None
+            built = native.available()
+        except (subprocess.SubprocessError, OSError) as e:
+            failures.append(f"cmake build failed: {e}")
+    if not built and not failures:
+        if shutil.which("g++"):
+            built = native.ensure_built()
+            if not built:
+                failures.append("g++ build failed (native.ensure_built)")
+        elif not shutil.which("cmake"):
+            lines.append("notice: no C++ toolchain (cmake/g++) -- "
+                         "native build skipped")
+
+    if built:
+        got = native._lib.nt_abi_version()
+        if got != native.ABI_VERSION:
+            failures.append(
+                f"ABI mismatch: built lib says {got}, "
+                f"nomad_tpu.native.ABI_VERSION is {native.ABI_VERSION} "
+                "-- rebuild native/ or fix the version stamp")
+        else:
+            lines.append(f"built + loaded, ABI v{got}")
+
+    # parity-registry completeness: every exported nt_* symbol must map
+    # to an existing test (source inspection -- runs even toolchain-less)
+    src = open(os.path.join(ROOT, "native", "pack_kernels.cc"),
+               encoding="utf-8").read()
+    exported = set(re.findall(
+        r"^(?:void|int32_t|int64_t|double)\s+(nt_\w+)\s*\(",
+        src, re.MULTILINE))
+    tests_src = open(os.path.join(ROOT, "tests", "test_native.py"),
+                     encoding="utf-8").read()
+    m = re.search(r"KERNEL_PARITY_TESTS\s*=\s*\{(.*?)\n\}",
+                  tests_src, re.DOTALL)
+    registry = dict(re.findall(r'"(nt_\w+)":\s*\n?\s*"([^"]+)"',
+                               m.group(1))) if m else {}
+    if not m:
+        failures.append("tests/test_native.py has no "
+                        "KERNEL_PARITY_TESTS registry")
+    for sym in sorted(exported - set(registry)):
+        failures.append(f"exported kernel {sym} has no registered "
+                        "parity test (KERNEL_PARITY_TESTS)")
+    for sym, ref in sorted(registry.items()):
+        path, _, test = ref.partition("::")
+        full = os.path.join(ROOT, path)
+        if not os.path.exists(full) or \
+                f"def {test}(" not in open(full, encoding="utf-8").read():
+            failures.append(f"{sym}: registered parity test {ref} "
+                            "does not exist")
+
+    if failures:
+        return 1, lines + failures, _native_results(failures)
+    return 0, lines, []
+
+
 COMPONENTS: Dict[str, Callable[[], Tuple[int, List[str], List[dict]]]] = {
     "nomadlint": _run_nomadlint,
     "knob-doc": lambda: _run_script("check_knob_doc.py", "knob-doc"),
@@ -100,6 +203,7 @@ COMPONENTS: Dict[str, Callable[[], Tuple[int, List[str], List[dict]]]] = {
                                        "metrics-doc"),
     "sanitizer-gates": lambda: _run_script("check_sanitizer_gates.py",
                                            "sanitizer-gates"),
+    "native": _run_native,
 }
 
 
@@ -125,7 +229,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="checkup",
         description="run every static gate (nomadlint + knob-doc + "
-        "metrics-doc + sanitizer-gates) with one combined exit code")
+        "metrics-doc + sanitizer-gates + native) with one combined "
+        "exit code")
     p.add_argument("--only", action="append", default=[],
                    metavar="NAME",
                    help="run only this component (repeatable); "

@@ -88,10 +88,11 @@ def test_hard_invariants_ignore_tolerances():
 
 def test_zero_baseline_lower_better_uses_epsilon():
     # zero baseline -> the tolerance fraction acts as an absolute
-    # ceiling (0.50 for quality_drift): noise under it passes, a real
-    # drift excursion over it fails
+    # ceiling: noise under it passes, a real excursion over it fails
+    # (for quality_drift the NOISE_FLOOR=1.0 dominates the 0.50
+    # epsilon, so the failing case must clear the floor too)
     reg, _ = cbr.compare_artifacts(
-        art(quality_drift=0.0), art(quality_drift=0.6))
+        art(quality_drift=0.0), art(quality_drift=1.2))
     assert any(r.startswith("quality_drift:") for r in reg)
     reg, _ = cbr.compare_artifacts(
         art(quality_drift=0.0), art(quality_drift=0.4))
@@ -99,6 +100,36 @@ def test_zero_baseline_lower_better_uses_epsilon():
     reg, _ = cbr.compare_artifacts(
         art(quality_drift=0.0), art(quality_drift=0.0))
     assert reg == []
+
+
+def test_noise_floor_absolute_pass():
+    """quality_drift's run-to-run noise spans 2.6e-08 .. 0.584 on
+    IDENTICAL code (BENCH_NOTES_r07/r08: a max over a timing-dependent
+    audit sample) -- a near-zero previous value must not turn that
+    noise into a regression.  Values at or below the absolute floor
+    pass regardless of the relative tolerance."""
+    assert cbr.NOISE_FLOOR["quality_drift"] >= 0.584
+    # the observed worst noise pair: pv ~ 0, cv = 0.584
+    reg, _ = cbr.compare_artifacts(
+        art(quality_drift=2.6e-08), art(quality_drift=0.584))
+    assert reg == []
+    # even with a zero previous and a tight override, under-floor passes
+    reg, _ = cbr.compare_artifacts(
+        art(quality_drift=0.0), art(quality_drift=0.30),
+        {"quality_drift": 0.01})
+    assert reg == []
+
+
+def test_noise_floor_does_not_excuse_real_drift():
+    """Above the floor the relative gate still bites: a genuine drift
+    excursion past prev*(1+tol) fails."""
+    reg, _ = cbr.compare_artifacts(
+        art(quality_drift=0.2), art(quality_drift=1.8))
+    assert any(r.startswith("quality_drift:") for r in reg)
+    # and fields WITHOUT a floor entry keep the old zero-epsilon rule
+    reg, _ = cbr.compare_artifacts(
+        art(churn_p99_ms=0.0), art(churn_p99_ms=0.3))
+    assert any(r.startswith("churn_p99_ms:") for r in reg)
 
 
 def test_discover_previous_by_round(tmp_path):

@@ -23,7 +23,7 @@ def test_checkup_clean_on_real_tree(capsys):
     assert cu.main([]) == 0, capsys.readouterr().out
     out = capsys.readouterr().out
     for name in ("nomadlint", "knob-doc", "metrics-doc",
-                 "sanitizer-gates"):
+                 "sanitizer-gates", "native"):
         assert f"== {name}: ok" in out
     assert "-> exit 0" in out
 
@@ -72,6 +72,68 @@ def test_component_failure_fails_the_run(capsys, monkeypatch):
     run = doc["runs"][0]
     assert run["tool"]["driver"]["name"] == "checkup"
     assert [r["ruleId"] for r in run["results"]] == ["knob-doc"]
+
+
+def test_native_gate_flags_unregistered_kernel(tmp_path, monkeypatch,
+                                               capsys):
+    """A new exported C kernel with no KERNEL_PARITY_TESTS entry fails
+    the native gate with a per-kernel finding."""
+    fake = tmp_path / "repo"
+    (fake / "native").mkdir(parents=True)
+    (fake / "tests").mkdir()
+    (fake / "native" / "pack_kernels.cc").write_text(
+        'extern "C" {\n'
+        "void nt_registered(double* x) {}\n"
+        "void nt_orphan(double* x) {}\n"
+        "}\n")
+    (fake / "tests" / "test_native.py").write_text(
+        "KERNEL_PARITY_TESTS = {\n"
+        '    "nt_registered":\n'
+        '        "tests/test_native.py::test_registered_parity",\n'
+        "}\n\n\n"
+        "def test_registered_parity():\n    pass\n")
+    monkeypatch.setattr(cu, "ROOT", str(fake))
+    rc, lines, results = cu._run_native()
+    out = "\n".join(lines)
+    assert rc == 1
+    assert "nt_orphan" in out and "no registered parity test" in out
+    assert "nt_registered" not in "".join(
+        r["message"]["text"] for r in results)
+
+
+def test_native_gate_flags_dangling_registry_entry(tmp_path,
+                                                   monkeypatch):
+    """A registry entry pointing at a test that does not exist fails."""
+    fake = tmp_path / "repo"
+    (fake / "native").mkdir(parents=True)
+    (fake / "tests").mkdir()
+    (fake / "native" / "pack_kernels.cc").write_text(
+        'extern "C" {\nvoid nt_k(double* x) {}\n}\n')
+    (fake / "tests" / "test_native.py").write_text(
+        "KERNEL_PARITY_TESTS = {\n"
+        '    "nt_k": "tests/test_native.py::test_gone",\n'
+        "}\n")
+    monkeypatch.setattr(cu, "ROOT", str(fake))
+    rc, lines, _ = cu._run_native()
+    assert rc == 1
+    assert any("test_gone" in ln and "does not exist" in ln
+               for ln in lines)
+
+
+def test_native_gate_abi_matches_on_real_tree():
+    """On the real tree with the library built, the gate reports the
+    matching ABI stamp (the build was exercised by the clean-tree
+    gate; this pins the version agreement specifically)."""
+    import sys
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from nomad_tpu import native
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    rc, lines, _ = cu._run_native()
+    assert rc == 0
+    assert any(f"ABI v{native.ABI_VERSION}" in ln for ln in lines)
 
 
 def test_sarif_merges_components_on_clean_tree(tmp_path, capsys):

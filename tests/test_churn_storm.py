@@ -190,26 +190,43 @@ def test_flap_storm_every_lost_alloc_replaced_exactly_once(monkeypatch):
         # flap the rest twice via the heartbeat fault point: a bounded
         # cluster-wide heartbeat hang longer than the TTL downs every
         # node; release recovers them (through the flap damper)
+        # each phase waits on its CONDITION against a generous deadline,
+        # never a fixed window: on a loaded 1-core host the old 6s/8s
+        # windows could lapse mid-phase, the storm became a partial
+        # no-op (nothing lost), and the drill failed ~1/10 on timing
+        # alone.  The hang stays armed until the fleet is actually
+        # down; the recovery wait holds until the survivors are
+        # actually back.  The phase deadlines are backstops -- with the
+        # hang armed the TTL (0.6s) guarantees down-ness, and the flap
+        # damper caps re-admission at FLAP_MAX_S (0.6s), so the
+        # conditions converge in seconds when the host cooperates.
+        def phase(cond, msg, timeout=30.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                sample_queues()
+                if cond():
+                    return
+                time.sleep(0.05)
+            raise AssertionError(f"storm phase timeout: {msg}")
+
         for cycle in range(2):
             faults.arm("heartbeat", "hang", delay_s=1.2)
-            deadline = time.time() + 6.0
-            while time.time() < deadline:
-                sample_queues()
-                down = [n for n in server.state.nodes()
-                        if n.status != NODE_STATUS_READY]
-                if len(down) >= 6:
-                    break
-                time.sleep(0.05)
+            phase(lambda: sum(1 for n in server.state.nodes()
+                              if n.status != NODE_STATUS_READY) >= 6,
+                  f"cycle {cycle}: >=6 nodes down")
             faults.disarm("heartbeat")
-            deadline = time.time() + 8.0
-            while time.time() < deadline:
-                sample_queues()
-                ready = [n for n in server.state.nodes()
-                         if n.status == NODE_STATUS_READY]
-                if len(ready) >= 5:
-                    break
-                time.sleep(0.05)
+            phase(lambda: sum(1 for n in server.state.nodes()
+                              if n.status == NODE_STATUS_READY) >= 5,
+                  f"cycle {cycle}: >=5 nodes recovered")
 
+        # the frozen loaded nodes' node-down evals deterministically
+        # mark their allocs lost -- but only once those evals process;
+        # wait for the loss to LAND rather than racing the final
+        # steady-state check against the scheduler
+        wait_until(lambda: any(
+            a.client_status == ALLOC_CLIENT_LOST
+            for a in server.state.allocs_by_job(job.namespace, job.id)),
+            timeout=20.0, msg="storm loses allocations")
         # steady state again on the surviving fleet
         wait_until(lambda: len(running()) == 12, timeout=25.0,
                    msg="12 running after storm")

@@ -1,0 +1,270 @@
+"""Native control-plane kill switch (ISSUE 17): ``NOMAD_TPU_NATIVE_CP=0``
+restores the pre-native Python paths -- wholesale snapshot copy, the
+Python plan-verify walk, eager alloc-metric materialization --
+bit-for-bit.  These tests run the same worlds under both settings and
+compare exact outcomes, plus unit parity for the snapshot delta view
+and the lazy alloc-metric stub."""
+import pytest
+
+from nomad_tpu import mock, native
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.server.plan_apply import Planner
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    AllocMetric, LazyAllocMetric, ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_RUNNING,
+)
+from nomad_tpu.structs.codec import encode
+from nomad_tpu.structs.job import reseed_ids
+
+
+def make_eval(job):
+    return mock.evaluation(job_id=job.id, namespace=job.namespace,
+                           type=job.type, priority=job.priority)
+
+
+# ----------------------------------------------------------------------
+# Plan verify: native kernel vs Python walk on the SAME snapshot/plan
+
+
+def _verify_world():
+    store = StateStore()
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        n.compute_class()
+        store.upsert_node(n)
+    jobs = [mock.job() for _ in range(2)]
+    for j in jobs:
+        store.upsert_job(j)
+    for j in jobs:
+        for i, n in enumerate(nodes):
+            a = mock.alloc_for(j, n, i)
+            a.client_status = (ALLOC_CLIENT_RUNNING if i % 3
+                               else ALLOC_CLIENT_COMPLETE)
+            store.upsert_allocs([a])
+    return store, nodes, jobs
+
+
+def _overflow_plan(store, node, job):
+    """A plan whose ask exceeds the node's remaining cpu -> must be
+    rejected by the verify walk (either implementation)."""
+    from nomad_tpu.structs import Plan
+    plan = Plan(eval_id="ncp-eval-0000000000000001", priority=50,
+                job=job)
+    a = mock.alloc_for(job, node, 7)
+    a.allocated_resources.tasks["web"].cpu_shares = \
+        node.node_resources.cpu.cpu_shares * 2
+    plan.append_alloc(a)
+    return plan
+
+
+def _fitting_plan(store, node, job):
+    from nomad_tpu.structs import Plan
+    plan = Plan(eval_id="ncp-eval-0000000000000002", priority=50,
+                job=job)
+    a = mock.alloc_for(job, node, 8)
+    a.allocated_resources.tasks["web"].cpu_shares = 1
+    a.allocated_resources.tasks["web"].memory_mb = 1
+    plan.append_alloc(a)
+    return plan
+
+
+def _result_shape(r):
+    return (sorted(r.rejected_nodes),
+            {nid: sorted(a.id for a in allocs)
+             for nid, allocs in sorted(r.node_allocation.items())})
+
+
+def test_plan_verify_killswitch_parity(monkeypatch):
+    """_evaluate_plan on the same snapshot+plan must produce identical
+    accept/reject decisions with the native kernel and with
+    NOMAD_TPU_NATIVE_CP=0 (the Python oracle)."""
+    store, nodes, jobs = _verify_world()
+    planner = Planner(store)
+    try:
+        snap = store.snapshot()
+        plans = [_overflow_plan(store, nodes[0], jobs[0]),
+                 _fitting_plan(store, nodes[1], jobs[1])]
+        shapes_native = []
+        shapes_oracle = []
+        for plan in plans:
+            monkeypatch.delenv("NOMAD_TPU_NATIVE_CP", raising=False)
+            shapes_native.append(
+                _result_shape(planner._evaluate_plan(snap, plan)))
+            monkeypatch.setenv("NOMAD_TPU_NATIVE_CP", "0")
+            shapes_oracle.append(
+                _result_shape(planner._evaluate_plan(snap, plan)))
+            monkeypatch.delenv("NOMAD_TPU_NATIVE_CP")
+        assert shapes_native == shapes_oracle
+        # the overflow plan was actually rejected, the fitting accepted
+        assert shapes_native[0][0] == [nodes[0].id]
+        assert not shapes_native[1][0]
+    finally:
+        planner.shutdown()
+
+
+def test_plan_verify_fallback_matches_kernel(monkeypatch):
+    """With the switch ON but the compiled library gone, the sequential
+    numpy/Python fallback must decide identically too."""
+    store, nodes, jobs = _verify_world()
+    planner = Planner(store)
+    try:
+        snap = store.snapshot()
+        plan = _overflow_plan(store, nodes[0], jobs[0])
+        with_lib = _result_shape(planner._evaluate_plan(snap, plan))
+        lib, native._lib = native._lib, None
+        try:
+            without = _result_shape(planner._evaluate_plan(snap, plan))
+        finally:
+            native._lib = lib
+        assert with_lib == without
+    finally:
+        planner.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Snapshot build: delta-advanced view vs wholesale dict copy
+
+
+def test_snapshot_view_matches_wholesale(monkeypatch):
+    """The delta-advanced snapshot alloc map must hold EXACTLY the
+    store's live dict -- same keys, same object identities -- through
+    upserts, replacements, and deletions."""
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(n)
+    job = mock.job()
+    store.upsert_job(job)
+    allocs = [mock.alloc_for(job, n, i) for i in range(30)]
+    store.upsert_allocs(allocs)
+
+    snap1 = store.snapshot()                 # wholesale (first snapshot)
+    # mutate: replace some, delete some, add some
+    repl = [mock.alloc_for(job, n, i) for i in range(5)]
+    for old, new in zip(allocs[:5], repl):
+        new.id = old.id
+    store.upsert_allocs(repl)
+    store.delete_allocs([allocs[10].id, allocs[11].id])
+    extra = [mock.alloc_for(job, n, 40 + i) for i in range(3)]
+    store.upsert_allocs(extra)
+
+    snap2 = store.snapshot()                 # delta-advanced
+    want = dict(store._allocs)
+    got = dict(snap2._allocs)
+    assert got.keys() == want.keys()
+    for k in want:
+        assert got[k] is want[k]
+    assert len(snap2._allocs) == len(want)
+    for k in want:
+        assert k in snap2._allocs
+        assert snap2._allocs.get(k) is want[k]
+    # the earlier snapshot is NOT disturbed by the advance
+    assert allocs[10].id in dict(snap1._allocs)
+
+    # kill switch: plain dict copies, no view involvement (mutate
+    # first -- an unchanged index may serve the memoized snapshot)
+    monkeypatch.setenv("NOMAD_TPU_NATIVE_CP", "0")
+    store.upsert_allocs([mock.alloc_for(job, n, 50)])
+    snap3 = store.snapshot()
+    assert type(snap3._allocs) is dict
+    assert snap3._allocs == dict(store._allocs)
+
+
+def test_snapshot_journal_gap_falls_back(monkeypatch):
+    """A journal gap (restore bumps with delta=None) must silently fall
+    back to the wholesale copy -- never serve a stale view."""
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(n)
+    job = mock.job()
+    store.upsert_job(job)
+    store.upsert_allocs([mock.alloc_for(job, n, i) for i in range(10)])
+    store.snapshot()
+    from nomad_tpu.raft.fsm import dump_state
+    blob = dump_state(store)
+    store.restore_from_snapshot(blob)
+    s = store.snapshot()
+    assert dict(s._allocs) == dict(store._allocs)
+
+
+# ----------------------------------------------------------------------
+# Materialization: lazy stub hydrates to the eager record
+
+
+def _base_metric():
+    base = AllocMetric(nodes_in_pool=12)
+    base.filter_node("c1", "missing-driver")
+    base.exhausted_node("n9", "c2", "memory")
+    base.nodes_available["dc1"] = 7
+    return base
+
+
+def test_lazy_alloc_metric_encodes_identically():
+    base = _base_metric()
+    eager = base.copy_for_alloc()
+    eager.nodes_evaluated = 5
+    eager.score_node("node-1", "normalized-score", 0.75)
+    eager.score_node("node-1", "preemption", -0.5)
+    lazy = LazyAllocMetric(base, "node-1", 0.75, 5, -0.5)
+    assert encode(lazy) == encode(eager)
+
+
+def test_lazy_alloc_metric_attribute_forwarding():
+    lazy = LazyAllocMetric(_base_metric(), "node-2", 0.25, 3)
+    assert lazy.nodes_evaluated == 3
+    assert lazy.scores == {"node-2.normalized-score": 0.25}
+    assert lazy.nodes_in_pool == 12
+    # asdict through the owning dataclass works via __deepcopy__ (the
+    # stub deep-copies as a hydrated AllocMetric, like the eager field
+    # would deep-copy as itself)
+    import dataclasses
+    a = mock.alloc_for(mock.job(), mock.node(), 0)
+    a.metrics = LazyAllocMetric(_base_metric(), "node-2", 0.25, 3)
+    d = dataclasses.asdict(a)
+    assert isinstance(d["metrics"], AllocMetric)
+    assert d["metrics"].nodes_evaluated == 3
+
+
+def test_scheduler_end_to_end_killswitch_parity(monkeypatch):
+    """Full service eval under a pinned id stream: placements (node,
+    name) and the encoded alloc metrics must agree between the native
+    path and NOMAD_TPU_NATIVE_CP=0."""
+    def run(native_cp):
+        if native_cp is None:
+            monkeypatch.delenv("NOMAD_TPU_NATIVE_CP", raising=False)
+        else:
+            monkeypatch.setenv("NOMAD_TPU_NATIVE_CP", native_cp)
+        reseed_ids(20260806)
+        h = Harness()
+        for _ in range(5):
+            h.state.upsert_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 7
+        h.state.upsert_job(job)
+        ev = make_eval(job)
+        h.state.upsert_evals([ev])
+        assert h.process("service", ev) is None
+        stored = h.state.allocs_by_job(job.namespace, job.id)
+        out = []
+        for a in stored:
+            m = encode(a.metrics)
+            # wall-clock timing can never match across two runs; every
+            # SEMANTIC field must
+            m.pop("allocation_time_ns")
+            out.append((a.node_id, a.name, m))
+        return sorted(out)
+
+    on = run(None)
+    off = run("0")
+    assert len(on) == 7
+    assert [x[:2] for x in on] == [x[:2] for x in off]
+    assert on == off
+
+
+def test_native_cp_default_on(monkeypatch):
+    monkeypatch.delenv("NOMAD_TPU_NATIVE_CP", raising=False)
+    assert native.native_cp_enabled()
+    monkeypatch.setenv("NOMAD_TPU_NATIVE_CP", "0")
+    assert not native.native_cp_enabled()
+    monkeypatch.setenv("NOMAD_TPU_NATIVE_CP", "1")
+    assert native.native_cp_enabled()
