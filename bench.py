@@ -23,6 +23,7 @@ process falls back to the CPU backend when the probe fails or times out.
 Prints ONE JSON line {"metric","value","unit","vs_baseline",...} on stdout;
 all diagnostics go to stderr.
 """
+import functools
 import json
 import os
 import statistics
@@ -562,6 +563,124 @@ def time_fused_solver(h, nodes, e_evals, per_eval, repeats=3):
     except Exception as e:  # noqa: BLE001 -- report without it
         log(f"bench: fused compute-only probe failed: {e!r}")
     return statistics.median(times), placed, mismatch, compute_info
+
+
+@functools.lru_cache(maxsize=1)
+def _mesh_single_device_fn():
+    """One pinned single-device jit of the fused greedy program,
+    shared across the mesh leg's sweep shapes (jit's own trace cache
+    buckets by shape; a fresh jit per call would defeat it)."""
+    import jax
+
+    from nomad_tpu.solver.binpack import solve_eval_batch
+
+    return jax.jit(
+        functools.partial(solve_eval_batch, spread_alg=False,
+                          dtype_name="float32"),
+        device=jax.devices()[0])
+
+
+def _per_shard_actual_by_device():
+    """Cumulative per-device actual bytes off the xferobs per_shard
+    ledger (rows accumulate; callers diff snapshots)."""
+    from nomad_tpu.solver import xferobs
+    by_dev = {}
+    for rows in (xferobs.state().get("per_shard") or {}).values():
+        for dev, row in rows.items():
+            by_dev[dev] = by_dev.get(dev, 0) + \
+                int(row.get("actual_bytes", 0))
+    return by_dev
+
+
+def time_mesh_leg(repeats=3):
+    """Multi-chip mesh solve leg (ISSUE 19): the fused greedy program
+    through the registered 2D (evals, nodes) mesh factories vs the
+    single-device jit of the SAME program, swept over node counts.
+    Guarded on >1 attached device AND the NOMAD_TPU_MESH knob -- the
+    rollback lever disables this leg exactly as it disables production
+    mesh dispatch.  Parity is gating (bit-exact by construction: the
+    cross-shard max/argmax is order-insensitive); per-shard shipped
+    bytes come off the xferobs per_shard ledger (max over devices for
+    the largest sweep shape -- the per-chip HBM ship budget).  On the
+    CPU virtual mesh collectives are intra-host copies, so the
+    collective overhead reads positive there by design; the walls are
+    the headline only on real chips (see OPERATIONS.md "Mesh
+    execution")."""
+    import jax
+    import numpy as np
+
+    from nomad_tpu.parallel import mesh as meshmod
+
+    if not meshmod.mesh_enabled() or jax.device_count() < 2:
+        return None
+
+    import __graft_entry__ as graft
+
+    e_evals, per_eval = 8, 16
+    mismatch = 0
+    sweep = []
+    for n_nodes in (256, 512):
+        rng = np.random.default_rng(n_nodes)
+        lanes = [graft._varied_inputs(rng, n_nodes, per_eval)
+                 for _ in range(e_evals)]
+        const, init, batch = (
+            jax.tree.map(lambda *xs: np.stack(xs),
+                         *[lane[i] for lane in lanes])
+            for i in range(3))
+
+        ref_fn = _mesh_single_device_fn()
+        ref = jax.block_until_ready(ref_fn(const, init, batch))
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(ref_fn(const, init, batch))
+            times.append(time.perf_counter() - t0)
+        single_dt = statistics.median(times)
+
+        mesh = meshmod.make_mesh(min(8, jax.device_count()))
+        if mesh is None:
+            return None
+        shard0 = _per_shard_actual_by_device()
+        with mesh:
+            s_const, s_init, s_batch = meshmod.shard_solver_inputs(
+                mesh, const, init, batch)
+            fn = meshmod.mesh_solve_fn(mesh, False, "float32")
+            out = jax.block_until_ready(fn(s_const, s_init, s_batch))
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(s_const, s_init, s_batch))
+                times.append(time.perf_counter() - t0)
+        mesh_dt = statistics.median(times)
+        shard1 = _per_shard_actual_by_device()
+        shard_bytes = max(
+            (shard1.get(d, 0) - shard0.get(d, 0) for d in shard1),
+            default=0)
+
+        for i in range(2):
+            mismatch += int((np.asarray(out[i])
+                             != np.asarray(ref[i])).sum())
+        sweep.append({
+            "nodes": n_nodes,
+            "single_ms": round(single_dt * 1e3, 3),
+            "mesh_ms": round(mesh_dt * 1e3, 3),
+            "shard_bytes": shard_bytes,
+        })
+
+    head = sweep[-1]
+    placements = e_evals * per_eval
+    return {
+        "mesh_pps": round(placements / (head["mesh_ms"] / 1e3), 2)
+        if head["mesh_ms"] else 0.0,
+        "mesh_shard_bytes": head["shard_bytes"],
+        "mesh_collective_ms": round(
+            max(0.0, head["mesh_ms"] - head["single_ms"]), 3),
+        "mesh_parity_mismatch": mismatch,
+        "mesh_grid": [int(x) for x in
+                      meshmod.make_mesh(
+                          min(8, jax.device_count())).devices.shape],
+        "mesh_sweep": sweep,
+    }
 
 
 def _tunnel_rtt():
@@ -1184,6 +1303,18 @@ def main_tier(platform: str, tier: int):
     # transfer ledger + tunnel-model fields (ISSUE 13): byte parity and
     # per-dispatch payload are gated per round like the sanitizers
     out.update(xferobs_stamp())
+    # ISSUE 19: mesh-route fields ride the tier tails too (self-guarded
+    # on device count + the NOMAD_TPU_MESH knob; parity is gating)
+    if os.environ.get("BENCH_SKIP_MESH", "") != "1":
+        try:
+            mesh_leg = time_mesh_leg()
+        except Exception as e:  # noqa: BLE001 -- report the rest anyway
+            log(f"bench[tier{tier}]: mesh leg failed: {e!r}")
+            mesh_leg = None
+        if mesh_leg is not None:
+            mismatch += mesh_leg["mesh_parity_mismatch"]
+            out["parity_mismatch"] = mismatch
+            out.update(mesh_leg)
     out.update(artifact_stamp())
     out["trace_artifact"] = _export_trace_artifact(
         default=f"BENCH_trace_tier{tier}.json")
@@ -1435,6 +1566,24 @@ def main():
     #     what the GIL-free verify/fold/materialize path buys the pool
     wscale_ab = time_worker_scaling_ab(mismatch)
 
+    # --- multi-chip mesh solve: mesh vs single-device walls + per-shard
+    #     ship bytes over a node-count sweep (ISSUE 19); self-guarded on
+    #     device count and the NOMAD_TPU_MESH rollback knob
+    mesh_leg = None
+    if os.environ.get("BENCH_SKIP_MESH", "") != "1":
+        try:
+            mesh_leg = time_mesh_leg()
+        except Exception as e:  # noqa: BLE001 -- report the rest anyway
+            log(f"bench: mesh leg failed: {e!r}")
+        if mesh_leg is not None:
+            mismatch += mesh_leg["mesh_parity_mismatch"]
+            log(f"bench: mesh leg grid={mesh_leg['mesh_grid']} "
+                f"{mesh_leg['mesh_pps']:.0f} placements/s, "
+                f"shard bytes {mesh_leg['mesh_shard_bytes']}, "
+                f"collective overhead "
+                f"{mesh_leg['mesh_collective_ms']:.1f}ms, "
+                f"parity_mismatch={mesh_leg['mesh_parity_mismatch']}")
+
     # --- per-eval fixed cost: snapshot+verify+commit with the solver
     #     out of the loop (ISSUE 17 headline microbench); runs LAST
     #     because it accumulates allocs into the bench world
@@ -1448,7 +1597,7 @@ def main():
           n_placed=n_tpu_ok, fused=fused, batched_full=batched_full,
           rtt=rtt, streaming=streaming, pack_tax=pack_tax, scale=scale,
           churn=churn, lpq=lpq, wscale=wscale, wscale_ab=wscale_ab,
-          eval_fixed=eval_fixed)
+          eval_fixed=eval_fixed, mesh=mesh_leg)
     if mismatch:
         log(f"bench: FAILED parity gate: {mismatch} mismatches")
         sys.exit(1)
@@ -1458,7 +1607,7 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
           batched=None, n_placed=0, fused=None, batched_full=None,
           rtt=None, streaming=None, pack_tax=None, scale=None,
           churn=None, lpq=None, wscale=None, wscale_ab=None,
-          eval_fixed=None):
+          eval_fixed=None, mesh=None):
     placements_per_sec = (n_placed / p50) if p50 > 0 else 0.0
     per_place_tpu = p50 / n_placed if n_placed else 0.0
     per_place_host = oracle_total / max(n_placed, 1)
@@ -1654,6 +1803,11 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
         out["eval_fixed_nocp_ms"] = eval_fixed["eval_fixed_nocp_ms"]
         out["eval_fixed_allocs_per_plan"] = eval_fixed["per_plan"]
         out["eval_fixed_table_allocs"] = eval_fixed["seed"]
+    if mesh is not None:
+        # ISSUE 19: mesh-route throughput, per-shard ship bytes and
+        # collective overhead over the node-count sweep; the parity
+        # field already rode into the gating mismatch upstream
+        out.update(mesh)
     # a CPU-fallback / breaker-degraded artifact must never read as a
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state

@@ -760,9 +760,14 @@ def cmd_operator_solver(args) -> int:
         pipe = st.get("dispatch_pipeline") or {}
         for k in ("depth", "in_flight"):
             print(f"pipeline.{k:19s} = {pipe.get(k)}")
+        me = st.get("mesh") or {}
+        for k in ("enabled", "devices", "grid", "dispatches",
+                  "lpq_dispatches"):
+            print(f"mesh.{k:23s} = {me.get(k)}")
         cc = st.get("const_cache") or {}
         for k in ("enabled", "entries", "resident_bytes", "hits",
-                  "misses", "bytes_saved_total", "invalidations"):
+                  "misses", "bytes_saved_total", "invalidations",
+                  "shard_entries", "shard_resident_bytes"):
             print(f"const_cache.{k:16s} = {cc.get(k)}")
         pc = st.get("pack_cache") or {}
         for k in ("enabled", "hits", "misses", "matrix_hits",
@@ -1289,8 +1294,12 @@ def cmd_operator_transfers(args) -> int:
     if fit:
         bw = fit.get("bw_mbps")
         xo = fit.get("crossover_bytes")
+        # a local (in-process CPU fallback) backend has no tunnel to
+        # fit: bandwidth is structurally absent, not merely unsampled
+        bw_txt = (f"{bw}MB/s" if bw is not None
+                  else "n/a (local backend)")
         print(f"tunnel fit: rtt={fit.get('rtt_ms')}ms "
-              f"bw={bw if bw is not None else '?'}MB/s "
+              f"bw={bw_txt} "
               f"samples={fit.get('samples')} "
               f"residual={fit.get('residual_rms_ms')}ms"
               + (f" crossover={xo}B" if xo is not None else "")

@@ -26,10 +26,21 @@ what this registry declares.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Optional
 
 import numpy as np
+
+
+def mesh_enabled() -> bool:
+    """The mesh-execution master switch (ISSUE 19). On (default) the
+    dispatch stack shards over the device mesh whenever >1 device is
+    attached and the shapes divide a grid; ``NOMAD_TPU_MESH=0`` makes
+    every factory below refuse a mesh, so every solve runs the
+    single-device program path bit-for-bit -- the rollback lever the
+    OPERATIONS.md mesh runbook documents."""
+    return os.environ.get("NOMAD_TPU_MESH", "1") != "0"
 
 
 def _single_flight(fn):
@@ -81,9 +92,12 @@ def pick_mesh(e: int, n: int, n_devices: Optional[int] = None):
     n_par = largest divisor of the (padded) node axis using the remaining
     devices. Falls back to pure node-sharding for E=1, so a single big
     eval still spreads over all chips. Returns None when fewer than 2
-    devices can be used."""
+    devices can be used. ``NOMAD_TPU_MESH=0`` always returns None --
+    the one chokepoint every production mesh route picks through."""
     import jax
 
+    if not mesh_enabled():
+        return None
     d = n_devices if n_devices is not None else jax.device_count()
     if d <= 1 or e < 1 or n < 1:
         return None
@@ -193,6 +207,23 @@ def eval_axis_partition_specs(tree):
     return jax.tree.map(lambda _leaf: P("evals"), tree)
 
 
+def lpq_partition_specs(tree):
+    """LPQ relaxation inputs ``(V, feas, ask, pcount, free, active)``:
+    the (L, N) lane-major matrices shard lanes on 'evals'; the small
+    per-lane ask/count vectors and the (N, 3) free-capacity table
+    replicate.  The dual-price ascent's cross-shard combine is an
+    all-gather of the lane shards, NOT a psum -- gathering moves bytes
+    without re-associating the float reduction, which keeps the mesh
+    program bit-for-bit the single-device one (see mesh_lpq_fn)."""
+    from jax.sharding import PartitionSpec as P
+
+    if len(tree) != 6:
+        raise ValueError(
+            f"lpq_in expects the 6-tuple (V, feas, ask, pcount, free, "
+            f"active), got {len(tree)} leaves")
+    return (P("evals", None), P("evals", None), P(), P(), P(), P())
+
+
 # group tag -> spec-tree builder; the tags line up with the transfer
 # ledger's tree groups (solver/xferobs.py) so the shardcheck per-shard
 # byte rows land next to the bytes they decompose
@@ -203,6 +234,8 @@ SPEC_GROUPS = {
     "mesh_out": output_partition_specs,
     "compact": eval_axis_partition_specs,
     "compact_preempt": eval_axis_partition_specs,
+    "lpq_in": lpq_partition_specs,
+    "lpq_out": output_partition_specs,
 }
 
 
@@ -222,7 +255,14 @@ def mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
     -- the dispatch path used to construct a new ``jax.jit`` closure
     per fused dispatch, which re-traced the whole program every
     generation (the exact steady-state-retrace class jitcheck.py
-    exists to catch; nomadlint's no-callsite-jit pins the fix)."""
+    exists to catch; nomadlint's no-callsite-jit pins the fix).
+
+    The program returns only (chosen, scores, n_yielded): the trailing
+    NodeState the single-device kernel also yields is (E, N)-sized and
+    was never read by the mesh route, yet replicated out_shardings
+    forced a full cross-shard all-gather of it every dispatch --
+    dropping it from the traced outputs lets XLA dead-code the gather
+    (the dominant output bytes at fleet-scale N)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -230,46 +270,126 @@ def mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
 
     return jax.jit(
         lambda c, i, b: solve_eval_batch(
-            c, i, b, spread_alg=spread_alg, dtype_name=dtype_name),
+            c, i, b, spread_alg=spread_alg, dtype_name=dtype_name)[:3],
         out_shardings=NamedSharding(mesh, P()))
 
 
-def shard_solver_inputs(mesh, const, init, batch):
+def _note_shard_rows(mesh, group: str, tree, specs) -> None:
+    """Fold this tree's per-shard declared/actual byte rows into the
+    transfer ledger (xferobs ``per_shard``): declared = what the
+    registry's spec budgets per device, actual = the shard bytes the
+    NamedSharding put actually gives each device. The production-path
+    twin of shardcheck's audit rows (same ``d<id>`` labels), so mesh
+    dispatches decompose per shard even with the sanitizer off."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..solver import xferobs
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    spec_leaves = jax.tree_util.tree_leaves(specs)
+    per_dev = 0
+    for leaf, spec in zip(leaves, spec_leaves):
+        arr = np.asarray(leaf)
+        shard_shape = NamedSharding(mesh, spec).shard_shape(arr.shape)
+        per_dev += int(np.prod(shard_shape, dtype=np.int64)
+                       * arr.dtype.itemsize)
+    for dev in mesh.devices.flat:
+        xferobs.note_shard_bytes(group, f"d{dev.id}", per_dev, per_dev)
+
+
+def shard_solver_inputs(mesh, const, init, batch, version=None):
     """NamedShardings for solve_eval_batch inputs, by the registry's
     declared specs: leading axis (E) on 'evals'; node-axis (last dim of
     per-node arrays) on 'nodes'.
 
-    Sharded puts bypass the device-resident const cache (it pins
-    unsharded single-device buffers), but they still report their
-    payload so ``nomad.solver.dispatch_bytes`` covers every transport
-    path."""
+    The const tree routes through the device-resident cache's
+    per-shard path (solver/constcache.py device_put_sharded_cached):
+    each shard slice is content-fingerprinted and pinned per device,
+    so repeated fleet tables ship zero bytes and a node-table write
+    re-uploads only the shards whose slice actually changed.
+    ``version`` is the packing snapshot's node_table_index (hygiene
+    eviction). init/batch ship fresh -- they change every generation
+    -- but still report payload and per-shard rows so
+    ``nomad.solver.dispatch_bytes`` and the ledger's ``per_shard``
+    decomposition cover every transport path."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..solver import constcache, xferobs
+    from ..solver.constcache import note_dispatch_bytes
+
+    def put_fresh(group, tree):
+        specs = declared_specs(group, tree)
+        total = sum(np.asarray(leaf).nbytes
+                    for leaf in jax.tree_util.tree_leaves(tree))
+        if xferobs.enabled():
+            xferobs.note_payload(group, total)
+            _note_shard_rows(mesh, group, tree, specs)
+        note_dispatch_bytes(total)
+        return jax.tree.map(
+            lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
+            tree, specs)
+
+    specs = declared_specs("mesh_const", const)
+    leaves, treedef = jax.tree_util.tree_flatten(const)
+    shardings = [NamedSharding(mesh, s)
+                 for s in treedef.flatten_up_to(specs)]
+    buffers, _shipped = constcache.device_put_sharded_cached(
+        leaves, shardings, group="mesh_const", version=version,
+        fallback_put=lambda arr, sh: jax.device_put(arr, sh))
+    s_const = jax.tree_util.tree_unflatten(treedef, buffers)
+    return (s_const, put_fresh("mesh_init", init),
+            put_fresh("mesh_batch", batch))
+
+
+@_single_flight
+@functools.lru_cache(maxsize=16)
+def mesh_lpq_fn(mesh, L_pad: int, N: int, steps: int):
+    """One pjit LPQ-relaxation program per (mesh, shape bucket) --
+    same lru + single-flight discipline as mesh_solve_fn.  Lanes (L)
+    shard on 'evals' per the lpq_in registry specs; node tables
+    replicate.  The per-step softmax/pricing math is shard-local
+    (row-wise, bit-exact), and the dual-price load reduction is forced
+    through an all-gather (with_sharding_constraint to replicated) so
+    the einsum over lanes runs whole on every device: gathering moves
+    bytes, not sums, so the mesh output is bit-for-bit the
+    single-device program's.  A psum here would re-associate the f32
+    reduction and the annealing loop amplifies that ulp noise into
+    placement flips (measured on the virtual CPU mesh)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..solver.lpq import _lp_solve_body
+
+    del L_pad  # shapes ride the traced args; L_pad keys the cache
+    rep = NamedSharding(mesh, P())
+    body = _lp_solve_body(
+        N, steps,
+        gather=lambda x: jax.lax.with_sharding_constraint(x, rep))
+    return jax.jit(body, out_shardings=rep)
+
+
+def shard_lpq_inputs(mesh, V, feas, ask, pcount, free, active):
+    """NamedShardings for the LPQ relaxation inputs by the registry's
+    ``lpq_in`` specs, with transfer-ledger attribution (one ``lpq``
+    tree group + per-shard rows). No const-cache routing: V/feas are
+    usage-dependent and change every solve."""
     import jax
     from jax.sharding import NamedSharding
 
     from ..solver import xferobs
     from ..solver.constcache import note_dispatch_bytes
-    # per-tree ledger attribution rides the same walk the byte counter
-    # uses, so mesh-sharded puts decompose like the fused transport's
-    # (gated so the kill switch skips the extra tree walks entirely)
+
+    tree = (V, feas, ask, pcount, free, active)
+    specs = declared_specs("lpq_in", tree)
+    total = sum(np.asarray(a).nbytes for a in tree)
     if xferobs.enabled():
-        for name, tree in (("const", const), ("init", init),
-                           ("batch", batch)):
-            xferobs.note_payload("mesh_" + name, sum(
-                np.asarray(leaf).nbytes
-                for leaf in jax.tree_util.tree_leaves(tree)))
-    note_dispatch_bytes(sum(
-        np.asarray(leaf).nbytes
-        for tree in (const, init, batch)
-        for leaf in jax.tree_util.tree_leaves(tree)))
-
-    def put(group, tree):
-        specs = declared_specs(group, tree)
-        return jax.tree.map(
-            lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
-            tree, specs)
-
-    return (put("mesh_const", const), put("mesh_init", init),
-            put("mesh_batch", batch))
+        xferobs.note_payload("lpq", total)
+        _note_shard_rows(mesh, "lpq", tree, specs)
+    note_dispatch_bytes(total)
+    return tuple(jax.device_put(a, NamedSharding(mesh, s))
+                 for a, s in zip(tree, specs))
 
 
 def shard_eval_axis(trees, tag: str = "compact"):

@@ -537,6 +537,36 @@ class _MeshFnWrapper:
                f"inner={self._sc_fn!r}>"
 
 
+class _LpqFnWrapper:
+    """Instrumented LPQ mesh callable (ISSUE 19): audits the lpq_in
+    6-tuple and the replicated lpq_out pair around the real pjit
+    program, sharing every detector with the dense wrapper."""
+
+    def __init__(self, fn, mesh, L_pad: int, N: int, steps: int):
+        self._sc_fn = fn
+        self._sc_mesh = mesh
+        self._sc_static = ("lpq", int(L_pad), int(N), int(steps))
+
+    def __call__(self, *args):
+        if not _ACTIVE:
+            return self._sc_fn(*args)
+        with _slock:
+            _counters["wrapped_dispatches"] += 1
+        audit_group(self._sc_mesh, "lpq_in", tuple(args), where="input")
+        _maybe_audit_program(self._sc_fn, self._sc_mesh,
+                             self._sc_static, args)
+        out = self._sc_fn(*args)
+        audit_group(self._sc_mesh, "lpq_out", out, where="output")
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._sc_fn, name)
+
+    def __repr__(self):
+        return f"<shardcheck.lpq_fn {self._sc_static} " \
+               f"inner={self._sc_fn!r}>"
+
+
 def _patched_mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
     fn = _REAL["mesh_solve_fn"](mesh, spread_alg, dtype_name)
     if not _ACTIVE:
@@ -544,8 +574,24 @@ def _patched_mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
     return _MeshFnWrapper(fn, mesh, spread_alg, dtype_name)
 
 
-def _patched_shard_solver_inputs(mesh, const, init, batch):
-    out = _REAL["shard_solver_inputs"](mesh, const, init, batch)
+def _patched_mesh_lpq_fn(mesh, L_pad: int, N: int, steps: int):
+    fn = _REAL["mesh_lpq_fn"](mesh, L_pad, N, steps)
+    if not _ACTIVE:
+        return fn
+    return _LpqFnWrapper(fn, mesh, L_pad, N, steps)
+
+
+def _patched_shard_solver_inputs(mesh, const, init, batch, version=None):
+    out = _REAL["shard_solver_inputs"](mesh, const, init, batch,
+                                       version=version)
+    if _ACTIVE:
+        with _slock:
+            _counters["sanctioned_puts"] += 1
+    return out
+
+
+def _patched_shard_lpq_inputs(mesh, *args):
+    out = _REAL["shard_lpq_inputs"](mesh, *args)
     if _ACTIVE:
         with _slock:
             _counters["sanctioned_puts"] += 1
@@ -676,6 +722,38 @@ def compile_audit(n_devices: int = 8, evals: Optional[int] = None,
         except Exception as e:  # noqa: BLE001 -- inventory over crash
             entry["audit_error"] = repr(e)
         out["programs"].append(entry)
+    # the LPQ relaxation program (ISSUE 19): lanes shard on 'evals',
+    # node tables replicate, the dual-ascent combine is an all-gather
+    from .solver.lpq import lpq_steps
+    L_pad = max(8, e_par)
+    steps = lpq_steps()
+    f32 = lambda *s: np.ones(s, dtype=np.float32)
+    lpq_tree = (f32(L_pad, N), np.ones((L_pad, N), dtype=bool),
+                f32(L_pad, 3), f32(L_pad),
+                f32(N, 3), np.ones(L_pad, dtype=bool))
+    lpq_specs = meshmod.declared_specs("lpq_in", lpq_tree)
+    total = per_dev = 0
+    for leaf, spec in zip(lpq_tree, lpq_specs):
+        nbytes = _leaf_nbytes(leaf)
+        total += nbytes
+        per_dev += nbytes // _n_shards(mesh, spec)
+    budgets["lpq_in"] = {"total_bytes": total,
+                         "declared_per_shard_bytes": per_dev}
+    fn = meshmod.mesh_lpq_fn(mesh, L_pad, N, steps)
+    family = (_mesh_key(mesh)[1], _mesh_key(mesh)[2],
+              "lpq", L_pad, N, steps)
+    entry = {"program": f"mesh_lpq(L={L_pad}, N={N}, steps={steps})"}
+    try:
+        with mesh:
+            s_in = meshmod.shard_lpq_inputs(mesh, *lpq_tree)
+            compiled = fn.lower(*s_in).compile()
+        entry["collectives"] = audit_hlo(
+            family, compiled.as_text(), program=entry["program"]) \
+            if _ACTIVE else scan_collectives(compiled.as_text())
+        entry.update(_cost_summary(compiled))
+    except Exception as e:  # noqa: BLE001 -- inventory over crash
+        entry["audit_error"] = repr(e)
+    out["programs"].append(entry)
     return out
 
 
@@ -707,8 +785,12 @@ def enable() -> None:
     if not _REAL:
         _REAL["mesh_solve_fn"] = meshmod.mesh_solve_fn
         _REAL["shard_solver_inputs"] = meshmod.shard_solver_inputs
+        _REAL["mesh_lpq_fn"] = meshmod.mesh_lpq_fn
+        _REAL["shard_lpq_inputs"] = meshmod.shard_lpq_inputs
     meshmod.mesh_solve_fn = _patched_mesh_solve_fn
     meshmod.shard_solver_inputs = _patched_shard_solver_inputs
+    meshmod.mesh_lpq_fn = _patched_mesh_lpq_fn
+    meshmod.shard_lpq_inputs = _patched_shard_lpq_inputs
     _ACTIVE = True
 
 
@@ -722,6 +804,8 @@ def disable() -> None:
     from .parallel import mesh as meshmod
     meshmod.mesh_solve_fn = _REAL["mesh_solve_fn"]
     meshmod.shard_solver_inputs = _REAL["shard_solver_inputs"]
+    meshmod.mesh_lpq_fn = _REAL["mesh_lpq_fn"]
+    meshmod.shard_lpq_inputs = _REAL["shard_lpq_inputs"]
 
 
 def maybe_install_from_env() -> None:

@@ -565,8 +565,10 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
               use_mesh: bool, ptab=None, pinit=None, wave: bool = False,
               cache_version=None):
     """One solve_eval_batch[_preempt] call; shards over an (evals, nodes)
-    mesh when multiple devices are attached and the shapes divide the
-    mesh (non-preempt path only; preemption tables stay single-device).
+    mesh when multiple devices are attached, the shapes divide the
+    mesh, and NOMAD_TPU_MESH is not 0 (the pick_mesh chokepoint; off
+    is bit-for-bit the single-device path). Non-preempt path only;
+    preemption tables stay single-device.
     ``wave`` (homogeneous by fuse_key) routes the group through the
     wavefront kernel -- its per-step work is O(B), so it skips mesh
     sharding (nothing N-heavy to shard)."""
@@ -601,9 +603,9 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         metrics.incr("nomad.solver.mesh_dispatches")
         with mesh:
             s_const, s_init, s_batch = shard_solver_inputs(
-                mesh, const, init, batch)
+                mesh, const, init, batch, version=cache_version)
             fn = mesh_solve_fn(mesh, spread_alg, dtype_name)
-            chosen, scores, n_yielded, _ = fn(s_const, s_init, s_batch)
+            chosen, scores, n_yielded = fn(s_const, s_init, s_batch)
         from .. import jitcheck
         with jitcheck.sanctioned_fetch("mesh"):
             # the mesh path's one bulk fetch: gather + host copy

@@ -2598,7 +2598,9 @@ def _put_eval_sharded(batched: bool, e_dim: int, trees,
                       cache_version=None, tag: str = "compact"):
     """Device-put a tuple of (possibly nested) arrays, sharding the
     leading eval axis across ALL attached devices when it divides the
-    device count. The fused eval axis is embarrassingly data-parallel:
+    device count (and NOMAD_TPU_MESH is not 0 -- the same master
+    switch as the dense/LPQ mesh routes, so rollback to single-device
+    is one knob). The fused eval axis is embarrassingly data-parallel:
     each chip runs its lanes' scans independently (no collectives;
     outputs gather on fetch). Shared by the wave and wave-preempt
     dispatch paths so their sharding gates can't diverge.
@@ -2613,9 +2615,10 @@ def _put_eval_sharded(batched: bool, e_dim: int, trees,
     ``tag`` is the transfer ledger's tree-group attribution for these
     tables (the wave transports ship merged compact tables that can't
     decompose into const/init/batch)."""
+    from ..parallel.mesh import mesh_enabled
     from .constcache import device_put_cached
 
-    if not (batched and jax.device_count() > 1
+    if not (batched and mesh_enabled() and jax.device_count() > 1
             and e_dim % jax.device_count() == 0):
         leaves, treedef = jax.tree_util.tree_flatten(trees)
         buffers, _ = device_put_cached(leaves, version=cache_version,

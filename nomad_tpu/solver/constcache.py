@@ -37,6 +37,13 @@ exactly the pre-cache behavior). Bounds: NOMAD_TPU_CONST_CACHE_ENTRIES
 than NOMAD_TPU_CONST_CACHE_MIN_BYTES (default 4096) are always shipped
 fresh -- they ARE the delta traffic the design wants on the wire, and
 caching them would churn the LRU for nothing.
+
+Mesh dispatches (ISSUE 19) ride a per-shard twin of the same design:
+``device_put_sharded_cached`` keys single-device shard buffers by
+(content key, shard device) in a separate pool bounded by
+NOMAD_TPU_CONST_CACHE_SHARD_ENTRIES (default 512) and the shared MB
+budget, so a node-table write re-uploads only the shards whose slice
+content changed.
 """
 from __future__ import annotations
 
@@ -51,6 +58,10 @@ import numpy as np
 
 _LOCK = threading.Lock()
 _CACHE: "OrderedDict[bytes, _Entry]" = OrderedDict()
+# per-shard pool (ISSUE 19): single-device shard buffers keyed
+# (content key, shard device) -- separate store so a fleet of N-shard
+# slices can't LRU-churn the unsharded entries (and vice versa)
+_SHARD_CACHE: "OrderedDict[bytes, _Entry]" = OrderedDict()
 _STATS = {
     "hits": 0,
     "misses": 0,
@@ -59,13 +70,17 @@ _STATS = {
     "invalidations": 0,
     "evictions": 0,
     "resident_bytes": 0,
+    "shard_resident_bytes": 0,
+    "shard_resident_hwm": 0,
 }
 
 
 class _Entry:
-    __slots__ = ("buf", "nbytes", "version", "created_at", "hits")
+    __slots__ = ("buf", "nbytes", "version", "created_at", "hits",
+                 "shard")
 
-    def __init__(self, buf, nbytes: int, version: Optional[int]):
+    def __init__(self, buf, nbytes: int, version: Optional[int],
+                 shard: Optional[int] = None):
         self.buf = buf              # the pinned jax.Array
         self.nbytes = nbytes
         self.version = version      # node_table_index tag (hygiene only)
@@ -73,6 +88,7 @@ class _Entry:
         # stale-version occupancy and eviction pressure first-class
         self.created_at = time.time()
         self.hits = 0
+        self.shard = shard          # holding device id (per-shard pool)
 
 
 def enabled() -> bool:
@@ -101,6 +117,14 @@ def _min_bytes() -> int:
                                   "4096"))
     except ValueError:
         return 4096
+
+
+def _max_shard_entries() -> int:
+    try:
+        return max(1, int(os.environ.get(
+            "NOMAD_TPU_CONST_CACHE_SHARD_ENTRIES", "512")))
+    except ValueError:
+        return 512
 
 
 def _fingerprint(arr: np.ndarray) -> bytes:
@@ -227,6 +251,187 @@ def _evict_over_bounds_locked() -> None:
         _STATS["evictions"] += 1
 
 
+def _evict_shard_over_bounds_locked() -> None:
+    # the per-shard pool shares the MB budget knob but carries its own
+    # entries bound: one const tree is ~20 leaves x n_devices shards,
+    # so the unsharded entries knob (64) would thrash immediately
+    max_e, max_b = _max_shard_entries(), _max_bytes()
+    while _SHARD_CACHE and (len(_SHARD_CACHE) > max_e
+                            or _STATS["shard_resident_bytes"] > max_b):
+        _, ent = _SHARD_CACHE.popitem(last=False)
+        _STATS["shard_resident_bytes"] -= ent.nbytes
+        _STATS["evictions"] += 1
+
+
+def device_put_sharded_cached(arrays: Sequence[np.ndarray],
+                              shardings: Sequence,
+                              group: str = "mesh_const",
+                              version: Optional[int] = None,
+                              fallback_put=None,
+                              ) -> Tuple[List, int]:
+    """Per-shard content-addressed transfer (ISSUE 19): split each
+    array into the shard slices its sharding (built by
+    parallel/mesh.py -- this module never constructs one) assigns per
+    device, fingerprint each slice, and reuse pinned single-device
+    buffers for unchanged shards.  Cache keys are (content key, shard
+    device): the same BLAKE2b content addressing as the unsharded
+    cache suffixed with the holding device's id, so a node-table write
+    re-uploads ONLY the shards whose slice content actually changed --
+    the unchanged majority of the fleet stays resident (groundwork for
+    ROADMAP-3 delta streaming).  The global jax.Array is assembled
+    from the per-device buffers with
+    ``jax.make_array_from_single_device_arrays`` (no re-layout, no
+    wire traffic).  Returns (buffers, bytes_shipped).
+
+    Accounting matches device_put_cached -- hit bytes are *resident*
+    payload, misses are shipped payload + dispatch bytes -- plus one
+    per-shard declared/actual row per device in the transfer ledger
+    (xferobs.note_shard_bytes): the production-path source of the
+    ``per_shard`` rows shardcheck otherwise only writes while enabled.
+    ``fallback_put(arr, sharding)`` performs the whole-array sharded
+    put for small / cache-disabled arrays; callers pass a
+    parallel/mesh.py closure so the no-implicit-put lint discipline
+    holds."""
+    import jax
+
+    from ..server.telemetry import metrics
+    from . import xferobs
+
+    if fallback_put is None:
+        raise TypeError("device_put_sharded_cached needs a "
+                        "fallback_put(arr, sharding) closure from "
+                        "parallel/mesh.py")
+    from .. import jitcheck
+
+    arrays = [np.asarray(a) for a in arrays]
+    min_b = _min_bytes()
+    use_cache = enabled()
+    buffers: List = [None] * len(arrays)
+    shipped = 0
+    hits = misses = saved = 0
+    hit_bytes = 0
+    miss_puts: List[Tuple[int, int, object, np.ndarray, bytes]] = []
+    per_arr_parts: dict = {}
+    with _LOCK:
+        for i, (arr, sharding) in enumerate(zip(arrays, shardings)):
+            if not use_cache or arr.nbytes < min_b:
+                continue                     # fallback path, below
+            idx_map = sharding.addressable_devices_indices_map(arr.shape)
+            devs = sorted(idx_map, key=lambda d: d.id)
+            parts: List = [None] * len(devs)
+            fp_by_slice: dict = {}
+            for j, dev in enumerate(devs):
+                idx = idx_map[dev]
+                slice_key = tuple(
+                    (s.start, s.stop, s.step) if isinstance(s, slice)
+                    else s for s in (idx or ()))
+                fp = fp_by_slice.get(slice_key)
+                part = None
+                if fp is None:
+                    part = np.ascontiguousarray(arr[idx])
+                    part.setflags(write=False)
+                    fp = _fingerprint(part)
+                    fp_by_slice[slice_key] = fp
+                    if jitcheck._ACTIVE:
+                        jitcheck.note_fingerprint(part, fp)
+                key = fp + dev.id.to_bytes(4, "little")
+                ent = _SHARD_CACHE.get(key)
+                if ent is not None:
+                    _SHARD_CACHE.move_to_end(key)
+                    ent.hits += 1
+                    parts[j] = ent.buf
+                    hits += 1
+                    saved += ent.nbytes
+                    hit_bytes += ent.nbytes
+                else:
+                    if part is None:
+                        part = np.ascontiguousarray(arr[idx])
+                        part.setflags(write=False)
+                    miss_puts.append((i, j, dev, part, key))
+                    misses += 1
+                    shipped += part.nbytes
+            per_arr_parts[i] = (sharding, parts)
+    # host->device uploads outside _LOCK (device_put can take long;
+    # the fused path batches its misses the same way)
+    if miss_puts:
+        put_bufs = jax.device_put([p for (_i, _j, _d, p, _k)
+                                   in miss_puts],
+                                  [d for (_i, _j, d, _p, _k)
+                                   in miss_puts])
+        with _LOCK:
+            for (i, j, dev, part, key), buf in zip(miss_puts, put_bufs):
+                per_arr_parts[i][1][j] = buf
+                _SHARD_CACHE[key] = _Entry(buf, part.nbytes, version,
+                                           shard=int(dev.id))
+                _STATS["shard_resident_bytes"] += part.nbytes
+            _evict_shard_over_bounds_locked()
+    # assemble the sharded jax.Arrays from the per-device buffers
+    for i, (sharding, parts) in per_arr_parts.items():
+        buffers[i] = jax.make_array_from_single_device_arrays(
+            arrays[i].shape, sharding, parts)
+    # fallback: small / cache-disabled arrays ship whole via the
+    # caller's parallel/mesh.py put closure
+    fresh_idx = [i for i, b in enumerate(buffers)
+                 if b is None]
+    for i in fresh_idx:
+        buffers[i] = fallback_put(arrays[i], shardings[i])
+        shipped += arrays[i].nbytes
+    with _LOCK:
+        _STATS["hits"] += hits
+        _STATS["misses"] += misses
+        _STATS["bytes_shipped_total"] += shipped
+        _STATS["bytes_saved_total"] += saved
+        if _STATS["shard_resident_bytes"] > _STATS["shard_resident_hwm"]:
+            _STATS["shard_resident_hwm"] = _STATS["shard_resident_bytes"]
+        shard_resident_now = _STATS["shard_resident_bytes"]
+        resident_now = _STATS["resident_bytes"] + shard_resident_now
+    # ledger attribution outside _LOCK (same ordering discipline as
+    # device_put_cached): hit bytes are resident, the rest shipped
+    if xferobs.enabled():
+        if hit_bytes:
+            xferobs.note_payload(group, hit_bytes, resident=True)
+        fresh_bytes = sum(arrays[i].nbytes for i in fresh_idx)
+        miss_bytes = sum(p.nbytes for (_i, _j, _d, p, _k) in miss_puts)
+        if fresh_bytes or miss_bytes:
+            xferobs.note_payload(group, fresh_bytes + miss_bytes)
+        # per-shard declared/actual rows: declared = the spec's shard
+        # bytes, actual = the bytes each device really holds -- equal
+        # by construction here (the put IS by the declared sharding)
+        per_dev: dict = {}
+        for i, (sharding, parts) in per_arr_parts.items():
+            idx_map = sharding.addressable_devices_indices_map(
+                arrays[i].shape)
+            for dev, part in zip(sorted(idx_map, key=lambda d: d.id),
+                                 parts):
+                per_dev[dev.id] = per_dev.get(dev.id, 0) + part.nbytes
+        for i in fresh_idx:
+            sharding = shardings[i]
+            idx_map = sharding.addressable_devices_indices_map(
+                arrays[i].shape)
+            shard_b = int(np.prod(
+                sharding.shard_shape(arrays[i].shape),
+                dtype=np.int64) * arrays[i].dtype.itemsize)
+            for dev in idx_map:
+                per_dev[dev.id] = per_dev.get(dev.id, 0) + shard_b
+        for dev_id in sorted(per_dev):
+            xferobs.note_shard_bytes(group, f"d{dev_id}",
+                                     per_dev[dev_id], per_dev[dev_id])
+        xferobs.note_resident_level(resident_now)
+    metrics.sample("nomad.solver.const_cache_shard_resident_bytes",
+                   float(shard_resident_now))
+    metrics.sample("nomad.solver.const_cache_shard_resident_hwm",
+                   float(_STATS["shard_resident_hwm"]))
+    if hits:
+        metrics.incr("nomad.solver.const_cache_hit", hits)
+    if misses:
+        metrics.incr("nomad.solver.const_cache_miss", misses)
+    note_dispatch_bytes(shipped)
+    from ..server.tracing import tracer
+    tracer.event("solver.constcache_sharded", hits=hits, misses=misses,
+                 bytes_shipped=shipped, bytes_saved=saved)
+    return buffers, shipped
+
+
 def note_dispatch_bytes(n: int) -> None:
     """Record one dispatch's actual host->device payload (bytes that hit
     the wire AFTER cache hits are subtracted). Shared by the fused,
@@ -248,11 +453,18 @@ def residency() -> List[dict]:
     occupancy and eviction pressure are readable, not inferred."""
     now = time.time()
     with _LOCK:
-        return [{"id": fp.hex()[:12], "bytes": ent.nbytes,
+        rows = [{"id": fp.hex()[:12], "bytes": ent.nbytes,
                  "version": ent.version,
                  "age_s": round(now - ent.created_at, 1),
                  "hits": ent.hits}
                 for fp, ent in _CACHE.items()]
+        rows.extend(
+            {"id": key.hex()[:12], "bytes": ent.nbytes,
+             "version": ent.version,
+             "age_s": round(now - ent.created_at, 1),
+             "hits": ent.hits, "shard": ent.shard}
+            for key, ent in _SHARD_CACHE.items())
+        return rows
 
 
 def note_table_write(tables, table_index: int, delta=None) -> None:
@@ -269,7 +481,7 @@ def note_node_table_write(table_index: int) -> None:
     under an older fleet version. Correctness never depends on this
     (content addressing self-validates); it keeps dead fleet versions
     from squatting on device memory until LRU pressure finds them."""
-    if not _CACHE:
+    if not _CACHE and not _SHARD_CACHE:
         return
     with _LOCK:
         stale = [fp for fp, ent in _CACHE.items()
@@ -277,10 +489,20 @@ def note_node_table_write(table_index: int) -> None:
         for fp in stale:
             ent = _CACHE.pop(fp)
             _STATS["resident_bytes"] -= ent.nbytes
-        if stale:
+        # per-shard pool: same hygiene -- shards whose content DID
+        # survive the write re-enter on the next dispatch as fresh
+        # entries keyed by the same (unchanged) content
+        stale_s = [k for k, ent in _SHARD_CACHE.items()
+                   if ent.version is not None
+                   and ent.version < table_index]
+        for k in stale_s:
+            ent = _SHARD_CACHE.pop(k)
+            _STATS["shard_resident_bytes"] -= ent.nbytes
+        if stale or stale_s:
             _STATS["invalidations"] += 1
-        resident_now = _STATS["resident_bytes"]
-    if stale:
+        resident_now = (_STATS["resident_bytes"]
+                        + _STATS["shard_resident_bytes"])
+    if stale or stale_s:
         from . import xferobs
         xferobs.note_resident_level(resident_now)
 
@@ -291,9 +513,11 @@ def invalidate_all(reason: str = "") -> None:
     transport are not trusted, and a fresh upload is cheap next to the
     outage that just ended."""
     with _LOCK:
-        had = bool(_CACHE)
+        had = bool(_CACHE) or bool(_SHARD_CACHE)
         _CACHE.clear()
+        _SHARD_CACHE.clear()
         _STATS["resident_bytes"] = 0
+        _STATS["shard_resident_bytes"] = 0
         if had:
             _STATS["invalidations"] += 1
     if had:
@@ -310,6 +534,7 @@ def stats() -> dict:
     with _LOCK:
         out = dict(_STATS)
         out["entries"] = len(_CACHE)
+        out["shard_entries"] = len(_SHARD_CACHE)
     out["enabled"] = enabled()
     return out
 
@@ -317,6 +542,8 @@ def stats() -> dict:
 def _reset_for_tests() -> None:
     with _LOCK:
         _CACHE.clear()
+        _SHARD_CACHE.clear()
         _STATS.update(hits=0, misses=0, bytes_shipped_total=0,
                       bytes_saved_total=0, invalidations=0, evictions=0,
-                      resident_bytes=0)
+                      resident_bytes=0, shard_resident_bytes=0,
+                      shard_resident_hwm=0)

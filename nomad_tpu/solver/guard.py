@@ -635,6 +635,12 @@ def state() -> dict:
         "cache_hit": counters.get("nomad.solver.pack_cache_hit", 0),
         "cache_miss": counters.get("nomad.solver.pack_cache_miss", 0),
     }
+    # mesh execution (ISSUE 19): knob + picked grid + dispatch counters
+    try:
+        from .service import mesh_status
+        snap["mesh"] = mesh_status()
+    except Exception:  # noqa: BLE001 -- status must never fail the agent
+        snap["mesh"] = {}
     snap["degraded"] = bool(
         (snap["checked"] and not snap["ok"])
         or breaker["state"] != BREAKER_CLOSED)

@@ -212,20 +212,32 @@ def _l_bucket(n: int) -> int:
     return int(2 ** np.ceil(np.log2(n)))
 
 
-@functools.lru_cache(maxsize=16)
-def _lp_program(L_pad: int, N: int, steps: int):
-    """Jitted projected-gradient / softmax-annealing LP relaxation.
+def _lp_solve_body(N: int, steps: int, gather=None):
+    """The pure projected-gradient / softmax-annealing solve, shared by
+    the single-device program (``_lp_program``) and the mesh program
+    (parallel/mesh.py ``mesh_lpq_fn``).
 
     Variables: X (L, N), each lane's relaxed placement distribution over
     nodes (rows of one lane are exchangeable -- uniform asks -- so the
     alloc x node program collapses to lane x node with per-lane
     multiplicity ``pcount``).  Dual prices mu (N, 3) ascend on
     cpu/mem/disk overload; the primal follows the price-adjusted values
-    through a falling softmax temperature (anneal -> argmax)."""
+    through a falling softmax temperature (anneal -> argmax).
+
+    ``gather`` is the mesh hook: applied to the load-einsum operand so
+    the sharded lane axis is all-gathered (replicated) BEFORE the
+    reduction over lanes.  The einsum then runs whole on every device
+    -- identical kernel, identical f32 summation order -- which is what
+    keeps mesh output bit-for-bit equal to single-device (a psum over
+    lane shards re-associates the sum, and the anneal amplifies that
+    ulp noise into placement flips).  None (single-device) is the
+    identity: the traced math is unchanged."""
     import jax
     import jax.numpy as jnp
 
     t_hi, t_lo, eta = 0.25, 0.02, 0.5
+    if gather is None:
+        gather = lambda x: x  # noqa: E731 -- identity, single-device
 
     def solve(V, feas, ask, pcount, free, active):
         # V/feas (L, N); ask (L, 3); pcount/active (L,); free (N, 3)
@@ -243,7 +255,8 @@ def _lp_program(L_pad: int, N: int, steps: int):
             frac = t.astype(jnp.float32) / max(steps - 1, 1)
             temp = t_hi * (t_lo / t_hi) ** frac
             X = X_at(mu, temp)
-            load = jnp.einsum("ln,lr->nr", X * pcount[:, None], ask)
+            load = jnp.einsum("ln,lr->nr",
+                              gather(X * pcount[:, None]), ask)
             mu = jnp.clip(mu + eta * (load - free) / cap, 0.0, None)
             return mu, None
 
@@ -251,7 +264,15 @@ def _lp_program(L_pad: int, N: int, steps: int):
         mu, _ = jax.lax.scan(body, mu0, jnp.arange(steps))
         return X_at(mu, t_lo), mu
 
-    return jax.jit(solve)
+    return solve
+
+
+@functools.lru_cache(maxsize=16)
+def _lp_program(L_pad: int, N: int, steps: int):
+    """Jitted single-device LP relaxation (see _lp_solve_body)."""
+    import jax
+
+    return jax.jit(_lp_solve_body(N, steps))
 
 
 # ---------------------------------------------------------------------------
@@ -544,11 +565,37 @@ def _solve_lp_group(lanes: List[PackedLane], ledger: Dict[str, list]
         ask[li] = v.ask
         pcount[li] = v.P
         active[li] = True
-    program = _lp_program(L_pad, n_pad, lpq_steps())
-    X, mu = program(V, feas, ask, pcount,
-                    free.T.astype(np.float32), active)
-    X = np.asarray(X, dtype=np.float64)[:L]
-    mu = np.asarray(mu, dtype=np.float64)                   # (N, 3)
+    import jax
+
+    steps = lpq_steps()
+    mesh = None
+    if jax.device_count() > 1:
+        # pick_mesh is the NOMAD_TPU_MESH chokepoint: knob off (or no
+        # usable grid) -> None -> the single-device program bit-for-bit
+        from ..parallel.mesh import pick_mesh
+        mesh = pick_mesh(L_pad, n_pad)
+    if mesh is not None:
+        from .. import jitcheck
+        from ..parallel.mesh import mesh_lpq_fn, shard_lpq_inputs
+        from . import xferobs
+        metrics.incr("nomad.lpq.mesh_dispatches")
+        with mesh:
+            s_in = shard_lpq_inputs(mesh, V, feas, ask, pcount,
+                                    free.T.astype(np.float32), active)
+            program = mesh_lpq_fn(mesh, L_pad, n_pad, steps)
+            X_dev, mu_dev = program(*s_in)
+        with jitcheck.sanctioned_fetch("lpq"):
+            # the mesh route's one bulk fetch: gather + host copy
+            X = np.asarray(X_dev, dtype=np.float64)[:L]
+            mu = np.asarray(mu_dev, dtype=np.float64)       # (N, 3)
+        xferobs.note_fetch(
+            int(X_dev.nbytes) + int(mu_dev.nbytes), "lpq")
+    else:
+        program = _lp_program(L_pad, n_pad, steps)
+        X, mu = program(V, feas, ask, pcount,
+                        free.T.astype(np.float32), active)
+        X = np.asarray(X, dtype=np.float64)[:L]
+        mu = np.asarray(mu, dtype=np.float64)               # (N, 3)
 
     # -- round: per-lane integral counts by largest remainder -----------
     assigned: List[np.ndarray] = []

@@ -241,6 +241,48 @@ def tg_solver_eligible(tg, job=None, preempt: bool = False) -> bool:
     return True
 
 
+def mesh_status() -> dict:
+    """Mesh-execution snapshot for guard.state() / `operator solver
+    status` (ISSUE 19): the NOMAD_TPU_MESH knob, attached device count,
+    the (evals, nodes) grid the dispatch stack would pick for a dense
+    8-lane batch, and the mesh dispatch counters for both production
+    kernels (fused greedy + LPQ). Never initializes jax: when the
+    backend has not been touched yet, devices reports 0 and no grid is
+    probed -- status must stay callable from light control-plane
+    paths."""
+    import sys
+
+    from ..parallel.mesh import mesh_enabled, pick_mesh
+    from ..server.telemetry import metrics
+
+    counters = metrics.snapshot().get("counters", {})
+    out = {
+        "enabled": mesh_enabled(),
+        "devices": 0,
+        "grid": None,
+        "dispatches": counters.get("nomad.solver.mesh_dispatches", 0),
+        "lpq_dispatches": counters.get("nomad.lpq.mesh_dispatches", 0),
+    }
+    jax = sys.modules.get("jax")
+    # gate on the guard's advisory flags, NOT a live jax call: with a
+    # hung/degraded backend, jax.device_count() can block for the full
+    # init window -- status would stall AND its late completion would
+    # read as a spurious recovery (the backend-guard reprobe drill)
+    from . import guard
+    checked, ok = guard._FLAGS
+    if jax is None or not (checked and ok):
+        return out
+    try:
+        out["devices"] = int(jax.device_count())
+        if out["enabled"] and out["devices"] > 1:
+            mesh = pick_mesh(8, 256)
+            if mesh is not None:
+                out["grid"] = [int(x) for x in mesh.devices.shape]
+    except Exception:  # noqa: BLE001 -- status must never fail the agent
+        pass
+    return out
+
+
 def dispatch_lane(lane: PackedLane):
     """Solve ONE lane in its own device dispatch; returns host-side numpy
     (chosen, scores, n_yielded[, evict_rows]). The batched path fuses many

@@ -102,6 +102,15 @@ HEADLINE_FIELDS = {
     # control-plane kernels move -- snapshot build + plan verify +
     # materialize, isolated from solver time
     "eval_fixed_ms": ("lower", 0.25),
+    # multi-chip mesh solve (ISSUE 19): mesh-route throughput must not
+    # fall, per-shard ship bytes and collective overhead must not
+    # bloat, and mesh-vs-single-device parity is zero-tolerance (the
+    # mesh route is bit-exact by construction; ANY positive count
+    # means a re-associated reduction crept into a kernel)
+    "mesh_pps": ("higher", 0.25),
+    "mesh_shard_bytes": ("lower", 0.25),
+    "mesh_collective_ms": ("lower", 0.50),
+    "mesh_parity_mismatch": ("lower", 0.0),
 }
 
 # Absolute noise floors for lower-better fields whose round-to-round

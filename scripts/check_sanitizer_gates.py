@@ -40,6 +40,7 @@ EXPECTED = {
     }),
     "_JITCHECK_SUITES": ("_jitcheck_sanitizer", {
         "test_dispatch_pipeline", "test_lpq", "test_solver_parity",
+        "test_mesh_grid",
     }),
     "_STATECHECK_SUITES": ("_statecheck_sanitizer", {
         "test_plan_batch", "test_pack_delta", "test_churn_storm",
@@ -51,6 +52,7 @@ EXPECTED = {
     }),
     "_SHARDCHECK_SUITES": ("_shardcheck_sanitizer", {
         "test_multichip_dryrun", "test_dispatch_pipeline",
+        "test_mesh_grid",
     }),
 }
 

@@ -54,6 +54,30 @@ def test_latency_rise_past_tolerance_fails():
     assert reg == []
 
 
+def test_mesh_rows_gate():
+    """ISSUE 19: the mesh leg's rows trend like the other headline
+    fields -- throughput higher-better, shard bytes/collective
+    overhead lower-better -- and mesh parity is zero-tolerance (a
+    positive count vs a zero round means a re-associated reduction
+    crept into a mesh kernel)."""
+    prev = art(mesh_pps=9000.0, mesh_shard_bytes=24616,
+               mesh_collective_ms=9.0, mesh_parity_mismatch=0)
+    reg, _ = cbr.compare_artifacts(prev, dict(prev))
+    assert reg == []
+    reg, _ = cbr.compare_artifacts(prev, {**prev, "mesh_pps": 6000.0})
+    assert any(r.startswith("mesh_pps:") for r in reg)
+    reg, _ = cbr.compare_artifacts(
+        prev, {**prev, "mesh_shard_bytes": 40000})
+    assert any(r.startswith("mesh_shard_bytes:") for r in reg)
+    reg, _ = cbr.compare_artifacts(
+        prev, {**prev, "mesh_parity_mismatch": 1})
+    assert any(r.startswith("mesh_parity_mismatch:") for r in reg)
+    # mesh fields absent (single-device round) only warns
+    reg, warn = cbr.compare_artifacts(prev, art())
+    assert not any(r.startswith("mesh_") for r in reg)
+    assert any(w.startswith("mesh_pps:") for w in warn)
+
+
 def test_tolerance_override():
     reg, _ = cbr.compare_artifacts(
         art(value=1000.0), art(value=850.0), {"value": 0.20})

@@ -23,7 +23,7 @@ def test_checkup_clean_on_real_tree(capsys):
     assert cu.main([]) == 0, capsys.readouterr().out
     out = capsys.readouterr().out
     for name in ("nomadlint", "knob-doc", "metrics-doc",
-                 "sanitizer-gates", "native"):
+                 "sanitizer-gates", "native", "compile-audit"):
         assert f"== {name}: ok" in out
     assert "-> exit 0" in out
 
@@ -134,6 +134,46 @@ def test_native_gate_abi_matches_on_real_tree():
     rc, lines, _ = cu._run_native()
     assert rc == 0
     assert any(f"ABI v{native.ABI_VERSION}" in ln for ln in lines)
+
+
+def test_compile_audit_skips_without_jax(monkeypatch):
+    """With jax not importable the compile-audit component is a
+    skip-with-notice, not a failure -- the static suite must stay
+    runnable on doc-only checkouts."""
+    import importlib.util as ilu
+    real = ilu.find_spec
+    monkeypatch.setattr(
+        ilu, "find_spec",
+        lambda name, *a, **k: None if name == "jax"
+        else real(name, *a, **k))
+    rc, lines, results = cu._run_compile_audit()
+    assert rc == 0
+    assert results == []
+    assert any("jax unavailable" in ln and "skipped" in ln
+               for ln in lines)
+
+
+def test_compile_audit_failure_surfaces(monkeypatch):
+    """A nonzero subprocess rc fails the component and carries the
+    audit's finding lines into the SARIF results."""
+    import subprocess
+
+    class _Proc:
+        returncode = 1
+        stdout = ("mesh = 4x2 over 8 devices\n"
+                  "program: mesh_solve(spread_alg=False)\n"
+                  "  AUDIT ERROR: unbudgeted all-reduce x3\n")
+        stderr = ""
+
+    monkeypatch.setattr(subprocess, "run",
+                        lambda *a, **k: _Proc())
+    rc, lines, results = cu._run_compile_audit()
+    assert rc == 1
+    assert any("AUDIT ERROR" in ln for ln in lines)
+    assert results and all(r["ruleId"] == "compile-audit"
+                           for r in results)
+    assert any("unbudgeted all-reduce" in r["message"]["text"]
+               for r in results)
 
 
 def test_sarif_merges_components_on_clean_tree(tmp_path, capsys):

@@ -39,9 +39,11 @@ def test_inventory_is_pinned():
         "test_batch_worker", "test_plan_batch", "test_churn_storm",
         "test_worker_pool"}
     # the sharding sanitizer covers the ISSUE-15 suites (the executed
-    # multichip gate + the mesh-dispatching pipeline suite)
+    # multichip gate + the mesh-dispatching pipeline suite) plus the
+    # ISSUE-19 mesh-shape parity grid
     assert csg.EXPECTED["_SHARDCHECK_SUITES"][1] == {
-        "test_multichip_dryrun", "test_dispatch_pipeline"}
+        "test_multichip_dryrun", "test_dispatch_pipeline",
+        "test_mesh_grid"}
 
 
 def _fake_conftest(tmp_path, body):
@@ -57,6 +59,7 @@ _LOCKCHECK_SUITES = {
 }
 _JITCHECK_SUITES = {
     "test_dispatch_pipeline", "test_lpq", "test_solver_parity",
+    "test_mesh_grid",
 }
 _STATECHECK_SUITES = {
     "test_plan_batch", "test_pack_delta", "test_churn_storm",
@@ -68,6 +71,7 @@ _SCHEDCHECK_SUITES = {
 }
 _SHARDCHECK_SUITES = {
     "test_multichip_dryrun", "test_dispatch_pipeline",
+    "test_mesh_grid",
 }
 
 

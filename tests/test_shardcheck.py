@@ -310,22 +310,32 @@ def test_ledger_mismatch_rows_ride_xferobs():
 
 @needs_mesh
 def test_compile_audit_inventories_programs():
-    """compile_audit compiles both registered program variants for the
-    8-device mesh with NO server and returns the collective + cost +
+    """compile_audit compiles every registered program for the
+    8-device mesh with NO server -- both greedy spread variants plus
+    the LPQ kernel (ISSUE 19) -- and returns the collective + cost +
     per-shard-budget inventory."""
     inv = shardcheck.compile_audit(n_devices=8, nodes=64, place=4)
     assert inv["mesh"] == [4, 2]
-    assert len(inv["programs"]) == 2
+    assert len(inv["programs"]) == 3
     for p in inv["programs"]:
         assert "audit_error" not in p, p
-        # the cross-shard select/argmax reduction must be visible
+        # the cross-shard reduction (select/argmax for greedy, the
+        # dual-ascent gather for LPQ) must be visible
         assert p["collectives"], p
+    lpq = [p for p in inv["programs"]
+           if p["program"].startswith("mesh_lpq")]
+    assert len(lpq) == 1
+    # the LPQ combine is an all-gather by design (a psum would
+    # re-associate the load sum and break bit-parity)
+    assert lpq[0]["collectives"].get("all-gather")
+    assert "all-reduce" not in lpq[0]["collectives"]
     budget = inv["per_shard_budget"]
     # node-sharded const tables: per-shard strictly below total
     assert budget["mesh_const"]["declared_per_shard_bytes"] < \
         budget["mesh_const"]["total_bytes"]
     assert budget["mesh_batch"]["declared_per_shard_bytes"] * 8 <= \
         budget["mesh_batch"]["total_bytes"] * 2
+    assert "lpq_in" in budget
 
 
 def test_compile_audit_refuses_without_devices():
