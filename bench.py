@@ -1038,6 +1038,14 @@ def time_scale_churn(mismatch):
         f"{out['rss_growth_mb']:+.0f}MB, "
         f"parity_mismatch={out['parity_mismatch']}"
         f"{', TRUNCATED' if out['truncated'] else ''}")
+    log(f"bench: churn delta stream "
+        f"{'ON' if out['delta_stream_enabled'] else 'OFF'}: "
+        f"{out['delta_promotions']} promotions / "
+        f"{out['delta_reuses']} reuses / "
+        f"{out['delta_fallbacks']} fallbacks, "
+        f"{out['delta_bytes_per_dispatch']:.0f}B delta + "
+        f"{out['shipped_bytes_per_dispatch']:.0f}B shipped per "
+        f"dispatch, ledger_parity={out['xfer_ledger_parity']}")
     return out
 
 
@@ -1294,8 +1302,9 @@ def main_tier(platform: str, tier: int):
     # explicit degraded verdict + breaker/dispatch state: a wedged
     # tunnel or tripped breaker must never read as a chip result
     from nomad_tpu.benchkit import (
-        artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
-        shardcheck_stamp, statecheck_stamp, xferobs_stamp)
+        artifact_stamp, delta_stream_stamp, dispatch_health_stamp,
+        jitcheck_stamp, shardcheck_stamp, statecheck_stamp,
+        xferobs_stamp)
     out.update(dispatch_health_stamp(platform))
     out.update(jitcheck_stamp())
     out.update(statecheck_stamp())
@@ -1303,6 +1312,9 @@ def main_tier(platform: str, tier: int):
     # transfer ledger + tunnel-model fields (ISSUE 13): byte parity and
     # per-dispatch payload are gated per round like the sanitizers
     out.update(xferobs_stamp())
+    # delta streaming (ISSUE 20): chain promotions vs wholesale
+    # fallbacks + cumulative delta payload, regress-gated
+    out.update(delta_stream_stamp())
     # ISSUE 19: mesh-route fields ride the tier tails too (self-guarded
     # on device count + the NOMAD_TPU_MESH knob; parity is gating)
     if os.environ.get("BENCH_SKIP_MESH", "") != "1":
@@ -1778,6 +1790,19 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
         out["churn_quarantine_deferrals"] = churn["quarantine_deferrals"]
         out["churn_parity_mismatch"] = churn["parity_mismatch"]
         out["churn_truncated"] = churn["truncated"]
+        # delta streaming (ISSUE 20): warm steady-state payload per
+        # dispatch (journal deltas scattered on device instead of
+        # re-shipped tables) + fallback count; ledger parity must be 0
+        out["churn_delta_stream_enabled"] = \
+            churn["delta_stream_enabled"]
+        out["churn_delta_promotions"] = churn["delta_promotions"]
+        out["churn_delta_reuses"] = churn["delta_reuses"]
+        out["churn_delta_fallbacks"] = churn["delta_fallbacks"]
+        out["churn_delta_bytes_per_dispatch"] = \
+            churn["delta_bytes_per_dispatch"]
+        out["churn_shipped_bytes_per_dispatch"] = \
+            churn["shipped_bytes_per_dispatch"]
+        out["churn_xfer_ledger_parity"] = churn["xfer_ledger_parity"]
     if wscale is not None:
         # N-worker control plane scaling (ISSUE 16): e2e placements/s
         # through the supervised plain pool per size, at fold parity 0
@@ -1812,8 +1837,9 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # healthy TPU round (VERDICT r3 next-step 1, r5 weak #1): stamp the
     # explicit degraded verdict + dispatch-layer state
     from nomad_tpu.benchkit import (
-        artifact_stamp, dispatch_health_stamp, jitcheck_stamp,
-        shardcheck_stamp, statecheck_stamp, xferobs_stamp)
+        artifact_stamp, delta_stream_stamp, dispatch_health_stamp,
+        jitcheck_stamp, shardcheck_stamp, statecheck_stamp,
+        xferobs_stamp)
     out.update(dispatch_health_stamp(platform))
     # dispatch discipline (ISSUE 10): retraces/host syncs/x64 leaks
     # observed this run, gated by scripts/check_bench_regress.py
@@ -1827,6 +1853,9 @@ def _emit(platform, p50, mismatch, oracle_total, native_total=None,
     # (must be 0), and the live rtt/bandwidth fit -- the r05 manual
     # tunnel diagnosis as a standing, regress-gated readout
     out.update(xferobs_stamp())
+    # delta streaming (ISSUE 20): version-chain promotions vs wholesale
+    # fallbacks + cumulative delta payload, regress-gated
+    out.update(delta_stream_stamp())
     # quality scoreboard + per-stage saturation from the headline e2e
     # server (ISSUE 7): quality_fragmentation / quality_drift /
     # stage_busy_pct_* so solver changes are judged on placement

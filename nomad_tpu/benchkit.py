@@ -161,6 +161,26 @@ def xferobs_stamp() -> dict:
     return xferobs.bench_fields()
 
 
+def delta_stream_stamp() -> dict:
+    """Delta-streaming artifact fields (ISSUE 20): version-chain
+    promotions/reuses vs wholesale fallbacks and the cumulative delta
+    payload, so the journal->device scatter path's win (and any
+    regression back to re-shipping full tables) is read off every
+    artifact. Gated by scripts/check_bench_regress.py direction rows."""
+    from .solver import constcache
+
+    cc = constcache.stats()
+    return {
+        "delta_stream_enabled": bool(
+            cc.get("delta_stream_enabled", False)),
+        "delta_promotions": cc.get("delta_promotions", 0),
+        "delta_reuses": cc.get("delta_reuses", 0),
+        "delta_fallbacks": cc.get("delta_fallbacks", 0),
+        "delta_bytes_total": cc.get("delta_bytes_total", 0),
+        "delta_chain_resident_bytes": cc.get("chain_resident_bytes", 0),
+    }
+
+
 def artifact_stamp(repo_root: Optional[str] = None) -> dict:
     """Provenance stamp for every bench artifact so trend tooling can
     line BENCH_rNN.json files up without guessing (ISSUE 7 satellite):
@@ -515,6 +535,14 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
                 break
         latencies_ms.clear()        # warmup is not steady state
         rss_rounds.append(round(rss_now_mb(), 1))
+        # ISSUE-20 delta-stream leg: snapshot the version-chain and
+        # transfer-ledger counters AFTER warmup so the reported
+        # bytes-per-dispatch is the warm steady state (install-time
+        # wholesale uploads are warmup, not the regime under test)
+        from .solver import constcache as _cc
+        from .solver import xferobs as _xo
+        cc0 = _cc.stats()
+        xo0 = _xo.state() if _xo.enabled() else {}
 
         flappy = fleet_ids[:flap_nodes]
         t_run0 = time.perf_counter()
@@ -590,6 +618,9 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
             if live_now == live_target:
                 break
             time.sleep(0.05)
+        cc1 = _cc.stats()
+        xo1 = _xo.state() if _xo.enabled() else {}
+        xfer_parity = abs(_xo.parity()) if _xo.enabled() else 0
     finally:
         if prev_lean is None:
             os.environ.pop("NOMAD_TPU_LEAN_ALLOC_METRICS", None)
@@ -610,7 +641,7 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
         return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2)
 
     rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-    return {
+    out = {
         "live_allocs": live,
         "terminal_allocs": terminal,
         "rounds": rounds,
@@ -629,6 +660,29 @@ def run_scale_churn(live_target: int, n_nodes: int = 10000,
         "parity_mismatch": parity_mismatch,
         "truncated": truncated,
     }
+    # ISSUE-20 delta-stream leg: warm steady-state deltas over the
+    # churn rounds only (warmup installs subtracted out).  dispatches
+    # come off the transfer ledger; with NOMAD_TPU_XFEROBS=0 the
+    # per-dispatch normalization is structurally absent and reported 0.
+    n_disp = (xo1.get("dispatches", 0) or 0) - \
+             (xo0.get("dispatches", 0) or 0)
+    d_bytes = cc1["delta_bytes_total"] - cc0["delta_bytes_total"]
+    shipped = (xo1.get("shipped_bytes_total", 0) or 0) - \
+              (xo0.get("shipped_bytes_total", 0) or 0)
+    out.update({
+        "delta_stream_enabled": bool(cc1.get("delta_stream_enabled")),
+        "delta_promotions": cc1["delta_promotions"]
+        - cc0["delta_promotions"],
+        "delta_reuses": cc1["delta_reuses"] - cc0["delta_reuses"],
+        "delta_fallbacks": cc1["delta_fallbacks"]
+        - cc0["delta_fallbacks"],
+        "delta_bytes_per_dispatch": round(d_bytes / n_disp, 1)
+        if n_disp else 0.0,
+        "shipped_bytes_per_dispatch": round(shipped / n_disp, 1)
+        if n_disp else 0.0,
+        "xfer_ledger_parity": xfer_parity,
+    })
+    return out
 
 
 def run_worker_scaling(pool_sizes=(1, 2, 4, 8), n_nodes: int = 2000,

@@ -1314,12 +1314,28 @@ def cmd_operator_transfers(args) -> int:
               f"(hwm {mb(res.get('resident_hwm_bytes'))}MB, "
               f"{res.get('evictions')} evictions, "
               f"{res.get('invalidations')} invalidations)")
+        if res.get("chain_entries"):
+            print(f"delta chain: {res.get('chain_entries')} entries, "
+                  f"{mb(res.get('chain_resident_bytes'))}MB resident, "
+                  f"{res.get('delta_promotions')} promotions / "
+                  f"{res.get('delta_reuses')} reuses / "
+                  f"{res.get('delta_fallbacks')} fallbacks, "
+                  f"{mb(res.get('delta_bytes_total'))}MB delta payload")
         top = res.get("top") or []
         if top:
+            # chain rows promote in place: show the base version the
+            # device buffer was installed at and how many journal
+            # deltas have been applied since
+            def chain_col(e):
+                if "base_version" in e:
+                    return (f"v{e['base_version']}"
+                            f"+{e.get('deltas_applied', 0)}d")
+                return ""
             print(_fmt_table(
                 [[e["id"], mb(e["bytes"]), str(e.get("version")),
-                  f"{e['age_s']:.0f}", str(e["hits"])] for e in top],
-                ["Entry", "MB", "Version", "Age(s)", "Hits"]))
+                  chain_col(e), f"{e['age_s']:.0f}", str(e["hits"])]
+                 for e in top],
+                ["Entry", "MB", "Version", "Chain", "Age(s)", "Hits"]))
     return 1 if st.get("parity_bytes") else 0
 
 

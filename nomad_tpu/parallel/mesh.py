@@ -274,6 +274,39 @@ def mesh_solve_fn(mesh, spread_alg: bool, dtype_name: str):
         out_shardings=NamedSharding(mesh, P()))
 
 
+@_single_flight
+@functools.lru_cache(maxsize=None)
+def mesh_delta_scatter_fn(mesh, shape: tuple, dtype_str: str,
+                          n_upd: int, spec):
+    """One jitted mesh-sharded delta-scatter program per (mesh, table
+    shape, dtype, update-count bucket, declared spec) -- the ISSUE-20
+    device-side update under NOMAD_TPU_MESH. Coordinate formulation
+    (the single-device program in solver/constcache.py scatters flat
+    indices): a sharded operand must never reshape to 1D across
+    shards, so the host unravels the flat diff indices into per-axis
+    coordinates and the program scatters in the table's native rank.
+    ``out_shardings`` pins the promoted buffer to the SAME declared
+    PartitionSpec as the resident table (SPEC_GROUPS discipline): the
+    replicated (coords, vals) payload reaches every device and each
+    nodes-axis shard keeps exactly the updates that land in its slice
+    -- whatever collective XLA inserts for that routing is recorded
+    and budgeted by ``shardcheck --compile-audit`` beside the solve
+    programs' argmax/all-gather baselines. No donation: the base
+    buffer may still be referenced by in-flight dispatches."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    del dtype_str, n_upd   # dtypes/shapes ride the traced args; they
+    #                        key the cache (one program per bucket)
+    out = NamedSharding(mesh, spec)
+    ndim = len(shape)
+
+    def _apply(buf, coords, vals):
+        return buf.at[tuple(coords[d] for d in range(ndim))].set(vals)
+
+    return jax.jit(_apply, out_shardings=out)
+
+
 def _note_shard_rows(mesh, group: str, tree, specs) -> None:
     """Fold this tree's per-shard declared/actual byte rows into the
     transfer ledger (xferobs ``per_shard``): declared = what the
@@ -298,7 +331,8 @@ def _note_shard_rows(mesh, group: str, tree, specs) -> None:
         xferobs.note_shard_bytes(group, f"d{dev.id}", per_dev, per_dev)
 
 
-def shard_solver_inputs(mesh, const, init, batch, version=None):
+def shard_solver_inputs(mesh, const, init, batch, version=None,
+                        delta_src=None):
     """NamedShardings for solve_eval_batch inputs, by the registry's
     declared specs: leading axis (E) on 'evals'; node-axis (last dim of
     per-node arrays) on 'nodes'.
@@ -309,12 +343,18 @@ def shard_solver_inputs(mesh, const, init, batch, version=None):
     so repeated fleet tables ship zero bytes and a node-table write
     re-uploads only the shards whose slice actually changed.
     ``version`` is the packing snapshot's node_table_index (hygiene
-    eviction). init/batch ship fresh -- they change every generation
-    -- but still report payload and per-shard rows so
-    ``nomad.solver.dispatch_bytes`` and the ledger's ``per_shard``
-    decomposition cover every transport path."""
+    eviction). The usage tree (mesh_init) routes through the ISSUE-20
+    version chain when ``delta_src`` (the packing snapshot's
+    (store, index)) is given: journal-covered generations ship only
+    the changed elements, replicated, and the mesh-sharded scatter
+    (mesh_delta_scatter_fn) applies them into the resident sharded
+    buffer under the SAME declared spec -- each nodes-axis shard keeps
+    the updates that land in its slice. batch ships fresh -- it
+    changes every generation -- but still reports payload and
+    per-shard rows so ``nomad.solver.dispatch_bytes`` and the ledger's
+    ``per_shard`` decomposition cover every transport path."""
     import jax
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..solver import constcache, xferobs
     from ..solver.constcache import note_dispatch_bytes
@@ -331,6 +371,70 @@ def shard_solver_inputs(mesh, const, init, batch, version=None):
             lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
             tree, specs)
 
+    def put_chain(group, tree):
+        # ISSUE-20 delta route for the usage tree: per-leaf version
+        # chain (solver/constcache.py chain_apply) with a mesh-sharded
+        # scatter. The fuse arena reuses these host buffers across
+        # generations, so chain_apply copies its shadow
+        # (copy_shadow=True). Chain keys carry the Mesh itself: a grid
+        # change re-installs rather than scattering into a buffer
+        # sharded under the old grid.
+        store = token = None
+        if delta_src is not None and constcache.delta_stream_enabled():
+            store, token = delta_src
+            if token is None or not hasattr(store, "alloc_deltas_since"):
+                store = token = None
+        if store is None:
+            return put_fresh(group, tree)
+        specs = declared_specs(group, tree)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        spec_leaves = treedef.flatten_up_to(specs)
+        min_b = constcache._min_bytes()
+        rep = NamedSharding(mesh, P())
+        bufs = []
+        shipped = 0
+        small_total = 0
+        for j, (leaf, spec) in enumerate(zip(leaves, spec_leaves)):
+            arr = np.asarray(leaf)
+            sh = NamedSharding(mesh, spec)
+            if arr.nbytes < min_b:
+                # small leaves ARE the delta traffic; ship by spec
+                bufs.append(jax.device_put(arr, sh))
+                shipped += arr.nbytes
+                small_total += arr.nbytes
+                continue
+
+            def scatter(buf, shape, dtype_str, idx_p, vals_p,
+                        _spec=spec):
+                # unravel the flat diff indices into per-axis
+                # coordinates (a sharded operand must never reshape to
+                # 1D across shards); the replicated puts below ARE the
+                # delta payload crossing the wire
+                coords = np.ascontiguousarray(np.stack(
+                    np.unravel_index(idx_p.astype(np.int64),
+                                     shape)).astype(np.int32))
+                pc = jax.device_put(coords, rep)
+                pv = jax.device_put(vals_p, rep)
+                prog = mesh_delta_scatter_fn(
+                    mesh, shape, dtype_str, int(idx_p.size), _spec)
+                return prog(buf, pc, pv)
+
+            buf, ship_j, _outcome = constcache.chain_apply(
+                (group, arr.dtype.str, arr.shape, j, mesh),
+                arr, store, token, group,
+                put_fn=lambda a, _sh=sh: jax.device_put(a, _sh),
+                scatter=scatter,
+                idx_width=4 * max(1, arr.ndim),
+                copy_shadow=True)
+            bufs.append(buf)
+            shipped += ship_j
+        if xferobs.enabled():
+            if small_total:
+                xferobs.note_payload(group, small_total)
+            _note_shard_rows(mesh, group, tree, specs)
+        note_dispatch_bytes(shipped)
+        return jax.tree_util.tree_unflatten(treedef, bufs)
+
     specs = declared_specs("mesh_const", const)
     leaves, treedef = jax.tree_util.tree_flatten(const)
     shardings = [NamedSharding(mesh, s)
@@ -339,7 +443,7 @@ def shard_solver_inputs(mesh, const, init, batch, version=None):
         leaves, shardings, group="mesh_const", version=version,
         fallback_put=lambda arr, sh: jax.device_put(arr, sh))
     s_const = jax.tree_util.tree_unflatten(treedef, buffers)
-    return (s_const, put_fresh("mesh_init", init),
+    return (s_const, put_chain("mesh_init", init),
             put_fresh("mesh_batch", batch))
 
 

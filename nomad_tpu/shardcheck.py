@@ -581,9 +581,11 @@ def _patched_mesh_lpq_fn(mesh, L_pad: int, N: int, steps: int):
     return _LpqFnWrapper(fn, mesh, L_pad, N, steps)
 
 
-def _patched_shard_solver_inputs(mesh, const, init, batch, version=None):
+def _patched_shard_solver_inputs(mesh, const, init, batch, version=None,
+                                 delta_src=None):
     out = _REAL["shard_solver_inputs"](mesh, const, init, batch,
-                                       version=version)
+                                       version=version,
+                                       delta_src=delta_src)
     if _ACTIVE:
         with _slock:
             _counters["sanctioned_puts"] += 1
@@ -751,6 +753,50 @@ def compile_audit(n_devices: int = 8, evals: Optional[int] = None,
             family, compiled.as_text(), program=entry["program"]) \
             if _ACTIVE else scan_collectives(compiled.as_text())
         entry.update(_cost_summary(compiled))
+    except Exception as e:  # noqa: BLE001 -- inventory over crash
+        entry["audit_error"] = repr(e)
+    out["programs"].append(entry)
+    # the delta-scatter program (ISSUE 20): journal-covered usage-table
+    # generations promote the resident sharded buffer in place instead
+    # of re-shipping it.  The replicated (coords, vals) payload reaches
+    # every device and each shard keeps the updates landing in its
+    # slice; whatever collective XLA inserts for that routing is
+    # budgeted here beside the solve/LPQ baselines.  Audit the smallest
+    # update bucket against the widest mesh_init leaf.
+    init_leaves = jax.tree_util.tree_leaves(init)
+    init_specs = jax.tree_util.tree_leaves(
+        meshmod.declared_specs("mesh_init", init))
+    j, leaf, spec = max(
+        ((j, lf, sp) for j, (lf, sp)
+         in enumerate(zip(init_leaves, init_specs))),
+        key=lambda t: _leaf_nbytes(t[1]))
+    arr = np.asarray(leaf)
+    n_upd = 8       # the minimum _pad_updates bucket
+    ndim = max(1, arr.ndim)
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+    fn = meshmod.mesh_delta_scatter_fn(
+        mesh, arr.shape, arr.dtype.str, n_upd, spec)
+    family = (_mesh_key(mesh)[1], _mesh_key(mesh)[2],
+              "delta_scatter", arr.dtype.str, _norm_spec(spec))
+    entry = {"program": f"mesh_delta_scatter(shape={arr.shape}, "
+                        f"dtype={arr.dtype.str}, n_upd={n_upd})"}
+    try:
+        with mesh:
+            s_buf = jax.device_put(arr, NamedSharding(mesh, spec))
+            s_coords = jax.device_put(
+                np.zeros((ndim, n_upd), dtype=np.int32), rep)
+            s_vals = jax.device_put(
+                np.zeros((n_upd,), dtype=arr.dtype), rep)
+            compiled = fn.lower(s_buf, s_coords, s_vals).compile()
+        entry["collectives"] = audit_hlo(
+            family, compiled.as_text(), program=entry["program"]) \
+            if _ACTIVE else scan_collectives(compiled.as_text())
+        entry.update(_cost_summary(compiled))
+        # the delta payload crossing the wire per promote at this
+        # bucket: replicated coords + vals on every device
+        entry["delta_payload_bytes_per_shard"] = int(
+            n_upd * (4 * ndim + arr.dtype.itemsize))
     except Exception as e:  # noqa: BLE001 -- inventory over crash
         entry["audit_error"] = repr(e)
     out["programs"].append(entry)

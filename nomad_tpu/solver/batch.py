@@ -376,7 +376,8 @@ class _FusedGroup:
 
     __slots__ = ("idxs", "const", "init", "batch", "ptab", "pinit",
                  "A", "e_real", "e_pad", "p_pad", "wave", "spread_alg",
-                 "dtype_name", "cache_version", "entry", "arena_reused")
+                 "dtype_name", "cache_version", "delta_src", "entry",
+                 "arena_reused")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -453,6 +454,7 @@ def _fuse_group(lanes: List[PackedLane], idxs: List[int], key: tuple,
         wave=lane0.wavefront_ok(), spread_alg=lane0.spread_alg,
         dtype_name=lane0.dtype_name,
         cache_version=getattr(lane0, "table_version", None),
+        delta_src=getattr(lane0, "delta_src", None),
         entry=entry, arena_reused=reused)
 
 
@@ -495,7 +497,8 @@ def solve_groups(lanes: List[PackedLane], groups: List[_FusedGroup],
                 out = _dispatch(g.const, g.init, g.batch, g.spread_alg,
                                 g.dtype_name, use_mesh, ptab=g.ptab,
                                 pinit=g.pinit, wave=g.wave,
-                                cache_version=g.cache_version)
+                                cache_version=g.cache_version,
+                                delta_src=g.delta_src)
             finally:
                 dt_ms = (time.perf_counter() - t0) * 1e3
                 xferobs.end_dispatch(dt_ms, t0_wall)
@@ -563,7 +566,7 @@ def fuse_and_solve(lanes: List[PackedLane], use_mesh: bool = True,
 
 def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
               use_mesh: bool, ptab=None, pinit=None, wave: bool = False,
-              cache_version=None):
+              cache_version=None, delta_src=None):
     """One solve_eval_batch[_preempt] call; shards over an (evals, nodes)
     mesh when multiple devices are attached, the shapes divide the
     mesh, and NOMAD_TPU_MESH is not 0 (the pick_mesh chokepoint; off
@@ -583,12 +586,14 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         return solve_lane_fused(const, init, batch, ptab, pinit,
                                 spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True,
-                                wave=wave, cache_version=cache_version)
+                                wave=wave, cache_version=cache_version,
+                                delta_src=delta_src)
     if wave:
         metrics.incr("nomad.solver.wavefront_dispatches")
         return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
                                 dtype_name=dtype_name, batched=True,
-                                wave=True, cache_version=cache_version)
+                                wave=True, cache_version=cache_version,
+                                delta_src=delta_src)
     metrics.incr("nomad.solver.dense_dispatches")
 
     E = const.cpu_cap.shape[0]
@@ -603,7 +608,8 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         metrics.incr("nomad.solver.mesh_dispatches")
         with mesh:
             s_const, s_init, s_batch = shard_solver_inputs(
-                mesh, const, init, batch, version=cache_version)
+                mesh, const, init, batch, version=cache_version,
+                delta_src=delta_src)
             fn = mesh_solve_fn(mesh, spread_alg, dtype_name)
             chosen, scores, n_yielded = fn(s_const, s_init, s_batch)
         from .. import jitcheck
@@ -616,7 +622,8 @@ def _dispatch(const, init, batch, spread_alg: bool, dtype_name: str,
         return combined[0], combined[1], combined[2]
     return solve_lane_fused(const, init, batch, spread_alg=spread_alg,
                             dtype_name=dtype_name, batched=True,
-                            cache_version=cache_version)
+                            cache_version=cache_version,
+                            delta_src=delta_src)
 
 
 def _cross_lane_fixpoint(lanes: List[PackedLane], results: List,

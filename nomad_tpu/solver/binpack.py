@@ -968,7 +968,7 @@ def _make_fused_fn(metas, treedef, group_keys, spread_alg: bool,
 def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
                      spread_alg: bool, dtype_name: str,
                      batched: bool = False, wave: bool = False,
-                     cache_version=None):
+                     cache_version=None, delta_src=None):
     """Solve with minimal transfers: returns host-side numpy
     (chosen int64, scores, n_yielded int64[, evict_rows]). When ``batched``
     every leaf carries a leading eval axis and outputs do too. ``wave``
@@ -977,18 +977,22 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
     (solve_lane_wave). Stacking chosen/n_yielded through the score dtype
     is exact: node indexes and yield counts are < 2^24. ``cache_version``
     tags const-tree buffers in the device-resident cache with the
-    packing snapshot's node_table_index (solver/constcache.py)."""
+    packing snapshot's node_table_index (solver/constcache.py);
+    ``delta_src`` is that snapshot's (store, index) pair for the
+    ISSUE-20 version chain -- journal-covered generations ship only
+    their diff and scatter it into the resident buffers on device."""
     from .cache import enable_compile_cache
     enable_compile_cache()
     if wave and ptab is None:
         return solve_lane_wave(const, init, batch, spread_alg=spread_alg,
                                dtype_name=dtype_name, batched=batched,
-                               cache_version=cache_version)
+                               cache_version=cache_version,
+                               delta_src=delta_src)
     if wave and ptab is not None:
         return solve_lane_wave_preempt(
             const, init, batch, ptab, pinit, spread_alg=spread_alg,
             dtype_name=dtype_name, batched=batched,
-            cache_version=cache_version)
+            cache_version=cache_version, delta_src=delta_src)
     trees = ((const, init, batch) if ptab is None
              else (const, init, batch, ptab, pinit))
     stacked, metas, treedef, group_keys = _fuse_trees(trees)
@@ -998,11 +1002,14 @@ def solve_lane_fused(const, init, batch, ptab=None, pinit=None, *,
     from .constcache import device_put_cached
     # only const-tree buffers (tree index 0) are pinned: init/batch
     # deltas change every dispatch and would churn the LRU. Tags name
-    # each stacked buffer's tree group for the transfer ledger.
+    # each stacked buffer's tree group for the transfer ledger; the
+    # stacked buffers are _fuse_trees' fresh np.stack outputs, so the
+    # version chain may retain them as frozen shadows without copying.
     buffers, _ = device_put_cached(
         stacked, version=cache_version,
         cacheable=[k[0] == 0 for k in group_keys],
-        tags=[_FUSE_TREE_NAMES[k[0]] for k in group_keys])
+        tags=[_FUSE_TREE_NAMES[k[0]] for k in group_keys],
+        delta_src=delta_src)
     out = fn(*buffers)
     # the 3-way output axis is leading in both forms: (3, P) or (3, E, P)
     if ptab is not None:
@@ -2522,7 +2529,8 @@ def _wave_preempt_program(cm_shape, cd_shape, c0_shape,
 
 def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
                             spread_alg: bool, dtype_name: str,
-                            batched: bool = False, cache_version=None):
+                            batched: bool = False, cache_version=None,
+                            delta_src=None):
     """Windowed-preemption solve with host precompute + compact transfer;
     returns host numpy (chosen int64, scores, n_yielded int64,
     evict_rows (P, A) bool), shaped like solve_lane_fused's preempt
@@ -2581,7 +2589,8 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
     cm, cd, sf, si, pn, c0 = _put_eval_sharded(
         batched, compact.shape[0],
         (compact, cand, scal_f, scal_i, pen, counts0),
-        cache_version=cache_version, tag="compact_preempt")
+        cache_version=cache_version, tag="compact_preempt",
+        delta_src=delta_src)
     out = fn(cm, cd, sf, si, pn, c0)
     with jitcheck.sanctioned_fetch("wave_preempt"):
         combined, ev = jax.device_get(out)
@@ -2595,7 +2604,8 @@ def solve_lane_wave_preempt(const, init, batch, ptab, pinit, *,
 
 
 def _put_eval_sharded(batched: bool, e_dim: int, trees,
-                      cache_version=None, tag: str = "compact"):
+                      cache_version=None, tag: str = "compact",
+                      delta_src=None):
     """Device-put a tuple of (possibly nested) arrays, sharding the
     leading eval axis across ALL attached devices when it divides the
     device count (and NOMAD_TPU_MESH is not 0 -- the same master
@@ -2621,8 +2631,14 @@ def _put_eval_sharded(batched: bool, e_dim: int, trees,
     if not (batched and mesh_enabled() and jax.device_count() > 1
             and e_dim % jax.device_count() == 0):
         leaves, treedef = jax.tree_util.tree_flatten(trees)
+        # the compact tables are the wave packers' fresh np.stack
+        # outputs, so the ISSUE-20 version chain can retain them as
+        # frozen shadows and scatter only the changed elements into
+        # the resident buffers (delta_src = the packing snapshot's
+        # (store, index); eval-axis sharded puts below stay wholesale)
         buffers, _ = device_put_cached(leaves, version=cache_version,
-                                       tags=[tag] * len(leaves))
+                                       tags=[tag] * len(leaves),
+                                       delta_src=delta_src)
         return jax.tree_util.tree_unflatten(treedef, buffers)
     # sharded route: the mesh factory, the PartitionSpec and the
     # NamedSharding put all live in parallel/mesh.py (the sharding-spec
@@ -2669,7 +2685,7 @@ def _wave_compact_program(cm_shape, sp_shape, spread_alg: bool,
 
 def solve_lane_wave(const, init, batch, *, spread_alg: bool,
                     dtype_name: str, batched: bool = False,
-                    cache_version=None):
+                    cache_version=None, delta_src=None):
     """Wavefront solve with host precompute + compact transfer; returns
     host numpy (chosen int64, scores, n_yielded int64), shaped like
     solve_lane_fused's non-preempt outputs. The slot-buffer width B is
@@ -2745,7 +2761,7 @@ def solve_lane_wave(const, init, batch, *, spread_alg: bool,
                                use_block)
     cm, sf, si, pn, spd = _put_eval_sharded(
         batched, compact.shape[0], (compact, scal_f, scal_i, pen, sp),
-        cache_version=cache_version)
+        cache_version=cache_version, delta_src=delta_src)
     out = fn(cm, sf, si, pn, spd)
     with jitcheck.sanctioned_fetch("wave"):
         combined = jax.device_get(out)

@@ -62,11 +62,12 @@ class PackedLane:
     __slots__ = ("service", "tg", "places", "nodes", "order", "const",
                  "init", "batch", "dtype_name", "spread_alg", "ptab",
                  "pinit", "cand_allocs", "table_version", "matrix",
-                 "_wave")
+                 "delta_src", "_wave")
 
     def __init__(self, service, tg, places, nodes, order, const, init,
                  batch, dtype_name, spread_alg, ptab=None, pinit=None,
-                 cand_allocs=None, table_version=None, matrix=None):
+                 cand_allocs=None, table_version=None, matrix=None,
+                 delta_src=None):
         self.service = service
         self.tg = tg
         self.places = places
@@ -89,6 +90,11 @@ class PackedLane:
         # the node-universe key the LP-queue tier groups lanes by, and
         # its node_ids are the canonical node axis (solver/lpq.py)
         self.matrix = matrix
+        # delta-streaming source (ISSUE 20): (store, snapshot index) --
+        # the alloc-delta journal + the exact version this lane's
+        # tables were packed AT, so the device-resident chain can
+        # advance v_old -> v_new by scatter instead of re-shipping
+        self.delta_src = delta_src
         self._wave = None
 
     def wavefront_ok(self) -> bool:
@@ -303,7 +309,8 @@ def dispatch_lane(lane: PackedLane):
     return solve_lane_fused(
         lane.const, lane.init, lane.batch, lane.ptab, lane.pinit,
         spread_alg=lane.spread_alg, dtype_name=lane.dtype_name,
-        wave=wave, cache_version=lane.table_version)
+        wave=wave, cache_version=lane.table_version,
+        delta_src=lane.delta_src)
 
 
 class _DeviceShim:
@@ -637,12 +644,22 @@ class TpuPlacementService:
                 from ..server.telemetry import metrics as _tm
                 _tm.incr("nomad.solver.device_preempt_host_fallback")
                 return None
+        # delta-streaming source (ISSUE 20): the store owning the
+        # alloc-delta journal + this pack's snapshot index. Snapshots
+        # expose the backing store as _store; a bare StateStore (tests,
+        # single-shot paths) carries the journal itself.
+        delta_store = getattr(self.ctx.state, "_store", None)
+        if delta_store is None and hasattr(self.ctx.state,
+                                           "alloc_deltas_since"):
+            delta_store = self.ctx.state
         return PackedLane(self, tg, places, nodes, order, const, init,
                           batch, np.dtype(dtype).name, self.spread_alg,
                           ptab=ptab, pinit=pinit, cand_allocs=cand_allocs,
                           table_version=getattr(
                               self.ctx.state, "node_table_index", None),
-                          matrix=matrix)
+                          matrix=matrix,
+                          delta_src=(delta_store, state_index)
+                          if delta_store is not None else None)
 
     @staticmethod
     def _cands_hold_matching_devices(requests, cand_allocs, ptab) -> bool:

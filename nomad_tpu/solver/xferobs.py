@@ -593,6 +593,15 @@ def residency_report(top: int = 12) -> dict:
         "resident_hwm_bytes": hwm,
         "evictions": cc.get("evictions", 0),
         "invalidations": cc.get("invalidations", 0),
+        # ISSUE-20 version chain: device buffers promoted in place by
+        # journal deltas (chain rows in ``top`` carry base_version +
+        # deltas_applied alongside the content-keyed entries)
+        "chain_entries": cc.get("chain_entries", 0),
+        "chain_resident_bytes": cc.get("chain_resident_bytes", 0),
+        "delta_promotions": cc.get("delta_promotions", 0),
+        "delta_reuses": cc.get("delta_reuses", 0),
+        "delta_fallbacks": cc.get("delta_fallbacks", 0),
+        "delta_bytes_total": cc.get("delta_bytes_total", 0),
         "top": snap_entries,
     }
 

@@ -231,6 +231,26 @@ def note_node_table_write(table_index: int) -> None:
         _stat_incr("invalidations")
 
 
+def journal_touched_nodes(pairs) -> set:
+    """The set of node ids an alloc-delta journal span touches: the
+    host-side translation of the PR-6 (old_alloc, new_alloc) pairs into
+    per-node scope (ISSUE 20 delta streaming). An alloc move touches
+    BOTH endpoints -- the node it left (usage freed) and the node it
+    landed on (usage charged). The device-side scatter's update set is
+    the authoritative bitwise diff (under the per-eval fit-order
+    shuffle journal rows don't map to stable device rows), so this
+    scope is the journal's observability half: how many fleet rows the
+    span implicates, surfaced beside the actually-scattered element
+    count in the transfer ledger's chain rows."""
+    touched: set = set()
+    for old, new in pairs:
+        for a in (old, new):
+            nid = getattr(a, "node_id", None)
+            if nid:
+                touched.add(nid)
+    return touched
+
+
 def _reset_pack_caches_for_tests() -> None:
     with _NODE_MATRIX_LOCK:
         _NODE_MATRIX_CACHE.clear()

@@ -111,6 +111,16 @@ HEADLINE_FIELDS = {
     "mesh_shard_bytes": ("lower", 0.25),
     "mesh_collective_ms": ("lower", 0.50),
     "mesh_parity_mismatch": ("lower", 0.0),
+    # delta streaming (ISSUE 20): warm steady-state churn payload per
+    # dispatch must not bloat back toward full-table re-ships, wholesale
+    # fallbacks must not grow (a journal gap or a diff-too-big slot
+    # crept into the steady state), and the churn round's transfer
+    # ledger parity is zero-tolerance like the headline's
+    "churn_delta_bytes_per_dispatch": ("lower", 0.25),
+    "churn_shipped_bytes_per_dispatch": ("lower", 0.25),
+    "churn_delta_fallbacks": ("lower", 0.50),
+    "churn_xfer_ledger_parity": ("lower", 0.0),
+    "delta_fallbacks": ("lower", 0.50),
 }
 
 # Absolute noise floors for lower-better fields whose round-to-round

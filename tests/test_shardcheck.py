@@ -311,20 +311,31 @@ def test_ledger_mismatch_rows_ride_xferobs():
 @needs_mesh
 def test_compile_audit_inventories_programs():
     """compile_audit compiles every registered program for the
-    8-device mesh with NO server -- both greedy spread variants plus
-    the LPQ kernel (ISSUE 19) -- and returns the collective + cost +
-    per-shard-budget inventory."""
+    8-device mesh with NO server -- both greedy spread variants, the
+    LPQ kernel (ISSUE 19) and the delta-scatter program (ISSUE 20) --
+    and returns the collective + cost + per-shard-budget inventory."""
     inv = shardcheck.compile_audit(n_devices=8, nodes=64, place=4)
     assert inv["mesh"] == [4, 2]
-    assert len(inv["programs"]) == 3
+    assert len(inv["programs"]) == 4
     for p in inv["programs"]:
         assert "audit_error" not in p, p
+        if p["program"].startswith("mesh_delta_scatter"):
+            continue
         # the cross-shard reduction (select/argmax for greedy, the
         # dual-ascent gather for LPQ) must be visible
         assert p["collectives"], p
     lpq = [p for p in inv["programs"]
            if p["program"].startswith("mesh_lpq")]
     assert len(lpq) == 1
+    # the ISSUE-20 delta scatter: replicated (coords, vals) in, each
+    # shard keeps the updates landing in its slice -- its sanctioned
+    # collective budget is ZERO, so any future regression inserting an
+    # all-gather into the promote path trips collective_excess
+    ds = [p for p in inv["programs"]
+          if p["program"].startswith("mesh_delta_scatter")]
+    assert len(ds) == 1
+    assert ds[0]["collectives"] == {}
+    assert ds[0]["delta_payload_bytes_per_shard"] > 0
     # the LPQ combine is an all-gather by design (a psum would
     # re-associate the load sum and break bit-parity)
     assert lpq[0]["collectives"].get("all-gather")
